@@ -1,0 +1,63 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capability surface of the
+reference framework (see /root/repo/SURVEY.md): eager tensors with autograd,
+nn.Layer modules, optimizers/AMP, jit-to-static compilation, a 5-axis hybrid
+parallel distributed stack (DP/TP/PP/sharding/SEP/EP) expressed as GSPMD
+shardings over a jax device mesh, and Pallas kernels for the hot ops.
+"""
+
+from __future__ import annotations
+
+# ---- core ----
+from .core.tensor import Tensor, to_tensor, is_tensor
+from .core.tape import no_grad, enable_grad, set_grad_enabled, is_grad_enabled
+from .core.tape import grad as _tape_grad
+from .core.dtypes import (  # noqa: F401
+    bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+    set_default_dtype, get_default_dtype,
+)
+from .core.generator import seed, get_rng_state, set_rng_state, Generator
+from .core.flags import set_flags, get_flags
+from .core import device
+from .core.device import (  # noqa: F401
+    set_device, get_device, CPUPlace, TPUPlace, is_compiled_with_cuda,
+    is_compiled_with_tpu, device_count,
+)
+
+# ---- ops (also patches Tensor methods) ----
+from .tensor import *  # noqa: F401,F403
+from . import tensor  # noqa: F401
+
+# ---- subsystems ----
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import metric  # noqa: F401
+from . import distributed  # noqa: F401
+from . import vision  # noqa: F401
+from . import incubate  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .framework import random as framework_random  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .autograd.py_layer import PyLayer  # noqa: F401
+
+grad = _tape_grad
+
+disable_static = lambda: None  # dygraph is the default and only eager mode
+enable_static = lambda: None   # static mode == jit tracing; see paddle_tpu.jit
+
+__version__ = "0.1.0"
+
+def in_dynamic_mode() -> bool:
+    """True when executing eagerly (not inside a jit trace)."""
+    try:
+        import jax.core as _core
+        return _core.trace_state_clean()
+    except Exception:
+        return True

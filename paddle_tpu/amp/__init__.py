@@ -1,0 +1,15 @@
+"""paddle_tpu.amp — automatic mixed precision (analogue of paddle.amp).
+
+auto_cast installs a per-op dtype policy into core.dispatch (the analogue of
+the eager AMP insert in generated ad_funcs, eager_amp_auto_cast.h); the white/
+black op lists mirror python/paddle/amp/amp_lists.py.  GradScaler implements
+dynamic loss scaling with found-inf short-circuit
+(python/paddle/amp/grad_scaler.py:41).
+"""
+
+from .auto_cast import auto_cast, amp_guard, decorate, amp_decorate, white_list, black_list
+from .grad_scaler import GradScaler, AmpScaler
+from . import debugging  # noqa: F401
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate", "GradScaler",
+           "AmpScaler", "white_list", "black_list", "debugging"]

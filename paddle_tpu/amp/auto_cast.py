@@ -1,0 +1,109 @@
+"""auto_cast (analogue of python/paddle/amp/auto_cast.py:687)."""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..core import dispatch as _dispatch
+from ..core.dtypes import convert_dtype
+
+# op categories (mirroring python/paddle/amp/amp_lists.py)
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "bmm", "mv", "einsum",
+    "addmm", "flash_attention", "sdpa", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose", "lstm", "gru", "rnn_tanh",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "mean", "sum", "softmax",
+    "log_softmax", "cross_entropy", "nll_loss", "layer_norm", "rms_norm",
+    "batch_norm", "group_norm", "instance_norm", "norm", "cumsum", "logsumexp",
+    "sigmoid_focal_loss", "bce_with_logits", "binary_cross_entropy", "pow",
+    "mse_loss", "l1_loss", "kl_div", "softmax_with_cross_entropy", "erfinv",
+    "acos", "asin", "cosh", "sinh", "tan", "atanh", "acosh", "asinh",
+    "reciprocal", "rsqrt",
+}
+
+
+def white_list():
+    return {"float16": {"O1": sorted(WHITE_LIST), "O2": sorted(WHITE_LIST)}}
+
+
+def black_list():
+    return {"float16": {"O1": sorted(BLACK_LIST), "O2": sorted(BLACK_LIST)}}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def _amp_hook(op_name):
+    if not _state.enabled:
+        return None
+    if op_name in _state.custom_black or op_name in BLACK_LIST:
+        return jnp.float32 if _state.level == "O2" else None
+    if op_name in _state.custom_white or op_name in WHITE_LIST:
+        return _state.dtype
+    if _state.level == "O2":
+        return _state.dtype
+    return None
+
+
+_dispatch.set_amp_cast_hook(_amp_hook)
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """Mirror paddle.amp.auto_cast.  Default dtype is bfloat16 — the TPU
+    native half precision (fp16 also accepted)."""
+    prev = (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+            _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = convert_dtype(dtype)
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+         _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Mirror paddle.amp.decorate: O2 casts model params to the AMP dtype and
+    turns on optimizer master weights."""
+    d = convert_dtype(dtype)
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=d)
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        for opt in opt_list:
+            if master_weight is not False:
+                opt._multi_precision = True
+        if single_model and single_opt:
+            return model_list[0], opt_list[0]
+        return model_list if not single_model else model_list[0], opt_list
+    return model_list[0] if single_model else model_list
+
+
+amp_decorate = decorate

@@ -1,0 +1,43 @@
+"""AMP debugging utilities (analogue of python/paddle/amp/debugging.py)."""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..core.flags import set_flags
+from ..core.tensor import Tensor
+
+__all__ = ["check_numerics", "enable_tensor_checker", "disable_tensor_checker",
+           "collect_operator_stats", "DebugMode"]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    arr = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    num_nan = int(jnp.sum(jnp.isnan(arr)))
+    num_inf = int(jnp.sum(jnp.isinf(arr)))
+    if num_nan or num_inf:
+        raise FloatingPointError(
+            f"numerics check failed for {op_type}:{var_name} — "
+            f"{num_nan} NaN, {num_inf} Inf values")
+    return Tensor(jnp.asarray(num_nan)), Tensor(jnp.asarray(num_inf))
+
+
+def enable_tensor_checker():
+    set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+@contextmanager
+def collect_operator_stats():
+    yield

@@ -1,0 +1,15 @@
+"""paddle_tpu.autograd — autograd facade (analogue of paddle.autograd).
+
+backward/grad run the eager tape (core.tape); PyLayer maps onto jax.custom_vjp
+semantics but keeps the reference's class-based API
+(``paddle/fluid/eager/pylayer/``).
+"""
+
+from ..core.tape import backward, grad, no_grad, enable_grad, set_grad_enabled
+from .py_layer import PyLayer, PyLayerContext
+from .functional import jvp, vjp, jacobian, hessian
+
+__all__ = [
+    "backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+    "PyLayer", "PyLayerContext", "jvp", "vjp", "jacobian", "hessian",
+]

@@ -1,0 +1,81 @@
+"""Functional autodiff (analogue of paddle.incubate.autograd jvp/vjp/jacobian/
+hessian, reference ``python/paddle/incubate/autograd/primapi.py``) — thin,
+direct mappings onto jax transforms, which is the TPU-native design: the
+reference needed a primitive-op system to get these; XLA gives them for free.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import tape as _tape
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._value
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _wrap(x):
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap(v) for v in x)
+    if isinstance(x, jax.Array):
+        return Tensor(x)
+    return x
+
+
+def _functionalize(func):
+    def pure(*arrays):
+        with _tape.no_grad():
+            out = func(*[Tensor(a) if isinstance(a, jax.Array) else a
+                         for a in arrays])
+        return _unwrap(out)
+
+    return pure
+
+
+def vjp(func, xs, v=None):
+    xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [_unwrap(x) for x in xs_t]
+    out, vjp_fn = jax.vjp(_functionalize(func), *arrays)
+    if v is None:
+        v = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v = _unwrap(v)
+    grads = vjp_fn(v)
+    return _wrap(out), _wrap(list(grads) if len(grads) > 1 else grads[0])
+
+
+def jvp(func, xs, v=None):
+    xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [_unwrap(x) for x in xs_t]
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        v_t = v if isinstance(v, (list, tuple)) else [v]
+        tangents = tuple(_unwrap(t) for t in v_t)
+    out, tangent_out = jax.jvp(_functionalize(func), tuple(arrays), tangents)
+    return _wrap(out), _wrap(tangent_out)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [_unwrap(x) for x in xs_t]
+    jac = jax.jacrev(_functionalize(func), argnums=tuple(range(len(arrays))))(*arrays)
+    if not isinstance(xs, (list, tuple)):
+        jac = jac[0]
+    return _wrap(jac)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [_unwrap(x) for x in xs_t]
+    hess = jax.hessian(_functionalize(func), argnums=tuple(range(len(arrays))))(*arrays)
+    if not isinstance(xs, (list, tuple)):
+        hess = hess[0][0]
+    return _wrap(hess)

@@ -1,0 +1,88 @@
+"""PyLayer — user-defined forward/backward (reference:
+``paddle/fluid/eager/pylayer/py_layer_node.h``, python ``paddle.autograd.PyLayer``).
+
+The user's ``backward`` staticmethod becomes the tape node's vjp function
+directly; saved tensors live on the context object, mirroring
+``ctx.save_for_backward`` semantics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import tape as _tape
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Subclass and define ``forward(ctx, *args)`` and ``backward(ctx, *grads)``."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with _tape.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        outs = out if isinstance(out, tuple) else (out,)
+
+        in_tensors = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = _tape.is_grad_enabled() and any(
+            not t.stop_gradient for t in in_tensors)
+        if not needs_grad:
+            return out
+
+        out_avals = [
+            jnp.zeros(o.shape, o.dtype) if isinstance(o, Tensor) else o
+            for o in outs
+        ]
+        import jax
+        out_avals = [jax.ShapeDtypeStruct(tuple(o.shape), o.dtype)
+                     for o in outs if isinstance(o, Tensor)]
+
+        def vjp_fn(cts):
+            cts = cts if isinstance(cts, tuple) else (cts,)
+            ct_tensors = tuple(Tensor(c) for c in cts)
+            with _tape.no_grad():
+                grads = cls.backward(ctx, *ct_tensors)
+            grads = grads if isinstance(grads, tuple) else (grads,)
+            out = []
+            gi = iter(grads)
+            for t in in_tensors:
+                g = next(gi, None)
+                out.append(None if g is None else
+                           (g._value if isinstance(g, Tensor) else jnp.asarray(g)))
+            return tuple(out)
+
+        node = _tape.TapeNode(cls.__name__, in_tensors, vjp_fn,
+                              len(out_avals), out_avals)
+        wrapped = []
+        slot = 0
+        for o in outs:
+            if isinstance(o, Tensor):
+                t = Tensor(o._value, stop_gradient=False)
+                t._node = node
+                t._out_index = slot
+                slot += 1
+                wrapped.append(t)
+            else:
+                wrapped.append(o)
+        return tuple(wrapped) if isinstance(out, tuple) else wrapped[0]

@@ -1,0 +1,122 @@
+"""Device management.
+
+TPU-native analogue of ``paddle.device`` (reference:
+``python/paddle/device/__init__.py:244 set_device``) and the backend/device
+registry (``paddle/phi/backends/device_manager.h:134``).  On JAX, devices are
+enumerated by the runtime (PJRT); "places" become thin descriptors wrapping a
+``jax.Device``.  The PJRT plugin mechanism is the analogue of the reference's
+custom-device C API (``paddle/phi/backends/device_ext.h:94``): third-party
+hardware integrates below us, so no extra plugin layer is re-implemented here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+
+
+class Place:
+    """A device descriptor (analogue of ``phi::Place``)."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def is_cpu_place(self):
+        return self.device_type == "cpu"
+
+    def is_tpu_place(self):
+        return self.device_type in ("tpu", "axon")
+
+    def jax_device(self) -> Optional[jax.Device]:
+        devs = [d for d in jax.devices() if _devtype(d) == self.device_type]
+        if not devs:
+            devs = jax.devices()
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+def CPUPlace():
+    return Place("cpu", 0)
+
+
+def TPUPlace(device_id: int = 0):
+    return Place("tpu", device_id)
+
+
+def _devtype(d: jax.Device) -> str:
+    plat = d.platform
+    return "tpu" if plat == "axon" else plat
+
+
+_current_place: Optional[Place] = None
+
+
+@functools.lru_cache(maxsize=None)
+def _default_backend() -> str:
+    return _devtype(jax.devices()[0])
+
+
+def get_all_device_type():
+    return sorted({_devtype(d) for d in jax.devices()})
+
+
+def device_count(device_type: Optional[str] = None) -> int:
+    if device_type is None:
+        return jax.device_count()
+    return len([d for d in jax.devices() if _devtype(d) == device_type])
+
+
+def set_device(device: str) -> Place:
+    """Mirror ``paddle.set_device``; accepts 'cpu', 'tpu', 'tpu:0'."""
+    global _current_place
+    if ":" in device:
+        dtype_, idx = device.split(":", 1)
+        place = Place(dtype_, int(idx))
+    else:
+        place = Place(device, 0)
+    _current_place = place
+    return place
+
+
+def get_device() -> str:
+    place = current_place()
+    return f"{place.device_type}:{place.device_id}"
+
+
+def current_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        _current_place = Place(_default_backend(), 0)
+    return _current_place
+
+
+def is_compiled_with_cuda() -> bool:  # API parity: this build has no CUDA
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(_devtype(d) == "tpu" for d in jax.devices())
+
+
+def is_tpu_backend() -> bool:
+    return _default_backend() == "tpu"
+
+
+def synchronize():
+    """Block until all dispatched device work completes."""
+    (jax.device_put(0.0) + 0).block_until_ready()

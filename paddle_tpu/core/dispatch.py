@@ -1,0 +1,163 @@
+"""Eager op dispatch.
+
+TPU-native analogue of the reference's generated dygraph forward functions
+(``eager_gen.py`` output: AMP cast -> API call -> GradNode wiring; see
+SURVEY §3.1).  Every public op funnels through :func:`dispatch`:
+
+    out = dispatch("matmul", impl_fn, (x, y), attrs)
+
+- ``impl_fn`` is a pure function over jax arrays (closed over attrs).
+- If grad is required, the op runs under ``jax.vjp`` and a TapeNode is
+  recorded (the vjp closure *is* the grad node — XLA traces the transpose).
+- AMP autocast happens here, mirroring eager_amp_auto_cast.h: ops are cast
+  per-policy before the impl runs.
+- NaN/Inf checking (FLAGS_check_nan_inf) mirrors eager/nan_inf_utils.cc.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import tape as _tape
+from .flags import flag
+from .tensor import Tensor
+
+# AMP policy hook — set by paddle_tpu.amp at import; signature:
+#   hook(op_name) -> target dtype to cast floating inputs to, or None.
+# The cast happens INSIDE the differentiated function so cotangents flow
+# back through convert_element_type into the original parameter dtype
+# (master-weight-correct, unlike casting at the boundary).
+_amp_cast_hook = None
+
+
+def set_amp_cast_hook(fn):
+    global _amp_cast_hook
+    _amp_cast_hook = fn
+
+
+# Parameter-access tracker: paddle_tpu.jit sets this to a dict {id: Parameter}
+# during its discovery pass to learn which parameters a traced function reads
+# (the analogue of to_static's program capture of persistable vars).
+_param_tracker = None
+
+
+def set_param_tracker(store):
+    global _param_tracker
+    _param_tracker = store
+
+
+def _as_array(x):
+    if isinstance(x, Tensor):
+        return x._value
+    if isinstance(x, (jax.Array, np.ndarray, int, float, bool, complex, np.generic)):
+        return jnp.asarray(x)
+    return jnp.asarray(x)
+
+
+def _requires_grad(args) -> bool:
+    if not _tape.is_grad_enabled():
+        return False
+    for a in args:
+        if isinstance(a, Tensor) and not a.stop_gradient:
+            return True
+    return False
+
+
+def _check_nan_inf(op_name, arrays):
+    for i, a in enumerate(arrays):
+        if isinstance(a, jax.core.Tracer):
+            continue  # debug check is eager-only; no-op under jit tracing
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            bad = bool(jnp.any(~jnp.isfinite(a)))
+            if bad:
+                raise FloatingPointError(
+                    f"NaN/Inf detected in output {i} of op '{op_name}' "
+                    "(FLAGS_check_nan_inf=1)")
+
+
+def dispatch(op_name: str, impl: Callable, tensor_args: Sequence,
+             nondiff_mask: Sequence[bool] = None,
+             n_diff_outputs: int = None):
+    """Execute ``impl(*arrays)`` eagerly with tape recording.
+
+    tensor_args: positional tensor-like inputs of ``impl``.
+    nondiff_mask: per-input True => never differentiate through that slot.
+    n_diff_outputs: if impl returns a tuple, how many leading outputs are
+      differentiable (the rest, e.g. argmax indices, are detached).
+    """
+    if _param_tracker is not None:
+        for a in tensor_args:
+            if isinstance(a, Tensor) and a._is_param:
+                _param_tracker.setdefault(id(a), a)
+    arrays = [_as_array(a) for a in tensor_args]
+    if _amp_cast_hook is not None:
+        cast_dtype = _amp_cast_hook(op_name)
+        if cast_dtype is not None:
+            inner_impl = impl
+
+            def impl(*full, _inner=inner_impl, _d=cast_dtype):
+                cast = [
+                    a.astype(_d)
+                    if (jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != _d)
+                    else a
+                    for a in full
+                ]
+                return _inner(*cast)
+
+    needs_grad = _requires_grad(tensor_args)
+    if needs_grad and nondiff_mask is not None:
+        needs_grad = any(
+            isinstance(a, Tensor) and not a.stop_gradient and not nd
+            for a, nd in zip(tensor_args, nondiff_mask))
+
+    if not needs_grad:
+        out = impl(*arrays)
+        outs = out if isinstance(out, tuple) else (out,)
+        if flag("check_nan_inf"):
+            _check_nan_inf(op_name, outs)
+        wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
+        return wrapped if isinstance(out, tuple) else wrapped[0]
+
+    # split diff vs nondiff inputs so vjp only tracks the diff ones
+    if nondiff_mask is None:
+        nondiff_mask = [False] * len(arrays)
+    diff_idx = [i for i, nd in enumerate(nondiff_mask) if not nd]
+    fixed = {i: arrays[i] for i, nd in enumerate(nondiff_mask) if nd}
+
+    def f(*diff_arrays):
+        full = list(arrays)
+        for j, i in enumerate(diff_idx):
+            full[i] = diff_arrays[j]
+        for i, v in fixed.items():
+            full[i] = v
+        return impl(*full)
+
+    out, vjp_fn = jax.vjp(f, *[arrays[i] for i in diff_idx])
+    outs = out if isinstance(out, tuple) else (out,)
+    if flag("check_nan_inf"):
+        _check_nan_inf(op_name, outs)
+
+    in_tensors = []
+    for i in diff_idx:
+        a = tensor_args[i]
+        in_tensors.append(a if isinstance(a, Tensor) else Tensor(arrays[i]))
+
+    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+    node = _tape.TapeNode(op_name, in_tensors, vjp_fn, len(outs), out_avals,
+                          out_is_tuple=isinstance(out, tuple))
+
+    if n_diff_outputs is None:
+        n_diff_outputs = len(outs)
+    wrapped = []
+    for slot, o in enumerate(outs):
+        diff = slot < n_diff_outputs and jnp.issubdtype(o.dtype, jnp.inexact)
+        t = Tensor(o, stop_gradient=not diff)
+        if diff:
+            t._node = node
+            t._out_index = slot
+        wrapped.append(t)
+    return tuple(wrapped) if isinstance(out, tuple) else wrapped[0]

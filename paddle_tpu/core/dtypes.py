@@ -1,0 +1,114 @@
+"""Dtype system.
+
+Maps the reference's ``phi::DataType`` (``paddle/phi/common/data_type.h``) onto
+JAX/numpy dtypes.  Dtypes are exposed both as objects (``paddle_tpu.float32``)
+and accepted as strings (``'float32'``), matching the reference Python API.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (numpy dtype instances — what jnp arrays report).
+# TPU-native dtype policy: the widest integer/float on the compute path is
+# 32-bit (TPUs have no 64-bit ALU path worth using; XLA x64 stays disabled).
+# 'int64'/'float64' are accepted everywhere as ALIASES of the 32-bit types —
+# the same "accept the name, run 32-bit" policy the reference applies on
+# accelerators that lack fp64.
+bool_ = jnp.dtype(jnp.bool_)
+uint8 = jnp.dtype(jnp.uint8)
+int8 = jnp.dtype(jnp.int8)
+int16 = jnp.dtype(jnp.int16)
+int32 = jnp.dtype(jnp.int32)
+int64 = jnp.dtype(jnp.int32)
+float16 = jnp.dtype(jnp.float16)
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float32 = jnp.dtype(jnp.float32)
+float64 = jnp.dtype(jnp.float32)
+complex64 = jnp.dtype(jnp.complex64)
+complex128 = jnp.dtype(jnp.complex64)
+float8_e4m3fn = jnp.dtype(jnp.float8_e4m3fn)
+float8_e5m2 = jnp.dtype(jnp.float8_e5m2)
+
+_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "half": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "float": float32,
+    "float64": float64,
+    "double": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+}
+
+FLOATING = (float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2)
+INTEGER = (uint8, int8, int16, int32, int64)
+COMPLEX = (complex64, complex128)
+
+
+def convert_dtype(dtype) -> jnp.dtype:
+    """Normalise a dtype spec (str | np/jnp dtype | python type) to a dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _ALIASES[dtype]
+        except KeyError:
+            raise ValueError(f"unsupported dtype string {dtype!r}") from None
+    if dtype is bool:
+        return bool_
+    if dtype is int:
+        return int64
+    if dtype is float:
+        return float32
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Canonical string name for a dtype."""
+    d = convert_dtype(dtype)
+    return np.dtype(d).name if d != bfloat16 else "bfloat16"
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in FLOATING
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in INTEGER
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype) in COMPLEX
+
+
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    """Mirror ``paddle.set_default_dtype``."""
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise ValueError(f"default dtype must be floating, got {dtype_name(d)}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    """Mirror ``paddle.get_default_dtype`` (returns canonical string)."""
+    return dtype_name(_default_dtype)
+
+
+def default_float_dtype():
+    return _default_dtype

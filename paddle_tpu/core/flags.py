@@ -1,0 +1,79 @@
+"""Global flag registry.
+
+TPU-native analogue of the reference's gflags-style system
+(``paddle/phi/core/flags.h:46-90``, surfaced in Python as
+``paddle.set_flags/get_flags`` and ``FLAGS_*`` env vars).  Flags are plain
+Python values; env vars named ``FLAGS_<name>`` override defaults at import.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Iterable, Union
+
+from .errors import NotFoundError
+
+_lock = threading.Lock()
+_FLAGS: Dict[str, Any] = {}
+_DEFS: Dict[str, dict] = {}
+
+
+def _coerce(value: str, default: Any):
+    if isinstance(default, bool):
+        return value.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(value)
+    if isinstance(default, float):
+        return float(value)
+    return value
+
+
+def define_flag(name: str, default: Any, help_str: str = "") -> None:
+    """Register a flag (``PHI_DEFINE_EXPORTED_*`` analogue)."""
+    with _lock:
+        _DEFS[name] = {"default": default, "help": help_str}
+        env = os.environ.get("FLAGS_" + name)
+        _FLAGS[name] = _coerce(env, default) if env is not None else default
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Set flag values; mirrors ``paddle.set_flags``."""
+    with _lock:
+        for k, v in flags.items():
+            key = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+            if key not in _FLAGS:
+                raise NotFoundError(f"unknown flag {k!r}")
+            _FLAGS[key] = v
+
+
+def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:
+    """Get flag values; mirrors ``paddle.get_flags``."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    with _lock:
+        for k in flags:
+            key = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+            if key not in _FLAGS:
+                raise NotFoundError(f"unknown flag {k!r}")
+            out["FLAGS_" + key] = _FLAGS[key]
+    return out
+
+
+def flag(name: str) -> Any:
+    """Fast internal accessor."""
+    return _FLAGS[name]
+
+
+# Core flags (subset of paddle/phi/core/flags.cc that is meaningful on TPU).
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf in eager mode")
+define_flag("benchmark", False, "block on every op for accurate eager timing")
+define_flag("use_autotune", True, "enable pallas kernel autotuning cache")
+define_flag("eager_log_level", 0, "verbosity of eager dispatch logging")
+define_flag("low_precision_op_list", 0, "record ops executed under AMP")
+define_flag("default_dtype", "float32", "default floating point dtype")
+define_flag("prefer_pallas_kernels", True,
+            "use pallas kernels for flash-attention/norms on TPU backends")
+define_flag("allocator_strategy", "auto_growth",
+            "accepted for API parity; XLA owns device memory on TPU")

@@ -1,0 +1,111 @@
+"""Random number generator state.
+
+TPU-native analogue of ``phi::Generator`` (``paddle/phi/core/generator.h``):
+the reference keeps a per-device (seed, philox-offset) pair; ops draw by
+advancing the offset.  JAX PRNG is already counter-based (threefry), so the
+natural mapping is: ``state = (base_key, offset)``; each draw folds the
+current offset into the base key and bumps the offset.  This gives the same
+"global seed + stateful stream" UX as the reference while every individual
+key is pure, so drawn ops remain jit-traceable.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+
+class Generator:
+    """Stateful RNG stream over a counter-based pure PRNG."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int) -> "Generator":
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._base_key = jax.random.key(int(seed))
+            self._offset = 0
+        return self
+
+    def seed(self) -> int:
+        return self._seed
+
+    def get_state(self):
+        return (self._seed, self._offset)
+
+    def set_state(self, state):
+        seed, offset = state
+        self.manual_seed(seed)
+        self._offset = int(offset)
+
+    def next_key(self):
+        """Draw the next PRNG key (advances the offset).  Inside a to_static
+        trace (trace key pushed), keys derive from the traced input key."""
+        if _trace_key_stack:
+            entry = _trace_key_stack[-1]
+            key = jax.random.fold_in(entry[0], entry[1])
+            entry[1] += 1
+            return key
+        with self._lock:
+            offset = self._offset
+            self._offset += 1
+        return jax.random.fold_in(self._base_key, offset)
+
+    def split(self, n: int):
+        return jax.random.split(self.next_key(), n)
+
+
+# When a trace key is pushed (by paddle_tpu.jit during to_static tracing),
+# draws derive from it instead of the concrete base key, so compiled programs
+# take the RNG key as an *input* and dropout masks vary per call — the
+# jit-correct analogue of the reference's seeded dropout ops in static graphs.
+_trace_key_stack = []
+
+
+def push_trace_key(key):
+    _trace_key_stack.append([key, 0])
+
+
+def pop_trace_key():
+    _trace_key_stack.pop()
+
+
+_default_generator: Optional[Generator] = None
+_lock = threading.Lock()
+
+
+def default_generator() -> Generator:
+    global _default_generator
+    if _default_generator is None:
+        with _lock:
+            if _default_generator is None:
+                _default_generator = Generator(0)
+    return _default_generator
+
+
+def seed(value: int) -> Generator:
+    """Mirror ``paddle.seed``: reset the global generator."""
+    gen = default_generator()
+    gen.manual_seed(value)
+    return gen
+
+
+def get_rng_state():
+    return [default_generator().get_state()]
+
+
+def set_rng_state(states):
+    default_generator().set_state(states[0])
+
+
+def next_key():
+    if _trace_key_stack:
+        entry = _trace_key_stack[-1]
+        key = jax.random.fold_in(entry[0], entry[1])
+        entry[1] += 1
+        return key
+    return default_generator().next_key()

@@ -1,0 +1,281 @@
+"""The eager Tensor facade.
+
+TPU-native analogue of ``paddle::Tensor`` + dygraph autograd meta
+(reference: ``paddle/phi/api/include/tensor.h``,
+``paddle/fluid/eager/autograd_meta.h:61``).  A ``Tensor`` wraps an immutable
+``jax.Array`` plus mutable framework state: ``stop_gradient``, ``.grad``,
+tape linkage, hooks, and a name.  In-place ops swap the wrapped array (XLA
+arrays are immutable; mutation is a facade — the TPU-correct design).
+
+The ``__jax_array__`` protocol makes Tensors directly consumable by any
+``jax.numpy`` function, which keeps interop and testing friction-free.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes as _dtypes
+from . import tape as _tape
+from .device import current_place
+
+_name_counter = itertools.count()
+
+
+def _auto_name(prefix="tensor"):
+    return f"{prefix}_{next(_name_counter)}"
+
+
+class Tensor:
+    __slots__ = (
+        "_value", "stop_gradient", "_grad", "_node", "_out_index",
+        "_grad_hooks", "name", "persistable", "_is_param", "_dist_attr",
+        "__weakref__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = value if isinstance(value, jax.Array) else jnp.asarray(value)
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._node = None          # producing TapeNode (None => leaf)
+        self._out_index = 0        # output slot in the producing node
+        self._grad_hooks = []
+        self.name = name or _auto_name()
+        self.persistable = False
+        self._is_param = False
+        self._dist_attr = None     # sharding annotation (PartitionSpec) if any
+
+    # ---- array protocol interop ----
+    def __jax_array__(self):
+        return self._value
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._value)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    # ---- meta ----
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        return current_place()
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    @property
+    def T(self):
+        from .. import tensor as ops
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def mT(self):
+        from .. import tensor as ops
+        perm = list(range(self.ndim))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        return ops.transpose(self, perm)
+
+    def numel(self):
+        return self.size
+
+    # ---- conversions ----
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return np.asarray(self._value).item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def astype(self, dtype):
+        from .dispatch import dispatch
+        d = _dtypes.convert_dtype(dtype)
+        return dispatch("cast", lambda x: x.astype(d), (self,))
+
+    cast = astype
+
+    def clone(self):
+        from .dispatch import dispatch
+        return dispatch("clone", lambda x: x + jnp.zeros((), x.dtype), (self,))
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._value, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        # accepts dtype or device strings like the reference's Tensor.to
+        out = self
+        for a in args:
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu"):
+                continue  # single logical device space under jit
+            out = out.astype(a)
+        if "dtype" in kwargs and kwargs["dtype"] is not None:
+            out = out.astype(kwargs["dtype"])
+        return out
+
+    # ---- autograd ----
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        if value is None:
+            self._grad = None
+        else:
+            self._grad = value if isinstance(value, Tensor) else Tensor(value)
+
+    def _wrap_grad(self, arr):
+        return Tensor(arr, stop_gradient=True, name=self.name + "@GRAD")
+
+    def _accumulate_grad(self, arr):
+        if self._grad is None:
+            self._grad = self._wrap_grad(arr)
+        else:
+            self._grad = self._wrap_grad(self._grad._value + arr)
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        _tape.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        """Register a gradient hook (reference: eager/hooks.h TensorHook)."""
+        self._grad_hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                try:
+                    self._grad_hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        self._node = None
+        return self
+
+    def set_value(self, value):
+        value = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+        self._value = value.astype(self._value.dtype) if value.dtype != self._value.dtype else value
+        self._node = None
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def _in_place_update(self, new_tensor: "Tensor"):
+        """Adopt another tensor's value+tape linkage (in-place op facade)."""
+        self._value = new_tensor._value
+        self._node = new_tensor._node
+        self._out_index = new_tensor._out_index
+        self.stop_gradient = new_tensor.stop_gradient
+        if self._node is not None:
+            # re-point the node's recorded output tensor to self is not needed:
+            # nodes reference inputs only; outputs are tracked via (_node,_out_index)
+            pass
+        return self
+
+    # ---- python protocol ----
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __bool__(self):
+        return bool(np.asarray(self._value))
+
+    def __int__(self):
+        return int(np.asarray(self._value))
+
+    def __float__(self):
+        return float(np.asarray(self._value))
+
+    def __index__(self):
+        return int(np.asarray(self._value))
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={_dtypes.dtype_name(self.dtype)}"
+                f"{grad_info},\n       {np.asarray(self._value)})")
+
+    def __dlpack__(self, *a, **k):
+        return self._value.__dlpack__(*a, **k)
+
+    def __dlpack_device__(self):
+        return self._value.__dlpack_device__()
+
+    # Arithmetic/indexing methods are patched in by paddle_tpu.tensor at import
+    # (the analogue of python/paddle/base/dygraph/tensor_patch_methods.py).
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """Mirror ``paddle.to_tensor``."""
+    if isinstance(data, Tensor):
+        out = data.astype(dtype) if dtype is not None else data.clone()
+        out.stop_gradient = stop_gradient
+        return out
+    d = _dtypes.convert_dtype(dtype)
+    if d is None and not hasattr(data, "dtype"):
+        # python scalars/lists: match the reference's defaulting rules
+        probe = np.asarray(data)
+        if probe.dtype == np.float64:
+            d = _dtypes.default_float_dtype()
+        elif probe.dtype == np.int64:
+            d = _dtypes.int64
+    arr = jnp.asarray(data, dtype=d)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)

@@ -1,0 +1,43 @@
+"""paddle_tpu.distributed — hybrid-parallel stack over a jax device mesh.
+
+SURVEY §2.5 parity map:
+- DP                        -> batch-axis sharding ("data") + GSPMD grad psum
+- TP (Column/Row/Vocab)     -> fleet.mp_layers with weight shardings ("model")
+- PP (1F1B / interleaved)   -> fleet.pipeline schedules over the "pipe" axis
+- sharding (ZeRO 1/2/3)     -> sharded optimizer states / params ("sharding")
+- SP / sep (Ulysses)        -> fleet.sequence_parallel ("sep" axis all_to_all)
+- EP (MoE)                  -> moe layer with all_to_all dispatch
+- HybridCommunicateGroup    -> topology.HybridCommunicateGroup -> jax Mesh
+- collective API            -> collective.py (axis-name collectives)
+"""
+
+from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env,
+                  is_initialized)
+from .collective import (ReduceOp, all_gather, all_gather_object, all_reduce,
+                         alltoall, alltoall_single, barrier, batch_isend_irecv,
+                         broadcast, destroy_process_group, get_group, irecv,
+                         isend, new_group, P2POp, recv, reduce, reduce_scatter,
+                         scatter, send, stream, wait)
+from .topology import (AXIS_ORDER, CommunicateTopology,
+                       HybridCommunicateGroup, build_mesh, get_global_mesh,
+                       set_global_mesh, get_hybrid_communicate_group,
+                       set_hybrid_communicate_group)
+from .parallel import DataParallel, shard_tensor_dp, spawn
+from .sharding_api import shard_tensor, shard_layer, shard_optimizer, reshard
+from . import fleet  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .utils import recompute  # noqa: F401
+from .launch_api import launch  # noqa: F401
+
+__all__ = [
+    "ParallelEnv", "get_rank", "get_world_size", "init_parallel_env",
+    "is_initialized", "ReduceOp", "all_reduce", "all_gather",
+    "all_gather_object", "reduce", "reduce_scatter", "alltoall",
+    "alltoall_single", "broadcast", "scatter", "send", "recv", "isend",
+    "irecv", "barrier", "wait", "stream", "new_group", "get_group",
+    "destroy_process_group", "P2POp", "batch_isend_irecv",
+    "CommunicateTopology", "HybridCommunicateGroup", "build_mesh",
+    "get_global_mesh", "set_global_mesh", "DataParallel", "spawn", "fleet",
+    "shard_tensor", "shard_layer", "shard_optimizer", "reshard", "recompute",
+    "launch",
+]

@@ -1,0 +1,13 @@
+"""Auto-parallel API (analogue of python/paddle/distributed/auto_parallel/).
+
+The dygraph semi-auto surface (shard_tensor/reshard/ProcessMesh) lives in
+paddle_tpu.distributed.sharding_api; this package re-exports it and hosts the
+static Engine analogue (strategy-driven compiled training).
+"""
+
+from ..sharding_api import (Partial, ProcessMesh, Replicate, Shard, reshard,
+                            shard_layer, shard_optimizer, shard_tensor)
+from .engine import Engine, Strategy
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "shard_layer", "shard_optimizer", "reshard", "Engine", "Strategy"]

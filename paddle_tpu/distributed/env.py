@@ -1,0 +1,87 @@
+"""Process-level distributed environment.
+
+Analogue of the reference's launch-env contract (PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM / MASTER_ADDR, parallel.py:925 init_parallel_env).  On
+JAX, multi-host initialization goes through jax.distributed (the coordination
+service replaces TCPStore) and intra-host parallelism is device-level SPMD,
+so "rank" here means *process* index for multi-host runs and 0 for the
+common single-process multi-device case.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK",
+                                  os.environ.get("LOCAL_RANK", 0)))
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    try:
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    try:
+        return jax.process_count()
+    except Exception:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_parallel_env():
+    """Initialize multi-process coordination (reference parallel.py:925).
+
+    Uses env vars compatible with both the reference's launcher contract and
+    JAX's: MASTER_ADDR/MASTER_PORT (or PADDLE_MASTER), PADDLE_TRAINER_ID,
+    PADDLE_TRAINERS_NUM.  Single-process runs are a no-op — SPMD over local
+    devices needs no process group.
+    """
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    n_procs = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                 os.environ.get("WORLD_SIZE", 1)))
+    if n_procs > 1 and jax.process_count() == 1:
+        addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
+        port = os.environ.get("MASTER_PORT", "8787")
+        pid = int(os.environ.get("PADDLE_TRAINER_ID",
+                                 os.environ.get("RANK", 0)))
+        jax.distributed.initialize(
+            coordinator_address=f"{addr}:{port}",
+            num_processes=n_procs, process_id=pid)
+    _initialized = True
+    return ParallelEnv()

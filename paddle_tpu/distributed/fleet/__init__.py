@@ -1,0 +1,20 @@
+"""paddle_tpu.distributed.fleet — the Fleet facade (analogue of
+python/paddle/distributed/fleet/fleet.py:99).
+"""
+
+from .fleet_base import (DistributedStrategy, Fleet, fleet, init,
+                         distributed_model, distributed_optimizer,
+                         get_hybrid_communicate_group)
+from . import meta_parallel  # noqa: F401
+from .meta_parallel.parallel_layers.mp_layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy)
+from .meta_parallel.parallel_layers.random import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed)
+from .utils import sequence_parallel_utils  # noqa: F401
+
+__all__ = ["Fleet", "fleet", "init", "DistributedStrategy",
+           "distributed_model", "distributed_optimizer",
+           "get_hybrid_communicate_group", "meta_parallel",
+           "ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy"]

@@ -1,0 +1,134 @@
+"""Fleet singleton + DistributedStrategy (reference fleet.py:99,
+distributed_strategy.py:121)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..topology import (CommunicateTopology, HybridCommunicateGroup,
+                        set_hybrid_communicate_group,
+                        get_hybrid_communicate_group as _get_hcg)
+
+
+class _HybridConfig(dict):
+    def __getattr__(self, k):
+        return self[k]
+
+
+class DistributedStrategy:
+    """Switch container (reference wraps distributed_strategy.proto; here a
+    plain object with the same field names used by the training recipes)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.fuse_all_reduce_ops = True
+        self.find_unused_parameters = False
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid_configs={self.hybrid_configs})"
+
+
+class Fleet:
+    def __init__(self):
+        self._is_initialized = False
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._user_defined_optimizer = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        dp = int(hc.get("dp_degree", 1))
+        mp = int(hc.get("mp_degree", 1))
+        pp = int(hc.get("pp_degree", 1))
+        sh = int(hc.get("sharding_degree", 1))
+        sep = int(hc.get("sep_degree", 1))
+        import jax
+        n_dev = len(jax.devices())
+        # auto-fill dp like the reference launcher: remaining devices -> dp
+        specified = mp * pp * sh * sep * dp
+        if specified < n_dev and n_dev % (mp * pp * sh * sep) == 0 and dp == 1:
+            dp = n_dev // (mp * pp * sh * sep)
+            hc["dp_degree"] = dp
+        topo = CommunicateTopology(
+            ["data", "pipe", "sharding", "sep", "model"],
+            [dp, pp, sh, sep, mp])
+        self._hcg = HybridCommunicateGroup(topo)
+        set_hybrid_communicate_group(self._hcg)
+        self._is_initialized = True
+        return self
+
+    def is_first_worker(self):
+        from ..env import get_rank
+        return get_rank() == 0
+
+    def worker_index(self):
+        from ..env import get_rank
+        return get_rank()
+
+    def worker_num(self):
+        from ..env import get_world_size
+        return get_world_size()
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def distributed_model(self, model):
+        from .model import distributed_model as _dm
+        return _dm(model, self._hcg, self._strategy)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from .meta_optimizers.hybrid_parallel_optimizer import (
+            HybridParallelOptimizer)
+        self._user_defined_optimizer = optimizer
+        if self._hcg is not None and self._hcg.nranks > 1:
+            return HybridParallelOptimizer(optimizer, self._hcg,
+                                           self._strategy)
+        return optimizer
+
+    def barrier_worker(self):
+        pass
+
+    def stop_worker(self):
+        pass
+
+
+fleet = Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    return fleet.init(role_maker, is_collective, strategy, log_level)
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def get_hybrid_communicate_group():
+    return fleet._hcg or _get_hcg()

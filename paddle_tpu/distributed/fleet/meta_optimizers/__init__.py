@@ -1,0 +1,4 @@
+from .hybrid_parallel_optimizer import HybridParallelOptimizer
+from .dygraph_sharding_optimizer import DygraphShardingOptimizer
+
+__all__ = ["HybridParallelOptimizer", "DygraphShardingOptimizer"]

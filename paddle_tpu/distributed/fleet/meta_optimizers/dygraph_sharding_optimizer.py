@@ -1,0 +1,63 @@
+"""DygraphShardingOptimizer — ZeRO stage-1 (analogue of
+meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py:39).
+
+TPU-native: instead of rank-partitioned python param lists + broadcast, the
+optimizer annotates its accumulators with a sharding over the "sharding"
+mesh axis.  Under the compiled train step, GSPMD keeps optimizer states
+sharded (ZeRO-1 memory) and the param update gathers via ICI — the same
+memory/communication tradeoff as the reference's shard+broadcast, chosen by
+the compiler.  Eagerly (1 device) it is a passthrough.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...topology import get_global_mesh
+
+SHARDING_AXIS = "sharding"
+
+
+class DygraphShardingOptimizer:
+    def __init__(self, optimizer, hcg=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._sharded = (hcg is not None and
+                         hcg.get_sharding_parallel_world_size() > 1)
+        if self._sharded:
+            self._wrap_accumulator_creation()
+
+    def _wrap_accumulator_creation(self):
+        inner = self._inner_opt
+        orig_add = inner._add_accumulator
+        mesh = get_global_mesh()
+
+        def sharded_add(name, param, fill_value=0.0, dtype=None):
+            arr = orig_add(name, param, fill_value, dtype)
+            if mesh is None or isinstance(arr, jax.core.Tracer):
+                return arr
+            # shard the largest dim over the sharding axis when divisible
+            spec_axes = [None] * arr.ndim
+            shard_size = mesh.shape[SHARDING_AXIS]
+            for i, s in enumerate(arr.shape):
+                if s % shard_size == 0 and s >= shard_size:
+                    spec_axes[i] = SHARDING_AXIS
+                    break
+            spec = PartitionSpec(*spec_axes)
+            placed = jax.device_put(arr, NamedSharding(mesh, spec))
+            inner._accumulators[name][id(param)] = placed
+            return placed
+
+        inner._add_accumulator = sharded_add
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad

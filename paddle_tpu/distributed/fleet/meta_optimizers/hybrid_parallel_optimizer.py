@@ -1,0 +1,50 @@
+"""HybridParallelOptimizer (analogue of
+meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:
+HybridParallelClipGrad:45, HybridParallelOptimizer:265).
+
+On the single-program SPMD model, gradients of replicated params are already
+globally reduced by GSPMD, so the optimizer's distributed duties reduce to:
+global-norm clipping that is correct across sharded params (sum of squares is
+computed over the full logical tensors — GSPMD handles partial shards), and
+delegating everything else to the inner optimizer.
+"""
+
+from __future__ import annotations
+
+from ....nn.clip import ClipGradByGlobalNorm
+
+
+class HybridParallelClipGrad(ClipGradByGlobalNorm):
+    def __init__(self, clip, hcg):
+        super().__init__(getattr(clip, "clip_norm", 1.0))
+        self._hcg = hcg
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        inner_clip = optimizer._grad_clip
+        if isinstance(inner_clip, ClipGradByGlobalNorm) and hcg is not None:
+            optimizer._grad_clip = HybridParallelClipGrad(inner_clip, hcg)
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *args, **kwargs):
+        return self._inner_opt.minimize(loss, *args, **kwargs)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)

@@ -1,0 +1,18 @@
+"""Meta-parallel wrappers (analogue of fleet/meta_parallel/)."""
+
+from .parallel_layers.mp_layers import (ColumnParallelLinear,
+                                        RowParallelLinear,
+                                        VocabParallelEmbedding,
+                                        ParallelCrossEntropy)
+from .parallel_layers.pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
+from .parallel_layers.random import (RNGStatesTracker, get_rng_state_tracker,
+                                     model_parallel_random_seed)
+from .tensor_parallel import TensorParallel
+from .pipeline_parallel import PipelineParallel
+from .sharding_parallel import ShardingParallel
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy", "LayerDesc",
+           "SharedLayerDesc", "PipelineLayer", "RNGStatesTracker",
+           "get_rng_state_tracker", "model_parallel_random_seed",
+           "TensorParallel", "PipelineParallel", "ShardingParallel"]

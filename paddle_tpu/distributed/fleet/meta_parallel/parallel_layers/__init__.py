@@ -1,0 +1,5 @@
+from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                        VocabParallelEmbedding, ParallelCrossEntropy)
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
+from .random import (RNGStatesTracker, get_rng_state_tracker,
+                     model_parallel_random_seed)

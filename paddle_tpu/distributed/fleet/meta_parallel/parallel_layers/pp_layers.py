@@ -1,0 +1,183 @@
+"""Pipeline layer description & segmentation.
+
+Analogue of ``fleet/meta_parallel/parallel_layers/pp_layers.py`` (LayerDesc:56,
+SharedLayerDesc:76, SegmentLayers:92, PipelineLayer:239).  PipelineLayer keeps
+the reference's description API; stage assignment feeds the shard_map pipeline
+engine (paddle_tpu.distributed.pipeline_engine) on a mesh, and runs serially
+(functionally identical) on one device.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+from .....nn.layer.layers import Layer
+from .....nn.layer.container import LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr
+                 ="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Partition N layer descs into num_parts stages (reference :92)."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform",
+                 num_virtual_pipeline_stage=None):
+        self._layers_desc = layers_desc
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(layers_desc)
+        assert self.num_items >= self.num_parts
+
+    def do_segment(self):
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.num_parts)
+        if self.method.startswith("layer:"):
+            # segment by layer class name occurrences
+            name = self.method.split(":", 1)[1]
+            marks = [0]
+            cnt = sum(1 for d in self._layers_desc
+                      if self._name_of(d) == name)
+            per = cnt // self.num_parts
+            assert per > 0, "fewer marked layers than stages"
+            seen = 0
+            for i, d in enumerate(self._layers_desc):
+                if self._name_of(d) == name:
+                    seen += 1
+                    if seen % per == 0 and len(marks) < self.num_parts:
+                        marks.append(i + 1)
+            marks.append(self.num_items)
+            while len(marks) < self.num_parts + 1:
+                marks.append(self.num_items)
+            return marks
+        if self.method == "parameters":
+            weights = [self._param_count(d) or 1 for d in self._layers_desc]
+            total = sum(weights)
+            target = total / self.num_parts
+            marks = [0]
+            acc = 0
+            for i, w in enumerate(weights):
+                acc += w
+                if acc >= target and len(marks) < self.num_parts:
+                    marks.append(i + 1)
+                    acc = 0
+            marks.append(self.num_items)
+            while len(marks) < self.num_parts + 1:
+                marks.append(self.num_items)
+            return marks
+        raise ValueError(f"unknown segment method {self.method}")
+
+    @staticmethod
+    def _name_of(desc):
+        if isinstance(desc, LayerDesc):
+            return desc.layer_func.__name__
+        return type(desc).__name__
+
+    @staticmethod
+    def _param_count(desc):
+        return 0  # uniform fallback weight for non-built descs
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        result = [0] * (num_parts + 1)
+        part_size = math.floor(num_items / num_parts)
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+
+class PipelineLayer(Layer):
+    """Reference PipelineLayer:239.  Holds the full layer list; ``forward``
+    runs end-to-end (single-program SPMD semantics).  ``get_stage_layers``
+    exposes per-stage slices for the pipeline engine; shared embeddings
+    (SharedLayerDesc with the same key) share one parameter instance."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0,
+                 num_virtual_pipeline_stages=None, **kwargs):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._recompute_interval = recompute_interval
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = num_stages or 1
+        self._shared = {}
+
+        seg = SegmentLayers(self._layers_desc, self._num_stages, seg_method)
+        self.segment_parts = seg.do_segment()
+
+        built = []
+        for desc in self._layers_desc:
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name not in self._shared:
+                    self._shared[desc.layer_name] = desc.build_layer()
+                layer = self._shared[desc.layer_name]
+                built.append((layer, desc.forward_func))
+            elif isinstance(desc, LayerDesc):
+                built.append((desc.build_layer(), None))
+            elif isinstance(desc, Layer):
+                built.append((desc, None))
+            elif callable(desc):
+                built.append((desc, None))
+            else:
+                raise TypeError(f"bad layer desc {desc!r}")
+        self.run_function = built
+        self._layer_list = LayerList(
+            [l for l, _ in built if isinstance(l, Layer)])
+
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    def get_num_virtual_stages(self):
+        return 1
+
+    def stage_boundaries(self, stage_id):
+        return self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+
+    def get_stage_layers(self, stage_id):
+        s, e = self.stage_boundaries(stage_id)
+        return self.run_function[s:e]
+
+    def forward(self, input, chunk_id=None):
+        x = input
+        for i, (layer, fwd) in enumerate(self.run_function):
+            if fwd is not None:
+                x = fwd(layer, x)
+            elif isinstance(layer, Layer):
+                if self._recompute_interval > 0 and \
+                        i % self._recompute_interval == 0 and self.training:
+                    from ....utils import recompute
+                    x = recompute(layer, x)
+                else:
+                    x = layer(x)
+            else:
+                x = layer(x)
+        return x

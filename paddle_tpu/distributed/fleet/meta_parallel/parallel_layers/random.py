@@ -1,0 +1,78 @@
+"""RNG state tracking for tensor parallel (analogue of
+fleet/layers/mpu/random.py: RNGStatesTracker:34,
+model_parallel_random_seed:88).
+
+On the counter-based PRNG, a "state" is just (base_key, offset); tracker
+contexts swap the active stream so dropout inside TP blocks draws from the
+local-per-rank stream while everything else draws from the global one.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from .....core.generator import Generator, default_generator
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return {n: g.get_state() for n, g in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for n, s in states.items():
+            if n not in self.states_:
+                self.states_[n] = Generator(0)
+            self.states_[n].set_state(s)
+
+    @contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        import paddle_tpu.core.generator as genmod
+        global_gen = genmod._default_generator
+        genmod._default_generator = self.states_[name]
+        try:
+            yield
+        finally:
+            genmod._default_generator = global_gen
+
+
+_rng_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _rng_tracker
+
+
+def model_parallel_random_seed(seed=None):
+    import random
+    from ....topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    rank = hcg.get_model_parallel_rank() if hcg else 0
+    if seed:
+        global_seed = seed
+        local_seed = seed * 1024 + rank * 100
+    else:
+        global_seed = random.randint(0, 100000)
+        local_seed = global_seed + 1024 + rank * 100
+    _rng_tracker.reset()
+    _rng_tracker.add(MODEL_PARALLEL_RNG, local_seed)
+    default_generator().manual_seed(global_seed)

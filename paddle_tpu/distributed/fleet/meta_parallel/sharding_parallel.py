@@ -1,0 +1,10 @@
+"""ShardingParallel wrapper (analogue of
+fleet/meta_parallel/sharding_parallel.py)."""
+
+from __future__ import annotations
+
+from .meta_parallel_base import MetaParallelBase
+
+
+class ShardingParallel(MetaParallelBase):
+    pass

@@ -1,0 +1,13 @@
+"""TensorParallel wrapper (analogue of fleet/meta_parallel/tensor_parallel.py).
+
+On GSPMD there is no input-broadcast step (inputs are logically global), so
+the wrapper's job is just API parity + ensuring mp-layer annotations exist.
+"""
+
+from __future__ import annotations
+
+from .meta_parallel_base import MetaParallelBase
+
+
+class TensorParallel(MetaParallelBase):
+    pass

@@ -1,0 +1,27 @@
+"""fleet.distributed_model (analogue of fleet/model.py:30)."""
+
+from __future__ import annotations
+
+from .meta_parallel import (PipelineParallel, ShardingParallel,
+                            TensorParallel)
+from .meta_parallel.parallel_layers.pp_layers import PipelineLayer
+from ..parallel import DataParallel
+
+
+def distributed_model(model, hcg=None, strategy=None):
+    if hcg is None:
+        from .fleet_base import fleet as _fleet
+        hcg = _fleet._hcg
+        strategy = strategy or _fleet._strategy
+    if hcg is None:
+        return model
+    mode = hcg.get_parallel_mode()
+    if mode == "pipeline" or isinstance(model, PipelineLayer):
+        return PipelineParallel(model, hcg, strategy)
+    if mode == "model_parallel":
+        return TensorParallel(model, hcg, strategy)
+    if mode == "sharding_parallel":
+        return ShardingParallel(model, hcg, strategy)
+    if hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model)
+    return model

@@ -1,0 +1,29 @@
+"""Hybrid-parallel gradient helpers (analogue of
+fleet/utils/hybrid_parallel_util.py: fused_allreduce_gradients:241,
+broadcast_mp_parameters:213).
+
+Under compiled SPMD these reductions are emitted by GSPMD; the functions are
+correct no-ops/identities in single-program execution and exist for recipe
+compatibility.
+"""
+
+from __future__ import annotations
+
+__all__ = ["fused_allreduce_gradients", "broadcast_mp_parameters",
+           "broadcast_dp_parameters", "broadcast_sharding_parameters"]
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    return None
+
+
+def broadcast_mp_parameters(model, hcg):
+    return None
+
+
+def broadcast_dp_parameters(model, hcg):
+    return None
+
+
+def broadcast_sharding_parameters(model, hcg):
+    return None

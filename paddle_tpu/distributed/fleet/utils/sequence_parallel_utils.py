@@ -1,0 +1,87 @@
+"""Sequence-parallel utilities (analogue of
+fleet/utils/sequence_parallel_utils.py: ScatterOp:83, GatherOp:95,
+AllGatherOp:109, mark_as_sequence_parallel_parameter:146).
+
+TPU-native: scatter/gather of activations along the sequence dim are
+sharding-constraint changes — GSPMD emits the all-gather / reduce-scatter
+pair the reference implements as autograd ops.  The "sep"/"model" axis names
+match the topology mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ....core.dispatch import dispatch
+from ....core.tensor import Tensor
+from ...topology import get_global_mesh
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "scatter", "all_gather",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks"]
+
+SEQ_AXIS_NAME = "model"  # Megatron-SP shards seq dim over the TP axis
+
+
+def _constrain_seq(x, shard: bool, seq_dim=0):
+    mesh = get_global_mesh()
+    if mesh is None:
+        return x if isinstance(x, Tensor) else Tensor(jax.numpy.asarray(x))
+    axes = [None] * x.ndim
+    if shard:
+        axes[seq_dim] = SEQ_AXIS_NAME
+    spec = PartitionSpec(*axes)
+
+    def impl(a):
+        if isinstance(a, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
+        return a
+
+    return dispatch("seq_parallel_constraint", impl, (x,))
+
+
+def scatter(x, seq_dim=0):
+    """Split activations along seq dim across the TP axis (ScatterOp)."""
+    return _constrain_seq(x, shard=True, seq_dim=seq_dim)
+
+
+def all_gather(x, seq_dim=0):
+    """Gather sequence shards (AllGatherOp/GatherOp)."""
+    return _constrain_seq(x, shard=False, seq_dim=seq_dim)
+
+
+class ScatterOp:
+    @staticmethod
+    def apply(x, seq_dim=0):
+        return scatter(x, seq_dim)
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x, seq_dim=0):
+        return all_gather(x, seq_dim)
+
+
+class AllGatherOp:
+    @staticmethod
+    def apply(x, seq_dim=0):
+        return all_gather(x, seq_dim)
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x, seq_dim=0):
+        return scatter(x, seq_dim)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """Under GSPMD the grads of sequence-parallel params are reduced by the
+    compiler; the hook registration is accepted for API parity."""
+    return None

@@ -1,0 +1,54 @@
+"""Launcher (analogue of `python -m paddle.distributed.launch`,
+reference python/paddle/distributed/launch/main.py:18).
+
+On TPU, one process per *host* drives all local chips (SPMD), so the
+launcher's job is multi-host process start + env contract, not per-GPU
+spawning.  Single-host: run the script in-process.  Multi-host: the operator
+runs this CLI on each host with PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+MASTER_ADDR set (same contract as the reference's collective controller).
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+
+def launch():
+    argv = sys.argv[1:]
+    # parse minimal flags: --nnodes, --master, --rank, then script + args
+    nnodes = 1
+    master = None
+    rank = 0
+    script_idx = 0
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("--nnodes"):
+            nnodes = int(a.split("=", 1)[1] if "=" in a else argv[i + 1])
+            i += 1 if "=" in a else 2
+            continue
+        if a.startswith("--master"):
+            master = a.split("=", 1)[1] if "=" in a else argv[i + 1]
+            i += 1 if "=" in a else 2
+            continue
+        if a.startswith("--rank"):
+            rank = int(a.split("=", 1)[1] if "=" in a else argv[i + 1])
+            i += 1 if "=" in a else 2
+            continue
+        script_idx = i
+        break
+    script = argv[script_idx]
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(nnodes))
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank))
+    if master:
+        addr, _, port = master.partition(":")
+        os.environ.setdefault("MASTER_ADDR", addr)
+        os.environ.setdefault("MASTER_PORT", port or "8787")
+    sys.argv = argv[script_idx:]
+    runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    launch()

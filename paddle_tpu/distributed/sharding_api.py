@@ -1,0 +1,170 @@
+"""Dygraph semi-auto sharding API (analogue of
+python/paddle/distributed/auto_parallel/api.py: shard_tensor:85, plus
+shard_layer/shard_optimizer from the 2.6-era semi-auto surface).
+
+A sharding annotation is a PartitionSpec stored on the Tensor
+(``_dist_attr``).  Eagerly, ``jax.device_put`` places the value with that
+NamedSharding (the analogue of DistTensor's local-shard construction);
+under jit, annotations become ``lax.with_sharding_constraint`` so GSPMD
+propagates layouts — the TPU-native replacement for the reference's
+reshard-function library (SURVEY §2.1 DistTensor row).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from .topology import get_global_mesh
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_layer", "shard_optimizer",
+           "reshard", "dtensor_from_fn", "Shard", "Replicate", "Partial"]
+
+
+class Shard:
+    """Placement: shard along tensor dim `dim` (reference dist.Shard)."""
+
+    def __init__(self, dim):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard({self.dim})"
+
+
+class Replicate:
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial:
+    """Pending-reduction placement.  GSPMD tracks partial sums internally;
+    accepted for API parity."""
+
+    def __repr__(self):
+        return "Partial()"
+
+
+class ProcessMesh:
+    """Analogue of paddle.distributed.ProcessMesh (dist_attr.h ProcessMesh):
+    wraps a jax Mesh (or builds one from shape/axis names)."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None, process_ids=None):
+        if isinstance(mesh, Mesh):
+            self.jax_mesh = mesh
+            self.dim_names = list(mesh.axis_names)
+        else:
+            import numpy as np
+            arr = np.asarray(mesh if mesh is not None else process_ids)
+            shape = arr.shape if shape is None else tuple(shape)
+            self.dim_names = list(dim_names or
+                                  [f"d{i}" for i in range(len(shape))])
+            devs = np.array(jax.devices()[:arr.size]).reshape(shape)
+            self.jax_mesh = Mesh(devs, self.dim_names)
+
+    @property
+    def shape(self):
+        return list(self.jax_mesh.devices.shape)
+
+    @property
+    def process_ids(self):
+        return list(range(self.jax_mesh.devices.size))
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            self.jax_mesh == other.jax_mesh
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+def _placements_to_spec(placements, ndim, mesh):
+    axes = [None] * ndim
+    names = list(mesh.axis_names)
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            if axes[p.dim] is None:
+                axes[p.dim] = names[mesh_dim]
+            elif isinstance(axes[p.dim], tuple):
+                axes[p.dim] = axes[p.dim] + (names[mesh_dim],)
+            else:
+                axes[p.dim] = (axes[p.dim], names[mesh_dim])
+    return PartitionSpec(*axes)
+
+
+def _resolve_mesh(mesh):
+    if mesh is None:
+        m = get_global_mesh()
+        if m is None:
+            raise ValueError("no global mesh; build one via "
+                             "HybridCommunicateGroup or pass mesh=")
+        return m
+    if isinstance(mesh, ProcessMesh):
+        return mesh.jax_mesh
+    return mesh
+
+
+def shard_tensor(data, mesh=None, placements=None, dtype=None,
+                 stop_gradient=None, spec: Optional[PartitionSpec] = None):
+    """Annotate (and place) a tensor with a sharding.
+
+    Accepts either reference-style ``placements`` ([Shard(0), Replicate()]
+    per mesh dim) or a direct PartitionSpec via ``spec``.
+    """
+    jmesh = _resolve_mesh(mesh)
+    t = data if isinstance(data, Tensor) else Tensor(jnp.asarray(data))
+    if spec is None:
+        placements = placements or []
+        spec = _placements_to_spec(placements, t.ndim, jmesh)
+    arr = t._value
+    if isinstance(arr, jax.core.Tracer):
+        out_arr = jax.lax.with_sharding_constraint(
+            arr, NamedSharding(jmesh, spec))
+    else:
+        out_arr = jax.device_put(arr, NamedSharding(jmesh, spec))
+    out = Tensor(out_arr, stop_gradient=t.stop_gradient
+                 if stop_gradient is None else stop_gradient)
+    out._dist_attr = spec
+    out._is_param = t._is_param
+    return out
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(x, mesh=None, placements=None, spec=None):
+    """Change a tensor's sharding (reference: reshard function library,
+    {r_to_s,s_to_r,...}_reshard_function.cc).  One call — XLA emits the
+    minimal collective to move between layouts."""
+    return shard_tensor(x, mesh, placements, spec=spec)
+
+
+def shard_layer(layer, process_mesh=None, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """Shard a layer's parameters in-place (reference
+    auto_parallel/api.py shard_layer)."""
+    jmesh = _resolve_mesh(process_mesh)
+    if shard_fn is None:
+        def shard_fn(name, l, mesh):
+            return None
+    for name, sub in list(layer.named_sublayers(include_self=True)):
+        shard_fn(name, sub, process_mesh)
+    # place any annotated params on device with their shardings
+    for p in layer.parameters():
+        if p._dist_attr is not None and not isinstance(p._value, jax.core.Tracer):
+            p._value = jax.device_put(
+                p._value, NamedSharding(jmesh, p._dist_attr))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """ZeRO-style optimizer-state sharding (reference dist.shard_optimizer):
+    marks the optimizer so accumulators are created with the parameter's
+    sharding (or sharded along the 'sharding' axis when the param is
+    replicated). The actual placement happens under jit via GSPMD."""
+    optimizer._zero_sharded = True
+    return optimizer

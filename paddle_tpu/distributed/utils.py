@@ -1,0 +1,105 @@
+"""Distributed utilities: recompute (activation checkpointing).
+
+Analogue of ``python/paddle/distributed/fleet/recompute/recompute.py``
+(RecomputeFunction:88).  TPU-native: ``jax.checkpoint`` (rematerialization)
+replaces the PyLayer replay machinery — RNG state is handled by the
+counter-based PRNG automatically (same key derivation in both passes), which
+is exactly what the reference's RNG-state tracker reconstructs by hand.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tape as _tape
+from ..core.dispatch import dispatch, set_param_tracker
+from ..core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function(*args)`` with activation rematerialization.
+
+    Under the eager tape: the recorded vjp closes over a
+    ``jax.checkpoint``-wrapped callable, so residuals are dropped and the
+    forward re-runs (on-device) during backward.
+    """
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+
+    # discover parameters the function uses so grads flow to them
+    store = {}
+    set_param_tracker(store)
+    try:
+        with _tape.no_grad():
+            probe_out = function(*args, **kwargs)
+    finally:
+        set_param_tracker(None)
+    params = list(store.values())
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    arg_slots = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+
+    from ..core import generator as _generator
+    rng_key = _generator.default_generator().next_key()
+
+    n_params = len(params)
+
+    @jax.checkpoint
+    def pure(rng, *arrays):
+        p_arrays = arrays[:n_params]
+        in_arrays = arrays[n_params:]
+        saved = [p._value for p in params]
+        _generator.push_trace_key(rng)
+        try:
+            for p, a in zip(params, p_arrays):
+                p._value = a
+            full_args = list(args)
+            for slot, arr in zip(arg_slots, in_arrays):
+                full_args[slot] = Tensor(arr)
+            with _tape.no_grad():
+                out = function(*full_args, **kwargs)
+        finally:
+            for p, s in zip(params, saved):
+                p._value = s
+            _generator.pop_trace_key()
+        outs = out if isinstance(out, tuple) else (out,)
+        return tuple(o._value if isinstance(o, Tensor) else o for o in outs)
+
+    def impl(*arrays):
+        return pure(rng_key, *arrays)
+
+    out = dispatch("recompute", impl, tuple(params) + tuple(tensor_args))
+    if isinstance(probe_out, tuple):
+        return out if isinstance(out, tuple) else (out,)
+    return out[0] if isinstance(out, tuple) and not isinstance(probe_out, tuple) \
+        else out
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Checkpoint a Sequential in segments (reference recompute_sequential:508)."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    if segments <= 1:
+        def run_all(*a):
+            out = a if len(a) > 1 else a[0]
+            for l in layers:
+                out = l(out)
+            return out
+        return recompute(run_all, *args, **kwargs)
+    seg_size = (len(layers) + segments - 1) // segments
+    out = args if len(args) > 1 else args[0]
+    for s in range(0, len(layers), seg_size):
+        chunk = layers[s:s + seg_size]
+
+        def run_chunk(x, _chunk=chunk):
+            for l in _chunk:
+                x = l(x)
+            return x
+
+        out = recompute(run_chunk, out, **kwargs)
+    return out

@@ -1,0 +1,4 @@
+"""paddle_tpu.framework — serialization and framework-level utilities."""
+
+from . import io  # noqa: F401
+from . import random  # noqa: F401

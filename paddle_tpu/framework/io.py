@@ -1,0 +1,61 @@
+"""paddle.save/load analogue (reference python/paddle/framework/io.py:650/:893).
+
+Tensors serialize as numpy arrays inside a pickle (protocol 4, so >4GB works
+— mirroring the reference's large-object handling).  Nested dicts/lists of
+Tensors (state_dicts, optimizer states) round-trip.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_SENTINEL = "__paddle_tpu_tensor__"
+_BF16 = "__bf16__"
+
+
+def _encode(obj):
+    if isinstance(obj, Tensor):
+        arr = obj._value
+        if arr.dtype == jnp.bfloat16:
+            return {_SENTINEL: True, _BF16: True,
+                    "data": np.asarray(arr.astype(jnp.float32))}
+        return {_SENTINEL: True, "data": np.asarray(arr)}
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_encode(v) for v in obj)
+    return obj
+
+
+def _decode(obj):
+    if isinstance(obj, dict):
+        if obj.get(_SENTINEL):
+            arr = jnp.asarray(obj["data"])
+            if obj.get(_BF16):
+                arr = arr.astype(jnp.bfloat16)
+            return Tensor(arr)
+        return {k: _decode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_decode(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_encode(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return _decode(pickle.load(f))

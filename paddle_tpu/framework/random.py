@@ -1,0 +1,15 @@
+"""Framework RNG helpers (analogue of python/paddle/framework/random.py)."""
+
+from ..core.generator import (Generator, default_generator, get_rng_state,
+                              seed, set_rng_state)
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "default_generator",
+           "Generator", "get_cuda_rng_state", "set_cuda_rng_state"]
+
+
+def get_cuda_rng_state():  # API parity: no CUDA in this build
+    return []
+
+
+def set_cuda_rng_state(state):
+    pass

@@ -1,0 +1,6 @@
+"""paddle_tpu.hapi — high-level Model API (analogue of python/paddle/hapi)."""
+
+from .model import Model
+from . import callbacks  # noqa: F401
+
+__all__ = ["Model", "callbacks"]

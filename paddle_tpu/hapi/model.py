@@ -1,0 +1,215 @@
+"""paddle.Model analogue (reference python/paddle/hapi/model.py, 2504 LoC).
+
+fit/evaluate/predict drive the eager tape; `prepare(jit=True)` (TPU default)
+swaps the inner train step for a fully-compiled TrainStep when the optimizer
+supports it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+        self._train_step = None
+
+    # ---- configuration ----
+    def prepare(self, optimizer=None, loss=None, metrics=None, jit=True,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, list) else [metrics]
+        self._use_jit = jit
+        return self
+
+    def _make_loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    def _compute_loss(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        lbls = labels if isinstance(labels, (list, tuple)) else [labels]
+        if self._loss is None:
+            return outs[0]
+        return self._loss(*outs, *lbls)
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return batch[:-1], batch[-1:]
+        return (batch,), ()
+
+    # ---- steps ----
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else (
+            [labels] if labels is not None else [])
+        if self._use_jit and self._train_step is None:
+            from ..jit.train_step import TrainStep
+
+            def loss_fn(net, *args):
+                n_in = len(inputs)
+                outs = net(*args[:n_in])
+                return self._compute_loss(outs, list(args[n_in:]))
+
+            step = TrainStep(self.network, loss_fn, self._optimizer)
+            if step._update_fn is not None:
+                self._train_step = step
+            else:
+                self._train_step = False  # unsupported optimizer: eager path
+        if self._train_step:
+            loss = self._train_step(*inputs, *labels)
+            return [float(np.asarray(loss._value))]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return [float(np.asarray(loss._value))]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..core.tape import no_grad
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else (
+            [labels] if labels is not None else [])
+        with no_grad():
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
+            metrics = []
+            for m in self._metrics:
+                outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+                res = m.compute(*outs, *labels)
+                m.update(res)
+                metrics.append(m.accumulate())
+        return [float(np.asarray(loss._value))], metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..core.tape import no_grad
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad():
+            outputs = self.network(*inputs)
+        return outputs
+
+    # ---- loops ----
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._make_loader(train_data, batch_size, shuffle)
+        eval_loader = self._make_loader(eval_data, batch_size, False)
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                log_freq=log_freq, verbose=verbose,
+                                save_dir=save_dir, save_freq=save_freq,
+                                metrics=[n for m in self._metrics
+                                         for n in (m.name() if isinstance(
+                                             m.name(), list) else [m.name()])])
+        self.stop_training = False
+        cbks.on_train_begin()
+        iters = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, lbls = self._split_batch(batch)
+                losses = self.train_batch(list(ins), list(lbls))
+                logs = {"loss": losses[0]}
+                cbks.on_train_batch_end(step, logs)
+                iters += 1
+                if num_iters is not None and iters >= num_iters:
+                    self.stop_training = True
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs if "logs" in dir() else None)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._make_loader(eval_data, batch_size, False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            ins, lbls = self._split_batch(batch)
+            l, _ = self.eval_batch(list(ins), list(lbls))
+            losses.append(l[0])
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            for n, v in zip(names, vals):
+                logs[n] = v
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            ins = batch if isinstance(batch, (list, tuple)) else [batch]
+            outs = self.predict_batch(list(ins))
+            outputs.append(outs)
+        return outputs
+
+    # ---- persistence / info ----
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as fload
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        total = 0
+        trainable = 0
+        lines = []
+        for name, p in self.network.named_parameters():
+            n = p.size
+            total += n
+            if not p.stop_gradient:
+                trainable += n
+            lines.append(f"  {name}: {list(p.shape)} = {n}")
+        report = {"total_params": total, "trainable_params": trainable}
+        print("\n".join(lines))
+        print(f"Total params: {total}  Trainable: {trainable}")
+        return report

@@ -1,0 +1,4 @@
+"""paddle_tpu.incubate (analogue of python/paddle/incubate/)."""
+
+from . import nn  # noqa: F401
+from . import autograd  # noqa: F401

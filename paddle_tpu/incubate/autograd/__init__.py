@@ -1,0 +1,12 @@
+"""Functional/higher-order autograd (analogue of
+python/paddle/incubate/autograd/primapi.py).  The jax transforms ARE the
+primitive system here — no separate prim op set is needed."""
+
+from ...autograd.functional import hessian, jacobian, jvp, vjp
+
+__all__ = ["jvp", "vjp", "jacobian", "hessian", "grad"]
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    from ...core.tape import grad as _g
+    return _g(outputs, inputs, grad_outputs)

@@ -1,0 +1,4 @@
+"""Incubate nn — fused LLM blocks (analogue of python/paddle/incubate/nn/)."""
+
+from . import functional  # noqa: F401
+from .layer import FusedMultiHeadAttention, FusedFeedForward  # noqa: F401

@@ -1,0 +1,139 @@
+"""Fused LLM functionals (analogue of python/paddle/incubate/nn/functional/:
+fused_rms_norm, fused_rotary_position_embedding, fused_linear,
+masked_multihead_attention, memory_efficient_attention).
+
+On TPU "fused" means: one dispatch whose impl XLA/Pallas fuses — the API
+names are kept for recipe compatibility.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import dispatch
+from ....nn.functional.attention import scaled_dot_product_attention
+from ....nn.functional.norm import layer_norm, rms_norm
+
+__all__ = ["fused_rms_norm", "fused_layer_norm", "fused_linear",
+           "fused_rotary_position_embedding", "rotary_position_embedding",
+           "fused_dropout_add", "masked_multihead_attention",
+           "memory_efficient_attention", "fused_bias_act",
+           "swiglu"]
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kwargs):
+    out = rms_norm(x, norm_weight, epsilon)
+    return (out,) if kwargs.get("return_tuple") else out
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, **kwargs):
+    shape = tuple(x.shape[begin_norm_axis:]) if begin_norm_axis != -1 \
+        else (x.shape[-1],)
+    return layer_norm(x, shape, norm_weight, norm_bias, epsilon)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    def impl(a, w, *rest):
+        wt = w.T if transpose_weight else w
+        out = jnp.matmul(a, wt)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return dispatch("fused_linear", impl, args)
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU activation (reference incubate fused op used by Llama FFN)."""
+    if y is not None:
+        return dispatch("swiglu",
+                        lambda a, b: jax.nn.silu(a) * b, (x, y))
+
+    def impl(a):
+        a1, a2 = jnp.split(a, 2, axis=-1)
+        return jax.nn.silu(a1) * a2
+
+    return dispatch("swiglu", impl, (x,))
+
+
+def _apply_rope(q, k, cos, sin):
+    def rotate_half(v):
+        v1, v2 = jnp.split(v, 2, axis=-1)
+        return jnp.concatenate([-v2, v1], axis=-1)
+
+    q_out = q * cos + rotate_half(q) * sin
+    k_out = k * cos + rotate_half(k) * sin
+    return q_out, k_out
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """RoPE (reference fused_rotary_position_embedding).  q/k: [B, S, H, D]."""
+    from ....ops.pallas import rope as pallas_rope
+    if sin is None or cos is None:
+        d = q.shape[-1]
+        s = q.shape[1]
+        inv_freq = 1.0 / (rotary_emb_base ** (jnp.arange(0, d, 2,
+                                                         dtype=jnp.float32) / d))
+        t = jnp.arange(s, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv_freq)
+        emb = jnp.concatenate([freqs, freqs], axis=-1)
+        cos_arr = jnp.cos(emb)[None, :, None, :]
+        sin_arr = jnp.sin(emb)[None, :, None, :]
+    else:
+        cos_arr = cos._value if hasattr(cos, "_value") else jnp.asarray(cos)
+        sin_arr = sin._value if hasattr(sin, "_value") else jnp.asarray(sin)
+        if cos_arr.ndim == 2:
+            cos_arr = cos_arr[None, :, None, :]
+            sin_arr = sin_arr[None, :, None, :]
+
+    if k is not None:
+        def impl(qa, ka):
+            qo, ko = _apply_rope(qa.astype(jnp.float32), ka.astype(jnp.float32),
+                                 cos_arr, sin_arr)
+            return qo.astype(qa.dtype), ko.astype(ka.dtype)
+
+        return dispatch("fused_rope", impl, (q, k))
+
+    def impl_q(qa):
+        qo, _ = _apply_rope(qa.astype(jnp.float32), qa.astype(jnp.float32),
+                            cos_arr, sin_arr)
+        return qo.astype(qa.dtype)
+
+    return dispatch("fused_rope", impl_q, (q,))
+
+
+rotary_position_embedding = fused_rotary_position_embedding
+
+
+def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train",
+                      name=None):
+    from ....nn.functional.common import dropout
+    return dropout(x, p, training=training, mode=mode) + y
+
+
+def masked_multihead_attention(x, cache_kv=None, src_mask=None, **kwargs):
+    raise NotImplementedError(
+        "masked_multihead_attention (decode-step fused kernel) lands with the "
+        "inference engine; use scaled_dot_product_attention with a KV cache")
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    """Memory-efficient attention == flash attention on TPU."""
+    return scaled_dot_product_attention(query, key, value,
+                                        attn_mask=attn_bias, dropout_p=p)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kwargs):
+    from ....nn import functional as F
+    act = {"gelu": F.gelu, "relu": F.relu, "silu": F.silu,
+           "swiglu": swiglu}[act_method]
+    if bias is not None:
+        x = x + bias
+    return act(x)

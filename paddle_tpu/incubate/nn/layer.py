@@ -1,0 +1,29 @@
+"""Fused transformer layers (analogue of
+python/paddle/incubate/nn/layer/fused_transformer.py)."""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer.transformer import MultiHeadAttention
+from ...nn.layer.common import Linear
+from ...nn import functional as F
+
+
+class FusedMultiHeadAttention(MultiHeadAttention):
+    """Fused QKV attention: same math, one dispatch through the flash path."""
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", **kwargs):
+        super().__init__()
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+
+    def forward(self, x):
+        act = {"relu": F.relu, "gelu": F.gelu}[self.activation]
+        h = act(self.linear1(x))
+        h = F.dropout(h, self.dropout_rate, training=self.training)
+        return self.linear2(h)

@@ -1,0 +1,22 @@
+"""paddle_tpu.io — datasets and DataLoader (analogue of paddle.io).
+
+The loader is a host-side pipeline: worker threads batch numpy data and a
+prefetch queue overlaps host batching with device compute (the analogue of
+the reference's LoDTensorBlockingQueue double-buffering,
+``paddle/fluid/operators/reader/lod_tensor_blocking_queue.h:30``).
+"""
+
+from .dataset import (Dataset, IterableDataset, TensorDataset, ComposeDataset,
+                      ChainDataset, Subset, ConcatDataset, random_split)
+from .sampler import (Sampler, SequenceSampler, RandomSampler, BatchSampler,
+                      DistributedBatchSampler, WeightedRandomSampler,
+                      SubsetRandomSampler)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "Subset", "ConcatDataset", "random_split", "Sampler",
+    "SequenceSampler", "RandomSampler", "BatchSampler",
+    "DistributedBatchSampler", "WeightedRandomSampler", "SubsetRandomSampler",
+    "DataLoader", "default_collate_fn", "get_worker_info",
+]

@@ -1,0 +1,171 @@
+"""DataLoader (analogue of python/paddle/io/dataloader/dataloader_iter.py).
+
+Host pipeline: worker threads fetch+collate numpy batches into a bounded
+queue; the iterator converts to device Tensors.  Threads (not processes) are
+the right default on TPU VMs — input work is numpy-bound and the GIL is
+released inside numpy, while device transfers overlap via the queue
+(reference equivalent: LoDTensorBlockingQueue + multiprocess workers).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn", "get_worker_info"]
+
+_worker_info = threading.local()
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched numpy arrays (mirrors the reference's
+    default_collate_fn field-wise recursion)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._value) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(f)) for f in transposed)
+    return np.asarray(batch)
+
+
+def _to_tensor(value):
+    if isinstance(value, np.ndarray):
+        return Tensor(jnp.asarray(value))
+    if isinstance(value, dict):
+        return {k: _to_tensor(v) for k, v in value.items()}
+    if isinstance(value, (tuple, list)):
+        return type(value)(_to_tensor(v) for v in value)
+    return value
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 2)
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout or None
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def _fetch(self, indices):
+        batch = [self.dataset[i] for i in indices]
+        return self.collate_fn(batch)
+
+    def _iter_iterable(self):
+        _worker_info.info = WorkerInfo(0, max(self.num_workers, 1), self.dataset)
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield _to_tensor(self.collate_fn(batch))
+                batch = []
+        if batch and not self.drop_last:
+            yield _to_tensor(self.collate_fn(batch))
+
+    def _iter_sync(self):
+        for indices in self.batch_sampler:
+            yield _to_tensor(self._fetch(indices))
+
+    def _iter_workers(self):
+        out_q: "queue.Queue" = queue.Queue(
+            maxsize=self.prefetch_factor * self.num_workers)
+        idx_q: "queue.Queue" = queue.Queue()
+        batches = list(self.batch_sampler)
+        for i, b in enumerate(batches):
+            idx_q.put((i, b))
+        n_batches = len(batches)
+        stop = threading.Event()
+
+        def worker(wid):
+            _worker_info.info = WorkerInfo(wid, self.num_workers, self.dataset)
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(wid)
+            while not stop.is_set():
+                try:
+                    i, indices = idx_q.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    out_q.put((i, self._fetch(indices)))
+                except Exception as e:  # surface worker errors to the consumer
+                    out_q.put((i, e))
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        try:
+            # reorder to preserve batch order
+            pending = {}
+            next_idx = 0
+            received = 0
+            while received < n_batches:
+                i, data = out_q.get(timeout=self.timeout)
+                received += 1
+                pending[i] = data
+                while next_idx in pending:
+                    item = pending.pop(next_idx)
+                    next_idx += 1
+                    if isinstance(item, Exception):
+                        raise item
+                    yield _to_tensor(item)
+        finally:
+            stop.set()
+
+    def __iter__(self):
+        if self._iterable:
+            return self._iter_iterable()
+        if self.num_workers > 0:
+            return self._iter_workers()
+        return self._iter_sync()

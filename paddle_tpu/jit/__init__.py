@@ -1,0 +1,16 @@
+"""paddle_tpu.jit — dygraph-to-compiled (analogue of paddle.jit / to_static).
+
+TPU-native design: instead of the reference's AST-transform + ProgramDesc +
+run_program grad node pipeline (SURVEY §3.3), ``to_static`` traces the Python
+function with jax.jit.  The compiled function is dispatched through the eager
+tape as a single op, so ``loss.backward()`` differentiates *through* the
+compiled region with a compiled transpose — functional parity with
+RunProgramGradNode (``paddle/fluid/eager/to_static/run_program_op_node.h:314``)
+at XLA-native speed.
+"""
+
+from .api import to_static, not_to_static, ignore_module, save, load, TranslatedLayer
+from .train_step import TrainStep
+
+__all__ = ["to_static", "not_to_static", "ignore_module", "save", "load",
+           "TranslatedLayer", "TrainStep"]

@@ -1,0 +1,298 @@
+"""to_static implementation (analogue of python/paddle/jit/api.py:233)."""
+
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as _dispatch
+from ..core import generator as _generator
+from ..core import tape as _tape
+from ..core.tensor import Tensor
+
+
+class InputSpec:
+    """Analogue of paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        from ..core.dtypes import convert_dtype
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+
+def _tree_key(args, kwargs, training):
+    def leaf_key(x):
+        if isinstance(x, Tensor):
+            return ("T", tuple(x._value.shape), str(x._value.dtype))
+        if isinstance(x, jax.Array):
+            return ("A", tuple(x.shape), str(x.dtype))
+        return ("L", repr(x))
+
+    flat, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    return (tuple(leaf_key(x) for x in flat), str(treedef), training)
+
+
+class StaticFunction:
+    """A traced+compiled callable with per-signature cache (the analogue of
+    ProgramTranslator's ConcreteProgram cache)."""
+
+    def __init__(self, function, input_spec=None, build_strategy=None,
+                 backend=None, full_graph=True):
+        functools.update_wrapper(self, function)
+        self._function = function
+        self._input_spec = input_spec
+        self._cache = {}
+        self._instance = None  # bound Layer when used as a method decorator
+
+    def __get__(self, instance, owner):
+        bound = StaticFunction(self._function, self._input_spec)
+        bound._instance = instance
+        bound._cache = self._cache
+        return bound
+
+    @property
+    def function(self):
+        return self._function
+
+    def _call_eager(self, *args, **kwargs):
+        if self._instance is not None:
+            return self._function(self._instance, *args, **kwargs)
+        return self._function(*args, **kwargs)
+
+    def _build(self, key, args, kwargs, training):
+        # ---- discovery pass: which Parameters does the function read? ----
+        store = {}
+        _dispatch.set_param_tracker(store)
+        try:
+            with _tape.no_grad():
+                self._call_eager(*args, **kwargs)
+        finally:
+            _dispatch.set_param_tracker(None)
+        params = list(store.values())
+
+        flat_in, in_treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        tensor_slots = [i for i, x in enumerate(flat_in)
+                        if isinstance(x, (Tensor, jax.Array))]
+        const_leaves = [x for i, x in enumerate(flat_in)
+                        if i not in tensor_slots]
+
+        out_treedef_box = {}
+        call_eager = self._call_eager
+
+        def pure_fn(rng_key, *arrays):
+            n_p = len(params)
+            p_arrays = arrays[:n_p]
+            in_arrays = arrays[n_p:]
+            saved = [p._value for p in params]
+            _generator.push_trace_key(rng_key)
+            try:
+                for p, a in zip(params, p_arrays):
+                    p._value = a
+                leaves = list(flat_in)
+                for slot, arr in zip(tensor_slots, in_arrays):
+                    leaves[slot] = Tensor(arr)
+                a2, k2 = jax.tree_util.tree_unflatten(in_treedef, leaves)
+                with _tape.no_grad():
+                    if self._instance is not None:
+                        out = self._function(self._instance, *a2, **k2)
+                    else:
+                        out = self._function(*a2, **k2)
+            finally:
+                for p, s in zip(params, saved):
+                    p._value = s
+                _generator.pop_trace_key()
+            out_flat, out_treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            out_treedef_box["treedef"] = out_treedef
+            out_treedef_box["is_tensor"] = [isinstance(x, (Tensor, jax.Array))
+                                            for x in out_flat]
+            out_treedef_box["const"] = [None if isinstance(x, (Tensor, jax.Array))
+                                        else x for x in out_flat]
+            return tuple(x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                         for x in out_flat
+                         if isinstance(x, (Tensor, jax.Array)))
+
+        jitted = jax.jit(pure_fn)
+        entry = {
+            "jitted": jitted,
+            "params": params,
+            "tensor_slots": tensor_slots,
+            "out_box": out_treedef_box,
+        }
+        self._cache[key] = entry
+        return entry
+
+    def __call__(self, *args, **kwargs):
+        training = bool(getattr(self._instance, "training", False))
+        key = _tree_key(args, kwargs, training)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(key, args, kwargs, training)
+        params = entry["params"]
+        flat_in, _ = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        input_tensors = [flat_in[i] for i in entry["tensor_slots"]]
+        rng_key = _generator.default_generator().next_key()
+
+        def jit_impl(*arrays, _jitted=entry["jitted"], _key=rng_key):
+            return _jitted(_key, *arrays)
+
+        outs = _dispatch.dispatch(
+            "jit_program", jit_impl, tuple(params) + tuple(input_tensors))
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        box = entry["out_box"]
+        out_flat = []
+        it = iter(outs)
+        for is_t, const in zip(box["is_tensor"], box["const"]):
+            out_flat.append(next(it) if is_t else const)
+        return jax.tree_util.tree_unflatten(box["treedef"], out_flat)
+
+    def concrete_program_specify_input_spec(self, *a, **k):
+        return None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Mirror paddle.jit.to_static (decorator or call form)."""
+
+    def decorate(fn):
+        from ..nn.layer.layers import Layer
+        if isinstance(fn, Layer):
+            layer = fn
+            static = StaticFunction(type(layer).forward, input_spec)
+            static._instance = layer
+            layer.forward = static
+            return layer
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+class TranslatedLayer:
+    """Loaded inference program (analogue of jit/translated_layer.py):
+    wraps a deserialized StableHLO executable + weights."""
+
+    def __init__(self, exported, state, in_spec):
+        self._exported = exported
+        self._state = state
+        self._in_spec = in_spec
+        self.training = False
+
+    def __call__(self, *args):
+        arrays = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in args]
+        out = self._exported.call(*self._state, *arrays)
+        if isinstance(out, (tuple, list)):
+            return tuple(Tensor(o) for o in out)
+        return Tensor(out)
+
+    def eval(self):
+        return self
+
+    def state_dict(self):
+        return {str(i): Tensor(a) for i, a in enumerate(self._state)}
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize a compiled inference program + weights.
+
+    TPU-native analogue of paddle.jit.save (reference python/paddle/jit/api.py
+    save): the forward is exported to portable StableHLO via jax.export, the
+    weights to a pickle — loadable without the model's Python class.
+    """
+    from ..nn.layer.layers import Layer
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec on TPU (static shapes)")
+    specs = [s if isinstance(s, InputSpec) else InputSpec(s.shape, s.dtype)
+             for s in input_spec]
+    if isinstance(layer, Layer):
+        layer.eval()
+        params = [(k, v) for k, v in layer.state_dict().items()]
+        fn = layer.forward
+        if isinstance(fn, StaticFunction):
+            fn = functools.partial(fn._function, layer)
+    else:
+        params = []
+        fn = layer
+
+    names = [k for k, _ in params]
+    values = [v._value for _, v in params]
+
+    def pure(p_values, *inputs):
+        from ..nn.layer.layers import Layer as _L
+        if isinstance(layer, _L):
+            saved = {}
+            sd = layer.state_dict()
+            for (k, t), new in zip(sd.items(), p_values):
+                saved[k] = t._value
+                t._value = new
+            try:
+                with _tape.no_grad():
+                    out = fn(*[Tensor(i) for i in inputs])
+            finally:
+                for k, t in sd.items():
+                    t._value = saved[k]
+        else:
+            with _tape.no_grad():
+                out = fn(*[Tensor(i) for i in inputs])
+        flat, _ = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+        return tuple(x._value if isinstance(x, Tensor) else x for x in flat)
+
+    in_shapes = [jax.ShapeDtypeStruct(s.shape, s.dtype) for s in specs]
+    p_shapes = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in values]
+
+    from jax import export as jax_export
+    exp = jax_export.export(jax.jit(lambda pv, *i: pure(pv, *i)))(
+        p_shapes, *in_shapes)
+    blob = exp.serialize()
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".ptpu_model", "wb") as f:
+        f.write(blob)
+    import numpy as np
+    with open(path + ".ptpu_params", "wb") as f:
+        pickle.dump({"names": names,
+                     "values": [np.asarray(v) for v in values],
+                     "in_spec": [(s.shape, str(s.dtype)) for s in specs]}, f)
+
+
+def load(path, **configs):
+    from jax import export as jax_export
+    with open(path + ".ptpu_model", "rb") as f:
+        exp = jax_export.deserialize(f.read())
+    with open(path + ".ptpu_params", "rb") as f:
+        meta = pickle.load(f)
+    values = [jnp.asarray(v) for v in meta["values"]]
+
+    class _Loaded(TranslatedLayer):
+        def __call__(self, *args):
+            arrays = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                      for a in args]
+            out = self._exported.call(values, *arrays)
+            if isinstance(out, (tuple, list)):
+                outs = tuple(Tensor(o) for o in out)
+                return outs if len(outs) > 1 else outs[0]
+            return Tensor(out)
+
+    return _Loaded(exp, values, meta["in_spec"])

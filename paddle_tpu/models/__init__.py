@@ -1,0 +1,11 @@
+"""Flagship model families (the analogue of PaddleNLP's model zoo entries
+named in BASELINE.md: Llama for LLM pretraining, plus GPT/ERNIE-style
+encoder)."""
+
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
+                    LlamaPretrainingCriterion, llama_3_8b_config,
+                    llama_3_70b_config, tiny_llama_config)
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+           "LlamaPretrainingCriterion", "llama_3_8b_config",
+           "llama_3_70b_config", "tiny_llama_config"]

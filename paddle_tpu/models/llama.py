@@ -1,0 +1,234 @@
+"""Llama model family — the flagship LLM.
+
+Capability analogue of PaddleNLP's Llama implementation driven by the
+reference's fleet hybrid-parallel stack (BASELINE configs 3 and 5).
+TPU-native design decisions:
+- GQA attention through nn.functional.scaled_dot_product_attention
+  (Pallas flash kernel on TPU, XLA fallback elsewhere).
+- RMSNorm / RoPE / SwiGLU via the incubate fused functionals.
+- 4D parallelism is pure annotation: mp layers (Column/Row/VocabParallel)
+  carry "model"-axis shardings; batch carries "data"; optimizer states
+  shard over "sharding"; the pipe axis is driven by PipelineLayer +
+  the pipeline engine.  One model definition serves 1-chip and v5p-64.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..incubate.nn.functional import (fused_rotary_position_embedding, swiglu)
+from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy)
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    tensor_parallel: bool = False
+    sequence_parallel: bool = False
+    recompute: bool = False
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama_3_8b_config(**kw):
+    return LlamaConfig(vocab_size=128256, hidden_size=4096,
+                       intermediate_size=14336, num_hidden_layers=32,
+                       num_attention_heads=32, num_key_value_heads=8,
+                       rope_theta=500000.0, **kw)
+
+
+def llama_3_70b_config(**kw):
+    return LlamaConfig(vocab_size=128256, hidden_size=8192,
+                       intermediate_size=28672, num_hidden_layers=80,
+                       num_attention_heads=64, num_key_value_heads=8,
+                       rope_theta=500000.0, **kw)
+
+
+def tiny_llama_config(**kw):
+    return LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, max_position_embeddings=128,
+                       **kw)
+
+
+def _linear_cls(config, kind):
+    if config.tensor_parallel:
+        return ColumnParallelLinear if kind == "col" else RowParallelLinear
+    return None
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.head_dim
+        kv_out = self.num_kv_heads * self.head_dim
+        if config.tensor_parallel:
+            self.q_proj = ColumnParallelLinear(h, h, has_bias=False,
+                                               gather_output=False)
+            self.k_proj = ColumnParallelLinear(h, kv_out, has_bias=False,
+                                               gather_output=False)
+            self.v_proj = ColumnParallelLinear(h, kv_out, has_bias=False,
+                                               gather_output=False)
+            self.o_proj = RowParallelLinear(h, h, has_bias=False,
+                                            input_is_parallel=True)
+        else:
+            self.q_proj = nn.Linear(h, h, bias_attr=False)
+            self.k_proj = nn.Linear(h, kv_out, bias_attr=False)
+            self.v_proj = nn.Linear(h, kv_out, bias_attr=False)
+            self.o_proj = nn.Linear(h, h, bias_attr=False)
+
+    def forward(self, x, position_ids=None, attention_mask=None, cache=None):
+        b, s, _ = x.shape
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        q, k = fused_rotary_position_embedding(
+            q, k, rotary_emb_base=self.config.rope_theta)
+        if cache is not None:
+            from ..tensor.manipulation import concat
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+            cache = (k, v)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attention_mask,
+            is_causal=attention_mask is None)
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        out = self.o_proj(out)
+        return (out, cache) if cache is not None else out
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        if config.tensor_parallel:
+            self.gate_proj = ColumnParallelLinear(h, m, has_bias=False,
+                                                  gather_output=False)
+            self.up_proj = ColumnParallelLinear(h, m, has_bias=False,
+                                                gather_output=False)
+            self.down_proj = RowParallelLinear(m, h, has_bias=False,
+                                               input_is_parallel=True)
+        else:
+            self.gate_proj = nn.Linear(h, m, bias_attr=False)
+            self.up_proj = nn.Linear(h, m, bias_attr=False)
+            self.down_proj = nn.Linear(m, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          config.rms_norm_eps)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   config.rms_norm_eps)
+        self._recompute = config.recompute
+
+    def _forward_impl(self, x, position_ids=None, attention_mask=None):
+        h = x + self.self_attn(self.input_layernorm(x), position_ids,
+                               attention_mask)
+        return h + self.mlp(self.post_attention_layernorm(h))
+
+    def forward(self, x, position_ids=None, attention_mask=None):
+        if self._recompute and self.training:
+            from ..distributed.utils import recompute
+            return recompute(self._forward_impl, x)
+        return self._forward_impl(x, position_ids, attention_mask)
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        if config.tensor_parallel:
+            self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                       config.hidden_size)
+        else:
+            self.embed_tokens = nn.Embedding(config.vocab_size,
+                                             config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, position_ids=None, attention_mask=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, position_ids, attention_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tensor_parallel:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=not config.tensor_parallel)
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+        if config.tie_word_embeddings:
+            self.lm_head.weight = self.llama.embed_tokens.weight
+
+    def forward(self, input_ids, position_ids=None, attention_mask=None,
+                labels=None):
+        hidden = self.llama(input_ids, position_ids, attention_mask)
+        logits = self.lm_head(hidden)
+        if labels is not None:
+            loss = LlamaPretrainingCriterion(self.config)(logits, labels)
+            return loss, logits
+        return logits
+
+
+class LlamaPretrainingCriterion(nn.Layer):
+    """Shifted-token cross entropy (PaddleNLP parity: criterion computes the
+    mean NLL over non-ignored positions)."""
+
+    def __init__(self, config: Optional[LlamaConfig] = None,
+                 ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+        self._parallel = bool(config and config.tensor_parallel)
+        if self._parallel:
+            self.parallel_ce = ParallelCrossEntropy(
+                ignore_index=ignore_index)
+
+    def forward(self, logits, labels):
+        if self._parallel:
+            losses = self.parallel_ce(logits, labels)
+            return losses.mean()
+        return F.cross_entropy(logits, labels,
+                               ignore_index=self.ignore_index,
+                               reduction="mean")

@@ -1,0 +1,17 @@
+"""paddle_tpu.nn.functional — functional neural-net ops.
+
+Analogue of ``python/paddle/nn/functional/``.  Convs/pools lower to
+``lax.conv_general_dilated`` / ``lax.reduce_window`` (MXU/VPU native);
+attention routes to the Pallas flash-attention kernel on TPU
+(:mod:`paddle_tpu.ops.pallas`) with a pure-XLA fallback elsewhere.
+"""
+
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .input import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+from .vision import *  # noqa: F401,F403

@@ -1,0 +1,221 @@
+"""Activation functions (analogue of python/paddle/nn/functional/activation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+from ...tensor._helpers import normalize_axis
+
+__all__ = [
+    "relu", "relu_", "relu6", "elu", "selu", "celu", "gelu", "silu", "swish",
+    "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "hardshrink",
+    "softshrink", "tanhshrink", "leaky_relu", "prelu", "rrelu", "log_sigmoid",
+    "maxout", "softplus", "softsign", "tanh", "mish", "softmax", "softmax_",
+    "log_softmax", "gumbel_softmax", "glu", "thresholded_relu",
+]
+
+
+def relu(x, name=None):
+    return dispatch("relu", jax.nn.relu, (x,))
+
+
+def relu_(x, name=None):
+    x._in_place_update(relu(x))
+    return x
+
+
+def relu6(x, name=None):
+    return dispatch("relu6", jax.nn.relu6, (x,))
+
+
+def elu(x, alpha=1.0, name=None):
+    return dispatch("elu", lambda a: jax.nn.elu(a, alpha), (x,))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return dispatch(
+        "selu",
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), (x,))
+
+
+def celu(x, alpha=1.0, name=None):
+    return dispatch("celu", lambda a: jax.nn.celu(a, alpha), (x,))
+
+
+def gelu(x, approximate=False, name=None):
+    return dispatch("gelu", lambda a: jax.nn.gelu(a, approximate=approximate),
+                    (x,))
+
+
+def silu(x, name=None):
+    return dispatch("silu", jax.nn.silu, (x,))
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def sigmoid(x, name=None):
+    return dispatch("sigmoid", jax.nn.sigmoid, (x,))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return dispatch("hardsigmoid",
+                    lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), (x,))
+
+
+def hardswish(x, name=None):
+    return dispatch("hardswish",
+                    lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, (x,))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return dispatch("hardtanh", lambda a: jnp.clip(a, min, max), (x,))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return dispatch(
+        "hardshrink",
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0).astype(a.dtype), (x,))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return dispatch(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)
+                            ).astype(a.dtype),
+        (x,))
+
+
+def tanhshrink(x, name=None):
+    return dispatch("tanhshrink", lambda a: a - jnp.tanh(a), (x,))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return dispatch("leaky_relu",
+                    lambda a: jax.nn.leaky_relu(a, negative_slope), (x,))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def impl(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        if data_format == "NCHW":
+            shape = (1, -1) + (1,) * (a.ndim - 2)
+        else:
+            shape = (1,) * (a.ndim - 1) + (-1,)
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+    return dispatch("prelu", impl, (x, weight))
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=True, name=None):
+    from ...core.generator import default_generator
+    if training:
+        key = default_generator().next_key()
+
+        def impl(a):
+            r = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, r * a)
+
+        return dispatch("rrelu", impl, (x,))
+    mid = (lower + upper) / 2.0
+    return dispatch("rrelu", lambda a: jnp.where(a >= 0, a, mid * a), (x,))
+
+
+def log_sigmoid(x, name=None):
+    return dispatch("log_sigmoid", jax.nn.log_sigmoid, (x,))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def impl(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (groups, c // groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax)
+
+    return dispatch("maxout", impl, (x,))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return dispatch(
+        "softplus",
+        lambda a: jnp.where(beta * a > threshold, a,
+                            jnp.log1p(jnp.exp(beta * a)) / beta).astype(a.dtype),
+        (x,))
+
+
+def softsign(x, name=None):
+    return dispatch("softsign", jax.nn.soft_sign, (x,))
+
+
+def tanh(x, name=None):
+    return dispatch("tanh", jnp.tanh, (x,))
+
+
+def mish(x, name=None):
+    return dispatch("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), (x,))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtypes import convert_dtype
+    d = convert_dtype(dtype)
+
+    def impl(a):
+        arr = a.astype(d) if d is not None else a
+        return jax.nn.softmax(arr, axis=axis)
+
+    return dispatch("softmax", impl, (x,))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    x._in_place_update(softmax(x, axis, dtype))
+    return x
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtypes import convert_dtype
+    d = convert_dtype(dtype)
+
+    def impl(a):
+        arr = a.astype(d) if d is not None else a
+        return jax.nn.log_softmax(arr, axis=axis)
+
+    return dispatch("log_softmax", impl, (x,))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core.generator import default_generator
+    key = default_generator().next_key()
+
+    def impl(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis) \
+                if hasattr(jnp, "put_along_axis") else \
+                y_hard.at[..., :].set(jax.nn.one_hot(
+                    jnp.argmax(y, axis=axis), y.shape[axis], dtype=y.dtype))
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+
+    return dispatch("gumbel_softmax", impl, (x,))
+
+
+def glu(x, axis=-1, name=None):
+    def impl(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+
+    return dispatch("glu", impl, (x,))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return dispatch(
+        "thresholded_relu",
+        lambda a: jnp.where(a > threshold, a, value).astype(a.dtype), (x,))

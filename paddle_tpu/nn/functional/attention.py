@@ -1,0 +1,148 @@
+"""Attention functionals.
+
+Analogue of ``python/paddle/nn/functional/flash_attention.py`` (which calls
+the FlashAttention-2 CUDA kernels, reference
+``paddle/phi/kernels/gpu/flash_attn_kernel.cu``).  Here the TPU path is a
+Pallas flash-attention kernel (:mod:`paddle_tpu.ops.pallas.flash_attention`);
+elsewhere a pure-XLA softmax attention (which XLA fuses reasonably well).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded", "sdp_kernel"]
+
+
+def _xla_attention(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None):
+    # q,k,v: [B, S, H, D] (paddle flash-attn layout)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    qt = jnp.swapaxes(q, 1, 2)  # B,H,S,D
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    # grouped-query attention: broadcast kv heads if fewer
+    if kt.shape[1] != qt.shape[1]:
+        rep = qt.shape[1] // kt.shape[1]
+        kt = jnp.repeat(kt, rep, axis=1)
+        vt = jnp.repeat(vt, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * s
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cmask, logits, -1e30)
+    if mask is not None:
+        logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)  # back to B,S,H,D
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """q/k/v: [batch, seq, heads, head_dim] — reference flash_attention API."""
+    from ...ops.pallas import flash_attention as pallas_fa
+    if pallas_fa.should_use_pallas(query, causal=causal, dropout=dropout):
+        out = pallas_fa.flash_attention(query, key, value, causal=causal)
+        return (out, None) if return_softmax else out
+
+    def impl(q, k, v):
+        return _xla_attention(q, k, v, causal=causal)
+
+    out = dispatch("flash_attention", impl, (query, key, value))
+    if return_softmax:
+        return out, None
+    return out
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """q/k/v: [batch, seq, heads, head_dim] (reference API layout)."""
+    from ...ops.pallas import flash_attention as pallas_fa
+    if attn_mask is None and pallas_fa.should_use_pallas(
+            query, causal=is_causal, dropout=dropout_p):
+        return pallas_fa.flash_attention(query, key, value, causal=is_causal)
+
+    if attn_mask is None:
+        def impl(q, k, v):
+            return _xla_attention(q, k, v, causal=is_causal)
+
+        return dispatch("sdpa", impl, (query, key, value))
+
+    def impl(q, k, v, m):
+        return _xla_attention(q, k, v, mask=m, causal=is_causal)
+
+    return dispatch("sdpa", impl, (query, key, value, attn_mask),
+                    nondiff_mask=[False, False, False, True])
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False, name=None):
+    """Varlen attention: runs dense attention with a mask built from the
+    cumulative sequence lengths (XLA wants static shapes; the padded-dense
+    form is the TPU-native expression of varlen batches)."""
+
+    def impl(q, k, v, cu_q, cu_k):
+        # q: [total_q, H, D] packed; reconstruct per-seq mask on the fly
+        b = cu_q.shape[0] - 1
+        # build dense [B, max_q, H, D]
+        def gather_seq(packed, cu, max_len):
+            def one(i):
+                start = cu[i]
+                length = cu[i + 1] - start
+                idx = start + jnp.minimum(jnp.arange(max_len), length - 1)
+                seq = jnp.take(packed, idx, axis=0)
+                valid = (jnp.arange(max_len) < length)[:, None, None]
+                return seq * valid
+            return jax.vmap(one)(jnp.arange(b))
+
+        qd = gather_seq(q, cu_q, max_seqlen_q)
+        kd = gather_seq(k, cu_k, max_seqlen_k)
+        vd = gather_seq(v, cu_k, max_seqlen_k)
+        lens_q = cu_q[1:] - cu_q[:-1]
+        lens_k = cu_k[1:] - cu_k[:-1]
+        mask = jnp.where(
+            (jnp.arange(max_seqlen_k)[None, None, None, :] <
+             lens_k[:, None, None, None]), 0.0, -1e30)
+        out = _xla_attention(qd, kd, vd, mask=mask, causal=causal, scale=scale)
+        # repack
+        def scatter_seq(dense_i, cu, total):
+            return dense_i  # returned dense; caller reshapes
+
+        # pack back to [total_q, H, D]
+        def one_out(i):
+            return out[i]
+        total_q = q.shape[0]
+        flat = out.reshape(-1, out.shape[-2], out.shape[-1])
+        pos = (cu_q[:, None] + jnp.arange(max_seqlen_q)[None, :]).reshape(-1)
+        valid = (jnp.arange(max_seqlen_q)[None, :] <
+                 (cu_q[1:] - cu_q[:-1])[:, None]).reshape(-1)
+        res = jnp.zeros_like(q)
+        res = res.at[jnp.where(valid, pos, total_q - 1)].add(
+            flat * valid[:, None, None])
+        return res
+
+    return dispatch("flash_attn_unpadded", impl,
+                    (query, key, value, cu_seqlens_q, cu_seqlens_k),
+                    nondiff_mask=[False, False, False, True, True])
+
+
+class sdp_kernel:
+    """Context selecting attention backends (API parity shim)."""
+
+    def __init__(self, enable_flash=True, enable_math=True,
+                 enable_mem_efficient=True):
+        self.enable_flash = enable_flash
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

@@ -1,0 +1,279 @@
+"""Common functional ops: linear, dropout, pad, interpolate, embedding-adjacent
+utilities (analogue of python/paddle/nn/functional/common.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+from ...core.generator import default_generator
+from ...core.tensor import Tensor
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "pad",
+    "interpolate", "upsample", "bilinear", "cosine_similarity", "pixel_shuffle",
+    "pixel_unshuffle", "channel_shuffle", "label_smooth", "unfold", "fold",
+    "zeropad2d",
+]
+
+from ...tensor.manipulation import pad  # shared impl
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W shaped [in, out] (reference convention)."""
+    if bias is None:
+        return dispatch("linear", lambda a, w: jnp.matmul(a, w), (x, weight))
+    return dispatch("linear",
+                    lambda a, w, b: jnp.matmul(a, w) + b, (x, weight, bias))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return dispatch("dropout", lambda a: a * (1.0 - p), (x,))
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    key = default_generator().next_key()
+
+    def impl(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return dispatch("dropout", impl, (x,))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = default_generator().next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def impl(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+
+    return dispatch("alpha_dropout", impl, (x,))
+
+
+def _resize_nearest(a, out_hw, data_format):
+    nhwc = a if data_format == "NHWC" else jnp.transpose(a, (0, 2, 3, 1))
+    n, h, w, c = nhwc.shape
+    oh, ow = out_hw
+    rows = (jnp.arange(oh) * (h / oh)).astype(jnp.int32)
+    cols = (jnp.arange(ow) * (w / ow)).astype(jnp.int32)
+    out = nhwc[:, rows][:, :, cols]
+    return out if data_format == "NHWC" else jnp.transpose(out, (0, 3, 1, 2))
+
+
+def _resize_linear_nd(a, out_spatial, data_format, align_corners, ndim_spatial):
+    # channels-last resize via jax.image
+    if data_format.startswith("NC"):
+        perm = (0,) + tuple(range(2, 2 + ndim_spatial)) + (1,)
+        a = jnp.transpose(a, perm)
+    n = a.shape[0]
+    c = a.shape[-1]
+    method = "bilinear" if ndim_spatial >= 2 else "linear"
+    if ndim_spatial == 3:
+        method = "trilinear"
+    out = jax.image.resize(a, (n,) + tuple(out_spatial) + (c,), method=method)
+    if data_format.startswith("NC"):
+        inv = (0, ndim_spatial + 1) + tuple(range(1, 1 + ndim_spatial))
+        out = jnp.transpose(out, inv)
+    return out
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    def impl(a):
+        ndim_spatial = a.ndim - 2
+        if data_format.startswith("NC"):
+            in_spatial = a.shape[2:]
+        else:
+            in_spatial = a.shape[1:-1]
+        if size is not None:
+            out_spatial = tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                                for s in (size if isinstance(size, (list, tuple))
+                                          else [size] * ndim_spatial))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * ndim_spatial
+            out_spatial = tuple(int(s * f) for s, f in zip(in_spatial, sf))
+        if mode == "nearest" and ndim_spatial == 2:
+            return _resize_nearest(a, out_spatial, data_format)
+        return _resize_linear_nd(a, out_spatial, data_format, align_corners,
+                                 ndim_spatial)
+
+    return dispatch("interpolate", impl, (x,))
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def impl(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return dispatch("bilinear", impl, args)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def impl(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return dispatch("cosine_similarity", impl, (x1, x2))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def impl(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            oc = c // (r * r)
+            out = a.reshape(n, oc, r, r, h, w)
+            out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+            return out.reshape(n, oc, h * r, w * r)
+        n, h, w, c = a.shape
+        oc = c // (r * r)
+        out = a.reshape(n, h, w, r, r, oc)
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        return out.reshape(n, h * r, w * r, oc)
+
+    return dispatch("pixel_shuffle", impl, (x,))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def impl(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            oh, ow = h // r, w // r
+            out = a.reshape(n, c, oh, r, ow, r)
+            out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+            return out.reshape(n, c * r * r, oh, ow)
+        n, h, w, c = a.shape
+        oh, ow = h // r, w // r
+        out = a.reshape(n, oh, r, ow, r, c)
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        return out.reshape(n, oh, ow, c * r * r)
+
+    return dispatch("pixel_unshuffle", impl, (x,))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def impl(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, groups, c // groups, h, w)
+            out = jnp.swapaxes(out, 1, 2)
+            return out.reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h, w, groups, c // groups)
+        out = jnp.swapaxes(out, 3, 4)
+        return out.reshape(n, h, w, c)
+
+    return dispatch("channel_shuffle", impl, (x,))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def impl(lbl, *rest):
+        k = lbl.shape[-1]
+        if rest:
+            return (1.0 - epsilon) * lbl + epsilon * rest[0]
+        return (1.0 - epsilon) * lbl + epsilon / k
+
+    args = (label, prior_dist) if prior_dist is not None else (label,)
+    return dispatch("label_smooth", impl, args)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 4
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def impl(a):
+        n, c, h, w = a.shape
+        a_p = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[1]), (pd[2], pd[3])))
+        kh, kw = ks
+        oh = (a_p.shape[2] - (dl[0] * (kh - 1) + 1)) // st[0] + 1
+        ow = (a_p.shape[3] - (dl[1] * (kw - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(kh):
+            for j in range(kw):
+                di, dj = i * dl[0], j * dl[1]
+                patch = a_p[:, :, di:di + oh * st[0]:st[0], dj:dj + ow * st[1]:st[1]]
+                patches.append(patch)
+        out = jnp.stack(patches, axis=2)  # n, c, kh*kw, oh, ow
+        return out.reshape(n, c * kh * kw, oh * ow)
+
+    return dispatch("unfold", impl, (x,))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 4
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def impl(a):
+        n, ckk, l = a.shape
+        kh, kw = ks
+        c = ckk // (kh * kw)
+        ph, pw = os_[0] + pd[0] + pd[1], os_[1] + pd[2] + pd[3]
+        oh = (ph - (dl[0] * (kh - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (kw - 1) + 1)) // st[1] + 1
+        cols = a.reshape(n, c, kh, kw, oh, ow)
+        out = jnp.zeros((n, c, ph, pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                di, dj = i * dl[0], j * dl[1]
+                out = out.at[:, :, di:di + oh * st[0]:st[0],
+                             dj:dj + ow * st[1]:st[1]].add(cols[:, :, i, j])
+        return out[:, :, pd[0]:ph - pd[1], pd[2]:pw - pd[3]]
+
+    return dispatch("fold", impl, (x,))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)

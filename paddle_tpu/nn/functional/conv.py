@@ -1,0 +1,166 @@
+"""Convolutions (analogue of python/paddle/nn/functional/conv.py).
+
+All convs lower to ``lax.conv_general_dilated``, XLA's single conv primitive
+that maps onto the MXU (reference equivalent: cuDNN conv kernels in
+``paddle/phi/kernels/gpudnn/conv_kernel.cu``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        out = []
+        for item in v:
+            if isinstance(item, (list, tuple)):
+                out.append(tuple(int(i) for i in item))
+            else:
+                out.append(int(item))
+        if len(out) == 1:
+            out = out * n
+        return out
+    return [int(v)] * n
+
+
+def _conv_padding(padding, n_spatial):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    p = _norm_tuple(padding, n_spatial)
+    if all(isinstance(i, int) for i in p):
+        if len(p) == n_spatial:
+            return [(i, i) for i in p]
+        if len(p) == 2 * n_spatial:
+            return [(p[2 * i], p[2 * i + 1]) for i in range(n_spatial)]
+    return [tuple(i) if isinstance(i, (list, tuple)) else (i, i) for i in p]
+
+
+def _dim_numbers(n_spatial, data_format):
+    sp = "DHW"[3 - n_spatial:]
+    if data_format.startswith("NC"):
+        lhs = "NC" + sp
+    else:
+        lhs = "N" + sp + "C"
+    rhs = "OI" + sp
+    return jax.lax.conv_dimension_numbers(
+        (1,) * (n_spatial + 2), (1,) * (n_spatial + 2), (lhs, rhs, lhs))
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
+          n_spatial, name):
+    strides = _norm_tuple(stride, n_spatial)
+    dilations = _norm_tuple(dilation, n_spatial)
+    pad = _conv_padding(padding, n_spatial)
+
+    def impl(a, w, *rest):
+        dn = _dim_numbers(n_spatial, data_format)
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+        if rest:
+            b = rest[0]
+            if data_format.startswith("NC"):
+                b = b.reshape((1, -1) + (1,) * n_spatial)
+            out = out + b
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return dispatch(name, impl, args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NCH" if data_format == "NCL" else "NHC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, df, 1,
+                 "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 2, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 3, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, groups,
+                    dilation, data_format, n_spatial, output_size, name):
+    strides = _norm_tuple(stride, n_spatial)
+    dilations = _norm_tuple(dilation, n_spatial)
+    pad = _conv_padding(padding, n_spatial)
+    opad = _norm_tuple(output_padding, n_spatial)
+
+    def impl(a, w, *rest):
+        sp = "DHW"[3 - n_spatial:]
+        lhs = ("NC" + sp) if data_format.startswith("NC") else ("N" + sp + "C")
+        # weight layout for paddle conv_transpose: [in, out/groups, *k] = IO<sp>
+        dn = jax.lax.conv_dimension_numbers(
+            a.shape, w.shape, (lhs, "IO" + sp, lhs))
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            # transpose conv effective padding: k-1-p on each side (+output_padding)
+            ksp = w.shape[2:]
+            padding_cfg = []
+            for i in range(n_spatial):
+                k_eff = dilations[i] * (ksp[i] - 1) + 1
+                lo = k_eff - 1 - pad[i][0]
+                hi = k_eff - 1 - pad[i][1] + opad[i]
+                padding_cfg.append((lo, hi))
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=(1,) * n_spatial, padding=padding_cfg,
+            lhs_dilation=strides, rhs_dilation=dilations,
+            dimension_numbers=dn, feature_group_count=groups,
+        )
+        if rest:
+            b = rest[0]
+            if data_format.startswith("NC"):
+                b = b.reshape((1, -1) + (1,) * n_spatial)
+            out = out + b
+        return out
+
+    def impl_flip(a, w, *rest):
+        # conv_transpose = conv with flipped spatial kernel & swapped in/out
+        wf = jnp.flip(w, axis=tuple(range(2, 2 + n_spatial)))
+        return impl(a, wf, *rest)
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return dispatch(name, impl_flip, args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    df = "NCH" if data_format == "NCL" else "NHC"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, df, 1, output_size,
+                           "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, data_format, 2, output_size,
+                           "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, data_format, 3, output_size,
+                           "conv3d_transpose")

@@ -1,0 +1,27 @@
+"""Input/embedding functionals (analogue of python/paddle/nn/functional/input.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+
+__all__ = ["embedding", "one_hot"]
+
+from ...tensor.manipulation import one_hot
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Embedding lookup.  ``sparse`` is accepted for API parity; on TPU the
+    lookup is a gather and the gradient a scatter-add — XLA's native sparse
+    path (reference: selected-rows grad in
+    ``paddle/phi/kernels/selected_rows/embedding_grad_kernel.cc``)."""
+
+    def impl(w, idx):
+        out = jnp.take(w, idx.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return dispatch("embedding", impl, (weight, x), nondiff_mask=[False, True])

@@ -1,0 +1,384 @@
+"""Loss functionals (analogue of python/paddle/nn/functional/loss.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+from ...core.tensor import Tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "nll_loss", "mse_loss",
+    "l1_loss", "smooth_l1_loss", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
+    "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
+    "log_loss", "square_error_cost", "sigmoid_focal_loss", "dice_loss",
+    "ctc_loss", "poisson_nll_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss",
+]
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True,
+                  label_smoothing=0.0, name=None):
+    def impl(logits, lbl, *rest):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
+            else jnp.log(jnp.maximum(logits, 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label or (lbl.ndim == logits.ndim and lbl.shape == logits.shape):
+            soft = lbl
+            if label_smoothing > 0.0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+            return _reduce_loss(loss, reduction)
+        idx = lbl.astype(jnp.int32)
+        squeeze = False
+        if idx.ndim == logits.ndim:  # trailing [..., 1] label layout
+            idx = jnp.squeeze(idx, axis=axis)
+            squeeze = True
+        if label_smoothing > 0.0:
+            soft = jax.nn.one_hot(idx, n_classes, axis=axis, dtype=logp.dtype)
+            soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            safe_idx = jnp.where(idx == ignore_index, 0, idx)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe_idx, axis), axis=axis)
+            loss = -jnp.squeeze(picked, axis=axis)
+        valid = idx != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if rest:  # class weights
+            w = rest[0]
+            sample_w = jnp.where(valid, jnp.take(w, jnp.where(valid, idx, 0)), 0.0)
+            loss = loss * sample_w
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(sample_w), 1e-12)
+        if reduction == "mean":
+            n_valid = jnp.maximum(jnp.sum(valid.astype(logp.dtype)), 1.0)
+            return jnp.sum(loss) / n_valid
+        if squeeze and reduction == "none":
+            loss = jnp.expand_dims(loss, axis)
+        return _reduce_loss(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return dispatch("cross_entropy", impl, args,
+                    nondiff_mask=[False, True] + [False] * (len(args) - 2))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    return _nll(input, label, weight, ignore_index, reduction)
+
+
+def _nll(input, label, weight, ignore_index, reduction):
+    def impl(logp, lbl, *rest):
+        idx = lbl.astype(jnp.int32)
+        safe_idx = jnp.where(idx == ignore_index, 0, idx)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe_idx, 1), axis=1)
+        loss = -jnp.squeeze(picked, axis=1)
+        valid = idx != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if rest:
+            w = rest[0]
+            sw = jnp.where(valid, jnp.take(w, safe_idx), 0.0)
+            loss = loss * sw
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(sw), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(logp.dtype)), 1.0)
+        return _reduce_loss(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return dispatch("nll_loss", impl, args,
+                    nondiff_mask=[False, True] + [False] * (len(args) - 2))
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return dispatch("mse_loss",
+                    lambda a, b: _reduce_loss(jnp.square(a - b), reduction),
+                    (input, label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return dispatch("l1_loss",
+                    lambda a, b: _reduce_loss(jnp.abs(a - b), reduction),
+                    (input, label))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def impl(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta) * delta
+        # paddle: huber with delta both threshold and scale
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce_loss(loss, reduction)
+
+    return dispatch("smooth_l1_loss", impl, (input, label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def impl(p, y, *rest):
+        eps = 1e-12
+        loss = -(y * jnp.log(jnp.maximum(p, eps)) +
+                 (1 - y) * jnp.log(jnp.maximum(1 - p, eps)))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce_loss(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return dispatch("binary_cross_entropy", impl, args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def impl(z, y, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        # numerically stable BCE-with-logits
+        neg_abs = -jnp.abs(z)
+        log1p = jnp.log1p(jnp.exp(neg_abs))
+        if pw is None:
+            loss = jnp.maximum(z, 0) - z * y + log1p
+        else:
+            log_sig = -jax.nn.softplus(-z)
+            log_one_minus = -z - jax.nn.softplus(-z)
+            loss = -(pw * y * log_sig + (1 - y) * log_one_minus)
+        if w is not None:
+            loss = loss * w
+        return _reduce_loss(loss, reduction)
+
+    args = (logit, label)
+    if weight is not None:
+        args += (weight,)
+    if pos_weight is not None:
+        args += (pos_weight,)
+    return dispatch("bce_with_logits", impl, args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def impl(logp, target):
+        if log_target:
+            loss = jnp.exp(target) * (target - logp)
+        else:
+            safe_t = jnp.maximum(target, 1e-12)
+            loss = target * (jnp.log(safe_t) - logp)
+            loss = jnp.where(target > 0, loss, 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce_loss(loss, reduction)
+
+    return dispatch("kl_div", impl, (input, label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def impl(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce_loss(loss, reduction)
+
+    return dispatch("margin_ranking_loss", impl, (input, other, label),
+                    nondiff_mask=[False, False, True])
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def impl(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce_loss(loss, reduction)
+
+    return dispatch("hinge_embedding_loss", impl, (input, label),
+                    nondiff_mask=[False, True])
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def impl(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce_loss(loss, reduction)
+
+    return dispatch("cosine_embedding_loss", impl, (input1, input2, label),
+                    nondiff_mask=[False, False, True])
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def impl(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
+
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+        return _reduce_loss(loss, reduction)
+
+    return dispatch("triplet_margin_loss", impl, (input, positive, negative))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def impl(p, y):
+        return -(y * jnp.log(p + epsilon) + (1 - y) * jnp.log(1 - p + epsilon))
+
+    return dispatch("log_loss", impl, (input, label))
+
+
+def square_error_cost(input, label, name=None):
+    return dispatch("square_error_cost",
+                    lambda a, b: jnp.square(a - b), (input, label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def impl(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce_loss(loss, reduction)
+
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return dispatch("sigmoid_focal_loss", impl, args)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def impl(p, y):
+        oh = jax.nn.one_hot(jnp.squeeze(y, -1).astype(jnp.int32),
+                            p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * oh, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(oh, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return dispatch("dice_loss", impl, (input, label),
+                    nondiff_mask=[False, True])
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def impl(a, y):
+        if log_input:
+            loss = jnp.exp(a) - y * a
+        else:
+            loss = a - y * jnp.log(a + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(
+                2 * jnp.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce_loss(loss, reduction)
+
+    return dispatch("poisson_nll_loss", impl, (input, label),
+                    nondiff_mask=[False, True])
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def impl(z, y, *rest):
+        loss = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        loss = jnp.mean(loss, axis=-1)
+        if rest:
+            loss = loss * rest[0]
+        return _reduce_loss(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return dispatch("multi_label_soft_margin_loss", impl, args,
+                    nondiff_mask=[False, True] + [False] * (len(args) - 2))
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def impl(z, y):
+        return _reduce_loss(jnp.log1p(jnp.exp(-y * z)), reduction)
+
+    return dispatch("soft_margin_loss", impl, (input, label),
+                    nondiff_mask=[False, True])
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via dynamic-programming in lax.scan (reference: warpctc binding
+    ``paddle/phi/kernels/gpu/warpctc_kernel.cu``)."""
+
+    def impl(lp, lbl, in_len, lbl_len):
+        # lp: [T, B, C] log-probs (paddle layout); convert to [B, T, C]
+        lp_b = jnp.transpose(lp, (1, 0, 2)) if lp.ndim == 3 else lp
+        B, T, C = lp_b.shape
+        L = lbl.shape[1]
+        S = 2 * L + 1
+        # extended label seq with blanks: [B, S]
+        ext = jnp.full((B, S), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        neg_inf = -1e30
+
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp_b[:, 0, blank])
+        first_lbl = jnp.take_along_axis(
+            lp_b[:, 0, :], ext[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(first_lbl)
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, t):
+            probs_t = jnp.take_along_axis(lp_b[:, t, :], ext, axis=1)
+            a_prev = alpha
+            a_shift1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            m = jnp.maximum(jnp.maximum(a_prev, a_shift1), a_shift2)
+            new = m + jnp.log(
+                jnp.exp(a_prev - m) + jnp.exp(a_shift1 - m) +
+                jnp.exp(a_shift2 - m) + 1e-37)
+            new = new + probs_t
+            # mask time steps beyond input length
+            new = jnp.where((t < in_len)[:, None], new, alpha)
+            return new, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        end1 = 2 * lbl_len.astype(jnp.int32)
+        end2 = end1 - 1
+        ll1 = jnp.take_along_axis(alpha, end1[:, None], axis=1)[:, 0]
+        ll2 = jnp.take_along_axis(alpha, jnp.maximum(end2, 0)[:, None], axis=1)[:, 0]
+        m = jnp.maximum(ll1, ll2)
+        ll = m + jnp.log(jnp.exp(ll1 - m) + jnp.exp(ll2 - m))
+        loss = -ll
+        if norm_by_times:
+            loss = loss / in_len.astype(loss.dtype)
+        if reduction == "mean":
+            return jnp.mean(loss / lbl_len.astype(loss.dtype))
+        return _reduce_loss(loss, reduction)
+
+    return dispatch("ctc_loss", impl,
+                    (log_probs, labels, input_lengths, label_lengths),
+                    nondiff_mask=[False, True, True, True])

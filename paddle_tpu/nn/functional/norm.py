@@ -1,0 +1,186 @@
+"""Normalization functionals (analogue of python/paddle/nn/functional/norm.py).
+
+rms_norm / layer_norm route to Pallas fused kernels on TPU when profitable
+(:mod:`paddle_tpu.ops.pallas.rms_norm`), else pure-XLA (which fuses well
+anyway — the Pallas path exists for the long-row regime).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+
+__all__ = ["normalize", "batch_norm", "layer_norm", "instance_norm",
+           "group_norm", "local_response_norm", "rms_norm"]
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def impl(a):
+        n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+
+    return dispatch("normalize", impl, (x,))
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    ns = ((normalized_shape,) if isinstance(normalized_shape, int)
+          else tuple(normalized_shape))
+    n_axes = len(ns)
+
+    def impl(a, *rest):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon))
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return dispatch("layer_norm", impl, args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (reference: python/paddle/incubate/nn/functional/fused_rms_norm.py)."""
+    from ...ops.pallas import rms_norm as pallas_rms
+    if pallas_rms.should_use_pallas(x):
+        return pallas_rms.rms_norm(x, weight, epsilon)
+
+    def impl(a, *rest):
+        acc = a.astype(jnp.float32)
+        var = jnp.mean(jnp.square(acc), axis=-1, keepdims=True)
+        out = acc * jax.lax.rsqrt(var + epsilon)
+        if rest:
+            out = out * rest[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    args = (x,) + ((weight,) if weight is not None else ())
+    return dispatch("rms_norm", impl, args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Batch norm.  In training mode the running stats tensors are updated
+    in-place (matching the reference's mutable-state semantics)."""
+    from ...core.tensor import Tensor
+
+    channels_first = data_format.startswith("NC") and x.ndim > 2
+    c_axis = 1 if channels_first or x.ndim == 2 else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    use_batch_stats = training and not (use_global_stats is True)
+
+    if use_batch_stats:
+        # compute batch stats eagerly so we can update the running buffers
+        def stats_impl(a):
+            af = a.astype(jnp.float32)
+            m = jnp.mean(af, axis=reduce_axes)
+            v = jnp.var(af, axis=reduce_axes)
+            return m, v
+
+        bmean, bvar = dispatch("batch_norm_stats", stats_impl, (x,))
+        if isinstance(running_mean, Tensor):
+            running_mean.set_value(momentum * running_mean._value +
+                                   (1.0 - momentum) * bmean._value)
+            running_var.set_value(momentum * running_var._value +
+                                  (1.0 - momentum) * bvar._value)
+        mean_t, var_t = bmean, bvar
+    else:
+        mean_t, var_t = running_mean, running_var
+
+    def impl(a, m, v, *rest):
+        shape = [1] * a.ndim
+        shape[c_axis] = -1
+        af = a.astype(jnp.float32)
+        out = (af - m.reshape(shape)) * jax.lax.rsqrt(
+            v.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+
+    args = (x, mean_t, var_t) + tuple(t for t in (weight, bias)
+                                      if t is not None)
+    nondiff = [False, True, True] + [False] * (len(args) - 3)
+    return dispatch("batch_norm", impl, args, nondiff_mask=nondiff)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    def impl(a, *rest):
+        axes = tuple(range(2, a.ndim))
+        af = a.astype(jnp.float32)
+        m = jnp.mean(af, axis=axes, keepdims=True)
+        v = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - m) * jax.lax.rsqrt(v + eps)
+        shape = [1, -1] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return dispatch("instance_norm", impl, args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channels_first = data_format.startswith("NC")
+
+    def impl(a, *rest):
+        if channels_first:
+            n, c = a.shape[0], a.shape[1]
+            spatial = a.shape[2:]
+            g = a.reshape((n, num_groups, c // num_groups) + spatial)
+            axes = tuple(range(2, g.ndim))
+        else:
+            n, c = a.shape[0], a.shape[-1]
+            spatial = a.shape[1:-1]
+            g = a.reshape((n,) + spatial + (num_groups, c // num_groups))
+            axes = tuple(range(1, g.ndim - 2)) + (g.ndim - 1,)
+        gf = g.astype(jnp.float32)
+        m = jnp.mean(gf, axis=axes, keepdims=True)
+        v = jnp.var(gf, axis=axes, keepdims=True)
+        out = ((gf - m) * jax.lax.rsqrt(v + epsilon)).reshape(a.shape)
+        shape = ([1, -1] + [1] * (a.ndim - 2)) if channels_first \
+            else ([1] * (a.ndim - 1) + [-1])
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return dispatch("group_norm", impl, args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def impl(a):
+        sq = jnp.square(a)
+        c_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        moved = jnp.moveaxis(sq, c_axis, -1)
+        pad = [(0, 0)] * (moved.ndim - 1) + [(size // 2, (size - 1) // 2)]
+        padded = jnp.pad(moved, pad)
+        window = jnp.stack([padded[..., i:i + moved.shape[-1]]
+                            for i in range(size)], axis=0).sum(axis=0)
+        div = (k + alpha * window) ** beta
+        return a / jnp.moveaxis(div, -1, c_axis)
+
+    return dispatch("local_response_norm", impl, (x,))

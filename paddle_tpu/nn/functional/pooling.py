@@ -1,0 +1,165 @@
+"""Pooling (analogue of python/paddle/nn/functional/pooling.py) via
+``lax.reduce_window`` (VPU-native windowed reductions)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import dispatch
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]
+
+
+def _tup(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in (list(v) * n if len(v) == 1 else v))
+    return (int(v),) * n
+
+
+def _pool(x, kernel, stride, padding, n_spatial, kind, data_format,
+          ceil_mode=False, exclusive=True, name="pool"):
+    ks = _tup(kernel, n_spatial)
+    st = _tup(stride if stride is not None else kernel, n_spatial)
+    if isinstance(padding, str):
+        pad_cfg = padding.upper()
+    else:
+        p = _tup(padding, n_spatial)
+        pad_cfg = [(i, i) for i in p]
+
+    channels_first = data_format.startswith("NC")
+
+    def impl(a):
+        if channels_first:
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            pads = [(0, 0), (0, 0)] + (pad_cfg if isinstance(pad_cfg, list) else [])
+        else:
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            pads = [(0, 0)] + (pad_cfg if isinstance(pad_cfg, list) else []) + [(0, 0)]
+        if isinstance(pad_cfg, str):
+            pads = pad_cfg
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pads)
+        # avg
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add,
+                                       window, strides, pads)
+        if exclusive and not isinstance(pads, str):
+            ones = jnp.ones_like(a)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
+                                           window, strides, pads)
+            return summed / counts
+        return summed / float(np.prod(ks))
+
+    return dispatch(name, impl, (x,))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", "NCL",
+                 ceil_mode, exclusive, "avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", data_format,
+                 ceil_mode, exclusive, "avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", data_format,
+                 ceil_mode, exclusive, "avg_pool3d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "max", "NCL",
+                 ceil_mode, name="max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "max", data_format,
+                 ceil_mode, name="max_pool2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "max", data_format,
+                 ceil_mode, name="max_pool3d")
+
+
+def _adaptive(x, output_size, n_spatial, kind, name):
+    def impl(a):
+        spatial = a.shape[2:]
+        os = _tup(output_size, n_spatial)
+        os = tuple(o if o is not None else s for o, s in zip(os, spatial))
+        out = a
+        # pool each spatial dim independently with computed windows
+        for d in range(n_spatial):
+            in_s, out_s = out.shape[2 + d], os[d]
+            if in_s == out_s:
+                continue
+            if in_s % out_s == 0:
+                k = in_s // out_s
+                window = [1] * out.ndim
+                strides = [1] * out.ndim
+                window[2 + d] = k
+                strides[2 + d] = k
+                if kind == "max":
+                    out = jax.lax.reduce_window(
+                        out, -jnp.inf, jax.lax.max, tuple(window),
+                        tuple(strides), "VALID")
+                else:
+                    out = jax.lax.reduce_window(
+                        out, 0.0, jax.lax.add, tuple(window), tuple(strides),
+                        "VALID") / k
+            else:
+                # general adaptive: gather per output index
+                starts = (np.arange(out_s) * in_s // out_s)
+                ends = ((np.arange(out_s) + 1) * in_s + out_s - 1) // out_s
+                slices = []
+                moved = jnp.moveaxis(out, 2 + d, 0)
+                for s, e in zip(starts, ends):
+                    seg = moved[s:e]
+                    red = jnp.max(seg, axis=0) if kind == "max" else jnp.mean(seg, axis=0)
+                    slices.append(red)
+                out = jnp.moveaxis(jnp.stack(slices, axis=0), 0, 2 + d)
+        return out
+
+    return dispatch(name, impl, (x,))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max", "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max", "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max", "adaptive_max_pool3d")

@@ -1,0 +1,66 @@
+"""Vision functionals (subset of python/paddle/nn/functional/vision.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+
+__all__ = ["affine_grid", "grid_sample"]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def impl(th):
+        n, c, h, w = [int(s) for s in out_shape]
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) + 0.5) / h * 2 - 1
+            xs = (jnp.arange(w) + 0.5) / w * 2 - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # H,W,3
+        grid = jnp.einsum("hwk,nok->nhwo", base, th)
+        return grid
+
+    return dispatch("affine_grid", impl, (theta,))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def impl(a, g):
+        n, c, h, w = a.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(ix, iy):
+            ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+            iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+            batch = jnp.arange(n)[:, None, None]
+            vals = a[batch, :, iyc, ixc]  # n, gh, gw, c
+            if padding_mode == "zeros":
+                inside = ((ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1))
+                vals = vals * inside[..., None]
+            return vals
+
+        if mode == "nearest":
+            out = sample(jnp.round(fx), jnp.round(fy))
+        else:
+            x0, y0 = jnp.floor(fx), jnp.floor(fy)
+            x1, y1 = x0 + 1, y0 + 1
+            wa = (x1 - fx) * (y1 - fy)
+            wb = (x1 - fx) * (fy - y0)
+            wc = (fx - x0) * (y1 - fy)
+            wd = (fx - x0) * (fy - y0)
+            out = (sample(x0, y0) * wa[..., None] + sample(x0, y1) * wb[..., None]
+                   + sample(x1, y0) * wc[..., None] + sample(x1, y1) * wd[..., None])
+        return jnp.transpose(out, (0, 3, 1, 2))
+
+    return dispatch("grid_sample", impl, (x, grid))

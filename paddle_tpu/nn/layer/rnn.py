@@ -1,0 +1,328 @@
+"""Recurrent layers (analogue of python/paddle/nn/layer/rnn.py).
+
+The whole sequence recurrence runs as ONE dispatched op whose impl is a
+``lax.scan`` — compiler-friendly control flow instead of the reference's
+per-timestep C++ loop (``paddle/phi/kernels/gpu/rnn_kernel.cu``), so jit
+produces a single fused while-loop on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import dispatch
+from ..initializer import Uniform
+from .layers import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN", "LSTM",
+           "GRU", "BiRNN"]
+
+
+class RNNCellBase(Layer):
+    def _init_params(self, input_size, hidden_size, gates, weight_ih_attr=None,
+                     weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (gates * hidden_size, input_size), attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (gates * hidden_size, hidden_size), attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter(
+            (gates * hidden_size,), attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter(
+            (gates * hidden_size,), attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+
+def _cell_step_fns(mode):
+    if mode == "LSTM":
+        def step(x, hc, w_ih, w_hh, b):
+            h, c = hc
+            gates = x @ w_ih.T + h @ w_hh.T + b
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, (h_new, c_new)
+        return step
+    if mode == "GRU":
+        def step(x, hc, w_ih, w_hh, b_split):
+            h = hc[0]
+            b_ih, b_hh = b_split
+            gi = x @ w_ih.T + b_ih
+            gh = h @ w_hh.T + b_hh
+            ri, zi, ni = jnp.split(gi, 3, axis=-1)
+            rh, zh, nh = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ri + rh)
+            z = jax.nn.sigmoid(zi + zh)
+            n = jnp.tanh(ni + r * nh)
+            h_new = (1 - z) * n + z * h
+            return h_new, (h_new,)
+        return step
+
+    def step(x, hc, w_ih, w_hh, b):
+        h_new = jnp.tanh(x @ w_ih.T + hc[0] @ w_hh.T + b)
+        return h_new, (h_new,)
+    return step
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self._init_params(input_size, hidden_size, 1, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        from ...tensor.creation import zeros
+        if states is None:
+            states = zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+
+        def impl(x, h, w_ih, w_hh, b_ih, b_hh):
+            return jnp.tanh(x @ w_ih.T + h @ w_hh.T + b_ih + b_hh)
+
+        h = dispatch("simple_rnn_cell", impl,
+                     (inputs, states, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh))
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self._init_params(input_size, hidden_size, 4, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        from ...tensor.creation import zeros
+        if states is None:
+            z = zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+            states = (z, z)
+        h0, c0 = states
+
+        def impl(x, h, c, w_ih, w_hh, b_ih, b_hh):
+            step = _cell_step_fns("LSTM")
+            h_new, (h2, c2) = step(x, (h, c), w_ih, w_hh, b_ih + b_hh)
+            return h2, c2
+
+        h, c = dispatch("lstm_cell", impl,
+                        (inputs, h0, c0, self.weight_ih, self.weight_hh,
+                         self.bias_ih, self.bias_hh))
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self._init_params(input_size, hidden_size, 3, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        from ...tensor.creation import zeros
+        if states is None:
+            states = zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+
+        def impl(x, h, w_ih, w_hh, b_ih, b_hh):
+            step = _cell_step_fns("GRU")
+            h_new, _ = step(x, (h,), w_ih, w_hh, (b_ih, b_hh))
+            return h_new
+
+        h = dispatch("gru_cell", impl,
+                     (inputs, states, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh))
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (reference RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # generic path: python loop in eager; used for custom cells
+        seq_axis = 0 if self.time_major else 1
+        steps = inputs.shape[seq_axis]
+        outs = []
+        state = initial_states
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        from ...tensor.manipulation import stack
+        for t in order:
+            xt = inputs[:, t] if seq_axis == 1 else inputs[t]
+            out, state = self.cell(xt, state)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis=seq_axis), state
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirect else 1
+        gates = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1}[mode]
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for direction_i in range(num_dir):
+                in_sz = input_size if layer == 0 else hidden_size * num_dir
+                suffix = "_reverse" if direction_i else ""
+                w_ih = self.create_parameter((gates * hidden_size, in_sz),
+                                             default_initializer=init)
+                w_hh = self.create_parameter((gates * hidden_size, hidden_size),
+                                             default_initializer=init)
+                b_ih = self.create_parameter((gates * hidden_size,),
+                                             is_bias=True,
+                                             default_initializer=init)
+                b_hh = self.create_parameter((gates * hidden_size,),
+                                             is_bias=True,
+                                             default_initializer=init)
+                setattr(self, f"weight_ih_l{layer}{suffix}", w_ih)
+                setattr(self, f"weight_hh_l{layer}{suffix}", w_hh)
+                setattr(self, f"bias_ih_l{layer}{suffix}", b_ih)
+                setattr(self, f"bias_hh_l{layer}{suffix}", b_hh)
+                self._all_weights.append((w_ih, w_hh, b_ih, b_hh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.creation import zeros
+        mode = self.mode
+        num_dir = 2 if self.bidirect else 1
+        b_axis = 1 if self.time_major else 0
+        batch = inputs.shape[b_axis]
+        if initial_states is None:
+            shape = [self.num_layers * num_dir, batch, self.hidden_size]
+            if mode == "LSTM":
+                initial_states = (zeros(shape, inputs.dtype),
+                                  zeros(shape, inputs.dtype))
+            else:
+                initial_states = zeros(shape, inputs.dtype)
+
+        is_lstm = mode == "LSTM"
+        h0 = initial_states[0] if is_lstm else initial_states
+        c0 = initial_states[1] if is_lstm else None
+        time_major = self.time_major
+        num_layers = self.num_layers
+        step = _cell_step_fns("LSTM" if is_lstm else
+                              ("GRU" if mode == "GRU" else "RNN"))
+
+        flat_weights = [w for tup in self._all_weights for w in tup]
+
+        def impl(x, h_all, *rest):
+            if is_lstm:
+                c_all = rest[0]
+                ws = rest[1:]
+            else:
+                c_all = None
+                ws = rest
+            seq = x if time_major else jnp.swapaxes(x, 0, 1)  # T,B,F
+            layer_in = seq
+            h_outs, c_outs = [], []
+            idx = 0
+            for layer in range(num_layers):
+                dir_outs = []
+                for d in range(num_dir):
+                    w_ih, w_hh, b_ih, b_hh = ws[4 * idx:4 * idx + 4]
+                    idx += 1
+                    state_i = layer * num_dir + d
+                    h_init = h_all[state_i]
+                    carry = (h_init, c_all[state_i]) if is_lstm else (h_init,)
+
+                    xs = jnp.flip(layer_in, 0) if d == 1 else layer_in
+
+                    def scan_step(carry_s, xt, w_ih=w_ih, w_hh=w_hh,
+                                  b_ih=b_ih, b_hh=b_hh):
+                        if mode == "GRU":
+                            out, new = step(xt, carry_s, w_ih, w_hh,
+                                            (b_ih, b_hh))
+                        else:
+                            out, new = step(xt, carry_s, w_ih, w_hh,
+                                            b_ih + b_hh)
+                        return new, out
+
+                    final, ys = jax.lax.scan(scan_step, carry, xs)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    dir_outs.append(ys)
+                    h_outs.append(final[0])
+                    if is_lstm:
+                        c_outs.append(final[1])
+                layer_in = jnp.concatenate(dir_outs, axis=-1) if num_dir == 2 \
+                    else dir_outs[0]
+            out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+            h_stack = jnp.stack(h_outs, axis=0)
+            if is_lstm:
+                return out, h_stack, jnp.stack(c_outs, axis=0)
+            return out, h_stack
+
+        if is_lstm:
+            out, h, c = dispatch("lstm", impl,
+                                 (inputs, h0, c0, *flat_weights))
+            return out, (h, c)
+        out, h = dispatch(mode.lower(), impl, (inputs, h0, *flat_weights))
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN_TANH", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.fw(inputs, states_fw)
+        out_bw, st_bw = self.bw(inputs, states_bw)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

@@ -1,0 +1,1 @@
+"""paddle_tpu.ops — op registry and Pallas kernel pack."""

@@ -1,0 +1,6 @@
+"""Pallas TPU kernels (the analogue of the reference's hand-written CUDA
+kernel set: flash-attention, fused norms, rope — SURVEY §2.1 rows
+"FlashAttention-2 integration" and "Fusion kernels")."""
+
+from . import flash_attention  # noqa: F401
+from . import rms_norm  # noqa: F401

@@ -1,0 +1,19 @@
+"""Shared predicates for Pallas kernel selection."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ...core.flags import flag
+
+
+@functools.lru_cache(maxsize=None)
+def on_tpu() -> bool:
+    plat = jax.devices()[0].platform
+    return plat in ("tpu", "axon")
+
+
+def pallas_enabled() -> bool:
+    return flag("prefer_pallas_kernels") and on_tpu()

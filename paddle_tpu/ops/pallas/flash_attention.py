@@ -1,0 +1,13 @@
+"""Pallas flash-attention (TPU).  Placeholder gating until the kernel lands
+in this round; the XLA fallback in nn.functional.attention is numerically
+complete."""
+
+from __future__ import annotations
+
+
+def should_use_pallas(query, causal=False, dropout=0.0) -> bool:
+    return False  # kernel lands later this round; fallback is XLA attention
+
+
+def flash_attention(q, k, v, causal=False):
+    raise NotImplementedError

@@ -1,0 +1,11 @@
+"""Pallas fused RMSNorm (TPU).  Placeholder gating until the kernel lands."""
+
+from __future__ import annotations
+
+
+def should_use_pallas(x) -> bool:
+    return False
+
+
+def rms_norm(x, weight, epsilon):
+    raise NotImplementedError

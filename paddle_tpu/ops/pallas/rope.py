@@ -1,0 +1,7 @@
+"""Pallas fused RoPE (TPU).  Placeholder gating until the kernel lands."""
+
+from __future__ import annotations
+
+
+def should_use_pallas(q) -> bool:
+    return False

@@ -1,0 +1,437 @@
+"""paddle_tpu.optimizer (analogue of paddle.optimizer).
+
+Each optimizer implements `_append_optimize_op(param, grad, lr, wd)` as a
+pure jitted update (cached per shape/dtype by jax.jit) that mirrors the
+reference's accumulator semantics (beta pow accumulators, master weights).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import lr  # noqa: F401
+from .lr import LRScheduler  # noqa: F401
+from .optimizer import Optimizer
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Lamb",
+           "RMSProp", "Adagrad", "Adadelta", "LBFGS", "lr", "LRScheduler"]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _sgd_update(p, g, lr_):
+    return p - lr_ * g.astype(p.dtype)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._wd_is_l2 = weight_decay is not None
+
+    def _append_optimize_op(self, p, grad, lr_, wd):
+        if self._use_master(p):
+            mw = self._master_weight(p)
+            new_mw = _sgd_update(mw, grad.astype(jnp.float32),
+                                 jnp.float32(lr_))
+            self._master_weights[id(p)] = new_mw
+            p._value = new_mw.astype(p._value.dtype)
+        else:
+            p._value = _sgd_update(p._value, grad, jnp.asarray(lr_, p._value.dtype))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2),
+                   static_argnames=("use_nesterov",))
+def _momentum_update(p, g, vel, lr_, mu, use_nesterov):
+    g = g.astype(p.dtype)
+    v_new = mu * vel + g
+    if use_nesterov:
+        p_new = p - lr_ * (g + mu * v_new)
+    else:
+        p_new = p - lr_ * v_new
+    return p_new, v_new
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+        self._wd_is_l2 = weight_decay is not None
+
+    def _create_accumulators(self, p):
+        self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, p, grad, lr_, wd):
+        vel = self._get_accumulator("velocity", p)
+        if self._use_master(p):
+            mw = self._master_weight(p)
+            new_mw, new_vel = _momentum_update(
+                mw, grad.astype(jnp.float32), vel, jnp.float32(lr_),
+                jnp.float32(self._momentum), self._use_nesterov)
+            self._master_weights[id(p)] = new_mw
+            p._value = new_mw.astype(p._value.dtype)
+        else:
+            p._value, new_vel = _momentum_update(
+                p._value, grad, vel, jnp.asarray(lr_, p._value.dtype),
+                jnp.asarray(self._momentum, p._value.dtype),
+                self._use_nesterov)
+        self._set_accumulator("velocity", p, new_vel)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3), static_argnames=("wd_mode",))
+def _adam_update(p, g, m, v, lr_, beta1, beta2, eps, b1pow, b2pow, wd,
+                 wd_mode):
+    gf = g.astype(m.dtype)
+    pf = p
+    if wd_mode == "decoupled":
+        pf = pf * (1.0 - lr_ * wd)
+    m_new = beta1 * m + (1 - beta1) * gf
+    v_new = beta2 * v + (1 - beta2) * gf * gf
+    m_hat = m_new / (1 - b1pow)
+    v_hat = v_new / (1 - b2pow)
+    p_new = pf - lr_ * m_hat / (jnp.sqrt(v_hat) + eps)
+    return p_new, m_new, v_new
+
+
+class Adam(Optimizer):
+    _wd_mode = "l2"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._wd_is_l2 = weight_decay is not None and self._wd_mode == "l2"
+
+    def _create_accumulators(self, p):
+        self._add_accumulator("moment1", p, dtype=jnp.float32)
+        self._add_accumulator("moment2", p, dtype=jnp.float32)
+        if "beta1_pow" not in self._accumulators or \
+                id(p) not in self._accumulators["beta1_pow"]:
+            self._accumulators["beta1_pow"][id(p)] = jnp.ones((), jnp.float32)
+            self._accumulators["beta2_pow"][id(p)] = jnp.ones((), jnp.float32)
+
+    def _append_optimize_op(self, p, grad, lr_, wd):
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        b1p = self._accumulators["beta1_pow"][id(p)] * self._beta1
+        b2p = self._accumulators["beta2_pow"][id(p)] * self._beta2
+        self._accumulators["beta1_pow"][id(p)] = b1p
+        self._accumulators["beta2_pow"][id(p)] = b2p
+        wd_mode = "decoupled" if (self._wd_mode == "decoupled" and wd) else "none"
+        use_master = self._use_master(p)
+        target = self._master_weight(p) if use_master else p._value
+        new_p, new_m, new_v = _adam_update(
+            target, grad, m, v, jnp.float32(lr_), jnp.float32(self._beta1),
+            jnp.float32(self._beta2), jnp.float32(self._epsilon), b1p, b2p,
+            jnp.float32(wd or 0.0), wd_mode)
+        if use_master:
+            self._master_weights[id(p)] = new_p
+            p._value = new_p.astype(p._value.dtype)
+        else:
+            p._value = new_p.astype(p._value.dtype)
+        self._set_accumulator("moment1", p, new_m)
+        self._set_accumulator("moment2", p, new_v)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference python/paddle/optimizer/adamw.py)."""
+
+    _wd_mode = "decoupled"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._wd_is_l2 = False
+
+    def _append_optimize_op(self, p, grad, lr_, wd):
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            wd = 0.0
+        super()._append_optimize_op(p, grad, lr_, wd)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _adamax_update(p, g, m, inf_norm, lr_, beta1, beta2, eps, b1pow):
+    gf = g.astype(m.dtype)
+    m_new = beta1 * m + (1 - beta1) * gf
+    inf_new = jnp.maximum(beta2 * inf_norm, jnp.abs(gf))
+    p_new = p - (lr_ / (1 - b1pow)) * m_new / (inf_new + eps)
+    return p_new, m_new, inf_new
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._wd_is_l2 = weight_decay is not None
+
+    def _create_accumulators(self, p):
+        self._add_accumulator("moment", p, dtype=jnp.float32)
+        self._add_accumulator("inf_norm", p, dtype=jnp.float32)
+        if id(p) not in self._accumulators["beta1_pow"]:
+            self._accumulators["beta1_pow"][id(p)] = jnp.ones((), jnp.float32)
+
+    def _append_optimize_op(self, p, grad, lr_, wd):
+        m = self._get_accumulator("moment", p)
+        inf = self._get_accumulator("inf_norm", p)
+        b1p = self._accumulators["beta1_pow"][id(p)] * self._beta1
+        self._accumulators["beta1_pow"][id(p)] = b1p
+        new_p, new_m, new_inf = _adamax_update(
+            p._value.astype(jnp.float32), grad, m, inf, jnp.float32(lr_),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._epsilon), b1p)
+        p._value = new_p.astype(p._value.dtype)
+        self._set_accumulator("moment", p, new_m)
+        self._set_accumulator("inf_norm", p, new_inf)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _lamb_update(p, g, m, v, lr_, beta1, beta2, eps, lamb_wd, b1pow, b2pow):
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    m_new = beta1 * m + (1 - beta1) * gf
+    v_new = beta2 * v + (1 - beta2) * gf * gf
+    m_hat = m_new / (1 - b1pow)
+    v_hat = v_new / (1 - b2pow)
+    r = m_hat / (jnp.sqrt(v_hat) + eps) + lamb_wd * pf
+    w_norm = jnp.linalg.norm(pf)
+    r_norm = jnp.linalg.norm(r)
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    p_new = pf - lr_ * ratio * r
+    return p_new, m_new, v_new
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._lamb_wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _create_accumulators(self, p):
+        self._add_accumulator("moment1", p, dtype=jnp.float32)
+        self._add_accumulator("moment2", p, dtype=jnp.float32)
+        if id(p) not in self._accumulators["beta1_pow"]:
+            self._accumulators["beta1_pow"][id(p)] = jnp.ones((), jnp.float32)
+            self._accumulators["beta2_pow"][id(p)] = jnp.ones((), jnp.float32)
+
+    def _append_optimize_op(self, p, grad, lr_, wd):
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        b1p = self._accumulators["beta1_pow"][id(p)] * self._beta1
+        b2p = self._accumulators["beta2_pow"][id(p)] * self._beta2
+        self._accumulators["beta1_pow"][id(p)] = b1p
+        self._accumulators["beta2_pow"][id(p)] = b2p
+        lamb_wd = 0.0 if (self._exclude_fn is not None and
+                          self._exclude_fn(p)) else self._lamb_wd
+        new_p, new_m, new_v = _lamb_update(
+            p._value, grad, m, v, jnp.float32(lr_), jnp.float32(self._beta1),
+            jnp.float32(self._beta2), jnp.float32(self._epsilon),
+            jnp.float32(lamb_wd), b1p, b2p)
+        p._value = new_p.astype(p._value.dtype)
+        self._set_accumulator("moment1", p, new_m)
+        self._set_accumulator("moment2", p, new_v)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3, 4),
+                   static_argnames=("centered",))
+def _rmsprop_update(p, g, mean_sq, mean_g, mom, lr_, rho, eps, momentum,
+                    centered):
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    ms_new = rho * mean_sq + (1 - rho) * gf * gf
+    if centered:
+        mg_new = rho * mean_g + (1 - rho) * gf
+        denom = jnp.sqrt(ms_new - mg_new * mg_new + eps)
+    else:
+        mg_new = mean_g
+        denom = jnp.sqrt(ms_new + eps)
+    mom_new = momentum * mom + lr_ * gf / denom
+    return pf - mom_new, ms_new, mg_new, mom_new
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+        self._wd_is_l2 = weight_decay is not None
+
+    def _create_accumulators(self, p):
+        self._add_accumulator("mean_square", p, dtype=jnp.float32)
+        self._add_accumulator("mean_grad", p, dtype=jnp.float32)
+        self._add_accumulator("momentum_acc", p, dtype=jnp.float32)
+
+    def _append_optimize_op(self, p, grad, lr_, wd):
+        ms = self._get_accumulator("mean_square", p)
+        mg = self._get_accumulator("mean_grad", p)
+        mom = self._get_accumulator("momentum_acc", p)
+        new_p, ms2, mg2, mom2 = _rmsprop_update(
+            p._value, grad, ms, mg, mom, jnp.float32(lr_),
+            jnp.float32(self._rho), jnp.float32(self._epsilon),
+            jnp.float32(self._momentum), self._centered)
+        p._value = new_p.astype(p._value.dtype)
+        self._set_accumulator("mean_square", p, ms2)
+        self._set_accumulator("mean_grad", p, mg2)
+        self._set_accumulator("momentum_acc", p, mom2)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2))
+def _adagrad_update(p, g, moment, lr_, eps):
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    mom_new = moment + gf * gf
+    return pf - lr_ * gf / (jnp.sqrt(mom_new) + eps), mom_new
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+        self._wd_is_l2 = weight_decay is not None
+
+    def _create_accumulators(self, p):
+        self._add_accumulator("moment_acc", p, fill_value=self._init_acc,
+                              dtype=jnp.float32)
+
+    def _append_optimize_op(self, p, grad, lr_, wd):
+        mom = self._get_accumulator("moment_acc", p)
+        new_p, mom2 = _adagrad_update(p._value, grad, mom, jnp.float32(lr_),
+                                      jnp.float32(self._epsilon))
+        p._value = new_p.astype(p._value.dtype)
+        self._set_accumulator("moment_acc", p, mom2)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _adadelta_update(p, g, avg_sq_grad, avg_sq_update, lr_, rho, eps):
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    asg = rho * avg_sq_grad + (1 - rho) * gf * gf
+    update = jnp.sqrt(avg_sq_update + eps) / jnp.sqrt(asg + eps) * gf
+    asu = rho * avg_sq_update + (1 - rho) * update * update
+    return pf - lr_ * update, asg, asu
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon, self._rho = epsilon, rho
+        self._wd_is_l2 = weight_decay is not None
+
+    def _create_accumulators(self, p):
+        self._add_accumulator("avg_squared_grad", p, dtype=jnp.float32)
+        self._add_accumulator("avg_squared_update", p, dtype=jnp.float32)
+
+    def _append_optimize_op(self, p, grad, lr_, wd):
+        asg = self._get_accumulator("avg_squared_grad", p)
+        asu = self._get_accumulator("avg_squared_update", p)
+        new_p, asg2, asu2 = _adadelta_update(
+            p._value, grad, asg, asu, jnp.float32(lr_),
+            jnp.float32(self._rho), jnp.float32(self._epsilon))
+        p._value = new_p.astype(p._value.dtype)
+        self._set_accumulator("avg_squared_grad", p, asg2)
+        self._set_accumulator("avg_squared_update", p, asu2)
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS (reference python/paddle/optimizer/lbfgs.py).
+    Requires a closure re-evaluating the loss."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.max_iter = max_iter
+        self.history_size = history_size
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self._s_hist = []
+        self._y_hist = []
+        self._prev_flat_grad = None
+        self._prev_flat_w = None
+
+    def _flatten(self, tensors):
+        return jnp.concatenate([t.reshape(-1).astype(jnp.float32)
+                                for t in tensors])
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure")
+        from ..core import tape as _tape
+        params = [p for p in self._parameter_list if not p.stop_gradient]
+        with _tape.enable_grad():
+            loss = closure()
+        flat_g = self._flatten([p._grad._value for p in params])
+        flat_w = self._flatten([p._value for p in params])
+        if self._prev_flat_grad is not None:
+            s = flat_w - self._prev_flat_w
+            y = flat_g - self._prev_flat_grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                if len(self._s_hist) > self.history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+        # two-loop recursion
+        q = flat_g
+        alphas = []
+        for s, y in zip(reversed(self._s_hist), reversed(self._y_hist)):
+            rho = 1.0 / jnp.dot(y, s)
+            alpha = rho * jnp.dot(s, q)
+            q = q - alpha * y
+            alphas.append((rho, alpha))
+        if self._y_hist:
+            y_last, s_last = self._y_hist[-1], self._s_hist[-1]
+            q = q * (jnp.dot(s_last, y_last) / jnp.dot(y_last, y_last))
+        for (s, y), (rho, alpha) in zip(zip(self._s_hist, self._y_hist),
+                                        reversed(alphas)):
+            beta = rho * jnp.dot(y, q)
+            q = q + (alpha - beta) * s
+        direction = -q
+        lr_ = self.get_lr()
+        new_flat = flat_w + lr_ * direction
+        # unflatten
+        offset = 0
+        for p in params:
+            n = p.size
+            p._value = new_flat[offset:offset + n].reshape(
+                p._value.shape).astype(p._value.dtype)
+            offset += n
+        self._prev_flat_grad = flat_g
+        self._prev_flat_w = flat_w
+        return loss

@@ -1,0 +1,193 @@
+"""Optimizer base (analogue of python/paddle/optimizer/optimizer.py).
+
+Mirrors the reference semantics: per-parameter accumulators, parameter
+groups, grad clip hooks, regularization (decoupled or L2), master weights
+for low-precision params.  Each update step runs as one jitted functional
+update per parameter (XLA fuses the elementwise chain; the compiled
+TrainStep path in paddle_tpu.jit fuses across parameters too).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tape import no_grad
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators: Dict[str, Dict[int, jax.Array]] = defaultdict(dict)
+        self._master_weights: Dict[int, jax.Array] = {}
+        self._param_groups: List[dict] = []
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                for g in parameters:
+                    self._add_param_group(dict(g))
+            else:
+                self._add_param_group({"params": parameters})
+        else:
+            self._add_param_group({"params": None})  # all live params, lazily
+        if isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+            self._wd_is_l2 = type(self).__name__ not in ("AdamW",)
+        elif weight_decay is None:
+            self._weight_decay = 0.0
+            self._wd_is_l2 = False
+        else:  # L2Decay-like object with a coeff
+            self._weight_decay = float(getattr(weight_decay, "_coeff",
+                                               getattr(weight_decay, "coeff", 0.0)))
+            self._wd_is_l2 = True
+
+    def _add_param_group(self, group):
+        group.setdefault("learning_rate", 1.0)
+        group.setdefault("weight_decay", None)
+        self._param_groups.append(group)
+
+    @property
+    def _parameter_list(self):
+        out = []
+        for g in self._param_groups:
+            if g["params"] is None:
+                from ..nn.layer.layers import _ALL_PARAMETERS
+                out.extend(list(_ALL_PARAMETERS))
+            else:
+                out.extend(g["params"])
+        return out
+
+    # ---- lr ----
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    # ---- accumulators ----
+    def _add_accumulator(self, name, param, fill_value=0.0, dtype=None):
+        store = self._accumulators[name]
+        if id(param) not in store:
+            d = dtype or (jnp.float32 if self._use_master(param)
+                          else param._value.dtype)
+            store[id(param)] = jnp.full(param._value.shape, fill_value, d)
+        return store[id(param)]
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][id(param)]
+
+    def _set_accumulator(self, name, param, value):
+        self._accumulators[name][id(param)] = value
+
+    def _use_master(self, param) -> bool:
+        return self._multi_precision and param._value.dtype in (
+            jnp.float16, jnp.bfloat16)
+
+    def _master_weight(self, param):
+        if id(param) not in self._master_weights:
+            self._master_weights[id(param)] = param._value.astype(jnp.float32)
+        return self._master_weights[id(param)]
+
+    # ---- the step ----
+    def _create_accumulators(self, param):
+        pass
+
+    def _append_optimize_op(self, param, grad, lr, group):
+        raise NotImplementedError
+
+    @no_grad()
+    def step(self):
+        params_grads = []
+        for g in self._param_groups:
+            plist = g["params"]
+            if plist is None:
+                plist = self._parameter_list
+            for p in plist:
+                if p.stop_gradient or p._grad is None:
+                    continue
+                params_grads.append((p, p._grad, g))
+        if self._grad_clip is not None:
+            clipped = self._grad_clip([(p, gr) for p, gr, _ in params_grads])
+            params_grads = [(p, gr, g) for (p, gr), (_, _, g) in
+                            zip(clipped, params_grads)]
+        lr = self.get_lr()
+        for p, grad_t, group in params_grads:
+            self._create_accumulators(p)
+            group_lr = lr * float(group.get("learning_rate", 1.0)) * \
+                float(p.optimize_attr.get("learning_rate", 1.0)
+                      if hasattr(p, "optimize_attr") else 1.0)
+            grad_arr = grad_t._value
+            wd = group.get("weight_decay")
+            wd = self._weight_decay if wd is None else (
+                float(getattr(wd, "_coeff", wd)) if not isinstance(wd, float)
+                else wd)
+            if wd and self._wd_is_l2:
+                grad_arr = grad_arr + wd * p._value.astype(grad_arr.dtype)
+                wd = 0.0
+            self._append_optimize_op(p, grad_arr, group_lr, wd)
+        if isinstance(self._learning_rate, LRScheduler) and \
+                self._learning_rate._step_on_opt_step:
+            pass  # reference steps schedulers explicitly via scheduler.step()
+
+    minimize = None  # assigned below
+
+    def _minimize(self, loss, startup_program=None, parameters=None,
+                  no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # ---- state dict ----
+    def state_dict(self):
+        out = {}
+        params_by_id = {id(p): name_idx for name_idx, p in
+                        enumerate(self._parameter_list)}
+        for acc_name, store in self._accumulators.items():
+            for pid, arr in store.items():
+                if pid in params_by_id:
+                    out[f"{acc_name}_{params_by_id[pid]}"] = Tensor(arr)
+        for pid, arr in self._master_weights.items():
+            if pid in params_by_id:
+                out[f"master_{params_by_id[pid]}"] = Tensor(arr)
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        params = self._parameter_list
+        for key, value in state.items():
+            if key == "LR_Scheduler":
+                if isinstance(self._learning_rate, LRScheduler):
+                    self._learning_rate.set_state_dict(value)
+                continue
+            name, _, idx = key.rpartition("_")
+            try:
+                p = params[int(idx)]
+            except (ValueError, IndexError):
+                continue
+            arr = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+            if name == "master":
+                self._master_weights[id(p)] = arr
+            else:
+                self._accumulators[name][id(p)] = arr
+
+
+Optimizer.minimize = Optimizer._minimize

@@ -1,0 +1,27 @@
+"""paddle_tpu.static — static-graph API shims.
+
+On this framework "static mode" IS jit tracing (SURVEY §7: ProgramDesc/PIR ≙
+jaxpr/StableHLO).  The paddle.static surface maps accordingly: InputSpec is
+shared with paddle_tpu.jit; save/load_inference_model serialize exported
+StableHLO programs.
+"""
+
+from ..jit.api import InputSpec
+from ..jit import save as _jit_save, load as _jit_load
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    program = kwargs.get("program")
+    if program is None:
+        raise ValueError(
+            "save_inference_model requires program=<Layer or callable>; "
+            "in this framework an inference program is a traced callable")
+    specs = [InputSpec(v.shape, v.dtype) for v in feed_vars]
+    _jit_save(program, path_prefix, input_spec=specs)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    return _jit_load(path_prefix)

@@ -1,0 +1,178 @@
+"""paddle_tpu.tensor — the op surface (analogue of python/paddle/tensor/).
+
+Importing this package also monkey-patches arithmetic/method access onto
+``Tensor`` (the analogue of
+``python/paddle/base/dygraph/tensor_patch_methods.py``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor
+
+from .math import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .einsum import *  # noqa: F401,F403
+from .attribute import *  # noqa: F401,F403
+
+from . import math as _math
+from . import creation as _creation
+from . import manipulation as _manip
+from . import logic as _logic
+from . import search as _search
+from . import linalg as _linalg
+from . import stat as _stat
+from . import attribute as _attr
+
+
+def _index_to_static(idx):
+    """Convert Tensors inside an index expression to raw arrays."""
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._value
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(i)
+        return i
+
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+def _getitem(self, idx):
+    sidx = _index_to_static(idx)
+    return dispatch("getitem", lambda a: a[sidx], (self,))
+
+
+def _setitem(self, idx, value):
+    sidx = _index_to_static(idx)
+    if isinstance(value, (int, float, bool, complex)):
+        out = dispatch("setitem", lambda a: a.at[sidx].set(value), (self,))
+    else:
+        out = dispatch("setitem",
+                       lambda a, v: a.at[sidx].set(v.astype(a.dtype)),
+                       (self, value))
+    self._in_place_update(out)
+    return self
+
+
+def _rsub(self, other):
+    return subtract(other, self)
+
+
+def _rdiv(self, other):
+    return divide(other, self)
+
+
+def _rpow(self, other):
+    return pow(other, self)
+
+
+def _rfloordiv(self, other):
+    return floor_divide(other, self)
+
+
+def _rmod(self, other):
+    return mod(other, self)
+
+
+def _matmul_method(self, other):
+    return matmul(self, other)
+
+
+def _rmatmul(self, other):
+    return matmul(other, self)
+
+
+def _inplace(fn):
+    def method(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        self._in_place_update(out)
+        return self
+
+    return method
+
+
+_BINARY = {
+    "__add__": add, "__radd__": add, "__sub__": subtract, "__rsub__": _rsub,
+    "__mul__": multiply, "__rmul__": multiply, "__truediv__": divide,
+    "__rtruediv__": _rdiv, "__div__": divide, "__floordiv__": floor_divide,
+    "__rfloordiv__": _rfloordiv, "__mod__": mod, "__rmod__": _rmod,
+    "__pow__": pow, "__rpow__": _rpow, "__matmul__": _matmul_method,
+    "__rmatmul__": _rmatmul, "__eq__": equal, "__ne__": not_equal,
+    "__lt__": less_than, "__le__": less_equal, "__gt__": greater_than,
+    "__ge__": greater_equal, "__and__": bitwise_and, "__or__": bitwise_or,
+    "__xor__": bitwise_xor, "__lshift__": bitwise_left_shift,
+    "__rshift__": bitwise_right_shift,
+}
+
+_METHOD_SOURCES = (_math, _manip, _logic, _search, _linalg, _stat, _attr,
+                   _creation)
+
+# methods the reference patches onto Tensor (subset that makes sense here)
+_METHOD_NAMES = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "pow",
+    "matmul", "maximum", "minimum", "exp", "log", "log2", "log10", "log1p",
+    "sqrt", "rsqrt", "square", "abs", "sign", "sin", "cos", "tan", "tanh",
+    "floor", "ceil", "round", "trunc", "reciprocal", "clip", "sum", "mean",
+    "max", "min", "prod", "logsumexp", "cumsum", "cumprod", "isfinite",
+    "isnan", "isinf", "erf", "lerp", "trace", "reshape", "transpose",
+    "squeeze", "unsqueeze", "flatten", "tile", "expand", "expand_as",
+    "broadcast_to", "flip", "roll", "gather", "gather_nd", "scatter",
+    "scatter_", "index_select", "masked_select", "masked_fill", "split",
+    "chunk", "unbind", "argmax", "argmin", "argsort", "sort", "topk",
+    "nonzero", "where", "equal", "not_equal", "greater_than", "greater_equal",
+    "less_than", "less_equal", "logical_and", "logical_or", "logical_not",
+    "logical_xor", "all", "any", "allclose", "isclose", "equal_all", "norm",
+    "dist", "dot", "cross", "cholesky", "inv", "det", "bmm", "mv", "t",
+    "std", "var", "median", "quantile", "kthvalue", "mode", "tril", "triu",
+    "diagonal", "numel", "take_along_axis", "put_along_axis", "unique",
+    "repeat_interleave", "concat", "stack", "scale", "add_n", "neg",
+    "flatten_", "reshape_", "squeeze_", "unsqueeze_", "cast_",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "index_sample", "index_add", "index_put", "cumsum", "moveaxis",
+    "is_complex", "is_floating_point",
+]
+
+
+def _patch_tensor_methods():
+    for name, fn in _BINARY.items():
+        setattr(Tensor, name, (lambda f: lambda self, other: f(self, other))(fn))
+    Tensor.__neg__ = lambda self: neg(self)
+    Tensor.__abs__ = lambda self: abs(self)
+    Tensor.__invert__ = lambda self: bitwise_not(self)
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+    for name in _METHOD_NAMES:
+        fn = None
+        for mod_ in _METHOD_SOURCES:
+            fn = getattr(mod_, name, None)
+            if fn is not None:
+                break
+        if fn is None:
+            continue
+        setattr(Tensor, name, (lambda f: lambda self, *a, **k: f(self, *a, **k))(fn))
+    # in-place arithmetic sugar
+    Tensor.add_ = _inplace(add)
+    Tensor.subtract_ = _inplace(subtract)
+    Tensor.multiply_ = _inplace(multiply)
+    Tensor.divide_ = _inplace(divide)
+    Tensor.clip_ = _inplace(clip)
+    Tensor.scale_ = _inplace(scale)
+    Tensor.tanh_ = _inplace(tanh)
+    Tensor.exp_ = _inplace(exp)
+    Tensor.sqrt_ = _inplace(sqrt)
+    Tensor.fill_ = lambda self, v: self.set_value(
+        jnp.full(self._value.shape, v, self._value.dtype))
+
+
+_patch_tensor_methods()

@@ -1,0 +1,50 @@
+"""Shared helpers for tensor op definitions."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor
+
+
+def is_scalar(x):
+    return isinstance(x, (int, float, bool, complex))
+
+
+def binop(name, fn, x, y):
+    """Binary op dispatch keeping python scalars weakly-typed (closed over)."""
+    if is_scalar(y) and not is_scalar(x):
+        return dispatch(name, lambda a: fn(a, y), (x,))
+    if is_scalar(x) and not is_scalar(y):
+        return dispatch(name, lambda b: fn(x, b), (y,))
+    return dispatch(name, fn, (x, y))
+
+
+def unop(name, fn, x):
+    return dispatch(name, fn, (x,))
+
+
+def normalize_axis(axis):
+    if isinstance(axis, Tensor):
+        return tuple(int(v) for v in axis.numpy().reshape(-1))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if axis is None:
+        return None
+    return int(axis)
+
+
+def normalize_shape(shape):
+    """Shapes may be int lists or Tensors (static values only under XLA)."""
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy().reshape(-1))
+    if isinstance(shape, (list, tuple)):
+        return tuple(
+            int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape
+        )
+    return (int(shape),)
+
+
+def asarray(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)

@@ -1,0 +1,32 @@
+"""Tensor attribute ops (analogue of python/paddle/tensor/attribute.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dtypes import is_complex as _dt_is_complex
+from ..core.dtypes import is_floating_point as _dt_is_float
+from ..core.dtypes import is_integer as _dt_is_int
+from ..core.tensor import Tensor
+from ._helpers import asarray
+
+__all__ = ["is_complex", "is_floating_point", "is_integer", "shape",
+           "real", "imag"]
+
+from .math import real, imag
+
+
+def is_complex(x):
+    return _dt_is_complex(asarray(x).dtype)
+
+
+def is_floating_point(x):
+    return _dt_is_float(asarray(x).dtype)
+
+
+def is_integer(x):
+    return _dt_is_int(asarray(x).dtype)
+
+
+def shape(input):
+    return Tensor(jnp.asarray(asarray(input).shape, dtype=jnp.int32))

@@ -1,0 +1,205 @@
+"""Creation ops (analogue of python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import dispatch
+from ..core.dtypes import convert_dtype, default_float_dtype
+from ..core.tensor import Tensor, to_tensor
+from ._helpers import normalize_shape, asarray
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "arange", "linspace", "logspace", "eye", "empty",
+    "empty_like", "diag", "diagflat", "tril_indices", "triu_indices",
+    "assign", "clone", "complex", "polar", "tril", "triu", "meshgrid",
+    "diag_embed", "diagonal",
+]
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    if d is None:
+        d = default or default_float_dtype()
+    return d
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(normalize_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(normalize_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = jnp.bool_
+        elif isinstance(fill_value, int):
+            dtype = jnp.int32
+        else:
+            dtype = default_float_dtype()
+    return Tensor(jnp.full(normalize_shape(shape), fill_value, _dt(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(asarray(x), dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(asarray(x), dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full_like(asarray(x), fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            pass
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    d = convert_dtype(dtype)
+    if d is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            d = default_float_dtype()
+        else:
+            d = jnp.int32
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item() if isinstance(num, Tensor) else num)
+    return Tensor(jnp.linspace(start, stop, num, dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item() if isinstance(num, Tensor) else num)
+    return Tensor(jnp.logspace(start, stop, num, base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def impl(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(a, dtype=jnp.bool_), k=offset)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(a, offset=offset)
+
+    return dispatch("diag", impl, (x,))
+
+
+def diagflat(x, offset=0, name=None):
+    return dispatch("diagflat",
+                    lambda a: jnp.diagflat(a.reshape(-1), k=offset), (x,))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def impl(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        rows = idx + max(-offset, 0)
+        cols = idx + max(offset, 0)
+        out = out.at[..., rows, cols].set(a)
+        ndim = out.ndim
+        d1, d2 = dim1 % ndim, dim2 % ndim
+        perm = [i for i in range(ndim) if i not in (ndim - 2, ndim - 1)]
+        # place the two new axes at dim1/dim2
+        full_perm = [None] * ndim
+        full_perm[d1] = ndim - 2
+        full_perm[d2] = ndim - 1
+        rest = iter(perm)
+        for i in range(ndim):
+            if full_perm[i] is None:
+                full_perm[i] = next(rest)
+        return jnp.transpose(out, np.argsort(full_perm))
+
+    return dispatch("diag_embed", impl, (x,))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch(
+        "diagonal",
+        lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+        (x,))
+
+
+def tril(x, diagonal=0, name=None):
+    return dispatch("tril", lambda a: jnp.tril(a, k=diagonal), (x,))
+
+
+def triu(x, diagonal=0, name=None):
+    return dispatch("triu", lambda a: jnp.triu(a, k=diagonal), (x,))
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(convert_dtype(dtype)))
+
+
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return dispatch("meshgrid",
+                    lambda *arrays: tuple(jnp.meshgrid(*arrays, indexing="ij")),
+                    args)
+
+
+def assign(x, output=None):
+    src = asarray(x)
+    out = dispatch("assign", lambda a: a + jnp.zeros((), a.dtype), (src,))
+    if output is not None:
+        output._in_place_update(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return x.clone() if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def complex(real, imag, name=None):
+    return dispatch("complex", lambda a, b: a + 1j * b, (real, imag))
+
+
+def polar(abs, angle, name=None):
+    return dispatch("polar",
+                    lambda r, t: r * jnp.cos(t) + 1j * r * jnp.sin(t),
+                    (abs, angle))

@@ -1,0 +1,15 @@
+"""Einsum (analogue of python/paddle/tensor/einsum.py) — jnp.einsum lowers
+straight onto the MXU via XLA dot_general fusion."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    return dispatch("einsum",
+                    lambda *arrays: jnp.einsum(equation, *arrays), operands)

@@ -1,0 +1,311 @@
+"""Linear algebra ops (analogue of python/paddle/tensor/linalg.py).
+
+These lower to XLA's native decompositions (cholesky/qr/svd/eigh run on TPU
+via XLA custom calls or host fallback) — no cuSOLVER analogue is needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor
+from ._helpers import normalize_axis
+
+__all__ = [
+    "matmul", "dot", "norm", "dist", "t", "cross", "cholesky",
+    "cholesky_solve", "cholesky_inverse", "inv", "det", "slogdet", "svd",
+    "qr", "eig", "eigh", "eigvals", "eigvalsh", "matrix_power", "matrix_rank",
+    "pinv", "solve", "triangular_solve", "lstsq", "lu", "bmm", "mv",
+    "multi_dot", "cond", "corrcoef", "cov", "householder_product",
+    "vector_norm", "matrix_norm", "pca_lowrank",
+]
+
+from .math import matmul  # shared definition
+
+
+def dot(x, y, name=None):
+    def impl(a, b):
+        if a.ndim == 1:
+            return jnp.dot(a, b)
+        return jnp.sum(a * b, axis=-1)
+
+    return dispatch("dot", impl, (x, y))
+
+
+def t(input, name=None):
+    def impl(a):
+        if a.ndim < 2:
+            return a
+        return a.T
+
+    return dispatch("t", impl, (input,))
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+
+    def impl(a):
+        if p is None or p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=None, axis=ax, keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=ax, keepdims=keepdim)
+        if p == float("inf"):
+            base = jnp.abs(a)
+            return jnp.max(base, axis=ax, keepdims=keepdim) if ax is not None or True else base
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if ax is None:
+            return jnp.sum(jnp.abs(a) ** p) ** (1.0 / p)
+        if isinstance(ax, tuple) and len(ax) == 2:
+            return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return dispatch("norm", impl, (x,))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+
+    def impl(a):
+        if ax is None:
+            flat = a.reshape(-1)
+            out = jnp.linalg.norm(flat, ord=p)
+            if keepdim:
+                out = out.reshape((1,) * a.ndim)
+            return out
+        return jnp.linalg.vector_norm(a, ord=p, axis=ax, keepdims=keepdim)
+
+    return dispatch("vector_norm", impl, (x,))
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return dispatch(
+        "matrix_norm",
+        lambda a: jnp.linalg.matrix_norm(a, ord=p, keepdims=keepdim),
+        (x,))
+
+
+def dist(x, y, p=2, name=None):
+    def impl(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+    return dispatch("dist", impl, (x, y))
+
+
+def cross(x, y, axis=9, name=None):
+    def impl(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return dispatch("cross", impl, (x, y))
+
+
+def cholesky(x, upper=False, name=None):
+    def impl(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+    return dispatch("cholesky", impl, (x,))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def impl(b, chol):
+        L = jnp.swapaxes(chol, -1, -2).conj() if upper else chol
+        z = jax.scipy.linalg.solve_triangular(L, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(L, -1, -2).conj(), z, lower=False)
+
+    return dispatch("cholesky_solve", impl, (x, y))
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    def impl(chol):
+        L = jnp.swapaxes(chol, -1, -2).conj() if upper else chol
+        eye = jnp.eye(L.shape[-1], dtype=L.dtype)
+        z = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+        return jnp.swapaxes(z, -1, -2).conj() @ z
+
+    return dispatch("cholesky_inverse", impl, (x,))
+
+
+def inv(x, name=None):
+    return dispatch("inv", jnp.linalg.inv, (x,))
+
+
+def det(x, name=None):
+    return dispatch("det", jnp.linalg.det, (x,))
+
+
+def slogdet(x, name=None):
+    def impl(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logabs])
+
+    return dispatch("slogdet", impl, (x,))
+
+
+def svd(x, full_matrices=False, name=None):
+    def impl(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+    return dispatch("svd", impl, (x,))
+
+
+def qr(x, mode="reduced", name=None):
+    def impl(a):
+        if mode == "r":
+            return jnp.linalg.qr(a, mode="r")
+        q, r = jnp.linalg.qr(a, mode=mode)
+        return q, r
+
+    return dispatch("qr", impl, (x,))
+
+
+def eig(x, name=None):
+    def impl(a):
+        # XLA has no general nonsymmetric eig on TPU; host callback via numpy
+        import numpy as np
+        if isinstance(a, jax.core.Tracer):
+            raise NotImplementedError("eig requires eager mode (host LAPACK)")
+        w, v = np.linalg.eig(np.asarray(a))
+        return jnp.asarray(w), jnp.asarray(v)
+
+    return dispatch("eig", impl, (x,), n_diff_outputs=0)
+
+
+def eigh(x, UPLO="L", name=None):
+    return dispatch("eigh",
+                    lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), (x,))
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    a = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if isinstance(a, jax.core.Tracer):
+        raise NotImplementedError("eigvals requires eager mode (host LAPACK)")
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(a))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return dispatch("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), (x,))
+
+
+def matrix_power(x, n, name=None):
+    return dispatch("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), (x,))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return dispatch(
+        "matrix_rank",
+        lambda a: jnp.linalg.matrix_rank(a, rtol=tol).astype(jnp.int32),
+        (x,), nondiff_mask=[True])
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return dispatch("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond,
+                                                      hermitian=hermitian), (x,))
+
+
+def solve(x, y, name=None):
+    return dispatch("solve", jnp.linalg.solve, (x, y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def impl(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+
+    return dispatch("triangular_solve", impl, (x, y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def impl(a, b):
+        sol, res, rank_, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank_.astype(jnp.int32), sv
+
+    return dispatch("lstsq", impl, (x, y), n_diff_outputs=1)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def impl(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        info = jnp.zeros((), jnp.int32)
+        if get_infos:
+            return lu_, (piv + 1).astype(jnp.int32), info
+        return lu_, (piv + 1).astype(jnp.int32)
+
+    return dispatch("lu", impl, (x,), n_diff_outputs=1)
+
+
+def bmm(x, y, name=None):
+    return dispatch("bmm", jnp.matmul, (x, y))
+
+
+def mv(x, vec, name=None):
+    return dispatch("mv", jnp.matmul, (x, vec))
+
+
+def multi_dot(x, name=None):
+    return dispatch("multi_dot",
+                    lambda *arrays: jnp.linalg.multi_dot(arrays), tuple(x))
+
+
+def cond(x, p=None, name=None):
+    return dispatch("cond", lambda a: jnp.linalg.cond(a, p=p), (x,))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return dispatch("corrcoef",
+                    lambda a: jnp.corrcoef(a, rowvar=rowvar), (x,))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def impl(a):
+        return jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0)
+
+    return dispatch("cov", impl, (x,))
+
+
+def householder_product(x, tau, name=None):
+    def impl(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i].at[..., i].set(1.0))
+            v = v[..., :, None]
+            h = eye - t_[..., i] * (v @ jnp.swapaxes(v, -1, -2))
+            return q @ h
+
+        q = eye
+        for i in range(n):
+            q = body(i, q)
+        return q[..., :, :n]
+
+    return dispatch("householder_product", impl, (x, tau))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def impl(a):
+        k = q if q is not None else min(6, a.shape[-2], a.shape[-1])
+        b = a - jnp.mean(a, axis=-2, keepdims=True) if center else a
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return u[..., :k], s[..., :k], jnp.swapaxes(vh, -1, -2)[..., :k]
+
+    return dispatch("pca_lowrank", impl, (x,))

@@ -1,0 +1,124 @@
+"""Comparison / logical / bitwise ops (analogue of python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor
+from ._helpers import binop, unop, asarray
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift", "is_empty", "isclose",
+    "allclose", "equal_all", "all", "any", "is_tensor",
+]
+
+
+def equal(x, y, name=None):
+    return binop("equal", jnp.equal, x, y)
+
+
+def not_equal(x, y, name=None):
+    return binop("not_equal", jnp.not_equal, x, y)
+
+
+def greater_than(x, y, name=None):
+    return binop("greater_than", jnp.greater, x, y)
+
+
+def greater_equal(x, y, name=None):
+    return binop("greater_equal", jnp.greater_equal, x, y)
+
+
+def less_than(x, y, name=None):
+    return binop("less_than", jnp.less, x, y)
+
+
+def less_equal(x, y, name=None):
+    return binop("less_equal", jnp.less_equal, x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return binop("logical_and", jnp.logical_and, x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return binop("logical_or", jnp.logical_or, x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return binop("logical_xor", jnp.logical_xor, x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return unop("logical_not", jnp.logical_not, x)
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return binop("bitwise_and", jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return binop("bitwise_or", jnp.bitwise_or, x, y)
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return binop("bitwise_xor", jnp.bitwise_xor, x, y)
+
+
+def bitwise_not(x, out=None, name=None):
+    return unop("bitwise_not", jnp.bitwise_not, x)
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return binop("bitwise_left_shift", jnp.left_shift, x, y)
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return binop("bitwise_right_shift", jnp.right_shift, x, y)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(
+        (x.size if isinstance(x, Tensor) else asarray(x).size) == 0))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return dispatch(
+        "isclose",
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        (x, y))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return dispatch(
+        "allclose",
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        (x, y))
+
+
+def equal_all(x, y, name=None):
+    a, b = asarray(x), asarray(y)
+    if a.shape != b.shape:
+        return Tensor(jnp.asarray(False))
+    return dispatch("equal_all", lambda p, q: jnp.all(jnp.equal(p, q)), (x, y))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    from ._helpers import normalize_axis
+    ax = normalize_axis(axis)
+    return dispatch("all", lambda a: jnp.all(a, axis=ax, keepdims=keepdim), (x,))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    from ._helpers import normalize_axis
+    ax = normalize_axis(axis)
+    return dispatch("any", lambda a: jnp.any(a, axis=ax, keepdims=keepdim), (x,))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

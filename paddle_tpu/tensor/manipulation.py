@@ -1,0 +1,599 @@
+"""Shape/layout manipulation ops (analogue of python/paddle/tensor/manipulation.py).
+
+Note on XLA semantics: ops whose output shape depends on data (masked_select,
+nonzero-driven gathers) are eager-only — under jit they raise with a clear
+message, mirroring how the reference routes them through dynamic-shape
+infershape that XLA cannot express (SURVEY §7 "Hard parts": bucketing policy).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor
+from ._helpers import asarray, normalize_shape, normalize_axis
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "concat", "stack", "split", "chunk",
+    "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "flatten", "tile",
+    "expand", "expand_as", "broadcast_to", "broadcast_tensors", "flip",
+    "roll", "gather", "gather_nd", "scatter", "scatter_", "scatter_nd",
+    "scatter_nd_add", "index_select", "index_sample", "index_add",
+    "index_put", "masked_select", "masked_fill", "slice", "strided_slice",
+    "crop", "pad", "unbind", "unstack", "repeat_interleave",
+    "take_along_axis", "put_along_axis", "moveaxis", "rot90",
+    "as_complex", "as_real", "view", "view_as", "tensor_split", "hsplit",
+    "vsplit", "dsplit", "hstack", "vstack", "dstack", "row_stack",
+    "column_stack", "atleast_1d", "atleast_2d", "atleast_3d", "unflatten",
+    "unique", "unique_consecutive", "bincount", "one_hot", "numel", "rank",
+    "shard_index", "flatten_", "cast", "cast_", "tolist", "chunk",
+]
+
+
+def cast(x, dtype):
+    return x.astype(dtype) if isinstance(x, Tensor) else Tensor(asarray(x)).astype(dtype)
+
+
+def cast_(x, dtype):
+    x._in_place_update(x.astype(dtype))
+    return x
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def reshape(x, shape, name=None):
+    sh = normalize_shape(shape)
+    return dispatch("reshape", lambda a: jnp.reshape(a, sh), (x,))
+
+
+def reshape_(x, shape, name=None):
+    x._in_place_update(reshape(x, shape))
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return dispatch("view_dtype",
+                    lambda a: a.view(shape_or_dtype)
+                    if hasattr(a, "view") else a, (x,))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def transpose(x, perm, name=None):
+    perm = [int(p) for p in perm]
+    return dispatch("transpose", lambda a: jnp.transpose(a, perm), (x,))
+
+
+def moveaxis(x, source, destination, name=None):
+    return dispatch("moveaxis",
+                    lambda a: jnp.moveaxis(a, source, destination), (x,))
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return dispatch("concat", lambda *arrays: jnp.concatenate(arrays, axis=ax),
+                    tuple(tensors))
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return dispatch("stack", lambda *arrays: jnp.stack(arrays, axis=axis),
+                    tuple(tensors))
+
+
+def hstack(x, name=None):
+    return dispatch("hstack", lambda *arrays: jnp.hstack(arrays), tuple(x))
+
+
+def vstack(x, name=None):
+    return dispatch("vstack", lambda *arrays: jnp.vstack(arrays), tuple(x))
+
+
+def dstack(x, name=None):
+    return dispatch("dstack", lambda *arrays: jnp.dstack(arrays), tuple(x))
+
+
+row_stack = vstack
+
+
+def column_stack(x, name=None):
+    return dispatch("column_stack",
+                    lambda *arrays: jnp.column_stack(arrays), tuple(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+
+    def impl(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=ax))
+        sections = [int(s) for s in num_or_sections]
+        total = a.shape[ax]
+        # paddle allows one -1 section
+        neg = [i for i, s in enumerate(sections) if s == -1]
+        if neg:
+            known = sum(s for s in sections if s != -1)
+            sections[neg[0]] = total - known
+        splits = np.cumsum(sections)[:-1].tolist()
+        return tuple(jnp.split(a, splits, axis=ax))
+
+    out = dispatch("split", impl, (x,))
+    return list(out)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return list(dispatch(
+        "tensor_split",
+        lambda a: tuple(jnp.array_split(a, num_or_indices, axis=axis)), (x,)))
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    ax = normalize_axis(axis)
+
+    def impl(a):
+        if ax is None:
+            return jnp.squeeze(a)
+        axes = (ax,) if isinstance(ax, int) else ax
+        axes = tuple(a_ % a.ndim for a_ in axes if a.shape[a_ % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return dispatch("squeeze", impl, (x,))
+
+
+def squeeze_(x, axis=None, name=None):
+    x._in_place_update(squeeze(x, axis))
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    ax = normalize_axis(axis)
+    axes = (ax,) if isinstance(ax, int) else ax
+
+    def impl(a):
+        out = a
+        for a_ in sorted(axes):
+            out = jnp.expand_dims(out, a_)
+        return out
+
+    return dispatch("unsqueeze", impl, (x,))
+
+
+def unsqueeze_(x, axis, name=None):
+    x._in_place_update(unsqueeze(x, axis))
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def impl(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+
+    return dispatch("flatten", impl, (x,))
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    x._in_place_update(flatten(x, start_axis, stop_axis))
+    return x
+
+
+def unflatten(x, axis, shape, name=None):
+    sh = normalize_shape(shape)
+
+    def impl(a):
+        ax = axis % a.ndim
+        return jnp.reshape(a, a.shape[:ax] + tuple(sh) + a.shape[ax + 1:])
+
+    return dispatch("unflatten", impl, (x,))
+
+
+def tile(x, repeat_times, name=None):
+    reps = normalize_shape(repeat_times)
+    return dispatch("tile", lambda a: jnp.tile(a, reps), (x,))
+
+
+def expand(x, shape, name=None):
+    sh = normalize_shape(shape)
+
+    def impl(a):
+        target = list(sh)
+        # -1 means keep original dim
+        offset = len(target) - a.ndim
+        for i in range(len(target)):
+            if target[i] == -1:
+                target[i] = a.shape[i - offset]
+        return jnp.broadcast_to(a, tuple(target))
+
+    return dispatch("expand", impl, (x,))
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    return list(dispatch("broadcast_tensors",
+                         lambda *arrays: tuple(jnp.broadcast_arrays(*arrays)),
+                         tuple(inputs)))
+
+
+def flip(x, axis, name=None):
+    ax = normalize_axis(axis)
+    return dispatch("flip", lambda a: jnp.flip(a, axis=ax), (x,))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return dispatch("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), (x,))
+
+
+def roll(x, shifts, axis=None, name=None):
+    ax = normalize_axis(axis)
+    sh = shifts if isinstance(shifts, int) else tuple(int(s) for s in np.atleast_1d(np.asarray(shifts)))
+
+    def impl(a):
+        if ax is None:
+            return jnp.roll(a.reshape(-1), sh).reshape(a.shape)
+        return jnp.roll(a, sh, axis=ax)
+
+    return dispatch("roll", impl, (x,))
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+
+    def impl(a, idx):
+        return jnp.take(a, idx.reshape(-1).astype(jnp.int32), axis=ax)
+
+    return dispatch("gather", impl, (x, index), nondiff_mask=[False, True])
+
+
+def gather_nd(x, index, name=None):
+    def impl(a, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        flat_idx = tuple(idx[..., i] for i in range(k))
+        return a[flat_idx]
+
+    return dispatch("gather_nd", impl, (x, index), nondiff_mask=[False, True])
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def impl(a, idx, upd):
+        idx = idx.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            return a.at[idx].set(upd)
+        # paddle semantics: zero the rows then accumulate
+        zeroed = a.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+
+    return dispatch("scatter", impl, (x, index, updates),
+                    nondiff_mask=[False, True, False])
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    x._in_place_update(scatter(x, index, updates, overwrite))
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def impl(a, idx, upd):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        return a.at[tuple(idx[..., i] for i in range(k))].add(upd)
+
+    return dispatch("scatter_nd_add", impl, (x, index, updates),
+                    nondiff_mask=[False, True, False])
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    base = zeros(shape, dtype=updates.dtype if isinstance(updates, Tensor) else None)
+    return scatter_nd_add(base, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    def impl(a, idx):
+        return jnp.take(a, idx.reshape(-1).astype(jnp.int32), axis=axis)
+
+    return dispatch("index_select", impl, (x, index), nondiff_mask=[False, True])
+
+
+def index_sample(x, index):
+    def impl(a, idx):
+        rows = jnp.arange(a.shape[0])[:, None]
+        return a[rows, idx.astype(jnp.int32)]
+
+    return dispatch("index_sample", impl, (x, index), nondiff_mask=[False, True])
+
+
+def index_add(x, index, axis, value, name=None):
+    def impl(a, idx, v):
+        idx = idx.reshape(-1).astype(jnp.int32)
+        moved = jnp.moveaxis(a, axis, 0)
+        vmoved = jnp.moveaxis(v, axis, 0)
+        out = moved.at[idx].add(vmoved)
+        return jnp.moveaxis(out, 0, axis)
+
+    return dispatch("index_add", impl, (x, index, value),
+                    nondiff_mask=[False, True, False])
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def impl(a, v, *idx):
+        idx = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer)
+                    else i for i in idx)
+        if accumulate:
+            return a.at[idx].add(v)
+        return a.at[idx].set(v)
+
+    return dispatch("index_put", impl, (x, value, *indices),
+                    nondiff_mask=[False, False] + [True] * len(indices))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def impl(a, idx):
+        return jnp.take_along_axis(a, idx.astype(jnp.int32), axis=axis)
+
+    return dispatch("take_along_axis", impl, (arr, indices),
+                    nondiff_mask=[False, True])
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    def impl(a, idx, v):
+        idx = idx.astype(jnp.int32)
+        v = jnp.broadcast_to(v, idx.shape) if jnp.ndim(v) else jnp.full(idx.shape, v, a.dtype)
+        moved_a = jnp.moveaxis(a, axis, 0)
+        moved_i = jnp.moveaxis(idx, axis, 0)
+        moved_v = jnp.moveaxis(v, axis, 0)
+        grid = jnp.indices(moved_i.shape)
+        full_idx = (moved_i,) + tuple(grid[1:])
+        if reduce == "assign":
+            out = moved_a.at[full_idx].set(moved_v)
+        elif reduce == "add":
+            out = moved_a.at[full_idx].add(moved_v)
+        elif reduce == "multiply" or reduce == "mul":
+            out = moved_a.at[full_idx].multiply(moved_v)
+        elif reduce == "amax":
+            out = moved_a.at[full_idx].max(moved_v)
+        elif reduce == "amin":
+            out = moved_a.at[full_idx].min(moved_v)
+        else:
+            raise ValueError(f"unsupported reduce {reduce!r}")
+        return jnp.moveaxis(out, 0, axis)
+
+    return dispatch("put_along_axis", impl, (arr, indices, values),
+                    nondiff_mask=[False, True, False])
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: eager only (see module docstring)
+    a, m = asarray(x), asarray(mask)
+    if isinstance(a, jax.core.Tracer) or isinstance(m, jax.core.Tracer):
+        raise NotImplementedError(
+            "masked_select has data-dependent output shape and cannot run "
+            "under jit; compute it eagerly or restructure with paddle.where")
+    return Tensor(a[np.asarray(m)])
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value.item() if isinstance(value, Tensor) and value.size == 1 else value
+
+    def impl(a, m):
+        return jnp.where(m, jnp.asarray(v, a.dtype), a)
+
+    return dispatch("masked_fill", impl, (x, mask), nondiff_mask=[False, True])
+
+
+def slice(input, axes, starts, ends, name=None):
+    def impl(a):
+        idx = [np.s_[:]] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            s = int(s.item()) if isinstance(s, Tensor) else int(s)
+            e = int(e.item()) if isinstance(e, Tensor) else int(e)
+            idx[ax] = np.s_[s:e]
+        return a[tuple(idx)]
+
+    return dispatch("slice", impl, (input,))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def impl(a):
+        idx = [np.s_[:]] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = np.s_[int(s):int(e):int(st)]
+        return a[tuple(idx)]
+
+    return dispatch("strided_slice", impl, (x,))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    sh = normalize_shape(shape)
+    offs = [0] * len(sh) if offsets is None else [int(o) for o in offsets]
+
+    def impl(a):
+        idx = tuple(np.s_[o:o + (s if s != -1 else a.shape[i] - o)]
+                    for i, (o, s) in enumerate(zip(offs, sh)))
+        return a[idx]
+
+    return dispatch("crop", impl, (x,))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad = [int(p) for p in (pad.tolist() if isinstance(pad, Tensor) else pad)]
+
+    def impl(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle NCHW/NCDHW convention: pad applies to trailing spatial dims,
+            # given in reverse (last dim first)
+            n_spatial = len(pad) // 2
+            width = [(0, 0)] * (nd - n_spatial)
+            spatial = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+            if data_format in ("NHWC", "NDHWC", "NLC"):
+                width = [(0, 0)] + spatial[::-1] + [(0, 0)]
+            else:
+                width += spatial[::-1]
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, width, mode=jmode, constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+
+    return dispatch("pad", impl, (x,))
+
+
+def unbind(input, axis=0, name=None):
+    n = input.shape[axis] if isinstance(input, Tensor) else asarray(input).shape[axis]
+
+    def impl(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        return tuple(moved[i] for i in range(n))
+
+    return list(dispatch("unbind", impl, (input,)))
+
+
+unstack = unbind
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats.numpy())
+        def impl(a):
+            return jnp.repeat(a if axis is not None else a.reshape(-1),
+                              jnp.asarray(reps), axis=0 if axis is None else axis,
+                              total_repeat_length=int(reps.sum()))
+        return dispatch("repeat_interleave", impl, (x,))
+
+    def impl(a):
+        return jnp.repeat(a if axis is not None else a.reshape(-1), repeats,
+                          axis=0 if axis is None else axis)
+
+    return dispatch("repeat_interleave", impl, (x,))
+
+
+def as_complex(x, name=None):
+    return dispatch("as_complex", lambda a: a[..., 0] + 1j * a[..., 1], (x,))
+
+
+def as_real(x, name=None):
+    return dispatch("as_real",
+                    lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+                    (x,))
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [dispatch("atleast_1d", jnp.atleast_1d, (t,)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [dispatch("atleast_2d", jnp.atleast_2d, (t,)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [dispatch("atleast_3d", jnp.atleast_3d, (t,)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic output shape: eager only
+    a = asarray(x)
+    if isinstance(a, jax.core.Tracer):
+        raise NotImplementedError("unique cannot run under jit (dynamic shape)")
+    res = np.unique(np.asarray(a), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    out = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    a = np.asarray(asarray(x))
+    if axis is None:
+        a = a.reshape(-1)
+        keep = np.ones(len(a), dtype=bool)
+        keep[1:] = a[1:] != a[:-1]
+        vals = a[keep]
+        outs = [Tensor(jnp.asarray(vals))]
+        if return_inverse:
+            outs.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, len(a)))
+            outs.append(Tensor(jnp.asarray(counts)))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis is not supported yet")
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    a = np.asarray(asarray(x))
+    w = np.asarray(asarray(weights)) if weights is not None else None
+    return Tensor(jnp.asarray(np.bincount(a, weights=w, minlength=minlength)))
+
+
+def one_hot(x, num_classes, name=None):
+    def impl(idx):
+        return jax.nn.one_hot(idx.astype(jnp.int32), num_classes, dtype=jnp.float32)
+
+    return dispatch("one_hot", impl, (x,), nondiff_mask=[True])
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size if isinstance(x, Tensor) else asarray(x).size,
+                              dtype=jnp.int32))
+
+
+def rank(input):
+    return Tensor(jnp.asarray(input.ndim if isinstance(input, Tensor)
+                              else asarray(input).ndim, dtype=jnp.int32))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def impl(idx):
+        shard = idx // shard_size
+        local = idx % shard_size
+        return jnp.where(shard == shard_id, local, ignore_value)
+
+    return dispatch("shard_index", impl, (input,), nondiff_mask=[True])

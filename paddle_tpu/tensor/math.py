@@ -1,0 +1,566 @@
+"""Math ops (analogue of python/paddle/tensor/math.py).
+
+Every op: eager path through core.dispatch (tape-recorded, AMP-aware),
+pure-jax impl underneath so the same function is jit/vjp/shard_map safe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor
+from ._helpers import binop, unop, is_scalar, normalize_axis
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "matmul", "maximum", "minimum", "fmax", "fmin", "exp", "expm1",
+    "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "square", "abs", "sign",
+    "sin", "cos", "tan", "asin", "acos", "atan", "atan2", "sinh", "cosh",
+    "asinh", "acosh", "atanh", "tanh", "floor", "ceil", "round", "trunc",
+    "frac", "reciprocal", "clip", "sum", "nansum", "mean", "nanmean", "max",
+    "min", "amax", "amin", "prod", "logsumexp", "cumsum", "cumprod", "cummax",
+    "cummin", "isfinite", "isnan", "isinf", "erf", "erfinv", "lerp", "addmm",
+    "inner", "outer", "scale", "stanh", "neg", "increment", "kron", "diff",
+    "trace", "deg2rad", "rad2deg", "gcd", "lcm", "heaviside", "rsqrt",
+    "multiplex", "logit", "digamma", "lgamma", "nan_to_num", "angle",
+    "conj", "real", "imag", "sgn", "count_nonzero", "add_n", "hypot",
+    "log_normal", "ldexp", "logaddexp", "floor_mod", "inverse",
+]
+
+
+def add(x, y, name=None):
+    return binop("add", jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return binop("subtract", jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return binop("multiply", jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    return binop("divide", jnp.divide, x, y)
+
+
+def floor_divide(x, y, name=None):
+    return binop("floor_divide", jnp.floor_divide, x, y)
+
+
+def mod(x, y, name=None):
+    return binop("mod", jnp.mod, x, y)
+
+
+remainder = mod
+floor_mod = mod
+
+
+def pow(x, y, name=None):
+    return binop("pow", jnp.power, x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def impl(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return dispatch("matmul", impl, (x, y))
+
+
+def maximum(x, y, name=None):
+    return binop("maximum", jnp.maximum, x, y)
+
+
+def minimum(x, y, name=None):
+    return binop("minimum", jnp.minimum, x, y)
+
+
+def fmax(x, y, name=None):
+    return binop("fmax", jnp.fmax, x, y)
+
+
+def fmin(x, y, name=None):
+    return binop("fmin", jnp.fmin, x, y)
+
+
+def hypot(x, y, name=None):
+    return binop("hypot", jnp.hypot, x, y)
+
+
+def logaddexp(x, y, name=None):
+    return binop("logaddexp", jnp.logaddexp, x, y)
+
+
+def ldexp(x, y, name=None):
+    return binop("ldexp", lambda a, b: a * jnp.power(2.0, b).astype(a.dtype), x, y)
+
+
+# ---- unary ----
+def exp(x, name=None):
+    return unop("exp", jnp.exp, x)
+
+
+def expm1(x, name=None):
+    return unop("expm1", jnp.expm1, x)
+
+
+def log(x, name=None):
+    return unop("log", jnp.log, x)
+
+
+def log2(x, name=None):
+    return unop("log2", jnp.log2, x)
+
+
+def log10(x, name=None):
+    return unop("log10", jnp.log10, x)
+
+
+def log1p(x, name=None):
+    return unop("log1p", jnp.log1p, x)
+
+
+def sqrt(x, name=None):
+    return unop("sqrt", jnp.sqrt, x)
+
+
+def rsqrt(x, name=None):
+    return unop("rsqrt", jax.lax.rsqrt, x)
+
+
+def square(x, name=None):
+    return unop("square", jnp.square, x)
+
+
+def abs(x, name=None):
+    return unop("abs", jnp.abs, x)
+
+
+def sign(x, name=None):
+    return unop("sign", jnp.sign, x)
+
+
+def sgn(x, name=None):
+    def impl(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(a)
+
+    return dispatch("sgn", impl, (x,))
+
+
+def sin(x, name=None):
+    return unop("sin", jnp.sin, x)
+
+
+def cos(x, name=None):
+    return unop("cos", jnp.cos, x)
+
+
+def tan(x, name=None):
+    return unop("tan", jnp.tan, x)
+
+
+def asin(x, name=None):
+    return unop("asin", jnp.arcsin, x)
+
+
+def acos(x, name=None):
+    return unop("acos", jnp.arccos, x)
+
+
+def atan(x, name=None):
+    return unop("atan", jnp.arctan, x)
+
+
+def atan2(x, y, name=None):
+    return binop("atan2", jnp.arctan2, x, y)
+
+
+def sinh(x, name=None):
+    return unop("sinh", jnp.sinh, x)
+
+
+def cosh(x, name=None):
+    return unop("cosh", jnp.cosh, x)
+
+
+def asinh(x, name=None):
+    return unop("asinh", jnp.arcsinh, x)
+
+
+def acosh(x, name=None):
+    return unop("acosh", jnp.arccosh, x)
+
+
+def atanh(x, name=None):
+    return unop("atanh", jnp.arctanh, x)
+
+
+def tanh(x, name=None):
+    return unop("tanh", jnp.tanh, x)
+
+
+def floor(x, name=None):
+    return unop("floor", jnp.floor, x)
+
+
+def ceil(x, name=None):
+    return unop("ceil", jnp.ceil, x)
+
+
+def round(x, name=None):
+    return unop("round", jnp.round, x)
+
+
+def trunc(x, name=None):
+    return unop("trunc", jnp.trunc, x)
+
+
+def frac(x, name=None):
+    return unop("frac", lambda a: a - jnp.trunc(a), x)
+
+
+def reciprocal(x, name=None):
+    return unop("reciprocal", jnp.reciprocal, x)
+
+
+def neg(x, name=None):
+    return unop("neg", jnp.negative, x)
+
+
+def erf(x, name=None):
+    return unop("erf", jax.scipy.special.erf, x)
+
+
+def erfinv(x, name=None):
+    return unop("erfinv", jax.scipy.special.erfinv, x)
+
+
+def digamma(x, name=None):
+    return unop("digamma", jax.scipy.special.digamma, x)
+
+
+def lgamma(x, name=None):
+    return unop("lgamma", jax.scipy.special.gammaln, x)
+
+
+def logit(x, eps=None, name=None):
+    def impl(a):
+        z = a if eps is None else jnp.clip(a, eps, 1.0 - eps)
+        out = jnp.log(z / (1.0 - z))
+        if eps is None:
+            out = jnp.where((a < 0) | (a > 1), jnp.nan, out)
+        return out
+
+    return dispatch("logit", impl, (x,))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return dispatch("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), (x,))
+
+
+def deg2rad(x, name=None):
+    return unop("deg2rad", jnp.deg2rad, x)
+
+
+def rad2deg(x, name=None):
+    return unop("rad2deg", jnp.rad2deg, x)
+
+
+def angle(x, name=None):
+    return unop("angle", jnp.angle, x)
+
+
+def conj(x, name=None):
+    return unop("conj", jnp.conj, x)
+
+
+def real(x, name=None):
+    return unop("real", jnp.real, x)
+
+
+def imag(x, name=None):
+    return unop("imag", jnp.imag, x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return dispatch(
+        "nan_to_num",
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        (x,))
+
+
+def gcd(x, y, name=None):
+    return binop("gcd", jnp.gcd, x, y)
+
+
+def lcm(x, y, name=None):
+    return binop("lcm", jnp.lcm, x, y)
+
+
+def heaviside(x, y, name=None):
+    return binop("heaviside", jnp.heaviside, x, y)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return dispatch("clip", lambda a: jnp.clip(a, lo, hi), (x,))
+
+
+def lerp(x, y, weight, name=None):
+    if is_scalar(weight):
+        return dispatch("lerp", lambda a, b: a + weight * (b - a), (x, y))
+    return dispatch("lerp", lambda a, b, w: a + w * (b - a), (x, y, weight))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = float(scale) if is_scalar(scale) else scale
+
+    def impl(a, *rest):
+        sv = rest[0] if rest else s
+        out = a * sv + bias if bias_after_scale else (a + bias) * sv
+        return out.astype(a.dtype)
+
+    if is_scalar(scale):
+        return dispatch("scale", impl, (x,))
+    return dispatch("scale", impl, (x, scale))
+
+
+def increment(x, value=1.0, name=None):
+    out = dispatch("increment", lambda a: a + value, (x,))
+    if isinstance(x, Tensor):
+        x._in_place_update(out)
+        return x
+    return out
+
+
+# ---- reductions ----
+def _reduce(name, fn, x, axis, keepdim, dtype=None):
+    ax = normalize_axis(axis)
+
+    def impl(a):
+        out = fn(a, axis=ax, keepdims=keepdim)
+        if dtype is not None:
+            out = out.astype(dtype)
+        return out
+
+    return dispatch(name, impl, (x,))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..core.dtypes import convert_dtype
+    d = convert_dtype(dtype)
+    ax = normalize_axis(axis)
+
+    def impl(a):
+        acc = a
+        if jnp.issubdtype(a.dtype, jnp.bool_):
+            acc = a.astype(jnp.int32)
+        out = jnp.sum(acc, axis=ax, keepdims=keepdim)
+        return out.astype(d) if d is not None else out
+
+    return dispatch("sum", impl, (x,))
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _reduce("nansum", jnp.nansum, x, axis, keepdim, dtype)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _reduce("mean", jnp.mean, x, axis, keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return _reduce("nanmean", jnp.nanmean, x, axis, keepdim)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _reduce("max", jnp.max, x, axis, keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _reduce("min", jnp.min, x, axis, keepdim)
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return _reduce("prod", jnp.prod, x, axis, keepdim, dtype)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return dispatch(
+        "logsumexp",
+        lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+        (x,))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return dispatch(
+        "count_nonzero",
+        lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim).astype(jnp.int32),
+        (x,))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    from ..core.dtypes import convert_dtype
+    d = convert_dtype(dtype)
+
+    def impl(a):
+        arr = a.reshape(-1) if axis is None else a
+        out = jnp.cumsum(arr, axis=0 if axis is None else axis)
+        return out.astype(d) if d is not None else out
+
+    return dispatch("cumsum", impl, (x,))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    from ..core.dtypes import convert_dtype
+    d = convert_dtype(dtype)
+
+    def impl(a):
+        out = jnp.cumprod(a, axis=dim)
+        return out.astype(d) if d is not None else out
+
+    return dispatch("cumprod", impl, (x,))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def impl(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        vals = jax.lax.associative_scan(jnp.maximum, arr, axis=ax)
+        n = arr.shape[ax]
+        idx = jnp.arange(n).reshape([-1 if i == (ax % arr.ndim) else 1
+                                     for i in range(arr.ndim)])
+        idx = jnp.broadcast_to(idx, arr.shape)
+        is_new = arr == vals
+        inds = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(is_new, idx, -1), axis=ax)
+        return vals, inds.astype(jnp.int32)
+
+    return dispatch("cummax", impl, (x,), n_diff_outputs=1)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def impl(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        vals = jax.lax.associative_scan(jnp.minimum, arr, axis=ax)
+        idx = jnp.arange(arr.shape[ax]).reshape(
+            [-1 if i == (ax % arr.ndim) else 1 for i in range(arr.ndim)])
+        idx = jnp.broadcast_to(idx, arr.shape)
+        is_new = arr == vals
+        inds = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(is_new, idx, -1), axis=ax)
+        return vals, inds.astype(jnp.int32)
+
+    return dispatch("cummin", impl, (x,), n_diff_outputs=1)
+
+
+def isfinite(x, name=None):
+    return unop("isfinite", jnp.isfinite, x)
+
+
+def isnan(x, name=None):
+    return unop("isnan", jnp.isnan, x)
+
+
+def isinf(x, name=None):
+    return unop("isinf", jnp.isinf, x)
+
+
+# ---- linear-algebra flavoured math ----
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return dispatch("addmm",
+                    lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                    (input, x, y))
+
+
+def inner(x, y, name=None):
+    return dispatch("inner", jnp.inner, (x, y))
+
+
+def outer(x, y, name=None):
+    return dispatch("outer",
+                    lambda a, b: jnp.outer(a.reshape(-1), b.reshape(-1)),
+                    (x, y))
+
+
+def kron(x, y, name=None):
+    return dispatch("kron", jnp.kron, (x, y))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch("trace",
+                    lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+                    (x,))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    tensors = [x]
+    has_prepend = prepend is not None
+    has_append = append is not None
+    if has_prepend:
+        tensors.append(prepend)
+    if has_append:
+        tensors.append(append)
+
+    def impl(a, *rest):
+        i = 0
+        pre = post = None
+        if has_prepend:
+            pre = rest[i]; i += 1
+        if has_append:
+            post = rest[i]
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=post)
+
+    return dispatch("diff", impl, tuple(tensors))
+
+
+def multiplex(inputs, index, name=None):
+    def impl(idx, *arrays):
+        stacked = jnp.stack(arrays, axis=0)
+        sel = idx.reshape(-1).astype(jnp.int32)
+        return stacked[sel, jnp.arange(stacked.shape[1])]
+
+    return dispatch("multiplex", impl, (index, *inputs),
+                    nondiff_mask=[True] + [False] * len(inputs))
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+
+    def impl(*arrays):
+        out = arrays[0]
+        for a in arrays[1:]:
+            out = out + a
+        return out
+
+    return dispatch("add_n", impl, tuple(inputs))
+
+
+def inverse(x, name=None):
+    return unop("inverse", jnp.linalg.inv, x)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    from .random import _draw
+    import jax.random as jrandom
+    sh = tuple(shape) if shape is not None else ()
+    return _draw("log_normal",
+                 lambda key: jnp.exp(mean + std * jrandom.normal(key, sh)))

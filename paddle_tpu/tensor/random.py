@@ -1,0 +1,152 @@
+"""Random sampling ops (analogue of python/paddle/tensor/random.py).
+
+All draws advance the global stateful Generator (SURVEY §2.1 RNG row); each
+individual draw uses a pure counter-derived key, so a drawn op is still a pure
+jax computation (safe under vjp; under jit the key is a baked constant, which
+matches the reference's seed+offset capture semantics at trace time).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.random as jrandom
+
+from ..core.dispatch import dispatch
+from ..core.dtypes import convert_dtype, default_float_dtype
+from ..core.generator import default_generator
+from ..core.tensor import Tensor
+from ._helpers import normalize_shape
+
+__all__ = [
+    "uniform", "uniform_", "normal", "normal_", "standard_normal", "randn",
+    "rand", "randint", "randint_like", "randperm", "bernoulli", "multinomial",
+    "poisson", "exponential_", "binomial", "standard_gamma",
+]
+
+
+def _draw(name, sample_fn):
+    key = default_generator().next_key()
+    return Tensor(sample_fn(key))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    sh = normalize_shape(shape)
+    d = convert_dtype(dtype) or default_float_dtype()
+    return _draw("uniform",
+                 lambda key: jrandom.uniform(key, sh, d, minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    out = uniform(x.shape, x.dtype, min, max)
+    x.set_value(out)
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        sh = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return _draw("normal",
+                     lambda key: m + s * jrandom.normal(key, sh,
+                                                        default_float_dtype()))
+    sh = normalize_shape(shape) if shape is not None else ()
+    return _draw("normal",
+                 lambda key: mean + std * jrandom.normal(key, sh,
+                                                         default_float_dtype()))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    out = normal(mean, std, x.shape)
+    x.set_value(out)
+    return x
+
+
+def standard_normal(shape, dtype=None, name=None):
+    sh = normalize_shape(shape)
+    d = convert_dtype(dtype) or default_float_dtype()
+    return _draw("standard_normal", lambda key: jrandom.normal(key, sh, d))
+
+
+randn = standard_normal
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    sh = normalize_shape(shape)
+    d = convert_dtype(dtype)
+    return _draw("randint", lambda key: jrandom.randint(key, sh, low, high, d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or "int64")
+
+
+def randperm(n, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+    return _draw("randperm",
+                 lambda key: jrandom.permutation(key, n).astype(d))
+
+
+def bernoulli(x, name=None):
+    key = default_generator().next_key()
+
+    def impl(p):
+        return jrandom.bernoulli(key, p).astype(p.dtype)
+
+    return dispatch("bernoulli", impl, (x,), nondiff_mask=[True])
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = default_generator().next_key()
+
+    def impl(p):
+        probs = p / jnp.sum(p, axis=-1, keepdims=True)
+        if replacement:
+            return jrandom.categorical(
+                key, jnp.log(jnp.maximum(probs, 1e-30)),
+                shape=(num_samples,) + p.shape[:-1]).T.astype(jnp.int32) \
+                if p.ndim > 1 else jrandom.categorical(
+                    key, jnp.log(jnp.maximum(probs, 1e-30)),
+                    shape=(num_samples,)).astype(jnp.int32)
+        # without replacement: gumbel top-k
+        g = jrandom.gumbel(key, p.shape)
+        scores = jnp.log(jnp.maximum(probs, 1e-30)) + g
+        _, idx = jax.lax.top_k(scores, num_samples)
+        return idx.astype(jnp.int32)
+
+    return dispatch("multinomial", impl, (x,), nondiff_mask=[True])
+
+
+def poisson(x, name=None):
+    key = default_generator().next_key()
+    return dispatch("poisson",
+                    lambda lam: jrandom.poisson(key, lam).astype(lam.dtype),
+                    (x,), nondiff_mask=[True])
+
+
+def binomial(count, prob, name=None):
+    key = default_generator().next_key()
+    return dispatch(
+        "binomial",
+        lambda n, p: jrandom.binomial(key, n.astype(jnp.float32), p).astype(jnp.int32),
+        (count, prob), nondiff_mask=[True, True])
+
+
+def standard_gamma(x, name=None):
+    key = default_generator().next_key()
+    return dispatch("standard_gamma",
+                    lambda a: jrandom.gamma(key, a), (x,), nondiff_mask=[True])
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = default_generator().next_key()
+    out = jrandom.exponential(key, tuple(x.shape), x.dtype) / lam
+    x.set_value(out)
+    return x

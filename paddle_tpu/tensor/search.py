@@ -1,0 +1,172 @@
+"""Search/sort ops (analogue of python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor
+from ._helpers import asarray
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+    "searchsorted", "masked_select", "kthvalue", "mode", "index_sample",
+    "bucketize",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtypes import convert_dtype
+    d = convert_dtype(dtype)
+
+    def impl(a):
+        arr = a.reshape(-1) if axis is None else a
+        out = jnp.argmax(arr, axis=0 if axis is None else axis, keepdims=keepdim)
+        return out.astype(d)
+
+    return dispatch("argmax", impl, (x,), nondiff_mask=[True])
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtypes import convert_dtype
+    d = convert_dtype(dtype)
+
+    def impl(a):
+        arr = a.reshape(-1) if axis is None else a
+        out = jnp.argmin(arr, axis=0 if axis is None else axis, keepdims=keepdim)
+        return out.astype(d)
+
+    return dispatch("argmin", impl, (x,), nondiff_mask=[True])
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def impl(a):
+        out = jnp.argsort(a, axis=axis, stable=stable or not descending)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out.astype(jnp.int32)
+
+    return dispatch("argsort", impl, (x,), nondiff_mask=[True])
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def impl(a):
+        out = jnp.sort(a, axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out
+
+    return dispatch("sort", impl, (x,))
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def impl(a):
+        ax = a.ndim - 1 if axis is None else axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(moved, kk)
+        else:
+            vals, idx = jax.lax.top_k(-moved, kk)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(jnp.int32))
+
+    return dispatch("topk", impl, (x,), n_diff_outputs=1)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+
+    def impl(c, a, b):
+        return jnp.where(c, a, b)
+
+    return dispatch("where", impl, (condition, x, y),
+                    nondiff_mask=[True, False, False])
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic output shape: eager only
+    a = asarray(x)
+    if isinstance(a, jax.core.Tracer):
+        raise NotImplementedError(
+            "nonzero has data-dependent output shape and cannot run under jit")
+    idx = np.nonzero(np.asarray(a))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i).reshape(-1, 1)) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1).astype(np.int64)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def impl(seq, v):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1]))
+            out = out.reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int32)
+
+    return dispatch("searchsorted", impl, (sorted_sequence, values),
+                    nondiff_mask=[True, True])
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def impl(a):
+        ax = axis % a.ndim
+        svals = jnp.sort(a, axis=ax)
+        sidx = jnp.argsort(a, axis=ax)
+        vals = jnp.take(svals, k - 1, axis=ax)
+        idx = jnp.take(sidx, k - 1, axis=ax)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return vals, idx.astype(jnp.int32)
+
+    return dispatch("kthvalue", impl, (x,), n_diff_outputs=1)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def impl(a):
+        ax = axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        n = moved.shape[-1]
+        flat = moved.reshape(-1, n)
+
+        def one(row):
+            svals = jnp.sort(row)
+            # count occurrences of each sorted value; mode = value w/ max count
+            eq = svals[:, None] == svals[None, :]
+            counts = eq.sum(-1)
+            best = jnp.argmax(counts)  # max count; ties -> smallest value wins
+            val = svals[best]
+            idx = jnp.max(jnp.where(row == val, jnp.arange(n), -1))
+            return val, idx
+
+        vals, idxs = jax.vmap(one)(flat)
+        vals = vals.reshape(moved.shape[:-1])
+        idxs = idxs.reshape(moved.shape[:-1])
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idxs = jnp.expand_dims(idxs, ax)
+        return vals, idxs.astype(jnp.int32)
+
+    return dispatch("mode", impl, (x,), n_diff_outputs=1)
+
+
+def index_sample(x, index):
+    from .manipulation import index_sample as _is
+    return _is(x, index)

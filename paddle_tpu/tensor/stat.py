@@ -1,0 +1,100 @@
+"""Statistics ops (analogue of python/paddle/tensor/stat.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ._helpers import normalize_axis
+
+__all__ = ["mean", "std", "var", "median", "nanmedian", "quantile",
+           "nanquantile", "histogram", "histogramdd", "numel"]
+
+from .math import mean
+from .manipulation import numel
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return dispatch(
+        "std",
+        lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+        (x,))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return dispatch(
+        "var",
+        lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+        (x,))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = normalize_axis(axis)
+
+    def impl(a):
+        if mode == "avg":
+            return jnp.median(a, axis=ax, keepdims=keepdim)
+        # 'min' mode: lower of the two middle values
+        arr = a.reshape(-1) if ax is None else a
+        axis_ = 0 if ax is None else ax
+        n = arr.shape[axis_]
+        s = jnp.sort(arr, axis=axis_)
+        out = jnp.take(s, (n - 1) // 2, axis=axis_)
+        if keepdim and ax is not None:
+            out = jnp.expand_dims(out, axis_)
+        return out
+
+    return dispatch("median", impl, (x,))
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = normalize_axis(axis)
+    return dispatch("nanmedian",
+                    lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), (x,))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = normalize_axis(axis)
+
+    def impl(a):
+        qq = jnp.asarray(q)
+        return jnp.quantile(a, qq, axis=ax, keepdims=keepdim,
+                            method=interpolation)
+
+    return dispatch("quantile", impl, (x,))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = normalize_axis(axis)
+    return dispatch(
+        "nanquantile",
+        lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=ax, keepdims=keepdim,
+                                  method=interpolation),
+        (x,))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    def impl(a, *rest):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        w = rest[0].reshape(-1) if rest else None
+        hist, _ = jnp.histogram(a.reshape(-1), bins=bins, range=(lo, hi),
+                                weights=w, density=density)
+        return hist if density or w is not None else hist.astype(jnp.int32)
+
+    args = (input, weight) if weight is not None else (input,)
+    return dispatch("histogram", impl, args,
+                    nondiff_mask=[True] * len(args))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    def impl(a, *rest):
+        w = rest[0] if rest else None
+        hist, edges = jnp.histogramdd(a, bins=bins, range=ranges,
+                                      weights=w, density=density)
+        return (hist,) + tuple(edges)
+
+    args = (x, weights) if weights is not None else (x,)
+    out = dispatch("histogramdd", impl, args, nondiff_mask=[True] * len(args))
+    return out[0], list(out[1:])

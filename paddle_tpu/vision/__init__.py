@@ -1,0 +1,5 @@
+"""paddle_tpu.vision (analogue of paddle.vision)."""
+
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401

@@ -1,0 +1,59 @@
+"""Vision datasets.  Zero-egress environment: synthetic datasets with the
+reference datasets' shapes/APIs (Cifar10/MNIST signatures), generated
+deterministically — the data pipeline and training loops exercise the same
+code paths as the real downloads."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST"]
+
+
+class _SyntheticImages(Dataset):
+    num_classes = 10
+    image_shape = (3, 32, 32)
+
+    def __init__(self, mode="train", transform=None, size=None, seed=0):
+        self.mode = mode
+        self.transform = transform
+        self.size = size or (1024 if mode == "train" else 256)
+        rng = np.random.default_rng(seed + (0 if mode == "train" else 1))
+        c, h, w = self.image_shape
+        # HWC uint8 like the real decoded datasets
+        self.images = rng.integers(0, 256, (self.size, h, w, c),
+                                   dtype=np.uint8)
+        self.labels = rng.integers(0, self.num_classes, (self.size,),
+                                   dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype(np.float32) / 255.0).transpose(2, 0, 1)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return self.size
+
+
+class Cifar10(_SyntheticImages):
+    num_classes = 10
+    image_shape = (3, 32, 32)
+
+
+class Cifar100(_SyntheticImages):
+    num_classes = 100
+    image_shape = (3, 32, 32)
+
+
+class MNIST(_SyntheticImages):
+    num_classes = 10
+    image_shape = (1, 28, 28)
+
+
+class FashionMNIST(MNIST):
+    pass

@@ -1,0 +1,8 @@
+"""Vision models (analogue of python/paddle/vision/models/)."""
+
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                     resnet152, wide_resnet50_2, wide_resnet101_2)
+from .lenet import LeNet
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+           "resnet152", "wide_resnet50_2", "wide_resnet101_2", "LeNet"]

@@ -1,0 +1,98 @@
+"""Minimal transforms (analogue of python/paddle/vision/transforms/)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Compose", "Normalize", "ToTensor", "Resize", "Transpose",
+           "RandomHorizontalFlip", "RandomCrop"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, dtype=np.float32)
+        if self.data_format == "CHW":
+            mean = self.mean.reshape(-1, 1, 1)
+            std = self.std.reshape(-1, 1, 1)
+        else:
+            mean = self.mean
+            std = self.std
+        return (img - mean) / std
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img, dtype=np.float32) / 255.0
+        if arr.ndim == 2:
+            arr = arr[None] if self.data_format == "CHW" else arr[..., None]
+        elif self.data_format == "CHW" and arr.shape[-1] in (1, 3, 4):
+            arr = arr.transpose(2, 0, 1)
+        return arr
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = self.size
+        # nearest resize (dependency-free)
+        ih, iw = arr.shape[0], arr.shape[1]
+        rows = (np.arange(h) * ih // h)
+        cols = (np.arange(w) * iw // w)
+        return arr[rows][:, cols]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return img
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = size if isinstance(size, (tuple, list)) else (size, size)
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if self.padding:
+            pad = [(self.padding, self.padding), (self.padding, self.padding)]
+            if arr.ndim == 3:
+                pad.append((0, 0))
+            arr = np.pad(arr, pad)
+        h, w = self.size
+        top = np.random.randint(0, arr.shape[0] - h + 1)
+        left = np.random.randint(0, arr.shape[1] - w + 1)
+        return arr[top:top + h, left:left + w]

@@ -1,0 +1,40 @@
+"""Test config: force an 8-device virtual CPU mesh (SURVEY §4 implication:
+CPU-XLA fake-device parity, the analogue of fake_cpu_device.h) so distributed
+sharding tests run without TPUs.
+
+The environment may carry a TPU PJRT plugin (axon) whose client init dials a
+remote device service; tests must be hermetic and CPU-only, so we drop that
+plugin from jax's backend factory registry BEFORE any backend initializes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+
+    if not _xb.backends_are_initialized():
+        for name in list(getattr(_xb, "_backend_factories", {})):
+            if name not in ("cpu",):
+                _xb._backend_factories.pop(name, None)
+except Exception:
+    pass
+
+assert jax.devices()[0].platform == "cpu", "tests must run on CPU XLA"
+assert jax.device_count() == 8, "expected 8 virtual CPU devices"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reseed():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    yield

@@ -1,0 +1,84 @@
+"""OpTest harness — numpy-referenced op checking.
+
+Analogue of the reference's OpTest (test/legacy_test/eager_op_test.py:380):
+each case supplies inputs and a numpy reference; ``check_output`` runs the op
+in eager AND jit (to_static) modes and compares; ``check_grad`` compares the
+tape gradient against numeric differentiation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _to_np(x):
+    from paddle_tpu.core.tensor import Tensor
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy(), dtype=np.float64) \
+            if np.issubdtype(np.asarray(x.numpy()).dtype, np.floating) \
+            else np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+def check_output(op_fn, np_fn, inputs, atol=1e-5, rtol=1e-5, check_jit=True,
+                 input_grads=None):
+    """Run op eagerly and under to_static; compare both against np_fn."""
+    tensors = [paddle.to_tensor(a) for a in inputs]
+    expected = np_fn(*inputs)
+    expected = expected if isinstance(expected, tuple) else (expected,)
+
+    # eager
+    out = op_fn(*tensors)
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    for o, e in zip(outs, expected):
+        np.testing.assert_allclose(_to_np(o), e, atol=atol, rtol=rtol,
+                                   err_msg="eager mismatch")
+    # jit
+    if check_jit:
+        static_fn = paddle.jit.to_static(lambda *ts: op_fn(*ts))
+        out_j = static_fn(*tensors)
+        outs_j = out_j if isinstance(out_j, (tuple, list)) else (out_j,)
+        for o, e in zip(outs_j, expected):
+            np.testing.assert_allclose(_to_np(o), e, atol=atol, rtol=rtol,
+                                       err_msg="jit mismatch")
+    return outs
+
+
+def check_grad(op_fn, inputs, grad_input_idx=0, eps=1e-3, atol=1e-2,
+               rtol=1e-2, reduce_to_scalar=True):
+    """Tape gradient vs numeric central difference."""
+    tensors = []
+    for i, a in enumerate(inputs):
+        t = paddle.to_tensor(np.asarray(a, dtype=np.float32))
+        t.stop_gradient = i != grad_input_idx
+        tensors.append(t)
+
+    def scalar_loss(*ts):
+        out = op_fn(*ts)
+        out = out[0] if isinstance(out, (tuple, list)) else out
+        return out.sum() if reduce_to_scalar else out
+
+    loss = scalar_loss(*tensors)
+    loss.backward()
+    analytic = np.asarray(tensors[grad_input_idx].grad.numpy(),
+                          dtype=np.float64)
+
+    x0 = np.asarray(inputs[grad_input_idx], dtype=np.float64)
+    numeric = np.zeros_like(x0).reshape(-1)
+    flat = x0.reshape(-1)
+    for j in range(flat.size):
+        xp = flat.copy(); xp[j] += eps
+        xm = flat.copy(); xm[j] -= eps
+        args_p = list(inputs); args_p[grad_input_idx] = xp.reshape(x0.shape)
+        args_m = list(inputs); args_m[grad_input_idx] = xm.reshape(x0.shape)
+        with paddle.no_grad():
+            lp = scalar_loss(*[paddle.to_tensor(
+                np.asarray(a, dtype=np.float32)) for a in args_p])
+            lm = scalar_loss(*[paddle.to_tensor(
+                np.asarray(a, dtype=np.float32)) for a in args_m])
+        numeric[j] = (float(lp) - float(lm)) / (2 * eps)
+    numeric = numeric.reshape(x0.shape)
+    np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol,
+                               err_msg="analytic vs numeric grad mismatch")

@@ -1,0 +1,248 @@
+"""AMP, DataLoader, save/load, to_static."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def a(*shape):
+    return np.random.default_rng(5).standard_normal(shape).astype(np.float32)
+
+
+# ---------------- AMP ----------------
+
+def test_auto_cast_white_black():
+    x = paddle.to_tensor(a(4, 4))
+    with paddle.amp.auto_cast(dtype="bfloat16"):
+        y = paddle.matmul(x, x)       # white -> bf16
+        z = paddle.exp(x)             # black -> stays f32
+    assert str(y.dtype) == "bfloat16"
+    assert str(z.dtype) == "float32"
+    y2 = paddle.matmul(x, x)
+    assert str(y2.dtype) == "float32"
+
+
+def test_auto_cast_grad_dtype():
+    w = nn.Parameter(a(4, 4))
+    x = paddle.to_tensor(a(2, 4))
+    with paddle.amp.auto_cast(dtype="bfloat16"):
+        loss = paddle.matmul(x, w).sum()
+    loss.backward()
+    # grads flow back through the cast into the param dtype
+    assert str(w.grad.dtype) == "float32"
+
+
+def test_grad_scaler_dynamic():
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                   incr_every_n_steps=2)
+    p = nn.Parameter(np.ones(2, np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    loss = (paddle.to_tensor([1.0], stop_gradient=False) * 0).sum()
+    # normal step: grads unscaled correctly
+    x = paddle.to_tensor([1.0])
+    loss = (p * x).sum()
+    scaled = scaler.scale(loss)
+    assert float(scaled) == pytest.approx(2.0 * 4.0)
+    scaled.backward()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(p.numpy(), [0.9, 0.9], rtol=1e-5)
+    # inf grads: step skipped, scale halves
+    p.clear_grad()
+    p._grad = paddle.to_tensor(np.array([np.inf, 1.0], np.float32))
+    before = p.numpy().copy()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(p.numpy(), before)
+    assert scaler._scale == 2.0
+
+
+def test_amp_decorate_o2():
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(parameters=model.parameters())
+    model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    assert str(model.weight.dtype) == "bfloat16"
+    assert opt._multi_precision
+
+
+# ---------------- io ----------------
+
+def test_dataloader_basic_and_workers():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return np.full((3,), i, np.float32), np.int64(i % 2)
+
+    for workers in (0, 2):
+        loader = DataLoader(DS(), batch_size=4, num_workers=workers)
+        batches = list(loader)
+        assert len(batches) == 3
+        xb, yb = batches[0]
+        assert xb.shape == [4, 3]
+        assert str(yb.dtype) == "int32"  # int64 aliases to int32 on TPU
+        # order preserved
+        np.testing.assert_allclose(xb.numpy()[:, 0], [0, 1, 2, 3])
+
+
+def test_batch_samplers():
+    from paddle_tpu.io import BatchSampler, DistributedBatchSampler
+
+    class DS:
+        def __len__(self):
+            return 10
+
+    bs = BatchSampler(DS(), batch_size=3, drop_last=True)
+    assert len(bs) == 3
+    assert all(len(b) == 3 for b in bs)
+    dbs = DistributedBatchSampler(DS(), batch_size=2, num_replicas=2, rank=0)
+    idx = [i for b in dbs for i in b]
+    dbs1 = DistributedBatchSampler(DS(), batch_size=2, num_replicas=2, rank=1)
+    idx1 = [i for b in dbs1 for i in b]
+    assert set(idx) | set(idx1) == set(range(10))
+    assert not (set(idx) & set(idx1))
+
+
+def test_save_load_roundtrip(tmp_path):
+    net = nn.Linear(3, 2)
+    opt = paddle.optimizer.Adam(parameters=net.parameters())
+    x = paddle.to_tensor(a(2, 3))
+    net(x).sum().backward()
+    opt.step()
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(net.state_dict(), path)
+    paddle.save(opt.state_dict(), str(tmp_path / "opt.pdopt"))
+    net2 = nn.Linear(3, 2)
+    net2.set_state_dict(paddle.load(path))
+    np.testing.assert_allclose(net2.weight.numpy(), net.weight.numpy())
+    opt2 = paddle.optimizer.Adam(parameters=net2.parameters())
+    opt2.set_state_dict(paddle.load(str(tmp_path / "opt.pdopt")))
+    assert opt2._accumulators["moment1"]
+
+
+def test_save_load_bf16(tmp_path):
+    t = paddle.to_tensor(a(3, 3)).astype("bfloat16")
+    paddle.save({"w": t}, str(tmp_path / "t.pd"))
+    back = paddle.load(str(tmp_path / "t.pd"))
+    assert str(back["w"].dtype) == "bfloat16"
+
+
+# ---------------- jit ----------------
+
+def test_to_static_function():
+    calls = []
+
+    @paddle.jit.to_static
+    def f(x, y):
+        calls.append(1)
+        return paddle.matmul(x, y) + 1.0
+
+    x, y = paddle.to_tensor(a(3, 4)), paddle.to_tensor(a(4, 2))
+    out1 = f(x, y)
+    out2 = f(x, y)
+    ref = x.numpy() @ y.numpy() + 1
+    np.testing.assert_allclose(out1.numpy(), ref, rtol=1e-5)
+    np.testing.assert_allclose(out2.numpy(), ref, rtol=1e-5)
+    # traced once (discovery + trace on first call only)
+    assert len(calls) <= 3
+
+
+def test_to_static_layer_with_params_and_backward():
+    net = nn.Linear(4, 2)
+
+    @paddle.jit.to_static
+    def step(x):
+        return net(x).sum()
+
+    x = paddle.to_tensor(a(3, 4))
+    loss = step(x)
+    loss.backward()
+    assert net.weight.grad is not None
+    np.testing.assert_allclose(net.weight.grad.numpy(),
+                               np.tile(x.numpy().sum(0)[:, None], (1, 2)),
+                               rtol=1e-5)
+    # param update visible to compiled fn (params passed as inputs)
+    old = float(step(x))
+    net.weight.set_value(net.weight._value * 0)
+    net.bias.set_value(net.bias._value * 0)
+    assert float(step(x)) == pytest.approx(0.0, abs=1e-6)
+    assert old != 0.0
+
+
+def test_to_static_shape_recompile():
+    @paddle.jit.to_static
+    def f(x):
+        return (x * 2).sum()
+
+    assert float(f(paddle.to_tensor(np.ones(3, np.float32)))) == 6.0
+    assert float(f(paddle.to_tensor(np.ones(5, np.float32)))) == 10.0
+
+
+def test_to_static_method_decorator():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(2, 2)
+
+        @paddle.jit.to_static
+        def forward(self, x):
+            return self.fc(x)
+
+    net = Net()
+    out = net(paddle.to_tensor(a(1, 2)))
+    assert out.shape == [1, 2]
+
+
+def test_jit_save_load(tmp_path):
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.to_tensor(a(2, 4))
+    ref = net(x).numpy()
+    path = str(tmp_path / "infer")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.static.InputSpec([2, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    out = loaded(x)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_train_step_compiled_matches_eager():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    y = rng.standard_normal((16, 1)).astype(np.float32)
+
+    paddle.seed(7)
+    net1 = nn.Linear(4, 1)
+    opt1 = paddle.optimizer.Adam(learning_rate=0.01,
+                                 parameters=net1.parameters())
+    paddle.seed(7)
+    net2 = nn.Linear(4, 1)
+    opt2 = paddle.optimizer.Adam(learning_rate=0.01,
+                                 parameters=net2.parameters())
+    np.testing.assert_allclose(net1.weight.numpy(), net2.weight.numpy())
+
+    from paddle_tpu.jit import TrainStep
+
+    def loss_fn(net, xb, yb):
+        return ((net(xb) - yb) ** 2).mean()
+
+    step = TrainStep(net2, loss_fn, opt2)
+    for i in range(5):
+        xb, yb = paddle.to_tensor(x), paddle.to_tensor(y)
+        # eager
+        loss1 = loss_fn(net1, xb, yb)
+        loss1.backward()
+        opt1.step()
+        opt1.clear_grad()
+        # compiled
+        loss2 = step(xb, yb)
+        np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-4)
+    np.testing.assert_allclose(net1.weight.numpy(), net2.weight.numpy(),
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,134 @@
+"""Autograd tape semantics: accumulation, hooks, no_grad, paddle.grad,
+PyLayer, retain_graph, functional transforms."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_grad_accumulation_and_clear():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5, 5])  # accumulates
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_stop_gradient_leaf():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0])  # stop_gradient=True
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3, 4])
+    assert y.grad is None
+
+
+def test_grad_api():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = paddle.to_tensor(3.0, stop_gradient=False)
+    z = x * x * y
+    gx, gy = paddle.grad(z, [x, y])
+    assert float(gx) == pytest.approx(12.0)
+    assert float(gy) == pytest.approx(4.0)
+    assert x.grad is None  # paddle.grad does not populate .grad
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6, 6])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    z = y * 3
+    z.backward(retain_graph=True)
+    z.backward(retain_graph=False)
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.array([[3., 1., 2.]], dtype=np.float32),
+                         stop_gradient=False)
+    vals, idx = paddle.topk(x, 2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0, 1]])
+
+
+def test_pylayer():
+    class Double(paddle.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor()
+            return grad * 2
+
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_functional_vjp_jvp_jacobian():
+    from paddle_tpu.autograd import vjp, jvp, jacobian
+
+    def f(x):
+        return x * x
+
+    x = paddle.to_tensor([1.0, 2.0])
+    out, g = vjp(f, x)
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+    out, t = jvp(f, x)
+    np.testing.assert_allclose(t.numpy(), [2.0, 4.0])
+    j = jacobian(f, x)
+    np.testing.assert_allclose(np.asarray(j.numpy()),
+                               np.diag([2.0, 4.0]), rtol=1e-6)
+
+
+def test_double_use_of_tensor():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x  # same tensor twice
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_diamond_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    a = x * 2
+    b = x * 3
+    c = a + b
+    c.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    z = y * 3
+    z.backward()
+    assert x.grad is None

@@ -1,0 +1,263 @@
+"""nn.Layer system + layers correctness."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def a(*shape):
+    return np.random.default_rng(3).standard_normal(shape).astype(np.float32)
+
+
+def test_linear():
+    layer = nn.Linear(4, 3)
+    x = paddle.to_tensor(a(2, 4))
+    out = layer(x)
+    ref = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+    assert len(layer.parameters()) == 2
+
+
+def test_layer_registration_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.register_buffer("step", paddle.zeros([1]))
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    sd = net.state_dict()
+    assert set(sd.keys()) == {"fc1.weight", "fc1.bias", "fc2.weight",
+                              "fc2.bias", "step"}
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    # round trip
+    net2 = Net()
+    net2.set_state_dict(sd)
+    np.testing.assert_allclose(net2.fc1.weight.numpy(),
+                               net.fc1.weight.numpy())
+    out = net(paddle.to_tensor(a(3, 4)))
+    assert out.shape == [3, 2]
+
+
+def test_forward_hooks():
+    layer = nn.Linear(2, 2)
+    calls = []
+    h1 = layer.register_forward_pre_hook(
+        lambda l, inp: calls.append("pre"))
+    h2 = layer.register_forward_post_hook(
+        lambda l, inp, out: calls.append("post"))
+    layer(paddle.to_tensor(a(1, 2)))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    layer(paddle.to_tensor(a(1, 2)))
+    assert calls == ["pre", "post"]
+
+
+def test_train_eval_mode_dropout():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    d.train()
+    out = d(x)
+    assert float(out.numpy().std()) > 0.1  # masks applied
+    d.eval()
+    out = d(x)
+    np.testing.assert_allclose(out.numpy(), np.ones(1000), rtol=1e-6)
+
+
+def test_conv2d_vs_naive():
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    x = a(1, 2, 5, 5)
+    out = conv(paddle.to_tensor(x))
+    assert out.shape == [1, 3, 5, 5]
+    # compare against manual correlation for one output position
+    w = conv.weight.numpy()
+    b = conv.bias.numpy()
+    xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+    ref = np.sum(xp[0, :, 2:5, 2:5] * w[1]) + b[1]
+    np.testing.assert_allclose(float(out.numpy()[0, 1, 2, 2]), ref, rtol=1e-4)
+
+
+def test_pooling():
+    x = a(1, 1, 4, 4)
+    out = F.max_pool2d(paddle.to_tensor(x), 2)
+    ref = x.reshape(1, 1, 2, 2, 2, 2).max((3, 5))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+    out = F.avg_pool2d(paddle.to_tensor(x), 2)
+    ref = x.reshape(1, 1, 2, 2, 2, 2).mean((3, 5))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+    out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 1)
+    np.testing.assert_allclose(out.numpy().reshape(-1),
+                               x.mean((2, 3)).reshape(-1), rtol=1e-6)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = a(4, 3, 2, 2) * 3 + 1
+    bn.train()
+    out = bn(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy().mean((0, 2, 3)), np.zeros(3),
+                               atol=1e-4)
+    np.testing.assert_allclose(out.numpy().std((0, 2, 3)), np.ones(3),
+                               atol=1e-2)
+    # running stats moved toward batch stats
+    assert abs(bn._mean.numpy().mean()) > 1e-4
+    bn.eval()
+    out2 = bn(paddle.to_tensor(x))
+    assert out2.shape == [4, 3, 2, 2]
+
+
+def test_layernorm_rmsnorm():
+    ln = nn.LayerNorm(8)
+    x = a(2, 3, 8)
+    out = ln(paddle.to_tensor(x))
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+        x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+    rn = nn.RMSNorm(8)
+    out = rn(paddle.to_tensor(x))
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor(np.array([[1, 0, 3]]))
+    out = emb(idx)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+
+
+def test_losses():
+    logits = a(4, 5)
+    labels = np.array([0, 2, 1, 4])
+    loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+    # numpy reference
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(4), labels]).mean()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-4)
+    # soft label
+    soft = p
+    loss2 = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft),
+                            soft_label=True)
+    ref2 = -(soft * np.log(p)).sum(-1).mean()
+    np.testing.assert_allclose(float(loss2), ref2, rtol=1e-4)
+    # mse / l1 / bce
+    x, y = a(3, 3), a(3, 3)
+    np.testing.assert_allclose(
+        float(F.mse_loss(paddle.to_tensor(x), paddle.to_tensor(y))),
+        ((x - y) ** 2).mean(), rtol=1e-5)
+    probs = 1 / (1 + np.exp(-x))
+    tgt = (y > 0).astype(np.float32)
+    np.testing.assert_allclose(
+        float(F.binary_cross_entropy_with_logits(
+            paddle.to_tensor(x), paddle.to_tensor(tgt))),
+        -(tgt * np.log(probs) + (1 - tgt) * np.log(1 - probs)).mean(),
+        rtol=1e-4)
+
+
+def test_cross_entropy_ignore_index_grad():
+    logits = paddle.to_tensor(a(4, 5), stop_gradient=False)
+    labels = paddle.to_tensor(np.array([0, -100, 1, -100]))
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    loss.backward()
+    g = logits.grad.numpy()
+    np.testing.assert_allclose(g[1], np.zeros(5), atol=1e-7)
+    assert np.abs(g[0]).sum() > 0
+
+
+def test_activations():
+    x = a(3, 4)
+    np.testing.assert_allclose(F.relu(paddle.to_tensor(x)).numpy(),
+                               np.maximum(x, 0), rtol=1e-6)
+    np.testing.assert_allclose(
+        F.softmax(paddle.to_tensor(x)).numpy().sum(-1), np.ones(3), rtol=1e-5)
+    np.testing.assert_allclose(
+        F.sigmoid(paddle.to_tensor(x)).numpy(), 1 / (1 + np.exp(-x)),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        F.silu(paddle.to_tensor(x)).numpy(), x / (1 + np.exp(-x)), rtol=1e-5)
+
+
+def test_sequential_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    out = seq(paddle.to_tensor(a(3, 4)))
+    assert out.shape == [3, 2]
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(ll.parameters()) == 8
+
+
+def test_multihead_attention_and_transformer():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.to_tensor(a(2, 5, 16))
+    out = mha(x)
+    assert out.shape == [2, 5, 16]
+    enc_layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(enc_layer, 2)
+    out = enc(x)
+    assert out.shape == [2, 5, 16]
+    # causal mask utility
+    m = nn.Transformer.generate_square_subsequent_mask(4)
+    assert float(m.numpy()[0, 1]) < -1e29
+
+
+def test_attention_causal_matches_mask():
+    q = paddle.to_tensor(a(1, 6, 2, 8))
+    out1 = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    mask = np.triu(np.full((6, 6), -1e30, np.float32), 1)[None, None]
+    out2 = F.scaled_dot_product_attention(q, q, q,
+                                          attn_mask=paddle.to_tensor(mask))
+    np.testing.assert_allclose(out1.numpy(), out2.numpy(), atol=1e-5)
+
+
+def test_rnn_lstm_gru():
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = paddle.to_tensor(a(2, 5, 4))
+    out, (h, c) = lstm(x)
+    assert out.shape == [2, 5, 8]
+    assert h.shape == [2, 2, 8]
+    gru = nn.GRU(4, 8, direction="bidirect")
+    out, h = gru(x)
+    assert out.shape == [2, 5, 16]
+    # grads flow
+    out.sum().backward()
+    assert lstm.weight_ih_l0.grad is None  # different layer
+    assert gru.weight_ih_l0.grad is not None
+
+
+def test_clip_grad_by_global_norm():
+    p1 = nn.Parameter(np.ones((2, 2), np.float32) * 3)
+    p2 = nn.Parameter(np.ones((2,), np.float32) * 4)
+    g1 = paddle.to_tensor(np.ones((2, 2), np.float32) * 3)
+    g2 = paddle.to_tensor(np.ones((2,), np.float32) * 4)
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    out = clip([(p1, g1), (p2, g2)])
+    total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+def test_initializers():
+    from paddle_tpu.nn.initializer import (Constant, Normal, XavierUniform,
+                                           KaimingNormal, Orthogonal, Assign)
+    import jax.numpy as jnp
+    c = Constant(2.5)((3, 3), jnp.float32)
+    np.testing.assert_allclose(np.asarray(c), np.full((3, 3), 2.5))
+    n = Normal(0, 1)((500, 4), jnp.float32)
+    assert abs(float(np.asarray(n).mean())) < 0.15
+    o = Orthogonal()((4, 4), jnp.float32)
+    np.testing.assert_allclose(np.asarray(o) @ np.asarray(o).T, np.eye(4),
+                               atol=1e-4)
+    v = Assign(np.arange(6).reshape(2, 3))((2, 3), jnp.float32)
+    np.testing.assert_allclose(np.asarray(v), np.arange(6).reshape(2, 3))

@@ -1,0 +1,161 @@
+"""Manipulation/search/logic op correctness."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output
+
+RNG = np.random.default_rng(1)
+
+
+def a(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+def test_reshape_transpose_flatten():
+    x = a(2, 3, 4)
+    check_output(lambda t: paddle.reshape(t, [4, 6]),
+                 lambda v: v.reshape(4, 6), [x])
+    check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                 lambda v: v.transpose(2, 0, 1), [x])
+    check_output(lambda t: paddle.flatten(t, 1, 2),
+                 lambda v: v.reshape(2, 12), [x])
+    check_output(lambda t: paddle.squeeze(paddle.unsqueeze(t, 0), 0),
+                 lambda v: v, [x])
+
+
+def test_concat_stack_split():
+    x, y = a(2, 3), a(2, 3)
+    check_output(lambda t, u: paddle.concat([t, u], axis=0),
+                 lambda v, w: np.concatenate([v, w], 0), [x, y])
+    check_output(lambda t, u: paddle.stack([t, u], axis=1),
+                 lambda v, w: np.stack([v, w], 1), [x, y])
+    outs = paddle.split(paddle.to_tensor(a(6, 4)), 3, axis=0)
+    assert len(outs) == 3 and outs[0].shape == [2, 4]
+    outs = paddle.split(paddle.to_tensor(a(7, 4)), [2, 5], axis=0)
+    assert outs[1].shape == [5, 4]
+    outs = paddle.split(paddle.to_tensor(a(7, 4)), [2, -1], axis=0)
+    assert outs[1].shape == [5, 4]
+
+
+def test_tile_expand_flip_roll():
+    x = a(2, 3)
+    check_output(lambda t: paddle.tile(t, [2, 2]),
+                 lambda v: np.tile(v, (2, 2)), [x])
+    check_output(lambda t: paddle.expand(t, [4, 2, 3]),
+                 lambda v: np.broadcast_to(v, (4, 2, 3)), [x])
+    check_output(lambda t: paddle.flip(t, axis=1),
+                 lambda v: np.flip(v, 1), [x])
+    check_output(lambda t: paddle.roll(t, 1, axis=0),
+                 lambda v: np.roll(v, 1, 0), [x])
+
+
+def test_gather_scatter():
+    x = a(5, 3)
+    idx = np.array([0, 2, 4])
+    check_output(lambda t: paddle.gather(t, paddle.to_tensor(idx), axis=0),
+                 lambda v: v[idx], [x])
+    upd = a(3, 3)
+    out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                         paddle.to_tensor(upd))
+    ref = x.copy()
+    ref[idx] = upd
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+    # gather_nd
+    gx = a(3, 4, 5)
+    gidx = np.array([[0, 1], [2, 3]])
+    check_output(lambda t: paddle.gather_nd(t, paddle.to_tensor(gidx)),
+                 lambda v: v[[0, 2], [1, 3]], [gx])
+
+
+def test_index_select_take_along():
+    x = a(4, 5)
+    idx = np.array([3, 1])
+    check_output(lambda t: paddle.index_select(t, paddle.to_tensor(idx), axis=1),
+                 lambda v: v[:, idx], [x])
+    ta_idx = np.argsort(x, axis=1)
+    check_output(lambda t: paddle.take_along_axis(
+        t, paddle.to_tensor(ta_idx), axis=1),
+        lambda v: np.take_along_axis(v, ta_idx, 1), [x])
+
+
+def test_pad():
+    x = a(2, 3, 4, 5)
+    check_output(lambda t: paddle.nn.functional.pad(t, [1, 2], value=0.5),
+                 lambda v: np.pad(v, [(0, 0), (0, 0), (0, 0), (1, 2)],
+                                  constant_values=0.5), [x])
+    check_output(lambda t: paddle.nn.functional.pad(t, [1, 1, 2, 2]),
+                 lambda v: np.pad(v, [(0, 0), (0, 0), (2, 2), (1, 1)]), [x])
+
+
+def test_search_sort():
+    x = a(4, 6)
+    check_output(lambda t: paddle.argmax(t, axis=1),
+                 lambda v: v.argmax(1).astype(np.int64), [x])
+    check_output(lambda t: paddle.sort(t, axis=1),
+                 lambda v: np.sort(v, 1), [x])
+    check_output(lambda t: paddle.argsort(t, axis=1, descending=True),
+                 lambda v: np.argsort(-v, 1, kind="stable").astype(np.int64),
+                 [x])
+    vals, idx = paddle.topk(paddle.to_tensor(x), 3, axis=1)
+    ref_vals = -np.sort(-x, 1)[:, :3]
+    np.testing.assert_allclose(vals.numpy(), ref_vals, rtol=1e-6)
+    # where
+    cond = x > 0
+    check_output(lambda t, u: paddle.where(paddle.to_tensor(cond), t, u),
+                 lambda v, w: np.where(cond, v, w), [x, a(4, 6)])
+
+
+def test_logic():
+    x, y = a(3, 3), a(3, 3)
+    check_output(lambda t, u: paddle.greater_than(t, u), lambda v, w: v > w,
+                 [x, y])
+    check_output(lambda t: paddle.logical_not(t > 0), lambda v: ~(v > 0), [x])
+    assert bool(paddle.allclose(paddle.to_tensor(x), paddle.to_tensor(x)))
+    assert bool(paddle.equal_all(paddle.to_tensor(x), paddle.to_tensor(x)))
+    assert not bool(paddle.equal_all(paddle.to_tensor(x), paddle.to_tensor(y)))
+
+
+def test_creation():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    # 'int64' is accepted as an alias of int32 (TPU-native 32-bit policy)
+    assert str(paddle.ones([2], dtype="int64").dtype) == "int32"
+    np.testing.assert_array_equal(paddle.arange(0, 10, 2).numpy(),
+                                  np.arange(0, 10, 2))
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                               np.linspace(0, 1, 5), rtol=1e-6)
+    e = paddle.eye(3)
+    np.testing.assert_array_equal(e.numpy(), np.eye(3, dtype=np.float32))
+    check_output(lambda t: paddle.tril(t), np.tril, [a(4, 4)])
+    g = paddle.meshgrid(paddle.arange(3).astype("float32"),
+                        paddle.arange(4).astype("float32"))
+    assert g[0].shape == [3, 4]
+
+
+def test_masked_select_nonzero_unique_eager():
+    x = a(4, 4)
+    mask = x > 0
+    out = paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(mask))
+    np.testing.assert_allclose(out.numpy(), x[mask], rtol=1e-6)
+    nz = paddle.nonzero(paddle.to_tensor(mask))
+    np.testing.assert_array_equal(nz.numpy(), np.stack(np.nonzero(mask), 1))
+    u = paddle.unique(paddle.to_tensor(np.array([3, 1, 2, 1, 3])))
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+
+
+def test_one_hot_getitem_setitem():
+    oh = paddle.nn.functional.one_hot(paddle.to_tensor(np.array([0, 2])), 4)
+    np.testing.assert_array_equal(oh.numpy(),
+                                  [[1, 0, 0, 0], [0, 0, 1, 0]])
+    x = paddle.to_tensor(a(4, 4))
+    ref = x.numpy().copy()
+    sub = x[1:3, ::2]
+    np.testing.assert_allclose(sub.numpy(), ref[1:3, ::2], rtol=1e-6)
+    x[0, 0] = 7.0
+    assert float(x[0, 0]) == 7.0
+    # getitem grad
+    y = paddle.to_tensor(ref, stop_gradient=False)
+    y[1:3].sum().backward()
+    g = y.grad.numpy()
+    assert g[1:3].sum() == 8.0 and g[0].sum() == 0.0

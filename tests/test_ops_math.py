@@ -1,0 +1,149 @@
+"""Math/reduction/linalg op correctness vs numpy (eager + jit)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+RNG = np.random.default_rng(0)
+
+
+def a(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+BINARY_CASES = [
+    (paddle.add, np.add), (paddle.subtract, np.subtract),
+    (paddle.multiply, np.multiply), (paddle.divide, np.divide),
+    (paddle.maximum, np.maximum), (paddle.minimum, np.minimum),
+    (paddle.atan2, np.arctan2),
+]
+
+
+@pytest.mark.parametrize("op,ref", BINARY_CASES,
+                         ids=[o.__name__ for o, _ in BINARY_CASES])
+def test_binary(op, ref):
+    x, y = a(3, 4), a(3, 4) + 2.0
+    check_output(op, ref, [x, y])
+
+
+UNARY_CASES = [
+    (paddle.exp, np.exp), (paddle.tanh, np.tanh), (paddle.sin, np.sin),
+    (paddle.cos, np.cos), (paddle.floor, np.floor), (paddle.ceil, np.ceil),
+    (paddle.abs, np.abs), (paddle.log1p, lambda x: np.log1p(np.abs(x) + 1)),
+]
+
+
+@pytest.mark.parametrize("op,ref", UNARY_CASES[:7],
+                         ids=[o.__name__ for o, _ in UNARY_CASES[:7]])
+def test_unary(op, ref):
+    x = a(2, 5)
+    check_output(op, ref, [x])
+
+
+def test_sqrt_log():
+    x = np.abs(a(3, 3)) + 0.5
+    check_output(paddle.sqrt, np.sqrt, [x])
+    check_output(paddle.log, np.log, [x])
+    check_output(paddle.rsqrt, lambda v: 1 / np.sqrt(v), [x], atol=1e-4,
+                 rtol=1e-3)
+
+
+def test_matmul():
+    x, y = a(4, 5), a(5, 6)
+    check_output(paddle.matmul, np.matmul, [x, y])
+    check_output(lambda p, q: paddle.matmul(p, q, transpose_y=True),
+                 lambda p, q: p @ q.T, [a(4, 5), a(6, 5)])
+
+
+def test_matmul_grad():
+    check_grad(paddle.matmul, [a(3, 4), a(4, 2)], grad_input_idx=0)
+    check_grad(paddle.matmul, [a(3, 4), a(4, 2)], grad_input_idx=1)
+
+
+def test_reductions():
+    x = a(3, 4, 5)
+    check_output(lambda t: paddle.sum(t), lambda v: np.sum(v), [x])
+    check_output(lambda t: paddle.sum(t, axis=1), lambda v: v.sum(1), [x])
+    check_output(lambda t: paddle.mean(t, axis=[0, 2]),
+                 lambda v: v.mean((0, 2)), [x])
+    check_output(lambda t: paddle.max(t, axis=1, keepdim=True),
+                 lambda v: v.max(1, keepdims=True), [x])
+    check_output(lambda t: paddle.prod(t, axis=-1),
+                 lambda v: v.prod(-1), [x], atol=1e-4)
+    check_output(lambda t: paddle.logsumexp(t, axis=1),
+                 lambda v: np.log(np.exp(v).sum(1)), [x], atol=1e-4)
+
+
+def test_cumsum_cumprod():
+    x = a(3, 4)
+    check_output(lambda t: paddle.cumsum(t, axis=1),
+                 lambda v: np.cumsum(v, 1), [x])
+    check_output(lambda t: paddle.cumsum(t),
+                 lambda v: np.cumsum(v.reshape(-1)), [x])
+    check_output(lambda t: paddle.cumprod(t, dim=0),
+                 lambda v: np.cumprod(v, 0), [x], atol=1e-4)
+
+
+def test_clip_lerp_trace():
+    x = a(4, 4)
+    check_output(lambda t: paddle.clip(t, -0.5, 0.5),
+                 lambda v: np.clip(v, -0.5, 0.5), [x])
+    check_output(lambda t, u: paddle.lerp(t, u, 0.3),
+                 lambda v, w: v + 0.3 * (w - v), [x, a(4, 4)])
+    check_output(paddle.trace, lambda v: np.trace(v), [x])
+
+
+def test_scale_pow():
+    x = a(3, 3)
+    check_output(lambda t: paddle.scale(t, 2.0, 1.0),
+                 lambda v: v * 2 + 1, [x])
+    check_output(lambda t: paddle.pow(t, 2.0), lambda v: v ** 2, [x])
+
+
+def test_linalg():
+    m = a(4, 4) + 4 * np.eye(4, dtype=np.float32)
+    check_output(paddle.inverse, np.linalg.inv, [m], atol=1e-3)
+    check_output(lambda t: paddle.linalg.det(t), np.linalg.det, [m],
+                 atol=1e-3, rtol=1e-3)
+    spd = (m @ m.T + 4 * np.eye(4)).astype(np.float32)
+    check_output(paddle.linalg.cholesky, np.linalg.cholesky, [spd], atol=1e-3)
+    check_output(lambda t: paddle.linalg.norm(t),
+                 lambda v: np.linalg.norm(v), [a(3, 5)], atol=1e-4)
+
+
+def test_einsum():
+    x, y = a(3, 4), a(4, 5)
+    check_output(lambda t, u: paddle.einsum("ij,jk->ik", t, u),
+                 lambda v, w: np.einsum("ij,jk->ik", v, w), [x, y])
+
+
+def test_unary_grads():
+    check_grad(paddle.tanh, [a(3, 3)])
+    check_grad(paddle.exp, [a(3, 3) * 0.3])
+    check_grad(lambda t: paddle.sum(paddle.multiply(t, t)), [a(4,)],
+               reduce_to_scalar=False)
+
+
+def test_stat():
+    x = a(5, 6)
+    check_output(lambda t: paddle.std(t, axis=1),
+                 lambda v: v.std(1, ddof=1), [x], atol=1e-4)
+    check_output(lambda t: paddle.var(t, unbiased=False),
+                 lambda v: v.var(), [x], atol=1e-4)
+    check_output(lambda t: paddle.median(t, axis=1),
+                 lambda v: np.median(v, 1), [x])
+
+
+def test_tensor_methods_and_operators():
+    x = paddle.to_tensor(a(3, 3), stop_gradient=False)
+    y = ((x + 1.0) * 2.0 - x / 2.0) ** 2
+    z = y.mean()
+    z.backward()
+    assert x.grad is not None
+    assert x.grad.shape == [3, 3]
+    # chained methods
+    w = paddle.to_tensor(a(2, 6))
+    assert w.reshape([3, 4]).transpose([1, 0]).shape == [4, 3]
+    assert float((w.exp().log() - w).abs().max()) < 1e-5
