@@ -1,0 +1,98 @@
+"""Benchmark: Llama decoder pretraining step throughput (tokens/sec/chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Runs the fully-compiled TrainStep (forward+loss+backward+AdamW, bf16 compute
+via AMP-style param dtype) on whatever device jax exposes (the real TPU chip
+under the driver; CPU otherwise, scaled-down shapes).
+
+vs_baseline: the reference publishes no in-tree numbers (BASELINE.md);
+we report the ratio of achieved model FLOPs/s to a 10% MFU floor on the
+chip's nominal bf16 peak — >1.0 means we beat that conservative floor.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaPretrainingCriterion)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=2048)
+        batch, seq, steps = 8, 1024, 10
+        peak_flops = 197e12  # v5p nominal bf16; v5e ~394/2... conservative
+        if "v5 lite" in str(dev).lower() or "v5e" in str(dev).lower():
+            peak_flops = 197e12
+        dtype = "bfloat16"
+    else:
+        cfg = LlamaConfig(vocab_size=1024, hidden_size=256,
+                          intermediate_size=704, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2)
+        batch, seq, steps = 2, 128, 3
+        peak_flops = 1e11
+        dtype = "float32"
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if dtype == "bfloat16":
+        model.to(dtype="bfloat16")
+    criterion = LlamaPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 multi_precision=(dtype == "bfloat16"))
+
+    def loss_fn(net, tokens, labels):
+        logits = net(tokens)
+        return criterion(logits, labels)
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    tokens = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    # warmup / compile
+    loss = step(tokens, labels)
+    loss._value.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(tokens, labels)
+    loss._value.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_s = batch * seq * steps / dt
+
+    # model FLOPs: 6 * n_params * tokens (dense decoder approximation)
+    n_params = sum(p.size for p in model.parameters())
+    flops_per_s = 6.0 * n_params * tokens_per_s
+    mfu_floor_ratio = flops_per_s / (0.10 * peak_flops)
+
+    print(json.dumps({
+        "metric": "llama_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu_floor_ratio, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

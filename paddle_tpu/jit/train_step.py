@@ -26,6 +26,9 @@ from ..optimizer import SGD, Adam, AdamW, Momentum
 from ..optimizer.optimizer import Optimizer
 
 
+_UNSET = object()
+
+
 def _functional_sgd(p, g, state, lr, hp):
     return p - lr * g.astype(p.dtype), state
 
@@ -69,6 +72,7 @@ class TrainStep:
         self._buffers = list(model.buffers())
         self._state = None
         self._compiled = None
+        self._batch_sharding_cache = _UNSET
         self._update_fn, self._hypers = self._select_update(optimizer)
 
     def _select_update(self, opt):
@@ -89,13 +93,60 @@ class TrainStep:
             return _functional_sgd, {}
         return None, None
 
+    def _mesh(self):
+        """Resolve mesh= (accepts jax Mesh, ProcessMesh, or None→global)."""
+        if self.mesh is None:
+            from ..distributed.topology import get_global_mesh
+            return get_global_mesh()
+        from ..distributed.sharding_api import _resolve_mesh
+        return _resolve_mesh(self.mesh)
+
+    def _opt_state_spec(self, p, mesh):
+        """PartitionSpec for a param's optimizer state: inherit the param's
+        sharding; under ZeRO (shard_optimizer) additionally shard the first
+        free divisible dim over the 'sharding' axis (ZeRO-1 layout)."""
+        from jax.sharding import PartitionSpec
+        spec = list(p._dist_attr) if p._dist_attr is not None \
+            else [None] * p._value.ndim
+        while len(spec) < p._value.ndim:
+            spec.append(None)
+
+        def uses_axis(entry, name):
+            return entry == name or (isinstance(entry, tuple) and name in entry)
+
+        if getattr(self.optimizer, "_zero_sharded", False) and \
+                "sharding" in mesh.axis_names and mesh.shape["sharding"] > 1 \
+                and not any(uses_axis(e, "sharding") for e in spec):
+            size = mesh.shape["sharding"]
+            for i, s in enumerate(p._value.shape):
+                if spec[i] is None and s % size == 0 and s >= size:
+                    spec[i] = "sharding"
+                    break
+        return PartitionSpec(*spec)
+
+    def _opt_state_sharding(self, p):
+        from jax.sharding import NamedSharding
+        mesh = self._mesh()
+        if mesh is None:
+            return None
+        return NamedSharding(mesh, self._opt_state_spec(p, mesh))
+
+    def _place(self, arr, sharding):
+        if sharding is None:
+            return arr
+        return jax.device_put(arr, sharding)
+
     def _init_state(self):
+        def zeros_like_placed(p, dtype=None):
+            arr = jnp.zeros(p._value.shape, dtype or p._value.dtype)
+            return self._place(arr, self._opt_state_sharding(p))
+
         if self._update_fn is _functional_adam:
-            return [{"m": jnp.zeros(p._value.shape, jnp.float32),
-                     "v": jnp.zeros(p._value.shape, jnp.float32),
+            return [{"m": zeros_like_placed(p, jnp.float32),
+                     "v": zeros_like_placed(p, jnp.float32),
                      "t": jnp.zeros((), jnp.float32)} for p in self._params]
         if self._update_fn is _functional_momentum:
-            return [{"velocity": jnp.zeros_like(p._value)}
+            return [{"velocity": zeros_like_placed(p)}
                     for p in self._params]
         return [{} for _ in self._params]
 
@@ -106,6 +157,27 @@ class TrainStep:
         model = self.model
         loss_fn = self.loss_fn
         grad_clip = self.optimizer._grad_clip
+
+        # Output-sharding pins: keep updated params/state on their input
+        # layouts so ZeRO sharding survives step 1 and donation holds.
+        mesh = self._mesh()
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            param_pins = [
+                NamedSharding(mesh, PartitionSpec(*p._dist_attr))
+                if p._dist_attr is not None else None
+                for p in params
+            ]
+            state_pins = [NamedSharding(mesh, self._opt_state_spec(p, mesh))
+                          for p in params]
+        else:
+            param_pins = [None] * len(params)
+            state_pins = [None] * len(params)
+
+        def pin(arr, sharding, like_shape):
+            if sharding is None or arr.shape != like_shape:
+                return arr
+            return jax.lax.with_sharding_constraint(arr, sharding)
 
         def compiled(p_values, opt_state, rng_key, lr, *inputs):
             def loss_of(pv):
@@ -135,8 +207,11 @@ class TrainStep:
                 scale = cn / jnp.maximum(gnorm, cn)
                 grads = [g * scale.astype(g.dtype) for g in grads]
             new_p, new_s = [], []
-            for p, g, s in zip(p_values, grads, opt_state):
+            for i, (p, g, s) in enumerate(zip(p_values, grads, opt_state)):
                 np_, ns_ = update_fn(p, g, s, lr, hypers)
+                np_ = pin(np_, param_pins[i], p.shape)
+                ns_ = {k: pin(v, state_pins[i], p.shape)
+                       for k, v in ns_.items()}
                 new_p.append(np_)
                 new_s.append(ns_)
             return new_p, new_s, loss, aux
@@ -144,12 +219,47 @@ class TrainStep:
         jit_kwargs = dict(donate_argnums=(0, 1))
         self._compiled = jax.jit(compiled, **jit_kwargs)
 
+    def _batch_sharding(self):
+        """NamedSharding for batch inputs: dim 0 over the 'data'
+        (+'sharding' fused ZeRO-DP) axes, replicated elsewhere.  Depends
+        only on the mesh — computed once and cached."""
+        if self._batch_sharding_cache is not _UNSET:
+            return self._batch_sharding_cache
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = self._mesh()
+        sharding = None
+        n_shards = 1
+        if mesh is not None:
+            batch_axes = [a for a in ("data", "sharding")
+                          if a in mesh.axis_names and mesh.shape[a] > 1]
+            if batch_axes:
+                for a in batch_axes:
+                    n_shards *= mesh.shape[a]
+                spec = PartitionSpec(tuple(batch_axes) if len(batch_axes) > 1
+                                     else batch_axes[0])
+                sharding = NamedSharding(mesh, spec)
+        self._batch_sharding_cache = (sharding, n_shards)
+        return self._batch_sharding_cache
+
+    def _shard_batch(self, x):
+        """Place a batch input over the data axes.  Inputs carrying an
+        explicit user sharding annotation (Tensor._dist_attr) are respected
+        and left untouched."""
+        if isinstance(x, Tensor) and x._dist_attr is not None:
+            return x._value
+        arr = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        sharding, n_shards = self._batch_sharding()
+        if sharding is None or arr.ndim == 0 or arr.shape[0] % n_shards != 0:
+            return arr
+        if getattr(arr, "sharding", None) == sharding:
+            return arr
+        return jax.device_put(arr, sharding)
+
     def __call__(self, *inputs):
         if self._state is None:
             self._state = self._init_state()
             self._build()
-        arrays = [i._value if isinstance(i, Tensor) else jnp.asarray(i)
-                  for i in inputs]
+        arrays = [self._shard_batch(i) for i in inputs]
         key = _generator.default_generator().next_key()
         lr = jnp.float32(self.optimizer.get_lr())
         p_values = [p._value for p in self._params]
