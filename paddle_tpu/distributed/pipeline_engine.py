@@ -1,0 +1,137 @@
+"""SPMD pipeline-parallel engine.
+
+The TPU-native replacement for the reference's pipeline runtimes — both the
+dygraph 1F1B loop (``fleet/meta_parallel/pipeline_parallel.py:387``
+forward_backward_pipeline + p2p_communication.py NCCL send/recv) and the
+static FleetExecutor actor graph (``fleet_executor/carrier.h:50`` +
+interceptors).  Design (scaling-book collective-permute pipelining):
+
+- The pipeline is expressed as ONE differentiable program: a ``lax.scan``
+  over schedule ticks inside a ``shard_map`` that is *manual* over the
+  "pipe" mesh axis and *auto* (GSPMD) over data/model/sharding/sep axes —
+  so TP/DP compose freely inside each stage.
+- Micro-batch activations move between stages with ``lax.ppermute``
+  (collective-permute rides ICI); XLA overlaps the permute of tick t with
+  the compute of tick t+1 — the steady-state overlap the reference builds
+  with P2P threads comes from the compiler schedule.
+- ``jax.grad`` through the scan+ppermute yields the backward pipeline
+  automatically (reversed scan, transposed permutes): a GPipe schedule,
+  with per-stage rematerialization via ``jax.checkpoint`` standing in for
+  the reference's recompute-in-1F1B memory profile.
+
+Stages must be shape-homogeneous (stage_fn: (stage_params, x) -> y with y
+shaped like x) — the transformer-decoder case; embedding/head run outside
+the pipelined region (the reference's PipelineLayer shares them across
+first/last stages for the same reason, pp_layers.py SharedLayerDesc).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+PIPE_AXIS = "pipe"
+
+
+
+def _pvary(x, axes):
+    """Mark x as varying over manual mesh axes (pcast on new jax, pvary on old)."""
+    try:
+        return jax.lax.pcast(x, axes, to="varying")
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(x, axes)
+
+def stack_stage_params(per_stage_params):
+    """[pytree per stage] -> single pytree with a leading stage dim."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *per_stage_params)
+
+
+def shard_stacked_params(stacked, mesh: Mesh):
+    """Place stacked stage params with the stage dim over the pipe axis."""
+    def place(leaf):
+        spec = PartitionSpec(PIPE_AXIS, *([None] * (leaf.ndim - 1)))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, stacked)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params: Any, micro_xs,
+                   n_stages: int, mesh: Mesh,
+                   remat: bool = True):
+    """Run micro-batches through the stage pipeline.
+
+    stage_fn(stage_params, x) -> y (same shape as x).
+    stacked_params: pytree, leaves [n_stages, ...] (sharded over pipe).
+    micro_xs: [n_micro, micro_batch, ...] activations entering stage 0.
+    Returns ys: [n_micro, micro_batch, ...] — the last stage's outputs,
+    valid on every device (broadcast over the pipe axis).
+    """
+    n_micro = micro_xs.shape[0]
+    total_ticks = n_micro + n_stages - 1
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def inner(params, xs):
+        # inside shard_map: params leaves have leading dim 1 (this stage)
+        my_params = jax.tree_util.tree_map(lambda l: l[0], params)
+        stage_idx = jax.lax.axis_index(PIPE_AXIS)
+        is_first = stage_idx == 0
+        is_last = stage_idx == n_stages - 1
+
+        buf0 = jnp.zeros_like(xs[0])
+        # mark the carry as varying over the pipe axis (shard_map VMA typing):
+        # the replicated zero init becomes device-varying after the first
+        # ppermute, so the scan carry type must start varying.
+        buf0 = _pvary(buf0, (PIPE_AXIS,))
+
+        def tick(carry, t):
+            recv = carry
+            # stage 0 feeds microbatch t (clamped); others take the wire
+            mb = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False)
+            inp = jnp.where(is_first, mb, recv)
+            out = fn(my_params, inp)
+            nxt = jax.lax.ppermute(out, PIPE_AXIS, perm)
+            return nxt, out
+
+        _, outs = jax.lax.scan(tick, buf0, jnp.arange(total_ticks))
+        # last stage produced valid results at ticks S-1 .. T-1
+        ys_last = jax.lax.dynamic_slice_in_dim(outs, n_stages - 1, n_micro,
+                                               axis=0)
+        # broadcast last stage's outputs to all pipe ranks (psum of masked)
+        contrib = jnp.where(is_last, ys_last, jnp.zeros_like(ys_last))
+        return jax.lax.psum(contrib, PIPE_AXIS)
+
+    n_dims_x = micro_xs.ndim
+    sm = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(
+            jax.tree_util.tree_map(lambda _: PartitionSpec(PIPE_AXIS),
+                                   stacked_params),
+            PartitionSpec(*([None] * n_dims_x)),
+        ),
+        out_specs=PartitionSpec(*([None] * n_dims_x)),
+        axis_names={PIPE_AXIS},
+    )
+    return sm(stacked_params, micro_xs)
+
+
+class PipelineStageRunner:
+    """Convenience wrapper binding stage_fn + mesh for repeated use."""
+
+    def __init__(self, stage_fn, n_stages, mesh, remat=True):
+        self.stage_fn = stage_fn
+        self.n_stages = n_stages
+        self.mesh = mesh
+        self.remat = remat
+
+    def __call__(self, stacked_params, micro_xs):
+        return pipeline_apply(self.stage_fn, stacked_params, micro_xs,
+                              self.n_stages, self.mesh, self.remat)

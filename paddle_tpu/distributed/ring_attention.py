@@ -1,0 +1,144 @@
+"""Ring attention — context parallelism over the sequence axis.
+
+The reference snapshot has NO ring/blockwise attention (SURVEY §2.5 "CP /
+ring attention: absent"); long context is handled there via flash-attn +
+Megatron-SP + the sep axis.  Here context parallelism is first-class:
+
+- :func:`ring_attention` — blockwise attention with the K/V shards rotating
+  around the mesh ring via ``lax.ppermute`` (ICI neighbor hops), accumulating
+  the softmax online (streaming m/l/acc, flash-attention style) so the full
+  sequence is never materialized on one device.
+- :func:`ulysses_attention` — the all-to-all alternative (DeepSpeed-Ulysses
+  style): seq-sharded activations swap to head-sharded for exact attention,
+  expressed as sharding constraints so GSPMD emits the all-to-alls over the
+  "sep" axis (the reference's sep-axis consumers live downstream; here the
+  consumer is in-tree).
+
+Both run inside the same mesh as DP/TP (shard_map manual over the context
+axis, auto elsewhere).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+SEQ_AXIS = "sep"
+
+
+
+def _pvary(x, axes):
+    """Mark x as varying over manual mesh axes (pcast on new jax, pvary on old)."""
+    try:
+        return jax.lax.pcast(x, axes, to="varying")
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(x, axes)
+
+def _block_attend(q, k, v, scale, mask):
+    """One block: returns (unnormalized acc, running max m, running sum l)."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    m = jnp.max(logits, axis=-1)                         # [B,H,Q]
+    p = jnp.exp(logits - m[..., None])
+    l = jnp.sum(p, axis=-1)                              # [B,H,Q]
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v)            # [B,Q,H,D]
+    return acc, m, l
+
+
+def ring_attention(query, key, value, mesh: Optional[Mesh] = None,
+                   axis: str = SEQ_AXIS, causal: bool = True,
+                   scale: Optional[float] = None):
+    """Exact attention over a seq-sharded batch via K/V ring rotation.
+
+    query/key/value: GLOBAL logical [B, S, H, D] arrays (sharded over
+    ``axis`` on dim 1 by the caller or by GSPMD).  Returns [B, S, H, D].
+    """
+    from .topology import get_global_mesh
+    mesh = mesh or get_global_mesh()
+    d = query.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    n = mesh.shape[axis]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def inner(q, k, v):
+        # local shards: [B, S/n, H, D]
+        my = jax.lax.axis_index(axis)
+        s_local = q.shape[1]
+        q_pos = my * s_local + jnp.arange(s_local)       # global q positions
+
+        def step(carry, t):
+            k_t, v_t, m_run, l_run, acc = carry
+            kv_rank = (my - t) % n                       # whose shard we hold
+            if causal:
+                k_pos = kv_rank * s_local + jnp.arange(s_local)
+                mask = q_pos[:, None] >= k_pos[None, :]  # [Q, K]
+                mask = mask[None, None, :, :]
+            else:
+                mask = None
+            blk_acc, blk_m, blk_l = _block_attend(q, k_t, v_t, s, mask)
+            new_m = jnp.maximum(m_run, blk_m)
+            alpha = jnp.exp(m_run - new_m)
+            beta = jnp.exp(blk_m - new_m)
+            l_new = l_run * alpha + blk_l * beta
+            acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + \
+                blk_acc * beta.transpose(0, 2, 1)[..., None]
+            k_nxt = jax.lax.ppermute(k_t, axis, perm)
+            v_nxt = jax.lax.ppermute(v_t, axis, perm)
+            return (k_nxt, v_nxt, new_m, l_new, acc_new), None
+
+        b, _, h, dd = q.shape
+        m0 = jnp.full((b, h, s_local), -1e30, jnp.float32)
+        l0 = jnp.zeros((b, h, s_local), jnp.float32)
+        acc0 = jnp.zeros((b, s_local, h, dd), jnp.float32)
+        m0 = _pvary(m0, (axis,))
+        l0 = _pvary(l0, (axis,))
+        acc0 = _pvary(acc0, (axis,))
+        qf = q.astype(jnp.float32)
+        kf = k.astype(jnp.float32)
+        vf = v.astype(jnp.float32)
+        (_, _, m_fin, l_fin, acc_fin), _ = jax.lax.scan(
+            step, (kf, vf, m0, l0, acc0), jnp.arange(n))
+        out = acc_fin / jnp.maximum(
+            l_fin.transpose(0, 2, 1)[..., None], 1e-30)
+        return out.astype(q.dtype)
+
+    spec = PartitionSpec(None, axis, None, None)
+    sm = jax.shard_map(inner, mesh=mesh,
+                       in_specs=(spec, spec, spec),
+                       out_specs=spec,
+                       axis_names={axis})
+    return sm(query, key, value)
+
+
+def ulysses_attention(query, key, value, mesh: Optional[Mesh] = None,
+                      axis: str = SEQ_AXIS, causal: bool = True,
+                      scale: Optional[float] = None):
+    """All-to-all sequence parallelism: constrain seq-sharded -> head-sharded
+    around an exact attention; GSPMD emits the two all-to-alls."""
+    from .topology import get_global_mesh
+    mesh = mesh or get_global_mesh()
+    d = query.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    def constrain(x, spec):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    seq_spec = PartitionSpec(None, axis, None, None)
+    head_spec = PartitionSpec(None, None, axis, None)
+
+    q = constrain(query, head_spec)   # a2a: seq-shard -> head-shard
+    k = constrain(key, head_spec)
+    v = constrain(value, head_spec)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * s
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return constrain(out.astype(query.dtype), seq_spec)  # a2a back

@@ -1,0 +1,4 @@
+from .gate import GShardGate, NaiveGate, SwitchGate, TopKGate
+from .moe_layer import MoELayer
+
+__all__ = ["MoELayer", "NaiveGate", "SwitchGate", "GShardGate", "TopKGate"]

@@ -1,0 +1,117 @@
+"""MoE gates (analogue of incubate/distributed/models/moe/gate/
+{naive_gate,switch_gate,gshard_gate}.py).
+
+Each gate returns (combine_weights [T,E,C], dispatch_mask [T,E,C] bool,
+aux_loss scalar) in the dense GShard formulation — the layout the TPU MoE
+dispatch consumes (one big einsum instead of the reference's
+global_scatter/global_gather all-to-all ops; under an expert-sharded mesh
+GSPMD lowers the einsum to exactly that all-to-all).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....nn.initializer import XavierUniform
+from .....nn.layer.layers import Layer
+from .....nn.layer.common import Linear
+
+
+def _dense_dispatch(gates, top_idx, top_gates, num_experts, capacity):
+    """Build combine/dispatch tensors from top-k assignments.
+
+    gates: [T, E] softmax probs; top_idx/top_gates: [T, k].
+    Position of each token within its expert's capacity buffer = its rank
+    among tokens routed to that expert (cumsum over the token dim).
+    """
+    T, E = gates.shape
+    k = top_idx.shape[1]
+    masks = [jax.nn.one_hot(top_idx[:, s], E, dtype=gates.dtype)
+             for s in range(k)]
+    combine = jnp.zeros((T, E, capacity), gates.dtype)
+    prev_counts = jnp.zeros((E,), gates.dtype)  # tokens already placed per expert
+    for slot in range(k):
+        onehot = masks[slot]
+        g = top_gates[:, slot]
+        # rank of this token within its expert's buffer: tokens routed to the
+        # same expert earlier in the token order + all earlier-slot traffic
+        pos_in_expert = jnp.cumsum(onehot, axis=0) - onehot + prev_counts[None]
+        prev_counts = prev_counts + jnp.sum(onehot, axis=0)
+        pos = jnp.sum(pos_in_expert * onehot, axis=1).astype(jnp.int32)  # [T]
+        keep = pos < capacity
+        pos_oh = jax.nn.one_hot(jnp.where(keep, pos, capacity), capacity + 1,
+                                dtype=gates.dtype)[:, :capacity]  # [T, C]
+        combine = combine + (g * keep)[:, None, None] * \
+            onehot[:, :, None] * pos_oh[:, None, :]
+    dispatch = combine > 0
+    return combine, dispatch
+
+
+class TopKGate(Layer):
+    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=1.25,
+                 weight_attr=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.gate = Linear(d_model, num_experts, weight_attr=weight_attr,
+                           bias_attr=False)
+
+    def capacity(self, num_tokens):
+        cap = int(self.capacity_factor * num_tokens * self.top_k /
+                  self.num_experts)
+        return max(cap, self.top_k)
+
+    def forward(self, x):
+        from .....core.dispatch import dispatch as _dispatch
+        num_experts = self.num_experts
+        top_k = self.top_k
+        capacity = self.capacity(x.shape[0] * (x.shape[1] if x.ndim == 3 else 1))
+
+        def impl(hidden, w):
+            flat = hidden.reshape(-1, hidden.shape[-1])
+            logits = flat @ w
+            gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            top_gates, top_idx = jax.lax.top_k(gates, top_k)
+            # renormalize top-k gate weights
+            top_gates = top_gates / jnp.maximum(
+                jnp.sum(top_gates, -1, keepdims=True), 1e-9)
+            combine, disp = _dense_dispatch(gates, top_idx, top_gates,
+                                            num_experts, capacity)
+            # GShard aux loss: E * sum_e (mean gate_e * mean routed_e)
+            me = jnp.mean(gates, axis=0)
+            ce = jnp.mean(
+                jax.nn.one_hot(top_idx[:, 0], num_experts,
+                               dtype=gates.dtype), axis=0)
+            aux = num_experts * jnp.sum(me * ce)
+            return combine.astype(hidden.dtype), disp, aux.astype(jnp.float32)
+
+        return _dispatch("moe_gate", impl, (x, self.gate.weight),
+                         n_diff_outputs=1)
+
+
+class NaiveGate(TopKGate):
+    """Top-k softmax gate without aux loss emphasis (reference naive_gate)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=1, top_k=2,
+                 **kwargs):
+        super().__init__(d_model, (num_expert or 1) * world_size, top_k)
+
+
+class SwitchGate(TopKGate):
+    """Top-1 switch routing (reference switch_gate)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=1, top_k=1,
+                 capacity_factor=1.25, **kwargs):
+        super().__init__(d_model, (num_expert or 1) * world_size, 1,
+                         capacity_factor)
+
+
+class GShardGate(TopKGate):
+    """Top-2 gating with load-balance loss (reference gshard_gate)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=1, top_k=2,
+                 capacity_factor=2.0, **kwargs):
+        super().__init__(d_model, (num_expert or 1) * world_size, 2,
+                         capacity_factor)

@@ -1,0 +1,99 @@
+"""Pipeline engine: parity with serial execution + gradient flow."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+
+def _mesh_pipe(n):
+    devs = np.array(jax.devices()[:n])
+    return Mesh(devs.reshape(n), ("pipe",))
+
+
+def test_pipeline_matches_serial_forward():
+    from paddle_tpu.distributed.pipeline_engine import (pipeline_apply,
+                                                        stack_stage_params,
+                                                        shard_stacked_params)
+    n_stages, n_micro, b, d = 4, 8, 2, 16
+    rng = np.random.default_rng(0)
+    per_stage = [{"w": jnp.asarray(rng.standard_normal((d, d)) * 0.1,
+                                   jnp.float32)}
+                 for _ in range(n_stages)]
+
+    def stage_fn(params, x):
+        return jnp.tanh(x @ params["w"])
+
+    mesh = _mesh_pipe(4)
+    stacked = shard_stacked_params(stack_stage_params(per_stage), mesh)
+    xs = jnp.asarray(rng.standard_normal((n_micro, b, d)), jnp.float32)
+
+    ys = jax.jit(lambda p, x: pipeline_apply(stage_fn, p, x, n_stages, mesh))(
+        stacked, xs)
+
+    # serial reference
+    ref = xs
+    for sp in per_stage:
+        ref = jnp.tanh(ref @ sp["w"])
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(ref), atol=1e-5)
+
+
+def test_pipeline_gradients_match_serial():
+    from paddle_tpu.distributed.pipeline_engine import (pipeline_apply,
+                                                        stack_stage_params,
+                                                        shard_stacked_params)
+    n_stages, n_micro, b, d = 2, 4, 2, 8
+    rng = np.random.default_rng(1)
+    per_stage = [{"w": jnp.asarray(rng.standard_normal((d, d)) * 0.1,
+                                   jnp.float32)}
+                 for _ in range(n_stages)]
+
+    def stage_fn(params, x):
+        return jnp.tanh(x @ params["w"])
+
+    mesh = _mesh_pipe(2)
+    stacked = stack_stage_params(per_stage)
+    xs = jnp.asarray(rng.standard_normal((n_micro, b, d)), jnp.float32)
+
+    def pp_loss(p, x):
+        ys = pipeline_apply(stage_fn, p, x, n_stages, mesh)
+        return jnp.mean(jnp.square(ys))
+
+    def serial_loss(p, x):
+        out = x
+        for s in range(n_stages):
+            sp = jax.tree_util.tree_map(lambda l: l[s], p)
+            out = jnp.tanh(out @ sp["w"])
+        return jnp.mean(jnp.square(out))
+
+    g_pp = jax.jit(jax.grad(pp_loss))(stacked, xs)
+    g_ref = jax.grad(serial_loss)(stacked, xs)
+    np.testing.assert_allclose(np.asarray(g_pp["w"]), np.asarray(g_ref["w"]),
+                               atol=1e-5)
+
+
+def test_pipeline_with_data_axis():
+    """pipe manual + data auto (GSPMD) compose in one program."""
+    from paddle_tpu.distributed.pipeline_engine import (pipeline_apply,
+                                                        stack_stage_params)
+    n_stages, n_micro, b, d = 2, 4, 8, 8
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("pipe", "data"))
+    rng = np.random.default_rng(2)
+    per_stage = [{"w": jnp.asarray(rng.standard_normal((d, d)) * 0.1,
+                                   jnp.float32)} for _ in range(n_stages)]
+
+    def stage_fn(params, x):
+        return jnp.tanh(x @ params["w"])
+
+    stacked = stack_stage_params(per_stage)
+    xs = jnp.asarray(rng.standard_normal((n_micro, b, d)), jnp.float32)
+
+    ys = jax.jit(lambda p, x: pipeline_apply(stage_fn, p, x, n_stages, mesh))(
+        stacked, xs)
+    ref = xs
+    for sp in per_stage:
+        ref = jnp.tanh(ref @ sp["w"])
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(ref), atol=1e-5)
