@@ -38,12 +38,8 @@ PIPE_AXIS = "pipe"
 
 
 
-def _pvary(x, axes):
-    """Mark x as varying over manual mesh axes (pcast on new jax, pvary on old)."""
-    try:
-        return jax.lax.pcast(x, axes, to="varying")
-    except (AttributeError, TypeError):
-        return jax.lax.pvary(x, axes)
+from .topology import pvary as _pvary
+
 
 def stack_stage_params(per_stage_params):
     """[pytree per stage] -> single pytree with a leading stage dim."""

@@ -29,23 +29,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 SEQ_AXIS = "sep"
 
 
-
-def _pvary(x, axes):
-    """Mark x as varying over manual mesh axes (pcast on new jax, pvary on old)."""
-    try:
-        return jax.lax.pcast(x, axes, to="varying")
-    except (AttributeError, TypeError):
-        return jax.lax.pvary(x, axes)
-
 def _block_attend(q, k, v, scale, mask):
-    """One block: returns (unnormalized acc, running max m, running sum l)."""
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    """One block: returns (unnormalized acc, running max m, running sum l).
+
+    q is f32; k/v arrive in the ring dtype (e.g. bf16) and are promoted to
+    f32 only here, so the ppermute hops move half the bytes while the
+    softmax accumulation stays full precision.
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     if mask is not None:
         logits = jnp.where(mask, logits, -1e30)
     m = jnp.max(logits, axis=-1)                         # [B,H,Q]
     p = jnp.exp(logits - m[..., None])
     l = jnp.sum(p, axis=-1)                              # [B,H,Q]
-    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v)            # [B,Q,H,D]
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                     preferred_element_type=jnp.float32)  # [B,Q,H,D]
     return acc, m, l
 
 
@@ -79,7 +78,7 @@ def ring_attention(query, key, value, mesh: Optional[Mesh] = None,
                 mask = mask[None, None, :, :]
             else:
                 mask = None
-            blk_acc, blk_m, blk_l = _block_attend(q, k_t, v_t, s, mask)
+            blk_acc, blk_m, blk_l = _block_attend(qf, k_t, v_t, s, mask)
             new_m = jnp.maximum(m_run, blk_m)
             alpha = jnp.exp(m_run - new_m)
             beta = jnp.exp(blk_m - new_m)
@@ -90,6 +89,7 @@ def ring_attention(query, key, value, mesh: Optional[Mesh] = None,
             v_nxt = jax.lax.ppermute(v_t, axis, perm)
             return (k_nxt, v_nxt, new_m, l_new, acc_new), None
 
+        from .topology import pvary as _pvary
         b, _, h, dd = q.shape
         m0 = jnp.full((b, h, s_local), -1e30, jnp.float32)
         l0 = jnp.zeros((b, h, s_local), jnp.float32)
@@ -97,11 +97,9 @@ def ring_attention(query, key, value, mesh: Optional[Mesh] = None,
         m0 = _pvary(m0, (axis,))
         l0 = _pvary(l0, (axis,))
         acc0 = _pvary(acc0, (axis,))
-        qf = q.astype(jnp.float32)
-        kf = k.astype(jnp.float32)
-        vf = v.astype(jnp.float32)
+        qf = q.astype(jnp.float32)  # q never rotates; promote once
         (_, _, m_fin, l_fin, acc_fin), _ = jax.lax.scan(
-            step, (kf, vf, m0, l0, acc0), jnp.arange(n))
+            step, (k, v, m0, l0, acc0), jnp.arange(n))
         out = acc_fin / jnp.maximum(
             l_fin.transpose(0, 2, 1)[..., None], 1e-30)
         return out.astype(q.dtype)

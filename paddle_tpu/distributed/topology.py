@@ -45,6 +45,15 @@ def get_global_mesh() -> Optional[Mesh]:
     return _global_mesh
 
 
+def pvary(x, axes):
+    """Mark x as varying over manual mesh axes (pcast on new jax, pvary on
+    old); shared by the shard_map-based engines (pipeline, ring attention)."""
+    try:
+        return jax.lax.pcast(x, axes, to="varying")
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(x, axes)
+
+
 class CommunicateTopology:
     """Rank <-> coordinate arithmetic (reference CommunicateTopology:60)."""
 

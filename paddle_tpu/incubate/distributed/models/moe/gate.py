@@ -13,7 +13,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .....nn.initializer import XavierUniform
 from .....nn.layer.layers import Layer
 from .....nn.layer.common import Linear
 
@@ -79,31 +78,39 @@ class TopKGate(Layer):
                 jnp.sum(top_gates, -1, keepdims=True), 1e-9)
             combine, disp = _dense_dispatch(gates, top_idx, top_gates,
                                             num_experts, capacity)
-            # GShard aux loss: E * sum_e (mean gate_e * mean routed_e)
+            # GShard aux loss: E * sum_e (mean gate_e * mean routed_e).
+            # me is differentiable through softmax; ce (routing counts) is a
+            # constant of the argmax. Returned in slot 1 so the tape keeps it
+            # attached (slot order: differentiable outputs first).
             me = jnp.mean(gates, axis=0)
             ce = jnp.mean(
                 jax.nn.one_hot(top_idx[:, 0], num_experts,
                                dtype=gates.dtype), axis=0)
             aux = num_experts * jnp.sum(me * ce)
-            return combine.astype(hidden.dtype), disp, aux.astype(jnp.float32)
+            return combine.astype(hidden.dtype), aux.astype(jnp.float32), disp
 
-        return _dispatch("moe_gate", impl, (x, self.gate.weight),
-                         n_diff_outputs=1)
+        combine, aux, disp = _dispatch("moe_gate", impl,
+                                       (x, self.gate.weight),
+                                       n_diff_outputs=2)
+        return combine, disp, aux
 
 
 class NaiveGate(TopKGate):
     """Top-k softmax gate without aux loss emphasis (reference naive_gate)."""
 
     def __init__(self, d_model, num_expert=None, world_size=1, top_k=2,
-                 **kwargs):
-        super().__init__(d_model, (num_expert or 1) * world_size, top_k)
+                 capacity_factor=1.25):
+        super().__init__(d_model, (num_expert or 1) * world_size, top_k,
+                         capacity_factor)
 
 
 class SwitchGate(TopKGate):
     """Top-1 switch routing (reference switch_gate)."""
 
     def __init__(self, d_model, num_expert=None, world_size=1, top_k=1,
-                 capacity_factor=1.25, **kwargs):
+                 capacity_factor=1.25):
+        if top_k != 1:
+            raise ValueError("SwitchGate routes top-1 by definition")
         super().__init__(d_model, (num_expert or 1) * world_size, 1,
                          capacity_factor)
 
@@ -112,6 +119,6 @@ class GShardGate(TopKGate):
     """Top-2 gating with load-balance loss (reference gshard_gate)."""
 
     def __init__(self, d_model, num_expert=None, world_size=1, top_k=2,
-                 capacity_factor=2.0, **kwargs):
-        super().__init__(d_model, (num_expert or 1) * world_size, 2,
+                 capacity_factor=2.0):
+        super().__init__(d_model, (num_expert or 1) * world_size, top_k,
                          capacity_factor)

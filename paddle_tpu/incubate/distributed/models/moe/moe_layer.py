@@ -11,17 +11,15 @@ by hand — one definition serves 1 chip and an EP-sharded pod.
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .....core.dispatch import dispatch as _dispatch
-from .....core.tensor import Tensor
 from .....nn.layer.layers import Layer
-from ....nn.functional import swiglu  # noqa: F401  (re-export convenience)
-from .gate import NaiveGate, TopKGate
+from .gate import TopKGate
 
 
 class MoELayer(Layer):

@@ -118,40 +118,64 @@ class DataLoader:
             yield _to_tensor(self._fetch(indices))
 
     def _iter_workers(self):
-        out_q: "queue.Queue" = queue.Queue(
-            maxsize=self.prefetch_factor * self.num_workers)
+        # Native prefetch pipeline: C++ BlockingQueue bounds the in-flight
+        # batches (≙ LoDTensorBlockingQueue feeding the buffered reader) and
+        # a C++ WorkQueue thread pool runs the fetch+collate tasks
+        # (≙ new_executor workqueue). Waits happen in native code with the
+        # GIL released; numpy collation overlaps across workers.
+        from .. import runtime as rt
+
+        out_q = rt.BlockingQueue(self.prefetch_factor * self.num_workers)
         idx_q: "queue.Queue" = queue.Queue()
         batches = list(self.batch_sampler)
         for i, b in enumerate(batches):
             idx_q.put((i, b))
         n_batches = len(batches)
-        stop = threading.Event()
+        pool = rt.WorkQueue(self.num_workers)
 
         def worker(wid):
-            _worker_info.info = WorkerInfo(wid, self.num_workers, self.dataset)
-            if self.worker_init_fn is not None:
-                self.worker_init_fn(wid)
-            while not stop.is_set():
+            # every failure mode (init fn, fetch, collate) is surfaced to the
+            # consumer through the queue so the iterator never hangs silently
+            try:
+                _worker_info.info = WorkerInfo(wid, self.num_workers,
+                                               self.dataset)
+                if self.worker_init_fn is not None:
+                    self.worker_init_fn(wid)
+            except Exception as e:
+                try:
+                    i, _ = idx_q.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    out_q.push((i, e))
+                except rt.QueueClosed:
+                    pass
+                return
+            while not out_q.closed:
                 try:
                     i, indices = idx_q.get_nowait()
                 except queue.Empty:
                     return
                 try:
-                    out_q.put((i, self._fetch(indices)))
+                    item = (i, self._fetch(indices))
                 except Exception as e:  # surface worker errors to the consumer
-                    out_q.put((i, e))
+                    item = (i, e)
+                try:
+                    out_q.push(item)
+                except rt.QueueClosed:
+                    return
 
-        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
-                   for w in range(self.num_workers)]
-        for t in threads:
-            t.start()
+        for w in range(self.num_workers):
+            pool.submit(lambda w=w: worker(w))
         try:
             # reorder to preserve batch order
             pending = {}
             next_idx = 0
             received = 0
             while received < n_batches:
-                i, data = out_q.get(timeout=self.timeout)
+                i, data = out_q.pop(timeout=self.timeout)
+                if rt.HostTracer.is_enabled():
+                    rt.HostTracer.counter("dataloader_queue_depth", out_q.size())
                 received += 1
                 pending[i] = data
                 while next_idx in pending:
@@ -161,7 +185,8 @@ class DataLoader:
                         raise item
                     yield _to_tensor(item)
         finally:
-            stop.set()
+            out_q.close()
+            pool.shutdown()
 
     def __iter__(self):
         if self._iterable:

@@ -1,0 +1,46 @@
+"""paddle_tpu.runtime — native host runtime services.
+
+C++ components (compiled on demand, ctypes-bound; see native/ptpu_runtime.h
+for the reference mapping):
+
+- BlockingQueue: DataLoader prefetch queue (≙ LoDTensorBlockingQueue)
+- TCPStore / TCPStoreServer: KV rendezvous (≙ phi TCPStore)
+- HostTracer: host profiling events + chrome trace (≙ host_event_recorder)
+- stat_*: named current/peak counters (≙ paddle/fluid/memory/stats.h)
+- WorkQueue: thread-pool task runner (≙ new_executor workqueue)
+
+Set PTPU_DISABLE_NATIVE=1 to force the pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import os
+
+NATIVE_AVAILABLE = False
+
+if os.environ.get("PTPU_DISABLE_NATIVE") != "1":
+    try:
+        from .native_bindings import (  # noqa: F401
+            BlockingQueue, QueueClosed, TCPStore, TCPStoreServer, HostTracer,
+            WorkQueue, now_ns, stat_update, stat_current, stat_peak,
+            stat_reset, stat_names,
+        )
+        NATIVE_AVAILABLE = True
+    except Exception as _e:  # pragma: no cover - toolchain missing
+        import warnings
+
+        warnings.warn(f"paddle_tpu native runtime unavailable ({_e}); "
+                      "using pure-Python fallback")
+
+if not NATIVE_AVAILABLE:
+    from ._fallback import (  # noqa: F401
+        BlockingQueue, QueueClosed, TCPStore, TCPStoreServer, HostTracer,
+        WorkQueue, now_ns, stat_update, stat_current, stat_peak,
+        stat_reset, stat_names,
+    )
+
+__all__ = [
+    "BlockingQueue", "QueueClosed", "TCPStore", "TCPStoreServer",
+    "HostTracer", "WorkQueue", "now_ns", "stat_update", "stat_current",
+    "stat_peak", "stat_reset", "stat_names", "NATIVE_AVAILABLE",
+]

@@ -1,0 +1,60 @@
+"""On-demand build of the native runtime shared library.
+
+Compiles ``paddle_tpu/runtime/native/*.cc`` into a cached ``.so`` with g++
+(the image has no pybind11; bindings are ctypes over the extern "C" surface
+declared in ``ptpu_runtime.h``). The build is keyed by a hash of the sources
+so edits trigger exactly one rebuild; concurrent builders (pytest-xdist,
+multi-process launch) race benignly via an atomic rename.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import tempfile
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), "_cache")
+
+
+def _sources():
+    return sorted(
+        os.path.join(_NATIVE_DIR, f)
+        for f in os.listdir(_NATIVE_DIR)
+        if f.endswith(".cc")
+    )
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for path in _sources() + [os.path.join(_NATIVE_DIR, "ptpu_runtime.h")]:
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def build_native(verbose: bool = False) -> str:
+    """Return the path to the built shared library, compiling if needed."""
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    so_path = os.path.join(_CACHE_DIR, f"libptpu_runtime_{_source_hash()}.so")
+    if os.path.exists(so_path):
+        return so_path
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_CACHE_DIR)
+    os.close(fd)
+    cmd = [
+        "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+        "-Wall", f"-I{_NATIVE_DIR}", *_sources(), "-o", tmp,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native runtime build failed:\n{proc.stderr[-4000:]}")
+        os.replace(tmp, so_path)  # atomic: concurrent builds converge
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    if verbose:
+        print(f"[paddle_tpu] built native runtime -> {so_path}")
+    return so_path

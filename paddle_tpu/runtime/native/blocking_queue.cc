@@ -1,0 +1,133 @@
+// Bounded MPMC blocking queue with close semantics.
+//
+// Serves the DataLoader prefetch pipeline the way the reference's
+// LoDTensorBlockingQueue (paddle/fluid/operators/reader/
+// lod_tensor_blocking_queue.h:30, blocking_queue.h:28) feeds its buffered
+// reader: producers block when full, consumers block when empty, and
+// close() wakes everyone so shutdown never deadlocks. Payloads are opaque
+// uint64 tokens — the Python side maps tokens to batch objects, so the
+// queue itself never touches the GIL (ctypes releases it around calls,
+// letting waits overlap with Python-side work).
+
+#include "ptpu_runtime.h"
+
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <unordered_map>
+
+namespace {
+
+struct BlockingQueue {
+  explicit BlockingQueue(int64_t cap) : capacity(cap) {}
+  int64_t capacity;
+  std::deque<uint64_t> items;
+  bool closed = false;
+  std::mutex mu;
+  std::condition_variable not_full;
+  std::condition_variable not_empty;
+};
+
+std::mutex g_reg_mu;
+std::unordered_map<int64_t, std::shared_ptr<BlockingQueue>> g_queues;
+int64_t g_next_id = 1;
+
+std::shared_ptr<BlockingQueue> get(int64_t h) {
+  std::lock_guard<std::mutex> l(g_reg_mu);
+  auto it = g_queues.find(h);
+  return it == g_queues.end() ? nullptr : it->second;
+}
+
+bool wait_on(std::condition_variable& cv, std::unique_lock<std::mutex>& l,
+             double timeout_s, const std::function<bool()>& pred) {
+  if (timeout_s < 0) {
+    cv.wait(l, pred);
+    return true;
+  }
+  return cv.wait_for(l, std::chrono::duration<double>(timeout_s), pred);
+}
+
+}  // namespace
+
+extern "C" {
+
+uint64_t ptpu_now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+int64_t ptpu_bq_create(int64_t capacity) {
+  if (capacity <= 0) capacity = 1;
+  std::lock_guard<std::mutex> l(g_reg_mu);
+  int64_t id = g_next_id++;
+  g_queues[id] = std::make_shared<BlockingQueue>(capacity);
+  return id;
+}
+
+int ptpu_bq_push(int64_t h, uint64_t value, double timeout_s) {
+  auto q = get(h);
+  if (!q) return PTPU_ERR;
+  std::unique_lock<std::mutex> l(q->mu);
+  bool ok = wait_on(q->not_full, l, timeout_s, [&] {
+    return q->closed || (int64_t)q->items.size() < q->capacity;
+  });
+  if (!ok) return PTPU_TIMEOUT;
+  if (q->closed) return PTPU_CLOSED;
+  q->items.push_back(value);
+  q->not_empty.notify_one();
+  return PTPU_OK;
+}
+
+int ptpu_bq_pop(int64_t h, uint64_t* out, double timeout_s) {
+  auto q = get(h);
+  if (!q) return PTPU_ERR;
+  std::unique_lock<std::mutex> l(q->mu);
+  bool ok = wait_on(q->not_empty, l, timeout_s,
+                    [&] { return q->closed || !q->items.empty(); });
+  if (!ok) return PTPU_TIMEOUT;
+  if (q->items.empty()) return PTPU_CLOSED;  // closed and drained
+  *out = q->items.front();
+  q->items.pop_front();
+  q->not_full.notify_one();
+  return PTPU_OK;
+}
+
+int64_t ptpu_bq_size(int64_t h) {
+  auto q = get(h);
+  if (!q) return -1;
+  std::lock_guard<std::mutex> l(q->mu);
+  return (int64_t)q->items.size();
+}
+
+int64_t ptpu_bq_capacity(int64_t h) {
+  auto q = get(h);
+  return q ? q->capacity : -1;
+}
+
+void ptpu_bq_close(int64_t h) {
+  auto q = get(h);
+  if (!q) return;
+  std::lock_guard<std::mutex> l(q->mu);
+  q->closed = true;
+  q->not_full.notify_all();
+  q->not_empty.notify_all();
+}
+
+int ptpu_bq_is_closed(int64_t h) {
+  auto q = get(h);
+  if (!q) return 1;
+  std::lock_guard<std::mutex> l(q->mu);
+  return q->closed ? 1 : 0;
+}
+
+void ptpu_bq_destroy(int64_t h) {
+  ptpu_bq_close(h);
+  std::lock_guard<std::mutex> l(g_reg_mu);
+  g_queues.erase(h);
+}
+
+}  // extern "C"

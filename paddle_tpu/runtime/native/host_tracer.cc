@@ -1,0 +1,234 @@
+// Host event tracer: per-thread event buffers + chrome-trace export.
+//
+// TPU-native analogue of the reference's host profiling layer
+// (paddle/fluid/platform/profiler/host_tracer.cc + host_event_recorder.h:
+// TLS ring buffers of RecordEvent ranges merged at export;
+// chrometracing_logger.cc writes the chrome://tracing JSON). Device-side
+// events come from XLA's own profiler; this records the host side
+// (dataloader, dispatch, python ranges) with nanosecond steady-clock
+// timestamps and near-zero overhead when disabled (one relaxed atomic
+// load on the hot path).
+
+#include "ptpu_runtime.h"
+
+#include <algorithm>
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Kind : uint8_t { kRange = 0, kInstant = 1, kCounter = 2 };
+
+struct Event {
+  Kind kind;
+  uint64_t t0;
+  uint64_t t1;
+  int64_t value;
+  std::string name;
+};
+
+struct ThreadBuffer {
+  int64_t tid;
+  std::vector<Event> events;
+  std::vector<std::pair<std::string, uint64_t>> open;  // begin() stack
+  std::mutex mu;  // export/clear vs. owning thread
+};
+
+struct RetiredEvent {
+  int64_t tid;
+  Event event;
+};
+
+std::atomic<bool> g_enabled{false};
+std::mutex g_bufs_mu;
+std::vector<ThreadBuffer*> g_bufs;
+std::vector<RetiredEvent> g_retired;  // events of exited threads
+std::atomic<int64_t> g_next_tid{1};
+
+// TLS holder: on thread exit, move the buffer's events into g_retired and
+// free it, so short-lived worker threads (dataloader pools are re-created
+// per epoch) don't grow g_bufs without bound while their profile data
+// still survives until export/clear.
+struct TlsHolder {
+  ThreadBuffer* buf;
+  explicit TlsHolder() {
+    buf = new ThreadBuffer();
+    buf->tid = g_next_tid.fetch_add(1);
+    std::lock_guard<std::mutex> l(g_bufs_mu);
+    g_bufs.push_back(buf);
+  }
+  ~TlsHolder() {
+    std::lock_guard<std::mutex> l(g_bufs_mu);
+    for (auto& e : buf->events) g_retired.push_back({buf->tid, std::move(e)});
+    g_bufs.erase(std::remove(g_bufs.begin(), g_bufs.end(), buf), g_bufs.end());
+    delete buf;
+  }
+};
+
+ThreadBuffer* tls_buffer() {
+  thread_local TlsHolder holder;
+  return holder.buf;
+}
+
+void json_escape(std::string* out, const std::string& s) {
+  for (char c : s) {
+    if (c == '"' || c == '\\') {
+      out->push_back('\\');
+      out->push_back(c);
+    } else if ((unsigned char)c < 0x20) {
+      char tmp[8];
+      snprintf(tmp, sizeof(tmp), "\\u%04x", c);
+      *out += tmp;
+    } else {
+      out->push_back(c);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void ptpu_trace_enable() { g_enabled.store(true); }
+void ptpu_trace_disable() { g_enabled.store(false); }
+int ptpu_trace_is_enabled() { return g_enabled.load() ? 1 : 0; }
+
+void ptpu_trace_begin(const char* name) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  auto* b = tls_buffer();
+  std::lock_guard<std::mutex> l(b->mu);
+  b->open.emplace_back(name, ptpu_now_ns());
+}
+
+void ptpu_trace_end() {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  auto* b = tls_buffer();
+  std::lock_guard<std::mutex> l(b->mu);
+  if (b->open.empty()) return;
+  auto [name, t0] = b->open.back();
+  b->open.pop_back();
+  b->events.push_back({kRange, t0, ptpu_now_ns(), 0, std::move(name)});
+}
+
+void ptpu_trace_instant(const char* name) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  auto* b = tls_buffer();
+  std::lock_guard<std::mutex> l(b->mu);
+  uint64_t t = ptpu_now_ns();
+  b->events.push_back({kInstant, t, t, 0, name});
+}
+
+void ptpu_trace_counter(const char* name, int64_t value) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  auto* b = tls_buffer();
+  std::lock_guard<std::mutex> l(b->mu);
+  uint64_t t = ptpu_now_ns();
+  b->events.push_back({kCounter, t, t, value, name});
+}
+
+int64_t ptpu_trace_count() {
+  std::lock_guard<std::mutex> lr(g_bufs_mu);
+  int64_t n = (int64_t)g_retired.size();
+  for (auto* b : g_bufs) {
+    std::lock_guard<std::mutex> l(b->mu);
+    n += (int64_t)b->events.size();
+  }
+  return n;
+}
+
+void ptpu_trace_clear() {
+  std::lock_guard<std::mutex> lr(g_bufs_mu);
+  g_retired.clear();
+  for (auto* b : g_bufs) {
+    std::lock_guard<std::mutex> l(b->mu);
+    b->events.clear();
+    b->open.clear();
+  }
+}
+
+void write_event_json(FILE* f, bool* first, int64_t tid, const Event& e) {
+  std::string name;
+  json_escape(&name, e.name);
+  double us0 = e.t0 / 1000.0;
+  if (!*first) fputs(",\n", f);
+  *first = false;
+  if (e.kind == kRange) {
+    fprintf(f,
+            "{\"name\":\"%s\",\"ph\":\"X\",\"pid\":0,\"tid\":%lld,"
+            "\"ts\":%.3f,\"dur\":%.3f}",
+            name.c_str(), (long long)tid, us0, (e.t1 - e.t0) / 1000.0);
+  } else if (e.kind == kInstant) {
+    fprintf(f,
+            "{\"name\":\"%s\",\"ph\":\"i\",\"pid\":0,\"tid\":%lld,"
+            "\"ts\":%.3f,\"s\":\"t\"}",
+            name.c_str(), (long long)tid, us0);
+  } else {
+    fprintf(f,
+            "{\"name\":\"%s\",\"ph\":\"C\",\"pid\":0,\"tid\":%lld,"
+            "\"ts\":%.3f,\"args\":{\"value\":%lld}}",
+            name.c_str(), (long long)tid, us0, (long long)e.value);
+  }
+}
+
+int ptpu_trace_export(const char* path) {
+  FILE* f = fopen(path, "w");
+  if (!f) return PTPU_ERR;
+  fputs("{\"traceEvents\":[\n", f);
+  bool first = true;
+  std::lock_guard<std::mutex> lr(g_bufs_mu);
+  for (const auto& r : g_retired) write_event_json(f, &first, r.tid, r.event);
+  for (auto* b : g_bufs) {
+    std::lock_guard<std::mutex> l(b->mu);
+    for (const auto& e : b->events) write_event_json(f, &first, b->tid, e);
+  }
+  fputs("\n]}\n", f);
+  fclose(f);
+  return PTPU_OK;
+}
+
+namespace {
+// Appends one record if it fits. Returns false (and leaves *off untouched)
+// when the buffer is exhausted, so a partial dump never contains a torn or
+// phantom record — the return value of ptpu_trace_dump is exactly the
+// number of valid bytes written (or needed, when buf is null).
+bool dump_one(uint8_t* buf, int64_t buflen, int64_t* off, int64_t tid,
+              const Event& e) {
+  uint32_t namelen = (uint32_t)e.name.size();
+  int64_t rec = 1 + 8 + 8 + 8 + 8 + 4 + namelen;
+  if (buf) {
+    if (*off + rec > buflen) return false;
+    uint8_t* p = buf + *off;
+    *p++ = (uint8_t)e.kind;
+    memcpy(p, &e.t0, 8); p += 8;
+    memcpy(p, &e.t1, 8); p += 8;
+    memcpy(p, &tid, 8); p += 8;
+    memcpy(p, &e.value, 8); p += 8;
+    memcpy(p, &namelen, 4); p += 4;
+    memcpy(p, e.name.data(), namelen);
+  }
+  *off += rec;
+  return true;
+}
+}  // namespace
+
+int64_t ptpu_trace_dump(uint8_t* buf, int64_t buflen) {
+  int64_t off = 0;
+  std::lock_guard<std::mutex> lr(g_bufs_mu);
+  for (const auto& r : g_retired) {
+    if (!dump_one(buf, buflen, &off, r.tid, r.event)) return off;
+  }
+  for (auto* b : g_bufs) {
+    std::lock_guard<std::mutex> l(b->mu);
+    for (const auto& e : b->events) {
+      if (!dump_one(buf, buflen, &off, b->tid, e)) return off;
+    }
+  }
+  return off;
+}
+
+}  // extern "C"

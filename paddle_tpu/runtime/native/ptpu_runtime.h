@@ -1,0 +1,92 @@
+// C API of the paddle_tpu native host runtime.
+//
+// TPU-native analogue of the reference's C++ runtime services:
+//   blocking queue  <- paddle/fluid/operators/reader/lod_tensor_blocking_queue.h:30
+//   TCP store       <- paddle/phi/core/distributed/store/tcp_store.h:120
+//   host tracer     <- paddle/fluid/platform/profiler/host_event_recorder.h
+//   stat registry   <- paddle/fluid/memory/stats.h
+//   work queue      <- paddle/fluid/framework/new_executor/workqueue/nonblocking_threadpool.h
+//
+// Everything is exposed as a flat extern "C" surface so Python binds via
+// ctypes (no pybind11 in this image). Handles are opaque int64 ids.
+
+#ifndef PTPU_RUNTIME_H_
+#define PTPU_RUNTIME_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#if defined(__cplusplus)
+extern "C" {
+#endif
+
+#define PTPU_OK 0
+#define PTPU_TIMEOUT 1
+#define PTPU_CLOSED 2
+#define PTPU_ERR 3
+
+// ---- clock ----
+uint64_t ptpu_now_ns();
+
+// ---- blocking queue (bounded MPMC, uint64 payload tokens) ----
+int64_t ptpu_bq_create(int64_t capacity);
+int ptpu_bq_push(int64_t h, uint64_t value, double timeout_s);
+int ptpu_bq_pop(int64_t h, uint64_t* out, double timeout_s);
+int64_t ptpu_bq_size(int64_t h);
+int64_t ptpu_bq_capacity(int64_t h);
+void ptpu_bq_close(int64_t h);   // wake all waiters; pops drain, pushes fail
+int ptpu_bq_is_closed(int64_t h);
+void ptpu_bq_destroy(int64_t h);
+
+// ---- TCP store (KV rendezvous) ----
+// Server: start/stop a listener owning the map. Client: connect to one.
+// get() blocks server-side until the key exists (or timeout).
+int64_t ptpu_store_server_start(int port);          // handle or -1
+int ptpu_store_server_port(int64_t h);
+void ptpu_store_server_stop(int64_t h);
+int64_t ptpu_store_client_create(const char* host, int port, double timeout_s);
+void ptpu_store_client_destroy(int64_t h);
+int ptpu_store_set(int64_t h, const char* key, const uint8_t* val, int64_t len);
+// returns value length (copied into buf up to buflen), -1 timeout, -2 error
+int64_t ptpu_store_get(int64_t h, const char* key, uint8_t* buf,
+                       int64_t buflen, double timeout_s);
+int64_t ptpu_store_add(int64_t h, const char* key, int64_t delta);  // new value
+int ptpu_store_wait(int64_t h, const char* key, double timeout_s);
+
+// ---- host tracer ----
+void ptpu_trace_enable();
+void ptpu_trace_disable();
+int ptpu_trace_is_enabled();
+void ptpu_trace_begin(const char* name);   // push TLS range
+void ptpu_trace_end();                     // pop TLS range -> event
+void ptpu_trace_instant(const char* name);
+void ptpu_trace_counter(const char* name, int64_t value);
+int64_t ptpu_trace_count();
+void ptpu_trace_clear();
+// Export all recorded events as a chrome://tracing JSON file.
+int ptpu_trace_export(const char* path);
+// Copy a compact binary dump (for Python-side summaries):
+// repeated records {u8 kind; u64 t0; u64 t1; i64 tid; i64 value; u32 namelen; name}
+int64_t ptpu_trace_dump(uint8_t* buf, int64_t buflen);
+
+// ---- stat registry ----
+void ptpu_stat_update(const char* name, int64_t delta);
+int64_t ptpu_stat_current(const char* name);
+int64_t ptpu_stat_peak(const char* name);
+void ptpu_stat_reset(const char* name);
+// newline-joined names; returns needed length
+int64_t ptpu_stat_names(char* buf, int64_t buflen);
+
+// ---- work queue (thread pool) ----
+typedef void (*ptpu_task_fn)(void* arg);
+int64_t ptpu_wq_create(int num_threads);
+int ptpu_wq_submit(int64_t h, ptpu_task_fn fn, void* arg);
+void ptpu_wq_wait_idle(int64_t h);
+int64_t ptpu_wq_pending(int64_t h);
+void ptpu_wq_destroy(int64_t h);
+
+#if defined(__cplusplus)
+}  // extern "C"
+#endif
+
+#endif  // PTPU_RUNTIME_H_

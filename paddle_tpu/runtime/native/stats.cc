@@ -1,0 +1,68 @@
+// Named stat registry: current + peak counters with atomic updates.
+//
+// TPU-native analogue of the reference's memory stat system
+// (paddle/fluid/memory/stats.h — DeviceMemoryStatCurrentValue /
+// HostMemoryStatUpdate): framework subsystems bump named counters
+// ("host_queue_bytes", "pinned_pool_bytes", ...) and Python reads them
+// via paddle_tpu.device.stats. Device HBM numbers come from
+// jax's memory_stats(); this covers the host runtime side.
+
+#include "ptpu_runtime.h"
+
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct Stat {
+  int64_t current = 0;
+  int64_t peak = 0;
+};
+
+std::mutex g_mu;
+std::map<std::string, Stat> g_stats;
+
+}  // namespace
+
+extern "C" {
+
+void ptpu_stat_update(const char* name, int64_t delta) {
+  std::lock_guard<std::mutex> l(g_mu);
+  Stat& s = g_stats[name];
+  s.current += delta;
+  if (s.current > s.peak) s.peak = s.current;
+}
+
+int64_t ptpu_stat_current(const char* name) {
+  std::lock_guard<std::mutex> l(g_mu);
+  auto it = g_stats.find(name);
+  return it == g_stats.end() ? 0 : it->second.current;
+}
+
+int64_t ptpu_stat_peak(const char* name) {
+  std::lock_guard<std::mutex> l(g_mu);
+  auto it = g_stats.find(name);
+  return it == g_stats.end() ? 0 : it->second.peak;
+}
+
+void ptpu_stat_reset(const char* name) {
+  std::lock_guard<std::mutex> l(g_mu);
+  g_stats.erase(name);
+}
+
+int64_t ptpu_stat_names(char* buf, int64_t buflen) {
+  std::lock_guard<std::mutex> l(g_mu);
+  std::string joined;
+  for (const auto& kv : g_stats) {
+    if (!joined.empty()) joined.push_back('\n');
+    joined += kv.first;
+  }
+  if (buf && (int64_t)joined.size() < buflen) {
+    memcpy(buf, joined.c_str(), joined.size() + 1);
+  }
+  return (int64_t)joined.size();
+}
+
+}  // extern "C"

@@ -1,0 +1,341 @@
+// TCPStore: key-value rendezvous over TCP sockets.
+//
+// TPU-native equivalent of the reference's bootstrap store
+// (paddle/phi/core/distributed/store/tcp_store.h:120, tcp_utils.cc):
+// one rank runs the master (server thread + per-connection handler
+// threads over a mutex-guarded map with a condvar for blocking gets);
+// every rank connects a client. Used by paddle_tpu.distributed for
+// process-group bootstrap and barriers on multi-host CPU/TPU pods where
+// jax.distributed is not already managing coordination.
+//
+// Wire protocol (little-endian):
+//   request:  u8 op | u32 keylen | key bytes | u64 arg | payload
+//     op=1 SET   arg=vallen, payload=value
+//     op=2 GET   arg=timeout_ms (blocks until key exists)
+//     op=3 ADD   arg=(i64)delta
+//     op=4 WAIT  arg=timeout_ms
+//   response: i64 status_or_len | payload
+//     SET -> 0 | GET -> len,value or -1 timeout | ADD -> new value
+//     WAIT -> 0 or -1
+
+#include "ptpu_runtime.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+bool send_all(int fd, const void* data, size_t len) {
+  const char* p = (const char*)data;
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    p += n;
+    len -= n;
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* data, size_t len) {
+  char* p = (char*)data;
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) return false;
+    p += n;
+    len -= n;
+  }
+  return true;
+}
+
+struct StoreServer {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stopping{false};
+  std::thread accept_thread;
+  std::vector<std::thread> handlers;
+  std::vector<int> conn_fds;
+  std::mutex handlers_mu;
+
+  std::map<std::string, std::string> kv;
+  std::mutex mu;
+  std::condition_variable cv;
+
+  void handle(int fd) {
+    while (!stopping.load()) {
+      uint8_t op;
+      uint32_t keylen;
+      uint64_t arg;
+      if (!recv_all(fd, &op, 1) || !recv_all(fd, &keylen, 4)) break;
+      std::string key(keylen, '\0');
+      if (keylen && !recv_all(fd, &key[0], keylen)) break;
+      if (!recv_all(fd, &arg, 8)) break;
+      int64_t status = 0;
+      std::string value;
+      if (op == 1) {  // SET
+        std::string val(arg, '\0');
+        if (arg && !recv_all(fd, &val[0], arg)) break;
+        {
+          std::lock_guard<std::mutex> l(mu);
+          kv[key] = std::move(val);
+        }
+        cv.notify_all();
+        status = 0;
+      } else if (op == 2 || op == 4) {  // GET / WAIT
+        std::unique_lock<std::mutex> l(mu);
+        auto pred = [&] { return stopping.load() || kv.count(key) > 0; };
+        bool ok;
+        if (arg == 0) {
+          cv.wait(l, pred);
+          ok = true;
+        } else {
+          ok = cv.wait_for(l, std::chrono::milliseconds(arg), pred);
+        }
+        if (!ok || stopping.load() || !kv.count(key)) {
+          status = -1;
+        } else if (op == 2) {
+          value = kv[key];
+          status = (int64_t)value.size();
+        } else {
+          status = 0;
+        }
+      } else if (op == 3) {  // ADD
+        std::lock_guard<std::mutex> l(mu);
+        int64_t cur = 0;
+        auto it = kv.find(key);
+        if (it != kv.end() && it->second.size() == 8) {
+          memcpy(&cur, it->second.data(), 8);
+        }
+        cur += (int64_t)arg;
+        std::string val(8, '\0');
+        memcpy(&val[0], &cur, 8);
+        kv[key] = std::move(val);
+        cv.notify_all();
+        status = cur;
+      } else {
+        status = -2;
+      }
+      if (!send_all(fd, &status, 8)) break;
+      if (op == 2 && status >= 0) {
+        if (!send_all(fd, value.data(), value.size())) break;
+      }
+    }
+    ::close(fd);
+  }
+
+  bool start(int want_port) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(want_port);
+    if (::bind(listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+      ::close(listen_fd);
+      return false;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(listen_fd, (sockaddr*)&addr, &alen);
+    port = ntohs(addr.sin_port);
+    if (::listen(listen_fd, 128) != 0) {
+      ::close(listen_fd);
+      return false;
+    }
+    accept_thread = std::thread([this] {
+      for (;;) {
+        int fd = ::accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) break;  // listen_fd closed on stop
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        std::lock_guard<std::mutex> l(handlers_mu);
+        conn_fds.push_back(fd);
+        handlers.emplace_back([this, fd] { handle(fd); });
+      }
+    });
+    return true;
+  }
+
+  // Joins every handler thread before returning, so destroying the server
+  // afterwards is safe (no detached thread can still reference *this).
+  // Handlers wake via stopping+cv (blocking gets) and via shutdown() on
+  // their connection fd (blocked recvs); each handler closes its own fd.
+  void stop() {
+    stopping.store(true);
+    cv.notify_all();
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+      listen_fd = -1;
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    std::lock_guard<std::mutex> l(handlers_mu);
+    for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+    for (auto& t : handlers)
+      if (t.joinable()) t.join();
+    handlers.clear();
+    conn_fds.clear();
+  }
+};
+
+struct StoreClient {
+  int fd = -1;
+  std::mutex mu;  // serialize request/response pairs
+
+  bool connect_to(const char* host, int port, double timeout_s) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    std::string port_s = std::to_string(port);
+    if (getaddrinfo(host, port_s.c_str(), &hints, &res) != 0) return false;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(timeout_s <= 0 ? 300 : timeout_s);
+    bool ok = false;
+    while (!ok && std::chrono::steady_clock::now() < deadline) {
+      fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+        ok = true;
+        break;
+      }
+      if (fd >= 0) ::close(fd);
+      fd = -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    freeaddrinfo(res);
+    if (ok) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    }
+    return ok;
+  }
+
+  // returns status; fills out with GET payload
+  int64_t request(uint8_t op, const std::string& key, uint64_t arg,
+                  const uint8_t* payload, size_t paylen, std::string* out) {
+    std::lock_guard<std::mutex> l(mu);
+    uint32_t keylen = key.size();
+    if (!send_all(fd, &op, 1) || !send_all(fd, &keylen, 4) ||
+        !send_all(fd, key.data(), keylen) || !send_all(fd, &arg, 8))
+      return -2;
+    if (paylen && !send_all(fd, payload, paylen)) return -2;
+    int64_t status;
+    if (!recv_all(fd, &status, 8)) return -2;
+    if (op == 2 && status >= 0 && out) {
+      out->resize(status);
+      if (status && !recv_all(fd, &(*out)[0], status)) return -2;
+    }
+    return status;
+  }
+};
+
+std::mutex g_mu;
+std::unordered_map<int64_t, std::shared_ptr<StoreServer>> g_servers;
+std::unordered_map<int64_t, std::shared_ptr<StoreClient>> g_clients;
+int64_t g_next = 1;
+
+std::shared_ptr<StoreClient> client(int64_t h) {
+  std::lock_guard<std::mutex> l(g_mu);
+  auto it = g_clients.find(h);
+  return it == g_clients.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t ptpu_store_server_start(int port) {
+  auto s = std::make_shared<StoreServer>();
+  if (!s->start(port)) return -1;
+  std::lock_guard<std::mutex> l(g_mu);
+  int64_t id = g_next++;
+  g_servers[id] = s;
+  return id;
+}
+
+int ptpu_store_server_port(int64_t h) {
+  std::lock_guard<std::mutex> l(g_mu);
+  auto it = g_servers.find(h);
+  return it == g_servers.end() ? -1 : it->second->port;
+}
+
+void ptpu_store_server_stop(int64_t h) {
+  std::shared_ptr<StoreServer> s;
+  {
+    std::lock_guard<std::mutex> l(g_mu);
+    auto it = g_servers.find(h);
+    if (it == g_servers.end()) return;
+    s = it->second;
+    g_servers.erase(it);
+  }
+  s->stop();
+}
+
+int64_t ptpu_store_client_create(const char* host, int port, double timeout_s) {
+  auto c = std::make_shared<StoreClient>();
+  if (!c->connect_to(host, port, timeout_s)) return -1;
+  std::lock_guard<std::mutex> l(g_mu);
+  int64_t id = g_next++;
+  g_clients[id] = c;
+  return id;
+}
+
+void ptpu_store_client_destroy(int64_t h) {
+  std::lock_guard<std::mutex> l(g_mu);
+  auto it = g_clients.find(h);
+  if (it == g_clients.end()) return;
+  if (it->second->fd >= 0) ::close(it->second->fd);
+  g_clients.erase(it);
+}
+
+int ptpu_store_set(int64_t h, const char* key, const uint8_t* val,
+                   int64_t len) {
+  auto c = client(h);
+  if (!c) return PTPU_ERR;
+  return c->request(1, key, (uint64_t)len, val, len, nullptr) == 0 ? PTPU_OK
+                                                                   : PTPU_ERR;
+}
+
+int64_t ptpu_store_get(int64_t h, const char* key, uint8_t* buf,
+                       int64_t buflen, double timeout_s) {
+  auto c = client(h);
+  if (!c) return -2;
+  uint64_t ms = timeout_s < 0 ? 0 : (uint64_t)(timeout_s * 1000);
+  std::string out;
+  int64_t status = c->request(2, key, ms, nullptr, 0, &out);
+  if (status < 0) return status;
+  int64_t n = std::min<int64_t>(status, buflen);
+  if (n > 0) memcpy(buf, out.data(), n);
+  return status;
+}
+
+int64_t ptpu_store_add(int64_t h, const char* key, int64_t delta) {
+  auto c = client(h);
+  if (!c) return INT64_MIN;
+  return c->request(3, key, (uint64_t)delta, nullptr, 0, nullptr);
+}
+
+int ptpu_store_wait(int64_t h, const char* key, double timeout_s) {
+  auto c = client(h);
+  if (!c) return PTPU_ERR;
+  uint64_t ms = timeout_s < 0 ? 0 : (uint64_t)(timeout_s * 1000);
+  return c->request(4, key, ms, nullptr, 0, nullptr) == 0 ? PTPU_OK
+                                                          : PTPU_TIMEOUT;
+}
+
+}  // extern "C"

@@ -1,0 +1,128 @@
+// Work queue: fixed thread pool draining a task queue, with idle barrier.
+//
+// TPU-native analogue of the reference executor's async work queue
+// (paddle/fluid/framework/new_executor/workqueue/nonblocking_threadpool.h
+// used by ProgramInterpreter::RunInstructionAsync): host-side tasks —
+// dataloader fetches, checkpoint shard writes, callback fan-out — are
+// submitted as C function pointers (ctypes callbacks acquire the GIL
+// themselves when the task is Python).
+
+#include "ptpu_runtime.h"
+
+#include <condition_variable>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct WorkQueue {
+  std::vector<std::thread> threads;
+  std::deque<std::pair<ptpu_task_fn, void*>> tasks;
+  std::mutex mu;
+  std::condition_variable cv;       // workers wait for tasks
+  std::condition_variable idle_cv;  // waiters for all-done
+  int64_t in_flight = 0;
+  bool stopping = false;
+
+  explicit WorkQueue(int n) {
+    for (int i = 0; i < n; ++i) {
+      threads.emplace_back([this] { loop(); });
+    }
+  }
+
+  void loop() {
+    for (;;) {
+      std::pair<ptpu_task_fn, void*> task;
+      {
+        std::unique_lock<std::mutex> l(mu);
+        cv.wait(l, [&] { return stopping || !tasks.empty(); });
+        if (stopping && tasks.empty()) return;
+        task = tasks.front();
+        tasks.pop_front();
+        ++in_flight;
+      }
+      task.first(task.second);
+      {
+        std::lock_guard<std::mutex> l(mu);
+        --in_flight;
+        if (tasks.empty() && in_flight == 0) idle_cv.notify_all();
+      }
+    }
+  }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> l(mu);
+      stopping = true;
+    }
+    cv.notify_all();
+    for (auto& t : threads)
+      if (t.joinable()) t.join();
+  }
+};
+
+std::mutex g_mu;
+std::unordered_map<int64_t, std::shared_ptr<WorkQueue>> g_queues;
+int64_t g_next = 1;
+
+std::shared_ptr<WorkQueue> get(int64_t h) {
+  std::lock_guard<std::mutex> l(g_mu);
+  auto it = g_queues.find(h);
+  return it == g_queues.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t ptpu_wq_create(int num_threads) {
+  if (num_threads <= 0) num_threads = 1;
+  std::lock_guard<std::mutex> l(g_mu);
+  int64_t id = g_next++;
+  g_queues[id] = std::make_shared<WorkQueue>(num_threads);
+  return id;
+}
+
+int ptpu_wq_submit(int64_t h, ptpu_task_fn fn, void* arg) {
+  auto q = get(h);
+  if (!q) return PTPU_ERR;
+  {
+    std::lock_guard<std::mutex> l(q->mu);
+    if (q->stopping) return PTPU_CLOSED;
+    q->tasks.emplace_back(fn, arg);
+  }
+  q->cv.notify_one();
+  return PTPU_OK;
+}
+
+void ptpu_wq_wait_idle(int64_t h) {
+  auto q = get(h);
+  if (!q) return;
+  std::unique_lock<std::mutex> l(q->mu);
+  q->idle_cv.wait(l, [&] { return q->tasks.empty() && q->in_flight == 0; });
+}
+
+int64_t ptpu_wq_pending(int64_t h) {
+  auto q = get(h);
+  if (!q) return -1;
+  std::lock_guard<std::mutex> l(q->mu);
+  return (int64_t)q->tasks.size() + q->in_flight;
+}
+
+void ptpu_wq_destroy(int64_t h) {
+  std::shared_ptr<WorkQueue> q;
+  {
+    std::lock_guard<std::mutex> l(g_mu);
+    auto it = g_queues.find(h);
+    if (it == g_queues.end()) return;
+    q = it->second;
+    g_queues.erase(it);
+  }
+  q->stop();
+}
+
+}  // extern "C"

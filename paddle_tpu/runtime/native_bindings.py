@@ -1,0 +1,388 @@
+"""ctypes bindings over the native host runtime (see native/ptpu_runtime.h).
+
+Exposes Pythonic wrappers:
+
+- :class:`BlockingQueue` — bounded MPMC queue of Python objects; blocking
+  semantics live in C++ (≙ LoDTensorBlockingQueue), object identity is kept
+  on the Python side via a token table.
+- :class:`TCPStoreServer` / :class:`TCPStore` — KV rendezvous
+  (≙ phi TCPStore) for multi-process bootstrap and barriers.
+- :class:`HostTracer` — process-wide host event recorder with
+  chrome-trace export (≙ host_event_recorder + chrometracing_logger).
+- :func:`stat_update` etc. — named current/peak counters (≙ memory/stats.h).
+- :class:`WorkQueue` — C++ thread pool running Python callables
+  (≙ nonblocking_threadpool).
+
+If the toolchain is unavailable the import raises and callers fall back to
+pure-Python shims (see paddle_tpu.runtime.__init__).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import itertools
+import struct
+import threading
+from typing import Any, Optional
+
+from .build import build_native
+
+_lib = ctypes.CDLL(build_native())
+
+_i64, _u64, _i32 = ctypes.c_int64, ctypes.c_uint64, ctypes.c_int
+_dbl, _chp, _u8p = ctypes.c_double, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8)
+
+_lib.ptpu_now_ns.restype = _u64
+_lib.ptpu_bq_create.restype = _i64
+_lib.ptpu_bq_create.argtypes = [_i64]
+_lib.ptpu_bq_push.restype = _i32
+_lib.ptpu_bq_push.argtypes = [_i64, _u64, _dbl]
+_lib.ptpu_bq_pop.restype = _i32
+_lib.ptpu_bq_pop.argtypes = [_i64, ctypes.POINTER(_u64), _dbl]
+_lib.ptpu_bq_size.restype = _i64
+_lib.ptpu_bq_size.argtypes = [_i64]
+_lib.ptpu_bq_capacity.restype = _i64
+_lib.ptpu_bq_capacity.argtypes = [_i64]
+_lib.ptpu_bq_close.argtypes = [_i64]
+_lib.ptpu_bq_is_closed.restype = _i32
+_lib.ptpu_bq_is_closed.argtypes = [_i64]
+_lib.ptpu_bq_destroy.argtypes = [_i64]
+
+_lib.ptpu_store_server_start.restype = _i64
+_lib.ptpu_store_server_start.argtypes = [_i32]
+_lib.ptpu_store_server_port.restype = _i32
+_lib.ptpu_store_server_port.argtypes = [_i64]
+_lib.ptpu_store_server_stop.argtypes = [_i64]
+_lib.ptpu_store_client_create.restype = _i64
+_lib.ptpu_store_client_create.argtypes = [_chp, _i32, _dbl]
+_lib.ptpu_store_client_destroy.argtypes = [_i64]
+_lib.ptpu_store_set.restype = _i32
+_lib.ptpu_store_set.argtypes = [_i64, _chp, _u8p, _i64]
+_lib.ptpu_store_get.restype = _i64
+_lib.ptpu_store_get.argtypes = [_i64, _chp, _u8p, _i64, _dbl]
+_lib.ptpu_store_add.restype = _i64
+_lib.ptpu_store_add.argtypes = [_i64, _chp, _i64]
+_lib.ptpu_store_wait.restype = _i32
+_lib.ptpu_store_wait.argtypes = [_i64, _chp, _dbl]
+
+_lib.ptpu_trace_begin.argtypes = [_chp]
+_lib.ptpu_trace_instant.argtypes = [_chp]
+_lib.ptpu_trace_counter.argtypes = [_chp, _i64]
+_lib.ptpu_trace_count.restype = _i64
+_lib.ptpu_trace_export.restype = _i32
+_lib.ptpu_trace_export.argtypes = [_chp]
+_lib.ptpu_trace_dump.restype = _i64
+_lib.ptpu_trace_dump.argtypes = [_u8p, _i64]
+_lib.ptpu_trace_is_enabled.restype = _i32
+
+_lib.ptpu_stat_update.argtypes = [_chp, _i64]
+_lib.ptpu_stat_current.restype = _i64
+_lib.ptpu_stat_current.argtypes = [_chp]
+_lib.ptpu_stat_peak.restype = _i64
+_lib.ptpu_stat_peak.argtypes = [_chp]
+_lib.ptpu_stat_reset.argtypes = [_chp]
+_lib.ptpu_stat_names.restype = _i64
+_lib.ptpu_stat_names.argtypes = [_chp, _i64]
+
+_TASK_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+_lib.ptpu_wq_create.restype = _i64
+_lib.ptpu_wq_create.argtypes = [_i32]
+_lib.ptpu_wq_submit.restype = _i32
+_lib.ptpu_wq_submit.argtypes = [_i64, _TASK_FN, ctypes.c_void_p]
+_lib.ptpu_wq_wait_idle.argtypes = [_i64]
+_lib.ptpu_wq_pending.restype = _i64
+_lib.ptpu_wq_pending.argtypes = [_i64]
+_lib.ptpu_wq_destroy.argtypes = [_i64]
+
+OK, TIMEOUT, CLOSED = 0, 1, 2
+
+
+def now_ns() -> int:
+    return int(_lib.ptpu_now_ns())
+
+
+class QueueClosed(Exception):
+    pass
+
+
+class BlockingQueue:
+    """Bounded blocking queue of arbitrary Python objects.
+
+    C++ owns the bounded/blocking/close semantics; Python keeps a token→
+    object table so payloads never cross the ABI.
+    """
+
+    def __init__(self, capacity: int):
+        self._h = _lib.ptpu_bq_create(capacity)
+        self._tokens = itertools.count(1)
+        self._objs: dict[int, Any] = {}
+        self._mu = threading.Lock()
+
+    def push(self, obj: Any, timeout: Optional[float] = None) -> bool:
+        tok = next(self._tokens)
+        with self._mu:
+            self._objs[tok] = obj
+        rc = _lib.ptpu_bq_push(self._h, tok, -1.0 if timeout is None else timeout)
+        if rc != OK:
+            with self._mu:
+                self._objs.pop(tok, None)
+            if rc == CLOSED:
+                raise QueueClosed("queue closed")
+            return False  # timeout
+        return True
+
+    def pop(self, timeout: Optional[float] = None) -> Any:
+        out = _u64(0)
+        rc = _lib.ptpu_bq_pop(self._h, ctypes.byref(out),
+                              -1.0 if timeout is None else timeout)
+        if rc == CLOSED:
+            raise QueueClosed("queue closed and drained")
+        if rc != OK:
+            raise TimeoutError("BlockingQueue.pop timed out")
+        with self._mu:
+            return self._objs.pop(int(out.value))
+
+    def size(self) -> int:
+        return int(_lib.ptpu_bq_size(self._h))
+
+    def capacity(self) -> int:
+        return int(_lib.ptpu_bq_capacity(self._h))
+
+    def close(self):
+        _lib.ptpu_bq_close(self._h)
+
+    @property
+    def closed(self) -> bool:
+        return bool(_lib.ptpu_bq_is_closed(self._h))
+
+    def __del__(self):
+        try:
+            _lib.ptpu_bq_destroy(self._h)
+        except Exception:
+            pass
+
+
+class TCPStoreServer:
+    """Master side of the rendezvous store (run on rank 0's host)."""
+
+    def __init__(self, port: int = 0):
+        self._h = _lib.ptpu_store_server_start(port)
+        if self._h < 0:
+            raise OSError(f"TCPStoreServer: cannot bind port {port}")
+
+    @property
+    def port(self) -> int:
+        return int(_lib.ptpu_store_server_port(self._h))
+
+    def stop(self):
+        if self._h >= 0:
+            _lib.ptpu_store_server_stop(self._h)
+            self._h = -1
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class TCPStore:
+    """Client handle; mirrors the reference TCPStore API
+    (set/get/add/wait — paddle/phi/core/distributed/store/tcp_store.h:120)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self._h = _lib.ptpu_store_client_create(host.encode(), port, timeout)
+        if self._h < 0:
+            raise ConnectionError(f"TCPStore: cannot connect {host}:{port}")
+
+    def set(self, key: str, value: bytes):
+        buf = (ctypes.c_uint8 * max(len(value), 1)).from_buffer_copy(
+            value or b"\0")
+        rc = _lib.ptpu_store_set(self._h, key.encode(), buf, len(value))
+        if rc != OK:
+            raise IOError("TCPStore.set failed")
+
+    def get(self, key: str, timeout: float = 60.0) -> bytes:
+        size = 1 << 16
+        while True:
+            buf = (ctypes.c_uint8 * size)()
+            n = _lib.ptpu_store_get(self._h, key.encode(), buf, size, timeout)
+            if n == -1:
+                raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+            if n < 0:
+                raise IOError("TCPStore.get failed")
+            if n <= size:
+                return bytes(buf[: int(n)])
+            size = int(n)  # retry with exact size
+
+    def add(self, key: str, delta: int = 1) -> int:
+        v = _lib.ptpu_store_add(self._h, key.encode(), delta)
+        if v == -(2**63):
+            raise IOError("TCPStore.add failed")
+        return int(v)
+
+    def wait(self, key: str, timeout: float = 60.0):
+        rc = _lib.ptpu_store_wait(self._h, key.encode(), timeout)
+        if rc != OK:
+            raise TimeoutError(f"TCPStore.wait({key!r}) timed out")
+
+    def close(self):
+        if self._h >= 0:
+            _lib.ptpu_store_client_destroy(self._h)
+            self._h = -1
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class HostTracer:
+    """Process-wide host tracer (all methods are static; state is in C++)."""
+
+    @staticmethod
+    def enable():
+        _lib.ptpu_trace_enable()
+
+    @staticmethod
+    def disable():
+        _lib.ptpu_trace_disable()
+
+    @staticmethod
+    def is_enabled() -> bool:
+        return bool(_lib.ptpu_trace_is_enabled())
+
+    @staticmethod
+    def begin(name: str):
+        _lib.ptpu_trace_begin(name.encode())
+
+    @staticmethod
+    def end():
+        _lib.ptpu_trace_end()
+
+    @staticmethod
+    def instant(name: str):
+        _lib.ptpu_trace_instant(name.encode())
+
+    @staticmethod
+    def counter(name: str, value: int):
+        _lib.ptpu_trace_counter(name.encode(), value)
+
+    @staticmethod
+    def count() -> int:
+        return int(_lib.ptpu_trace_count())
+
+    @staticmethod
+    def clear():
+        _lib.ptpu_trace_clear()
+
+    @staticmethod
+    def export_chrome_trace(path: str):
+        if _lib.ptpu_trace_export(path.encode()) != OK:
+            raise IOError(f"cannot write trace to {path}")
+
+    @staticmethod
+    def events() -> list:
+        """Decode the binary dump into [(kind, t0_ns, t1_ns, tid, value, name)]."""
+        need = _lib.ptpu_trace_dump(None, 0)
+        if need <= 0:
+            return []
+        # slack absorbs events recorded between the size query and the dump;
+        # dump never writes a partial record, so raw[:got] is always valid
+        size = int(need) + 65536
+        buf = (ctypes.c_uint8 * size)()
+        got = _lib.ptpu_trace_dump(buf, size)
+        raw = bytes(buf[: int(got)])
+        out, off = [], 0
+        while off + 37 <= len(raw):
+            kind = raw[off]
+            t0, t1, tid, value, namelen = struct.unpack_from("<QQqqI", raw, off + 1)
+            off += 37
+            name = raw[off: off + namelen].decode("utf-8", "replace")
+            off += namelen
+            out.append((kind, t0, t1, tid, value, name))
+        return out
+
+
+def stat_update(name: str, delta: int):
+    _lib.ptpu_stat_update(name.encode(), delta)
+
+
+def stat_current(name: str) -> int:
+    return int(_lib.ptpu_stat_current(name.encode()))
+
+
+def stat_peak(name: str) -> int:
+    return int(_lib.ptpu_stat_peak(name.encode()))
+
+
+def stat_reset(name: str):
+    _lib.ptpu_stat_reset(name.encode())
+
+
+def stat_names() -> list:
+    n = _lib.ptpu_stat_names(None, 0)
+    if n <= 0:
+        return []
+    buf = ctypes.create_string_buffer(int(n) + 1)
+    _lib.ptpu_stat_names(buf, int(n) + 1)
+    return buf.value.decode().split("\n") if buf.value else []
+
+
+class WorkQueue:
+    """C++ thread pool executing Python callables.
+
+    ctypes CFUNCTYPE trampolines acquire the GIL per task, so pure-numpy
+    tasks overlap (numpy releases the GIL) while scheduling/wakeups stay
+    native.
+    """
+
+    def __init__(self, num_threads: int):
+        self._h = _lib.ptpu_wq_create(num_threads)
+        self._mu = threading.Lock()
+        self._tasks: dict[int, Any] = {}
+        self._ids = itertools.count(1)
+        self._errors: list = []
+
+        def trampoline(arg):
+            tid = int(arg)
+            with self._mu:
+                fn = self._tasks.pop(tid)
+            try:
+                fn()
+            except Exception as e:  # surfaced on wait_idle
+                with self._mu:
+                    self._errors.append(e)
+
+        self._cb = _TASK_FN(trampoline)  # keep alive
+
+    def submit(self, fn):
+        tid = next(self._ids)
+        with self._mu:
+            self._tasks[tid] = fn
+        rc = _lib.ptpu_wq_submit(self._h, self._cb, ctypes.c_void_p(tid))
+        if rc != OK:
+            with self._mu:
+                self._tasks.pop(tid, None)
+            raise RuntimeError("WorkQueue.submit on stopped queue")
+
+    def wait_idle(self):
+        _lib.ptpu_wq_wait_idle(self._h)
+        with self._mu:
+            if self._errors:
+                raise self._errors.pop(0)
+
+    def pending(self) -> int:
+        return int(_lib.ptpu_wq_pending(self._h))
+
+    def shutdown(self):
+        if self._h >= 0:
+            _lib.ptpu_wq_destroy(self._h)
+            self._h = -1
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass

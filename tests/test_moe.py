@@ -89,3 +89,22 @@ def test_moe_expert_sharded_jit():
         assert np.isfinite(float(out))
     finally:
         set_global_mesh(None)
+
+
+def test_moe_aux_loss_gradient_flows():
+    """The GShard balance term must backprop into the gate weight (the whole
+    point of adding last_aux_loss to the training loss)."""
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    paddle.seed(1)
+    layer = MoELayer(d_model=16, d_hidden=32, num_experts=4, top_k=2,
+                     capacity_factor=2.0)
+    x = paddle.to_tensor(
+        np.random.default_rng(1).standard_normal((2, 8, 16)).astype("float32"),
+        stop_gradient=False)
+    out = layer(x)
+    loss = out.sum() + 0.01 * layer.last_aux_loss
+    assert not layer.last_aux_loss.stop_gradient
+    loss.backward()
+    g = layer.gate.gate.weight.grad
+    assert g is not None
+    assert float(g.abs().sum()) > 0

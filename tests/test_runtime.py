@@ -1,0 +1,206 @@
+"""Tests for the native host runtime (paddle_tpu.runtime).
+
+Covers the C++ components through their ctypes bindings: blocking queue
+semantics (bounded, ordered, close), TCPStore rendezvous incl. a separate
+client process, host tracer event capture + chrome export, stat counters,
+and the work-queue thread pool. Mirrors the reference's reader/store tests
+(SURVEY §4) at unit scale.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import runtime as rt
+
+
+def test_native_available():
+    # the image has g++; the native path must actually build
+    assert rt.NATIVE_AVAILABLE
+
+
+def test_blocking_queue_fifo_and_capacity():
+    q = rt.BlockingQueue(2)
+    assert q.capacity() == 2
+    q.push(1)
+    q.push("two")
+    assert q.size() == 2
+    assert q.push(3, timeout=0.05) is False  # full -> timeout
+    assert q.pop() == 1
+    assert q.pop() == "two"
+    with pytest.raises(TimeoutError):
+        q.pop(timeout=0.05)
+
+
+def test_blocking_queue_blocking_producer_consumer():
+    q = rt.BlockingQueue(4)
+    n = 200
+    got = []
+
+    def producer():
+        for i in range(n):
+            q.push(i)
+        q.close()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    while True:
+        try:
+            got.append(q.pop(timeout=5))
+        except rt.QueueClosed:
+            break
+    t.join()
+    assert got == list(range(n))
+
+
+def test_blocking_queue_close_wakes_consumer():
+    q = rt.BlockingQueue(1)
+    err = []
+
+    def consumer():
+        try:
+            q.pop(timeout=5)
+        except rt.QueueClosed:
+            err.append("closed")
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    q.close()
+    t.join(timeout=2)
+    assert err == ["closed"]
+
+
+def test_tcp_store_same_process():
+    srv = rt.TCPStoreServer()
+    st = rt.TCPStore("127.0.0.1", srv.port)
+    st.set("alpha", b"123")
+    assert st.get("alpha") == b"123"
+    assert st.add("rank_counter", 1) == 1
+    assert st.add("rank_counter", 4) == 5
+    with pytest.raises(TimeoutError):
+        st.get("missing", timeout=0.1)
+    st.wait("alpha", timeout=1)
+    # blocking get satisfied by a later set from another client
+    st2 = rt.TCPStore("127.0.0.1", srv.port)
+    result = {}
+
+    def getter():
+        result["v"] = st.get("later", timeout=5)
+
+    t = threading.Thread(target=getter)
+    t.start()
+    time.sleep(0.1)
+    st2.set("later", b"xyz")
+    t.join(timeout=5)
+    assert result["v"] == b"xyz"
+    srv.stop()
+
+
+def test_tcp_store_cross_process():
+    srv = rt.TCPStoreServer()
+    st = rt.TCPStore("127.0.0.1", srv.port)
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from paddle_tpu import runtime as rt\n"
+        "st = rt.TCPStore('127.0.0.1', %d)\n"
+        "st.set('from_child', b'hi-parent')\n"
+        "print(st.get('from_parent', timeout=20).decode())\n"
+    ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))), srv.port)
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    assert st.get("from_child", timeout=20) == b"hi-parent"
+    st.set("from_parent", b"hi-child")
+    out, _ = proc.communicate(timeout=30)
+    assert proc.returncode == 0
+    assert out.strip() == "hi-child"
+    srv.stop()
+
+
+def test_host_tracer_and_chrome_export(tmp_path):
+    rt.HostTracer.clear()
+    rt.HostTracer.enable()
+    rt.HostTracer.begin("outer")
+    rt.HostTracer.begin("inner")
+    rt.HostTracer.end()
+    rt.HostTracer.end()
+    rt.HostTracer.instant("tick")
+    rt.HostTracer.counter("bytes", 7)
+    rt.HostTracer.disable()
+    events = rt.HostTracer.events()
+    names = sorted(e[5] for e in events)
+    assert names == ["bytes", "inner", "outer", "tick"]
+    inner = next(e for e in events if e[5] == "inner")
+    outer = next(e for e in events if e[5] == "outer")
+    assert outer[1] <= inner[1] and inner[2] <= outer[2]  # nesting
+    path = str(tmp_path / "trace.json")
+    rt.HostTracer.export_chrome_trace(path)
+    doc = json.load(open(path))
+    assert len(doc["traceEvents"]) == 4
+    assert {"X", "i", "C"} == {e["ph"] for e in doc["traceEvents"]}
+    rt.HostTracer.clear()
+    assert rt.HostTracer.count() == 0
+
+
+def test_tracer_disabled_is_noop():
+    rt.HostTracer.clear()
+    assert not rt.HostTracer.is_enabled()
+    rt.HostTracer.begin("x")
+    rt.HostTracer.end()
+    assert rt.HostTracer.count() == 0
+
+
+def test_stats_current_peak():
+    rt.stat_reset("test_stat")
+    rt.stat_update("test_stat", 100)
+    rt.stat_update("test_stat", 50)
+    rt.stat_update("test_stat", -120)
+    assert rt.stat_current("test_stat") == 30
+    assert rt.stat_peak("test_stat") == 150
+    assert "test_stat" in rt.stat_names()
+    rt.stat_reset("test_stat")
+    assert rt.stat_current("test_stat") == 0
+
+
+def test_work_queue_parallel_and_errors():
+    wq = rt.WorkQueue(4)
+    results = []
+    lock = threading.Lock()
+    for i in range(50):
+        def task(i=i):
+            with lock:
+                results.append(i)
+        wq.submit(task)
+    wq.wait_idle()
+    assert sorted(results) == list(range(50))
+
+    def boom():
+        raise ValueError("task failed")
+
+    wq.submit(boom)
+    with pytest.raises(ValueError, match="task failed"):
+        wq.wait_idle()
+    wq.shutdown()
+
+
+def test_dataloader_uses_native_queue():
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+
+    class Squares(Dataset):
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            return np.float32(i * i)
+
+    loader = DataLoader(Squares(), batch_size=8, num_workers=3)
+    batches = [b.numpy() for b in loader]
+    flat = np.concatenate(batches)
+    np.testing.assert_allclose(flat, np.arange(32, dtype=np.float32) ** 2)
