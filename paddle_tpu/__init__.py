@@ -31,6 +31,8 @@ from .tensor import *  # noqa: F401,F403
 from . import tensor  # noqa: F401
 
 # ---- subsystems ----
+from . import runtime  # noqa: F401
+from . import profiler  # noqa: F401
 from . import autograd  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401

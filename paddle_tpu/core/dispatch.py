@@ -25,6 +25,7 @@ import numpy as np
 from . import tape as _tape
 from .flags import flag
 from .tensor import Tensor
+from ..runtime import HostTracer as _tracer
 
 # AMP policy hook — set by paddle_tpu.amp at import; signature:
 #   hook(op_name) -> target dtype to cast floating inputs to, or None.
@@ -93,6 +94,18 @@ def dispatch(op_name: str, impl: Callable, tensor_args: Sequence,
         for a in tensor_args:
             if isinstance(a, Tensor) and a._is_param:
                 _param_tracker.setdefault(id(a), a)
+    if _tracer.enabled:  # ≙ RecordEvent instrumentation in operator.cc
+        _tracer.begin(f"op::{op_name}")
+        try:
+            return _dispatch_impl(op_name, impl, tensor_args, nondiff_mask,
+                                  n_diff_outputs)
+        finally:
+            _tracer.end()
+    return _dispatch_impl(op_name, impl, tensor_args, nondiff_mask,
+                          n_diff_outputs)
+
+
+def _dispatch_impl(op_name, impl, tensor_args, nondiff_mask, n_diff_outputs):
     arrays = [_as_array(a) for a in tensor_args]
     if _amp_cast_hook is not None:
         cast_dtype = _amp_cast_hook(op_name)

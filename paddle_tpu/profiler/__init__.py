@@ -1,0 +1,24 @@
+"""paddle_tpu.profiler — profiling API.
+
+Analogue of ``python/paddle/profiler/profiler.py:349`` (Profiler with
+state scheduler, ``export_chrome_tracing``, summary tables) over two
+backends:
+
+- the native :class:`~paddle_tpu.runtime.HostTracer` (C++ per-thread event
+  buffers ≙ host_event_recorder.h) records host ranges — op dispatch,
+  dataloader, user ``RecordEvent`` scopes;
+- ``jax.profiler`` (XLA/TPU xplane tracer ≙ CudaTracer/CUPTI) captures the
+  device side when a trace dir is given.
+"""
+
+from .profiler import (  # noqa: F401
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent, SummaryView,
+    make_scheduler, export_chrome_tracing, load_profiler_result,
+)
+from .utils import record_function  # noqa: F401
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+    "SummaryView", "make_scheduler", "export_chrome_tracing",
+    "load_profiler_result", "record_function",
+]

@@ -1,0 +1,270 @@
+"""Profiler core (reference: python/paddle/profiler/profiler.py:349 over
+paddle/fluid/platform/profiler/profiler.h:47).
+
+The reference merges a host tracer and a CUPTI device tracer into an event
+tree and exports chrome traces + summary tables. Here the host side is the
+native C++ tracer (paddle_tpu.runtime.HostTracer); the device side is
+jax.profiler (XLA xplane, viewable in TensorBoard/Perfetto), started and
+stopped in lockstep when ``targets`` includes TPU.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from collections import defaultdict
+from typing import Callable, Iterable, Optional
+
+from .. import runtime as rt
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3  # last RECORD step of a window: collect + return
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1   # accepted for API parity; maps to the XLA device tracer
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0) -> Callable:
+    """State machine over step numbers (mirror of profiler.py:79).
+
+    skip_first steps CLOSED, then cycles of [closed CLOSED, ready READY,
+    record RECORD (last returns RECORD_AND_RETURN)]; ``repeat=0`` = cycle
+    forever.
+    """
+    if closed < 0 or ready < 0 or record <= 0:
+        raise ValueError("make_scheduler: closed/ready >= 0 and record >= 1")
+    cycle = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat > 0 and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD  # profile everything between start and stop
+
+
+def export_chrome_tracing(dir_name: str,
+                          worker_name: Optional[str] = None) -> Callable:
+    """on_trace_ready callback factory (≙ profiler.py:215)."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof: "Profiler"):
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_step{prof.step_num}.json")
+        rt.HostTracer.export_chrome_trace(path)
+        prof._exported_paths.append(path)
+
+    return handler
+
+
+class RecordEvent:
+    """User-scoped host range (≙ python/paddle/profiler/utils.py:38)."""
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.event_type = event_type
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def begin(self):
+        rt.HostTracer.begin(self.name)
+
+    def end(self):
+        rt.HostTracer.end()
+
+
+class _EventStat:
+    __slots__ = ("count", "total_ns", "max_ns", "min_ns")
+
+    def __init__(self):
+        self.count = 0
+        self.total_ns = 0
+        self.max_ns = 0
+        self.min_ns = None
+
+    def add(self, dur: int):
+        self.count += 1
+        self.total_ns += dur
+        self.max_ns = max(self.max_ns, dur)
+        self.min_ns = dur if self.min_ns is None else min(self.min_ns, dur)
+
+
+class SummaryView:
+    """Aggregated per-name host event table (≙ profiler_statistic.py)."""
+
+    def __init__(self, events):
+        self.stats = defaultdict(_EventStat)
+        for kind, t0, t1, tid, value, name in events:
+            if kind == 0:  # range
+                self.stats[name].add(t1 - t0)
+
+    def rows(self):
+        out = []
+        for name, s in sorted(self.stats.items(),
+                              key=lambda kv: -kv[1].total_ns):
+            out.append({
+                "name": name, "calls": s.count,
+                "total_ms": s.total_ns / 1e6,
+                "avg_ms": s.total_ns / s.count / 1e6,
+                "max_ms": s.max_ns / 1e6,
+                "min_ms": (s.min_ns or 0) / 1e6,
+            })
+        return out
+
+    def table(self) -> str:
+        rows = self.rows()
+        header = f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}" \
+                 f"{'Max(ms)':>12}{'Min(ms)':>12}"
+        lines = [header, "-" * len(header)]
+        for r in rows:
+            lines.append(
+                f"{r['name'][:39]:<40}{r['calls']:>8}{r['total_ms']:>12.3f}"
+                f"{r['avg_ms']:>12.3f}{r['max_ms']:>12.3f}{r['min_ms']:>12.3f}")
+        return "\n".join(lines)
+
+
+def load_profiler_result(path: str):
+    """Load an exported chrome trace back as a list of event dicts."""
+    import json
+    with open(path) as f:
+        return json.load(f)["traceEvents"]
+
+
+class Profiler:
+    """Reference-parity profiler driver.
+
+    with Profiler(targets=[ProfilerTarget.CPU], scheduler=(2, 5)) as p:
+        for batch in loader:
+            train_step(batch)
+            p.step()
+    print(p.summary().table())
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 timer_only: bool = False, profile_memory: bool = False,
+                 with_flops: bool = False):
+        self.targets = list(targets or [ProfilerTarget.CPU])
+        if scheduler is None:
+            self.scheduler = _default_scheduler
+        elif callable(scheduler):
+            self.scheduler = scheduler
+        else:  # (start, end) tuple like the reference
+            start, end = scheduler
+            self.scheduler = make_scheduler(
+                closed=max(start - 1, 0), ready=1 if start >= 1 else 0,
+                record=end - start, repeat=1)
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._device_trace_dir = None
+        self._device_tracing = False
+        self._exported_paths: list = []
+        self._events_snapshot = None
+
+    # -- lifecycle --
+    def start(self):
+        self.current_state = self.scheduler(self.step_num)
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._start_record()
+
+    def stop(self):
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._stop_record()
+            if self.on_trace_ready is not None:
+                self.on_trace_ready(self)
+
+    def step(self):
+        prev = self.current_state
+        self.step_num += 1
+        new = self.scheduler(self.step_num)
+        recording = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if prev in recording and new not in recording:
+            self._stop_record()
+            if self.on_trace_ready is not None:
+                self.on_trace_ready(self)
+        elif prev not in recording and new in recording:
+            self._start_record()
+        self.current_state = new
+
+    def _start_record(self):
+        rt.HostTracer.clear()
+        rt.HostTracer.enable()
+        if not self.timer_only and any(
+                t in (ProfilerTarget.TPU, ProfilerTarget.GPU,
+                      ProfilerTarget.CUSTOM_DEVICE) for t in self.targets):
+            import tempfile
+            self._device_trace_dir = tempfile.mkdtemp(prefix="ptpu_xprof_")
+            try:
+                import jax
+                jax.profiler.start_trace(self._device_trace_dir)
+                self._device_tracing = True
+            except Exception:
+                self._device_tracing = False
+
+    def _stop_record(self):
+        rt.HostTracer.disable()
+        self._events_snapshot = rt.HostTracer.events()
+        if self._device_tracing:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- results --
+    def events(self):
+        return self._events_snapshot or rt.HostTracer.events()
+
+    def summary(self) -> SummaryView:
+        return SummaryView(self.events())
+
+    def export_chrome_trace(self, path: str):
+        rt.HostTracer.export_chrome_trace(path)
+        self._exported_paths.append(path)
+
+    @property
+    def device_trace_dir(self):
+        """Directory with the XLA xplane dump (TensorBoard-viewable)."""
+        return self._device_trace_dir

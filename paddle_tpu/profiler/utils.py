@@ -1,0 +1,21 @@
+"""Profiler helpers (≙ python/paddle/profiler/utils.py)."""
+
+from __future__ import annotations
+
+import functools
+
+from .profiler import RecordEvent
+
+
+def record_function(name: str):
+    """Decorator form of RecordEvent."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with RecordEvent(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco

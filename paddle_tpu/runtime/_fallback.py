@@ -72,15 +72,19 @@ _trace_tls = _TracerState()
 
 
 class HostTracer:
+    enabled = False  # fast-path mirror, same as the native bindings
+
     @staticmethod
     def enable():
         global _trace_enabled
         _trace_enabled = True
+        HostTracer.enabled = True
 
     @staticmethod
     def disable():
         global _trace_enabled
         _trace_enabled = False
+        HostTracer.enabled = False
 
     @staticmethod
     def is_enabled() -> bool:

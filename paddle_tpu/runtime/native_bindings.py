@@ -239,14 +239,22 @@ class TCPStore:
 
 
 class HostTracer:
-    """Process-wide host tracer (all methods are static; state is in C++)."""
+    """Process-wide host tracer (all methods are static; state is in C++).
+
+    ``enabled`` mirrors the C++ flag as a plain Python attribute so hot
+    paths (op dispatch) can check it without crossing the ABI.
+    """
+
+    enabled = False
 
     @staticmethod
     def enable():
+        HostTracer.enabled = True
         _lib.ptpu_trace_enable()
 
     @staticmethod
     def disable():
+        HostTracer.enabled = False
         _lib.ptpu_trace_disable()
 
     @staticmethod

@@ -1,0 +1,100 @@
+"""Profiler API tests (scheduler state machine, RecordEvent capture,
+chrome export, summary tables, op-dispatch instrumentation)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import (
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent, make_scheduler,
+    export_chrome_tracing, load_profiler_result, record_function,
+)
+
+
+def test_make_scheduler_state_machine():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=2,
+                           skip_first=1)
+    states = [sched(i) for i in range(10)]
+    assert states[0] == ProfilerState.CLOSED          # skip_first
+    assert states[1] == ProfilerState.CLOSED
+    assert states[2] == ProfilerState.READY
+    assert states[3] == ProfilerState.RECORD
+    assert states[4] == ProfilerState.RECORD_AND_RETURN
+    assert states[5] == ProfilerState.CLOSED          # cycle 2
+    assert states[8] == ProfilerState.RECORD_AND_RETURN
+    assert states[9] == ProfilerState.CLOSED          # repeat exhausted
+
+
+def test_make_scheduler_validation():
+    with pytest.raises(ValueError):
+        make_scheduler(closed=0, ready=0, record=0)
+
+
+def test_profiler_records_user_and_op_events():
+    with Profiler(targets=[ProfilerTarget.CPU]) as prof:
+        with RecordEvent("my_scope"):
+            x = paddle.to_tensor(np.ones((4, 4), np.float32))
+            y = paddle.matmul(x, x)
+            _ = y.numpy()
+    names = {e[5] for e in prof.events()}
+    assert "my_scope" in names
+    assert "op::matmul" in names
+    rows = prof.summary().rows()
+    assert any(r["name"] == "op::matmul" and r["calls"] >= 1 for r in rows)
+    table = prof.summary().table()
+    assert "op::matmul" in table and "Calls" in table
+
+
+def test_profiler_disabled_outside_window():
+    prof = Profiler(scheduler=make_scheduler(closed=1, ready=0, record=1,
+                                             repeat=1))
+    prof.start()  # step 0 -> CLOSED
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    _ = paddle.matmul(x, x)
+    assert prof.current_state == ProfilerState.CLOSED
+    prof.step()  # step 1 -> RECORD_AND_RETURN
+    _ = paddle.matmul(x, x)
+    prof.step()  # leaves window -> collected
+    prof.stop()
+    ops = [e for e in prof.events() if e[5] == "op::matmul"]
+    assert len(ops) == 1  # only the in-window matmul
+
+
+def test_chrome_export_and_reload(tmp_path):
+    out_dir = str(tmp_path / "traces")
+    handler = export_chrome_tracing(out_dir, worker_name="w0")
+    with Profiler(on_trace_ready=handler) as prof:
+        with RecordEvent("exported_scope"):
+            pass
+    files = os.listdir(out_dir)
+    assert len(files) == 1
+    events = load_profiler_result(os.path.join(out_dir, files[0]))
+    assert any(e["name"] == "exported_scope" for e in events)
+    json.dumps(events)  # valid json structure
+
+
+def test_record_function_decorator():
+    @record_function("decorated_fn")
+    def f(a, b):
+        return a + b
+
+    with Profiler() as prof:
+        assert f(2, 3) == 5
+    assert any(e[5] == "decorated_fn" for e in prof.events())
+
+
+def test_profiler_step_scheduler_tuple():
+    # (start, end) tuple form: record steps [start, end)
+    prof = Profiler(scheduler=(1, 3))
+    prof.start()
+    seen = []
+    for _ in range(4):
+        seen.append(prof.current_state)
+        prof.step()
+    prof.stop()
+    recording = [s in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+                 for s in seen]
+    assert recording == [False, True, True, False]
