@@ -1,0 +1,26 @@
+"""paddle_tpu._C_ops — the low-level op namespace.
+
+Analogue of ``python/paddle/_C_ops.py:20`` (which re-exports the generated
+``core.eager.ops``). Every op in the registry (paddle_tpu/ops/ops.yaml) is
+reachable here by name, giving reference users their accustomed
+``_C_ops.matmul(x, y)`` escape hatch. Resolution is lazy per attribute so
+importing this module costs nothing.
+"""
+
+from __future__ import annotations
+
+from .ops import registry as _registry
+
+
+def __getattr__(name: str):
+    specs = _registry.registry_by_name()
+    if name in specs:
+        fn = _registry.resolve(specs[name])
+        globals()[name] = fn  # cache for next access
+        return fn
+    raise AttributeError(f"_C_ops has no op {name!r} "
+                         "(not in paddle_tpu/ops/ops.yaml)")
+
+
+def __dir__():
+    return sorted(_registry.registry_by_name())

@@ -1,1 +1,4 @@
-"""paddle_tpu.ops — op registry and Pallas kernel pack."""
+"""paddle_tpu.ops — op registry (ops.yaml) and Pallas kernel pack."""
+
+from . import registry  # noqa: F401
+from .registry import OpSpec, load_registry, resolve  # noqa: F401

@@ -141,6 +141,32 @@ _METHOD_NAMES = [
     "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
     "index_sample", "index_add", "index_put", "cumsum", "moveaxis",
     "is_complex", "is_floating_point",
+    # elementwise / math
+    "acos", "acosh", "asin", "asinh", "atan", "atan2", "atanh", "sinh",
+    "cosh", "expm1", "digamma", "lgamma", "erfinv", "frac", "deg2rad",
+    "rad2deg", "angle", "conj", "logit", "logaddexp", "heaviside", "hypot",
+    "fmax", "fmin", "floor_mod", "remainder", "gcd", "lcm", "ldexp",
+    "nan_to_num", "sgn", "stanh", "increment",
+    # reductions / stats
+    "amax", "amin", "count_nonzero", "cummax", "cummin", "nanmean",
+    "nanmedian", "nanquantile", "nansum", "bincount", "histogram",
+    # linalg
+    "addmm", "cholesky_solve", "triangular_solve", "inverse", "kron",
+    "inner", "outer", "matrix_power", "pinv", "qr", "svd", "eig", "eigvals",
+    "slogdet", "solve", "lstsq", "lu", "cond", "matrix_rank", "multi_dot",
+    "vector_norm", "matrix_norm", "corrcoef", "cov",
+    # complex views
+    "as_complex", "as_real", "real", "imag",
+    # manipulation
+    "diff", "rot90", "unflatten", "unstack", "view", "view_as", "crop",
+    "slice", "strided_slice", "tensor_split", "hsplit", "vsplit", "dsplit",
+    "unique_consecutive", "bucketize", "searchsorted", "multiplex",
+    "scatter_nd_add", "shard_index", "is_empty", "is_integer",
+    # bitwise shifts
+    "bitwise_left_shift", "bitwise_right_shift",
+    # random (in-place samplers + draws conditioned on self)
+    "bernoulli", "multinomial", "normal_", "uniform_", "exponential_",
+    "log_normal",
 ]
 
 

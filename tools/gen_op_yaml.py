@@ -1,0 +1,136 @@
+"""Regenerate paddle_tpu/ops/ops.yaml from the implemented op surface.
+
+The YAML is the single source of truth for the op registry (analogue of
+paddle/phi/api/yaml/ops.yaml, which drives the reference's API codegen —
+SURVEY §2.1). Here the flow is inverted only for bootstrap: this tool
+introspects the op modules once to seed the registry; from then on the
+consistency test (tests/test_op_registry.py) fails whenever the YAML and
+the implementation drift, so every new op must be registered.
+
+Usage: python tools/gen_op_yaml.py [--check]
+"""
+
+from __future__ import annotations
+
+import argparse
+import inspect
+import importlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OP_MODULES = [
+    "paddle_tpu.tensor.math",
+    "paddle_tpu.tensor.manipulation",
+    "paddle_tpu.tensor.creation",
+    "paddle_tpu.tensor.linalg",
+    "paddle_tpu.tensor.logic",
+    "paddle_tpu.tensor.search",
+    "paddle_tpu.tensor.random",
+    "paddle_tpu.tensor.stat",
+    "paddle_tpu.tensor.attribute",
+    "paddle_tpu.tensor.einsum",
+    "paddle_tpu.nn.functional.activation",
+    "paddle_tpu.nn.functional.common",
+    "paddle_tpu.nn.functional.conv",
+    "paddle_tpu.nn.functional.loss",
+    "paddle_tpu.nn.functional.norm",
+    "paddle_tpu.nn.functional.pooling",
+    "paddle_tpu.nn.functional.input",
+    "paddle_tpu.nn.functional.vision",
+    "paddle_tpu.nn.functional.attention",
+]
+
+YAML_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "paddle_tpu", "ops", "ops.yaml")
+
+
+def public_functions(mod):
+    names = getattr(mod, "__all__", None)
+    if names is None:
+        names = [n for n, v in vars(mod).items()
+                 if inspect.isfunction(v) and not n.startswith("_")]
+    out = []
+    for n in names:
+        fn = getattr(mod, n, None)
+        if inspect.isfunction(fn):
+            out.append((n, fn))
+    return out
+
+
+def signature_str(fn) -> str:
+    try:
+        return str(inspect.signature(fn))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def build_entries():
+    from paddle_tpu.core.tensor import Tensor
+
+    entries = []
+    seen = set()
+    for mod_name in OP_MODULES:
+        mod = importlib.import_module(mod_name)
+        for name, fn in public_functions(mod):
+            if fn.__module__ != mod_name:  # re-export; owned elsewhere
+                continue
+            if name in seen:
+                continue
+            seen.add(name)
+            entries.append({
+                "op": name,
+                "module": mod_name,
+                "args": signature_str(fn),
+                "tensor_method": hasattr(Tensor, name),
+                "inplace": hasattr(Tensor, name + "_"),
+            })
+    entries.sort(key=lambda e: e["op"])
+    return entries
+
+
+def render(entries) -> str:
+    lines = [
+        "# Op registry — single source of truth for the public op surface.",
+        "# Regenerate with: python tools/gen_op_yaml.py",
+        "# Validated by tests/test_op_registry.py (drift in either direction fails).",
+        "#",
+        "# Fields per op (≙ paddle/phi/api/yaml/ops.yaml entries):",
+        "#   op:            public name (also the _C_ops name)",
+        "#   module:        implementing python module",
+        "#   args:          python signature",
+        "#   tensor_method: patched onto Tensor",
+        "#   inplace:       has an <op>_ in-place variant on Tensor",
+        "",
+    ]
+    for e in entries:
+        lines.append(f"- op: {e['op']}")
+        lines.append(f"  module: {e['module']}")
+        lines.append(f"  args: \"{e['args']}\"")
+        lines.append(f"  tensor_method: {str(e['tensor_method']).lower()}")
+        lines.append(f"  inplace: {str(e['inplace']).lower()}")
+    return "\n".join(lines) + "\n"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if ops.yaml is stale")
+    args = ap.parse_args()
+    text = render(build_entries())
+    if args.check:
+        with open(YAML_PATH) as f:
+            if f.read() != text:
+                print("ops.yaml is stale; run python tools/gen_op_yaml.py")
+                sys.exit(1)
+        print("ops.yaml up to date")
+        return
+    os.makedirs(os.path.dirname(YAML_PATH), exist_ok=True)
+    with open(YAML_PATH, "w") as f:
+        f.write(text)
+    print(f"wrote {YAML_PATH}: {text.count('- op:')} ops")
+
+
+if __name__ == "__main__":
+    main()
