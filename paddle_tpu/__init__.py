@@ -40,6 +40,7 @@ from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import static  # noqa: F401
+from . import inference  # noqa: F401
 from . import metric  # noqa: F401
 from . import distributed  # noqa: F401
 from . import vision  # noqa: F401

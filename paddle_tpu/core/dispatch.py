@@ -51,6 +51,18 @@ def set_param_tracker(store):
     _param_tracker = store
 
 
+# Static-graph builder: paddle_tpu.static sets this under program_guard.
+# Ops touching at least one symbolic Variable are recorded into the Program
+# instead of executing (ops over concrete tensors still run eagerly — the
+# analogue of the startup program running during build).
+_static_builder = None
+
+
+def set_static_builder(fn):
+    global _static_builder
+    _static_builder = fn
+
+
 def _as_array(x):
     if isinstance(x, Tensor):
         return x._value
@@ -90,6 +102,10 @@ def dispatch(op_name: str, impl: Callable, tensor_args: Sequence,
     n_diff_outputs: if impl returns a tuple, how many leading outputs are
       differentiable (the rest, e.g. argmax indices, are detached).
     """
+    if _static_builder is not None and any(
+            isinstance(a, Tensor) and hasattr(a, "_static_var_id")
+            for a in tensor_args):
+        return _static_builder(op_name, impl, tensor_args)
     if _param_tracker is not None:
         for a in tensor_args:
             if isinstance(a, Tensor) and a._is_param:

@@ -35,6 +35,7 @@ class Tensor:
     __slots__ = (
         "_value", "stop_gradient", "_grad", "_node", "_out_index",
         "_grad_hooks", "name", "persistable", "_is_param", "_dist_attr",
+        "_static_var_id",  # set only on static-graph Variables (static mode)
         "__weakref__",
     )
 

@@ -1,0 +1,17 @@
+"""paddle_tpu.inference — the deployment/serving path.
+
+TPU-native analogue of the reference inference engine (SURVEY §2.8:
+``AnalysisPredictor`` at paddle/fluid/inference/api/analysis_predictor.h:94
+with Config, zero-copy IO handles, clone-per-thread). The redesign:
+
+- graph optimization (the 276 IR fuse passes + TensorRT subgraphs) is XLA's
+  job — the saved artifact is StableHLO, compiled AOT on first use and
+  cached persistently (jax compilation cache ≙ serialized TRT engines);
+- zero-copy IO maps to device arrays handed in/out without host staging;
+- ``Predictor.clone()`` shares weights between handles (≙
+  AnalysisPredictor::Clone for multi-thread serving).
+"""
+
+from .predictor import Config, Predictor, create_predictor  # noqa: F401
+
+__all__ = ["Config", "Predictor", "create_predictor"]

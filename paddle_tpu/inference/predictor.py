@@ -1,0 +1,189 @@
+"""Predictor implementation (≙ AnalysisPredictor, SURVEY §3.5).
+
+Serve path: Config names a saved model (paddle_tpu.jit.save artifact:
+StableHLO program + weights); create_predictor loads it, places weights on
+device once, and compiles the program AOT. ``run`` is the hot loop —
+one fused XLA executable call, no Python op dispatch.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Config:
+    """≙ paddle_infer.Config (analysis_config.cc)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # accept either the jit.save prefix or explicit file paths
+        if prog_file is not None and prog_file.endswith(".ptpu_model"):
+            self._prefix = prog_file[: -len(".ptpu_model")]
+        else:
+            self._prefix = prog_file
+        self._params_file = params_file
+        self._cache_dir: Optional[str] = None
+        self._memory_optim = True
+        self._glog_info = False
+        self._device = None
+
+    def set_model(self, prefix: str, params_file: Optional[str] = None):
+        self._prefix = prefix
+        self._params_file = params_file
+
+    def model_dir(self):
+        return self._prefix
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def set_compilation_cache_dir(self, path: str):
+        """Persistent XLA executable cache (≙ TRT engine serialization)."""
+        self._cache_dir = path
+
+    def enable_tpu(self):
+        self._device = "tpu"
+
+    def enable_use_gpu(self, *a, **k):  # accepted for API parity
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def summary(self) -> str:
+        return (f"Config(model={self._prefix!r}, device={self._device}, "
+                f"cache_dir={self._cache_dir!r})")
+
+
+class _IOHandle:
+    """Zero-copy style tensor handle (≙ ZeroCopyTensor)."""
+
+    def __init__(self, name: str, shape, dtype):
+        self.name = name
+        self._shape = tuple(shape)
+        self._dtype = dtype
+        self._array = None
+
+    def shape(self):
+        return list(self._shape)
+
+    def copy_from_cpu(self, data: np.ndarray):
+        self._array = jnp.asarray(data)
+
+    def share_external_data(self, array):
+        """True zero-copy: accept a device array without host staging."""
+        self._array = getattr(array, "_value", array)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._array is None:
+            raise RuntimeError(f"output {self.name!r} not produced yet; "
+                               "call predictor.run() first")
+        return np.asarray(self._array)
+
+    def to_device_array(self):
+        return self._array
+
+
+class Predictor:
+    def __init__(self, config: Config, _shared=None):
+        self.config = config
+        if _shared is not None:
+            (self._exported, self._param_values, self._in_spec,
+             self._compiled) = _shared
+        else:
+            prefix = config.model_dir()
+            if prefix is None:
+                raise ValueError("Config has no model path")
+            if config._cache_dir:
+                os.makedirs(config._cache_dir, exist_ok=True)
+                jax.config.update("jax_compilation_cache_dir",
+                                  config._cache_dir)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0)
+            from jax import export as jax_export
+            with open(prefix + ".ptpu_model", "rb") as f:
+                self._exported = jax_export.deserialize(f.read())
+            with open(prefix + ".ptpu_params", "rb") as f:
+                meta = pickle.load(f)
+            self._param_values = [jnp.asarray(v) for v in meta["values"]]
+            self._in_spec = meta["in_spec"]
+            exported = self._exported
+            self._compiled = jax.jit(
+                lambda pv, *ins: exported.call(pv, *ins))
+        self._inputs: Dict[str, _IOHandle] = {}
+        self._outputs: Dict[str, _IOHandle] = {}
+        self._out_values: Optional[tuple] = None
+        self._lock = threading.Lock()
+        for i, (shape, dtype) in enumerate(self._in_spec):
+            name = f"input_{i}"
+            self._inputs[name] = _IOHandle(name, shape, dtype)
+
+    # -- reference API surface --
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_input_handle(self, name: str) -> _IOHandle:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        self._ensure_ran()
+        return list(self._outputs)
+
+    def get_output_handle(self, name: str) -> _IOHandle:
+        self._ensure_ran()
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[List] = None):
+        """Execute the compiled program. Either feed via input handles
+        (reference style) or pass arrays directly and get arrays back."""
+        if inputs is not None:
+            arrays = [getattr(a, "_value", None) if hasattr(a, "_value")
+                      else jnp.asarray(a) for a in inputs]
+            arrays = [a if a is not None else jnp.asarray(b)
+                      for a, b in zip(arrays, inputs)]
+        else:
+            arrays = []
+            for name, h in self._inputs.items():
+                if h._array is None:
+                    raise RuntimeError(f"input {name!r} not set; call "
+                                       "copy_from_cpu first")
+                arrays.append(h._array)
+        with self._lock:
+            out = self._compiled(self._param_values, *arrays)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        self._outputs = {}
+        for i, o in enumerate(outs):
+            h = _IOHandle(f"output_{i}", o.shape, o.dtype)
+            h._array = o
+            self._outputs[h.name] = h
+        self._out_values = tuple(outs)
+        if inputs is not None:
+            return [np.asarray(o) for o in outs]
+        return True
+
+    def _ensure_ran(self):
+        if not self._outputs:
+            # run lazily if inputs are staged (reference returns names after
+            # graph load; we materialize them on first demand)
+            raise RuntimeError("no outputs yet; call run() first")
+
+    def clone(self) -> "Predictor":
+        """Share weights + executable with a new handle (per-thread serving,
+        ≙ AnalysisPredictor::Clone)."""
+        return Predictor(self.config,
+                         _shared=(self._exported, self._param_values,
+                                  self._in_spec, self._compiled))
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -42,6 +42,9 @@ class SGD(Optimizer):
         else:
             p._value = _sgd_update(p._value, grad, jnp.asarray(lr_, p._value.dtype))
 
+    def _functional_update(self):
+        return lambda p, g, lr: p - jnp.asarray(lr, p.dtype) * g.astype(p.dtype)
+
 
 @functools.partial(jax.jit, donate_argnums=(0, 2),
                    static_argnames=("use_nesterov",))

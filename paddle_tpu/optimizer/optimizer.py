@@ -145,9 +145,37 @@ class Optimizer:
 
     def _minimize(self, loss, startup_program=None, parameters=None,
                   no_grad_set=None):
+        if hasattr(loss, "_static_var_id"):  # static mode: record update ops
+            return self._minimize_static(loss, parameters)
         loss.backward()
         self.step()
         return None, None
+
+    def _minimize_static(self, loss, parameters=None):
+        """Static-graph path: append_backward + functional update recorded
+        into the Program; the Executor runs the update inside the compiled
+        program and writes the new values back (≙ optimizer ops appended to
+        a static Program)."""
+        from ..static.program import current_build_program
+        prog = current_build_program()
+        if prog is None:
+            raise RuntimeError("minimize(loss) on a static Variable must run "
+                               "under program_guard")
+        params_grads = prog.append_backward(loss, parameters or
+                                            self._parameter_list)
+        update = self._functional_update()
+        lr = self.get_lr()
+        for p, g in params_grads:
+            prog.updates.append((p, lambda pv, gv, _lr=lr: update(pv, gv, _lr)))
+        return params_grads, None
+
+    def _functional_update(self):
+        """Pure (param, grad, lr) -> new_param for static/compiled paths.
+        Subclasses with per-param state (Adam family) override or use
+        jit.TrainStep instead."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support static-mode minimize; "
+            "use SGD/Momentum or the compiled jit.TrainStep path")
 
     def clear_grad(self, set_to_zero=True):
         for p in self._parameter_list:

@@ -1,15 +1,28 @@
-"""paddle_tpu.static — static-graph API shims.
+"""paddle_tpu.static — static-graph API.
 
-On this framework "static mode" IS jit tracing (SURVEY §7: ProgramDesc/PIR ≙
-jaxpr/StableHLO).  The paddle.static surface maps accordingly: InputSpec is
-shared with paddle_tpu.jit; save/load_inference_model serialize exported
-StableHLO programs.
+TPU-native static mode (SURVEY §7: ProgramDesc/PIR ≙ captured DAG compiled
+as one XLA program). Two complementary surfaces:
+
+- Program capture: ``data`` + ``program_guard`` + ``Executor`` +
+  ``append_backward`` (see program.py) — the reference's
+  build-program-then-run workflow, compiled whole-program by XLA.
+- jit bridge: ``InputSpec`` and ``save/load_inference_model`` over
+  paddle_tpu.jit traced artifacts (the deployment path).
 """
 
 from ..jit.api import InputSpec
 from ..jit import save as _jit_save, load as _jit_load
+from .program import (  # noqa: F401
+    Program, program_guard, data, Executor, append_backward,
+    default_main_program, default_startup_program, global_scope,
+)
+from . import nn  # noqa: F401
 
-__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+__all__ = [
+    "InputSpec", "save_inference_model", "load_inference_model",
+    "Program", "program_guard", "data", "Executor", "append_backward",
+    "default_main_program", "default_startup_program", "global_scope", "nn",
+]
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,

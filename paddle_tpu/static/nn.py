@@ -1,0 +1,44 @@
+"""paddle_tpu.static.nn — static-graph layer builders (≙ paddle.static.nn).
+
+Each builder constructs the underlying nn layer eagerly (its parameters are
+concrete, registered as captured vars of the current Program — the
+startup-program role) and applies it to the symbolic input, recording the
+compute into the Program.
+"""
+
+from __future__ import annotations
+
+from ..nn.layer.common import Linear, Embedding
+from ..nn.layer.conv import Conv2D
+from ..nn.layer.norm import BatchNorm2D
+from ..nn import functional as F
+
+__all__ = ["fc", "embedding", "conv2d", "batch_norm"]
+
+
+def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+    layer = Linear(x.shape[-1], size)
+    out = layer(x)
+    if activation is not None:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, name=None):
+    layer = Embedding(size[0], size[1], padding_idx=padding_idx)
+    return layer(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           activation=None, name=None):
+    layer = Conv2D(input.shape[1], num_filters, filter_size, stride=stride,
+                   padding=padding)
+    out = layer(input)
+    if activation is not None:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def batch_norm(input, name=None):
+    layer = BatchNorm2D(input.shape[1])
+    return layer(input)

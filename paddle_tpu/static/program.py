@@ -1,0 +1,342 @@
+"""Static-graph mode: Program capture + compiled Executor.
+
+TPU-native redesign of the reference's static stack (SURVEY §2.2/§3.2:
+ProgramDesc ⊃ Blocks ⊃ Ops; ``exe.run`` → ``_ExecutorCache`` →
+``StandaloneExecutor`` → instruction DAG on a workqueue). Here the IR *is*
+the captured op DAG, and the executor is XLA:
+
+- ``static.data(name, shape, dtype)`` creates a feed Variable (a symbolic
+  Tensor holding an aval, no storage).
+- under ``program_guard`` every op that flows through the eager dispatcher
+  is recorded into the Program instead of executing (out-avals via
+  ``jax.eval_shape`` ≙ InferMeta); concrete Tensors crossing into the graph
+  become parameters/constants of the program (≙ persistable vars in Scope).
+- ``Executor.run(program, feed=…, fetch_list=…)`` replays the DAG as one
+  pure jax function, jit-compiles it per (program, feed-signature) — the
+  whole Program is ONE fused XLA executable, the TPU-correct analogue of
+  the instruction-by-instruction interpreter — and caches it (≙
+  _ExecutorCache at executor.py:816).
+- ``append_backward(loss)`` marks gradient outputs computed by ``jax.grad``
+  over the same replay (≙ base/backward.py's grad-op construction).
+- ``Optimizer.minimize(loss)`` in static mode records functional parameter
+  updates executed inside the same compiled program; updated values are
+  written back to the parameter tensors after each run (≙ optimizer ops +
+  Scope mutation).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch as _dispatch_mod
+from ..core.dtypes import convert_dtype
+from ..core.tensor import Tensor
+
+_var_ids = itertools.count()
+
+
+def _symbolic_tensor(aval, name=None) -> Tensor:
+    """A Tensor with no storage: `_value` is a ShapeDtypeStruct. Shape/dtype
+    queries work; any attempt to read data raises, like an uninitialized
+    static Variable in the reference."""
+    t = Tensor.__new__(Tensor)
+    t._value = aval  # jax.ShapeDtypeStruct quacks shape/dtype
+    t.stop_gradient = True
+    t._grad = None
+    t._node = None
+    t._out_index = 0
+    t._grad_hooks = []
+    t.name = name or f"var_{next(_var_ids)}"
+    t.persistable = False
+    t._is_param = False
+    t._dist_attr = None
+    return t
+
+
+class _OpRecord:
+    __slots__ = ("op_name", "impl", "inputs", "n_outputs", "out_ids")
+
+    def __init__(self, op_name, impl, inputs, n_outputs, out_ids):
+        self.op_name = op_name
+        self.impl = impl          # pure fn over jax arrays (attrs closed over)
+        self.inputs = inputs      # list of ("var", id) | ("const", key)
+        self.n_outputs = n_outputs
+        self.out_ids = out_ids
+
+
+class Program:
+    """Captured op DAG (≙ ProgramDesc, framework.proto:267)."""
+
+    def __init__(self):
+        self.ops: List[_OpRecord] = []
+        self.feeds: Dict[str, int] = {}       # feed name -> var id
+        self.var_avals: Dict[int, jax.ShapeDtypeStruct] = {}
+        self.var_names: Dict[int, str] = {}
+        # concrete tensors captured by the graph (params + constants):
+        self.captured: Dict[int, Tensor] = {}  # key=id(tensor)
+        self.grad_of: Dict[int, Tensor] = {}   # grad var id -> param tensor
+        self._loss_var: Optional[int] = None
+        self.updates: List = []   # (param, new_value_var_id)
+        self.version = 0
+
+    # -- building --
+    def add_feed(self, name, shape, dtype) -> Tensor:
+        aval = jax.ShapeDtypeStruct(tuple(shape), convert_dtype(dtype))
+        t = _symbolic_tensor(aval, name)
+        vid = next(_var_ids)
+        t._static_var_id = vid
+        self.feeds[name] = vid
+        self.var_avals[vid] = aval
+        self.var_names[vid] = name
+        self.version += 1
+        return t
+
+    def record(self, op_name, impl, tensor_args):
+        in_refs = []
+        in_avals = []
+        for a in tensor_args:
+            if isinstance(a, Tensor) and hasattr(a, "_static_var_id"):
+                in_refs.append(("var", a._static_var_id))
+                in_avals.append(self.var_avals[a._static_var_id])
+            elif isinstance(a, Tensor):
+                self.captured[id(a)] = a
+                in_refs.append(("const", id(a)))
+                in_avals.append(jax.ShapeDtypeStruct(
+                    tuple(a._value.shape), a._value.dtype))
+            else:
+                arr = jnp.asarray(a) if not isinstance(a, jax.Array) else a
+                holder = Tensor(arr)
+                self.captured[id(holder)] = holder
+                in_refs.append(("const", id(holder)))
+                in_avals.append(jax.ShapeDtypeStruct(
+                    tuple(arr.shape), arr.dtype))
+        out_aval = jax.eval_shape(impl, *in_avals)  # ≙ InferMeta
+        outs = out_aval if isinstance(out_aval, tuple) else (out_aval,)
+        out_ids = []
+        out_tensors = []
+        for av in outs:
+            vid = next(_var_ids)
+            t = _symbolic_tensor(av)
+            t._static_var_id = vid
+            self.var_avals[vid] = av
+            self.var_names[vid] = t.name
+            out_ids.append(vid)
+            out_tensors.append(t)
+        self.ops.append(_OpRecord(op_name, impl, in_refs, len(outs), out_ids))
+        self.version += 1
+        return (tuple(out_tensors) if isinstance(out_aval, tuple)
+                else out_tensors[0])
+
+    # -- backward / optimize --
+    def append_backward(self, loss: Tensor, parameter_list=None):
+        if not hasattr(loss, "_static_var_id"):
+            raise ValueError("append_backward: loss is not a Variable of "
+                             "this program")
+        self._loss_var = loss._static_var_id
+        params = [p for p in (parameter_list or
+                              [t for t in self.captured.values()
+                               if t._is_param])
+                  if not p.stop_gradient]
+        grads = []
+        for p in params:
+            gvid = next(_var_ids)
+            gt = _symbolic_tensor(jax.ShapeDtypeStruct(
+                tuple(p._value.shape), p._value.dtype), p.name + "@GRAD")
+            gt._static_var_id = gvid
+            self.var_avals[gvid] = gt._value
+            self.var_names[gvid] = gt.name
+            self.grad_of[gvid] = p
+            grads.append((p, gt))
+        self.version += 1
+        return grads
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        return [t for t in self.captured.values() if t._is_param]
+
+    def __str__(self):
+        lines = [f"Program(ops={len(self.ops)}, feeds={list(self.feeds)})"]
+        for op in self.ops:
+            ins = ", ".join(
+                self.var_names.get(k, "?") if kind == "var" else "const"
+                for kind, k in op.inputs)
+            outs = ", ".join(self.var_names[i] for i in op.out_ids)
+            lines.append(f"  {outs} = {op.op_name}({ins})")
+        return "\n".join(lines)
+
+
+_default_main_program = Program()
+_default_startup_program = Program()  # params init eagerly; kept for parity
+_building: List[Program] = []
+
+
+def default_main_program() -> Program:
+    return _default_main_program
+
+
+def default_startup_program() -> Program:
+    return _default_startup_program
+
+
+def current_build_program() -> Optional[Program]:
+    return _building[-1] if _building else None
+
+
+class program_guard:
+    """Route op capture into ``main_program`` (≙ base/framework.py
+    program_guard)."""
+
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self.main_program = main_program
+        self.startup_program = startup_program
+
+    def __enter__(self):
+        _building.append(self.main_program)
+        _dispatch_mod.set_static_builder(_record_into_current)
+        return self
+
+    def __exit__(self, *exc):
+        _building.pop()
+        if not _building:
+            _dispatch_mod.set_static_builder(None)
+        return False
+
+
+def _record_into_current(op_name, impl, tensor_args):
+    return _building[-1].record(op_name, impl, tensor_args)
+
+
+def data(name: str, shape: Sequence[int], dtype="float32", lod_level=0):
+    """Feed placeholder (≙ paddle.static.data)."""
+    prog = current_build_program()
+    if prog is None:
+        raise RuntimeError("static.data() must be called under program_guard")
+    shape = [1 if (s is None or s < 0) else s for s in shape]
+    return prog.add_feed(name, shape, dtype)
+
+
+def append_backward(loss, parameter_list=None):
+    prog = current_build_program() or default_main_program()
+    return prog.append_backward(loss, parameter_list)
+
+
+class Executor:
+    """Compiles and runs Programs (≙ base/executor.py:1036 over
+    StandaloneExecutor). The compile cache is keyed by (program identity,
+    program version, fetch ids) — the analogue of _ExecutorCache."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, return_numpy: bool = True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_ids = []
+        for f in fetch_list:
+            if not hasattr(f, "_static_var_id"):
+                raise ValueError(f"fetch target {f!r} is not a Variable of "
+                                 "the program")
+            fetch_ids.append(f._static_var_id)
+
+        key = (id(program), program.version, tuple(fetch_ids))
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._compile(program, fetch_ids)
+            self._cache[key] = entry
+        fn, param_keys, needs_grads = entry
+
+        feed_vals = []
+        for name in sorted(program.feeds):
+            if name not in feed:
+                raise ValueError(f"missing feed {name!r}")
+            v = feed[name]
+            v = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            feed_vals.append(v)
+        param_vals = [program.captured[k]._value for k in param_keys]
+
+        outs, new_params = fn(param_vals, feed_vals)
+        if new_params is not None:  # optimizer updates: write back to scope
+            for k, new in zip(param_keys, new_params):
+                program.captured[k]._value = new
+        results = [np.asarray(o) if return_numpy else Tensor(o) for o in outs]
+        return results
+
+    def _compile(self, program: Program, fetch_ids):
+        param_keys = sorted(program.captured)
+        key_pos = {k: i for i, k in enumerate(param_keys)}
+        grad_fetches = [fid for fid in fetch_ids if fid in program.grad_of]
+        needs_grads = bool(grad_fetches) or bool(program.updates)
+
+        def replay(param_vals, feed_vals):
+            env = {}
+            for i, name in enumerate(sorted(program.feeds)):
+                env[program.feeds[name]] = feed_vals[i]
+
+            def read(ref):
+                kind, k = ref
+                return env[k] if kind == "var" else param_vals[key_pos[k]]
+
+            for op in program.ops:
+                ins = [read(r) for r in op.inputs]
+                out = op.impl(*ins)
+                outs = out if isinstance(out, tuple) else (out,)
+                for vid, o in zip(op.out_ids, outs):
+                    env[vid] = o
+            return env
+
+        # parameters whose grads are demanded (fetch or updates)
+        grad_params = [program.grad_of[fid] for fid in grad_fetches]
+        upd_params = [p for (p, _) in program.updates]
+        diff_tensors = {id(p): p for p in grad_params + upd_params}
+        diff_keys = list(diff_tensors)
+
+        def fn(param_vals, feed_vals):
+            if needs_grads:
+                def loss_of(diff_vals):
+                    pv = list(param_vals)
+                    for k, v in zip(diff_keys, diff_vals):
+                        pv[key_pos[k]] = v
+                    env = replay(pv, feed_vals)
+                    return env[program._loss_var]
+
+                diff_vals = [param_vals[key_pos[k]] for k in diff_keys]
+                loss, grads = jax.value_and_grad(loss_of)(diff_vals)
+                grad_by_key = dict(zip(diff_keys, grads))
+                env = replay(param_vals, feed_vals)
+                outs = []
+                for fid in fetch_ids:
+                    if fid in program.grad_of:
+                        outs.append(grad_by_key[id(program.grad_of[fid])])
+                    else:
+                        outs.append(env[fid])
+                new_params = None
+                if program.updates:
+                    new_params = list(param_vals)
+                    for p, update_fn in program.updates:
+                        i = key_pos[id(p)]
+                        new_params[i] = update_fn(param_vals[i],
+                                                  grad_by_key[id(p)])
+                return outs, new_params
+            env = replay(param_vals, feed_vals)
+            return [env[fid] for fid in fetch_ids], None
+
+        jfn = jax.jit(fn)
+        return jfn, param_keys, needs_grads
+
+
+_global_scope = {}
+
+
+def global_scope():
+    return _global_scope

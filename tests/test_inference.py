@@ -1,0 +1,86 @@
+"""Inference engine: save -> Config/create_predictor -> IO handles -> run,
+clone-per-thread sharing, persistent compile cache config."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    paddle.seed(7)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+    prefix = str(tmp_path_factory.mktemp("infer") / "model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[paddle.static.InputSpec([2, 8], "float32")])
+    x = np.random.default_rng(0).standard_normal((2, 8)).astype("float32")
+    expected = net(paddle.to_tensor(x)).numpy()
+    return prefix, x, expected
+
+
+def test_predictor_handle_workflow(saved_model):
+    prefix, x, expected = saved_model
+    config = inference.Config(prefix)
+    config.enable_memory_optim()
+    predictor = inference.create_predictor(config)
+    in_names = predictor.get_input_names()
+    assert in_names == ["input_0"]
+    h = predictor.get_input_handle(in_names[0])
+    assert h.shape() == [2, 8]
+    h.copy_from_cpu(x)
+    assert predictor.run() is True
+    out_names = predictor.get_output_names()
+    out = predictor.get_output_handle(out_names[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_direct_run(saved_model):
+    prefix, x, expected = saved_model
+    predictor = inference.create_predictor(inference.Config(prefix))
+    outs = predictor.run([x])
+    np.testing.assert_allclose(outs[0], expected, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_clone_shares_weights(saved_model):
+    prefix, x, expected = saved_model
+    p1 = inference.create_predictor(inference.Config(prefix))
+    p2 = p1.clone()
+    assert p2._param_values is p1._param_values
+    results = {}
+
+    def serve(pred, key):
+        results[key] = pred.run([x])[0]
+
+    t1 = threading.Thread(target=serve, args=(p1, "a"))
+    t2 = threading.Thread(target=serve, args=(p2, "b"))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    np.testing.assert_allclose(results["a"], expected, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(results["b"], expected, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_errors(saved_model):
+    prefix, _, _ = saved_model
+    predictor = inference.create_predictor(inference.Config(prefix))
+    with pytest.raises(RuntimeError, match="not set"):
+        predictor.run()
+    with pytest.raises(RuntimeError, match="run"):
+        predictor.get_output_names()
+    with pytest.raises(ValueError, match="model path"):
+        inference.create_predictor(inference.Config())
+
+
+def test_compilation_cache_dir(saved_model, tmp_path):
+    prefix, x, expected = saved_model
+    cache = str(tmp_path / "xla_cache")
+    config = inference.Config(prefix)
+    config.set_compilation_cache_dir(cache)
+    predictor = inference.create_predictor(config)
+    outs = predictor.run([x])
+    np.testing.assert_allclose(outs[0], expected, rtol=1e-5, atol=1e-5)
+    assert os.path.isdir(cache)

@@ -1,0 +1,121 @@
+"""Static-graph mode: program capture, Executor compile+run, backward,
+minimize-driven training, static.nn builders, program cache reuse."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def test_program_capture_and_run():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8], "float32")
+        y = static.data("y", [4, 8], "float32")
+        z = paddle.add(paddle.multiply(x, y), paddle.to_tensor(1.0))
+        w = z.sum()
+    assert len(prog.ops) >= 3
+    exe = static.Executor()
+    xv = np.random.rand(4, 8).astype("float32")
+    yv = np.random.rand(4, 8).astype("float32")
+    z_out, w_out = exe.run(prog, feed={"x": xv, "y": yv},
+                           fetch_list=[z, w])
+    np.testing.assert_allclose(z_out, xv * yv + 1.0, rtol=1e-6)
+    np.testing.assert_allclose(w_out, (xv * yv + 1.0).sum(), rtol=1e-5)
+
+
+def test_program_str_and_missing_feed():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 2], "float32")
+        y = paddle.exp(x)
+    s = str(prog)
+    assert "exp" in s
+    exe = static.Executor()
+    with pytest.raises(ValueError, match="missing feed"):
+        exe.run(prog, feed={}, fetch_list=[y])
+
+
+def test_symbolic_ops_execute_nothing_eagerly():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [3], "float32")
+        y = paddle.sqrt(x)
+        # symbolic tensors know shape/dtype but hold no data
+        assert y.shape == [3]
+        assert str(y.dtype) == "float32"
+    # eager ops outside the guard are unaffected
+    t = paddle.to_tensor(np.float32(4.0))
+    assert float(paddle.sqrt(t)) == 2.0
+
+
+def test_append_backward_grad_fetch():
+    prog = static.Program()
+    lin = paddle.nn.Linear(4, 3)
+    with static.program_guard(prog):
+        x = static.data("x", [2, 4], "float32")
+        out = lin(x)
+        loss = out.sum()
+        grads = static.append_backward(loss)
+    assert grads
+    param_to_grad = {p.name: g for p, g in grads}
+    exe = static.Executor()
+    xv = np.random.rand(2, 4).astype("float32")
+    (gw,) = exe.run(prog, feed={"x": xv},
+                    fetch_list=[param_to_grad[lin.weight.name]])
+    # d(sum(xW+b))/dW = x^T . ones
+    np.testing.assert_allclose(gw, xv.T @ np.ones((2, 3), np.float32),
+                               rtol=1e-5)
+
+
+def test_static_training_with_minimize():
+    prog = static.Program()
+    lin = paddle.nn.Linear(2, 1)
+    sgd = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    with static.program_guard(prog):
+        x = static.data("x", [8, 2], "float32")
+        y = static.data("y", [8, 1], "float32")
+        pred = lin(x)
+        loss = ((pred - y) ** 2).mean()
+        sgd.minimize(loss)
+    exe = static.Executor()
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((8, 2)).astype("float32")
+    yv = (xv @ np.array([[2.0], [-1.0]], np.float32)).astype("float32")
+    losses = []
+    for _ in range(30):
+        (lv,) = exe.run(prog, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.1  # parameters actually update
+
+
+def test_static_nn_fc():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 16], "float32")
+        h = static.nn.fc(x, 8, activation="relu")
+        assert h.shape == [4, 8]
+    exe = static.Executor()
+    (hv,) = exe.run(prog, feed={"x": np.ones((4, 16), np.float32)},
+                    fetch_list=[h])
+    assert hv.shape == (4, 8)
+    assert (hv >= 0).all()
+
+
+def test_executor_cache_reuse():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2], "float32")
+        y = paddle.scale(x, 3.0)
+    exe = static.Executor()
+    exe.run(prog, feed={"x": np.ones(2, np.float32)}, fetch_list=[y])
+    n_entries = len(exe._cache)
+    exe.run(prog, feed={"x": np.zeros(2, np.float32)}, fetch_list=[y])
+    assert len(exe._cache) == n_entries  # same compiled program reused
+
+
+def test_data_requires_guard():
+    with pytest.raises(RuntimeError, match="program_guard"):
+        static.data("x", [1], "float32")
