@@ -42,6 +42,10 @@ from . import jit  # noqa: F401
 from . import static  # noqa: F401
 from . import inference  # noqa: F401
 from . import metric  # noqa: F401
+from . import distribution  # noqa: F401
+from . import sparse  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from . import distributed  # noqa: F401
 from . import vision  # noqa: F401
 from . import incubate  # noqa: F401

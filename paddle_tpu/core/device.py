@@ -120,3 +120,104 @@ def is_tpu_backend() -> bool:
 def synchronize():
     """Block until all dispatched device work completes."""
     (jax.device_put(0.0) + 0).block_until_ready()
+
+
+class Stream:
+    """Stream facade (≙ paddle.device.Stream / cuda streams).
+
+    XLA owns stream scheduling on TPU — compiled programs already overlap
+    compute, HBM traffic and collectives — so a Stream here is an ordering
+    scope: ``synchronize`` drains the device; ``record_event``/``wait_event``
+    give the reference's event-ordering API over block_until_ready.
+    """
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+        self.priority = priority
+
+    def synchronize(self):
+        synchronize()
+
+    def record_event(self, event: "Event" = None) -> "Event":
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def wait_event(self, event: "Event"):
+        event.synchronize()
+
+    def wait_stream(self, stream: "Stream"):
+        stream.synchronize()
+
+
+class Event:
+    """Event facade (≙ paddle.device.Event): records a point in the
+    dispatched work; query/synchronize/elapsed_time over host clocks after a
+    device drain."""
+
+    def __init__(self, enable_timing: bool = True, blocking: bool = False):
+        self.enable_timing = enable_timing
+        self._time_ns = None
+
+    def record(self, stream: Optional[Stream] = None):
+        from ..runtime import now_ns
+        synchronize()  # device-complete timestamp
+        self._time_ns = now_ns()
+
+    def query(self) -> bool:
+        return self._time_ns is not None
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end_event: "Event") -> float:
+        """Milliseconds between two recorded events."""
+        if self._time_ns is None or end_event._time_ns is None:
+            raise RuntimeError("both events must be recorded")
+        return (end_event._time_ns - self._time_ns) / 1e6
+
+
+_default_stream = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    return _default_stream
+
+
+def stream_guard(stream: Stream):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        yield stream
+
+    return guard()
+
+
+def memory_stats(device: Optional[str] = None) -> dict:
+    """Device memory statistics: HBM numbers from PJRT plus host-runtime
+    counters (≙ paddle/fluid/memory/stats.h surfaced via paddle.device)."""
+    from .. import runtime as rt
+    place = current_place() if device is None else set_device(device)
+    stats = {}
+    try:
+        dev_stats = place.jax_device().memory_stats() or {}
+        stats.update(dev_stats)
+    except Exception:
+        pass
+    for name in rt.stat_names():
+        stats[f"host.{name}"] = rt.stat_current(name)
+    return stats
+
+
+def max_memory_allocated(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+
+
+def memory_allocated(device=None) -> int:
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def empty_cache():
+    """No-op on XLA (allocator is runtime-managed); kept for API parity."""

@@ -1,0 +1,27 @@
+"""paddle_tpu.distribution — probability distributions.
+
+Analogue of ``python/paddle/distribution/`` (SURVEY §2.9: ~20 distributions,
+transforms, KL registry). Distributions are Tensor-in/Tensor-out; sampling
+draws keys from the global Generator so it composes with paddle.seed and
+stays jit-traceable under to_static (counter-based PRNG).
+"""
+
+from .distribution import (  # noqa: F401
+    Distribution, Normal, Uniform, Bernoulli, Categorical, Multinomial,
+    Beta, Gamma, Dirichlet, Exponential, Laplace, LogNormal, Cauchy,
+    Geometric, Gumbel, Poisson, StudentT, Binomial,
+)
+from .kl import kl_divergence, register_kl  # noqa: F401
+from .transform import (  # noqa: F401
+    Transform, AffineTransform, ExpTransform, SigmoidTransform,
+    TanhTransform, ChainTransform, TransformedDistribution,
+)
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+    "Multinomial", "Beta", "Gamma", "Dirichlet", "Exponential", "Laplace",
+    "LogNormal", "Cauchy", "Geometric", "Gumbel", "Poisson", "StudentT",
+    "Binomial", "kl_divergence", "register_kl", "Transform",
+    "AffineTransform", "ExpTransform", "SigmoidTransform", "TanhTransform",
+    "ChainTransform", "TransformedDistribution",
+]

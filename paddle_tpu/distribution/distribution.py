@@ -1,0 +1,568 @@
+"""Distribution implementations (≙ python/paddle/distribution/*.py).
+
+Each distribution stores broadcast parameters as jax arrays and exposes the
+reference surface: sample/rsample, log_prob/prob, entropy, mean/variance,
+cdf where standard. Reparameterized sampling (rsample) is provided where
+the pathwise gradient is well-defined.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp_special
+
+from ..core import generator as _generator
+from ..core.tensor import Tensor
+
+
+def _arr(x, dtype=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if dtype is not None and v.dtype != dtype:
+        v = v.astype(dtype)
+    if jnp.issubdtype(v.dtype, jnp.integer):
+        v = v.astype(jnp.float32)
+    return v
+
+
+def _key():
+    return _generator.default_generator().next_key()
+
+
+def _shape(sample_shape, batch_shape, event_shape=()):
+    return tuple(sample_shape) + tuple(batch_shape) + tuple(event_shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape: Sequence[int] = ()):
+        return Tensor(jax.lax.stop_gradient(self._sample(tuple(shape))))
+
+    def rsample(self, shape: Sequence[int] = ()):
+        return Tensor(self._sample(tuple(shape)))
+
+    def log_prob(self, value):
+        return Tensor(self._log_prob(_arr(value)))
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self._log_prob(_arr(value))))
+
+    def entropy(self):
+        return Tensor(self._entropy())
+
+    def _sample(self, shape):
+        raise NotImplementedError
+
+    def _log_prob(self, value):
+        raise NotImplementedError
+
+    def _entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self._batch_shape))
+
+    def _sample(self, shape):
+        eps = jax.random.normal(_key(), _shape(shape, self._batch_shape))
+        return self.loc + self.scale * eps
+
+    def _log_prob(self, v):
+        var = self.scale ** 2
+        return -((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) \
+            - 0.5 * math.log(2 * math.pi)
+
+    def _entropy(self):
+        out = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return jnp.broadcast_to(out, self._batch_shape)
+
+    def cdf(self, value):
+        z = (_arr(value) - self.loc) / (self.scale * math.sqrt(2))
+        return Tensor(0.5 * (1 + jax.scipy.special.erf(z)))
+
+    def kl_divergence(self, other: "Normal"):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to((self.low + self.high) / 2,
+                                       self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to((self.high - self.low) ** 2 / 12,
+                                       self._batch_shape))
+
+    def _sample(self, shape):
+        u = jax.random.uniform(_key(), _shape(shape, self._batch_shape))
+        return self.low + (self.high - self.low) * u
+
+    def _log_prob(self, v):
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(self.high - self.low),
+                                self._batch_shape)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _arr(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _arr(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def _sample(self, shape):
+        return jax.random.bernoulli(
+            _key(), self.probs, _shape(shape, self._batch_shape)
+        ).astype(jnp.float32)
+
+    def _log_prob(self, v):
+        return v * jax.nn.log_sigmoid(self.logits) + \
+            (1 - v) * jax.nn.log_sigmoid(-self.logits)
+
+    def _entropy(self):
+        p = self.probs
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        # reference Categorical(logits=unnormalized probs); accept both
+        if logits is not None:
+            arr = _arr(logits)
+            # reference treats `logits` as unnormalized nonneg scores only if
+            # explicitly probabilities; standard interpretation: log-space
+            self.logits = jax.nn.log_softmax(arr, axis=-1)
+        else:
+            p = _arr(probs)
+            self.logits = jnp.log(p / jnp.sum(p, -1, keepdims=True))
+        self.probs = jnp.exp(self.logits)
+        super().__init__(self.logits.shape[:-1])
+        self._n = self.logits.shape[-1]
+
+    @property
+    def mean(self):  # undefined; parity with reference raising
+        raise NotImplementedError("Categorical has no mean")
+
+    def _sample(self, shape):
+        return jax.random.categorical(
+            _key(), self.logits, shape=_shape(shape, self._batch_shape))
+
+    def _log_prob(self, v):
+        idx = v.astype(jnp.int32)
+        return jnp.take_along_axis(
+            jnp.broadcast_to(self.logits, idx.shape + (self._n,)),
+            idx[..., None], axis=-1)[..., 0]
+
+    def _entropy(self):
+        return -jnp.sum(self.probs * self.logits, axis=-1)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _arr(probs)
+        self.probs = self.probs / jnp.sum(self.probs, -1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def _sample(self, shape):
+        logits = jnp.log(self.probs)
+        draws = jax.random.categorical(
+            _key(), logits,
+            shape=(self.total_count,) + _shape(shape, self._batch_shape))
+        n = self.probs.shape[-1]
+        counts = jax.nn.one_hot(draws, n).sum(0)
+        return counts
+
+    def _log_prob(self, v):
+        logits = jnp.log(self.probs)
+        return (jsp_special.gammaln(self.total_count + 1.0)
+                - jnp.sum(jsp_special.gammaln(v + 1.0), -1)
+                + jnp.sum(v * logits, -1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s * s * (s + 1)))
+
+    def _sample(self, shape):
+        return jax.random.beta(_key(), self.alpha, self.beta,
+                               _shape(shape, self._batch_shape))
+
+    def _log_prob(self, v):
+        return ((self.alpha - 1) * jnp.log(v) +
+                (self.beta - 1) * jnp.log1p(-v) -
+                (jsp_special.gammaln(self.alpha) +
+                 jsp_special.gammaln(self.beta) -
+                 jsp_special.gammaln(self.alpha + self.beta)))
+
+    def _entropy(self):
+        a, b = self.alpha, self.beta
+        lbeta = (jsp_special.gammaln(a) + jsp_special.gammaln(b)
+                 - jsp_special.gammaln(a + b))
+        return (lbeta - (a - 1) * jsp_special.digamma(a)
+                - (b - 1) * jsp_special.digamma(b)
+                + (a + b - 2) * jsp_special.digamma(a + b))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2)
+
+    def _sample(self, shape):
+        g = jax.random.gamma(_key(), self.concentration,
+                             _shape(shape, self._batch_shape))
+        return g / self.rate
+
+    def _log_prob(self, v):
+        a, r = self.concentration, self.rate
+        return (a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v
+                - jsp_special.gammaln(a))
+
+    def _entropy(self):
+        a, r = self.concentration, self.rate
+        return (a - jnp.log(r) + jsp_special.gammaln(a)
+                + (1 - a) * jsp_special.digamma(a))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration /
+                      jnp.sum(self.concentration, -1, keepdims=True))
+
+    def _sample(self, shape):
+        return jax.random.dirichlet(_key(), self.concentration,
+                                    _shape(shape, self._batch_shape))
+
+    def _log_prob(self, v):
+        a = self.concentration
+        return (jnp.sum((a - 1) * jnp.log(v), -1)
+                + jsp_special.gammaln(jnp.sum(a, -1))
+                - jnp.sum(jsp_special.gammaln(a), -1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate ** -2)
+
+    def _sample(self, shape):
+        e = jax.random.exponential(_key(), _shape(shape, self._batch_shape))
+        return e / self.rate
+
+    def _log_prob(self, v):
+        return jnp.log(self.rate) - self.rate * v
+
+    def _entropy(self):
+        return jnp.broadcast_to(1 - jnp.log(self.rate), self._batch_shape)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(2 * self.scale ** 2,
+                                       self._batch_shape))
+
+    def _sample(self, shape):
+        u = jax.random.laplace(_key(), _shape(shape, self._batch_shape))
+        return self.loc + self.scale * u
+
+    def _log_prob(self, v):
+        return -jnp.abs(v - self.loc) / self.scale - \
+            jnp.log(2 * self.scale)
+
+    def _entropy(self):
+        return jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                self._batch_shape)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def _sample(self, shape):
+        eps = jax.random.normal(_key(), _shape(shape, self._batch_shape))
+        return jnp.exp(self.loc + self.scale * eps)
+
+    def _log_prob(self, v):
+        logv = jnp.log(v)
+        return (-((logv - self.loc) ** 2) / (2 * self.scale ** 2)
+                - logv - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def _entropy(self):
+        return self.loc + 0.5 + 0.5 * math.log(2 * math.pi) + \
+            jnp.log(self.scale)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def _sample(self, shape):
+        return jax.random.cauchy(
+            _key(), _shape(shape, self._batch_shape)) * self.scale + self.loc
+
+    def _log_prob(self, v):
+        z = (v - self.loc) / self.scale
+        return -jnp.log(math.pi * self.scale * (1 + z * z))
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(4 * math.pi * self.scale),
+                                self._batch_shape)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p for k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs) / self.probs ** 2)
+
+    def _sample(self, shape):
+        u = jax.random.uniform(_key(), _shape(shape, self._batch_shape))
+        return jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs))
+
+    def _log_prob(self, v):
+        return v * jnp.log1p(-self.probs) + jnp.log(self.probs)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * 0.5772156649015329)
+
+    @property
+    def variance(self):
+        return Tensor((math.pi ** 2 / 6) * self.scale ** 2)
+
+    def _sample(self, shape):
+        g = jax.random.gumbel(_key(), _shape(shape, self._batch_shape))
+        return self.loc + self.scale * g
+
+    def _log_prob(self, v):
+        z = (v - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(self.scale) + 1.5772156649015329,
+                                self._batch_shape)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def _sample(self, shape):
+        return jax.random.poisson(
+            _key(), self.rate,
+            _shape(shape, self._batch_shape)).astype(jnp.float32)
+
+    def _log_prob(self, v):
+        return v * jnp.log(self.rate) - self.rate - \
+            jsp_special.gammaln(v + 1.0)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+    def _sample(self, shape):
+        t = jax.random.t(_key(), self.df, _shape(shape, self._batch_shape))
+        return self.loc + self.scale * t
+
+    def _log_prob(self, v):
+        d = self.df
+        z = (v - self.loc) / self.scale
+        return (jsp_special.gammaln((d + 1) / 2)
+                - jsp_special.gammaln(d / 2)
+                - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                - (d + 1) / 2 * jnp.log1p(z * z / d))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def _sample(self, shape):
+        draws = jax.random.bernoulli(
+            _key(), self.probs,
+            (self.total_count,) + _shape(shape, self._batch_shape))
+        return draws.astype(jnp.float32).sum(0)
+
+    def _log_prob(self, v):
+        n = self.total_count
+        return (jsp_special.gammaln(n + 1.0)
+                - jsp_special.gammaln(v + 1.0)
+                - jsp_special.gammaln(n - v + 1.0)
+                + v * jnp.log(self.probs)
+                + (n - v) * jnp.log1p(-self.probs))

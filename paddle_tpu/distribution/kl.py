@@ -1,0 +1,101 @@
+"""KL divergence registry (≙ python/paddle/distribution/kl.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy import special as jsp_special
+
+from ..core.tensor import Tensor
+from . import distribution as D
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q) -> Tensor:
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return Tensor(fn(p, q))
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(D.Normal, D.Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+@register_kl(D.Uniform, D.Uniform)
+def _kl_uniform(p, q):
+    result = jnp.log((q.high - q.low) / (p.high - p.low))
+    return jnp.where((q.low <= p.low) & (p.high <= q.high), result, jnp.inf)
+
+
+@register_kl(D.Categorical, D.Categorical)
+def _kl_categorical(p, q):
+    return jnp.sum(p.probs * (p.logits - q.logits), axis=-1)
+
+
+@register_kl(D.Bernoulli, D.Bernoulli)
+def _kl_bernoulli(p, q):
+    a = p.probs * (jnp.log(p.probs) - jnp.log(q.probs))
+    b = (1 - p.probs) * (jnp.log1p(-p.probs) - jnp.log1p(-q.probs))
+    return a + b
+
+
+@register_kl(D.Beta, D.Beta)
+def _kl_beta(p, q):
+    sum_p = p.alpha + p.beta
+    lbeta_p = (jsp_special.gammaln(p.alpha) + jsp_special.gammaln(p.beta)
+               - jsp_special.gammaln(sum_p))
+    lbeta_q = (jsp_special.gammaln(q.alpha) + jsp_special.gammaln(q.beta)
+               - jsp_special.gammaln(q.alpha + q.beta))
+    return (lbeta_q - lbeta_p
+            + (p.alpha - q.alpha) * jsp_special.digamma(p.alpha)
+            + (p.beta - q.beta) * jsp_special.digamma(p.beta)
+            + (q.alpha - p.alpha + q.beta - p.beta)
+            * jsp_special.digamma(sum_p))
+
+
+@register_kl(D.Exponential, D.Exponential)
+def _kl_exponential(p, q):
+    ratio = q.rate / p.rate
+    return jnp.log(1 / ratio) + ratio - 1
+
+
+@register_kl(D.Gamma, D.Gamma)
+def _kl_gamma(p, q):
+    return ((p.concentration - q.concentration)
+            * jsp_special.digamma(p.concentration)
+            - jsp_special.gammaln(p.concentration)
+            + jsp_special.gammaln(q.concentration)
+            + q.concentration * (jnp.log(p.rate) - jnp.log(q.rate))
+            + p.concentration * (q.rate / p.rate - 1))
+
+
+@register_kl(D.Dirichlet, D.Dirichlet)
+def _kl_dirichlet(p, q):
+    a0 = jnp.sum(p.concentration, -1)
+    return (jsp_special.gammaln(a0)
+            - jnp.sum(jsp_special.gammaln(p.concentration), -1)
+            - jsp_special.gammaln(jnp.sum(q.concentration, -1))
+            + jnp.sum(jsp_special.gammaln(q.concentration), -1)
+            + jnp.sum((p.concentration - q.concentration)
+                      * (jsp_special.digamma(p.concentration)
+                         - jsp_special.digamma(a0)[..., None]), -1))
+
+
+@register_kl(D.Laplace, D.Laplace)
+def _kl_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    loc_abs = jnp.abs(p.loc - q.loc) / q.scale
+    return (-jnp.log(scale_ratio) + scale_ratio *
+            jnp.exp(-loc_abs / scale_ratio) + loc_abs - 1)

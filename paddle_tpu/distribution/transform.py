@@ -1,0 +1,121 @@
+"""Bijective transforms + TransformedDistribution
+(≙ python/paddle/distribution/transform.py, transformed_distribution.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _arr
+
+
+class Transform:
+    def forward(self, x):
+        return Tensor(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._fldj(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(-self._fldj(self._inverse(_arr(y))))
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return jax.nn.log_sigmoid(x) + jax.nn.log_sigmoid(-x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base: Distribution, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transform = (transforms[0] if len(transforms) == 1
+                          else ChainTransform(transforms))
+        super().__init__(base._batch_shape, base._event_shape)
+
+    def _sample(self, shape):
+        return self.transform._forward(self.base._sample(shape))
+
+    def _log_prob(self, v):
+        x = self.transform._inverse(v)
+        return self.base._log_prob(x) - self.transform._fldj(x)

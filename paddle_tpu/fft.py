@@ -1,0 +1,81 @@
+"""paddle_tpu.fft — FFT family (≙ python/paddle/fft.py over pocketfft;
+here XLA's native FFT, which lowers to the TPU's FFT implementation)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import dispatch
+from .core.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+    "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftfreq",
+    "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    return None if norm == "backward" else norm
+
+
+def _mk1d(jfn, name):
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return dispatch(name, lambda a: jfn(a, n=n, axis=axis,
+                                            norm=_norm(norm)), (x,))
+    op.__name__ = name
+    return op
+
+
+fft = _mk1d(jnp.fft.fft, "fft")
+ifft = _mk1d(jnp.fft.ifft, "ifft")
+rfft = _mk1d(jnp.fft.rfft, "rfft")
+irfft = _mk1d(jnp.fft.irfft, "irfft")
+hfft = _mk1d(jnp.fft.hfft, "hfft")
+ihfft = _mk1d(jnp.fft.ihfft, "ihfft")
+
+
+def _mk2d(jfn, name):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name_=None):
+        return dispatch(name, lambda a: jfn(a, s=s, axes=axes,
+                                            norm=_norm(norm)), (x,))
+    op.__name__ = name
+    return op
+
+
+fft2 = _mk2d(jnp.fft.fft2, "fft2")
+ifft2 = _mk2d(jnp.fft.ifft2, "ifft2")
+rfft2 = _mk2d(jnp.fft.rfft2, "rfft2")
+irfft2 = _mk2d(jnp.fft.irfft2, "irfft2")
+
+
+def _mknd(jfn, name):
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        return dispatch(name, lambda a: jfn(a, s=s, axes=axes,
+                                            norm=_norm(norm)), (x,))
+    op.__name__ = name
+    return op
+
+
+fftn = _mknd(jnp.fft.fftn, "fftn")
+ifftn = _mknd(jnp.fft.ifftn, "ifftn")
+rfftn = _mknd(jnp.fft.rfftn, "rfftn")
+irfftn = _mknd(jnp.fft.irfftn, "irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d=d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d=d))
+
+
+def fftshift(x, axes=None, name=None):
+    return dispatch("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes),
+                    (x,))
+
+
+def ifftshift(x, axes=None, name=None):
+    return dispatch("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes),
+                    (x,))

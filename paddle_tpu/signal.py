@@ -1,0 +1,112 @@
+"""paddle_tpu.signal — STFT/ISTFT (≙ python/paddle/signal.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import dispatch
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames along ``axis`` (last-axis framing)."""
+
+    def impl(a):
+        n = a.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(num)[:, None] * hop_length +
+               jnp.arange(frame_length)[None, :])
+        moved = jnp.moveaxis(a, axis, -1)
+        framed = moved[..., idx]  # [..., num, frame_length]
+        return jnp.moveaxis(framed, (-2, -1), (axis - 1 if axis < 0 else axis,
+                                               axis if axis < 0 else axis + 1))
+
+    return dispatch("frame", impl, (x,))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def impl(a):
+        # expects [..., frames, frame_length] on the last two axes
+        moved = jnp.moveaxis(a, axis, -1) if axis != -1 else a
+        *batch, frames, flen = moved.shape
+        out_len = (frames - 1) * hop_length + flen
+        out = jnp.zeros((*batch, out_len), moved.dtype)
+        for i in range(frames):  # static unroll: frames is static under jit
+            out = out.at[..., i * hop_length: i * hop_length + flen].add(
+                moved[..., i, :])
+        return out
+
+    return dispatch("overlap_add", impl, (x,))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win_arr = None if window is None else (
+        window._value if isinstance(window, Tensor) else jnp.asarray(window))
+
+    def impl(a):
+        sig = a
+        if center:
+            pad = [(0, 0)] * (sig.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            sig = jnp.pad(sig, pad, mode=pad_mode)
+        n = sig.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(num)[:, None] * hop_length +
+               jnp.arange(n_fft)[None, :])
+        frames = sig[..., idx]  # [..., num, n_fft]
+        if win_arr is not None:
+            w = win_arr
+            if win_length < n_fft:
+                lp = (n_fft - win_length) // 2
+                w = jnp.pad(w, (lp, n_fft - win_length - lp))
+            frames = frames * w
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        # reference layout: [..., n_freq, num_frames]
+        return jnp.swapaxes(spec, -1, -2)
+
+    return dispatch("stft", impl, (x,))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win_arr = None if window is None else (
+        window._value if isinstance(window, Tensor) else jnp.asarray(window))
+
+    def impl(spec_in):
+        spec = jnp.swapaxes(spec_in, -1, -2)  # [..., frames, n_freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1).real)
+        w = jnp.ones((n_fft,), frames.dtype) if win_arr is None else win_arr
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        frames = frames * w
+        *batch, num, _ = frames.shape
+        out_len = (num - 1) * hop_length + n_fft
+        out = jnp.zeros((*batch, out_len), frames.dtype)
+        norm = jnp.zeros((out_len,), frames.dtype)
+        for i in range(num):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            norm = norm.at[sl].add(w * w)
+        out = out / jnp.maximum(norm, 1e-8)
+        if center:
+            out = out[..., n_fft // 2: out.shape[-1] - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return dispatch("istft", impl, (x,))

@@ -1,0 +1,193 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors and ops.
+
+Analogue of ``python/paddle/sparse/`` over the reference's
+SparseCooTensor/SparseCsrTensor (paddle/phi/core/sparse_coo_tensor.h,
+SURVEY §2.1). TPU-native design: backed by jax.experimental.sparse
+BCOO/BCSR — XLA lowers sparse matmuls to gather/scatter+MXU-dense blocks,
+which is the right TPU formulation (no cuSPARSE analogue needed).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_same_shape", "matmul", "add", "multiply",
+    "masked_matmul", "relu", "nn",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (indices [ndim, nnz] like the reference)."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T)  # reference layout [ndim, nnz]
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._bcoo))
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    def __init__(self, bcsr: jsparse.BCSR):
+        self._bcsr = bcsr
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        return self._bcsr.dtype
+
+    def crows(self) -> Tensor:
+        return Tensor(self._bcsr.indptr)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._bcsr.indices)
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcsr.data)
+
+    def nnz(self) -> int:
+        return int(self._bcsr.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcsr.todense())
+
+    def to_sparse_coo(self, sparse_dim=None) -> SparseCooTensor:
+        return SparseCooTensor(self._bcsr.to_bcoo())
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def _as_array(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
+                      dtype=None, place=None, stop_gradient=True):
+    """Build a COO tensor from [ndim, nnz] indices + [nnz] values
+    (reference paddle.sparse.sparse_coo_tensor)."""
+    idx = np.asarray(_as_array(indices)).T  # -> [nnz, ndim]
+    vals = _as_array(values)
+    if dtype is not None:
+        from ..core.dtypes import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=0))
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx)), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    vals = _as_array(values)
+    if dtype is not None:
+        from ..core.dtypes import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    bcsr = jsparse.BCSR((vals, _as_array(cols).astype(jnp.int32),
+                         _as_array(crows).astype(jnp.int32)),
+                        shape=tuple(shape))
+    return SparseCsrTensor(bcsr)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def _lift(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return x._bcsr
+    return _as_array(x)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense (or sparse @ sparse -> dense)."""
+    a, b = _lift(x), _lift(y)
+    out = a @ b
+    if isinstance(out, (jsparse.BCOO, jsparse.BCSR)):
+        out = out.todense()
+    return Tensor(out)
+
+
+def masked_matmul(x, y, mask: SparseCooTensor, name=None):
+    """dense @ dense evaluated only at mask's nonzeros (SDDMM)."""
+    a, b = _as_array(x), _as_array(y)
+    out = jsparse.bcoo_dot_general_sampled(
+        a, b, mask._bcoo.indices,
+        dimension_numbers=(((a.ndim - 1,), (0,)), ((), ())))
+    return SparseCooTensor(
+        jsparse.BCOO((out, mask._bcoo.indices), shape=mask._bcoo.shape))
+
+
+def add(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return SparseCooTensor((x._bcoo + y._bcoo).sum_duplicates())
+    return Tensor(_lift(x).todense() + _as_array(y))
+
+
+def multiply(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and not isinstance(y, SparseCooTensor):
+        # elementwise with dense: scale values at nonzero coords
+        dense_vals = _as_array(y)[tuple(x._bcoo.indices.T)]
+        return SparseCooTensor(jsparse.BCOO(
+            (x._bcoo.data * dense_vals, x._bcoo.indices),
+            shape=x._bcoo.shape))
+    return Tensor(_lift(x).todense() * _as_array(y))
+
+
+def relu(x, name=None):
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(jsparse.BCOO(
+            (jnp.maximum(x._bcoo.data, 0), x._bcoo.indices),
+            shape=x._bcoo.shape))
+    return Tensor(jnp.maximum(_as_array(x), 0))
+
+
+class _SparseNN:
+    """paddle.sparse.nn facade (ReLU module)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+
+nn = _SparseNN()
