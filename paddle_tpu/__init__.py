@@ -20,7 +20,7 @@ from .core.dtypes import (  # noqa: F401
 )
 from .core.generator import seed, get_rng_state, set_rng_state, Generator
 from .core.flags import set_flags, get_flags
-from .core import device
+from . import device
 from .core.device import (  # noqa: F401
     set_device, get_device, CPUPlace, TPUPlace, is_compiled_with_cuda,
     is_compiled_with_tpu, device_count,
@@ -49,6 +49,7 @@ from . import signal  # noqa: F401
 from . import distributed  # noqa: F401
 from . import vision  # noqa: F401
 from . import incubate  # noqa: F401
+from . import regularizer  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .framework import random as framework_random  # noqa: F401
 from .hapi.model import Model  # noqa: F401

@@ -1,0 +1,35 @@
+"""``paddle_tpu.device`` — device management package (analogue of
+``python/paddle/device/__init__.py``: set_device:244, get_device:271,
+Stream/Event wrappers :410, plus the ``cuda`` submodule — here ``tpu``).
+Implementation lives in ``paddle_tpu.core.device``; this package gives the
+reference's import surface."""
+
+from ..core.device import (  # noqa: F401
+    Place, CPUPlace, TPUPlace, get_all_device_type, device_count,
+    set_device, get_device, current_place, is_compiled_with_cuda,
+    is_compiled_with_tpu, synchronize, Stream, Event, current_stream,
+    stream_guard, memory_stats, max_memory_allocated, memory_allocated,
+    empty_cache)
+
+from . import tpu  # noqa: F401
+from . import cuda  # noqa: F401
+
+__all__ = [
+    "Place", "CPUPlace", "TPUPlace", "get_all_device_type", "device_count",
+    "set_device", "get_device", "current_place", "is_compiled_with_cuda",
+    "is_compiled_with_tpu", "synchronize", "Stream", "Event",
+    "current_stream", "stream_guard", "memory_stats",
+    "max_memory_allocated", "memory_allocated", "empty_cache",
+    "tpu", "cuda",
+]
+
+
+def get_available_device():
+    return [f"{t}:{i}" for t in get_all_device_type()
+            for i in range(device_count(t))]
+
+
+def get_available_custom_device():
+    # PJRT plugins appear as regular jax backends; nothing extra to surface.
+    return [d for d in get_available_device()
+            if not d.startswith(("cpu", "tpu", "gpu"))]

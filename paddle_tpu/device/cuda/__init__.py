@@ -1,0 +1,16 @@
+"""``paddle_tpu.device.cuda`` — API-parity shim for code written against
+``paddle.device.cuda``.  This build has no CUDA (is_compiled_with_cuda() is
+False); the calls map onto the same backend-agnostic facade as
+``device.tpu`` so device-generic user code keeps working."""
+
+from ..tpu import (  # noqa: F401
+    Stream, Event, current_stream, stream_guard, synchronize, device_count,
+    memory_stats, max_memory_allocated, memory_allocated,
+    max_memory_reserved, memory_reserved, empty_cache)
+
+__all__ = [
+    "Stream", "Event", "current_stream", "stream_guard", "synchronize",
+    "device_count", "memory_stats", "max_memory_allocated",
+    "memory_allocated", "max_memory_reserved", "memory_reserved",
+    "empty_cache",
+]

@@ -1,0 +1,30 @@
+"""``paddle_tpu.device.tpu`` — per-accelerator utilities (analogue of
+``python/paddle/device/cuda/__init__.py``: Stream, Event, memory stats,
+empty_cache, synchronize — for the TPU backend)."""
+
+from ...core.device import (  # noqa: F401
+    Stream, Event, current_stream, stream_guard, synchronize,
+    memory_stats, max_memory_allocated, memory_allocated, empty_cache,
+    device_count as _device_count,
+)
+
+__all__ = [
+    "Stream", "Event", "current_stream", "stream_guard", "synchronize",
+    "device_count", "memory_stats", "max_memory_allocated",
+    "memory_allocated", "max_memory_reserved", "memory_reserved",
+    "empty_cache",
+]
+
+
+def device_count() -> int:
+    return _device_count("tpu") or _device_count()
+
+
+def max_memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("peak_pool_bytes", s.get("peak_bytes_in_use", 0)))
+
+
+def memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("pool_bytes", s.get("bytes_in_use", 0)))

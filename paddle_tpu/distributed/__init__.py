@@ -25,6 +25,8 @@ from .topology import (AXIS_ORDER, CommunicateTopology,
 from .parallel import DataParallel, shard_tensor_dp, spawn
 from .sharding_api import shard_tensor, shard_layer, shard_optimizer, reshard
 from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .utils import recompute  # noqa: F401
 from .launch_api import launch  # noqa: F401
@@ -39,5 +41,5 @@ __all__ = [
     "CommunicateTopology", "HybridCommunicateGroup", "build_mesh",
     "get_global_mesh", "set_global_mesh", "DataParallel", "spawn", "fleet",
     "shard_tensor", "shard_layer", "shard_optimizer", "reshard", "recompute",
-    "launch",
+    "launch", "sharding", "group_sharded_parallel", "save_group_sharded_model",
 ]

@@ -12,9 +12,12 @@ from .meta_parallel.parallel_layers.mp_layers import (  # noqa: F401
 from .meta_parallel.parallel_layers.random import (  # noqa: F401
     RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed)
 from .utils import sequence_parallel_utils  # noqa: F401
+from . import recompute as recompute_mod  # noqa: F401
+from .recompute import recompute, recompute_sequential, recompute_hybrid  # noqa: F401
 
 __all__ = ["Fleet", "fleet", "init", "DistributedStrategy",
            "distributed_model", "distributed_optimizer",
            "get_hybrid_communicate_group", "meta_parallel",
            "ColumnParallelLinear", "RowParallelLinear",
-           "VocabParallelEmbedding", "ParallelCrossEntropy"]
+           "VocabParallelEmbedding", "ParallelCrossEntropy",
+           "recompute", "recompute_sequential", "recompute_hybrid"]

@@ -44,10 +44,12 @@ class Optimizer:
         elif weight_decay is None:
             self._weight_decay = 0.0
             self._wd_is_l2 = False
-        else:  # L2Decay-like object with a coeff
+        else:  # L1Decay/L2Decay-like object with a coeff
             self._weight_decay = float(getattr(weight_decay, "_coeff",
                                                getattr(weight_decay, "coeff", 0.0)))
             self._wd_is_l2 = True
+            self._wd_regularizer = weight_decay if callable(weight_decay) \
+                else None
 
     def _add_param_group(self, group):
         group.setdefault("learning_rate", 1.0)
@@ -133,7 +135,11 @@ class Optimizer:
             wd = self._weight_decay if wd is None else (
                 float(getattr(wd, "_coeff", wd)) if not isinstance(wd, float)
                 else wd)
-            if wd and self._wd_is_l2:
+            reg = getattr(self, "_wd_regularizer", None)
+            if reg is not None and getattr(reg, "_is_l1", False):
+                grad_arr = reg(grad_arr, p._value)
+                wd = 0.0
+            elif wd and self._wd_is_l2:
                 grad_arr = grad_arr + wd * p._value.astype(grad_arr.dtype)
                 wd = 0.0
             self._append_optimize_op(p, grad_arr, group_lr, wd)

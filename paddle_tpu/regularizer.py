@@ -1,0 +1,49 @@
+"""Weight-decay regularizers (analogue of ``python/paddle/regularizer.py``).
+
+The reference appends regularization ops to the gradient before the optimizer
+update (L2Decay: ``grad += coeff * param``; L1Decay: ``grad += coeff *
+sign(param)``).  Here the optimizer consumes these objects directly in its
+fused update — XLA folds the extra elementwise term into the update kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    """Base class.  ``__call__(grad, param) -> regularized grad``."""
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+        self._coeff = float(coeff)  # alias the optimizer reads
+
+    def __call__(self, grad, param):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self.coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 weight decay: ``grad + coeff * sign(param)``."""
+
+    _is_l1 = True
+
+    def __call__(self, grad, param):
+        if not self.coeff:
+            return grad
+        return grad + self.coeff * jnp.sign(param).astype(grad.dtype)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 weight decay: ``grad + coeff * param``."""
+
+    _is_l1 = False
+
+    def __call__(self, grad, param):
+        if not self.coeff:
+            return grad
+        return grad + self.coeff * param.astype(grad.dtype)
