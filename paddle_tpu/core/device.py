@@ -198,7 +198,13 @@ def memory_stats(device: Optional[str] = None) -> dict:
     """Device memory statistics: HBM numbers from PJRT plus host-runtime
     counters (≙ paddle/fluid/memory/stats.h surfaced via paddle.device)."""
     from .. import runtime as rt
-    place = current_place() if device is None else set_device(device)
+    if device is None:
+        place = current_place()
+    elif ":" in device:  # a query must not mutate the current device
+        dtype_, idx = device.split(":", 1)
+        place = Place(dtype_, int(idx))
+    else:
+        place = Place(device, 0)
     stats = {}
     try:
         dev_stats = place.jax_device().memory_stats() or {}

@@ -4,9 +4,15 @@ False); the calls map onto the same backend-agnostic facade as
 ``device.tpu`` so device-generic user code keeps working."""
 
 from ..tpu import (  # noqa: F401
-    Stream, Event, current_stream, stream_guard, synchronize, device_count,
+    Stream, Event, current_stream, stream_guard, synchronize,
     memory_stats, max_memory_allocated, memory_allocated,
     max_memory_reserved, memory_reserved, empty_cache)
+
+
+def device_count() -> int:
+    """0: this build has no CUDA.  Keeps the reference GPU-detection idiom
+    (``if device_count() > 0``) truthful on CUDA-less builds."""
+    return 0
 
 __all__ = [
     "Stream", "Event", "current_stream", "stream_guard", "synchronize",

@@ -20,23 +20,22 @@ layouts are *shardings on the "sharding" mesh axis*:
 
 from __future__ import annotations
 
+import warnings
+
 import jax
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..topology import get_global_mesh
-from ..sharding_api import shard_optimizer
+from ..sharding_api import shard_optimizer, shard_first_divisible_dim
 
 __all__ = ["group_sharded_parallel", "save_group_sharded_model"]
 
 
 def _shard_param_spec(shape, axis_size) -> PartitionSpec:
-    """Spec sharding the first dim divisible by the sharding-axis size."""
-    spec = [None] * len(shape)
-    for i, d in enumerate(shape):
-        if d % axis_size == 0 and d >= axis_size:
-            spec[i] = "sharding"
-            break
-    return PartitionSpec(*spec)
+    """Spec sharding the first dim divisible by the sharding-axis size
+    (same rule TrainStep uses for optimizer states)."""
+    return PartitionSpec(
+        *shard_first_divisible_dim([None] * len(shape), shape, axis_size))
 
 
 def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
@@ -49,15 +48,19 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
 
     ``group``/``buffer_max_size``/``segment_size``/``sync_comm`` exist for
     API parity: bucketing and comm/compute overlap are XLA's job on TPU.
-    ``offload`` requests host placement of optimizer states (honored when
-    the runtime exposes host memory spaces; otherwise states stay in HBM
-    sharded 1/N, which is usually smaller than offloaded-but-replicated).
+    ``offload`` is accepted for parity but NOT implemented — states stay in
+    HBM sharded 1/N (usually smaller than offloaded-but-replicated); a
+    warning is emitted if requested.
     """
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError(f"level must be os|os_g|p_g_os, got {level!r}")
     shard_optimizer(optimizer)  # stages 1-2: sharded states + scattered grads
     optimizer._group_sharded_level = level
     optimizer._group_sharded_offload = bool(offload)
+    if offload:
+        warnings.warn("group_sharded_parallel(offload=True): host offload of "
+                      "optimizer states is not implemented on this backend; "
+                      "states remain in HBM sharded over the 'sharding' axis")
 
     if level == "p_g_os":
         mesh = get_global_mesh()
@@ -65,12 +68,16 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
         if mesh is not None and "sharding" in mesh.axis_names \
                 and mesh.shape["sharding"] > 1:
             axis = mesh.shape["sharding"]
+        if axis is None:
+            warnings.warn(
+                "group_sharded_parallel(level='p_g_os'): no global mesh with "
+                "a 'sharding' axis >1 is set — parameters stay replicated "
+                "(stage-1/2 state sharding still applies). Build a "
+                "HybridCommunicateGroup(sharding=N) first for ZeRO-3 layouts.")
         for p in model.parameters():
-            if p.stop_gradient:
+            if p.stop_gradient or axis is None:
                 continue
             shape = p._value.shape
-            if axis is None:
-                continue
             spec = _shard_param_spec(shape, axis)
             if all(s is None for s in spec):
                 continue

@@ -168,3 +168,14 @@ def shard_optimizer(optimizer, shard_fn=None):
     replicated). The actual placement happens under jit via GSPMD."""
     optimizer._zero_sharded = True
     return optimizer
+
+
+def shard_first_divisible_dim(spec, shape, axis_size, axis_name="sharding"):
+    """Shared ZeRO layout rule: mark the first unsharded dim divisible by
+    ``axis_size`` with ``axis_name``.  Used for both stage-3 param sharding
+    and optimizer-state sharding so the two layouts always agree."""
+    for i, s in enumerate(shape):
+        if spec[i] is None and s % axis_size == 0 and s >= axis_size:
+            spec[i] = axis_name
+            break
+    return spec

@@ -259,12 +259,30 @@ def save(layer, path, input_spec=None, **configs):
             out, is_leaf=lambda x: isinstance(x, Tensor))
         return tuple(x._value if isinstance(x, Tensor) else x for x in flat)
 
-    in_shapes = [jax.ShapeDtypeStruct(s.shape, s.dtype) for s in specs]
+    from jax import export as jax_export
+
+    # None / -1 dims (dynamic batch) become export-time symbolic dims
+    scope = None
+    in_shapes = []
+    for si, s in enumerate(specs):
+        if any(d is None or d == -1 for d in s.shape):
+            if scope is None:
+                scope = jax_export.SymbolicScope()
+            dimstr = ",".join(
+                f"dyn{si}_{j}" if (d is None or d == -1) else str(d)
+                for j, d in enumerate(s.shape))
+            shape = jax_export.symbolic_shape(dimstr, scope=scope)
+        else:
+            shape = s.shape
+        in_shapes.append(jax.ShapeDtypeStruct(shape, s.dtype))
     p_shapes = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in values]
 
-    from jax import export as jax_export
-    exp = jax_export.export(jax.jit(lambda pv, *i: pure(pv, *i)))(
-        p_shapes, *in_shapes)
+    jitted = jax.jit(lambda pv, *i: pure(pv, *i))
+    try:  # portable artifact: loadable on either host CPU or TPU
+        exp = jax_export.export(jitted, platforms=("cpu", "tpu"))(
+            p_shapes, *in_shapes)
+    except Exception:
+        exp = jax_export.export(jitted)(p_shapes, *in_shapes)
     blob = exp.serialize()
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

@@ -117,11 +117,9 @@ class TrainStep:
         if getattr(self.optimizer, "_zero_sharded", False) and \
                 "sharding" in mesh.axis_names and mesh.shape["sharding"] > 1 \
                 and not any(uses_axis(e, "sharding") for e in spec):
-            size = mesh.shape["sharding"]
-            for i, s in enumerate(p._value.shape):
-                if spec[i] is None and s % size == 0 and s >= size:
-                    spec[i] = "sharding"
-                    break
+            from ..distributed.sharding_api import shard_first_divisible_dim
+            shard_first_divisible_dim(spec, p._value.shape,
+                                      mesh.shape["sharding"])
         return PartitionSpec(*spec)
 
     def _opt_state_sharding(self, p):

@@ -131,17 +131,28 @@ class Optimizer:
                 float(p.optimize_attr.get("learning_rate", 1.0)
                       if hasattr(p, "optimize_attr") else 1.0)
             grad_arr = grad_t._value
-            wd = group.get("weight_decay")
-            wd = self._weight_decay if wd is None else (
-                float(getattr(wd, "_coeff", wd)) if not isinstance(wd, float)
-                else wd)
-            reg = getattr(self, "_wd_regularizer", None)
+            group_wd = group.get("weight_decay")
+            # a per-group or global regularizer object wins over coefficients;
+            # an explicit per-group number (e.g. 0.0 to exempt biases) wins
+            # over the global regularizer.
+            reg = group_wd if callable(group_wd) and not isinstance(
+                group_wd, (int, float)) else (
+                getattr(self, "_wd_regularizer", None)
+                if group_wd is None else None)
             if reg is not None and getattr(reg, "_is_l1", False):
                 grad_arr = reg(grad_arr, p._value)
                 wd = 0.0
-            elif wd and self._wd_is_l2:
-                grad_arr = grad_arr + wd * p._value.astype(grad_arr.dtype)
-                wd = 0.0
+            else:
+                if group_wd is None:
+                    wd, as_l2 = self._weight_decay, self._wd_is_l2
+                else:
+                    wd = float(getattr(group_wd, "_coeff", group_wd))
+                    # per-group decay is coupled (L2) for all but AdamW,
+                    # whose decay is decoupled inside _append_optimize_op
+                    as_l2 = type(self).__name__ != "AdamW"
+                if wd and as_l2:
+                    grad_arr = grad_arr + wd * p._value.astype(grad_arr.dtype)
+                    wd = 0.0
             self._append_optimize_op(p, grad_arr, group_lr, wd)
         if isinstance(self._learning_rate, LRScheduler) and \
                 self._learning_rate._step_on_opt_step:
