@@ -29,7 +29,8 @@ from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .utils import recompute  # noqa: F401
-from .launch_api import launch  # noqa: F401
+from . import launch  # noqa: F401
+from . import rpc  # noqa: F401
 
 __all__ = [
     "ParallelEnv", "get_rank", "get_world_size", "init_parallel_env",

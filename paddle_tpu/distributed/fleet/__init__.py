@@ -13,6 +13,7 @@ from .meta_parallel.parallel_layers.random import (  # noqa: F401
     RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed)
 from .utils import sequence_parallel_utils  # noqa: F401
 from . import recompute as recompute_mod  # noqa: F401
+from . import elastic  # noqa: F401
 from .recompute import recompute, recompute_sequential, recompute_hybrid  # noqa: F401
 
 __all__ = ["Fleet", "fleet", "init", "DistributedStrategy",

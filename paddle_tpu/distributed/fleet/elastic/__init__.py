@@ -1,0 +1,117 @@
+"""Elastic training manager (analogue of
+``python/paddle/distributed/fleet/elastic/manager.py:126`` ``ElasticManager``
+with ``ElasticStatus:48`` / ``LauncherInterface:56``).
+
+The reference watches an ETCD registry of live pods and restarts the whole
+job from checkpoint when membership changes.  TPU-native: there is no ETCD;
+slice health comes from the JAX coordination service, and elasticity is
+checkpoint-restart — the launcher (``launch --max_restart``) re-runs workers,
+and this manager supervises a single host's worker processes: watch, kill on
+scale events, report status.  (SURVEY §5 failure-detection row: "pod failure
+→ whole-job restart from checkpoint; no in-flight recovery" — same model.)
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["ElasticStatus", "LauncherInterface", "ElasticManager",
+           "enable_elastic", "launch_elastic"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class LauncherInterface:
+    """Owns local worker processes (reference LauncherInterface:56)."""
+
+    def __init__(self, args):
+        self.args = args
+        self.procs = []
+
+    def _terminate_procs(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in self.procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            if p.poll() is None:
+                p.kill()
+        self.procs = []
+
+    def launch(self):
+        cmd = list(self.args)
+        self.procs.append(subprocess.Popen(cmd))
+
+    def watch(self):
+        """Poll worker status: None while running, else an ElasticStatus."""
+        if not self.procs:
+            return ElasticStatus.COMPLETED
+        codes = [p.poll() for p in self.procs]
+        if any(c not in (None, 0) for c in codes):
+            return ElasticStatus.ERROR
+        if all(c == 0 for c in codes):
+            return ElasticStatus.COMPLETED
+        return None
+
+    def stop(self):
+        self._terminate_procs()
+
+
+class ElasticManager:
+    """Supervise a training command; on worker failure restart it (up to
+    ``max_restart``), mirroring the reference's pod-level restart loop."""
+
+    def __init__(self, cmd, max_restart: int = 3, poll_interval: float = 0.5):
+        self.cmd = list(cmd)
+        self.max_restart = max_restart
+        self.poll_interval = poll_interval
+        self.restarts = 0
+        self.launcher = None
+
+    def run(self) -> str:
+        while True:
+            self.launcher = LauncherInterface(self.cmd)
+            self.launcher.launch()
+            status = None
+            while status is None:
+                time.sleep(self.poll_interval)
+                status = self.launcher.watch()
+            if status == ElasticStatus.COMPLETED:
+                return ElasticStatus.COMPLETED
+            self.launcher.stop()
+            self.restarts += 1
+            if self.restarts > self.max_restart:
+                return ElasticStatus.ERROR
+            print(f"[elastic] restart {self.restarts}/{self.max_restart}",
+                  file=sys.stderr)
+
+    def exit(self):
+        if self.launcher:
+            self.launcher.stop()
+
+
+def enable_elastic(args=None, etcd=None) -> bool:
+    """Reference ``enable_elastic``: True when an elastic registry is
+    configured.  Here: when PADDLE_ELASTIC_MAX_RESTART requests it."""
+    return int(os.environ.get("PADDLE_ELASTIC_MAX_RESTART", 0)) > 0
+
+
+def launch_elastic(cmd=None, max_restart=None) -> str:
+    """Entry (reference fleet/elastic/__init__.py:49): supervise ``cmd``
+    (defaults to re-running sys.argv as a worker)."""
+    cmd = cmd or [sys.executable] + sys.argv
+    if max_restart is None:
+        max_restart = int(os.environ.get("PADDLE_ELASTIC_MAX_RESTART", 3))
+    return ElasticManager(cmd, max_restart=max_restart).run()

@@ -1,0 +1,22 @@
+"""``python -m paddle_tpu.distributed.launch`` — distributed job launcher
+(analogue of ``python/paddle/distributed/launch/main.py:18`` and its
+collective controller ``launch/controllers/collective.py:22``).
+
+TPU-native contract: one process per host drives all local chips (SPMD), so
+``--nproc_per_node`` defaults to 1; values >1 exist for the CPU-mesh test
+pattern (SURVEY §4: spawn-with-env localhost clusters) and for multi-process
+GPU-style debugging.  Env contract matches the reference:
+
+- ``PADDLE_TRAINER_ID``    — global process rank
+- ``PADDLE_TRAINERS_NUM``  — world size (nnodes * nproc_per_node)
+- ``PADDLE_LOCAL_RANK``    — rank within this host
+- ``MASTER_ADDR/PORT``     — coordination service address (jax.distributed
+  replaces the reference's TCPStore bootstrap, parallel.py:1088)
+
+Elastic restart (reference fleet/elastic/manager.py:126): ``--max_restart N``
+re-launches failed workers from the last checkpoint up to N times.
+"""
+
+from .main import launch, main  # noqa: F401
+
+__all__ = ["launch", "main"]

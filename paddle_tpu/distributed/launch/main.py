@@ -1,0 +1,123 @@
+"""Launcher implementation.  See package docstring for the env contract."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a distributed training job "
+                    "(reference: paddle.distributed.launch)")
+    p.add_argument("--master", default=None,
+                   help="coordination address ip:port (default: local)")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", 1)))
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", 0)),
+                   help="this node's rank in [0, nnodes)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes on this host (TPU SPMD default: 1)")
+    p.add_argument("--devices", default=None,
+                   help="device selection string, exported as "
+                        "PADDLE_VISIBLE_DEVICES")
+    p.add_argument("--job_id", default="default",
+                   help="job name, exported as PADDLE_JOB_ID")
+    p.add_argument("--log_dir", default="log", help="worker log directory")
+    p.add_argument("--max_restart", type=int, default=0,
+                   help="elastic: restart failed workers up to N times")
+    p.add_argument("script", help="training script to run")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _worker_env(args, local_rank: int) -> dict:
+    world = args.nnodes * args.nproc_per_node
+    global_rank = args.rank * args.nproc_per_node + local_rank
+    env = dict(os.environ)
+    env["PADDLE_TRAINER_ID"] = str(global_rank)
+    env["PADDLE_TRAINERS_NUM"] = str(world)
+    env["PADDLE_LOCAL_RANK"] = str(local_rank)
+    env["PADDLE_JOB_ID"] = args.job_id
+    if args.master:
+        addr, _, port = args.master.partition(":")
+        env["MASTER_ADDR"] = addr
+        env["MASTER_PORT"] = port or "8787"
+    if args.devices is not None:
+        env["PADDLE_VISIBLE_DEVICES"] = args.devices
+    return env
+
+
+def _run_in_process(args):
+    """Single local worker: exec the script in this interpreter (fast path —
+    no fork, keeps the TPU client singleton)."""
+    env = _worker_env(args, 0)
+    os.environ.update({k: env[k] for k in env
+                       if k.startswith(("PADDLE_", "MASTER_"))})
+    sys.argv = [args.script] + list(args.script_args)
+    runpy.run_path(args.script, run_name="__main__")
+    return 0
+
+
+def _spawn_workers(args):
+    """Reference collective controller: Popen one proc per local rank, tee
+    logs, propagate first failure (kill the rest)."""
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs = []
+    logs = []
+    for lr in range(args.nproc_per_node):
+        logf = open(os.path.join(args.log_dir, f"workerlog.{lr}"), "ab")
+        cmd = [sys.executable, "-u", args.script] + list(args.script_args)
+        procs.append(subprocess.Popen(cmd, env=_worker_env(args, lr),
+                                      stdout=logf, stderr=subprocess.STDOUT))
+        logs.append(logf)
+    rc = 0
+    try:
+        while procs:
+            for i, pr in enumerate(list(procs)):
+                r = pr.poll()
+                if r is None:
+                    continue
+                procs.remove(pr)
+                if r != 0:
+                    rc = r
+                    for other in procs:
+                        other.send_signal(signal.SIGTERM)
+                    for other in procs:
+                        other.wait()
+                    procs = []
+                    break
+            time.sleep(0.2)
+    finally:
+        for f in logs:
+            f.close()
+    return rc
+
+
+def launch(argv=None):
+    args = _parse(sys.argv[1:] if argv is None else argv)
+    attempt = 0
+    while True:
+        if args.nproc_per_node <= 1 and args.max_restart == 0:
+            return _run_in_process(args)
+        rc = _spawn_workers(args)
+        if rc == 0:
+            return 0
+        attempt += 1
+        if attempt > args.max_restart:
+            sys.exit(rc)
+        print(f"[launch] workers failed (rc={rc}); elastic restart "
+              f"{attempt}/{args.max_restart}", file=sys.stderr)
+
+
+def main():
+    raise SystemExit(launch())

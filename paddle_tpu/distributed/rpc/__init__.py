@@ -1,0 +1,175 @@
+"""``paddle_tpu.distributed.rpc`` — minimal tensor-capable RPC (analogue of
+``paddle.distributed.rpc`` over ``paddle/fluid/distributed/rpc/rpc_agent.h``;
+python surface ``python/paddle/distributed/rpc/__init__.py``: init_rpc,
+rpc_sync, rpc_async, shutdown, get_worker_info, get_all_worker_infos).
+
+The reference rides brpc; here each worker runs a small threaded TCP server
+executing pickled ``(fn, args, kwargs)`` calls, with rendezvous through the
+native TCPStore (runtime/native/tcp_store.cc) — the same store that replaces
+the reference's PG bootstrap.  Tensors cross as numpy arrays.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from dataclasses import dataclass
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_state = {"name": None, "rank": None, "workers": {}, "server": None,
+          "store_server": None, "pool": None}
+
+
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+
+
+def _recv_msg(sock) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("!Q", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            req = pickle.loads(_recv_msg(self.request))
+            fn, args, kwargs = req
+            try:
+                result = ("ok", fn(*args, **kwargs))
+            except Exception as e:  # ship the exception back
+                result = ("err", e)
+            _send_msg(self.request, pickle.dumps(result))
+        except ConnectionError:
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def init_rpc(name: str, rank: int = None, world_size: int = None,
+             master_endpoint: str = None):
+    """Start this worker's RPC server and rendezvous with peers.
+
+    Mirrors the reference contract: every worker calls init_rpc; the master
+    endpoint hosts the KV store (worker 0 starts it here).
+    """
+    from ... import runtime as rt
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT",
+        f"127.0.0.1:{os.environ.get('MASTER_PORT', '8813')}")
+    host, _, port = master_endpoint.partition(":")
+    port = int(port or 8813)
+
+    if rank == 0:
+        _state["store_server"] = rt.TCPStoreServer(port)
+        port = _state["store_server"].port
+    store = None
+    deadline = time.time() + 60
+    while store is None:
+        try:
+            store = rt.TCPStore(host, port)
+        except OSError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)  # rank 0 has not started the store yet
+
+    server = _Server(("127.0.0.1", 0), _Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    my_ip, my_port = server.server_address
+
+    store.set(f"rpc/{rank}", pickle.dumps(
+        WorkerInfo(name, rank, "127.0.0.1", my_port)))
+    workers = {}
+    for r in range(world_size):
+        info = pickle.loads(store.get(f"rpc/{r}"))
+        workers[info.name] = info
+    _state.update(name=name, rank=rank, workers=workers, server=server,
+                  pool=concurrent.futures.ThreadPoolExecutor(max_workers=8))
+    store.close()
+
+
+def _call(to: str, fn, args, kwargs, timeout):
+    info = _state["workers"].get(to)
+    if info is None:
+        raise ValueError(f"unknown rpc worker {to!r}; known: "
+                         f"{sorted(_state['workers'])}")
+    with socket.create_connection((info.ip, info.port),
+                                  timeout=timeout or None) as s:
+        _send_msg(s, pickle.dumps((fn, args or (), kwargs or {})))
+        status, payload = pickle.loads(_recv_msg(s))
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=None):
+    """Run ``fn(*args)`` on worker ``to``; block for the result."""
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout=None):
+    """Run ``fn`` on worker ``to``; returns a Future (``.wait()``/
+    ``.result()``)."""
+    fut = _state["pool"].submit(_call, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # reference API calls it .wait()
+    return fut
+
+
+def get_worker_info(name: str = None) -> WorkerInfo:
+    if name is None:
+        name = _state["name"]
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    return list(_state["workers"].values())
+
+
+def shutdown(graceful: bool = True):
+    if graceful and _state["rank"] is not None:
+        time.sleep(0.05)  # drain in-flight handlers
+    if _state["pool"] is not None:
+        _state["pool"].shutdown(wait=graceful)
+    if _state["server"] is not None:
+        _state["server"].shutdown()
+        _state["server"].server_close()
+    if _state["store_server"] is not None:
+        _state["store_server"].stop()
+    _state.update(name=None, rank=None, workers={}, server=None,
+                  store_server=None, pool=None)
