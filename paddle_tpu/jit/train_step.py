@@ -177,25 +177,37 @@ class TrainStep:
                 return arr
             return jax.lax.with_sharding_constraint(arr, sharding)
 
-        def compiled(p_values, opt_state, rng_key, lr, *inputs):
+        buffers = self._buffers
+
+        def compiled(p_values, opt_state, rng_key, lr, b_values, *inputs):
             def loss_of(pv):
                 saved = [p._value for p in params]
+                saved_b = [b._value for b in buffers]
                 _generator.push_trace_key(rng_key)
                 try:
                     for p, a in zip(params, pv):
                         p._value = a
+                    for b, a in zip(buffers, b_values):
+                        b._value = a
                     with _tape.no_grad():
                         out = loss_fn(model, *[Tensor(i) for i in inputs])
+                    # mutable buffers (e.g. BatchNorm running stats) updated
+                    # in-place during the traced forward come out as aux so
+                    # no tracer leaks into module state
+                    new_b = [b._value for b in buffers]
                 finally:
                     for p, s in zip(params, saved):
                         p._value = s
+                    for b, s in zip(buffers, saved_b):
+                        b._value = s
                     _generator.pop_trace_key()
                 loss_t = out[0] if isinstance(out, tuple) else out
                 aux = out[1:] if isinstance(out, tuple) else ()
-                return loss_t._value, tuple(
-                    a._value if isinstance(a, Tensor) else a for a in aux)
+                return loss_t._value, (tuple(
+                    a._value if isinstance(a, Tensor) else a
+                    for a in aux), new_b)
 
-            (loss, aux), grads = jax.value_and_grad(
+            (loss, (aux, new_b)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(list(p_values))
             if grad_clip is not None and hasattr(grad_clip, "clip_norm"):
                 gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -212,7 +224,7 @@ class TrainStep:
                        for k, v in ns_.items()}
                 new_p.append(np_)
                 new_s.append(ns_)
-            return new_p, new_s, loss, aux
+            return new_p, new_s, loss, aux, new_b
 
         jit_kwargs = dict(donate_argnums=(0, 1))
         self._compiled = jax.jit(compiled, **jit_kwargs)
@@ -261,10 +273,13 @@ class TrainStep:
         key = _generator.default_generator().next_key()
         lr = jnp.float32(self.optimizer.get_lr())
         p_values = [p._value for p in self._params]
-        new_p, self._state, loss, aux = self._compiled(
-            p_values, self._state, key, lr, *arrays)
+        b_values = [b._value for b in self._buffers]
+        new_p, self._state, loss, aux, new_b = self._compiled(
+            p_values, self._state, key, lr, b_values, *arrays)
         for p, v in zip(self._params, new_p):
             p._value = v
+        for b, v in zip(self._buffers, new_b):
+            b._value = v
         loss_t = Tensor(loss)
         if aux:
             return (loss_t,) + tuple(Tensor(a) for a in aux)

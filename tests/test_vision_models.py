@@ -1,0 +1,44 @@
+"""Vision model family smoke tests (≙ test/legacy_test/test_vision_models.py
+pattern: build each model, run a tiny forward, check output shape)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _check(model, size=64, num_classes=8):
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((2, 3, size, size))
+        .astype(np.float32))
+    model.eval()
+    out = model(x)
+    assert tuple(out.shape) == (2, num_classes)
+    assert np.all(np.isfinite(np.asarray(out._value)))
+
+
+@pytest.mark.parametrize("name", [
+    "alexnet", "vgg11", "mobilenet_v1", "mobilenet_v2", "mobilenet_v3_small",
+    "mobilenet_v3_large", "squeezenet1_0", "squeezenet1_1", "densenet121",
+    "googlenet", "shufflenet_v2_x0_25", "shufflenet_v2_swish",
+])
+def test_model_forward(name):
+    model = getattr(models, name)(num_classes=8)
+    size = 96 if name == "alexnet" else 64
+    _check(model, size=size)
+
+
+def test_inception_v3():
+    _check(models.inception_v3(num_classes=8), size=96)
+
+
+def test_no_head_variant():
+    m = models.mobilenet_v2(num_classes=0, with_pool=True)
+    x = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+    out = m(x)
+    assert out.shape[0] == 1 and out.shape[1] == 1280
+
+
+def test_vgg_batch_norm():
+    _check(models.vgg11(batch_norm=True, num_classes=8), size=64)
