@@ -5,7 +5,27 @@ encoder)."""
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
                     LlamaPretrainingCriterion, llama_3_8b_config,
                     llama_3_70b_config, tiny_llama_config)
+from .ernie import (ErnieConfig, ErnieModel, ErnieForSequenceClassification,
+                    ErnieForTokenClassification, ErnieForQuestionAnswering,
+                    ErnieForPretraining, ErniePretrainingCriterion,
+                    ernie_base_config, tiny_ernie_config,
+                    BertConfig, BertModel, BertForSequenceClassification,
+                    BertForTokenClassification, BertForQuestionAnswering,
+                    BertForPretraining)
+from .gpt import (GPTConfig, GPTModel, GPTForCausalLM,
+                  GPTPretrainingCriterion, gpt2_small_config,
+                  gpt3_13b_config, tiny_gpt_config)
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
            "LlamaPretrainingCriterion", "llama_3_8b_config",
-           "llama_3_70b_config", "tiny_llama_config"]
+           "llama_3_70b_config", "tiny_llama_config",
+           "ErnieConfig", "ErnieModel", "ErnieForSequenceClassification",
+           "ErnieForTokenClassification", "ErnieForQuestionAnswering",
+           "ErnieForPretraining", "ErniePretrainingCriterion",
+           "ernie_base_config", "tiny_ernie_config",
+           "BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForTokenClassification", "BertForQuestionAnswering",
+           "BertForPretraining",
+           "GPTConfig", "GPTModel", "GPTForCausalLM",
+           "GPTPretrainingCriterion", "gpt2_small_config",
+           "gpt3_13b_config", "tiny_gpt_config"]

@@ -1,0 +1,261 @@
+"""ERNIE / BERT-style encoder model family.
+
+Capability analogue of PaddleNLP's `ErnieModel`/`BertModel` (the BASELINE
+"ERNIE-base finetune 1 chip" smoke config).  Built on the framework's own
+TransformerEncoder stack; pretraining (MLM + NSP) and finetune heads
+(sequence / token classification, QA) match the reference model zoo's
+surface.  TPU notes: the whole forward is static-shape (padded seq len),
+attention uses the shared scaled_dot_product_attention (Pallas flash path
+on TPU), and encoders run in bf16 under AMP with fp32 layernorm.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+from ..tensor.creation import arange, zeros_like
+from ..tensor.manipulation import unsqueeze
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 18000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 4
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+    dtype: str = "float32"
+
+
+def ernie_base_config(**kw):
+    return ErnieConfig(**kw)
+
+
+def tiny_ernie_config(**kw):
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("max_position_embeddings", 64)
+    return ErnieConfig(**kw)
+
+
+class ErnieEmbeddings(nn.Layer):
+    """word + position + token-type embeddings -> LayerNorm -> dropout."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(config.vocab_size,
+                                            config.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        if position_ids is None:
+            seq = input_ids.shape[1]
+            position_ids = unsqueeze(arange(0, seq, dtype="int64"), 0)
+        if token_type_ids is None:
+            token_type_ids = zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class ErniePooler(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden_states):
+        return F.tanh(self.dense(hidden_states[:, 0]))
+
+
+def _attention_mask_from_ids(input_ids, pad_token_id, dtype):
+    """[b, s] token ids -> additive [b, 1, 1, s] mask (-1e4 at pads)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    ids = input_ids.value if isinstance(input_ids, Tensor) else input_ids
+    mask = (ids != pad_token_id).astype(jnp.float32)
+    bias = (1.0 - mask)[:, None, None, :] * -1e4
+    return Tensor(bias.astype(dtype))
+
+
+class ErnieModel(nn.Layer):
+    """Reference parity: PaddleNLP ErnieModel (embeddings -> N encoder
+    layers -> pooled [CLS]); post-norm encoder like BERT."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = ErnieEmbeddings(config)
+        encoder_layer = nn.TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, dropout=config.hidden_dropout_prob,
+            activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            act_dropout=0.0, layer_norm_eps=config.layer_norm_eps)
+        self.encoder = nn.TransformerEncoder(encoder_layer,
+                                             config.num_hidden_layers)
+        self.pooler = ErniePooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is None:
+            attention_mask = _attention_mask_from_ids(
+                input_ids, self.config.pad_token_id, "float32")
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        sequence_output = self.encoder(x, attention_mask)
+        pooled_output = self.pooler(sequence_output)
+        return sequence_output, pooled_output
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.num_classes = num_classes
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                               attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            loss = F.cross_entropy(logits, labels)
+            return loss, logits
+        return logits
+
+
+class ErnieForTokenClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.num_classes = num_classes
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, labels=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, position_ids,
+                            attention_mask)
+        logits = self.classifier(self.dropout(seq))
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.num_classes]), labels.reshape([-1]))
+            return loss, logits
+        return logits
+
+
+class ErnieForQuestionAnswering(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.classifier = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, position_ids,
+                            attention_mask)
+        logits = self.classifier(seq)
+        start_logits = logits[:, :, 0]
+        end_logits = logits[:, :, 1]
+        return start_logits, end_logits
+
+
+class ErniePretrainingHeads(nn.Layer):
+    """MLM transform + decoder (tied to word embeddings) and NSP head."""
+
+    def __init__(self, config: ErnieConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.activation = F.gelu if config.hidden_act == "gelu" else F.relu
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       config.layer_norm_eps)
+        if embedding_weights is not None:
+            # weight tying: decoder kernel is the transposed word embedding.
+            # Keep only a bias here; reference the shared Parameter without
+            # re-registering it (it already lives under the embedding layer).
+            object.__setattr__(self, "_tied", embedding_weights)
+            self.decoder_bias = self.create_parameter(
+                [config.vocab_size], is_bias=True)
+        else:
+            object.__setattr__(self, "_tied", None)
+            self.decoder = nn.Linear(config.hidden_size, config.vocab_size)
+        self.seq_relationship = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, sequence_output, pooled_output):
+        x = self.layer_norm(self.activation(self.transform(sequence_output)))
+        if self._tied is not None:
+            from ..tensor.linalg import matmul
+            prediction_scores = matmul(x, self._tied, transpose_y=True) \
+                + self.decoder_bias
+        else:
+            prediction_scores = self.decoder(x)
+        seq_relationship_score = self.seq_relationship(pooled_output)
+        return prediction_scores, seq_relationship_score
+
+
+class ErnieForPretraining(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.ernie = ErnieModel(config)
+        self.cls = ErniePretrainingHeads(
+            config, self.ernie.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                                 attention_mask)
+        return self.cls(seq, pooled)
+
+
+class ErniePretrainingCriterion(nn.Layer):
+    """MLM + NSP loss (ignore_index=-100 masks unmasked positions)."""
+
+    def __init__(self, vocab_size: int, ignore_index: int = -100):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.ignore_index = ignore_index
+
+    def forward(self, prediction_scores, seq_relationship_score,
+                masked_lm_labels, next_sentence_labels=None):
+        mlm = F.cross_entropy(
+            prediction_scores.reshape([-1, self.vocab_size]),
+            masked_lm_labels.reshape([-1]),
+            ignore_index=self.ignore_index, reduction="mean")
+        if next_sentence_labels is None:
+            return mlm
+        nsp = F.cross_entropy(seq_relationship_score,
+                              next_sentence_labels.reshape([-1]),
+                              reduction="mean")
+        return mlm + nsp
+
+
+# BERT aliases: the architectures are identical at this capability level;
+# PaddleNLP ships both families with the same topology.
+BertConfig = ErnieConfig
+BertModel = ErnieModel
+BertForSequenceClassification = ErnieForSequenceClassification
+BertForTokenClassification = ErnieForTokenClassification
+BertForQuestionAnswering = ErnieForQuestionAnswering
+BertForPretraining = ErnieForPretraining

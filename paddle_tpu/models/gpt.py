@@ -1,0 +1,241 @@
+"""GPT decoder model family.
+
+Capability analogue of PaddleNLP's `GPTModel` (GPT-2/3 topology: learned
+position embeddings, pre-norm decoder blocks, GELU MLP, causal attention).
+Supports the same hybrid-parallel hooks as Llama: tensor-parallel linear
+layers when `tensor_parallel=True`, recompute per block, and greedy
+decoding with KV cache for generation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .. import nn
+from ..nn import functional as F
+from ..tensor.creation import arange
+from ..tensor.manipulation import concat, unsqueeze
+from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy)
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_eps: float = 1e-5
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    tensor_parallel: bool = False
+    recompute: bool = False
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def gpt2_small_config(**kw):
+    return GPTConfig(**kw)
+
+
+def gpt3_13b_config(**kw):
+    return GPTConfig(hidden_size=5120, num_hidden_layers=40,
+                     num_attention_heads=40, intermediate_size=20480,
+                     max_position_embeddings=2048, **kw)
+
+
+def tiny_gpt_config(**kw):
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("max_position_embeddings", 64)
+    return GPTConfig(**kw)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.head_dim
+        if config.tensor_parallel:
+            self.qkv_proj = ColumnParallelLinear(h, 3 * h,
+                                                 gather_output=False)
+            self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
+        else:
+            self.qkv_proj = nn.Linear(h, 3 * h)
+            self.out_proj = nn.Linear(h, h)
+        self.dropout_p = config.attention_probs_dropout_prob
+
+    def forward(self, x, attention_mask=None, cache=None):
+        b, s, _ = x.shape
+        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads,
+                                        self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cache is not None:
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+            cache = (k, v)
+        # causal whenever q covers the same span as k (full forward, or the
+        # prompt step of cached decoding where the cache starts empty); a
+        # single-token decode step attends to the whole cache, so no mask.
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attention_mask,
+            dropout_p=self.dropout_p if self.training else 0.0,
+            is_causal=attention_mask is None and k.shape[1] == s)
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        out = self.out_proj(out)
+        return (out, cache) if cache is not None else out
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        if config.tensor_parallel:
+            self.fc_in = ColumnParallelLinear(h, m, gather_output=False)
+            self.fc_out = RowParallelLinear(m, h, input_is_parallel=True)
+        else:
+            self.fc_in = nn.Linear(h, m)
+            self.fc_out = nn.Linear(m, h)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        return self.dropout(self.fc_out(F.gelu(self.fc_in(x))))
+
+
+class GPTDecoderLayer(nn.Layer):
+    """Pre-norm block: x + attn(ln1(x)); x + mlp(ln2(x))."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.mlp = GPTMLP(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self._recompute = config.recompute
+
+    def _forward_impl(self, x, attention_mask=None, cache=None):
+        if cache is not None:
+            a, cache = self.attn(self.ln_1(x), attention_mask, cache)
+        else:
+            a = self.attn(self.ln_1(x), attention_mask)
+        x = x + self.dropout(a)
+        x = x + self.mlp(self.ln_2(x))
+        return (x, cache) if cache is not None else x
+
+    def forward(self, x, attention_mask=None, cache=None):
+        if self._recompute and self.training and cache is None:
+            from ..distributed.utils import recompute
+            return recompute(self._forward_impl, x, attention_mask)
+        return self._forward_impl(x, attention_mask, cache)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        if config.tensor_parallel:
+            self.wte = VocabParallelEmbedding(config.vocab_size,
+                                              config.hidden_size)
+        else:
+            self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings,
+                                config.hidden_size)
+        self.drop = nn.Dropout(config.hidden_dropout_prob)
+        self.h = nn.LayerList([GPTDecoderLayer(config)
+                               for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+
+    def forward(self, input_ids, position_ids=None, attention_mask=None,
+                caches=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            start = 0 if caches is None else caches[0][0].shape[1]
+            position_ids = unsqueeze(
+                arange(start, start + s, dtype="int64"), 0)
+        x = self.drop(self.wte(input_ids) + self.wpe(position_ids))
+        new_caches = [] if caches is not None else None
+        for i, block in enumerate(self.h):
+            if caches is not None:
+                x, c = block(x, attention_mask, caches[i])
+                new_caches.append(c)
+            else:
+                x = block(x, attention_mask)
+        x = self.ln_f(x)
+        return (x, new_caches) if caches is not None else x
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if config.tensor_parallel:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=True)
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+        self.criterion = GPTPretrainingCriterion(config)
+
+    def forward(self, input_ids, position_ids=None, attention_mask=None,
+                labels=None, caches=None):
+        if caches is not None:
+            hidden, caches = self.gpt(input_ids, position_ids,
+                                      attention_mask, caches)
+            return self.lm_head(hidden), caches
+        hidden = self.gpt(input_ids, position_ids, attention_mask)
+        logits = self.lm_head(hidden)
+        if labels is not None:
+            loss = self.criterion(logits, labels)
+            return loss, logits
+        return logits
+
+    def generate(self, input_ids, max_new_tokens: int = 16):
+        """Greedy decode with KV cache (static shapes per step)."""
+        from ..tensor.creation import zeros
+        b = input_ids.shape[0]
+        caches = [(zeros([b, 0, self.config.num_attention_heads,
+                          self.config.head_dim]),
+                   zeros([b, 0, self.config.num_attention_heads,
+                          self.config.head_dim]))
+                  for _ in range(self.config.num_hidden_layers)]
+        tokens = input_ids
+        cur = input_ids
+        for _ in range(max_new_tokens):
+            logits, caches = self.forward(cur, caches=caches)
+            nxt = logits[:, -1].argmax(axis=-1).reshape([b, 1]).astype("int64")
+            tokens = concat([tokens, nxt], axis=1)
+            cur = nxt
+        return tokens
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    def __init__(self, config: Optional[GPTConfig] = None,
+                 ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+        self._parallel = bool(config and config.tensor_parallel)
+        if self._parallel:
+            self.parallel_ce = ParallelCrossEntropy(
+                ignore_index=ignore_index)
+
+    def forward(self, logits, labels):
+        if self._parallel:
+            return self.parallel_ce(logits, labels).mean()
+        return F.cross_entropy(logits, labels,
+                               ignore_index=self.ignore_index,
+                               reduction="mean")

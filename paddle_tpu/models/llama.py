@@ -161,7 +161,8 @@ class LlamaDecoderLayer(nn.Layer):
     def forward(self, x, position_ids=None, attention_mask=None):
         if self._recompute and self.training:
             from ..distributed.utils import recompute
-            return recompute(self._forward_impl, x)
+            return recompute(self._forward_impl, x, position_ids,
+                             attention_mask)
         return self._forward_impl(x, position_ids, attention_mask)
 
 

@@ -61,12 +61,12 @@ class InvertedResidual(nn.Layer):
 
 
 _STAGE_CFG = {
-    0.25: ([24, 24, 48, 96, 512], "relu"),
-    0.33: ([24, 32, 64, 128, 512], "relu"),
-    0.5: ([24, 48, 96, 192, 1024], "relu"),
-    1.0: ([24, 116, 232, 464, 1024], "relu"),
-    1.5: ([24, 176, 352, 704, 1024], "relu"),
-    2.0: ([24, 244, 488, 976, 2048], "relu"),
+    0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
 }
 _REPEATS = [4, 8, 4]
 
@@ -75,7 +75,7 @@ class ShuffleNetV2(nn.Layer):
     def __init__(self, scale=1.0, act="relu", num_classes=1000,
                  with_pool=True):
         super().__init__()
-        channels = _STAGE_CFG[scale][0]
+        channels = _STAGE_CFG[scale]
         self.num_classes = num_classes
         self.with_pool = with_pool
 

@@ -56,9 +56,8 @@ class SqueezeNet(nn.Layer):
             raise ValueError(f"unsupported SqueezeNet version {version}")
 
         if num_classes > 0:
-            self.final_conv = nn.Conv2D(512, num_classes, 1)
             self.classifier = nn.Sequential(
-                nn.Dropout(0.5), self.final_conv, nn.ReLU())
+                nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU())
         if with_pool:
             self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
 
