@@ -50,6 +50,7 @@ from . import distributed  # noqa: F401
 from . import vision  # noqa: F401
 from . import incubate  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import quantization  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .framework import random as framework_random  # noqa: F401
 from .hapi.model import Model  # noqa: F401

@@ -1,0 +1,143 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Capability analogue of ``paddle.incubate.asp``
+(reference: python/paddle/incubate/asp/{asp.py,utils.py}): compute n:m
+sparse masks for Linear/Conv weights (`prune_model`), keep them enforced
+through training by masking after each optimizer step (`decorate`), with
+per-layer exclusion lists and density reporting.
+
+TPU note: n:m masks are plain elementwise multiplies that XLA fuses into
+the producing matmul; the mask pattern follows the reference's mask_1d
+(best-n-of-m along the input dimension).
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn import Layer, Linear
+from ...nn.layer.conv import Conv2D
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density",
+           "check_sparsity", "create_mask"]
+
+# model -> {param full-name: numpy mask} (weak keys: entries die with the
+# model, and a recycled id can never alias a dead model's state)
+_MASKS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+# id(parameter Tensor) -> (weakref, mask); set_value mutates in place so the
+# id is stable while the param lives, and the weakref guards against id
+# reuse after a pruned model is garbage-collected
+_PARAM_MASKS: Dict[int, tuple] = {}
+_EXCLUDED: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def create_mask(weight: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """mask_1d: within every group of m along the last axis keep the n
+    largest magnitudes (reference utils.get_mask_1d)."""
+    w = np.asarray(weight)
+    flat = w.reshape(-1, w.shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.concatenate(
+            [flat, np.zeros((flat.shape[0], pad), flat.dtype)], axis=1)
+    groups = flat.reshape(flat.shape[0], -1, m)
+    order = np.argsort(np.abs(groups), axis=-1)  # ascending
+    mask = np.ones_like(groups, dtype=np.float32)
+    drop = order[:, :, :m - n]
+    np.put_along_axis(mask, drop, 0.0, axis=-1)
+    mask = mask.reshape(flat.shape[0], -1)[:, :cols]
+    return mask.reshape(w.shape)
+
+
+def check_sparsity(weight, n: int = 2, m: int = 4) -> bool:
+    w = np.asarray(weight._value if isinstance(weight, Tensor) else weight)
+    flat = w.reshape(-1, w.shape[-1])
+    cols = flat.shape[1] - flat.shape[1] % m
+    groups = flat[:, :cols].reshape(flat.shape[0], -1, m)
+    return bool(np.all(np.count_nonzero(groups, axis=-1) <= n))
+
+
+def set_excluded_layers(model: Layer, layer_names):
+    _EXCLUDED.setdefault(model, set()).update(layer_names)
+
+
+def reset_excluded_layers(model: Layer = None):
+    if model is None:
+        _EXCLUDED.clear()
+    else:
+        _EXCLUDED.pop(model, None)
+
+
+def _supported(sub: Layer) -> bool:
+    return isinstance(sub, (Linear, Conv2D))
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d", with_mask: bool = True):
+    """Compute and apply n:m masks to every supported layer's weight.
+
+    Returns {param_name: mask}; masks are remembered so a decorated
+    optimizer keeps enforcing them.
+    """
+    if mask_algo not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
+        raise ValueError(f"unknown mask_algo {mask_algo!r}")
+    excluded = _EXCLUDED.get(model, set())
+    masks = _MASKS.setdefault(model, {})
+    for lname, sub in model.named_sublayers():
+        if not _supported(sub) or lname in excluded:
+            continue
+        w = sub.weight
+        arr = np.asarray(w._value)
+        # mask along the input dim: for Linear [in, out] that is axis 0,
+        # so transpose; for Conv [out, in, kh, kw] flatten per out-channel.
+        if isinstance(sub, Linear):
+            mask = create_mask(arr.T, n, m).T
+        else:
+            oc = arr.shape[0]
+            mask = create_mask(arr.reshape(oc, -1), n, m).reshape(arr.shape)
+        w.set_value(jnp.asarray(arr * mask, dtype=w._value.dtype))
+        masks[f"{lname}.weight"] = mask
+        _PARAM_MASKS[id(w)] = (weakref.ref(w), mask)
+    return dict(masks)
+
+
+def decorate(optimizer):
+    """Wrap an optimizer so every ``step`` re-applies the stored masks to
+    pruned parameters (reference ASPHelper decorate/OptimizerWithSparsity
+    Guarantee)."""
+    return _ASPOptimizer(optimizer)
+
+
+class _ASPOptimizer:
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+        if not _PARAM_MASKS:
+            return
+        for p in self._inner._parameter_list:
+            entry = _PARAM_MASKS.get(id(p))
+            if entry is None:
+                continue
+            ref, mask = entry
+            if ref() is not p:  # stale id from a collected model
+                del _PARAM_MASKS[id(p)]
+                continue
+            p.set_value(jnp.asarray(np.asarray(p._value) * mask,
+                                    dtype=p._value.dtype))

@@ -18,7 +18,13 @@ __all__ = ["scaled_dot_product_attention", "flash_attention",
            "flash_attn_unpadded", "sdp_kernel"]
 
 
-def _xla_attention(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None):
+def _dropout_key():
+    from ...core.generator import default_generator
+    return default_generator().next_key()
+
+
+def _xla_attention(q, k, v, mask=None, causal=False, dropout_p=0.0,
+                   dropout_key=None, scale=None):
     # q,k,v: [B, S, H, D] (paddle flash-attn layout)
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
@@ -38,6 +44,10 @@ def _xla_attention(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None):
     if mask is not None:
         logits = logits + mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0) \
+            .astype(probs.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
     return jnp.swapaxes(out, 1, 2)  # back to B,S,H,D
 
@@ -51,8 +61,12 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
         out = pallas_fa.flash_attention(query, key, value, causal=causal)
         return (out, None) if return_softmax else out
 
+    p = dropout if training else 0.0
+    dkey = _dropout_key() if p > 0.0 else None
+
     def impl(q, k, v):
-        return _xla_attention(q, k, v, causal=causal)
+        return _xla_attention(q, k, v, causal=causal, dropout_p=p,
+                              dropout_key=dkey)
 
     out = dispatch("flash_attention", impl, (query, key, value))
     if return_softmax:
@@ -69,14 +83,19 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             query, causal=is_causal, dropout=dropout_p):
         return pallas_fa.flash_attention(query, key, value, causal=is_causal)
 
+    p = dropout_p if training else 0.0
+    dkey = _dropout_key() if p > 0.0 else None
+
     if attn_mask is None:
         def impl(q, k, v):
-            return _xla_attention(q, k, v, causal=is_causal)
+            return _xla_attention(q, k, v, causal=is_causal, dropout_p=p,
+                                  dropout_key=dkey)
 
         return dispatch("sdpa", impl, (query, key, value))
 
     def impl(q, k, v, m):
-        return _xla_attention(q, k, v, mask=m, causal=is_causal)
+        return _xla_attention(q, k, v, mask=m, causal=is_causal, dropout_p=p,
+                              dropout_key=dkey)
 
     return dispatch("sdpa", impl, (query, key, value, attn_mask),
                     nondiff_mask=[False, False, False, True])

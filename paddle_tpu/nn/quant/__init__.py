@@ -1,0 +1,15 @@
+"""Quantization-aware layers (reference: ``python/paddle/nn/quant/``).
+
+``QuantedLinear``/``QuantedConv2D`` wrap a float layer with weight and
+activation fake-quanters during QAT; ``QuantizedLinearInfer``/
+``QuantizedConv2DInfer`` are the converted inference forms holding int8
+weights + scales and dequantizing on the fly (XLA fuses the dequant into
+the matmul/conv epilogue on TPU).
+"""
+
+from .quant_layers import (QuantedLinear, QuantedConv2D,
+                           QuantizedLinearInfer, QuantizedConv2DInfer,
+                           QuantStub)
+
+__all__ = ["QuantedLinear", "QuantedConv2D", "QuantizedLinearInfer",
+           "QuantizedConv2DInfer", "QuantStub"]

@@ -1,0 +1,116 @@
+"""QAT wrapper layers and converted int8 inference layers.
+
+Reference parity: ``python/paddle/nn/quant/quant_layers.py``
+(QuantizedLinear/QuantizedConv2D with fake-quant on weight+activation).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ..layer.layers import Layer
+from .. import functional as F
+
+
+class QuantStub(Layer):
+    """Marks an activation quantization point; holds the act quanter."""
+
+    def __init__(self, quanter):
+        super().__init__()
+        self.quanter = quanter
+
+    def forward(self, x):
+        return self.quanter(x) if self.quanter is not None else x
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized weight and (optionally) activation."""
+
+    def __init__(self, layer, act_quanter=None, weight_quanter=None):
+        super().__init__()
+        # keep a plain reference to the float layer (not a registered
+        # sublayer — its weight/bias are re-registered on this wrapper and
+        # must not appear twice in parameters())
+        object.__setattr__(self, "_float_layer", layer)
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, layer, act_quanter=None, weight_quanter=None):
+        super().__init__()
+        object.__setattr__(self, "_float_layer", layer)
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self._stride = layer.stride
+        self._padding = layer.padding
+        self._dilation = layer.dilation
+        self._groups = layer.groups
+        self._data_format = layer.data_format
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.conv2d(x, w, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)
+
+
+def _dequant(qw, scale, axis):
+    shape = [1] * qw.ndim
+    shape[axis % qw.ndim] = -1
+    return qw.astype(jnp.float32) * jnp.asarray(scale, jnp.float32).reshape(shape)
+
+
+class QuantizedLinearInfer(Layer):
+    """Converted inference Linear: int8 weight + per-channel scales."""
+
+    def __init__(self, qweight, scales, bias, in_features, out_features,
+                 act_scale=None, bits=8):
+        super().__init__()
+        self.register_buffer("qweight", Tensor(qweight))
+        self.register_buffer("weight_scale", Tensor(scales))
+        self.bias = bias
+        self.in_features = in_features
+        self.out_features = out_features
+        self._act_scale = act_scale
+        self._bits = bits
+
+    def forward(self, x):
+        w = Tensor(_dequant(self.qweight._value, self.weight_scale._value,
+                            axis=-1))
+        return F.linear(x, w, self.bias)
+
+
+class QuantizedConv2DInfer(Layer):
+    def __init__(self, qweight, scales, bias, conv_args, act_scale=None,
+                 bits=8):
+        super().__init__()
+        self.register_buffer("qweight", Tensor(qweight))
+        self.register_buffer("weight_scale", Tensor(scales))
+        self.bias = bias
+        (self._stride, self._padding, self._dilation, self._groups,
+         self._data_format) = conv_args
+        self._act_scale = act_scale
+        self._bits = bits
+
+    def forward(self, x):
+        w = Tensor(_dequant(self.qweight._value, self.weight_scale._value,
+                            axis=0))
+        return F.conv2d(x, w, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)

@@ -1,0 +1,31 @@
+"""Quantization (QAT + PTQ) — capability analogue of ``paddle.quantization``
+(reference: ``python/paddle/quantization/{config.py,qat.py,ptq.py}``,
+imperative QAT in ``python/paddle/quantization/imperative/qat.py`` and the
+static PTQ/QAT tooling under ``python/paddle/static/quantization``).
+
+TPU-native design: fake-quantization is expressed as quantize-dequantize
+(QDQ) with a straight-through-estimator gradient — ``x + stop_gradient(
+dq(q(x)) - x)`` — which XLA folds into the surrounding matmul; the
+converted inference model carries int8 weights with per-tensor or
+per-channel scales and computes in bf16/fp32 after dequant (int8 MXU
+matmul is a kernel-level optimization the Pallas pack can add without
+changing this surface).
+"""
+
+from .config import QuantConfig
+from .observers import (AbsmaxObserver, MovingAverageAbsmaxObserver,
+                        PerChannelAbsmaxObserver, BaseObserver)
+from .quanters import (BaseQuanter, FakeQuanterWithAbsMaxObserver,
+                       FakeQuanterChannelWiseAbsMaxObserver,
+                       quantize_tensor, dequantize_tensor, fake_quant)
+from .qat import QAT
+from .ptq import PTQ
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ",
+    "BaseObserver", "AbsmaxObserver", "MovingAverageAbsmaxObserver",
+    "PerChannelAbsmaxObserver",
+    "BaseQuanter", "FakeQuanterWithAbsMaxObserver",
+    "FakeQuanterChannelWiseAbsMaxObserver",
+    "quantize_tensor", "dequantize_tensor", "fake_quant",
+]

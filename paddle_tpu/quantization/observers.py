@@ -1,0 +1,94 @@
+"""PTQ observers — collect activation statistics during calibration.
+
+Reference parity: ``paddle.quantization.observers.AbsmaxObserver`` plus the
+moving-average and per-channel variants used by the static PTQ tooling
+(python/paddle/static/quantization/quanter.py scale strategies).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn import Layer
+
+
+class BaseObserver(Layer):
+    """Observers are identity layers that record statistics; ``scales()``
+    yields the calibrated quantization scale (absmax)."""
+
+    def __init__(self, bit_length: int = 8):
+        super().__init__()
+        self._bits = bit_length
+
+    def bit_length(self):
+        return self._bits
+
+    def quant_axis(self):
+        return None
+
+    def scales(self):
+        raise NotImplementedError
+
+    def forward(self, x):
+        self.observe(x)
+        return x
+
+    def observe(self, x):
+        raise NotImplementedError
+
+
+class AbsmaxObserver(BaseObserver):
+    """Global absmax over everything seen during calibration."""
+
+    def __init__(self, bit_length: int = 8, **kwargs):
+        super().__init__(bit_length)
+        self._absmax = 0.0
+
+    def observe(self, x):
+        cur = float(jnp.max(jnp.abs(jnp.asarray(x._value, jnp.float32))))
+        self._absmax = max(self._absmax, cur)
+
+    def scales(self):
+        return Tensor(jnp.asarray(max(self._absmax, 1e-9), jnp.float32))
+
+
+class MovingAverageAbsmaxObserver(BaseObserver):
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 **kwargs):
+        super().__init__(bit_length)
+        self._moving_rate = moving_rate
+        self._absmax = None
+
+    def observe(self, x):
+        cur = float(jnp.max(jnp.abs(jnp.asarray(x._value, jnp.float32))))
+        if self._absmax is None:
+            self._absmax = cur
+        else:
+            self._absmax = (self._moving_rate * self._absmax +
+                            (1 - self._moving_rate) * cur)
+
+    def scales(self):
+        return Tensor(jnp.asarray(max(self._absmax or 0.0, 1e-9),
+                                  jnp.float32))
+
+
+class PerChannelAbsmaxObserver(BaseObserver):
+    def __init__(self, quant_axis: int = -1, bit_length: int = 8, **kwargs):
+        super().__init__(bit_length)
+        self._axis = quant_axis
+        self._absmax = None
+
+    def quant_axis(self):
+        return self._axis
+
+    def observe(self, x):
+        axis = self._axis % x.ndim
+        reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+        cur = jnp.max(jnp.abs(jnp.asarray(x._value, jnp.float32)),
+                      axis=reduce_axes)
+        self._absmax = cur if self._absmax is None \
+            else jnp.maximum(self._absmax, cur)
+
+    def scales(self):
+        return Tensor(jnp.maximum(self._absmax, 1e-9))

@@ -1,0 +1,76 @@
+"""PTQ — post-training quantization driver.
+
+Reference parity: ``paddle.quantization.PTQ``
+(python/paddle/quantization/ptq.py): ``quantize(model)`` inserts observers
+in front of quantizable layers; the user runs calibration batches; then
+``convert(model)`` freezes observed scales into the int8 inference model.
+"""
+
+from __future__ import annotations
+
+from ..nn import Layer, Linear
+from ..nn.layer.conv import Conv2D
+from ..nn.quant.quant_layers import QuantedLinear, QuantedConv2D
+from .config import QuantConfig
+from .qat import QAT, _freeze
+from .observers import BaseObserver, PerChannelAbsmaxObserver
+
+
+class _ObservedLayer(Layer):
+    """Float layer with an input observer attached (calibration phase)."""
+
+    def __init__(self, layer, observer):
+        super().__init__()
+        self.inner = layer
+        self.observer = observer
+
+    def forward(self, *args, **kwargs):
+        if self.observer is not None and args:
+            self.observer.observe(args[0])
+        return self.inner(*args, **kwargs)
+
+
+class PTQ:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._insert_rec(model)
+        return model
+
+    def _insert_rec(self, layer: Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, (Linear, Conv2D)):
+                obs = self._config.activation_quanter_for(sub)
+                if obs is not None and not isinstance(obs, BaseObserver):
+                    raise TypeError(
+                        "PTQ activation config must be an observer class, "
+                        f"got {type(obs).__name__}")
+                if obs is not None:
+                    layer._sub_layers[name] = _ObservedLayer(sub, obs)
+            else:
+                self._insert_rec(sub)
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._convert_rec(model)
+        return model
+
+    def _convert_rec(self, layer: Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, _ObservedLayer):
+                inner = sub.inner
+                act_scale = sub.observer.scales() if sub.observer else None
+                wrapper_cls = QuantedLinear if isinstance(inner, Linear) \
+                    else QuantedConv2D
+                q = wrapper_cls(inner, None, None)
+                frozen = _freeze(q)
+                frozen._act_scale = act_scale
+                layer._sub_layers[name] = frozen
+            else:
+                self._convert_rec(sub)

@@ -1,0 +1,105 @@
+"""QAT — quantization-aware training driver.
+
+Reference parity: ``paddle.quantization.QAT``
+(python/paddle/quantization/qat.py): ``quantize(model)`` swaps supported
+layers for fake-quantized wrappers in place of training; ``convert(model)``
+freezes scales and emits the int8-weight inference model.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn import Layer, Linear
+from ..nn.layer.conv import Conv2D
+from ..nn.quant.quant_layers import (QuantedLinear, QuantedConv2D,
+                                     QuantizedLinearInfer,
+                                     QuantizedConv2DInfer)
+from .config import QuantConfig
+from .quanters import FakeQuanterChannelWiseAbsMaxObserver
+
+
+class QAT:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def _wrap(self, layer):
+        act = self._config.activation_quanter_for(layer)
+        weight = self._config.weight_quanter_for(layer)
+        if act is None and weight is None:
+            return None
+        if isinstance(layer, Linear):
+            if isinstance(weight, FakeQuanterChannelWiseAbsMaxObserver):
+                weight._axis = -1  # out-features axis of [in, out]
+            return QuantedLinear(layer, act, weight)
+        if isinstance(layer, Conv2D):
+            if isinstance(weight, FakeQuanterChannelWiseAbsMaxObserver):
+                weight._axis = 0   # out-channels axis of [out, in, kh, kw]
+            return QuantedConv2D(layer, act, weight)
+        return None
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        """Replace every quantizable sublayer with its QAT wrapper."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._quantize_rec(model)
+        return model
+
+    def _quantize_rec(self, layer: Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            wrapped = self._wrap(sub)
+            if wrapped is not None:
+                layer._sub_layers[name] = wrapped
+            else:
+                self._quantize_rec(sub)
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        """Freeze a trained QAT model into the int8 inference form."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._convert_rec(model)
+        return model
+
+    def _convert_rec(self, layer: Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, (QuantedLinear, QuantedConv2D)):
+                layer._sub_layers[name] = _freeze(sub)
+            else:
+                self._convert_rec(sub)
+
+
+def _freeze(qlayer):
+    """Snapshot weight scales, quantize the weight to int8, and build the
+    inference layer."""
+    w = jnp.asarray(qlayer.weight._value, jnp.float32)
+    bits = (qlayer.weight_quanter.bit_length()
+            if qlayer.weight_quanter is not None else 8)
+    qmax = float(2 ** (bits - 1) - 1)
+    act_scale = None
+    if qlayer.activation_quanter is not None:
+        act_scale = qlayer.activation_quanter.scales()
+
+    if isinstance(qlayer, QuantedLinear):
+        axis = 1  # [in, out] -> per-out-channel
+        reduce_axes = (0,)
+        scales = jnp.maximum(jnp.max(jnp.abs(w), axis=reduce_axes) / qmax,
+                             1e-9)
+        qw = jnp.clip(jnp.round(w / scales[None, :]), -qmax, qmax) \
+            .astype(jnp.int8)
+        return QuantizedLinearInfer(
+            qw, scales, qlayer.bias, qlayer._float_layer.in_features,
+            qlayer._float_layer.out_features, act_scale, bits)
+
+    axis = 0  # conv [out, in, kh, kw]
+    reduce_axes = tuple(range(1, w.ndim))
+    scales = jnp.maximum(jnp.max(jnp.abs(w), axis=reduce_axes) / qmax, 1e-9)
+    shape = [1] * w.ndim
+    shape[axis] = -1
+    qw = jnp.clip(jnp.round(w / scales.reshape(shape)), -qmax, qmax) \
+        .astype(jnp.int8)
+    conv_args = (qlayer._stride, qlayer._padding, qlayer._dilation,
+                 qlayer._groups, qlayer._data_format)
+    return QuantizedConv2DInfer(qw, scales, qlayer.bias, conv_args,
+                                act_scale, bits)

@@ -1,0 +1,148 @@
+"""Fake quanters (QDQ with straight-through gradients).
+
+Reference parity: ``paddle.quantization.quanters.FakeQuanterWithAbsMaxObserver``
+(python/paddle/quantization/quanters/abs_max.py) and the channel-wise
+variant used for conv/linear weights.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor
+from ..nn import Layer
+
+
+def _qrange(bits: int):
+    qmax = float(2 ** (bits - 1) - 1)
+    return -qmax, qmax
+
+
+def quantize_tensor(x, scale, bits: int = 8, axis=None):
+    """real -> int: round(x / scale) clipped to the signed range."""
+    qmin, qmax = _qrange(bits)
+
+    def impl(a, s):
+        if axis is not None:
+            shape = [1] * a.ndim
+            shape[axis] = -1
+            s = s.reshape(shape)
+        q = jnp.clip(jnp.round(a / s), qmin, qmax)
+        return q.astype(jnp.int8 if bits <= 8 else jnp.int32)
+
+    return dispatch("quantize", impl, (x, scale), nondiff_mask=[True, True])
+
+
+def dequantize_tensor(q, scale, axis=None):
+    def impl(a, s):
+        if axis is not None:
+            shape = [1] * a.ndim
+            shape[axis] = -1
+            s = s.reshape(shape)
+        return a.astype(jnp.float32) * s
+
+    return dispatch("dequantize", impl, (q, scale), nondiff_mask=[True, True])
+
+
+def fake_quant(x, scale, bits: int = 8, axis=None):
+    """QDQ with straight-through estimator: gradient of round/clip is
+    identity inside the representable range (STE), which is exactly
+    ``x + stop_gradient(qdq(x) - x)``."""
+    qmin, qmax = _qrange(bits)
+
+    def impl(a, s):
+        sf = jnp.maximum(jnp.asarray(s, jnp.float32), 1e-9)
+        if axis is not None:
+            shape = [1] * a.ndim
+            shape[axis] = -1
+            sf = sf.reshape(shape)
+        qdq = jnp.clip(jnp.round(a / sf), qmin, qmax) * sf
+        return a + lax.stop_gradient(qdq - a.astype(qdq.dtype)).astype(a.dtype)
+
+    return dispatch("fake_quantize_dequantize", impl, (x, scale),
+                    nondiff_mask=[False, True])
+
+
+class BaseQuanter(Layer):
+    """A quanter is a Layer inserted into the model; calling it fake-quants
+    its input and (in training) updates its observer statistics."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def quant_axis(self):
+        return None
+
+    def bit_length(self):
+        return self._bits
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """Per-tensor moving-average absmax fake quanter (activation quanter).
+
+    Matches the reference quanter of the same name: in training mode the
+    scale is the EMA of per-batch absmax; in eval mode the stored scale
+    is used.
+    """
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 **kwargs):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._bits = bit_length
+        self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)))
+        self.register_buffer("_initialized",
+                             Tensor(jnp.zeros((), jnp.bool_)))
+
+    def scales(self):
+        return self.scale
+
+    def forward(self, x):
+        if self.training:
+            # pure-jnp EMA update so the quanter traces under jit/TrainStep
+            # (buffers are threaded through the compiled step like
+            # batch-norm running stats)
+            cur = jnp.maximum(jnp.max(jnp.abs(
+                jnp.asarray(x._value).astype(jnp.float32))), 1e-9)
+            prev = jnp.asarray(self.scale._value, jnp.float32)
+            new = jnp.where(self._initialized._value,
+                            self._moving_rate * prev +
+                            (1 - self._moving_rate) * cur,
+                            cur)
+            self.scale.set_value(new)
+            self._initialized.set_value(jnp.ones((), jnp.bool_))
+        # stored scale is the absmax (reference semantics); the QDQ step
+        # size is absmax / qmax
+        qmax = float(2 ** (self._bits - 1) - 1)
+        step = Tensor(jnp.asarray(self.scale._value, jnp.float32) / qmax)
+        return fake_quant(x, step, bits=self._bits)
+
+
+class FakeQuanterChannelWiseAbsMaxObserver(BaseQuanter):
+    """Per-channel absmax fake quanter (weight quanter): scale is computed
+    from the current weight every call — weights change each step, and the
+    convert step snapshots the final scales."""
+
+    def __init__(self, quant_axis: int = -1, bit_length: int = 8, **kwargs):
+        super().__init__()
+        self._axis = quant_axis
+        self._bits = bit_length
+        self._last_scale = None
+
+    def quant_axis(self):
+        return self._axis
+
+    def scales(self):
+        return self._last_scale
+
+    def forward(self, w):
+        axis = self._axis % w.ndim
+        reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+        scale_arr = jnp.max(jnp.abs(jnp.asarray(w._value, jnp.float32)),
+                            axis=reduce_axes)
+        qmax = float(2 ** (self._bits - 1) - 1)
+        scale_arr = jnp.maximum(scale_arr / qmax, 1e-9)
+        self._last_scale = Tensor(scale_arr)
+        return fake_quant(w, self._last_scale, bits=self._bits, axis=axis)

@@ -1,0 +1,426 @@
+"""Vision ops — detection primitives.
+
+Capability analogue of ``paddle.vision.ops``
+(reference: python/paddle/vision/ops.py: roi_align:1107, roi_pool,
+deform_conv2d:536, nms:1380, box_coder, prior_box; CUDA kernels under
+paddle/phi/kernels/gpu/{roi_align_kernel.cu,deformable_conv_kernel.cu,
+nms_kernel.cu}).
+
+TPU-native design: roi_align / deform_conv2d are expressed as bilinear
+gathers (differentiable, static-shape, XLA-fusable — the TPU analogue of
+the reference's hand-written CUDA bilinear kernels).  NMS is inherently
+data-dependent, so it runs as an eager host op returning kept indices
+(like the reference's dynamic-shape outputs, it is eager-only and
+non-differentiable).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+
+__all__ = ["roi_align", "RoIAlign", "roi_pool", "RoIPool", "nms",
+           "deform_conv2d", "DeformConv2D", "box_coder", "prior_box",
+           "matrix_nms"]
+
+
+def _bilinear_sample(feat, ys, xs):
+    """feat [C, H, W]; ys/xs arbitrary same-shaped float grids -> [C, *grid].
+
+    Out-of-range samples clamp to the border (reference roi_align
+    behavior: sample points outside the image are clipped)."""
+    H, W = feat.shape[-2], feat.shape[-1]
+    y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    ly = jnp.clip(ys - y0, 0.0, 1.0)
+    lx = jnp.clip(xs - x0, 0.0, 1.0)
+    y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+    x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+
+    def gather(yi, xi):
+        return feat[:, yi, xi]  # advanced indexing broadcasts over grid
+
+    v00 = gather(y0i, x0i)
+    v01 = gather(y0i, x1i)
+    v10 = gather(y1i, x0i)
+    v11 = gather(y1i, x1i)
+    return ((1 - ly) * (1 - lx) * v00 + (1 - ly) * lx * v01 +
+            ly * (1 - lx) * v10 + ly * lx * v11)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (Mask R-CNN): averages bilinear samples in each output bin.
+
+    x: [N, C, H, W]; boxes: [R, 4] (x1, y1, x2, y2); boxes_num: [N] rois
+    per image (prefix-assignment, reference semantics).
+    """
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def impl(xa, ba, bna):
+        roi_img = jnp.repeat(jnp.arange(bna.shape[0]), bna,
+                             total_repeat_length=ba.shape[0])
+        offset = 0.5 if aligned else 0.0
+        x1 = ba[:, 0] * spatial_scale - offset
+        y1 = ba[:, 1] * spatial_scale - offset
+        x2 = ba[:, 2] * spatial_scale - offset
+        y2 = ba[:, 3] * spatial_scale - offset
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        ns = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid per roi: [ph*ns] x [pw*ns] points
+        iy = (jnp.arange(ph * ns) + 0.5) / ns  # in bin-h units
+        ix = (jnp.arange(pw * ns) + 0.5) / ns
+
+        def one_roi(img_idx, yy1, xx1, bh, bw):
+            ys = yy1 + iy * bh                      # [ph*ns]
+            xs = xx1 + ix * bw                      # [pw*ns]
+            grid_y = jnp.broadcast_to(ys[:, None], (ph * ns, pw * ns))
+            grid_x = jnp.broadcast_to(xs[None, :], (ph * ns, pw * ns))
+            vals = _bilinear_sample(xa[img_idx], grid_y, grid_x)
+            c = vals.shape[0]
+            vals = vals.reshape(c, ph, ns, pw, ns)
+            return vals.mean(axis=(2, 4))           # [C, ph, pw]
+
+        import jax
+        return jax.vmap(one_roi)(roi_img, y1, x1, bin_h, bin_w)
+
+    return dispatch("roi_align", impl, (x, boxes, boxes_num),
+                    nondiff_mask=[False, True, True])
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool (Fast R-CNN): max over quantized bins.  Expressed as a dense
+    sample-then-max (static shapes; the reference maxes over the integer
+    cells of each bin, we max over a fixed 4x-oversampled grid per bin —
+    sub-pixel spacing for bins up to 4 px wide)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    ns = 4
+
+    def impl(xa, ba, bna):
+        roi_img = jnp.repeat(jnp.arange(bna.shape[0]), bna,
+                             total_repeat_length=ba.shape[0])
+        x1 = jnp.round(ba[:, 0] * spatial_scale)
+        y1 = jnp.round(ba[:, 1] * spatial_scale)
+        x2 = jnp.round(ba[:, 2] * spatial_scale)
+        y2 = jnp.round(ba[:, 3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        iy = (jnp.arange(ph * ns) + 0.5) / ns
+        ix = (jnp.arange(pw * ns) + 0.5) / ns
+
+        def one_roi(img_idx, yy1, xx1, bh, bw):
+            ys = yy1 + iy * bh
+            xs = xx1 + ix * bw
+            grid_y = jnp.broadcast_to(ys[:, None], (ph * ns, pw * ns))
+            grid_x = jnp.broadcast_to(xs[None, :], (ph * ns, pw * ns))
+            vals = _bilinear_sample(xa[img_idx], grid_y, grid_x)
+            c = vals.shape[0]
+            vals = vals.reshape(c, ph, ns, pw, ns)
+            return vals.max(axis=(2, 4))
+
+        import jax
+        return jax.vmap(one_roi)(roi_img, y1, x1, bin_h, bin_w)
+
+    return dispatch("roi_pool", impl, (x, boxes, boxes_num),
+                    nondiff_mask=[False, True, True])
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    xx1 = np.maximum(x1[:, None], x1[None, :])
+    yy1 = np.maximum(y1[:, None], y1[None, :])
+    xx2 = np.minimum(x2[:, None], x2[None, :])
+    yy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / np.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS.  Eager host op (dynamic output shape, like the
+    reference's nms_kernel); returns kept indices sorted by score."""
+    b = np.asarray(boxes._value if isinstance(boxes, Tensor) else boxes,
+                   np.float32)
+    n = b.shape[0]
+    s = (np.asarray(scores._value if isinstance(scores, Tensor) else scores,
+                    np.float32) if scores is not None
+         else np.arange(n, 0, -1, dtype=np.float32))
+    if category_idxs is not None:
+        # category-aware: offset boxes per category so they never overlap
+        cidx = np.asarray(category_idxs._value
+                          if isinstance(category_idxs, Tensor)
+                          else category_idxs)
+        max_coord = b.max() if n else 0.0
+        b = b + (cidx[:, None].astype(np.float32) * (max_coord + 1.0))
+    order = np.argsort(-s)
+    iou = _iou_matrix(b)
+    keep = []
+    suppressed = np.zeros(n, bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        suppressed |= iou[i] > iou_threshold
+        suppressed[i] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=-1, keep_top_k=-1, use_gaussian=False,
+               gaussian_sigma=2.0, name=None):
+    """Matrix NMS (SOLOv2): soft decay of scores by pairwise IoU.
+    Single-image [N, 4] boxes + [N] scores variant; returns
+    (decayed_scores, kept_indices)."""
+    b = np.asarray(bboxes._value if isinstance(bboxes, Tensor) else bboxes,
+                   np.float32)
+    s = np.asarray(scores._value if isinstance(scores, Tensor) else scores,
+                   np.float32)
+    valid = np.nonzero(s >= score_threshold)[0]
+    if nms_top_k > 0:
+        valid = valid[np.argsort(-s[valid])[:nms_top_k]]
+    else:
+        valid = valid[np.argsort(-s[valid])]
+    if valid.size == 0:
+        return Tensor(jnp.zeros((0,), jnp.float32)), \
+            Tensor(jnp.zeros((0,), jnp.int64))
+    bb, ss = b[valid], s[valid]
+    iou = np.triu(_iou_matrix(bb), k=1)
+    # compensate IoU: for each box (as suppressor i), its own max IoU with
+    # any higher-scored box — row-indexed in the decay matrix (SOLOv2 eq. 4)
+    iou_cmax = iou.max(axis=0)
+    if use_gaussian:
+        decay = np.exp(-(iou ** 2 - iou_cmax[:, None] ** 2) / gaussian_sigma)
+        decay = decay.min(axis=0)
+    else:
+        decay = ((1 - iou) / np.maximum(1 - iou_cmax[:, None], 1e-10)) \
+            .min(axis=0)
+    decay = np.minimum(decay, 1.0)
+    decayed = ss * decay
+    mask = decayed >= post_threshold
+    out_idx = valid[mask]
+    out_scores = decayed[mask]
+    order = np.argsort(-out_scores)
+    if keep_top_k > 0:
+        order = order[:keep_top_k]
+    return Tensor(jnp.asarray(out_scores[order])), \
+        Tensor(jnp.asarray(out_idx[order].astype(np.int64)))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 as bilinear-gather + matmul.
+
+    x [N, Cin, H, W]; offset [N, 2*dg*kh*kw, Ho, Wo] ((dy, dx) pairs);
+    mask [N, dg*kh*kw, Ho, Wo] for v2 modulation; weight
+    [Cout, Cin/groups, kh, kw].
+    """
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError(
+            "deform_conv2d: groups/deformable_groups > 1 not supported yet")
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) \
+        else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    kh, kw = weight.shape[2], weight.shape[3]
+    tensors = [x, offset, weight]
+    has_mask = mask is not None
+    if has_mask:
+        tensors.append(mask)
+    if bias is not None:
+        tensors.append(bias)
+
+    def impl(xa, off, wa, *rest):
+        import jax
+        r = list(rest)
+        ma = r.pop(0) if has_mask else None
+        ba = r.pop(0) if (bias is not None) else None
+        N, C, H, W = xa.shape
+        Ho = (H + 2 * padding[0] - dilation[0] * (kh - 1) - 1) \
+            // stride[0] + 1
+        Wo = (W + 2 * padding[1] - dilation[1] * (kw - 1) - 1) \
+            // stride[1] + 1
+        xa_p = jnp.pad(xa, ((0, 0), (0, 0),
+                            (padding[0], padding[0]),
+                            (padding[1], padding[1])))
+        # base sampling locations per (k, out-pixel), in padded coords
+        oy = jnp.arange(Ho) * stride[0]
+        ox = jnp.arange(Wo) * stride[1]
+        ky = jnp.arange(kh) * dilation[0]
+        kx = jnp.arange(kw) * dilation[1]
+        base_y = oy[None, :, None] + ky[:, None, None]    # [kh, Ho, 1]
+        base_x = ox[None, None, :] + kx[:, None, None]    # [kw, 1, Wo] via kx
+        # offsets: [N, 2*kh*kw, Ho, Wo] -> dy/dx [N, kh*kw, Ho, Wo]
+        off = off.reshape(N, kh * kw, 2, Ho, Wo)
+        dy, dx = off[:, :, 0], off[:, :, 1]
+        ys = (base_y.reshape(kh, 1, Ho, 1) +
+              jnp.zeros((1, kw, 1, Wo))).reshape(1, kh * kw, Ho, Wo) + dy
+        xs = (jnp.zeros((kh, 1, Ho, 1)) +
+              base_x.reshape(1, kw, 1, Wo)).reshape(1, kh * kw, Ho, Wo) + dx
+
+        def per_image(feat, ysi, xsi, mi):
+            vals = _bilinear_sample(feat, ysi, xsi)  # [C, kh*kw, Ho, Wo]
+            if mi is not None:
+                vals = vals * mi[None]
+            return vals
+
+        vals = jax.vmap(per_image)(
+            xa_p, ys, xs,
+            ma.reshape(N, kh * kw, Ho, Wo) if ma is not None else
+            jnp.ones((N, kh * kw, Ho, Wo), xa.dtype))
+        # contract [C*kh*kw] with weight [Cout, C*kh*kw]
+        cols = vals.reshape(N, C * kh * kw, Ho * Wo)
+        wmat = wa.reshape(wa.shape[0], C * kh * kw)
+        out = jnp.einsum("ok,nkp->nop", wmat, cols).reshape(
+            N, wa.shape[0], Ho, Wo)
+        if ba is not None:
+            out = out + ba[None, :, None, None]
+        return out
+
+    return dispatch("deform_conv2d", impl, tensors)
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn.initializer import XavierNormal
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, ks[0], ks[1]),
+            attr=weight_attr, default_initializer=XavierNormal())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self._stride,
+                             self._padding, self._dilation,
+                             self._deformable_groups, self._groups, mask)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference box_coder op)."""
+    norm = 0.0 if box_normalized else 1.0
+
+    def impl(pb, pbv, tb):
+        pw = pb[:, 2] - pb[:, 0] + norm
+        phh = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + phh * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            dx = (tcx - pcx) / pw / pbv[:, 0]
+            dy = (tcy - pcy) / phh / pbv[:, 1]
+            dw = jnp.log(tw / pw) / pbv[:, 2]
+            dh = jnp.log(th / phh) / pbv[:, 3]
+            return jnp.stack([dx, dy, dw, dh], axis=-1)
+        # decode_center_size: tb holds deltas
+        dcx = pbv[:, 0] * tb[..., 0] * pw + pcx
+        dcy = pbv[:, 1] * tb[..., 1] * phh + pcy
+        dw = jnp.exp(pbv[:, 2] * tb[..., 2]) * pw
+        dh = jnp.exp(pbv[:, 3] * tb[..., 3]) * phh
+        return jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                          dcx + dw * 0.5 - norm, dcy + dh * 0.5 - norm],
+                         axis=-1)
+
+    return dispatch("box_coder", impl, (prior_box, prior_box_var, target_box),
+                    nondiff_mask=[True, True, False])
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    """SSD prior (anchor) boxes over a feature map (reference prior_box)."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = float(image.shape[2]), float(image.shape[3])
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+
+    boxes = []
+    for ms in min_sizes:
+        for ar in ars:
+            w = ms * np.sqrt(ar)
+            h = ms / np.sqrt(ar)
+            boxes.append((w, h))
+        if max_sizes:
+            for mx in max_sizes:
+                s = np.sqrt(ms * mx)
+                boxes.append((s, s))
+    k = len(boxes)
+    cy = (np.arange(fh) + offset) * step_h
+    cx = (np.arange(fw) + offset) * step_w
+    grid_cx, grid_cy = np.meshgrid(cx, cy)
+    out = np.zeros((fh, fw, k, 4), np.float32)
+    for i, (w, h) in enumerate(boxes):
+        out[:, :, i, 0] = (grid_cx - w / 2) / iw
+        out[:, :, i, 1] = (grid_cy - h / 2) / ih
+        out[:, :, i, 2] = (grid_cx + w / 2) / iw
+        out[:, :, i, 3] = (grid_cy + h / 2) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))

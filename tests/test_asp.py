@@ -1,0 +1,77 @@
+"""ASP n:m sparsity tests (≙ test/asp/test_asp_pruning_*.py pattern)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate import asp
+
+
+def test_create_mask_2_4():
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((8, 16)).astype(np.float32)
+    mask = asp.create_mask(w, n=2, m=4)
+    groups = mask.reshape(8, -1, 4)
+    assert np.all(groups.sum(axis=-1) == 2)
+    # the kept entries are the two largest magnitudes in each group
+    g = w.reshape(8, -1, 4)
+    kept = np.abs(g * groups.astype(bool))
+    dropped = np.abs(g * (1 - groups))
+    assert np.all(kept.max(axis=-1) >= dropped.max(axis=-1))
+
+
+def test_prune_model_and_density():
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    masks = asp.prune_model(model, n=2, m=4)
+    assert len(masks) == 2
+    for _, sub in model.named_sublayers():
+        if isinstance(sub, nn.Linear):
+            # mask is along input dim: check transposed weight is 2:4
+            assert asp.check_sparsity(
+                np.asarray(sub.weight._value).T, n=2, m=4)
+            assert abs(asp.calculate_density(sub.weight) - 0.5) < 1e-6
+
+
+def test_decorated_optimizer_keeps_sparsity():
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    asp.prune_model(model, n=2, m=4)
+    opt = asp.decorate(
+        optimizer.SGD(learning_rate=0.1, parameters=model.parameters()))
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((8, 16)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 4, size=(8,)).astype("int64"))
+    for _ in range(3):
+        loss = nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    for _, sub in model.named_sublayers():
+        if isinstance(sub, nn.Linear):
+            assert asp.check_sparsity(
+                np.asarray(sub.weight._value).T, n=2, m=4)
+            assert abs(asp.calculate_density(sub.weight) - 0.5) < 1e-6
+
+
+def test_excluded_layers():
+    model = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+    asp.set_excluded_layers(model, ["0"])
+    masks = asp.prune_model(model)
+    assert "0.weight" not in masks and "1.weight" in masks
+    assert asp.calculate_density(model[0].weight) == 1.0
+    asp.reset_excluded_layers(model)
+
+
+def test_conv_prune():
+    model = nn.Sequential(nn.Conv2D(4, 8, 3, padding=1))
+    asp.prune_model(model)
+    w = np.asarray(model[0].weight._value)
+    assert asp.check_sparsity(w.reshape(w.shape[0], -1))
+
+
+def test_bad_mask_algo():
+    model = nn.Sequential(nn.Linear(4, 4))
+    try:
+        asp.prune_model(model, mask_algo="nope")
+        assert False
+    except ValueError as e:
+        assert "mask_algo" in str(e)

@@ -261,3 +261,21 @@ def test_initializers():
                                atol=1e-4)
     v = Assign(np.arange(6).reshape(2, 3))((2, 3), jnp.float32)
     np.testing.assert_allclose(np.asarray(v), np.arange(6).reshape(2, 3))
+
+
+def test_attention_dropout_applied_in_training():
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import functional as F
+    rng = np.random.default_rng(0)
+    q = paddle.to_tensor(rng.standard_normal((2, 8, 2, 4)).astype(np.float32))
+    out_eval = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                              training=False)
+    out_train = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                               training=True)
+    # training dropout must change the output; eval must not
+    assert not np.allclose(np.asarray(out_eval._value),
+                           np.asarray(out_train._value))
+    out_eval2 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                               training=False)
+    np.testing.assert_allclose(np.asarray(out_eval._value),
+                               np.asarray(out_eval2._value))

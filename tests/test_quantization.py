@@ -1,0 +1,178 @@
+"""Quantization tests (≙ test/quantization/test_quant.py pattern: QAT
+wrap -> train -> convert; PTQ observe -> convert; numeric sanity of QDQ)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.quantization import (
+    QuantConfig, QAT, PTQ, AbsmaxObserver, PerChannelAbsmaxObserver,
+    FakeQuanterWithAbsMaxObserver, FakeQuanterChannelWiseAbsMaxObserver,
+    fake_quant, quantize_tensor, dequantize_tensor)
+from paddle_tpu.nn.quant import (QuantedLinear, QuantedConv2D,
+                                 QuantizedLinearInfer, QuantizedConv2DInfer)
+
+
+def _net():
+    return nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+        nn.AdaptiveAvgPool2D(1), nn.Flatten(),
+        nn.Linear(8, 4))
+
+
+def test_fake_quant_roundtrip():
+    x = paddle.to_tensor(np.linspace(-1, 1, 17, dtype=np.float32))
+    scale = paddle.to_tensor(np.float32(1.0 / 127))
+    y = fake_quant(x, scale, bits=8)
+    err = np.abs(np.asarray(y._value) - np.asarray(x._value)).max()
+    assert err <= (1.0 / 127) / 2 + 1e-7  # within half a quant step
+
+
+def test_quantize_dequantize_tensor():
+    rng = np.random.default_rng(0)
+    w = paddle.to_tensor(rng.standard_normal((4, 6)).astype(np.float32))
+    scale = paddle.to_tensor((np.abs(np.asarray(w._value)).max(axis=0) /
+                              127).astype(np.float32))
+    q = quantize_tensor(w, scale, bits=8, axis=1)
+    assert str(q.dtype).endswith("int8")
+    dq = dequantize_tensor(q, scale, axis=1)
+    err = np.abs(np.asarray(dq._value) - np.asarray(w._value)).max()
+    assert err < float(np.asarray(scale._value).max())
+
+
+def test_fake_quant_ste_gradient():
+    x = paddle.to_tensor(np.array([0.3, -0.7], np.float32),
+                         stop_gradient=False)
+    scale = paddle.to_tensor(np.float32(1.0 / 127))
+    y = fake_quant(x, scale)
+    y.sum().backward()
+    # straight-through: gradient is identity inside range
+    np.testing.assert_allclose(np.asarray(x.grad._value), [1.0, 1.0])
+
+
+def test_qat_quantize_and_train():
+    model = _net()
+    cfg = QuantConfig(
+        activation=FakeQuanterWithAbsMaxObserver,
+        weight=FakeQuanterChannelWiseAbsMaxObserver)
+    qat = QAT(cfg)
+    qmodel = qat.quantize(model)
+    wrapped = [type(l).__name__ for l in qmodel.sublayers()]
+    assert "QuantedConv2D" in wrapped and "QuantedLinear" in wrapped
+
+    opt = optimizer.SGD(learning_rate=0.05, parameters=qmodel.parameters())
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((4, 3, 8, 8)).astype(np.float32))
+    labels = paddle.to_tensor(rng.integers(0, 4, size=(4,)).astype("int64"))
+    qmodel.train()
+    losses = []
+    for _ in range(5):
+        loss = nn.functional.cross_entropy(qmodel(x), labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+    infer = qat.convert(qmodel)
+    types = [type(l).__name__ for l in infer.sublayers()]
+    assert "QuantizedLinearInfer" in types and "QuantizedConv2DInfer" in types
+    infer.eval()
+    out = infer(x)
+    assert tuple(out.shape) == (4, 4)
+    assert np.all(np.isfinite(np.asarray(out._value)))
+
+
+def test_qat_convert_close_to_float():
+    # an already-trained float model converted via QAT wrappers should give
+    # outputs close to float (int8 weight quant error only)
+    model = _net()
+    model.eval()
+    rng = np.random.default_rng(2)
+    x = paddle.to_tensor(rng.standard_normal((2, 3, 8, 8)).astype(np.float32))
+    ref = np.asarray(model(x)._value)
+    qat = QAT(QuantConfig(activation=None,
+                          weight=FakeQuanterChannelWiseAbsMaxObserver))
+    infer = qat.convert(qat.quantize(model))
+    out = np.asarray(infer(x)._value)
+    np.testing.assert_allclose(out, ref, atol=0.1, rtol=0.1)
+
+
+def test_ptq_calibrate_convert():
+    model = _net()
+    model.eval()
+    ptq = PTQ(QuantConfig(activation=AbsmaxObserver, weight=None))
+    qmodel = ptq.quantize(model)
+    rng = np.random.default_rng(3)
+    ref_in = paddle.to_tensor(
+        rng.standard_normal((2, 3, 8, 8)).astype(np.float32))
+    ref = np.asarray(qmodel(ref_in)._value)  # observers are identity
+    for _ in range(3):
+        x = paddle.to_tensor(
+            rng.standard_normal((2, 3, 8, 8)).astype(np.float32))
+        qmodel(x)
+    infer = ptq.convert(qmodel)
+    types = [type(l).__name__ for l in infer.sublayers()]
+    assert "QuantizedConv2DInfer" in types and "QuantizedLinearInfer" in types
+    out = np.asarray(infer(ref_in)._value)
+    np.testing.assert_allclose(out, ref, atol=0.15, rtol=0.15)
+    # act scales recorded
+    infer_layers = [l for l in infer.sublayers()
+                    if isinstance(l, (QuantizedLinearInfer,
+                                      QuantizedConv2DInfer))]
+    assert all(l._act_scale is not None for l in infer_layers)
+
+
+def test_quant_config_type_override():
+    cfg = QuantConfig(activation=None, weight=None)
+    cfg.add_type_config(nn.Linear,
+                        weight=FakeQuanterChannelWiseAbsMaxObserver)
+    model = _net()
+    qmodel = QAT(cfg).quantize(model)
+    names = [type(l).__name__ for l in qmodel.sublayers()]
+    assert "QuantedLinear" in names and "QuantedConv2D" not in names
+
+
+def test_qat_state_dict_roundtrip():
+    model = _net()
+    qat = QAT(QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                          weight=FakeQuanterChannelWiseAbsMaxObserver))
+    qmodel = qat.quantize(model)
+    x = paddle.to_tensor(np.random.default_rng(4)
+                         .standard_normal((1, 3, 8, 8)).astype(np.float32))
+    qmodel.train()
+    qmodel(x)
+    sd = qmodel.state_dict()
+    assert any("scale" in k for k in sd)
+
+
+def test_qat_no_duplicate_params():
+    model = _net()
+    qmodel = QAT(QuantConfig(
+        activation=FakeQuanterWithAbsMaxObserver,
+        weight=FakeQuanterChannelWiseAbsMaxObserver)).quantize(model)
+    ids = [id(p) for p in qmodel.parameters()]
+    assert len(ids) == len(set(ids))
+    keys = list(qmodel.state_dict())
+    assert not any("_float_layer" in k for k in keys)
+
+
+def test_qat_compiles_under_train_step():
+    from paddle_tpu.jit.train_step import TrainStep
+    model = _net()
+    qmodel = QAT(QuantConfig(
+        activation=FakeQuanterWithAbsMaxObserver,
+        weight=FakeQuanterChannelWiseAbsMaxObserver)).quantize(model)
+    qmodel.train()
+    opt = optimizer.SGD(learning_rate=0.05, parameters=qmodel.parameters())
+
+    def loss_fn(net, x, y):
+        return nn.functional.cross_entropy(net(x), y)
+
+    step = TrainStep(qmodel, loss_fn, opt)
+    rng = np.random.default_rng(7)
+    x = paddle.to_tensor(rng.standard_normal((4, 3, 8, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 4, size=(4,)).astype("int64"))
+    losses = [float(step(x, y)) for _ in range(4)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]

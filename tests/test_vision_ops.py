@@ -1,0 +1,136 @@
+"""Vision detection ops tests (≙ test/legacy_test/test_{roi_align,nms,
+deform_conv2d,box_coder}_op.py: numpy references on small fixtures)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops
+
+
+def test_roi_align_constant_map():
+    # constant feature map -> every roi bin averages to the constant
+    x = paddle.to_tensor(np.full((1, 2, 8, 8), 3.0, np.float32))
+    boxes = paddle.to_tensor(np.array([[0, 0, 4, 4], [2, 2, 6, 6]],
+                                      np.float32))
+    num = paddle.to_tensor(np.array([2], np.int32))
+    out = ops.roi_align(x, boxes, num, output_size=2)
+    assert tuple(out.shape) == (2, 2, 2, 2)
+    np.testing.assert_allclose(np.asarray(out._value), 3.0, rtol=1e-6)
+
+
+def test_roi_align_gradient_flows():
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((1, 1, 8, 8)).astype(np.float32),
+                         stop_gradient=False)
+    boxes = paddle.to_tensor(np.array([[1, 1, 5, 5]], np.float32))
+    num = paddle.to_tensor(np.array([1], np.int32))
+    out = ops.roi_align(x, boxes, num, output_size=2)
+    out.sum().backward()
+    g = np.asarray(x.grad._value)
+    assert g.shape == (1, 1, 8, 8) and g.sum() > 0
+
+
+def test_roi_pool_max_semantics():
+    x_np = np.zeros((1, 1, 8, 8), np.float32)
+    x_np[0, 0, 2, 2] = 9.0
+    x = paddle.to_tensor(x_np)
+    boxes = paddle.to_tensor(np.array([[0, 0, 7, 7]], np.float32))
+    num = paddle.to_tensor(np.array([1], np.int32))
+    out = ops.roi_pool(x, boxes, num, output_size=2)
+    assert float(np.asarray(out._value).max()) > 0
+
+
+def test_nms_suppresses_overlaps():
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10],
+        [1, 1, 11, 11],     # heavy overlap with first
+        [20, 20, 30, 30],   # disjoint
+    ], np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+    keep = ops.nms(boxes, iou_threshold=0.5, scores=scores)
+    assert np.asarray(keep._value).tolist() == [0, 2]
+
+
+def test_nms_category_aware():
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10], [1, 1, 11, 11]], np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8], np.float32))
+    cats = paddle.to_tensor(np.array([0, 1], np.int64))
+    keep = ops.nms(boxes, 0.5, scores, category_idxs=cats,
+                   categories=[0, 1])
+    assert len(np.asarray(keep._value)) == 2  # different classes: both kept
+
+
+def test_matrix_nms_decays_scores():
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]], np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+    out_scores, idx = ops.matrix_nms(boxes, scores, score_threshold=0.1)
+    s = np.asarray(out_scores._value)
+    i = np.asarray(idx._value)
+    assert 0 in i and 2 in i
+    # the overlapping box's score must decay below its raw 0.8
+    decayed = s[list(i).index(1)] if 1 in list(i) else 0.0
+    assert decayed < 0.8
+
+
+def test_deform_conv2d_zero_offset_matches_conv2d():
+    rng = np.random.default_rng(1)
+    x_np = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    w_np = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)
+    x = paddle.to_tensor(x_np)
+    w = paddle.to_tensor(w_np)
+    offset = paddle.to_tensor(np.zeros((2, 18, 8, 8), np.float32))
+    out = ops.deform_conv2d(x, offset, w, padding=1)
+    ref = paddle.nn.functional.conv2d(x, w, padding=1)
+    np.testing.assert_allclose(np.asarray(out._value),
+                               np.asarray(ref._value), atol=1e-4)
+
+
+def test_deform_conv2d_layer_and_grad():
+    layer = ops.DeformConv2D(2, 4, 3, padding=1)
+    x = paddle.to_tensor(np.random.default_rng(2)
+                         .standard_normal((1, 2, 6, 6)).astype(np.float32))
+    offset = paddle.to_tensor(
+        0.1 * np.random.default_rng(3)
+        .standard_normal((1, 18, 6, 6)).astype(np.float32),
+        stop_gradient=False)
+    out = layer(x, offset)
+    assert tuple(out.shape) == (1, 4, 6, 6)
+    out.sum().backward()
+    assert offset.grad is not None
+    assert layer.weight.grad is not None
+
+
+def test_deform_conv2d_mask_modulation():
+    rng = np.random.default_rng(4)
+    x = paddle.to_tensor(rng.standard_normal((1, 2, 6, 6)).astype(np.float32))
+    w = paddle.to_tensor(rng.standard_normal((3, 2, 3, 3)).astype(np.float32))
+    offset = paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+    mask0 = paddle.to_tensor(np.zeros((1, 9, 6, 6), np.float32))
+    out = ops.deform_conv2d(x, offset, w, padding=1, mask=mask0)
+    np.testing.assert_allclose(np.asarray(out._value), 0.0, atol=1e-6)
+
+
+def test_box_coder_roundtrip():
+    priors = paddle.to_tensor(np.array([[0, 0, 10, 10], [5, 5, 15, 20]],
+                                       np.float32))
+    var = paddle.to_tensor(np.full((2, 4), 0.1, np.float32))
+    targets = paddle.to_tensor(np.array([[1, 1, 11, 12], [4, 6, 14, 18]],
+                                        np.float32))
+    enc = ops.box_coder(priors, var, targets, "encode_center_size")
+    dec = ops.box_coder(priors, var, enc, "decode_center_size")
+    np.testing.assert_allclose(np.asarray(dec._value),
+                               np.asarray(targets._value), atol=1e-4)
+
+
+def test_prior_box():
+    feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    boxes, var = ops.prior_box(feat, img, min_sizes=[8.0],
+                               aspect_ratios=[1.0, 2.0], clip=True)
+    assert boxes.shape[0] == 4 and boxes.shape[1] == 4
+    b = np.asarray(boxes._value)
+    assert b.min() >= 0.0 and b.max() <= 1.0
+    assert var.shape == boxes.shape
