@@ -1,0 +1,8 @@
+"""paddle_tpu.audio (analogue of ``python/paddle/audio``: features,
+functional, backends)."""
+
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from . import backends  # noqa: F401
+from .features import (Spectrogram, MelSpectrogram, LogMelSpectrogram,
+                       MFCC)  # noqa: F401

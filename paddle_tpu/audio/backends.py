@@ -1,0 +1,83 @@
+"""Audio IO backends (reference: ``python/paddle/audio/backends/
+{init_backend.py,wave_backend.py}``): stdlib-wave based load/save —
+the reference's default backend is the same pure-python wave module
+when soundfile is absent."""
+
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["load", "save", "info", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+_BACKEND = "wave_backend"
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return _BACKEND
+
+
+def set_backend(backend_name: str):
+    if backend_name not in list_available_backends():
+        raise ValueError(f"unknown audio backend {backend_name!r}")
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_frames, num_channels,
+                 bits_per_sample):
+        self.sample_rate = sample_rate
+        self.num_frames = num_frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+
+
+def info(filepath: str) -> AudioInfo:
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8)
+
+
+def load(filepath: str, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Returns (waveform Tensor [C, T] (or [T, C]), sample_rate)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, nch)
+    if width == 1:
+        data = data.astype(np.int16) - 128
+    if normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    arr = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(arr)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first=True,
+         bits_per_sample=16):
+    if bits_per_sample != 16:
+        raise ValueError("wave backend only writes 16-bit PCM")
+    arr = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T  # -> [T, C]
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    pcm = np.clip(arr, -1.0, 1.0)
+    pcm = (pcm * 32767.0).astype("<i2")
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(sample_rate)
+        f.writeframes(pcm.tobytes())

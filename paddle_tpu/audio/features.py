@@ -1,0 +1,99 @@
+"""Audio feature layers (reference: ``python/paddle/audio/features/layers.py``:
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..nn import Layer
+from ..signal import stft
+from .functional import (compute_fbank_matrix, create_dct, get_window,
+                         power_to_db)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer("window",
+                             get_window(window, self.win_length))
+
+    def forward(self, x):
+        spec = stft(x, self.n_fft, self.hop_length, self.win_length,
+                    self.window, center=self.center, pad_mode=self.pad_mode)
+
+        def impl(c):
+            mag = jnp.abs(c)
+            return mag ** self.power if self.power != 1.0 else mag
+
+        # differentiable through the complex stft (reference feature layers
+        # backprop into the waveform)
+        return dispatch("spectrogram_mag", impl, (spec,))
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode)
+        self.n_mels = n_mels
+        self.register_buffer(
+            "fbank_matrix",
+            compute_fbank_matrix(sr, n_fft, n_mels, f_min,
+                                 f_max or sr / 2, htk, norm))
+
+    def forward(self, x):
+        spec = self._spectrogram(x)  # [..., n_freq, frames]
+
+        def impl(s, fb):
+            return jnp.einsum("mf,...ft->...mt", fb, s)
+
+        return dispatch("mel_spectrogram", impl, (spec, self.fbank_matrix),
+                        nondiff_mask=[False, True])
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, ref_value=1.0, amin=1e-10, top_db=None,
+                 **mel_kwargs):
+        super().__init__()
+        self._mel = MelSpectrogram(sr=sr, **mel_kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return power_to_db(self._mel(x), self.ref_value, self.amin,
+                           self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, norm="ortho", **mel_kwargs):
+        super().__init__()
+        self._log_mel = LogMelSpectrogram(sr=sr, **mel_kwargs)
+        n_mels = self._log_mel._mel.n_mels
+        if n_mfcc > n_mels:
+            raise ValueError(
+                f"n_mfcc ({n_mfcc}) cannot exceed n_mels ({n_mels})")
+        self.register_buffer("dct_matrix", create_dct(n_mfcc, n_mels, norm))
+
+    def forward(self, x):
+        log_mel = self._log_mel(x)  # [..., n_mels, frames]
+
+        def impl(lm, dct):
+            return jnp.einsum("mk,...mt->...kt", dct, lm)
+
+        return dispatch("mfcc", impl, (log_mel, self.dct_matrix),
+                        nondiff_mask=[False, True])

@@ -61,6 +61,32 @@ def create_mask(weight: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
     return mask.reshape(w.shape)
 
 
+def create_mask_2d(weight: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """mask_2d_greedy: within every m x m block keep entries greedily by
+    magnitude such that every row AND column of the block keeps at most n
+    (reference utils.get_mask_2d_greedy)."""
+    w = np.abs(np.asarray(weight, np.float64))
+    if w.ndim != 2:
+        raise ValueError("mask_2d requires a 2-D weight view")
+    rows, cols = w.shape
+    pr, pc = (-rows) % m, (-cols) % m
+    wp = np.pad(w, ((0, pr), (0, pc)))
+    mask = np.zeros_like(wp, np.float32)
+    for bi in range(0, wp.shape[0], m):
+        for bj in range(0, wp.shape[1], m):
+            block = wp[bi:bi + m, bj:bj + m]
+            order = np.dstack(np.unravel_index(
+                np.argsort(-block, axis=None), block.shape))[0]
+            rcount = np.zeros(m, np.int32)
+            ccount = np.zeros(m, np.int32)
+            for r, c in order:
+                if rcount[r] < n and ccount[c] < n:
+                    mask[bi + r, bj + c] = 1.0
+                    rcount[r] += 1
+                    ccount[c] += 1
+    return mask[:rows, :cols]
+
+
 def check_sparsity(weight, n: int = 2, m: int = 4) -> bool:
     w = np.asarray(weight._value if isinstance(weight, Tensor) else weight)
     flat = w.reshape(-1, w.shape[-1])
@@ -93,6 +119,13 @@ def prune_model(model: Layer, n: int = 2, m: int = 4,
     """
     if mask_algo not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
         raise ValueError(f"unknown mask_algo {mask_algo!r}")
+    # mask_2d_best degrades to greedy (the reference's exhaustive search
+    # differs only in block-permutation enumeration)
+    mask_fn = create_mask if mask_algo == "mask_1d" else create_mask_2d
+    # evict records of garbage-collected params so the registry is bounded
+    for pid in [pid for pid, (ref, _) in _PARAM_MASKS.items()
+                if ref() is None]:
+        del _PARAM_MASKS[pid]
     excluded = _EXCLUDED.get(model, set())
     masks = _MASKS.setdefault(model, {})
     for lname, sub in model.named_sublayers():
@@ -103,10 +136,10 @@ def prune_model(model: Layer, n: int = 2, m: int = 4,
         # mask along the input dim: for Linear [in, out] that is axis 0,
         # so transpose; for Conv [out, in, kh, kw] flatten per out-channel.
         if isinstance(sub, Linear):
-            mask = create_mask(arr.T, n, m).T
+            mask = mask_fn(arr.T, n, m).T
         else:
             oc = arr.shape[0]
-            mask = create_mask(arr.reshape(oc, -1), n, m).reshape(arr.shape)
+            mask = mask_fn(arr.reshape(oc, -1), n, m).reshape(arr.shape)
         w.set_value(jnp.asarray(arr * mask, dtype=w._value.dtype))
         masks[f"{lname}.weight"] = mask
         _PARAM_MASKS[id(w)] = (weakref.ref(w), mask)

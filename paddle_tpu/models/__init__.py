@@ -15,6 +15,9 @@ from .ernie import (ErnieConfig, ErnieModel, ErnieForSequenceClassification,
 from .gpt import (GPTConfig, GPTModel, GPTForCausalLM,
                   GPTPretrainingCriterion, gpt2_small_config,
                   gpt3_13b_config, tiny_gpt_config)
+from .ocr import (DBNet, DBNetConfig, DBLoss, DBFPN, DBHead, db_postprocess,
+                  CRNN, CRNNConfig, CTCHeadLoss, ctc_greedy_decode,
+                  PPOCRSystem)
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
            "LlamaPretrainingCriterion", "llama_3_8b_config",
@@ -28,4 +31,7 @@ __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
            "BertForPretraining",
            "GPTConfig", "GPTModel", "GPTForCausalLM",
            "GPTPretrainingCriterion", "gpt2_small_config",
-           "gpt3_13b_config", "tiny_gpt_config"]
+           "gpt3_13b_config", "tiny_gpt_config",
+           "DBNet", "DBNetConfig", "DBLoss", "DBFPN", "DBHead",
+           "db_postprocess", "CRNN", "CRNNConfig", "CTCHeadLoss",
+           "ctc_greedy_decode", "PPOCRSystem"]

@@ -27,6 +27,14 @@ def quanter_factory(cls, **kwargs):
     return _FactorySpec(cls, **kwargs)
 
 
+class _Unset:
+    """Sentinel: distinguishes "not overridden" from an explicit None
+    (which exempts the layer from the global quanter)."""
+
+
+_UNSET = _Unset()
+
+
 class QuantConfig:
     def __init__(self, activation=None, weight=None):
         self._global_act = self._as_spec(activation)
@@ -36,19 +44,19 @@ class QuantConfig:
 
     @staticmethod
     def _as_spec(q):
-        if q is None or isinstance(q, _FactorySpec):
+        if q is None or q is _UNSET or isinstance(q, _FactorySpec):
             return q
         if isinstance(q, type):
             return _FactorySpec(q)
         raise TypeError(f"expected a quanter class or factory, got {q!r}")
 
-    def add_layer_config(self, layer, activation=None, weight=None):
+    def add_layer_config(self, layer, activation=_UNSET, weight=_UNSET):
         layers = layer if isinstance(layer, (list, tuple)) else [layer]
         for l in layers:
             self._layer_overrides.append(
                 (l, self._as_spec(activation), self._as_spec(weight)))
 
-    def add_type_config(self, layer_type, activation=None, weight=None):
+    def add_type_config(self, layer_type, activation=_UNSET, weight=_UNSET):
         types = layer_type if isinstance(layer_type, (list, tuple)) \
             else [layer_type]
         for t in types:
@@ -58,10 +66,12 @@ class QuantConfig:
     def _specs_for(self, layer: Layer):
         for inst, act, w in self._layer_overrides:
             if inst is layer:
-                return act or self._global_act, w or self._global_weight
+                return (self._global_act if act is _UNSET else act,
+                        self._global_weight if w is _UNSET else w)
         for t, act, w in self._type_overrides:
             if isinstance(layer, t):
-                return act or self._global_act, w or self._global_weight
+                return (self._global_act if act is _UNSET else act,
+                        self._global_weight if w is _UNSET else w)
         return self._global_act, self._global_weight
 
     def activation_quanter_for(self, layer) -> Optional[Layer]:

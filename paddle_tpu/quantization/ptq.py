@@ -62,13 +62,20 @@ class PTQ:
         return model
 
     def _convert_rec(self, layer: Layer):
+        from .quanters import FakeQuanterChannelWiseAbsMaxObserver
         for name, sub in list(layer._sub_layers.items()):
             if isinstance(sub, _ObservedLayer):
                 inner = sub.inner
                 act_scale = sub.observer.scales() if sub.observer else None
+                # honor the configured weight observer's bit width (the
+                # weight scales themselves are recomputed per-channel from
+                # the frozen weights)
+                wspec = self._config.weight_quanter_for(inner)
+                bits = wspec.bit_length() if wspec is not None else 8
+                wq = FakeQuanterChannelWiseAbsMaxObserver(bit_length=bits)
                 wrapper_cls = QuantedLinear if isinstance(inner, Linear) \
                     else QuantedConv2D
-                q = wrapper_cls(inner, None, None)
+                q = wrapper_cls(inner, None, wq)
                 frozen = _freeze(q)
                 frozen._act_scale = act_scale
                 layer._sub_layers[name] = frozen

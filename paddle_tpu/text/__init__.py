@@ -1,0 +1,7 @@
+"""paddle_tpu.text (analogue of ``python/paddle/text``: viterbi decode and
+text dataset scaffolding; the reference's dataset downloads are gated on
+network, here they raise with a clear message in this air-gapped build)."""
+
+from .viterbi import viterbi_decode, ViterbiDecoder  # noqa: F401
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]

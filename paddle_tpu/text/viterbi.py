@@ -1,0 +1,84 @@
+"""Viterbi decoding (reference: ``python/paddle/text/viterbi_decode.py``
+over ``paddle/phi/kernels/cpu/viterbi_decode_kernel.cc``).
+
+TPU-native: the DP recursion is a ``lax.scan`` over time steps (static
+shapes, no host loop), scores+paths returned like the reference op.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..nn import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """potentials [B, T, N]; transition [N, N]; lengths [B].
+
+    Returns (scores [B], paths [B, T]).  Positions past each sequence
+    length hold the last valid tag (reference pads with the final state).
+    """
+
+    def impl(emit, trans, lens):
+        b, t, n = emit.shape
+        if include_bos_eos_tag:
+            # reference semantics (python/paddle/text/viterbi_decode.py):
+            # the LAST row/column of transitions is the start tag, the
+            # second-to-last the stop tag
+            start_idx, stop_idx = n - 1, n - 2
+            init = emit[:, 0] + trans[start_idx][None, :]
+        else:
+            init = emit[:, 0]
+
+        def step(carry, e_t):
+            alpha, tstep = carry
+            # alpha [B, N]; scores [B, N(from), N(to)]
+            scores = alpha[:, :, None] + trans[None, :, :]
+            best_from = jnp.argmax(scores, axis=1)          # [B, N]
+            best_score = jnp.max(scores, axis=1) + e_t      # [B, N]
+            # only advance sequences that still have tokens
+            active = (tstep < lens)[:, None]
+            alpha_new = jnp.where(active, best_score, alpha)
+            return (alpha_new, tstep + 1), (best_from, active)
+
+        (alpha, _), (backptr, actives) = jax.lax.scan(
+            step, (init, jnp.ones((), jnp.int32)),
+            jnp.swapaxes(emit[:, 1:], 0, 1))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, stop_idx][None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last = jnp.argmax(alpha, axis=-1)                    # [B]
+
+        def back(tag, inp):
+            # reverse scan: carry is the tag at step i+1, output it, and
+            # step back through the pointer to the tag at step i
+            ptr, active = inp
+            prev = jnp.take_along_axis(ptr, tag[:, None], axis=1)[:, 0]
+            return jnp.where(active[:, 0], prev, tag), tag
+
+        tag0, path_rev = jax.lax.scan(back, last, (backptr, actives),
+                                      reverse=True)
+        # path_rev[i] = tag at step i+1 (original order); prepend step 0
+        paths = jnp.concatenate([tag0[:, None],
+                                 jnp.swapaxes(path_rev, 0, 1)], axis=1)
+        return scores, paths.astype(jnp.int64)
+
+    return dispatch("viterbi_decode", impl,
+                    (potentials, transition_params, lengths),
+                    nondiff_mask=[True, True, True], n_diff_outputs=0)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

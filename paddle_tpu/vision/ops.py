@@ -55,6 +55,31 @@ def _bilinear_sample(feat, ys, xs):
             ly * (1 - lx) * v10 + ly * lx * v11)
 
 
+def _bilinear_sample_zero(feat, ys, xs):
+    """Like _bilinear_sample but out-of-range corners contribute zero
+    (deformable-conv reference semantics, dmcn_im2col_bilinear: each of
+    the four corners outside the map is dropped, and fully-outside points
+    vanish entirely)."""
+    H, W = feat.shape[-2], feat.shape[-1]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    y1 = y0 + 1
+    x1 = x0 + 1
+    ly = ys - y0
+    lx = xs - x0
+
+    def corner(yc, xc, w):
+        valid = ((yc >= 0) & (yc <= H - 1) & (xc >= 0) & (xc <= W - 1))
+        yi = jnp.clip(yc, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xc, 0, W - 1).astype(jnp.int32)
+        return feat[:, yi, xi] * (w * valid.astype(feat.dtype))
+
+    return (corner(y0, x0, (1 - ly) * (1 - lx)) +
+            corner(y0, x1, (1 - ly) * lx) +
+            corner(y1, x0, ly * (1 - lx)) +
+            corner(y1, x1, ly * lx))
+
+
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
     """RoIAlign (Mask R-CNN): averages bilinear samples in each output bin.
@@ -307,7 +332,7 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
               base_x.reshape(1, kw, 1, Wo)).reshape(1, kh * kw, Ho, Wo) + dx
 
         def per_image(feat, ysi, xsi, mi):
-            vals = _bilinear_sample(feat, ysi, xsi)  # [C, kh*kw, Ho, Wo]
+            vals = _bilinear_sample_zero(feat, ysi, xsi)  # [C,kh*kw,Ho,Wo]
             if mi is not None:
                 vals = vals * mi[None]
             return vals

@@ -75,3 +75,17 @@ def test_bad_mask_algo():
         assert False
     except ValueError as e:
         assert "mask_algo" in str(e)
+
+
+def test_mask_2d_greedy_row_and_col_sparsity():
+    rng = np.random.default_rng(5)
+    w = rng.standard_normal((8, 8)).astype(np.float32)
+    mask = asp.create_mask_2d(w, n=2, m=4)
+    for bi in range(0, 8, 4):
+        for bj in range(0, 8, 4):
+            block = mask[bi:bi + 4, bj:bj + 4]
+            assert np.all(block.sum(axis=0) <= 2)
+            assert np.all(block.sum(axis=1) <= 2)
+    model = nn.Sequential(nn.Linear(8, 8))
+    asp.prune_model(model, mask_algo="mask_2d_greedy")
+    assert asp.calculate_density(model[0].weight) <= 0.5 + 1e-6

@@ -1,0 +1,135 @@
+"""Audio features + text viterbi tests (≙ test/legacy_test/
+test_{spectrogram,mfcc,viterbi_decode}* patterns: numpy/brute-force refs)."""
+
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+from paddle_tpu.text import viterbi_decode
+
+
+def _sine(sr=8000, dur=0.5, f=440.0):
+    t = np.arange(int(sr * dur)) / sr
+    return np.sin(2 * np.pi * f * t).astype(np.float32)
+
+
+def test_mel_conversions_roundtrip():
+    for htk in (False, True):
+        hz = 440.0
+        mel = audio.functional.hz_to_mel(hz, htk=htk)
+        back = audio.functional.mel_to_hz(mel, htk=htk)
+        assert abs(back - hz) < 1e-3
+
+
+def test_fbank_matrix_shape_and_coverage():
+    fb = audio.functional.compute_fbank_matrix(8000, 512, n_mels=40)
+    arr = np.asarray(fb._value)
+    assert arr.shape == (40, 257)
+    assert (arr >= 0).all()
+    assert (arr.sum(axis=1) > 0).all()  # every filter covers some bins
+
+
+def test_spectrogram_peak_at_tone():
+    sr, f = 8000, 1000.0
+    x = paddle.to_tensor(_sine(sr, 0.25, f)[None])
+    spec = audio.Spectrogram(n_fft=512, hop_length=128)(x)
+    arr = np.asarray(spec._value)[0]  # [freq, time]
+    peak_bin = arr.mean(axis=1).argmax()
+    expected = int(round(f * 512 / sr))
+    assert abs(int(peak_bin) - expected) <= 1
+
+
+def test_log_mel_and_mfcc_shapes():
+    x = paddle.to_tensor(_sine()[None])
+    lm = audio.LogMelSpectrogram(sr=8000, n_fft=512, n_mels=40)(x)
+    assert np.asarray(lm._value).shape[1] == 40
+    mfcc = audio.MFCC(sr=8000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+    assert np.asarray(mfcc._value).shape[1] == 13
+
+
+def test_mfcc_validates_n_mfcc():
+    try:
+        audio.MFCC(sr=8000, n_mfcc=80, n_mels=40)
+        assert False
+    except ValueError as e:
+        assert "n_mfcc" in str(e)
+
+
+def test_wave_backend_roundtrip(tmp_path):
+    sr = 8000
+    wav = _sine(sr, 0.1)
+    path = os.path.join(tmp_path, "t.wav")
+    audio.backends.save(path, paddle.to_tensor(wav[None]), sr)
+    info = audio.backends.info(path)
+    assert info.sample_rate == sr and info.num_channels == 1
+    loaded, sr2 = audio.backends.load(path)
+    assert sr2 == sr
+    np.testing.assert_allclose(np.asarray(loaded._value)[0], wav, atol=1e-3)
+
+
+def _brute_viterbi(emit, trans, length):
+    import itertools
+    n = emit.shape[-1]
+    best, best_score = None, -1e30
+    for path in itertools.product(range(n), repeat=length):
+        s = emit[0, path[0]]
+        for i in range(1, length):
+            s += trans[path[i - 1], path[i]] + emit[i, path[i]]
+        if s > best_score:
+            best_score, best = s, path
+    return best_score, list(best)
+
+
+def test_viterbi_matches_brute_force():
+    rng = np.random.default_rng(0)
+    b, t, n = 2, 5, 4
+    emit = rng.standard_normal((b, t, n)).astype(np.float32)
+    trans = rng.standard_normal((n, n)).astype(np.float32)
+    lens = np.array([5, 5], np.int64)
+    scores, paths = viterbi_decode(
+        paddle.to_tensor(emit), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=False)
+    for i in range(b):
+        ref_score, ref_path = _brute_viterbi(emit[i], trans, t)
+        assert abs(float(np.asarray(scores._value)[i]) - ref_score) < 1e-4
+        assert np.asarray(paths._value)[i].tolist() == ref_path
+
+
+def test_viterbi_respects_lengths():
+    rng = np.random.default_rng(1)
+    emit = rng.standard_normal((1, 6, 3)).astype(np.float32)
+    trans = rng.standard_normal((3, 3)).astype(np.float32)
+    s_full, p_full = viterbi_decode(
+        paddle.to_tensor(emit), paddle.to_tensor(trans),
+        paddle.to_tensor(np.array([3], np.int64)),
+        include_bos_eos_tag=False)
+    ref_score, ref_path = _brute_viterbi(emit[0], trans, 3)
+    assert abs(float(np.asarray(s_full._value)[0]) - ref_score) < 1e-4
+    assert np.asarray(p_full._value)[0][:3].tolist() == ref_path
+
+
+def test_spectrogram_gradient_flows():
+    x = paddle.to_tensor(_sine(8000, 0.05), stop_gradient=False)
+    spec = audio.Spectrogram(n_fft=128, hop_length=64)(
+        x.reshape([1, -1]))
+    spec.sum().backward()
+    assert x.grad is not None
+    assert float(np.abs(np.asarray(x.grad._value)).sum()) > 0
+
+
+def test_viterbi_bos_eos_rows():
+    # 3 real tags + start(last row)/stop(second-to-last): transitions 5x5
+    n = 5
+    emit = np.zeros((1, 2, n), np.float32)
+    trans = np.zeros((n, n), np.float32)
+    trans[n - 1, 1] = 5.0   # start row strongly prefers tag 1
+    trans[2, n - 2] = 3.0   # tag 2 strongly prefers stop
+    lens = np.array([2], np.int64)
+    _, paths = viterbi_decode(
+        paddle.to_tensor(emit), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=True)
+    p = np.asarray(paths._value)[0]
+    assert p[0] == 1   # start-row bonus applied at step 0
+    assert p[1] == 2   # stop-column bonus applied at the last step

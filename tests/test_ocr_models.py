@@ -1,0 +1,103 @@
+"""OCR model family tests (det DBNet + rec CRNN, BASELINE config 4)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import models, optimizer
+from paddle_tpu.nn import functional as F
+
+
+def test_dbnet_train_and_eval_shapes():
+    m = models.DBNet()
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((2, 3, 64, 64)).astype(np.float32))
+    m.train()
+    out = m(x)["maps"]
+    assert tuple(out.shape) == (2, 3, 64, 64)  # prob, thresh, binary
+    m.eval()
+    out = m(x)["maps"]
+    assert tuple(out.shape) == (2, 1, 64, 64)
+    v = np.asarray(out._value)
+    assert v.min() >= 0.0 and v.max() <= 1.0  # sigmoid output
+
+
+def test_dbnet_loss_decreases():
+    m = models.DBNet(models.DBNetConfig(backbone_scale=0.25,
+                                        fpn_channels=32))
+    m.train()
+    crit = models.DBLoss()
+    opt = optimizer.Adam(learning_rate=5e-3, parameters=m.parameters())
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((1, 3, 32, 32))
+                         .astype(np.float32))
+    gt = np.zeros((1, 1, 32, 32), np.float32)
+    gt[:, :, 8:24, 8:24] = 1.0
+    gt_t = paddle.to_tensor(gt)
+    losses = []
+    for _ in range(5):
+        loss = crit(m(x), gt_t, gt_t * 0.5)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_db_postprocess_finds_box():
+    pm = np.zeros((1, 1, 32, 32), np.float32)
+    pm[0, 0, 10:20, 5:25] = 0.9
+    boxes = models.db_postprocess(paddle.to_tensor(pm))
+    assert len(boxes) == 1 and boxes[0].shape[0] == 1
+    x1, y1, x2, y2, score = boxes[0][0]
+    assert (x1, y1, x2, y2) == (5, 10, 25, 20)
+    assert score > 0.6
+
+
+def test_crnn_forward_and_ctc_training():
+    cfg = models.CRNNConfig(num_classes=12, hidden_size=32, image_height=32)
+    m = models.CRNN(cfg)
+    m.train()
+    crit = models.CTCHeadLoss()
+    opt = optimizer.Adam(learning_rate=5e-3, parameters=m.parameters())
+    rng = np.random.default_rng(2)
+    x = paddle.to_tensor(rng.standard_normal((2, 3, 32, 64))
+                         .astype(np.float32))
+    logits = m(x)
+    assert logits.shape[0] == 2 and logits.shape[2] == 12
+    t_steps = logits.shape[1]
+    labels = paddle.to_tensor(
+        rng.integers(1, 12, size=(2, 4)).astype("int64"))
+    lens = paddle.to_tensor(np.array([4, 3], np.int64))
+    losses = []
+    for _ in range(4):
+        loss = crit(m(x), labels, lens)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    assert t_steps >= 8  # width/4 time steps
+
+
+def test_ctc_greedy_decode():
+    # logits favoring sequence [blank, 3, 3, blank, 5] -> [3, 5]
+    logits = np.full((1, 5, 8), -5.0, np.float32)
+    for t, c in enumerate([0, 3, 3, 0, 5]):
+        logits[0, t, c] = 5.0
+    out = models.ctc_greedy_decode(paddle.to_tensor(logits))
+    assert out == [[3, 5]]
+
+
+def test_ppocr_system_facade():
+    sys = models.PPOCRSystem(
+        models.DBNet(models.DBNetConfig(backbone_scale=0.25,
+                                        fpn_channels=32)),
+        models.CRNN(models.CRNNConfig(num_classes=10, hidden_size=16)))
+    sys.eval()
+    img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    det = sys(img)
+    assert "maps" in det
+    crops = paddle.to_tensor(np.zeros((2, 3, 32, 48), np.float32))
+    rec = sys.recognize_crops(crops)
+    assert rec.shape[0] == 2 and rec.shape[2] == 10

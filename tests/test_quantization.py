@@ -176,3 +176,32 @@ def test_qat_compiles_under_train_step():
     y = paddle.to_tensor(rng.integers(0, 4, size=(4,)).astype("int64"))
     losses = [float(step(x, y)) for _ in range(4)]
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_quant_config_explicit_none_exempts_layer():
+    model = _net()
+    lin = model[4]
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                      weight=FakeQuanterChannelWiseAbsMaxObserver)
+    cfg.add_layer_config(lin, activation=None, weight=None)
+    qmodel = QAT(cfg).quantize(model)
+    names = [type(l).__name__ for l in qmodel.sublayers()]
+    assert "QuantedConv2D" in names and "QuantedLinear" not in names
+
+
+def test_ptq_honors_weight_bits():
+    from paddle_tpu.quantization.config import quanter_factory
+    model = nn.Sequential(nn.Linear(8, 4))
+    model.eval()
+    ptq = PTQ(QuantConfig(
+        activation=AbsmaxObserver,
+        weight=quanter_factory(PerChannelAbsmaxObserver, bit_length=4)))
+    qmodel = ptq.quantize(model)
+    qmodel(paddle.to_tensor(np.random.default_rng(8)
+                            .standard_normal((2, 8)).astype(np.float32)))
+    infer = ptq.convert(qmodel)
+    layer = [l for l in infer.sublayers()
+             if isinstance(l, QuantizedLinearInfer)][0]
+    assert layer._bits == 4
+    qw = np.asarray(layer.qweight._value)
+    assert qw.max() <= 7 and qw.min() >= -7  # int4 range

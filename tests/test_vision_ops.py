@@ -134,3 +134,13 @@ def test_prior_box():
     b = np.asarray(boxes._value)
     assert b.min() >= 0.0 and b.max() <= 1.0
     assert var.shape == boxes.shape
+
+
+def test_deform_conv2d_outside_samples_are_zero():
+    # a constant feature map with offsets pushing far outside: output 0
+    x = paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32))
+    w = paddle.to_tensor(np.ones((1, 1, 1, 1), np.float32))
+    offset = paddle.to_tensor(
+        np.full((1, 2, 4, 4), 100.0, np.float32))  # dy=dx=100 -> outside
+    out = ops.deform_conv2d(x, offset, w)
+    np.testing.assert_allclose(np.asarray(out._value), 0.0, atol=1e-6)
