@@ -31,6 +31,8 @@ from . import auto_parallel  # noqa: F401
 from .utils import recompute  # noqa: F401
 from . import launch  # noqa: F401
 from . import rpc  # noqa: F401
+from . import auto_tuner  # noqa: F401
+from . import checkpoint  # noqa: F401
 
 __all__ = [
     "ParallelEnv", "get_rank", "get_world_size", "init_parallel_env",

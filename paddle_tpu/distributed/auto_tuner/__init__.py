@@ -1,0 +1,219 @@
+"""Auto-tuner for parallel configurations.
+
+Capability analogue of ``python/paddle/distributed/auto_tuner``
+(reference: auto_tuner/{tuner.py,search.py,prune.py,recorder.py}): given a
+device count and model description, enumerate candidate (dp, mp, pp,
+sharding-stage, micro-batch) configs, prune invalid/oversized ones, rank
+by an analytic TPU cost model, and optionally measure real trials through
+a user-supplied runner — recording a sorted history like the reference's
+``recorder.store_history``.
+
+TPU-native cost model: step time ≈ compute (model FLOPs / chip peak /
+mp·pp·dp) + TP collective time (2·(mp-1)/mp · activation bytes / ICI bw
+per layer) + PP bubble factor ((pp-1)/micro_steps) + DP gradient
+all-reduce amortized — the scaling-book first-order terms, enough to
+rank configs the way the reference's profile trials do.
+"""
+
+from __future__ import annotations
+
+import csv
+import itertools
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+__all__ = ["TunerConfig", "Candidate", "AutoTuner"]
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+@dataclass
+class TunerConfig:
+    """Search space (reference tuner_cfg keys; "auto" = search)."""
+
+    num_devices: int = 8
+    num_nodes: int = 1
+    global_batch_size: int = 32
+    model_size_b: float = 7.0          # parameters, billions
+    hidden_size: int = 4096
+    num_layers: int = 32
+    seq_len: int = 4096
+    dp_degree: object = "auto"
+    mp_degree: object = "auto"
+    pp_degree: object = "auto"
+    sharding_degree: object = "auto"
+    sharding_stage: object = "auto"    # 1/2/3
+    micro_batch_size: object = "auto"
+    chip_hbm_gb: float = 95.0          # v5p
+    chip_peak_tflops: float = 459.0    # v5p bf16
+    ici_gbps: float = 1200.0           # per-link bidirectional
+    max_trials: int = 0                # 0 = cost-model only
+
+
+@dataclass
+class Candidate:
+    dp: int
+    mp: int
+    pp: int
+    sharding: int
+    sharding_stage: int
+    micro_batch: int
+    est_step_time: float = math.inf
+    est_mem_gb: float = math.inf
+    measured: Optional[float] = None
+    pruned: Optional[str] = None
+
+    def as_dict(self):
+        return {"dp_degree": self.dp, "mp_degree": self.mp,
+                "pp_degree": self.pp, "sharding_degree": self.sharding,
+                "sharding_stage": self.sharding_stage,
+                "micro_batch_size": self.micro_batch,
+                "est_step_time": self.est_step_time,
+                "est_mem_gb": self.est_mem_gb,
+                "measured": self.measured, "pruned": self.pruned}
+
+
+class AutoTuner:
+    def __init__(self, config: TunerConfig):
+        self.cfg = config
+        self.history: list[Candidate] = []
+
+    # ------------------------------------------------------------- search
+    def _axis_options(self, value, n):
+        if value == "auto":
+            return _divisors(n)
+        return [int(value)]
+
+    def generate_candidates(self):
+        c = self.cfg
+        n = c.num_devices
+        cands = []
+        for mp in self._axis_options(c.mp_degree, n):
+            for pp in self._axis_options(c.pp_degree, n // mp if n % mp == 0
+                                         else 0):
+                if mp * pp > n or n % (mp * pp):
+                    continue
+                rest = n // (mp * pp)
+                for sharding in self._axis_options(c.sharding_degree, rest):
+                    if rest % sharding:
+                        continue
+                    dp = rest // sharding
+                    if c.dp_degree != "auto" and dp != int(c.dp_degree):
+                        continue
+                    stages = ([1, 2, 3] if c.sharding_stage == "auto"
+                              else [int(c.sharding_stage)])
+                    if sharding == 1:
+                        stages = [1]
+                    mbs = (self._mb_options(dp * sharding)
+                           if c.micro_batch_size == "auto"
+                           else [int(c.micro_batch_size)])
+                    for st, mb in itertools.product(stages, mbs):
+                        cands.append(Candidate(dp, mp, pp, sharding, st, mb))
+        return cands
+
+    def _mb_options(self, data_ways):
+        per_rank = self.cfg.global_batch_size // max(data_ways, 1)
+        return [m for m in (1, 2, 4, 8, 16) if m <= max(per_rank, 1)]
+
+    # -------------------------------------------------------------- prune
+    def prune(self, cand: Candidate) -> Optional[str]:
+        c = self.cfg
+        data_ways = cand.dp * cand.sharding
+        if c.global_batch_size % data_ways:
+            return "global batch not divisible by dp*sharding"
+        per_rank = c.global_batch_size // data_ways
+        if per_rank % cand.micro_batch:
+            return "per-rank batch not divisible by micro batch"
+        if c.num_layers % cand.pp:
+            return "layers not divisible by pp"
+        if cand.mp > 1 and c.hidden_size % cand.mp:
+            return "hidden not divisible by mp"
+        cand.est_mem_gb = self._estimate_memory(cand)
+        if cand.est_mem_gb > c.chip_hbm_gb:
+            return f"est mem {cand.est_mem_gb:.0f}GB > HBM"
+        return None
+
+    def _estimate_memory(self, cand: Candidate) -> float:
+        c = self.cfg
+        p = c.model_size_b * 1e9 / (cand.mp * cand.pp)
+        # bf16 weights + fp32 master + 2 fp32 moments = 18 bytes/param,
+        # optimizer+master sharded by `sharding` (stage>=1), grads by
+        # stage>=2, params by stage 3
+        opt = 12.0 / cand.sharding
+        grad = 2.0 / (cand.sharding if cand.sharding_stage >= 2 else 1)
+        weight = 2.0 / (cand.sharding if cand.sharding_stage >= 3 else 1)
+        states = p * (weight + grad + opt)
+        # activations: micro_batch * seq * hidden * layers-per-stage * ~34B
+        # (bf16, flash-attn era per-layer footprint, remat halves it)
+        act = (cand.micro_batch * c.seq_len * c.hidden_size *
+               (c.num_layers / cand.pp) * 34 / cand.mp) * 0.5
+        return (states + act) / 1e9
+
+    # --------------------------------------------------------- cost model
+    def estimate_step_time(self, cand: Candidate) -> float:
+        c = self.cfg
+        flops = 6.0 * c.model_size_b * 1e9 * c.global_batch_size * c.seq_len
+        chip_flops = flops / c.num_devices
+        t_compute = chip_flops / (c.chip_peak_tflops * 1e12 * 0.5)
+        # TP collectives: 2 all-reduces of activations per layer fwd+bwd
+        if cand.mp > 1:
+            act_bytes = (c.global_batch_size /
+                         (cand.dp * cand.sharding)) * c.seq_len \
+                * c.hidden_size * 2
+            ar = 2 * (cand.mp - 1) / cand.mp * act_bytes \
+                / (c.ici_gbps * 1e9 / 8)
+            t_tp = 4 * c.num_layers / cand.pp * ar
+        else:
+            t_tp = 0.0
+        # PP bubble
+        micro_steps = max(
+            c.global_batch_size // (cand.dp * cand.sharding *
+                                    cand.micro_batch), 1)
+        bubble = (cand.pp - 1) / (micro_steps + cand.pp - 1)
+        # DP/sharding gradient reduce-scatter+all-gather
+        p_bytes = c.model_size_b * 1e9 / (cand.mp * cand.pp) * 2
+        data_ways = cand.dp * cand.sharding
+        t_dp = (2 * (data_ways - 1) / data_ways * p_bytes /
+                (c.ici_gbps * 1e9 / 8)) if data_ways > 1 else 0.0
+        return (t_compute + t_tp) / (1 - bubble) + t_dp
+
+    # --------------------------------------------------------------- tune
+    def tune(self, runner: Callable[[Candidate], float] = None):
+        """Rank all candidates; optionally measure the top max_trials with
+        ``runner(candidate) -> step_time`` (reference: launching trial
+        jobs).  Returns the best candidate."""
+        cands = self.generate_candidates()
+        for cand in cands:
+            cand.pruned = self.prune(cand)
+            if cand.pruned is None:
+                cand.est_step_time = self.estimate_step_time(cand)
+        self.history = sorted(
+            cands, key=lambda x: (x.pruned is not None, x.est_step_time))
+        valid = [x for x in self.history if x.pruned is None]
+        if not valid:
+            raise ValueError("no valid parallel config for this search "
+                             "space; all candidates pruned")
+        if runner is not None:
+            # a supplied runner always measures: default to 3 trials when
+            # max_trials was left 0 (cost-model-only is runner=None)
+            trials = self.cfg.max_trials or 3
+            for cand in valid[:trials]:
+                cand.measured = runner(cand)
+            valid.sort(key=lambda x: (x.measured is None,
+                                      x.measured if x.measured is not None
+                                      else x.est_step_time))
+        return valid[0]
+
+    def store_history(self, path: str):
+        """CSV export (reference recorder.store_history)."""
+        if not self.history:
+            raise ValueError("tune() has not been run")
+        with open(path, "w", newline="") as f:
+            writer = csv.DictWriter(
+                f, fieldnames=list(self.history[0].as_dict()))
+            writer.writeheader()
+            for cand in self.history:
+                writer.writerow(cand.as_dict())

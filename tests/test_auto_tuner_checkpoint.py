@@ -1,0 +1,131 @@
+"""Auto-tuner + distributed checkpoint reshard tests (≙ reference
+test/auto_tuner/* and auto_parallel converter tests)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_tuner import AutoTuner, TunerConfig
+from paddle_tpu.distributed.checkpoint import (
+    ShardSpec, save_sharded_state_dict, load_merged_state_dict,
+    load_sharded_state_dict, reshard_checkpoint)
+
+
+# ------------------------------------------------------------------ tuner
+
+def test_candidates_cover_device_factorizations():
+    tuner = AutoTuner(TunerConfig(num_devices=8, global_batch_size=32,
+                                  model_size_b=0.5, hidden_size=1024,
+                                  num_layers=8, seq_len=1024,
+                                  chip_hbm_gb=95.0))
+    cands = tuner.generate_candidates()
+    assert all(c.dp * c.mp * c.pp * c.sharding == 8 for c in cands)
+    # all mp degrees that divide 8 appear
+    assert {c.mp for c in cands} == {1, 2, 4, 8}
+
+
+def test_tune_returns_valid_config_and_history(tmp_path):
+    tuner = AutoTuner(TunerConfig(num_devices=8, global_batch_size=32,
+                                  model_size_b=0.5, hidden_size=1024,
+                                  num_layers=8, seq_len=1024))
+    best = tuner.tune()
+    assert best.pruned is None
+    assert np.isfinite(best.est_step_time)
+    csv_path = os.path.join(tmp_path, "history.csv")
+    tuner.store_history(csv_path)
+    text = open(csv_path).read()
+    assert "dp_degree" in text and str(best.mp) in text
+
+
+def test_memory_pruning_rejects_oversized():
+    # 70B params on a single tiny-memory chip: everything pruned
+    tuner = AutoTuner(TunerConfig(num_devices=1, global_batch_size=8,
+                                  model_size_b=70.0, hidden_size=8192,
+                                  num_layers=80, seq_len=4096,
+                                  chip_hbm_gb=16.0))
+    with pytest.raises(ValueError, match="pruned"):
+        tuner.tune()
+
+
+def test_runner_trials_override_cost_model():
+    cfg = TunerConfig(num_devices=4, global_batch_size=16, model_size_b=0.1,
+                      hidden_size=512, num_layers=4, seq_len=512,
+                      max_trials=3)
+    tuner = AutoTuner(cfg)
+    # runner prefers mp=2 regardless of the cost model
+    calls = []
+
+    def runner(cand):
+        calls.append(cand)
+        return 0.5 if cand.mp == 2 else 1.0
+
+    best = tuner.tune(runner)
+    assert len(calls) == 3
+    if any(c.mp == 2 for c in calls):
+        assert best.mp == 2
+
+
+def test_fixed_degrees_respected():
+    tuner = AutoTuner(TunerConfig(num_devices=8, mp_degree=2, pp_degree=2,
+                                  sharding_degree=1, global_batch_size=32,
+                                  model_size_b=0.5, hidden_size=1024,
+                                  num_layers=8, seq_len=1024))
+    best = tuner.tune()
+    assert best.mp == 2 and best.pp == 2 and best.dp == 2
+
+
+# ------------------------------------------------------------- checkpoint
+
+def _save_layout(tmp, world, axis):
+    full_w = np.arange(32, dtype=np.float32).reshape(8, 4)
+    full_b = np.arange(4, dtype=np.float32)
+    specs = {"w": ShardSpec(axis, world)}
+    for r in range(world):
+        shard = np.split(full_w, world, axis=axis)[r]
+        save_sharded_state_dict({"w": shard, "b": full_b}, tmp, r, specs)
+    return full_w, full_b
+
+
+def test_save_and_merge_roundtrip(tmp_path):
+    d = str(tmp_path / "ck")
+    full_w, full_b = _save_layout(d, world=4, axis=0)
+    merged = load_merged_state_dict(d)
+    np.testing.assert_array_equal(merged["w"], full_w)
+    np.testing.assert_array_equal(merged["b"], full_b)
+
+
+def test_reshard_on_load_different_world(tmp_path):
+    d = str(tmp_path / "ck")
+    full_w, _ = _save_layout(d, world=4, axis=0)
+    # load under a 2-way layout sharded on axis 1
+    target = {"w": ShardSpec(1, 2)}
+    r0 = load_sharded_state_dict(d, 0, target)
+    r1 = load_sharded_state_dict(d, 1, target)
+    np.testing.assert_array_equal(
+        np.concatenate([r0["w"], r1["w"]], axis=1), full_w)
+    np.testing.assert_array_equal(r0["b"], r1["b"])
+
+
+def test_offline_reshard_checkpoint(tmp_path):
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "dst")
+    full_w, _ = _save_layout(src, world=4, axis=0)
+    reshard_checkpoint(src, dst, {"w": ShardSpec(0, 2)}, target_world=2)
+    merged = load_merged_state_dict(dst)
+    np.testing.assert_array_equal(merged["w"], full_w)
+
+
+def test_missing_shard_raises(tmp_path):
+    d = str(tmp_path / "ck")
+    specs = {"w": ShardSpec(0, 2)}
+    save_sharded_state_dict({"w": np.zeros((2, 2), np.float32)}, d, 0, specs)
+    with pytest.raises(ValueError, match="missing shards"):
+        load_merged_state_dict(d)
+
+
+def test_indivisible_target_raises(tmp_path):
+    d = str(tmp_path / "ck")
+    _save_layout(d, world=4, axis=0)
+    with pytest.raises(ValueError, match="not divisible"):
+        load_sharded_state_dict(d, 0, {"w": ShardSpec(0, 3)})
