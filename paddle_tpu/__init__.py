@@ -53,6 +53,8 @@ from . import regularizer  # noqa: F401
 from . import quantization  # noqa: F401
 from . import audio  # noqa: F401
 from . import text  # noqa: F401
+from . import utils  # noqa: F401
+from . import onnx  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .framework import random as framework_random  # noqa: F401
 from .hapi.model import Model  # noqa: F401

@@ -72,10 +72,13 @@ def save_sharded_state_dict(state_dict: Dict, path: str, rank: int,
             if rank == 0:
                 arrays[name] = arr
     np.savez(os.path.join(path, f"rank_{rank}.npz"), **arrays)
+    # every rank computes identical metadata; write-to-temp + atomic rename
+    # makes concurrent saves race-free (last writer wins with valid JSON)
     meta_path = os.path.join(path, "meta.json")
-    if rank == 0 or not os.path.exists(meta_path):
-        with open(meta_path, "w") as f:
-            json.dump(meta, f)
+    tmp_path = os.path.join(path, f".meta.json.tmp.{rank}")
+    with open(tmp_path, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp_path, meta_path)
 
 
 def _read_meta(path: str) -> Dict:
@@ -95,31 +98,36 @@ def load_merged_state_dict(path: str) -> Dict[str, np.ndarray]:
         raise FileNotFoundError(f"no rank_*.npz shards under {path}")
     per_rank = {r: np.load(os.path.join(path, f"rank_{r}.npz"))
                 for r in ranks}
-    merged = {}
-    for name, info in meta.items():
-        spec = ShardSpec.from_json(info["spec"])
-        if spec.axis is None:
-            if 0 not in per_rank or name not in per_rank[0]:
-                raise ValueError(
-                    f"checkpoint {path!r} is missing rank_0.npz (or "
-                    f"{name!r} within it) — replicated tensors are stored "
-                    "on rank 0 only")
-            merged[name] = per_rank[0][name]
-        else:
-            missing = [r for r in range(spec.world) if r not in per_rank
-                       or name not in per_rank[r]]
-            if missing:
-                raise ValueError(
-                    f"checkpoint {path!r} is missing shards of {name!r} "
-                    f"for ranks {missing}")
-            merged[name] = np.concatenate(
-                [per_rank[r][name] for r in range(spec.world)],
-                axis=spec.axis)
-            if list(merged[name].shape) != info["global_shape"]:
-                raise ValueError(
-                    f"merged shape {list(merged[name].shape)} of {name!r} "
-                    f"!= recorded global shape {info['global_shape']}")
-    return merged
+    try:
+        merged = {}
+        for name, info in meta.items():
+            spec = ShardSpec.from_json(info["spec"])
+            if spec.axis is None:
+                if 0 not in per_rank or name not in per_rank[0]:
+                    raise ValueError(
+                        f"checkpoint {path!r} is missing rank_0.npz (or "
+                        f"{name!r} within it) — replicated tensors are "
+                        "stored on rank 0 only")
+                merged[name] = per_rank[0][name]
+            else:
+                missing = [r for r in range(spec.world)
+                           if r not in per_rank or name not in per_rank[r]]
+                if missing:
+                    raise ValueError(
+                        f"checkpoint {path!r} is missing shards of "
+                        f"{name!r} for ranks {missing}")
+                merged[name] = np.concatenate(
+                    [per_rank[r][name] for r in range(spec.world)],
+                    axis=spec.axis)
+                if list(merged[name].shape) != info["global_shape"]:
+                    raise ValueError(
+                        f"merged shape {list(merged[name].shape)} of "
+                        f"{name!r} != recorded global shape "
+                        f"{info['global_shape']}")
+        return merged
+    finally:
+        for f in per_rank.values():
+            f.close()
 
 
 def load_sharded_state_dict(path: str, rank: int, target_specs:
@@ -153,6 +161,20 @@ def reshard_checkpoint(src_path: str, dst_path: str,
                 f"target spec for {name!r} has world={spec.world} but "
                 f"target_world={target_world}; all {target_world} shards "
                 "must be written or the checkpoint would be incomplete")
+    # merge once, split per rank (not a per-rank re-read of the source)
+    merged = load_merged_state_dict(src_path)
     for rank in range(target_world):
-        shard = load_sharded_state_dict(src_path, rank, target_specs)
+        shard = {}
+        for name, arr in merged.items():
+            spec = target_specs.get(name)
+            if spec is None or spec.axis is None:
+                shard[name] = arr
+            else:
+                if arr.shape[spec.axis] % spec.world:
+                    raise ValueError(
+                        f"{name!r} axis {spec.axis} "
+                        f"(= {arr.shape[spec.axis]}) not divisible by "
+                        f"target world {spec.world}")
+                shard[name] = np.split(arr, spec.world,
+                                       axis=spec.axis)[rank]
         save_sharded_state_dict(shard, dst_path, rank, target_specs)

@@ -1,3 +1,5 @@
 from . import sequence_parallel_utils  # noqa: F401
+from . import fs  # noqa: F401
+from .fs import LocalFS, HDFSClient  # noqa: F401
 from .hybrid_parallel_util import fused_allreduce_gradients  # noqa: F401
 from ..recompute import recompute  # noqa: F401  (reference fleet.utils.recompute)

@@ -1,0 +1,216 @@
+"""Filesystem abstraction for checkpoints/data.
+
+Reference parity: ``python/paddle/distributed/fleet/utils/fs.py``
+(LocalFS:113, HDFSClient:424, AFSClient:1152).  LocalFS is fully
+functional; HDFSClient shells out to a configured ``hadoop`` binary and
+raises clearly when one is not present (this build is air-gapped).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+__all__ = ["FS", "LocalFS", "HDFSClient", "FSFileExistsError",
+           "FSFileNotExistsError"]
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class ExecuteError(Exception):
+    """A filesystem shell command exited nonzero (reference fs.py
+    ExecuteError)."""
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        raise NotImplementedError
+
+    def upload_dir(self, local_dir, dest_dir):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local filesystem (reference LocalFS — same method surface)."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for entry in os.listdir(fs_path):
+            if os.path.isdir(os.path.join(fs_path, entry)):
+                dirs.append(entry)
+            else:
+                files.append(entry)
+        return dirs, files
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+        elif self.is_file(fs_path):
+            os.remove(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=True):
+        if test_exists:
+            if not self.is_exist(src_path):
+                raise FSFileNotExistsError(f"{src_path} not found")
+            if self.is_exist(dst_path) and not overwrite:
+                raise FSFileExistsError(f"{dst_path} already exists")
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        shutil.move(src_path, dst_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(f"{fs_path} already exists")
+            return
+        with open(fs_path, "a"):
+            pass
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def upload_dir(self, local_dir, dest_dir):
+        shutil.copytree(local_dir, dest_dir)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient(FS):
+    """HDFS via the hadoop CLI (reference HDFSClient shells out the same
+    way).  Requires a working ``hadoop`` executable."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=300000,
+                 sleep_inter=1000):
+        self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else "hadoop"
+        self._configs = configs or {}
+        if shutil.which(self._hadoop) is None:
+            raise RuntimeError(
+                f"hadoop executable {self._hadoop!r} not found; HDFSClient "
+                "needs a Hadoop installation (air-gapped CI uses LocalFS)")
+
+    def _run(self, *args, check=False):
+        cmd = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if check and proc.returncode != 0:
+            raise ExecuteError(
+                f"{' '.join(cmd)} failed (exit {proc.returncode}): "
+                f"{proc.stderr.strip()}")
+        return proc.returncode, proc.stdout
+
+    def is_exist(self, fs_path):
+        code, _ = self._run("-test", "-e", fs_path)
+        return code == 0
+
+    def is_dir(self, fs_path):
+        code, _ = self._run("-test", "-d", fs_path)
+        return code == 0
+
+    def is_file(self, fs_path):
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def ls_dir(self, fs_path):
+        _, out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path, check=True)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", "-f", fs_path, check=True)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path, check=True)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path, check=True)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        self._run("-mv", fs_src_path, fs_dst_path, check=True)
+
+    def need_upload_download(self):
+        return True
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path) and not exist_ok:
+            raise FSFileExistsError(f"{fs_path} already exists")
+        self._run("-touchz", fs_path, check=True)

@@ -1,0 +1,8 @@
+"""paddle_tpu.utils (analogue of ``python/paddle/utils``: dlpack interop,
+cpp_extension custom-op build/load, run_check environment check)."""
+
+from . import dlpack  # noqa: F401
+from . import cpp_extension  # noqa: F401
+from .install_check import run_check  # noqa: F401
+
+__all__ = ["dlpack", "cpp_extension", "run_check"]

@@ -1,0 +1,217 @@
+"""Custom C++ op build-and-load.
+
+Capability analogue of ``python/paddle/utils/cpp_extension/cpp_extension.py``
+(``load()``:799 JIT build + ``setup()``:79) and the runtime registration in
+``paddle/fluid/framework/custom_operator.cc:958``.
+
+TPU-native design: a custom C++ op is a *host* op — compiled with g++ into
+a shared library, called through ctypes, and wrapped in
+``jax.pure_callback`` so it composes with jit/vmap tracing exactly like a
+phi CPU kernel composes with the CUDA graph in the reference (XLA treats
+it as a host custom-call).  Device-side custom kernels are written in
+Pallas instead (see paddle_tpu.ops.pallas) — the reference's .cu path has
+no place on TPU.
+
+C ABI contract (one function per op):
+
+    extern "C" void <name>(const float* x, float* out, int64_t n);
+
+elementwise over ``n`` floats; richer signatures can be registered by
+passing ``arity=2`` for binary ops:
+
+    extern "C" void <name>(const float* x, const float* y, float* out,
+                           int64_t n);
+
+Ops are registered into ``paddle_tpu._C_ops`` by name; an optional
+``vjp`` (another loaded op name or python fn) makes them differentiable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["load", "CppExtension", "setup", "get_build_directory",
+           "register_python_op"]
+
+
+def get_build_directory():
+    d = os.environ.get("PADDLE_TPU_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(),
+                                    "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _compile(name: str, sources: Sequence[str],
+             extra_cxx_flags: Sequence[str] = (),
+             verbose: bool = False) -> str:
+    """g++ -shared -fPIC sources -> <build_dir>/<name>_<hash>.so
+    (recompiled only when sources change — the reference's version-hash
+    cache in extension_utils)."""
+    build_dir = get_build_directory()
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(extra_cxx_flags).encode())
+    so_path = os.path.join(build_dir, f"{name}_{h.hexdigest()[:12]}.so")
+    if not os.path.exists(so_path):
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               *extra_cxx_flags, *sources, "-o", so_path]
+        if verbose:
+            print("compiling:", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"custom op compilation failed:\n{proc.stderr}")
+    return so_path
+
+
+class _LoadedModule:
+    """Holds python wrappers for each exported op function."""
+
+    def __init__(self, name):
+        self.name = name
+        self._fns = {}
+
+    def __getattr__(self, item):
+        try:
+            return self.__dict__["_fns"][item]
+        except KeyError:
+            raise AttributeError(
+                f"custom module {self.name!r} has no op {item!r}; "
+                f"available: {list(self.__dict__['_fns'])}")
+
+
+def _wrap_host_op(op_name: str, cfn, arity: int, vjp=None):
+    """ctypes fn -> framework op via jax.pure_callback (works eagerly,
+    under jit, and on TPU as a host custom-call)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import dispatch
+    from ..core.tensor import Tensor
+
+    def host_compute(*arrays):
+        arrs = [np.ascontiguousarray(np.asarray(a, np.float32))
+                for a in arrays]
+        out = np.empty_like(arrs[0])
+        n = ctypes.c_int64(arrs[0].size)
+        ptrs = [a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                for a in arrs]
+        cfn(*ptrs, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
+        return out
+
+    def _callback(*a):
+        shape = jax.ShapeDtypeStruct(a[0].shape, jnp.float32)
+        return jax.pure_callback(host_compute, shape, *a,
+                                 vmap_method="sequential")
+
+    if vjp is not None:
+        # differentiable via custom vjp: vjp(grad_out, *inputs) -> grads.
+        # The callback itself must only ever be traced through the custom
+        # rule (pure_callback has no JVP).
+        diff_f = jax.custom_vjp(_callback)
+
+        def fwd(*a):
+            return _callback(*a), a
+
+        def bwd(res, g):
+            grads = vjp(g, *res)
+            return tuple(grads) if isinstance(grads, (tuple, list)) \
+                else (grads,)
+
+        diff_f.defvjp(fwd, bwd)
+        impl = diff_f
+    else:
+        impl = _callback
+
+    def py_op(*tensors):
+        if len(tensors) != arity:
+            raise TypeError(
+                f"custom op {op_name!r} expects {arity} inputs, got "
+                f"{len(tensors)}")
+        nondiff = None if vjp is not None else [True] * arity
+        return dispatch(op_name, impl, tensors, nondiff_mask=nondiff)
+
+    py_op.__name__ = op_name
+    return py_op
+
+
+def load(name: str, sources: Sequence[str], functions=None,
+         extra_cxx_flags: Sequence[str] = (), arities=None, vjps=None,
+         verbose: bool = False) -> _LoadedModule:
+    """Compile + load custom C++ host ops and register them.
+
+    functions: exported symbol names (default: [name]).
+    arities: per-function input count (default 1).
+    vjps: per-function python vjp callable or None.
+    """
+    functions = functions or [name]
+    arities = arities or {}
+    vjps = vjps or {}
+    so_path = _compile(name, sources, extra_cxx_flags, verbose)
+    lib = ctypes.CDLL(so_path)
+    module = _LoadedModule(name)
+    from .. import _C_ops
+    for fn_name in functions:
+        if hasattr(_C_ops, fn_name):
+            raise ValueError(
+                f"custom op name {fn_name!r} collides with an existing "
+                "_C_ops entry; rename the exported symbol (builtin ops "
+                "cannot be shadowed by custom host ops)")
+        cfn = getattr(lib, fn_name)
+        arity = arities.get(fn_name, 1)
+        cfn.restype = None
+        cfn.argtypes = ([ctypes.POINTER(ctypes.c_float)] * (arity + 1)
+                        + [ctypes.c_int64])
+        wrapper = _wrap_host_op(fn_name, cfn, arity, vjps.get(fn_name))
+        module._fns[fn_name] = wrapper
+        setattr(_C_ops, fn_name, wrapper)  # runtime registration
+    return module
+
+
+def register_python_op(name: str, fn, vjp=None):
+    """Register a pure-python/jnp custom op into paddle_tpu._C_ops (the
+    analogue of a python-implemented custom op; differentiable if vjp
+    given or if fn is jnp-traceable)."""
+    from ..core.dispatch import dispatch
+    from .. import _C_ops
+
+    if hasattr(_C_ops, name):
+        raise ValueError(
+            f"custom op name {name!r} collides with an existing _C_ops "
+            "entry; pick a different name")
+
+    def py_op(*tensors):
+        return dispatch(name, fn, tensors)
+
+    py_op.__name__ = name
+    setattr(_C_ops, name, py_op)
+    return py_op
+
+
+class CppExtension:
+    """setup()-style extension description (reference CppExtension)."""
+
+    def __init__(self, sources, name=None, extra_compile_args=()):
+        self.sources = list(sources)
+        self.name = name
+        self.extra_compile_args = list(extra_compile_args)
+
+
+def setup(name: str, ext_modules, **kwargs):
+    """Eager build of extensions (the reference's setuptools path builds a
+    wheel; here we build+load in place and return the loaded modules)."""
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) \
+        else [ext_modules]
+    return [load(e.name or name, e.sources,
+                 extra_cxx_flags=e.extra_compile_args, **kwargs)
+            for e in exts]

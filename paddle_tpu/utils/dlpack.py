@@ -1,0 +1,40 @@
+"""DLPack zero-copy tensor interchange.
+
+Reference parity: ``python/paddle/utils/dlpack.py`` (to_dlpack/from_dlpack
+over ``paddle/fluid/framework/dlpack_tensor.cc``).  Here the exchange is
+the DLPack protocol on the underlying jax.Array — zero-copy on CPU and
+same-device on TPU where the consumer supports it.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack-protocol object (implements ``__dlpack__`` /
+    ``__dlpack_device__``; DLPack 1.0 exchanges protocol objects rather
+    than raw capsules — torch/numpy/jax ``from_dlpack`` all accept it)."""
+    if not isinstance(x, Tensor):
+        raise TypeError(f"to_dlpack expects a Tensor, got {type(x).__name__}")
+    return x._value
+
+
+def _is_capsule(obj):
+    return type(obj).__name__ == "PyCapsule"
+
+
+def from_dlpack(ext) -> Tensor:
+    """Any object with ``__dlpack__`` -> Tensor (zero-copy where the
+    producer allows it)."""
+    if _is_capsule(ext):
+        raise TypeError(
+            "from_dlpack expects an object implementing the DLPack "
+            "protocol (__dlpack__), not a raw capsule; pass the producing "
+            "tensor/array itself")
+    arr = jnp.from_dlpack(ext)
+    return Tensor(arr)

@@ -1,0 +1,31 @@
+"""Environment self-check (reference: ``python/paddle/utils/install_check.py``
+``run_check()``: verifies the install by running a tiny training step and
+reporting the devices found)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+
+    devices = jax.devices()
+    print(f"Running verify on {len(devices)} {devices[0].platform} "
+          "device(s).")
+    model = nn.Linear(4, 2)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((2,), np.int64))
+    loss = nn.functional.cross_entropy(model(x), y)
+    loss.backward()
+    opt.step()
+    if not np.isfinite(float(loss)):
+        raise RuntimeError("paddle_tpu self-check produced a non-finite "
+                           "loss; the installation is broken")
+    print("paddle_tpu is installed successfully!")

@@ -1,0 +1,146 @@
+"""utils tests: dlpack interop, cpp_extension custom C++ host ops,
+run_check, onnx gating (≙ test/custom_op/* + test_dlpack.py patterns)."""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension, dlpack
+
+
+def test_dlpack_roundtrip():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    cap = dlpack.to_dlpack(x)
+    y = dlpack.from_dlpack(cap)
+    np.testing.assert_array_equal(
+        np.asarray(y._value), np.arange(6, dtype=np.float32).reshape(2, 3))
+
+
+def test_dlpack_torch_interop():
+    import torch
+    t = torch.arange(4, dtype=torch.float32)
+    y = dlpack.from_dlpack(t)
+    np.testing.assert_array_equal(np.asarray(y._value),
+                                  [0.0, 1.0, 2.0, 3.0])
+    x = paddle.to_tensor(np.array([5.0, 6.0], np.float32))
+    back = torch.utils.dlpack.from_dlpack(dlpack.to_dlpack(x))
+    assert back.tolist() == [5.0, 6.0]
+
+
+def test_dlpack_type_error():
+    with pytest.raises(TypeError, match="Tensor"):
+        dlpack.to_dlpack(np.zeros(3))
+
+
+@pytest.fixture(scope="module")
+def custom_module(tmp_path_factory):
+    src = tmp_path_factory.mktemp("ext") / "my_ops.cc"
+    src.write_text(textwrap.dedent("""
+        #include <cstdint>
+        extern "C" void custom_relu(const float* x, float* out,
+                                    int64_t n) {
+            for (int64_t i = 0; i < n; ++i)
+                out[i] = x[i] > 0.f ? x[i] : 0.f;
+        }
+        extern "C" void custom_add(const float* x, const float* y,
+                                   float* out, int64_t n) {
+            for (int64_t i = 0; i < n; ++i) out[i] = x[i] + y[i];
+        }
+    """))
+    return cpp_extension.load(
+        "my_ops", [str(src)],
+        functions=["custom_relu", "custom_add"],
+        arities={"custom_add": 2},
+        vjps={"custom_relu":
+              lambda g, x: (g * (np.asarray(x) > 0).astype(np.float32),)})
+
+
+def test_cpp_extension_elementwise(custom_module):
+    x = paddle.to_tensor(np.array([-1.0, 2.0, -3.0, 4.0], np.float32))
+    out = custom_module.custom_relu(x)
+    np.testing.assert_array_equal(np.asarray(out._value), [0, 2, 0, 4])
+
+
+def test_cpp_extension_binary_and_c_ops_registration(custom_module):
+    from paddle_tpu import _C_ops
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.array([10.0, 20.0], np.float32))
+    out = _C_ops.custom_add(a, b)
+    np.testing.assert_array_equal(np.asarray(out._value), [11.0, 22.0])
+
+
+def test_cpp_extension_vjp_gradient(custom_module):
+    x = paddle.to_tensor(np.array([-1.0, 2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    out = custom_module.custom_relu(x)
+    out.sum().backward()
+    np.testing.assert_array_equal(np.asarray(x.grad._value), [0.0, 1.0, 1.0])
+
+
+def test_cpp_extension_compile_error(tmp_path):
+    bad = tmp_path / "bad.cc"
+    bad.write_text("this is not C++")
+    with pytest.raises(RuntimeError, match="compilation failed"):
+        cpp_extension.load("bad_ops", [str(bad)])
+
+
+def test_cpp_extension_arity_check(custom_module):
+    x = paddle.to_tensor(np.zeros(2, np.float32))
+    with pytest.raises(TypeError, match="expects 2 inputs"):
+        custom_module.custom_add(x)
+
+
+def test_register_python_op():
+    import jax.numpy as jnp
+    op = cpp_extension.register_python_op("my_square",
+                                          lambda a: jnp.square(a))
+    x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    y = op(x)
+    y.sum().backward()
+    assert float(np.asarray(y._value)[0]) == 9.0
+    assert float(np.asarray(x.grad._value)[0]) == 6.0  # autodiff through jnp
+
+
+def test_run_check(capsys):
+    paddle.utils.run_check()
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
+
+
+def test_onnx_export_gated():
+    with pytest.raises((RuntimeError, NotImplementedError),
+                       match="onnx|ONNX"):
+        paddle.onnx.export(None, "/tmp/x.onnx")
+
+
+def test_local_fs(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import LocalFS
+    from paddle_tpu.distributed.fleet.utils.fs import (FSFileExistsError,
+                                                       FSFileNotExistsError)
+    fs = LocalFS()
+    d = str(tmp_path / "a")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d)
+    f = os.path.join(d, "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    dirs, files = fs.ls_dir(str(tmp_path))
+    assert "a" in dirs
+    fs.mv(f, os.path.join(d, "y.txt"))
+    assert fs.is_file(os.path.join(d, "y.txt"))
+    with pytest.raises(FSFileNotExistsError):
+        fs.mv(os.path.join(d, "nope"), os.path.join(d, "z"))
+    fs.touch(os.path.join(d, "y.txt"))  # exist_ok default
+    with pytest.raises(FSFileExistsError):
+        fs.touch(os.path.join(d, "y.txt"), exist_ok=False)
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+
+def test_hdfs_client_gated():
+    from paddle_tpu.distributed.fleet.utils import HDFSClient
+    with pytest.raises(RuntimeError, match="hadoop"):
+        HDFSClient("/nonexistent/hadoop_home")
