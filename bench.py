@@ -69,14 +69,16 @@ def main():
     labels = paddle.to_tensor(
         rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
 
-    # warmup / compile
+    # warmup / compile.  Sync via a host fetch of the loss: on the axon
+    # PJRT tunnel block_until_ready() acks the enqueue, not completion —
+    # only a device->host transfer truly drains the step chain.
     loss = step(tokens, labels)
-    loss._value.block_until_ready()
+    float(loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = step(tokens, labels)
-    loss._value.block_until_ready()
+    float(loss)  # true device sync (chained through every step's params)
     dt = time.perf_counter() - t0
 
     tokens_per_s = batch * seq * steps / dt

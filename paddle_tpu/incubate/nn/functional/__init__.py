@@ -75,7 +75,8 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     time_major=False, rotary_emb_base=10000.0):
     """RoPE (reference fused_rotary_position_embedding).  q/k: [B, S, H, D]."""
     from ....ops.pallas import rope as pallas_rope
-    if sin is None or cos is None:
+    tables_built_here = sin is None or cos is None
+    if tables_built_here:
         d = q.shape[-1]
         s = q.shape[1]
         inv_freq = 1.0 / (rotary_emb_base ** (jnp.arange(0, d, 2,
@@ -92,18 +93,39 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             cos_arr = cos_arr[None, :, None, :]
             sin_arr = sin_arr[None, :, None, :]
 
+    # Pallas path: the half-split kernel matches rotate_half exactly when
+    # the table is the NeoX concat(freqs, freqs) layout — guaranteed when
+    # we built the tables here (user-provided tables stay on XLA since
+    # verifying cos[:d/2] == cos[d/2:] would force a device sync).
+    d_half = cos_arr.shape[-1] // 2
+    use_pallas = (tables_built_here and use_neox_rotary_style
+                  and pallas_rope.should_use_pallas(q))
+    cos_h = cos_arr[..., :d_half]
+    sin_h = sin_arr[..., :d_half]
+
     if k is not None:
-        def impl(qa, ka):
-            qo, ko = _apply_rope(qa.astype(jnp.float32), ka.astype(jnp.float32),
-                                 cos_arr, sin_arr)
-            return qo.astype(qa.dtype), ko.astype(ka.dtype)
+        if use_pallas:
+            def impl(qa, ka):
+                return (pallas_rope.apply_rope(qa, cos_h, sin_h),
+                        pallas_rope.apply_rope(ka, cos_h, sin_h))
+        else:
+            def impl(qa, ka):
+                qo, ko = _apply_rope(qa.astype(jnp.float32),
+                                     ka.astype(jnp.float32),
+                                     cos_arr, sin_arr)
+                return qo.astype(qa.dtype), ko.astype(ka.dtype)
 
         return dispatch("fused_rope", impl, (q, k))
 
-    def impl_q(qa):
-        qo, _ = _apply_rope(qa.astype(jnp.float32), qa.astype(jnp.float32),
-                            cos_arr, sin_arr)
-        return qo.astype(qa.dtype)
+    if use_pallas:
+        def impl_q(qa):
+            return pallas_rope.apply_rope(qa, cos_h, sin_h)
+    else:
+        def impl_q(qa):
+            qo, _ = _apply_rope(qa.astype(jnp.float32),
+                                qa.astype(jnp.float32),
+                                cos_arr, sin_arr)
+            return qo.astype(qa.dtype)
 
     return dispatch("fused_rope", impl_q, (q,))
 

@@ -57,8 +57,13 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
                     training=True, name=None):
     """q/k/v: [batch, seq, heads, head_dim] — reference flash_attention API."""
     from ...ops.pallas import flash_attention as pallas_fa
-    if pallas_fa.should_use_pallas(query, causal=causal, dropout=dropout):
-        out = pallas_fa.flash_attention(query, key, value, causal=causal)
+    if pallas_fa.should_use_pallas(query, causal=causal,
+                                   dropout=dropout if training else 0.0,
+                                   key=key):
+        def impl(q, k, v):
+            return pallas_fa.flash_attention(q, k, v, causal=causal)
+
+        out = dispatch("flash_attention", impl, (query, key, value))
         return (out, None) if return_softmax else out
 
     p = dropout if training else 0.0
@@ -80,8 +85,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     """q/k/v: [batch, seq, heads, head_dim] (reference API layout)."""
     from ...ops.pallas import flash_attention as pallas_fa
     if attn_mask is None and pallas_fa.should_use_pallas(
-            query, causal=is_causal, dropout=dropout_p):
-        return pallas_fa.flash_attention(query, key, value, causal=is_causal)
+            query, causal=is_causal,
+            dropout=dropout_p if training else 0.0, key=key):
+        def impl(q, k, v):
+            return pallas_fa.flash_attention(q, k, v, causal=is_causal)
+
+        return dispatch("flash_attention", impl, (query, key, value))
 
     p = dropout_p if training else 0.0
     dkey = _dropout_key() if p > 0.0 else None

@@ -50,8 +50,11 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     """RMSNorm (reference: python/paddle/incubate/nn/functional/fused_rms_norm.py)."""
     from ...ops.pallas import rms_norm as pallas_rms
-    if pallas_rms.should_use_pallas(x):
-        return pallas_rms.rms_norm(x, weight, epsilon)
+    if weight is not None and pallas_rms.should_use_pallas(x):
+        def impl(a, w):
+            return pallas_rms.rms_norm(a, w, epsilon)
+
+        return dispatch("rms_norm_pallas", impl, (x, weight))
 
     def impl(a, *rest):
         acc = a.astype(jnp.float32)

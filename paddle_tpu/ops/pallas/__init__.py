@@ -1,6 +1,10 @@
 """Pallas TPU kernels (the analogue of the reference's hand-written CUDA
-kernel set: flash-attention, fused norms, rope — SURVEY §2.1 rows
-"FlashAttention-2 integration" and "Fusion kernels")."""
+kernel set: flash-attention, fused norms, rope, fused optimizer updates —
+SURVEY §2.1 rows "FlashAttention-2 integration" and "Fusion kernels") plus
+the autotune harness (≙ phi/kernels/autotune)."""
 
 from . import flash_attention  # noqa: F401
 from . import rms_norm  # noqa: F401
+from . import rope  # noqa: F401
+from . import fused_optimizer  # noqa: F401
+from . import autotune  # noqa: F401

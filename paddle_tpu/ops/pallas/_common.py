@@ -15,5 +15,30 @@ def on_tpu() -> bool:
     return plat in ("tpu", "axon")
 
 
+@functools.lru_cache(maxsize=None)
+def _pallas_compiles() -> bool:
+    """One-time probe: compile+run a trivial kernel on the real device.
+    If the platform's Pallas lowering is unavailable (e.g. a PJRT plugin
+    without Mosaic support), every ``should_use_pallas`` gate degrades to
+    the XLA fallback instead of failing mid-training."""
+    if not on_tpu():
+        return True  # interpret mode always works (used by CPU CI)
+    try:
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def k(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * 2.0
+
+        out = pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        )(jnp.ones((8, 128), jnp.float32))
+        out.block_until_ready()
+        return bool(out[0, 0] == 2.0)
+    except Exception:
+        return False
+
+
 def pallas_enabled() -> bool:
-    return flag("prefer_pallas_kernels") and on_tpu()
+    return (flag("prefer_pallas_kernels") and on_tpu()
+            and _pallas_compiles())

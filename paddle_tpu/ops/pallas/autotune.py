@@ -1,0 +1,106 @@
+"""Pallas kernel autotuning harness.
+
+TPU analogue of the reference's runtime autotune
+(``paddle/phi/kernels/autotune/{auto_tune_base.h,cache.h}``: time each
+candidate algorithm once, cache the winner per input signature) and of
+CINN's auto_schedule role for kernel configs.
+
+Usage:
+
+    tuned = autotune(
+        lambda bq, bk: functools.partial(flash_attention,
+                                         block_q=bq, block_k=bk),
+        candidates=[(128, 128), (256, 128), (128, 256)],
+    )
+    out = tuned(q, k, v)      # first call times candidates; later calls
+                              # reuse the cached winner for that signature
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Sequence, Tuple
+
+import jax
+
+__all__ = ["autotune", "clear_cache", "cache_info"]
+
+_CACHE: Dict[Tuple, Tuple] = {}
+
+
+def _signature(args, kwargs):
+    sig = []
+    for a in args:
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            sig.append(("arr", tuple(a.shape), str(a.dtype)))
+        else:
+            sig.append(("val", a))
+    sig.extend(sorted(kwargs.items()))
+    return tuple(sig)
+
+
+def _sync(out):
+    """True device sync: fetch one element to host.  block_until_ready is
+    NOT sufficient on tunnelled PJRT backends (axon) — it acks the enqueue
+    only (same reason bench.py syncs via float(loss))."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    if hasattr(leaf, "ndim"):
+        jax.device_get(leaf[(0,) * leaf.ndim])
+    return out
+
+
+def _time_once(fn, args, kwargs, warmup=1, iters=3) -> float:
+    for _ in range(warmup):
+        out = fn(*args, **kwargs)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args, **kwargs)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def autotune(make_fn: Callable, candidates: Sequence, name: str = None):
+    """make_fn(*candidate) -> callable kernel variant.  Returns a wrapper
+    that, per input signature, times every candidate once and caches the
+    fastest."""
+    label = name or getattr(make_fn, "__name__", "pallas_op")
+
+    def tuned(*args, **kwargs):
+        from ...core.flags import flag
+        if not flag("use_autotune"):
+            # kill switch (FLAGS_use_autotune): first candidate, no timing
+            first = candidates[0]
+            first = first if isinstance(first, tuple) else (first,)
+            return make_fn(*first)(*args, **kwargs)
+        key = (label, _signature(args, kwargs))
+        if key in _CACHE:
+            best = _CACHE[key][0]
+            return make_fn(*best)(*args, **kwargs)
+        best, best_t = None, float("inf")
+        for cand in candidates:
+            cand = cand if isinstance(cand, tuple) else (cand,)
+            try:
+                t = _time_once(make_fn(*cand), args, kwargs)
+            except Exception:
+                continue  # invalid config for this shape
+            if t < best_t:
+                best, best_t = cand, t
+        if best is None:
+            raise ValueError(
+                f"autotune({label}): no candidate config succeeded for "
+                f"signature {key[1]}")
+        _CACHE[key] = (best, best_t)
+        return make_fn(*best)(*args, **kwargs)
+
+    tuned.__name__ = f"autotuned_{label}"
+    return tuned
+
+
+def clear_cache():
+    _CACHE.clear()
+
+
+def cache_info():
+    """{(name, signature): (winning_config, seconds)} snapshot."""
+    return dict(_CACHE)

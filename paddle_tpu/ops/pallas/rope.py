@@ -1,7 +1,72 @@
-"""Pallas fused RoPE (TPU).  Placeholder gating until the kernel lands."""
+"""Fused rotary position embedding (RoPE) Pallas kernel.
+
+TPU analogue of the reference fused kernel behind
+``paddle.incubate.nn.functional.fused_rotary_position_embedding``
+(``paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu``): rotates the
+half-split feature pairs in one elementwise pass.  The vjp is the inverse
+rotation (rotation matrices are orthogonal), so no residuals beyond the
+cos/sin tables are kept.
+"""
 
 from __future__ import annotations
 
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ._common import on_tpu, pallas_enabled
+
 
 def should_use_pallas(q) -> bool:
-    return False
+    if not pallas_enabled():
+        return False
+    return q.ndim == 4 and q.shape[-1] % 2 == 0 and q.shape[-1] >= 64
+
+
+def _rope_kernel(x_ref, cos_ref, sin_ref, y_ref):
+    x = x_ref[:].astype(jnp.float32)        # [1, s, h, d]
+    cos = cos_ref[:].astype(jnp.float32)    # [1, s, 1, d//2]
+    sin = sin_ref[:].astype(jnp.float32)
+    d = x.shape[-1]
+    x1 = x[..., : d // 2]
+    x2 = x[..., d // 2:]
+    y1 = x1 * cos - x2 * sin
+    y2 = x2 * cos + x1 * sin
+    y_ref[:] = jnp.concatenate([y1, y2], axis=-1).astype(y_ref.dtype)
+
+
+def _rope_call(x, cos, sin):
+    b = x.shape[0]
+    return pl.pallas_call(
+        _rope_kernel,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1,) + x.shape[1:], lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1,) + cos.shape[1:], lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((1,) + sin.shape[1:], lambda i: (0, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1,) + x.shape[1:], lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=not on_tpu(),
+    )(x, cos, sin)
+
+
+@jax.custom_vjp
+def apply_rope(x, cos, sin):
+    """x: [b, s, h, d]; cos/sin: [1, s, 1, d//2] (half-split convention)."""
+    return _rope_call(x, cos, sin)
+
+
+def _rope_fwd(x, cos, sin):
+    return _rope_call(x, cos, sin), (cos, sin)
+
+
+def _rope_bwd(res, g):
+    cos, sin = res
+    # inverse rotation: g rotated by -theta
+    return _rope_call(g, cos, -sin), None, None
+
+
+apply_rope.defvjp(_rope_fwd, _rope_bwd)

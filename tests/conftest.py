@@ -22,7 +22,10 @@ try:
 
     if not _xb.backends_are_initialized():
         for name in list(getattr(_xb, "_backend_factories", {})):
-            if name not in ("cpu",):
+            # keep the stock "tpu" factory: JAX_PLATFORMS=cpu prevents its
+            # init, but its registration keeps "tpu" a known MLIR platform
+            # (checkify/pallas register tpu lowerings at import time)
+            if name not in ("cpu", "tpu"):
                 _xb._backend_factories.pop(name, None)
 except Exception:
     pass
