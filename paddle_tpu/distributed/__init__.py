@@ -31,6 +31,7 @@ from . import auto_parallel  # noqa: F401
 from .utils import recompute  # noqa: F401
 from . import launch  # noqa: F401
 from . import rpc  # noqa: F401
+from . import ps  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import checkpoint  # noqa: F401
 

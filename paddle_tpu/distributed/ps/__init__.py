@@ -1,0 +1,173 @@
+"""Parameter-server training (capability analogue of
+``python/paddle/distributed/ps`` + the C++ PS in
+``paddle/fluid/distributed/ps/``).
+
+Architecture: the native TCP parameter server
+(runtime/native/ps_server.cc ≙ brpc_ps_server.h) owns dense and sparse
+float tables and applies the SGD rule server-side
+(≙ table/sparse_sgd_rule.h); trainers hold :class:`PSClient` connections
+and embed :class:`SparseEmbedding` layers whose forward pulls rows for
+the batch's ids and whose backward pushes gradients — the async-push
+semantics of the reference's communicator collapse to synchronous
+push-on-backward here (the "sync mode" of the_one_ps), which is the
+honest starting point on TPU hosts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...runtime.native_bindings import PSServerHandle, PSClientHandle
+from ...autograd.py_layer import PyLayer
+from ...core.tensor import Tensor
+from ...nn import Layer
+
+__all__ = ["PSServer", "PSClient", "SparseEmbedding", "DensePSParameter"]
+
+
+class PSServer:
+    """Run the native parameter server (usually on the trainer-0 host or a
+    dedicated CPU node; reference: ``fleet.init_server()``/run_server)."""
+
+    def __init__(self, port: int = 0):
+        self._handle = PSServerHandle(port)
+
+    @property
+    def port(self) -> int:
+        return self._handle.port
+
+    def stop(self):
+        self._handle.stop()
+
+
+class PSClient:
+    """Trainer-side client (reference ``PSClient``/``brpc_ps_client.h``)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout_s: float = 30.0):
+        self._c = PSClientHandle(host, port, timeout_s)
+        self._dense_dims = {}
+        self._sparse_dims = {}
+
+    # table management -------------------------------------------------
+    def create_dense_table(self, table_id: int, dim: int, init=None):
+        self._c.create_dense(table_id, dim)
+        self._dense_dims[table_id] = dim
+        if init is not None:
+            self._c.set_dense(table_id, np.asarray(init, np.float32))
+
+    def create_sparse_table(self, table_id: int, dim: int,
+                            init_scale: float = 0.01, seed: int = 0):
+        self._c.create_sparse(table_id, dim, init_scale, seed)
+        self._sparse_dims[table_id] = dim
+
+    # dense ------------------------------------------------------------
+    def pull_dense(self, table_id: int):
+        return self._c.pull_dense(table_id, self._dense_dims[table_id])
+
+    def push_dense_grad(self, table_id: int, grad, lr: float):
+        self._c.push_dense(table_id, grad, lr)
+
+    def set_dense(self, table_id: int, values):
+        self._c.set_dense(table_id, values)
+
+    # sparse -----------------------------------------------------------
+    def pull_sparse(self, table_id: int, keys):
+        return self._c.pull_sparse(table_id, keys,
+                                   self._sparse_dims[table_id])
+
+    def push_sparse_grad(self, table_id: int, keys, grads, lr: float):
+        self._c.push_sparse(table_id, keys, grads, lr)
+
+    def sparse_table_size(self, table_id: int) -> int:
+        return self._c.sparse_size(table_id)
+
+    def close(self):
+        self._c.close()
+
+
+class _SparseLookup(PyLayer):
+    """forward: pull rows; backward: push grads to the server (the
+    reference's pull_sparse / push_sparse_grad pair around the embedding
+    op, ps/service/communicator)."""
+
+    @staticmethod
+    def forward(ctx, ids, hook, client, table_id, lr):
+        # `hook` is a scalar trainable dummy: PyLayer wires its node into
+        # the tape only when some input requires grad, and the PS table
+        # has no local Parameter (≙ the remote-table var in the reference)
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids)
+        flat = ids_np.reshape(-1).astype(np.uint64)
+        rows = client.pull_sparse(table_id, flat)
+        ctx.client = client
+        ctx.table_id = table_id
+        ctx.keys = flat
+        ctx.lr = lr
+        out = rows.reshape(ids_np.shape + (rows.shape[-1],))
+        return Tensor(out, stop_gradient=False)
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        g = np.asarray(grad_out._value if isinstance(grad_out, Tensor)
+                       else grad_out)
+        g2 = g.reshape(-1, g.shape[-1])
+        # duplicate ids in a batch each contribute their own gradient row;
+        # the server accumulates them (one push per occurrence collapses
+        # to a pre-summed push here, matching mean-free SGD accumulation)
+        order = np.argsort(ctx.keys, kind="stable")
+        keys_sorted = ctx.keys[order]
+        uniq, start = np.unique(keys_sorted, return_index=True)
+        summed = np.add.reduceat(g2[order], start, axis=0)
+        ctx.client.push_sparse_grad(ctx.table_id, uniq, summed, ctx.lr)
+        # grads align with tensor inputs (ids, hook): ids not
+        # differentiable; hook gets zeros so optimizers see a no-op
+        return None, Tensor(np.zeros(1, np.float32))
+
+
+class SparseEmbedding(Layer):
+    """Embedding whose table lives on the parameter server (reference:
+    ``paddle.static.nn.sparse_embedding`` + memory_sparse_table).  The
+    learning rate is applied server-side on push."""
+
+    def __init__(self, client: PSClient, table_id: int, embedding_dim: int,
+                 learning_rate: float = 0.01, init_scale: float = 0.01,
+                 seed: int = 0):
+        super().__init__()
+        self.client = client
+        self.table_id = table_id
+        self.embedding_dim = embedding_dim
+        self.learning_rate = learning_rate
+        client.create_sparse_table(table_id, embedding_dim, init_scale,
+                                   seed)
+        self._grad_hook = self.create_parameter([1], is_bias=True)
+
+    def forward(self, ids):
+        return _SparseLookup.apply(ids, self._grad_hook, self.client,
+                                   self.table_id, self.learning_rate)
+
+
+class DensePSParameter:
+    """A dense parameter mirrored from the server: ``sync()`` pulls the
+    latest values into the local Tensor, ``push_grad()`` sends the local
+    gradient (reference dense-table pull/push in the communicator)."""
+
+    def __init__(self, client: PSClient, table_id: int, shape,
+                 learning_rate: float = 0.01, init=None):
+        self.client = client
+        self.table_id = table_id
+        self.shape = tuple(shape)
+        self.learning_rate = learning_rate
+        dim = int(np.prod(self.shape))
+        client.create_dense_table(table_id, dim,
+                                  None if init is None
+                                  else np.asarray(init, np.float32)
+                                  .reshape(-1))
+
+    def sync(self) -> Tensor:
+        vals = self.client.pull_dense(self.table_id)
+        return Tensor(vals.reshape(self.shape))
+
+    def push_grad(self, grad):
+        g = np.asarray(grad._value if isinstance(grad, Tensor) else grad)
+        self.client.push_dense_grad(self.table_id, g.reshape(-1),
+                                    self.learning_rate)

@@ -103,31 +103,22 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     cos_h = cos_arr[..., :d_half]
     sin_h = sin_arr[..., :d_half]
 
-    if k is not None:
+    # the reference rotates every tensor passed (q, and optionally k and
+    # v); return a tuple matching the inputs that were given
+    present = [t for t in (q, k, v) if t is not None]
+
+    def rotate_one(xa):
         if use_pallas:
-            def impl(qa, ka):
-                return (pallas_rope.apply_rope(qa, cos_h, sin_h),
-                        pallas_rope.apply_rope(ka, cos_h, sin_h))
-        else:
-            def impl(qa, ka):
-                qo, ko = _apply_rope(qa.astype(jnp.float32),
-                                     ka.astype(jnp.float32),
-                                     cos_arr, sin_arr)
-                return qo.astype(qa.dtype), ko.astype(ka.dtype)
+            return pallas_rope.apply_rope(xa, cos_h, sin_h)
+        xo, _ = _apply_rope(xa.astype(jnp.float32), xa.astype(jnp.float32),
+                            cos_arr, sin_arr)
+        return xo.astype(xa.dtype)
 
-        return dispatch("fused_rope", impl, (q, k))
+    def impl(*arrs):
+        outs = tuple(rotate_one(a) for a in arrs)
+        return outs if len(outs) > 1 else outs[0]
 
-    if use_pallas:
-        def impl_q(qa):
-            return pallas_rope.apply_rope(qa, cos_h, sin_h)
-    else:
-        def impl_q(qa):
-            qo, _ = _apply_rope(qa.astype(jnp.float32),
-                                qa.astype(jnp.float32),
-                                cos_arr, sin_arr)
-            return qo.astype(qa.dtype)
-
-    return dispatch("fused_rope", impl_q, (q,))
+    return dispatch("fused_rope", impl, tuple(present))
 
 
 rotary_position_embedding = fused_rotary_position_embedding

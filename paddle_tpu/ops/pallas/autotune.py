@@ -18,6 +18,7 @@ Usage:
 
 from __future__ import annotations
 
+import itertools
 import time
 from typing import Callable, Dict, Sequence, Tuple
 
@@ -26,16 +27,18 @@ import jax
 __all__ = ["autotune", "clear_cache", "cache_info"]
 
 _CACHE: Dict[Tuple, Tuple] = {}
+_ANON = itertools.count()
+
+
+def _abstract(a):
+    if hasattr(a, "shape") and hasattr(a, "dtype"):
+        return ("arr", tuple(a.shape), str(a.dtype))
+    return ("val", a)
 
 
 def _signature(args, kwargs):
-    sig = []
-    for a in args:
-        if hasattr(a, "shape") and hasattr(a, "dtype"):
-            sig.append(("arr", tuple(a.shape), str(a.dtype)))
-        else:
-            sig.append(("val", a))
-    sig.extend(sorted(kwargs.items()))
+    sig = [_abstract(a) for a in args]
+    sig.extend((k, _abstract(v)) for k, v in sorted(kwargs.items()))
     return tuple(sig)
 
 
@@ -64,7 +67,14 @@ def autotune(make_fn: Callable, candidates: Sequence, name: str = None):
     """make_fn(*candidate) -> callable kernel variant.  Returns a wrapper
     that, per input signature, times every candidate once and caches the
     fastest."""
-    label = name or getattr(make_fn, "__name__", "pallas_op")
+    label = name
+    if label is None:
+        base = getattr(make_fn, "__name__", "pallas_op")
+        if base == "<lambda>":
+            # anonymous factories must not share cache entries: two
+            # different lambdas with same-shaped inputs would collide
+            base = f"lambda_{next(_ANON)}"
+        label = base
 
     def tuned(*args, **kwargs):
         from ...core.flags import flag

@@ -19,11 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ._common import on_tpu, pallas_enabled
-
-
-def should_use_pallas(p) -> bool:
-    return pallas_enabled() and p.size >= 1024
+from ._common import on_tpu
 
 
 def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, t_ref,

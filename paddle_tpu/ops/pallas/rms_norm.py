@@ -21,64 +21,70 @@ from ._common import on_tpu, pallas_enabled
 BLOCK_ROWS = 256
 
 
+def _pick_rows(n: int) -> int:
+    """Largest divisor of n that is <= BLOCK_ROWS and a multiple of 8
+    (the fp32 sublane tile)."""
+    best = 0
+    for r in range(8, min(BLOCK_ROWS, n) + 1, 8):
+        if n % r == 0:
+            best = r
+    return best
+
+
 def should_use_pallas(x) -> bool:
     if not pallas_enabled():
         return False
     if x.ndim < 2:
         return False
-    return x.shape[-1] % 128 == 0
+    if x.shape[-1] % 128 != 0:
+        return False
+    n = 1
+    for s in x.shape[:-1]:
+        n *= s
+    # need a tileable row block; otherwise the XLA fallback handles it
+    return _pick_rows(n) > 0
 
 
-def _fwd_kernel(x_ref, w_ref, y_ref, rrms_ref, *, epsilon):
+def _fwd_kernel(x_ref, w_ref, y_ref, *, epsilon):
     x = x_ref[:].astype(jnp.float32)
     ms = jnp.mean(x * x, axis=-1, keepdims=True)
     rrms = jax.lax.rsqrt(ms + epsilon)
     y_ref[:] = (x * rrms * w_ref[:].astype(jnp.float32)).astype(y_ref.dtype)
-    rrms_ref[:] = rrms[:, 0]
 
 
 def _rms_fwd_impl(x2, w, epsilon):
     n, d = x2.shape
-    rows = min(BLOCK_ROWS, n)
-    if n % rows:
-        rows = n
-    y, rrms = pl.pallas_call(
+    rows = _pick_rows(n) or n
+    return pl.pallas_call(
         functools.partial(_fwd_kernel, epsilon=epsilon),
         grid=(n // rows,),
         in_specs=[
             pl.BlockSpec((rows, d), lambda i: (i, 0)),
             pl.BlockSpec((d,), lambda i: (0,)),
         ],
-        out_specs=[
-            pl.BlockSpec((rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((rows,), lambda i: (i,)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(x2.shape, x2.dtype),
-            jax.ShapeDtypeStruct((n,), jnp.float32),
-        ],
+        out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
         interpret=not on_tpu(),
     )(x2, w)
-    return y, rrms
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def _rms(x2, w, epsilon):
-    y, _ = _rms_fwd_impl(x2, w, epsilon)
-    return y
+    return _rms_fwd_impl(x2, w, epsilon)
 
 
 def _rms_fwd(x2, w, epsilon):
-    y, rrms = _rms_fwd_impl(x2, w, epsilon)
-    return y, (x2, w, rrms)
+    # residuals are just (x, w): rrms is a cheap row-reduce recomputed in
+    # the backward (saves the awkward 1-D stat output on TPU tiling)
+    return _rms_fwd_impl(x2, w, epsilon), (x2, w)
 
 
 def _rms_bwd(epsilon, res, g):
-    x2, w, rrms = res
+    x2, w = res
     xf = x2.astype(jnp.float32)
     gf = g.astype(jnp.float32)
     wf = w.astype(jnp.float32)
-    r = rrms[:, None]
+    r = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + epsilon)
     xhat = xf * r
     gw = gf * wf
     dx = r * (gw - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True))

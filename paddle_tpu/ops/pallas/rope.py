@@ -22,7 +22,12 @@ from ._common import on_tpu, pallas_enabled
 def should_use_pallas(q) -> bool:
     if not pallas_enabled():
         return False
-    return q.ndim == 4 and q.shape[-1] % 2 == 0 and q.shape[-1] >= 64
+    if not (q.ndim == 4 and q.shape[-1] % 2 == 0 and q.shape[-1] >= 64):
+        return False
+    # the kernel maps one [1, s, h, d] block per grid step: keep the fp32
+    # working set (input + output + halves) inside the ~16 MB VMEM budget
+    b, s, h, d = q.shape
+    return 3 * s * h * d * 4 <= 12 * 1024 * 1024
 
 
 def _rope_kernel(x_ref, cos_ref, sin_ref, y_ref):

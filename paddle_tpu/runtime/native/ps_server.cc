@@ -1,0 +1,471 @@
+// Parameter server: dense + sparse float tables over TCP.
+//
+// TPU-native analogue of the reference's brpc parameter server
+// (paddle/fluid/distributed/ps/service/brpc_ps_server.h, tables
+// paddle/fluid/distributed/ps/table/{memory_dense_table.h,
+// memory_sparse_table.h}, update rules sparse_sgd_rule.h): the server owns
+// the tables and applies the SGD rule on push (the "accessor" role);
+// sparse rows are created on first pull with uniform(-scale, scale) init,
+// matching the reference's create-on-miss embedding semantics. One thread
+// per connection; tables sharded under a mutex each.
+//
+// Wire protocol (little-endian), one request per round trip:
+//   u8 op | i32 table | u64 n | u64 dim | f64 lr | payload
+//     op=1 CREATE_DENSE                 payload: -
+//     op=2 CREATE_SPARSE  lr=init_scale payload: u64 seed
+//     op=3 PULL_DENSE                   payload: -
+//     op=4 SET_DENSE                    payload: dim floats
+//     op=5 PUSH_DENSE                   payload: dim floats (grad)
+//     op=6 PULL_SPARSE                  payload: n u64 keys
+//     op=7 PUSH_SPARSE                  payload: n u64 keys, n*dim floats
+//     op=8 SPARSE_SIZE                  payload: -
+//   response: i64 status_or_len | payload (floats / u64)
+
+#include "ptpu_runtime.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+bool ps_send_all(int fd, const void* data, size_t len) {
+  const char* p = (const char*)data;
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    p += n;
+    len -= n;
+  }
+  return true;
+}
+
+bool ps_recv_all(int fd, void* data, size_t len) {
+  char* p = (char*)data;
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) return false;
+    p += n;
+    len -= n;
+  }
+  return true;
+}
+
+struct DenseTable {
+  std::mutex mu;
+  std::vector<float> data;
+};
+
+struct SparseTable {
+  std::mutex mu;
+  int64_t dim = 0;
+  double init_scale = 0.0;
+  uint64_t seed = 0;
+  std::unordered_map<uint64_t, std::vector<float>> rows;
+
+  std::vector<float>& row(uint64_t key) {
+    auto it = rows.find(key);
+    if (it != rows.end()) return it->second;
+    std::vector<float> v((size_t)dim);
+    if (init_scale != 0.0) {
+      // per-key deterministic init: same key -> same row on any server
+      std::mt19937_64 gen(seed ^ (key * 0x9e3779b97f4a7c15ULL));
+      std::uniform_real_distribution<float> dist((float)-init_scale,
+                                                 (float)init_scale);
+      for (auto& x : v) x = dist(gen);
+    }
+    return rows.emplace(key, std::move(v)).first->second;
+  }
+};
+
+struct PSServer {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> running{true};
+  std::thread accept_thread;
+  std::vector<std::thread> handlers;
+  std::vector<int> conn_fds;
+  std::mutex handlers_mu;
+
+  std::mutex tables_mu;
+  std::map<int32_t, std::unique_ptr<DenseTable>> dense;
+  std::map<int32_t, std::unique_ptr<SparseTable>> sparse;
+
+  DenseTable* dense_table(int32_t id) {
+    std::lock_guard<std::mutex> l(tables_mu);
+    auto it = dense.find(id);
+    return it == dense.end() ? nullptr : it->second.get();
+  }
+  SparseTable* sparse_table(int32_t id) {
+    std::lock_guard<std::mutex> l(tables_mu);
+    auto it = sparse.find(id);
+    return it == sparse.end() ? nullptr : it->second.get();
+  }
+};
+
+void ps_reply_status(int fd, int64_t status) {
+  ps_send_all(fd, &status, sizeof(status));
+}
+
+void ps_handle_conn(PSServer* s, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  while (s->running.load()) {
+    uint8_t op;
+    int32_t table;
+    uint64_t n, dim;
+    double lr;
+    if (!ps_recv_all(fd, &op, 1)) break;
+    if (!ps_recv_all(fd, &table, 4) || !ps_recv_all(fd, &n, 8) ||
+        !ps_recv_all(fd, &dim, 8) || !ps_recv_all(fd, &lr, 8))
+      break;
+    switch (op) {
+      case 1: {  // CREATE_DENSE
+        std::lock_guard<std::mutex> l(s->tables_mu);
+        auto& t = s->dense[table];
+        if (!t) t = std::make_unique<DenseTable>();
+        t->data.assign((size_t)dim, 0.f);
+        ps_reply_status(fd, 0);
+        break;
+      }
+      case 2: {  // CREATE_SPARSE
+        uint64_t seed;
+        if (!ps_recv_all(fd, &seed, 8)) return;
+        std::lock_guard<std::mutex> l(s->tables_mu);
+        auto& t = s->sparse[table];
+        if (!t) t = std::make_unique<SparseTable>();
+        t->dim = (int64_t)dim;
+        t->init_scale = lr;  // lr field carries init_scale for op=2
+        t->seed = seed;
+        ps_reply_status(fd, 0);
+        break;
+      }
+      case 3: {  // PULL_DENSE
+        DenseTable* t = s->dense_table(table);
+        if (!t || t->data.size() != dim) {
+          ps_reply_status(fd, -2);
+          break;
+        }
+        std::vector<float> copy;
+        {
+          std::lock_guard<std::mutex> l(t->mu);
+          copy = t->data;
+        }
+        int64_t len = (int64_t)(copy.size() * sizeof(float));
+        ps_send_all(fd, &len, 8);
+        ps_send_all(fd, copy.data(), (size_t)len);
+        break;
+      }
+      case 4:    // SET_DENSE
+      case 5: {  // PUSH_DENSE (w -= lr * g)
+        std::vector<float> buf((size_t)dim);
+        if (!ps_recv_all(fd, buf.data(), buf.size() * sizeof(float)))
+          return;
+        DenseTable* t = s->dense_table(table);
+        if (!t || t->data.size() != dim) {
+          ps_reply_status(fd, -2);
+          break;
+        }
+        {
+          std::lock_guard<std::mutex> l(t->mu);
+          if (op == 4) {
+            t->data = buf;
+          } else {
+            for (size_t i = 0; i < buf.size(); ++i)
+              t->data[i] -= (float)lr * buf[i];
+          }
+        }
+        ps_reply_status(fd, 0);
+        break;
+      }
+      case 6: {  // PULL_SPARSE
+        std::vector<uint64_t> keys((size_t)n);
+        if (!ps_recv_all(fd, keys.data(), keys.size() * 8)) return;
+        SparseTable* t = s->sparse_table(table);
+        if (!t || (uint64_t)t->dim != dim) {
+          ps_reply_status(fd, -2);
+          break;
+        }
+        std::vector<float> out((size_t)(n * dim));
+        {
+          std::lock_guard<std::mutex> l(t->mu);
+          for (uint64_t i = 0; i < n; ++i) {
+            auto& row = t->row(keys[i]);
+            std::memcpy(out.data() + i * dim, row.data(),
+                        (size_t)dim * sizeof(float));
+          }
+        }
+        int64_t len = (int64_t)(out.size() * sizeof(float));
+        ps_send_all(fd, &len, 8);
+        ps_send_all(fd, out.data(), (size_t)len);
+        break;
+      }
+      case 7: {  // PUSH_SPARSE (row -= lr * g)
+        std::vector<uint64_t> keys((size_t)n);
+        std::vector<float> grads((size_t)(n * dim));
+        if (!ps_recv_all(fd, keys.data(), keys.size() * 8)) return;
+        if (!ps_recv_all(fd, grads.data(), grads.size() * sizeof(float)))
+          return;
+        SparseTable* t = s->sparse_table(table);
+        if (!t || (uint64_t)t->dim != dim) {
+          ps_reply_status(fd, -2);
+          break;
+        }
+        {
+          std::lock_guard<std::mutex> l(t->mu);
+          for (uint64_t i = 0; i < n; ++i) {
+            auto& row = t->row(keys[i]);
+            for (uint64_t j = 0; j < dim; ++j)
+              row[j] -= (float)lr * grads[i * dim + j];
+          }
+        }
+        ps_reply_status(fd, 0);
+        break;
+      }
+      case 8: {  // SPARSE_SIZE
+        SparseTable* t = s->sparse_table(table);
+        if (!t) {
+          ps_reply_status(fd, -2);
+          break;
+        }
+        std::lock_guard<std::mutex> l(t->mu);
+        ps_reply_status(fd, (int64_t)t->rows.size());
+        break;
+      }
+      default:
+        ps_reply_status(fd, -3);
+        break;
+    }
+  }
+  ::close(fd);
+}
+
+std::mutex g_ps_mu;
+std::map<int64_t, std::unique_ptr<PSServer>> g_ps_servers;
+std::map<int64_t, int> g_ps_clients;  // handle -> fd
+int64_t g_ps_next = 1;
+
+}  // namespace
+
+extern "C" {
+
+int64_t ptpu_ps_server_start(int port) {
+  auto s = std::make_unique<PSServer>();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) return -1;
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (::bind(s->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 64) != 0) {
+    ::close(s->listen_fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s->listen_fd, (sockaddr*)&addr, &alen);
+  s->port = ntohs(addr.sin_port);
+  PSServer* sp = s.get();
+  s->accept_thread = std::thread([sp] {
+    while (sp->running.load()) {
+      int fd = ::accept(sp->listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      std::lock_guard<std::mutex> l(sp->handlers_mu);
+      if (!sp->running.load()) {
+        ::close(fd);
+        break;
+      }
+      sp->conn_fds.push_back(fd);
+      sp->handlers.emplace_back(ps_handle_conn, sp, fd);
+    }
+  });
+  std::lock_guard<std::mutex> l(g_ps_mu);
+  int64_t h = g_ps_next++;
+  g_ps_servers[h] = std::move(s);
+  return h;
+}
+
+int ptpu_ps_server_port(int64_t h) {
+  std::lock_guard<std::mutex> l(g_ps_mu);
+  auto it = g_ps_servers.find(h);
+  return it == g_ps_servers.end() ? -1 : it->second->port;
+}
+
+void ptpu_ps_server_stop(int64_t h) {
+  std::unique_ptr<PSServer> s;
+  {
+    std::lock_guard<std::mutex> l(g_ps_mu);
+    auto it = g_ps_servers.find(h);
+    if (it == g_ps_servers.end()) return;
+    s = std::move(it->second);
+    g_ps_servers.erase(it);
+  }
+  s->running.store(false);
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  // wake every handler (shutdown makes their recv return 0) and JOIN
+  // them before the server object is destroyed — a detached handler
+  // would dereference freed memory on its next request
+  {
+    std::lock_guard<std::mutex> l(s->handlers_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : s->handlers)
+    if (t.joinable()) t.join();
+}
+
+int64_t ptpu_ps_client_create(const char* host, int port, double timeout_s) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  snprintf(portstr, sizeof(portstr), "%d", port);
+  if (getaddrinfo(host, portstr, &hints, &res) != 0 || !res) return -1;
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0 || ::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    freeaddrinfo(res);
+    if (fd >= 0) ::close(fd);
+    return -1;
+  }
+  freeaddrinfo(res);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  if (timeout_s > 0) {
+    timeval tv;
+    tv.tv_sec = (time_t)timeout_s;
+    tv.tv_usec = (suseconds_t)((timeout_s - (double)tv.tv_sec) * 1e6);
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  }
+  std::lock_guard<std::mutex> l(g_ps_mu);
+  int64_t h = g_ps_next++;
+  g_ps_clients[h] = fd;
+  return h;
+}
+
+void ptpu_ps_client_destroy(int64_t h) {
+  std::lock_guard<std::mutex> l(g_ps_mu);
+  auto it = g_ps_clients.find(h);
+  if (it == g_ps_clients.end()) return;
+  ::close(it->second);
+  g_ps_clients.erase(it);
+}
+
+namespace {
+
+int ps_client_fd(int64_t h) {
+  std::lock_guard<std::mutex> l(g_ps_mu);
+  auto it = g_ps_clients.find(h);
+  return it == g_ps_clients.end() ? -1 : it->second;
+}
+
+bool ps_send_header(int fd, uint8_t op, int32_t table, uint64_t n,
+                    uint64_t dim, double lr) {
+  return ps_send_all(fd, &op, 1) && ps_send_all(fd, &table, 4) &&
+         ps_send_all(fd, &n, 8) && ps_send_all(fd, &dim, 8) &&
+         ps_send_all(fd, &lr, 8);
+}
+
+int64_t ps_recv_status(int fd) {
+  int64_t st = -9;
+  if (!ps_recv_all(fd, &st, 8)) return -9;
+  return st;
+}
+
+}  // namespace
+
+int ptpu_ps_create_dense(int64_t c, int32_t table, int64_t dim) {
+  int fd = ps_client_fd(c);
+  if (fd < 0) return PTPU_ERR;
+  if (!ps_send_header(fd, 1, table, 0, (uint64_t)dim, 0.0)) return PTPU_ERR;
+  return ps_recv_status(fd) == 0 ? PTPU_OK : PTPU_ERR;
+}
+
+int ptpu_ps_create_sparse(int64_t c, int32_t table, int64_t dim,
+                          double init_scale, uint64_t seed) {
+  int fd = ps_client_fd(c);
+  if (fd < 0) return PTPU_ERR;
+  if (!ps_send_header(fd, 2, table, 0, (uint64_t)dim, init_scale))
+    return PTPU_ERR;
+  if (!ps_send_all(fd, &seed, 8)) return PTPU_ERR;
+  return ps_recv_status(fd) == 0 ? PTPU_OK : PTPU_ERR;
+}
+
+int ptpu_ps_pull_dense(int64_t c, int32_t table, float* out, int64_t dim) {
+  int fd = ps_client_fd(c);
+  if (fd < 0) return PTPU_ERR;
+  if (!ps_send_header(fd, 3, table, 0, (uint64_t)dim, 0.0)) return PTPU_ERR;
+  int64_t len = ps_recv_status(fd);
+  if (len != dim * (int64_t)sizeof(float)) return PTPU_ERR;
+  return ps_recv_all(fd, out, (size_t)len) ? PTPU_OK : PTPU_ERR;
+}
+
+int ptpu_ps_set_dense(int64_t c, int32_t table, const float* val,
+                      int64_t dim) {
+  int fd = ps_client_fd(c);
+  if (fd < 0) return PTPU_ERR;
+  if (!ps_send_header(fd, 4, table, 0, (uint64_t)dim, 0.0)) return PTPU_ERR;
+  if (!ps_send_all(fd, val, (size_t)dim * sizeof(float))) return PTPU_ERR;
+  return ps_recv_status(fd) == 0 ? PTPU_OK : PTPU_ERR;
+}
+
+int ptpu_ps_push_dense(int64_t c, int32_t table, const float* grad,
+                       int64_t dim, double lr) {
+  int fd = ps_client_fd(c);
+  if (fd < 0) return PTPU_ERR;
+  if (!ps_send_header(fd, 5, table, 0, (uint64_t)dim, lr)) return PTPU_ERR;
+  if (!ps_send_all(fd, grad, (size_t)dim * sizeof(float))) return PTPU_ERR;
+  return ps_recv_status(fd) == 0 ? PTPU_OK : PTPU_ERR;
+}
+
+int ptpu_ps_pull_sparse(int64_t c, int32_t table, const uint64_t* keys,
+                        int64_t n, int64_t dim, float* out) {
+  int fd = ps_client_fd(c);
+  if (fd < 0) return PTPU_ERR;
+  if (!ps_send_header(fd, 6, table, (uint64_t)n, (uint64_t)dim, 0.0))
+    return PTPU_ERR;
+  if (!ps_send_all(fd, keys, (size_t)n * 8)) return PTPU_ERR;
+  int64_t len = ps_recv_status(fd);
+  if (len != n * dim * (int64_t)sizeof(float)) return PTPU_ERR;
+  return ps_recv_all(fd, out, (size_t)len) ? PTPU_OK : PTPU_ERR;
+}
+
+int ptpu_ps_push_sparse(int64_t c, int32_t table, const uint64_t* keys,
+                        int64_t n, int64_t dim, const float* grads,
+                        double lr) {
+  int fd = ps_client_fd(c);
+  if (fd < 0) return PTPU_ERR;
+  if (!ps_send_header(fd, 7, table, (uint64_t)n, (uint64_t)dim, lr))
+    return PTPU_ERR;
+  if (!ps_send_all(fd, keys, (size_t)n * 8)) return PTPU_ERR;
+  if (!ps_send_all(fd, grads, (size_t)(n * dim) * sizeof(float)))
+    return PTPU_ERR;
+  return ps_recv_status(fd) == 0 ? PTPU_OK : PTPU_ERR;
+}
+
+int64_t ptpu_ps_sparse_size(int64_t c, int32_t table) {
+  int fd = ps_client_fd(c);
+  if (fd < 0) return -1;
+  if (!ps_send_header(fd, 8, table, 0, 0, 0.0)) return -1;
+  return ps_recv_status(fd);
+}
+
+}  // extern "C"

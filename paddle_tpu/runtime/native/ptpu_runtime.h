@@ -85,6 +85,33 @@ void ptpu_wq_wait_idle(int64_t h);
 int64_t ptpu_wq_pending(int64_t h);
 void ptpu_wq_destroy(int64_t h);
 
+// ---- parameter server ----
+// TPU-native analogue of the reference brpc PS
+// (paddle/fluid/distributed/ps/: brpc_ps_server.h, memory_dense_table.h,
+// memory_sparse_table.h, sparse_sgd_rule.h): dense + sparse (hash) float
+// tables behind a threaded TCP server; server-side SGD apply on push
+// (the accessor rule), create-on-first-pull sparse rows with uniform init.
+int64_t ptpu_ps_server_start(int port);             // handle or -1
+int ptpu_ps_server_port(int64_t h);
+void ptpu_ps_server_stop(int64_t h);
+int64_t ptpu_ps_client_create(const char* host, int port, double timeout_s);
+void ptpu_ps_client_destroy(int64_t h);
+int ptpu_ps_create_dense(int64_t c, int32_t table, int64_t dim);
+int ptpu_ps_create_sparse(int64_t c, int32_t table, int64_t dim,
+                          double init_scale, uint64_t seed);
+int ptpu_ps_pull_dense(int64_t c, int32_t table, float* out, int64_t dim);
+int ptpu_ps_set_dense(int64_t c, int32_t table, const float* val,
+                      int64_t dim);
+// server applies w -= lr * grad
+int ptpu_ps_push_dense(int64_t c, int32_t table, const float* grad,
+                       int64_t dim, double lr);
+int ptpu_ps_pull_sparse(int64_t c, int32_t table, const uint64_t* keys,
+                        int64_t n, int64_t dim, float* out /* n*dim */);
+int ptpu_ps_push_sparse(int64_t c, int32_t table, const uint64_t* keys,
+                        int64_t n, int64_t dim, const float* grads,
+                        double lr);
+int64_t ptpu_ps_sparse_size(int64_t c, int32_t table);  // #rows
+
 #if defined(__cplusplus)
 }  // extern "C"
 #endif

@@ -394,3 +394,162 @@ class WorkQueue:
             self.shutdown()
         except Exception:
             pass
+
+
+# ---- parameter server (≙ brpc PS: ps/service + memory tables) ----
+
+_lib.ptpu_ps_server_start.restype = _i64
+_lib.ptpu_ps_server_start.argtypes = [_i32]
+_lib.ptpu_ps_server_port.restype = _i32
+_lib.ptpu_ps_server_port.argtypes = [_i64]
+_lib.ptpu_ps_server_stop.argtypes = [_i64]
+_lib.ptpu_ps_client_create.restype = _i64
+_lib.ptpu_ps_client_create.argtypes = [_chp, _i32, _dbl]
+_lib.ptpu_ps_client_destroy.argtypes = [_i64]
+_fltp = ctypes.POINTER(ctypes.c_float)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+_lib.ptpu_ps_create_dense.restype = _i32
+_lib.ptpu_ps_create_dense.argtypes = [_i64, _i32, _i64]
+_lib.ptpu_ps_create_sparse.restype = _i32
+_lib.ptpu_ps_create_sparse.argtypes = [_i64, _i32, _i64, _dbl, _u64]
+_lib.ptpu_ps_pull_dense.restype = _i32
+_lib.ptpu_ps_pull_dense.argtypes = [_i64, _i32, _fltp, _i64]
+_lib.ptpu_ps_set_dense.restype = _i32
+_lib.ptpu_ps_set_dense.argtypes = [_i64, _i32, _fltp, _i64]
+_lib.ptpu_ps_push_dense.restype = _i32
+_lib.ptpu_ps_push_dense.argtypes = [_i64, _i32, _fltp, _i64, _dbl]
+_lib.ptpu_ps_pull_sparse.restype = _i32
+_lib.ptpu_ps_pull_sparse.argtypes = [_i64, _i32, _u64p, _i64, _i64, _fltp]
+_lib.ptpu_ps_push_sparse.restype = _i32
+_lib.ptpu_ps_push_sparse.argtypes = [_i64, _i32, _u64p, _i64, _i64, _fltp,
+                                     _dbl]
+_lib.ptpu_ps_sparse_size.restype = _i64
+_lib.ptpu_ps_sparse_size.argtypes = [_i64, _i32]
+
+
+class PSServerHandle:
+    """In-process parameter-server (the reference runs brpc services;
+    here a native TCP server thread owns the tables)."""
+
+    def __init__(self, port: int = 0):
+        self._h = _lib.ptpu_ps_server_start(port)
+        if self._h < 0:
+            raise OSError(f"PSServer: cannot bind port {port}")
+
+    @property
+    def port(self) -> int:
+        return int(_lib.ptpu_ps_server_port(self._h))
+
+    def stop(self):
+        if self._h >= 0:
+            _lib.ptpu_ps_server_stop(self._h)
+            self._h = -1
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class PSClientHandle:
+    """One TCP connection to a PS server.  NOT thread-safe (the reference
+    brpc client multiplexes; here use one client per thread)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
+        self._h = _lib.ptpu_ps_client_create(host.encode(), port, timeout_s)
+        if self._h < 0:
+            raise OSError(f"PSClient: cannot connect {host}:{port}")
+        self._lock = threading.Lock()
+
+    def close(self):
+        if self._h >= 0:
+            _lib.ptpu_ps_client_destroy(self._h)
+            self._h = -1
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    @staticmethod
+    def _check(rc, what):
+        if rc != OK:
+            raise RuntimeError(f"parameter server: {what} failed (rc={rc})")
+
+    def create_dense(self, table: int, dim: int):
+        with self._lock:
+            self._check(_lib.ptpu_ps_create_dense(self._h, table, dim),
+                        "create_dense")
+
+    def create_sparse(self, table: int, dim: int, init_scale: float = 0.0,
+                      seed: int = 0):
+        with self._lock:
+            self._check(
+                _lib.ptpu_ps_create_sparse(self._h, table, dim,
+                                           init_scale, seed),
+                "create_sparse")
+
+    def pull_dense(self, table: int, dim: int):
+        import numpy as np
+        out = np.empty(dim, np.float32)
+        with self._lock:
+            self._check(
+                _lib.ptpu_ps_pull_dense(self._h, table,
+                                        out.ctypes.data_as(_fltp), dim),
+                "pull_dense")
+        return out
+
+    def set_dense(self, table: int, values):
+        import numpy as np
+        arr = np.ascontiguousarray(values, np.float32)
+        with self._lock:
+            self._check(
+                _lib.ptpu_ps_set_dense(self._h, table,
+                                       arr.ctypes.data_as(_fltp), arr.size),
+                "set_dense")
+
+    def push_dense(self, table: int, grad, lr: float):
+        import numpy as np
+        arr = np.ascontiguousarray(grad, np.float32)
+        with self._lock:
+            self._check(
+                _lib.ptpu_ps_push_dense(self._h, table,
+                                        arr.ctypes.data_as(_fltp),
+                                        arr.size, lr),
+                "push_dense")
+
+    def pull_sparse(self, table: int, keys, dim: int):
+        import numpy as np
+        k = np.ascontiguousarray(keys, np.uint64)
+        out = np.empty((k.size, dim), np.float32)
+        with self._lock:
+            self._check(
+                _lib.ptpu_ps_pull_sparse(self._h, table,
+                                         k.ctypes.data_as(_u64p), k.size,
+                                         dim, out.ctypes.data_as(_fltp)),
+                "pull_sparse")
+        return out
+
+    def push_sparse(self, table: int, keys, grads, lr: float):
+        import numpy as np
+        k = np.ascontiguousarray(keys, np.uint64)
+        g = np.ascontiguousarray(grads, np.float32)
+        if g.shape[0] != k.size:
+            raise ValueError(
+                f"push_sparse: {k.size} keys but {g.shape[0]} grad rows")
+        with self._lock:
+            self._check(
+                _lib.ptpu_ps_push_sparse(self._h, table,
+                                         k.ctypes.data_as(_u64p), k.size,
+                                         g.shape[1],
+                                         g.ctypes.data_as(_fltp), lr),
+                "push_sparse")
+
+    def sparse_size(self, table: int) -> int:
+        with self._lock:
+            n = int(_lib.ptpu_ps_sparse_size(self._h, table))
+        if n < 0:
+            raise RuntimeError("parameter server: sparse_size failed")
+        return n

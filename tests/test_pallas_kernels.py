@@ -236,3 +236,41 @@ def test_fused_rope_uses_pallas_convention_equivalence():
     rot = np.concatenate([-qn[..., d // 2:], qn[..., : d // 2]], -1)
     ref = qn * cos + rot * sin
     np.testing.assert_allclose(np.asarray(qo._value), ref, atol=1e-5)
+
+
+def test_fused_rope_rotates_v_too():
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn.functional import (
+        fused_rotary_position_embedding)
+    rng = np.random.default_rng(8)
+    q = paddle.to_tensor(rng.standard_normal((1, 8, 2, 16))
+                         .astype(np.float32))
+    k = paddle.to_tensor(rng.standard_normal((1, 8, 2, 16))
+                         .astype(np.float32))
+    v = paddle.to_tensor(rng.standard_normal((1, 8, 2, 16))
+                         .astype(np.float32))
+    qo, ko, vo = fused_rotary_position_embedding(q, k, v)
+    # v must be rotated the same way as q/k (reference semantics)
+    assert not np.allclose(np.asarray(vo._value), np.asarray(v._value))
+    q2 = fused_rotary_position_embedding(q)
+    np.testing.assert_allclose(np.asarray(q2._value),
+                               np.asarray(qo._value), atol=1e-6)
+
+
+def test_autotune_anonymous_lambdas_do_not_collide():
+    at.clear_cache()
+    t1 = at.autotune(lambda s: (lambda x: x * s), candidates=[(2,)])
+    t2 = at.autotune(lambda s: (lambda x: x + s), candidates=[(3,)])
+    x = jnp.ones((2,))
+    np.testing.assert_allclose(np.asarray(t1(x)), 2.0)
+    np.testing.assert_allclose(np.asarray(t2(x)), 4.0)
+    at.clear_cache()
+
+
+def test_autotune_array_kwargs_hashable():
+    at.clear_cache()
+    tuned = at.autotune(lambda s: (lambda x, bias=None: x * s + bias),
+                        candidates=[(2,)], name="kwop")
+    out = tuned(jnp.ones((2,)), bias=jnp.ones((2,)))
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    at.clear_cache()

@@ -1,0 +1,128 @@
+"""Parameter server tests (≙ test pattern of ps_local_client + the
+dist-table unit tests: in-process server on localhost, numpy checks)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.ps import (PSServer, PSClient, SparseEmbedding,
+                                       DensePSParameter)
+
+
+@pytest.fixture(scope="module")
+def ps():
+    server = PSServer(port=0)
+    client = PSClient("127.0.0.1", server.port)
+    yield server, client
+    client.close()
+    server.stop()
+
+
+def test_dense_table_pull_push(ps):
+    _, client = ps
+    client.create_dense_table(1, 8, init=np.arange(8, dtype=np.float32))
+    np.testing.assert_array_equal(client.pull_dense(1),
+                                  np.arange(8, dtype=np.float32))
+    grad = np.ones(8, np.float32)
+    client.push_dense_grad(1, grad, lr=0.5)
+    np.testing.assert_allclose(client.pull_dense(1),
+                               np.arange(8, dtype=np.float32) - 0.5)
+
+
+def test_sparse_table_create_on_pull_and_sgd(ps):
+    _, client = ps
+    client.create_sparse_table(2, 4, init_scale=0.0)
+    rows = client.pull_sparse(2, np.array([5, 9], np.uint64))
+    np.testing.assert_array_equal(rows, np.zeros((2, 4), np.float32))
+    assert client.sparse_table_size(2) == 2
+    grads = np.ones((2, 4), np.float32)
+    client.push_sparse_grad(2, np.array([5, 9], np.uint64), grads, lr=0.1)
+    rows = client.pull_sparse(2, np.array([5], np.uint64))
+    np.testing.assert_allclose(rows[0], -0.1 * np.ones(4), atol=1e-6)
+
+
+def test_sparse_init_deterministic(ps):
+    _, client = ps
+    client.create_sparse_table(3, 4, init_scale=0.5, seed=7)
+    a = client.pull_sparse(3, np.array([42], np.uint64))
+    b = client.pull_sparse(3, np.array([42], np.uint64))
+    np.testing.assert_array_equal(a, b)
+    assert np.abs(a).max() <= 0.5 and np.abs(a).max() > 0
+
+
+def test_sparse_embedding_layer_trains(ps):
+    _, client = ps
+    emb = SparseEmbedding(client, table_id=10, embedding_dim=4,
+                          learning_rate=0.2, init_scale=0.0)
+    ids = paddle.to_tensor(np.array([[1, 2], [2, 3]], np.int64))
+    out = emb(ids)
+    assert tuple(out.shape) == (2, 2, 4)
+    loss = out.sum()
+    loss.backward()
+    # every pulled row had grad 1 per occurrence; key 2 appears twice ->
+    # summed grad 2; after server SGD: row1 = -0.2, row2 = -0.4, row3=-0.2
+    rows = client.pull_sparse(10, np.array([1, 2, 3], np.uint64))
+    np.testing.assert_allclose(rows[0], -0.2 * np.ones(4), atol=1e-6)
+    np.testing.assert_allclose(rows[1], -0.4 * np.ones(4), atol=1e-6)
+    np.testing.assert_allclose(rows[2], -0.2 * np.ones(4), atol=1e-6)
+
+
+def test_sparse_embedding_in_model(ps):
+    _, client = ps
+    emb = SparseEmbedding(client, table_id=11, embedding_dim=8,
+                          learning_rate=0.05, init_scale=0.01, seed=3)
+    head = nn.Linear(8, 2)
+    opt = optimizer.SGD(learning_rate=0.05, parameters=head.parameters())
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, 50, size=(8, 4)).astype("int64"))
+    labels = paddle.to_tensor(rng.integers(0, 2, size=(8,)).astype("int64"))
+    losses = []
+    for _ in range(5):
+        feats = emb(ids).mean(axis=1)
+        loss = nn.functional.cross_entropy(head(feats), labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]  # both PS rows and local head learn
+
+
+def test_dense_ps_parameter(ps):
+    _, client = ps
+    p = DensePSParameter(client, table_id=20, shape=(2, 3),
+                         learning_rate=0.1,
+                         init=np.ones((2, 3), np.float32))
+    t = p.sync()
+    assert tuple(t.shape) == (2, 3)
+    p.push_grad(np.ones((2, 3), np.float32))
+    np.testing.assert_allclose(np.asarray(p.sync()._value),
+                               0.9 * np.ones((2, 3)), atol=1e-6)
+
+
+def test_multiple_clients_share_tables(ps):
+    server, client = ps
+    client.create_dense_table(30, 4, init=np.zeros(4, np.float32))
+    c2 = PSClient("127.0.0.1", server.port)
+    c2._dense_dims[30] = 4
+    c2.push_dense_grad(30, np.ones(4, np.float32), lr=1.0)
+    np.testing.assert_allclose(client.pull_dense(30), -np.ones(4))
+    c2.close()
+
+
+def test_error_on_missing_table(ps):
+    _, client = ps
+    client._dense_dims[99] = 4
+    with pytest.raises(RuntimeError, match="pull_dense"):
+        client.pull_dense(99)
+
+
+def test_server_stop_with_live_client_no_crash():
+    server = PSServer(port=0)
+    client = PSClient("127.0.0.1", server.port)
+    client.create_dense_table(1, 4)
+    server.stop()  # must join handlers; no UAF when client acts after
+    with pytest.raises((RuntimeError, OSError)):
+        client.pull_dense(1)
+    client.close()
