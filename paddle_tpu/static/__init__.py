@@ -17,11 +17,13 @@ from .program import (  # noqa: F401
     default_main_program, default_startup_program, global_scope,
 )
 from . import nn  # noqa: F401
+from .control_flow import cond, while_loop, case, switch_case  # noqa: F401
 
 __all__ = [
     "InputSpec", "save_inference_model", "load_inference_model",
     "Program", "program_guard", "data", "Executor", "append_backward",
     "default_main_program", "default_startup_program", "global_scope", "nn",
+    "cond", "while_loop", "case", "switch_case",
 ]
 
 

@@ -1,0 +1,173 @@
+"""Control-flow ops: cond / while_loop / case / switch_case.
+
+Capability analogue of ``paddle.static.nn.{cond,while_loop,case,
+switch_case}`` (reference: python/paddle/static/nn/control_flow.py over
+the conditional_block/while C++ ops) — and of the dy2static AST
+transforms whose whole purpose is to rewrite Python ``if``/``while`` into
+these ops.  The TPU-native design: in eager mode the predicate is
+concrete, so the chosen branch simply runs (reference dygraph semantics);
+under a jit trace the predicate is a tracer and the op lowers to
+``lax.cond`` / ``lax.while_loop`` / ``lax.switch`` — XLA's structured
+control flow, which is what the reference's AST transpiler ultimately
+emulates.  Outputs keep their eager types: leaves that the branch
+returned as Tensors come back as Tensors, raw arrays stay raw.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _is_tracer(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _pred_value(pred):
+    return pred._value if isinstance(pred, Tensor) else pred
+
+
+class _StructMeta:
+    """Records the pytree structure + which leaves were Tensors, so the
+    traced path can reconstruct exactly what the eager path returns."""
+
+    def __init__(self):
+        self.treedef = None
+        self.is_tensor = None
+
+    def flatten(self, out):
+        leaves, treedef = jax.tree_util.tree_flatten(
+            out, is_leaf=_is_tensor)
+        if self.treedef is None:
+            self.treedef = treedef
+            self.is_tensor = [_is_tensor(l) for l in leaves]
+        return [l._value if _is_tensor(l) else l for l in leaves]
+
+    def unflatten(self, leaves):
+        rebuilt = [Tensor(v) if t else v
+                   for v, t in zip(leaves, self.is_tensor)]
+        return jax.tree_util.tree_unflatten(self.treedef, rebuilt)
+
+
+def cond(pred, true_fn, false_fn, name=None):
+    """Run ``true_fn()`` if pred else ``false_fn()``.  Both branches must
+    return structures with matching shapes/dtypes when traced."""
+    pv = _pred_value(pred)
+    if not _is_tracer(pv):
+        return true_fn() if bool(pv) else false_fn()
+    meta = _StructMeta()
+    out = lax.cond(jnp.asarray(pv).astype(bool).reshape(()),
+                   lambda _: meta.flatten(true_fn()),
+                   lambda _: meta.flatten(false_fn()),
+                   0)
+    return meta.unflatten(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """While loop over a tuple/list of loop vars.
+
+    cond_fn(*vars) -> bool scalar; body_fn(*vars) -> same-structured vars.
+    Tracedness is decided from the loop vars (a cond_fn that closes over a
+    traced value while all loop vars are concrete is not supported).
+    """
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise TypeError("while_loop: loop_vars must be a non-empty "
+                        "list/tuple")
+    meta = _StructMeta()
+    init = meta.flatten(tuple(loop_vars))
+    traced = any(_is_tracer(l) for l in init)
+    if not traced:
+        vars_ = tuple(loop_vars)
+        while bool(_pred_value(cond_fn(*vars_))):
+            out = body_fn(*vars_)
+            vars_ = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+        return list(vars_)
+
+    def c(carry):
+        pv = _pred_value(cond_fn(*meta.unflatten(carry)))
+        return jnp.asarray(pv).astype(bool).reshape(())
+
+    def b(carry):
+        out = body_fn(*meta.unflatten(carry))
+        out = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+        return meta.flatten(out)
+
+    final = lax.while_loop(c, b, init)
+    return list(meta.unflatten(final))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First pair whose pred is true wins (reference static.nn.case; when
+    ``default`` is None the last pair's fn doubles as the default)."""
+    if not pred_fn_pairs:
+        raise TypeError("case: pred_fn_pairs must be non-empty")
+    pairs = list(pred_fn_pairs)
+    if default is None:
+        # reference semantics: the final fn is the fallback — drop its
+        # predicate so it is not traced twice (once as branch, once as tail)
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+        if not pairs:
+            return default()
+    preds = [_pred_value(p) for p, _ in pairs]
+    if not any(_is_tracer(p) for p in preds):
+        for p, fn in pairs:
+            if bool(_pred_value(p)):
+                return fn()
+        return default()
+    fns = [fn for _, fn in pairs]
+
+    def build(i):
+        if i == len(fns):
+            return default
+        return lambda: cond(Tensor(jnp.asarray(preds[i])), fns[i],
+                            build(i + 1))
+
+    return build(0)()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Dispatch on an integer index (reference static.nn.switch_case).
+    branch_fns: dict {index: fn} or list of (index, fn) or list of fns."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items(), key=lambda kv: kv[0])
+    elif branch_fns and isinstance(branch_fns[0], (list, tuple)):
+        items = sorted(((i, f) for i, f in branch_fns),
+                       key=lambda kv: kv[0])
+    else:
+        items = list(enumerate(branch_fns))
+    seen = set()
+    for k, _ in items:
+        if k in seen:
+            raise ValueError(
+                f"switch_case: duplicate branch index {k}")
+        seen.add(k)
+    idx_v = _pred_value(branch_index)
+    if not _is_tracer(idx_v):
+        i = int(idx_v)
+        for key, fn in items:
+            if key == i:
+                return fn()
+        if default is not None:
+            return default()
+        return items[-1][1]()
+    keys = jnp.asarray([k for k, _ in items])
+    fns = [f for _, f in items]
+    if default is not None:
+        fns = fns + [default]
+    # unmatched index selects the final entry (the default when given,
+    # else the last branch — reference behavior)
+    matches = keys == jnp.asarray(idx_v).reshape(())
+    sel = jnp.where(jnp.any(matches), jnp.argmax(matches), len(fns) - 1)
+    meta = _StructMeta()
+    out = lax.switch(sel, [lambda f=f: meta.flatten(f()) for f in fns])
+    return meta.unflatten(out)

@@ -12,8 +12,11 @@ from ..nn.layer.common import Linear, Embedding
 from ..nn.layer.conv import Conv2D
 from ..nn.layer.norm import BatchNorm2D
 from ..nn import functional as F
+from .control_flow import (cond, while_loop, case,  # noqa: F401
+                           switch_case)
 
-__all__ = ["fc", "embedding", "conv2d", "batch_norm"]
+__all__ = ["fc", "embedding", "conv2d", "batch_norm",
+           "cond", "while_loop", "case", "switch_case"]
 
 
 def fc(x, size, num_flatten_dims=1, activation=None, name=None):

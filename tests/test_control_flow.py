@@ -1,0 +1,157 @@
+"""Control-flow ops (≙ test/legacy_test/test_{cond,while_loop,case,
+switch_case}.py: eager + traced behavior, gradients through branches)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.core.tensor import Tensor
+
+
+def test_cond_eager():
+    x = paddle.to_tensor(np.float32(3.0))
+    out = static.cond(x > 2, lambda: x * 2, lambda: x - 1)
+    assert float(out) == 6.0
+    out = static.cond(x > 5, lambda: x * 2, lambda: x - 1)
+    assert float(out) == 2.0
+
+
+def test_cond_traced_under_jit():
+    def f(xv):
+        x = Tensor(xv)
+        return static.cond(x > 0, lambda: x * 2, lambda: x - 1)._value
+
+    jf = jax.jit(f)
+    assert float(jf(jnp.float32(3.0))) == 6.0
+    assert float(jf(jnp.float32(-3.0))) == -4.0
+
+
+def test_cond_gradient_through_branch():
+    def loss(xv):
+        x = Tensor(xv)
+        out = static.cond(x > 0, lambda: x * x, lambda: -x)
+        return out._value
+
+    g = jax.grad(loss)(jnp.float32(3.0))
+    assert float(g) == 6.0
+    g = jax.grad(loss)(jnp.float32(-3.0))
+    assert float(g) == -1.0
+
+
+def test_while_loop_eager():
+    i = paddle.to_tensor(np.int32(0))
+    s = paddle.to_tensor(np.float32(0.0))
+    i2, s2 = static.while_loop(lambda i, s: i < 5,
+                               lambda i, s: (i + 1, s + float(2.0)),
+                               [i, s])
+    assert int(i2) == 5 and float(s2) == 10.0
+
+
+def test_while_loop_traced():
+    def f(n):
+        i = Tensor(jnp.int32(0))
+        s = Tensor(jnp.float32(0.0))
+        i2, s2 = static.while_loop(
+            lambda i, s: i._value < n,
+            lambda i, s: (Tensor(i._value + 1), Tensor(s._value + 2.0)),
+            [i, s])
+        return s2._value
+
+    out = jax.jit(f)(jnp.int32(7))
+    assert float(out) == 14.0
+
+
+def test_while_loop_validates_loop_vars():
+    with pytest.raises(TypeError, match="loop_vars"):
+        static.while_loop(lambda: True, lambda: (), [])
+
+
+def test_case_eager_and_default():
+    x = paddle.to_tensor(np.float32(1.0))
+    out = static.case([(x > 2, lambda: x * 10), (x > 0, lambda: x + 1)],
+                      default=lambda: x - 99)
+    assert float(out) == 2.0
+    out = static.case([(x > 2, lambda: x * 10), (x > 1.5, lambda: x + 1)],
+                      default=lambda: x - 99)
+    assert float(out) == -98.0
+
+
+def test_case_traced():
+    def f(xv):
+        x = Tensor(xv)
+        return static.case([(x > 2, lambda: x * 10),
+                            (x > 0, lambda: x + 1)],
+                           default=lambda: x - 99)._value
+
+    jf = jax.jit(f)
+    assert float(jf(jnp.float32(3.0))) == 30.0
+    assert float(jf(jnp.float32(1.0))) == 2.0
+    assert float(jf(jnp.float32(-1.0))) == -100.0
+
+
+def test_switch_case_eager():
+    out = static.switch_case(paddle.to_tensor(np.int32(1)),
+                             {0: lambda: paddle.to_tensor(np.float32(10)),
+                              1: lambda: paddle.to_tensor(np.float32(20))})
+    assert float(out) == 20.0
+    # unmatched + default
+    out = static.switch_case(paddle.to_tensor(np.int32(7)),
+                             {0: lambda: paddle.to_tensor(np.float32(10))},
+                             default=lambda: paddle.to_tensor(np.float32(-1)))
+    assert float(out) == -1.0
+
+
+def test_switch_case_traced():
+    def f(iv):
+        return static.switch_case(
+            Tensor(iv),
+            {0: lambda: Tensor(jnp.float32(10.0)),
+             2: lambda: Tensor(jnp.float32(30.0))},
+            default=lambda: Tensor(jnp.float32(-1.0)))._value
+
+    jf = jax.jit(f)
+    assert float(jf(jnp.int32(0))) == 10.0
+    assert float(jf(jnp.int32(2))) == 30.0
+    assert float(jf(jnp.int32(5))) == -1.0
+
+
+def test_cond_with_paddle_ops_inside_branches():
+    # branches that call framework ops (dispatch) must trace cleanly
+    def f(xv):
+        x = Tensor(xv)
+        return static.cond(
+            x.sum() > 0,
+            lambda: paddle.nn.functional.relu(x),
+            lambda: x * 0)._value
+
+    out = jax.jit(f)(jnp.asarray([1.0, -2.0, 4.0], jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), [1.0, 0.0, 4.0])
+
+
+def test_exports_and_nn_alias():
+    from paddle_tpu.static import nn as snn
+    assert snn.cond is static.cond and snn.while_loop is static.while_loop
+    assert "cond" in static.__all__ and "switch_case" in static.__all__
+
+
+def test_switch_case_duplicate_index_rejected():
+    with pytest.raises(ValueError, match="duplicate"):
+        static.switch_case(paddle.to_tensor(np.int32(0)),
+                           [(1, lambda: 1), (1, lambda: 2)])
+
+
+def test_traced_type_consistency_raw_arrays():
+    # raw jnp leaves must come back raw even under trace
+    def f(xv):
+        out = static.cond(Tensor(xv) > 0,
+                          lambda: {"a": xv * 2, "b": Tensor(xv + 1)},
+                          lambda: {"a": xv * 3, "b": Tensor(xv - 1)})
+        assert isinstance(out["b"], Tensor)
+        assert not isinstance(out["a"], Tensor)
+        return out["a"] + out["b"]._value
+
+    assert float(jax.jit(f)(jnp.float32(2.0))) == 7.0
