@@ -1,0 +1,29 @@
+"""Tensor-aware pytree flatten/unflatten helpers.
+
+One shared implementation of the "strip Tensors to jax.Arrays at a trace
+boundary, re-box on the way out" pattern used by jit tracing and the
+structured control-flow ops."""
+
+from __future__ import annotations
+
+import jax
+
+from .tensor import Tensor
+
+
+def is_tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+def flatten_tensors(tree):
+    """-> (raw_leaves, treedef, is_tensor_flags)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree,
+                                                 is_leaf=is_tensor_leaf)
+    flags = [is_tensor_leaf(l) for l in leaves]
+    raw = [l._value if f else l for l, f in zip(leaves, flags)]
+    return raw, treedef, flags
+
+
+def unflatten_tensors(raw_leaves, treedef, flags):
+    rebuilt = [Tensor(v) if f else v for v, f in zip(raw_leaves, flags)]
+    return jax.tree_util.tree_unflatten(treedef, rebuilt)

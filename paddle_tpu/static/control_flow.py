@@ -38,24 +38,30 @@ def _pred_value(pred):
 
 class _StructMeta:
     """Records the pytree structure + which leaves were Tensors, so the
-    traced path can reconstruct exactly what the eager path returns."""
+    traced path can reconstruct exactly what the eager path returns.
+    Every branch must agree on both — mismatches raise instead of being
+    silently coerced to the first branch's typing."""
 
     def __init__(self):
         self.treedef = None
         self.is_tensor = None
 
     def flatten(self, out):
-        leaves, treedef = jax.tree_util.tree_flatten(
-            out, is_leaf=_is_tensor)
+        from ..core.pytree import flatten_tensors
+        raw, treedef, flags = flatten_tensors(out)
         if self.treedef is None:
             self.treedef = treedef
-            self.is_tensor = [_is_tensor(l) for l in leaves]
-        return [l._value if _is_tensor(l) else l for l in leaves]
+            self.is_tensor = flags
+        elif treedef != self.treedef or flags != self.is_tensor:
+            raise ValueError(
+                "control flow: branches must return the same pytree "
+                f"structure and Tensor/raw typing (got {treedef} vs "
+                f"{self.treedef})")
+        return raw
 
     def unflatten(self, leaves):
-        rebuilt = [Tensor(v) if t else v
-                   for v, t in zip(leaves, self.is_tensor)]
-        return jax.tree_util.tree_unflatten(self.treedef, rebuilt)
+        from ..core.pytree import unflatten_tensors
+        return unflatten_tensors(leaves, self.treedef, self.is_tensor)
 
 
 def cond(pred, true_fn, false_fn, name=None):
@@ -138,6 +144,8 @@ def case(pred_fn_pairs, default=None, name=None):
 def switch_case(branch_index, branch_fns, default=None, name=None):
     """Dispatch on an integer index (reference static.nn.switch_case).
     branch_fns: dict {index: fn} or list of (index, fn) or list of fns."""
+    if not branch_fns:
+        raise TypeError("switch_case: branch_fns must be non-empty")
     if isinstance(branch_fns, dict):
         items = sorted(branch_fns.items(), key=lambda kv: kv[0])
     elif branch_fns and isinstance(branch_fns[0], (list, tuple)):

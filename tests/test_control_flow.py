@@ -155,3 +155,18 @@ def test_traced_type_consistency_raw_arrays():
         return out["a"] + out["b"]._value
 
     assert float(jax.jit(f)(jnp.float32(2.0))) == 7.0
+
+
+def test_cond_traced_structure_mismatch_raises():
+    def f(xv):
+        return static.cond(Tensor(xv) > 0,
+                           lambda: (Tensor(xv), xv),
+                           lambda: (xv, Tensor(xv)))
+
+    with pytest.raises(ValueError, match="same pytree"):
+        jax.jit(lambda v: f(v) and v)(jnp.float32(1.0))
+
+
+def test_switch_case_empty_rejected():
+    with pytest.raises(TypeError, match="non-empty"):
+        static.switch_case(paddle.to_tensor(np.int32(0)), [])

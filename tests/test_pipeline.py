@@ -181,3 +181,44 @@ def test_interleaved_gradients_match_serial():
     g_ref = jax.grad(serial_loss)(stacked, xs)
     np.testing.assert_allclose(np.asarray(g_pp["w"]),
                                np.asarray(g_ref["w"]), atol=1e-4)
+
+
+def test_pipeline_composes_with_dp_and_tp_axes():
+    """4D-story composition (BASELINE config 5): pipeline manual over
+    "pipe", GSPMD auto over "data" (batch) and "model" (weight columns)
+    on one 2x2x2 mesh."""
+    from jax.sharding import NamedSharding
+    from paddle_tpu.distributed.pipeline_engine import (pipeline_apply,
+                                                        stack_stage_params)
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("data", "pipe", "model"))
+    n_stages, n_micro, b, d = 2, 4, 4, 16
+    rng = np.random.default_rng(7)
+    per_stage = [{"w1": jnp.asarray(rng.standard_normal((d, 2 * d)) * 0.1,
+                                    jnp.float32),
+                  "w2": jnp.asarray(rng.standard_normal((2 * d, d)) * 0.1,
+                                    jnp.float32)}
+                 for _ in range(n_stages)]
+
+    def stage_fn(params, x):
+        h = jnp.tanh(x @ params["w1"])   # column-parallel over "model"
+        return h @ params["w2"]          # row-parallel contraction
+
+    stacked = stack_stage_params(per_stage)
+    # pin TP shardings: w1 [S, d, 2d] cols over "model"; w2 rows over it
+    stacked = {
+        "w1": jax.device_put(stacked["w1"], NamedSharding(
+            mesh, PartitionSpec("pipe", None, "model"))),
+        "w2": jax.device_put(stacked["w2"], NamedSharding(
+            mesh, PartitionSpec("pipe", "model", None))),
+    }
+    xs = jnp.asarray(rng.standard_normal((n_micro, b, d)), jnp.float32)
+    xs = jax.device_put(xs, NamedSharding(
+        mesh, PartitionSpec(None, "data", None)))  # batch over "data"
+
+    ys = jax.jit(lambda p, x: pipeline_apply(stage_fn, p, x, n_stages,
+                                             mesh))(stacked, xs)
+    ref = xs
+    for sp in per_stage:
+        ref = jnp.tanh(ref @ sp["w1"]) @ sp["w2"]
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(ref), atol=1e-5)
