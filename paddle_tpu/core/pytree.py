@@ -1,8 +1,9 @@
 """Tensor-aware pytree flatten/unflatten helpers.
 
 One shared implementation of the "strip Tensors to jax.Arrays at a trace
-boundary, re-box on the way out" pattern used by jit tracing and the
-structured control-flow ops."""
+boundary, re-box on the way out" pattern.  Currently used by the
+structured control-flow ops; the older inline copies in jit/api.py and
+jit/train_step.py should migrate here as they are touched."""
 
 from __future__ import annotations
 

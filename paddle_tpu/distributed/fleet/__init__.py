@@ -15,10 +15,13 @@ from .utils import sequence_parallel_utils  # noqa: F401
 from . import recompute as recompute_mod  # noqa: F401
 from . import elastic  # noqa: F401
 from .recompute import recompute, recompute_sequential, recompute_hybrid  # noqa: F401
+from .role_maker import (PaddleCloudRoleMaker, UserDefinedRoleMaker,  # noqa: F401
+                         Role)
 
 __all__ = ["Fleet", "fleet", "init", "DistributedStrategy",
            "distributed_model", "distributed_optimizer",
            "get_hybrid_communicate_group", "meta_parallel",
            "ColumnParallelLinear", "RowParallelLinear",
            "VocabParallelEmbedding", "ParallelCrossEntropy",
-           "recompute", "recompute_sequential", "recompute_hybrid"]
+           "recompute", "recompute_sequential", "recompute_hybrid",
+           "PaddleCloudRoleMaker", "UserDefinedRoleMaker", "Role"]

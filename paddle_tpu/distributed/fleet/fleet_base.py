@@ -55,9 +55,26 @@ class Fleet:
         self._hcg: Optional[HybridCommunicateGroup] = None
         self._strategy: Optional[DistributedStrategy] = None
         self._user_defined_optimizer = None
+        self._role_maker = None
+        self._ps_server = None
+        self._ps_client = None
 
     def init(self, role_maker=None, is_collective=True, strategy=None,
              log_level="INFO"):
+        if role_maker is None and not is_collective:
+            # reference default: PS mode constructs a cloud role maker
+            # reading the launcher's env contract
+            from .role_maker import PaddleCloudRoleMaker
+            role_maker = PaddleCloudRoleMaker()
+        self._role_maker = role_maker
+        if role_maker is not None and not is_collective \
+                and not getattr(role_maker, "_is_collective", False):
+            # parameter-server mode (reference: the_one_ps workflow —
+            # servers call init_server()/run_server(), workers
+            # init_worker() then train; tables live on the native PS)
+            self._strategy = strategy or DistributedStrategy()
+            self._is_initialized = True
+            return self
         self._strategy = strategy or DistributedStrategy()
         hc = self._strategy.hybrid_configs
         dp = int(hc.get("dp_degree", 1))
@@ -111,8 +128,64 @@ class Fleet:
     def barrier_worker(self):
         pass
 
+    # ---- parameter-server mode (reference fleet PS surface) ----
+    def is_server(self):
+        return (self._role_maker is not None
+                and self._role_maker.is_server())
+
+    def is_worker(self):
+        return self._role_maker is None or self._role_maker.is_worker()
+
+    def init_server(self, *args, **kwargs):
+        """Start the native parameter server on this node's endpoint."""
+        from ..ps import PSServer
+        if not self.is_server():
+            raise RuntimeError("init_server() called on a non-server role")
+        ep = self._role_maker._current_endpoint
+        port = int(ep.rsplit(":", 1)[1]) if ":" in ep else 0
+        self._ps_server = PSServer(port)
+        return self._ps_server
+
+    def run_server(self):
+        """Reference run_server blocks serving requests; our native server
+        serves from its own threads, so this just asserts liveness and
+        returns the server handle for the caller to hold."""
+        if self._ps_server is None:
+            raise RuntimeError("run_server() before init_server()")
+        return self._ps_server
+
+    def init_worker(self, *args, **kwargs):
+        """Connect a PS client to the first configured server endpoint."""
+        from ..ps import PSClient
+        eps = (self._role_maker.get_pserver_endpoints()
+               if self._role_maker else [])
+        if not eps:
+            raise RuntimeError(
+                "init_worker(): no PADDLE_PSERVERS_IP_PORT_LIST endpoints")
+        if len(eps) > 1:
+            raise NotImplementedError(
+                "init_worker(): table sharding across multiple parameter "
+                f"servers is not supported yet (got {len(eps)} endpoints); "
+                "launch with --server_num 1")
+        host, port = eps[0].rsplit(":", 1)
+        self._ps_client = PSClient(host, int(port))
+        return self._ps_client
+
+    def ps_client(self):
+        if self._ps_client is None:
+            raise RuntimeError("PS client not initialized; call "
+                               "fleet.init_worker() first")
+        return self._ps_client
+
     def stop_worker(self):
-        pass
+        if self._ps_client is not None:
+            self._ps_client.close()
+            self._ps_client = None
+
+    def stop_server(self):
+        if self._ps_server is not None:
+            self._ps_server.stop()
+            self._ps_server = None
 
 
 fleet = Fleet()

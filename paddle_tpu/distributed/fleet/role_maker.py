@@ -1,0 +1,66 @@
+"""Role makers for parameter-server mode.
+
+Reference parity: ``python/paddle/distributed/fleet/base/role_maker.py``
+(PaddleCloudRoleMaker reads TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST /
+PADDLE_TRAINERS_NUM etc. from the launch environment).
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class PaddleCloudRoleMaker:
+    """Reads the launch CLI's env contract (reference role_maker env keys)."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+        role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self._role = Role.SERVER if role == "PSERVER" else Role.WORKER
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = [e for e in eps.split(",") if e]
+        self._worker_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._worker_index = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT",
+                                                "")
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._worker_index == 0
+
+    def worker_num(self):
+        return self._worker_num
+
+    def worker_index(self):
+        return self._worker_index
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit role assignment (reference UserDefinedRoleMaker)."""
+
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None, **kwargs):
+        self._is_collective = False
+        self._role = role
+        self._server_endpoints = list(server_endpoints or [])
+        self._worker_num = worker_num
+        self._worker_index = current_id
+        self._current_endpoint = ""

@@ -35,6 +35,12 @@ def _parse(argv):
     p.add_argument("--log_dir", default="log", help="worker log directory")
     p.add_argument("--max_restart", type=int, default=0,
                    help="elastic: restart failed workers up to N times")
+    p.add_argument("--server_num", type=int, default=0,
+                   help="PS mode: number of parameter-server processes "
+                        "(reference ps controller)")
+    p.add_argument("--trainer_num", type=int, default=None,
+                   help="PS mode: trainer process count "
+                        "(default nproc_per_node)")
     p.add_argument("script", help="training script to run")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -103,8 +109,92 @@ def _spawn_workers(args):
     return rc
 
 
+def _free_port():
+    # bind-then-close has a small TOCTOU window before the server rebinds;
+    # the server process fails fast (nonzero exit) on a stolen port and
+    # kill-on-first-failure below surfaces it instead of hanging
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_ps(args):
+    """PS controller (reference launch/controllers/ps.py): spawn server
+    procs (TRAINING_ROLE=PSERVER) then trainer procs with the server
+    endpoint list in the env contract."""
+    os.makedirs(args.log_dir, exist_ok=True)
+    if args.server_num > 1:
+        raise SystemExit(
+            "--server_num > 1: table sharding across multiple parameter "
+            "servers is not supported yet; use --server_num 1")
+    n_trainers = args.trainer_num or args.nproc_per_node
+    endpoints = [f"127.0.0.1:{_free_port()}"
+                 for _ in range(args.server_num)]
+    procs, logs = [], []
+
+    def start(role, idx, extra_env):
+        logf = open(os.path.join(args.log_dir,
+                                 f"{role.lower()}log.{idx}"), "ab")
+        env = _worker_env(args, idx)
+        env["TRAINING_ROLE"] = role
+        env["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(endpoints)
+        env["PADDLE_TRAINERS_NUM"] = str(n_trainers)
+        env.update(extra_env)
+        cmd = [sys.executable, "-u", args.script] + list(args.script_args)
+        procs.append(subprocess.Popen(cmd, env=env, stdout=logf,
+                                      stderr=subprocess.STDOUT))
+        logs.append(logf)
+
+    for i, ep in enumerate(endpoints):
+        start("PSERVER", i, {"PADDLE_CURRENT_ENDPOINT": ep})
+    for i in range(n_trainers):
+        start("TRAINER", i, {"PADDLE_TRAINER_ID": str(i)})
+
+    # job is done when every TRAINER exits; first failure (trainer OR
+    # server) kills the rest — a hung peer must not deadlock the launcher
+    trainer_procs = list(procs[args.server_num:])
+    server_procs = list(procs[:args.server_num])
+    rc = 0
+    try:
+        live = list(trainer_procs)
+        while live:
+            for pr in list(live):
+                r = pr.poll()
+                if r is None:
+                    continue
+                live.remove(pr)
+                if r != 0 and rc == 0:
+                    rc = r
+                    for other in live:
+                        other.send_signal(signal.SIGTERM)
+            for pr in server_procs:
+                r = pr.poll()
+                if r is not None and r != 0 and rc == 0:
+                    # a server died mid-job: the trainers can never finish
+                    rc = r
+                    for other in live:
+                        other.send_signal(signal.SIGTERM)
+            time.sleep(0.2)
+    finally:
+        for pr in trainer_procs:
+            if pr.poll() is None:
+                pr.send_signal(signal.SIGTERM)
+        for pr in server_procs:
+            pr.send_signal(signal.SIGTERM)
+        for pr in procs:
+            pr.wait()
+        for f in logs:
+            f.close()
+    return rc
+
+
 def launch(argv=None):
     args = _parse(sys.argv[1:] if argv is None else argv)
+    if args.server_num > 0:
+        return _spawn_ps(args)
     attempt = 0
     while True:
         if args.nproc_per_node <= 1 and args.max_restart == 0:

@@ -46,17 +46,23 @@ class _StructMeta:
         self.treedef = None
         self.is_tensor = None
 
-    def flatten(self, out):
+    def flatten(self, out, coerce_flags=False):
+        """coerce_flags: accept Tensor/raw typing differences and keep the
+        recorded typing (the while-loop carry contract: the body may box
+        raw init vars into Tensors); structure differences always raise."""
         from ..core.pytree import flatten_tensors
         raw, treedef, flags = flatten_tensors(out)
         if self.treedef is None:
             self.treedef = treedef
             self.is_tensor = flags
-        elif treedef != self.treedef or flags != self.is_tensor:
+        elif treedef != self.treedef:
             raise ValueError(
                 "control flow: branches must return the same pytree "
-                f"structure and Tensor/raw typing (got {treedef} vs "
-                f"{self.treedef})")
+                f"structure (got {treedef} vs {self.treedef})")
+        elif flags != self.is_tensor and not coerce_flags:
+            raise ValueError(
+                "control flow: branches must agree on which leaves are "
+                f"Tensors vs raw arrays (got {flags} vs {self.is_tensor})")
         return raw
 
     def unflatten(self, leaves):
@@ -105,7 +111,7 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
     def b(carry):
         out = body_fn(*meta.unflatten(carry))
         out = tuple(out) if isinstance(out, (list, tuple)) else (out,)
-        return meta.flatten(out)
+        return meta.flatten(out, coerce_flags=True)
 
     final = lax.while_loop(c, b, init)
     return list(meta.unflatten(final))

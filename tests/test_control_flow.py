@@ -163,10 +163,24 @@ def test_cond_traced_structure_mismatch_raises():
                            lambda: (Tensor(xv), xv),
                            lambda: (xv, Tensor(xv)))
 
-    with pytest.raises(ValueError, match="same pytree"):
+    with pytest.raises(ValueError, match="same pytree|Tensors vs raw"):
         jax.jit(lambda v: f(v) and v)(jnp.float32(1.0))
 
 
 def test_switch_case_empty_rejected():
     with pytest.raises(TypeError, match="non-empty"):
         static.switch_case(paddle.to_tensor(np.int32(0)), [])
+
+
+def test_while_loop_body_may_box_raw_init():
+    # body returning Tensors for raw-array init vars (carry coercion)
+    def f(n):
+        out = static.while_loop(
+            lambda i: Tensor(i) < n if not isinstance(i, Tensor) else i < n,
+            lambda i: (Tensor((i if not isinstance(i, Tensor)
+                               else i._value) + 1),),
+            [jnp.int32(0)])
+        v = out[0]
+        return v._value if isinstance(v, Tensor) else v
+
+    assert int(jax.jit(f)(jnp.int32(3))) == 3
