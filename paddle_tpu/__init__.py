@@ -58,6 +58,7 @@ from . import onnx  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .framework import random as framework_random  # noqa: F401
 from .hapi.model import Model  # noqa: F401
+from .hapi.dynamic_flops import flops  # noqa: F401
 from .autograd.py_layer import PyLayer  # noqa: F401
 
 grad = _tape_grad

@@ -98,14 +98,20 @@ class Fleet:
         return self
 
     def is_first_worker(self):
+        if self._role_maker is not None:
+            return self._role_maker.is_first_worker()
         from ..env import get_rank
         return get_rank() == 0
 
     def worker_index(self):
+        if self._role_maker is not None:
+            return self._role_maker.worker_index()
         from ..env import get_rank
         return get_rank()
 
     def worker_num(self):
+        if self._role_maker is not None:
+            return self._role_maker.worker_num()
         from ..env import get_world_size
         return get_world_size()
 
@@ -146,12 +152,17 @@ class Fleet:
         self._ps_server = PSServer(port)
         return self._ps_server
 
-    def run_server(self):
-        """Reference run_server blocks serving requests; our native server
-        serves from its own threads, so this just asserts liveness and
-        returns the server handle for the caller to hold."""
+    def run_server(self, block=True, poll_interval_s=0.5):
+        """Blocks serving requests until ``stop_server()`` (or process
+        signal) — reference run_server semantics: the canonical server
+        script is ``init_server(); run_server()`` with nothing after.
+        Pass ``block=False`` to only assert liveness (in-process tests)."""
         if self._ps_server is None:
             raise RuntimeError("run_server() before init_server()")
+        if block:
+            import time as _time
+            while self._ps_server is not None:
+                _time.sleep(poll_interval_s)
         return self._ps_server
 
     def init_worker(self, *args, **kwargs):

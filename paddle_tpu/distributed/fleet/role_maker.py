@@ -63,4 +63,9 @@ class UserDefinedRoleMaker(PaddleCloudRoleMaker):
         self._server_endpoints = list(server_endpoints or [])
         self._worker_num = worker_num
         self._worker_index = current_id
-        self._current_endpoint = ""
+        # a server's own endpoint is its slot in the server list
+        # (reference UserDefinedRoleMaker semantics)
+        if role == Role.SERVER and current_id < len(self._server_endpoints):
+            self._current_endpoint = self._server_endpoints[current_id]
+        else:
+            self._current_endpoint = ""

@@ -130,7 +130,15 @@ def _spawn_ps(args):
         raise SystemExit(
             "--server_num > 1: table sharding across multiple parameter "
             "servers is not supported yet; use --server_num 1")
-    n_trainers = args.trainer_num or args.nproc_per_node
+    if args.nnodes > 1:
+        raise SystemExit(
+            "PS mode (--server_num) is single-node only for now; "
+            "multi-node PS needs externally visible server endpoints")
+    n_trainers = (args.trainer_num if args.trainer_num is not None
+                  else args.nproc_per_node)
+    if n_trainers < 1:
+        raise SystemExit("PS mode needs at least one trainer "
+                         f"(got --trainer_num {args.trainer_num})")
     endpoints = [f"127.0.0.1:{_free_port()}"
                  for _ in range(args.server_num)]
     procs, logs = [], []

@@ -1,0 +1,98 @@
+"""FLOPs counting (reference: ``python/paddle/hapi/dynamic_flops.py``
+``paddle.flops(net, input_size)``): forward-hook based per-layer MAC
+counting for the common layer types, with a printable table."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn import Layer
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv1D, Conv2D, Conv3D
+from ..nn.layer.norm import LayerNorm, _BatchNormBase
+from ..nn.layer.pooling import AdaptiveAvgPool2D, AvgPool2D, MaxPool2D
+
+__all__ = ["flops"]
+
+
+def _numel(shape):
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def _conv_flops(layer, inp, out):
+    # MACs = out_elems * (in_channels/groups * prod(kernel))
+    kernel = _numel(layer.weight.shape[2:])
+    cin_g = layer.weight.shape[1]
+    return _numel(out.shape) * cin_g * kernel
+
+
+def _linear_flops(layer, inp, out):
+    return _numel(out.shape) * layer.weight.shape[0]
+
+
+def _norm_flops(layer, inp, out):
+    return 2 * _numel(inp.shape)
+
+
+def _pool_flops(layer, inp, out):
+    return _numel(inp.shape)
+
+
+_HANDLERS = [
+    ((Conv1D, Conv2D, Conv3D), _conv_flops),
+    ((Linear,), _linear_flops),
+    ((_BatchNormBase, LayerNorm), _norm_flops),
+    ((AvgPool2D, MaxPool2D, AdaptiveAvgPool2D), _pool_flops),
+]
+
+
+def flops(net: Layer, input_size, custom_ops=None, print_detail=False):
+    """Count forward MAC-FLOPs of ``net`` on a zero batch of
+    ``input_size`` (reference ``paddle.flops``).  custom_ops:
+    {LayerType: fn(layer, input, output) -> flops} extends/overrides the
+    builtin handlers."""
+    import paddle_tpu as paddle
+
+    custom_ops = custom_ops or {}
+    records = []
+    hooks = []
+
+    def make_hook(layer, handler):
+        def hook(l, inputs, output):
+            inp = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+            records.append((type(layer).__name__,
+                            int(handler(layer, inp, output))))
+        return hook
+
+    for sub in net.sublayers(include_self=True):
+        handler = custom_ops.get(type(sub))
+        if handler is None:
+            for types, h in _HANDLERS:
+                if isinstance(sub, types):
+                    handler = h
+                    break
+        if handler is not None:
+            hooks.append(sub.register_forward_post_hook(
+                make_hook(sub, handler)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        x = paddle.to_tensor(np.zeros(tuple(input_size), np.float32))
+        net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(f for _, f in records)
+    if print_detail:
+        for name, f in records:
+            print(f"  {name}: {f:,}")
+    print(f"Total Flops: {total}     Total Params: "
+          f"{sum(p.size for p in net.parameters())}")
+    return total

@@ -45,11 +45,13 @@ class _StructMeta:
     def __init__(self):
         self.treedef = None
         self.is_tensor = None
+        self.out_is_tensor = None  # body's typing (while-loop outputs)
 
     def flatten(self, out, coerce_flags=False):
-        """coerce_flags: accept Tensor/raw typing differences and keep the
-        recorded typing (the while-loop carry contract: the body may box
-        raw init vars into Tensors); structure differences always raise."""
+        """coerce_flags: accept Tensor/raw typing differences in the loop
+        carry (the body may box raw init vars into Tensors); the body's
+        typing is remembered so the final outputs match what the eager
+        loop would return.  Structure differences always raise."""
         from ..core.pytree import flatten_tensors
         raw, treedef, flags = flatten_tensors(out)
         if self.treedef is None:
@@ -63,11 +65,16 @@ class _StructMeta:
             raise ValueError(
                 "control flow: branches must agree on which leaves are "
                 f"Tensors vs raw arrays (got {flags} vs {self.is_tensor})")
+        if coerce_flags:
+            self.out_is_tensor = flags
         return raw
 
-    def unflatten(self, leaves):
+    def unflatten(self, leaves, final=False):
         from ..core.pytree import unflatten_tensors
-        return unflatten_tensors(leaves, self.treedef, self.is_tensor)
+        flags = (self.out_is_tensor
+                 if final and self.out_is_tensor is not None
+                 else self.is_tensor)
+        return unflatten_tensors(leaves, self.treedef, flags)
 
 
 def cond(pred, true_fn, false_fn, name=None):
@@ -114,7 +121,8 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
         return meta.flatten(out, coerce_flags=True)
 
     final = lax.while_loop(c, b, init)
-    return list(meta.unflatten(final))
+    # outputs carry the body's typing (what the eager loop returns)
+    return list(meta.unflatten(final, final=True))
 
 
 def case(pred_fn_pairs, default=None, name=None):

@@ -158,9 +158,11 @@ def slogdet(x, name=None):
 
 
 def svd(x, full_matrices=False, name=None):
+    """Returns (U, S, VH) with x = U @ diag(S) @ VH (reference
+    python/paddle/tensor/linalg.py:2000 — VH is the conjugate transpose
+    of V)."""
     def impl(a):
-        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
-        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+        return jnp.linalg.svd(a, full_matrices=full_matrices)
 
     return dispatch("svd", impl, (x,))
 

@@ -184,3 +184,27 @@ def test_while_loop_body_may_box_raw_init():
         return v._value if isinstance(v, Tensor) else v
 
     assert int(jax.jit(f)(jnp.int32(3))) == 3
+
+
+def test_while_loop_traced_output_typing_matches_eager():
+    def body(i):
+        return (Tensor((i._value if isinstance(i, Tensor) else i) + 1),)
+
+    def cond_fn(i):
+        v = i._value if isinstance(i, Tensor) else i
+        return Tensor(v < 2)
+
+    # eager: body returns Tensor -> output is Tensor
+    out_eager = static.while_loop(cond_fn, body, [jnp.int32(0)])
+    assert isinstance(out_eager[0], Tensor)
+
+    # traced: must also be Tensor (body typing, not init typing)
+    kinds = []
+
+    def f(n):
+        out = static.while_loop(cond_fn, body, [jnp.int32(0) + 0 * n])
+        kinds.append(isinstance(out[0], Tensor))
+        return out[0]._value if isinstance(out[0], Tensor) else out[0]
+
+    jax.jit(f)(jnp.int32(1))
+    assert kinds == [True]

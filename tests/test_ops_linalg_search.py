@@ -1,0 +1,215 @@
+"""Single-op correctness for linalg/search/stat ops through the OpTest
+harness (SURVEY §4 backbone: numpy references + numeric grad checks)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import linalg, search, stat, math as tmath
+from op_test import check_output, check_grad
+
+
+def _rand(*shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape) \
+        .astype(np.float32)
+
+
+def _spd(n, seed=0):
+    a = _rand(n, n, seed=seed)
+    return (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+
+# ----------------------------------------------------------------- linalg
+
+def test_cholesky_and_solve():
+    a = _spd(4)
+    check_output(lambda x: linalg.cholesky(x), np.linalg.cholesky, [a])
+    b = _rand(4, 2, seed=1)
+    check_output(lambda x, y: linalg.solve(x, y), np.linalg.solve, [a, b])
+
+
+def test_det_slogdet_inv():
+    a = _spd(3)
+    check_output(lambda x: linalg.det(x), np.linalg.det, [a])
+    check_output(lambda x: linalg.inv(x), np.linalg.inv, [a])
+    sign, logdet = linalg.slogdet(paddle.to_tensor(a))
+    s_ref, l_ref = np.linalg.slogdet(a)
+    assert np.isclose(float(sign), s_ref) and \
+        np.isclose(float(logdet), l_ref, atol=1e-5)
+
+
+def test_svd_qr_reconstruction():
+    a = _rand(5, 3, seed=2)
+    u, s, vh = linalg.svd(paddle.to_tensor(a))
+    rec = np.asarray(u._value) @ np.diag(np.asarray(s._value)) \
+        @ np.asarray(vh._value)
+    np.testing.assert_allclose(rec, a, atol=1e-5)
+    q, r = linalg.qr(paddle.to_tensor(a))
+    np.testing.assert_allclose(np.asarray(q._value) @ np.asarray(r._value),
+                               a, atol=1e-5)
+    # Q orthonormal
+    np.testing.assert_allclose(
+        np.asarray(q._value).T @ np.asarray(q._value), np.eye(3), atol=1e-5)
+
+
+def test_eigh_eigvalsh():
+    a = _spd(4, seed=3)
+    w, v = linalg.eigh(paddle.to_tensor(a))
+    w_ref = np.linalg.eigvalsh(a)
+    np.testing.assert_allclose(np.sort(np.asarray(w._value)),
+                               np.sort(w_ref), atol=1e-4)
+    rec = (np.asarray(v._value) * np.asarray(w._value)[None, :]) \
+        @ np.asarray(v._value).T
+    np.testing.assert_allclose(rec, a, atol=1e-4)
+    check_output(lambda x: linalg.eigvalsh(x), np.linalg.eigvalsh, [a],
+                 atol=1e-4)
+
+
+def test_matrix_power_rank_pinv():
+    a = _spd(3, seed=4)
+    check_output(lambda x: linalg.matrix_power(x, 3),
+                 lambda x: np.linalg.matrix_power(x, 3), [a], atol=1e-2,
+                 rtol=1e-4)
+    assert int(linalg.matrix_rank(paddle.to_tensor(a))) == 3
+    p = linalg.pinv(paddle.to_tensor(a))
+    np.testing.assert_allclose(np.asarray(p._value), np.linalg.pinv(a),
+                               atol=1e-4)
+
+
+def test_triangular_solve_and_lstsq():
+    a = np.triu(_spd(3, seed=5)).astype(np.float32)
+    b = _rand(3, 1, seed=6)
+    out = linalg.triangular_solve(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(a @ np.asarray(out._value), b, atol=1e-4)
+    A = _rand(6, 3, seed=7)
+    y = _rand(6, 1, seed=8)
+    sol = linalg.lstsq(paddle.to_tensor(A), paddle.to_tensor(y))[0]
+    ref = np.linalg.lstsq(A, y, rcond=None)[0]
+    np.testing.assert_allclose(np.asarray(sol._value), ref, atol=1e-4)
+
+
+def test_norms_and_grad():
+    x = _rand(4, 5, seed=9)
+    check_output(lambda a: linalg.norm(a),
+                 lambda a: np.linalg.norm(a), [x])
+    check_output(lambda a: linalg.norm(a, p=1, axis=1),
+                 lambda a: np.abs(a).sum(axis=1), [x])
+    check_grad(lambda a: linalg.norm(a), [x])
+
+
+def test_cross_dot_mv_bmm():
+    a = _rand(3, seed=10)
+    b = _rand(3, seed=11)
+    check_output(lambda x, y: linalg.cross(x, y), np.cross, [a, b])
+    check_output(lambda x, y: linalg.dot(x, y), np.dot, [a, b])
+    m = _rand(2, 3, 4, seed=12)
+    n = _rand(2, 4, 5, seed=13)
+    check_output(lambda x, y: linalg.bmm(x, y), np.matmul, [m, n])
+    v = _rand(4, seed=14)
+    check_output(lambda x, y: linalg.mv(x, y), np.matmul, [m[0], v])
+
+
+def test_cov_corrcoef():
+    x = _rand(3, 50, seed=15)
+    check_output(lambda a: linalg.cov(a), np.cov, [x], atol=1e-4)
+    check_output(lambda a: linalg.corrcoef(a), np.corrcoef, [x], atol=1e-4)
+
+
+# ----------------------------------------------------------------- search
+
+def test_topk_argsort_searchsorted():
+    x = _rand(4, 8, seed=16)
+    vals, idx = search.topk(paddle.to_tensor(x), k=3, axis=-1)
+    ref = np.sort(x, axis=-1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(np.asarray(vals._value), ref, atol=1e-6)
+    check_output(lambda a: search.argsort(a, axis=-1),
+                 lambda a: np.argsort(a, axis=-1, kind="stable"), [x])
+    sorted_seq = np.sort(_rand(10, seed=17))
+    queries = _rand(4, seed=18)
+    check_output(lambda a, q: search.searchsorted(a, q),
+                 lambda a, q: np.searchsorted(a, q), [sorted_seq, queries])
+
+
+def test_argmax_argmin_where_masked():
+    x = _rand(3, 4, seed=19)
+    check_output(lambda a: search.argmax(a, axis=1),
+                 lambda a: np.argmax(a, axis=1), [x])
+    check_output(lambda a: search.argmin(a, axis=0),
+                 lambda a: np.argmin(a, axis=0), [x])
+    cond_np = (x > 0)
+    y = _rand(3, 4, seed=20)
+    out = search.where(paddle.to_tensor(cond_np), paddle.to_tensor(x),
+                       paddle.to_tensor(y))
+    np.testing.assert_allclose(np.asarray(out._value),
+                               np.where(cond_np, x, y))
+
+
+def test_kthvalue_mode():
+    x = _rand(2, 7, seed=21)
+    vals, _ = search.kthvalue(paddle.to_tensor(x), k=3, axis=-1)
+    ref = np.sort(x, axis=-1)[:, 2]
+    np.testing.assert_allclose(np.asarray(vals._value), ref, atol=1e-6)
+
+
+# ------------------------------------------------------------------- stat
+
+def test_median_quantile_nan_variants():
+    x = _rand(4, 6, seed=22)
+    check_output(lambda a: stat.median(a, axis=1),
+                 lambda a: np.median(a, axis=1), [x], atol=1e-6)
+    check_output(lambda a: stat.quantile(a, 0.25, axis=1),
+                 lambda a: np.quantile(a, 0.25, axis=1), [x], atol=1e-5)
+    xn = x.copy()
+    xn[0, 0] = np.nan
+    check_output(lambda a: stat.nanmedian(a, axis=1),
+                 lambda a: np.nanmedian(a, axis=1), [xn], atol=1e-6)
+
+
+def test_std_var_numel():
+    x = _rand(3, 5, seed=23)
+    check_output(lambda a: stat.std(a, axis=1),
+                 lambda a: np.std(a, axis=1, ddof=1), [x], atol=1e-5)
+    check_output(lambda a: stat.var(a, axis=1),
+                 lambda a: np.var(a, axis=1, ddof=1), [x], atol=1e-5)
+    assert int(stat.numel(paddle.to_tensor(x))) == 15
+
+
+# ----------------------------------------------------- math grads (numeric)
+
+@pytest.mark.parametrize("op,ref", [
+    ("log1p", np.log1p),
+    ("expm1", np.expm1),
+    ("atan", np.arctan),
+    ("sinh", np.sinh),
+    ("erf", None),
+])
+def test_unary_op_grads(op, ref):
+    x = np.abs(_rand(3, 4, seed=24)) * 0.5 + 0.1
+    fn = getattr(tmath, op)
+    if ref is not None:
+        check_output(lambda a: fn(a), ref, [x.astype(np.float32)])
+    check_grad(lambda a: fn(a), [x.astype(np.float32)])
+
+
+# ------------------------------------------------------------------- flops
+
+def test_paddle_flops_counts_conv_and_linear():
+    from paddle_tpu import nn
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                        nn.AdaptiveAvgPool2D(1), nn.Flatten(),
+                        nn.Linear(8, 10))
+    total = paddle.flops(net, (1, 3, 16, 16))
+    # conv: 16*16*8 out elems * 3*3*3 macs = 55296; linear: 10*8 = 80
+    assert total == 16 * 16 * 8 * 27 + 8 * 10 + 16 * 16 * 8  # + pool reads
+
+
+def test_paddle_flops_custom_op():
+    from paddle_tpu import nn
+
+    class Custom(nn.Layer):
+        def forward(self, x):
+            return x
+
+    net = nn.Sequential(Custom())
+    total = paddle.flops(net, (1, 4), custom_ops={Custom: lambda l, i, o: 42})
+    assert total == 42

@@ -141,7 +141,7 @@ def test_fleet_ps_mode_roundtrip():
     server_fleet.init(role_maker=rm_s, is_collective=False)
     assert server_fleet.is_server() and not server_fleet.is_worker()
     srv = server_fleet.init_server()
-    assert server_fleet.run_server() is srv
+    assert server_fleet.run_server(block=False) is srv
 
     # worker side (same process; endpoints point at the live server)
     worker_fleet = Fleet()
@@ -198,8 +198,7 @@ def test_launch_ps_mode_end_to_end(tmp_path):
         fleet.init(role_maker=PaddleCloudRoleMaker(), is_collective=False)
         if fleet.is_server():
             fleet.init_server()
-            fleet.run_server()
-            time.sleep(30)  # killed by the launcher when trainers finish
+            fleet.run_server()  # blocks until the launcher terminates us
         else:
             # wait for the server socket
             client = None
@@ -221,13 +220,15 @@ def test_launch_ps_mode_end_to_end(tmp_path):
     env = dict(_os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""
-    env["PYTHONPATH"] = "/root/repo"
+    repo_root = _os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root
     proc = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--server_num", "1", "--trainer_num", "1",
          "--log_dir", log_dir, str(script)],
         capture_output=True, text=True, timeout=300, env=env,
-        cwd="/root/repo")
+        cwd=repo_root)
     trainer_log = open(_os.path.join(log_dir, "trainerlog.0")).read()
     assert proc.returncode == 0, (proc.stdout, proc.stderr, trainer_log)
     assert "TRAINER_OK" in trainer_log
