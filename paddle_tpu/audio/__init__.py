@@ -4,5 +4,6 @@ functional, backends)."""
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
 from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
 from .features import (Spectrogram, MelSpectrogram, LogMelSpectrogram,
                        MFCC)  # noqa: F401

@@ -97,21 +97,32 @@ class Fleet:
         self._is_initialized = True
         return self
 
+    def _ps_role_maker(self):
+        # only PS-mode role makers own the worker topology; collective
+        # runs keep using the process-group rank/world
+        rm = self._role_maker
+        if rm is not None and not getattr(rm, "_is_collective", False):
+            return rm
+        return None
+
     def is_first_worker(self):
-        if self._role_maker is not None:
-            return self._role_maker.is_first_worker()
+        rm = self._ps_role_maker()
+        if rm is not None:
+            return rm.is_first_worker()
         from ..env import get_rank
         return get_rank() == 0
 
     def worker_index(self):
-        if self._role_maker is not None:
-            return self._role_maker.worker_index()
+        rm = self._ps_role_maker()
+        if rm is not None:
+            return rm.worker_index()
         from ..env import get_rank
         return get_rank()
 
     def worker_num(self):
-        if self._role_maker is not None:
-            return self._role_maker.worker_num()
+        rm = self._ps_role_maker()
+        if rm is not None:
+            return rm.worker_num()
         from ..env import get_world_size
         return get_world_size()
 

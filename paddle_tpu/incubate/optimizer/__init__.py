@@ -1,0 +1,8 @@
+"""Incubate optimizers (reference: ``python/paddle/incubate/optimizer/
+{lookahead.py,modelaverage.py}``): LookAhead slow/fast weights and
+ModelAverage EMA-style parameter averaging with apply/restore."""
+
+from .lookahead import LookAhead
+from .modelaverage import ModelAverage
+
+__all__ = ["LookAhead", "ModelAverage"]

@@ -1,0 +1,70 @@
+"""LookAhead optimizer (arXiv:1907.08610; reference
+python/paddle/incubate/optimizer/lookahead.py): every k inner steps the
+slow weights move toward the fast weights by alpha, and the fast weights
+are reset to the slow weights."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["LookAhead"]
+
+
+class LookAhead:
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner_optimizer cannot be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError(f"k must be a positive integer, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_count = 0
+        # slow weights snapshot at construction (reference initializes the
+        # slow copies from the current params)
+        self._slow = {id(p): np.asarray(p._value).copy()
+                      for p in inner_optimizer._parameter_list}
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k:
+            return
+        for p in self.inner_optimizer._parameter_list:
+            slow = self._slow.get(id(p))
+            if slow is None:  # param added after construction
+                slow = np.asarray(p._value).copy()
+            fast = np.asarray(p._value)
+            slow = slow + self.alpha * (fast - slow)
+            self._slow[id(p)] = slow
+            p.set_value(jnp.asarray(slow, dtype=p._value.dtype))
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def state_dict(self):
+        """Round-trippable: slow weights are saved per parameter index
+        (the reference keeps them as optimizer accumulators for the same
+        reason)."""
+        sd = self.inner_optimizer.state_dict()
+        sd["@LOOKAHEAD_step"] = self._step_count
+        for i, p in enumerate(self.inner_optimizer._parameter_list):
+            slow = self._slow.get(id(p))
+            if slow is not None:
+                sd[f"@LOOKAHEAD_slow_{i}"] = np.asarray(slow)
+        return sd
+
+    def set_state_dict(self, state_dict):
+        state_dict = dict(state_dict)
+        self._step_count = int(state_dict.pop("@LOOKAHEAD_step", 0))
+        for i, p in enumerate(self.inner_optimizer._parameter_list):
+            slow = state_dict.pop(f"@LOOKAHEAD_slow_{i}", None)
+            if slow is not None:
+                self._slow[id(p)] = np.asarray(slow)
+        self.inner_optimizer.set_state_dict(state_dict)

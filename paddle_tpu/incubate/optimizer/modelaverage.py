@@ -1,0 +1,72 @@
+"""ModelAverage (reference python/paddle/incubate/optimizer/
+modelaverage.py): maintains running sums of parameter values over a
+sliding window; ``apply()`` swaps averaged weights in for evaluation and
+``restore()`` puts the trained weights back."""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ModelAverage"]
+
+
+class ModelAverage:
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("ModelAverage requires an explicit parameter "
+                             "list in this framework")
+        self.avg_rate = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window
+        self._params = list(parameters)
+        self._sum = {id(p): np.zeros_like(np.asarray(p._value),
+                                          dtype=np.float64)
+                     for p in self._params}
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current parameter values (call after
+        optimizer.step()).  The window restarts once max_average_window
+        samples have accumulated, keeping the running average as one
+        sample (simplified form of the reference num_updates rule)."""
+        self._count += 1
+        for p in self._params:
+            self._sum[id(p)] += np.asarray(p._value, dtype=np.float64)
+        if self._count >= self.max_window:
+            for p in self._params:
+                self._sum[id(p)] = self._sum[id(p)] / self._count
+            self._count = 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context-manager friendly)."""
+        if self._count == 0:
+            raise RuntimeError("ModelAverage.apply() before any step()")
+        self._backup = {id(p): np.asarray(p._value).copy()
+                        for p in self._params}
+        for p in self._params:
+            avg = self._sum[id(p)] / self._count
+            p.set_value(jnp.asarray(avg, dtype=p._value.dtype))
+        if need_restore:
+            return self._restore_ctx()
+        return None
+
+    @contextmanager
+    def _restore_ctx(self):
+        try:
+            yield
+        finally:
+            self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p.set_value(jnp.asarray(self._backup[id(p)],
+                                    dtype=p._value.dtype))
+        self._backup = None

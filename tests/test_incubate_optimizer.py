@@ -1,0 +1,108 @@
+"""Incubate optimizers (≙ test/legacy_test/test_{lookahead,modelaverage}.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage
+
+
+def _setup(lr=0.1):
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    opt = optimizer.SGD(learning_rate=lr, parameters=net.parameters())
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((8, 4)).astype(np.float32))
+    y = paddle.to_tensor(np.random.default_rng(1)
+                         .integers(0, 2, size=(8,)).astype("int64"))
+    return net, opt, x, y
+
+
+def test_lookahead_validates_args():
+    net, opt, *_ = _setup()
+    with pytest.raises(ValueError, match="alpha"):
+        LookAhead(opt, alpha=2.0)
+    with pytest.raises(ValueError, match="k must"):
+        LookAhead(opt, k=0)
+
+
+def test_lookahead_slow_update_every_k():
+    net, opt, x, y = _setup()
+    la = LookAhead(opt, alpha=0.5, k=2)
+    w0 = np.asarray(net.weight._value).copy()
+    losses = []
+    for i in range(4):
+        loss = nn.functional.cross_entropy(net(x), y)
+        loss.backward()
+        la.step()
+        la.clear_grad()
+        losses.append(float(loss))
+    # after step 2 and 4 the weights are slow-interpolated; training works
+    assert losses[-1] < losses[0]
+    assert not np.allclose(np.asarray(net.weight._value), w0)
+
+
+def test_lookahead_k_boundary_resets_fast_to_slow():
+    net, opt, x, y = _setup(lr=1.0)
+    la = LookAhead(opt, alpha=0.0, k=1)  # alpha=0: slow never moves
+    w0 = np.asarray(net.weight._value).copy()
+    loss = nn.functional.cross_entropy(net(x), y)
+    loss.backward()
+    la.step()
+    # alpha=0 & k=1: fast is reset to the initial slow weights every step
+    np.testing.assert_allclose(np.asarray(net.weight._value), w0, atol=1e-7)
+
+
+def test_model_average_apply_restore():
+    net, opt, x, y = _setup()
+    ma = ModelAverage(0.15, parameters=net.parameters(),
+                      min_average_window=2, max_average_window=10)
+    snapshots = []
+    for _ in range(3):
+        loss = nn.functional.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        ma.step()
+        snapshots.append(np.asarray(net.weight._value).copy())
+    trained = np.asarray(net.weight._value).copy()
+    expected_avg = np.mean(snapshots, axis=0)
+    with ma.apply():
+        np.testing.assert_allclose(np.asarray(net.weight._value),
+                                   expected_avg, atol=1e-6)
+    # restored after the context
+    np.testing.assert_allclose(np.asarray(net.weight._value), trained,
+                               atol=1e-7)
+
+
+def test_model_average_requires_steps():
+    net, opt, *_ = _setup()
+    ma = ModelAverage(0.15, parameters=net.parameters())
+    with pytest.raises(RuntimeError, match="before any step"):
+        ma.apply()
+
+
+def test_lookahead_state_dict_roundtrip():
+    net, opt, x, y = _setup()
+    la = LookAhead(opt, alpha=0.5, k=3)
+    for _ in range(2):
+        loss = nn.functional.cross_entropy(net(x), y)
+        loss.backward()
+        la.step()
+        la.clear_grad()
+    sd = la.state_dict()
+    assert sd["@LOOKAHEAD_step"] == 2
+    assert any(k.startswith("@LOOKAHEAD_slow_") for k in sd)
+
+    net2 = nn.Linear(4, 2)
+    net2.set_state_dict(net.state_dict())
+    opt2 = optimizer.SGD(learning_rate=0.1, parameters=net2.parameters())
+    la2 = LookAhead(opt2, alpha=0.5, k=3)
+    la2.set_state_dict(sd)
+    assert la2._step_count == 2
+    # slow weights restored, not re-snapshotted from fast
+    p0 = la2.inner_optimizer._parameter_list[0]
+    np.testing.assert_allclose(
+        la2._slow[id(p0)],
+        la._slow[id(la.inner_optimizer._parameter_list[0])])
