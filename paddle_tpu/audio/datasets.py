@@ -49,7 +49,7 @@ class _SyntheticAudio(Dataset):
                            + 0.1 * rng.standard_normal((self.size, n))
                            ).astype(np.float32)
 
-    def _featurize(self, wave):
+    def _featurize(self, wave, sr):
         if self.feat_type == "raw":
             return wave
         from .features import (LogMelSpectrogram, MFCC, MelSpectrogram,
@@ -64,19 +64,21 @@ class _SyntheticAudio(Dataset):
         if cls is Spectrogram:  # sr-agnostic (no mel scale)
             layer = cls(**self._feat_kwargs)
         else:
-            layer = cls(sr=self.sample_rate, **self._feat_kwargs)
+            layer = cls(sr=sr, **self._feat_kwargs)
         feat = layer(paddle.to_tensor(wave[None]))
         return np.asarray(feat._value)[0]
 
     def __getitem__(self, idx):
         if self._files is not None:
-            wave_t, _ = backends.load(self._files[idx])
+            # use each file's real sample rate for the mel scale
+            wave_t, sr = backends.load(self._files[idx])
             wave = np.asarray(wave_t._value)[0]
             label = idx % self.num_classes  # caller remaps real labels
         else:
             wave = self._waves[idx]
+            sr = self.sample_rate
             label = int(self._labels[idx])
-        return self._featurize(wave), np.int64(label)
+        return self._featurize(wave, sr), np.int64(label)
 
     def __len__(self):
         return self.size

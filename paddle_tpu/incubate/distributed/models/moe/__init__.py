@@ -1,4 +1,6 @@
 from .gate import GShardGate, NaiveGate, SwitchGate, TopKGate
 from .moe_layer import MoELayer
+from .grad_clip import ClipGradForMOEByGlobalNorm
 
-__all__ = ["MoELayer", "NaiveGate", "SwitchGate", "GShardGate", "TopKGate"]
+__all__ = ["MoELayer", "NaiveGate", "SwitchGate", "GShardGate", "TopKGate",
+           "ClipGradForMOEByGlobalNorm"]

@@ -1,0 +1,65 @@
+"""MoE-aware global-norm gradient clipping.
+
+Reference parity: ``python/paddle/incubate/distributed/models/moe/
+grad_clip.py`` (ClipGradForMOEByGlobalNorm): expert parameters live only
+on their expert-parallel rank, so their squared norms must be summed
+across the moe group before being combined with the (replicated)
+non-expert norm — clipping every rank with the same global norm.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor
+from .....nn.clip import ClipGradByGlobalNorm
+
+__all__ = ["ClipGradForMOEByGlobalNorm"]
+
+
+def _is_expert_param(p, is_expert_param_func=None):
+    if is_expert_param_func is not None:
+        return bool(is_expert_param_func(p))
+    return bool(getattr(p, "_is_expert", False) or
+                "expert" in (getattr(p, "name", "") or ""))
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    """Global-norm clip where expert-parameter norms are all-reduced over
+    ``moe_group`` before combining:
+    ``global_norm = sqrt(norm_normal^2 + sum_group(norm_expert^2))``."""
+
+    def __init__(self, clip_norm, is_expert_param_func=None, moe_group=None,
+                 group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name)
+        self._is_expert = is_expert_param_func
+        self._moe_group = moe_group
+
+    def _clip(self, params_grads):
+        normal, expert = [], []
+        for p, g in params_grads:
+            (expert if _is_expert_param(p, self._is_expert)
+             else normal).append((p, g))
+        sq_normal = self._global_norm_sq(normal)
+        sq_expert = self._global_norm_sq(expert)
+        if sq_normal is None and sq_expert is None:
+            return params_grads
+        if sq_expert is not None and self._moe_group is not None \
+                and getattr(self._moe_group, "nranks", 1) > 1:
+            # all_reduce mutates the tensor in place and returns a task
+            from .....distributed import all_reduce
+            t = Tensor(sq_expert)
+            all_reduce(t, group=self._moe_group)
+            sq_expert = t._value
+        sq = (sq_normal if sq_normal is not None else 0.0) + \
+             (sq_expert if sq_expert is not None else 0.0)
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._value * scale)
+                                  .astype(g._value.dtype))))
+        return out

@@ -29,6 +29,8 @@ class LookAhead:
                       for p in inner_optimizer._parameter_list}
 
     def __getattr__(self, name):
+        if name == "inner_optimizer":  # empty instance dict (unpickling)
+            raise AttributeError(name)
         return getattr(self.inner_optimizer, name)
 
     def step(self):

@@ -28,17 +28,24 @@ class ModelAverage:
                                           dtype=np.float64)
                      for p in self._params}
         self._count = 0
+        self._total_steps = 0
         self._backup = None
+
+    def _window(self) -> int:
+        """Reference num_updates rule: window grows with training length
+        (rate * total steps), clamped to [min, max]."""
+        w = int(self._total_steps * self.avg_rate)
+        return max(self.min_window, min(self.max_window, max(w, 1)))
 
     def step(self):
         """Accumulate the current parameter values (call after
-        optimizer.step()).  The window restarts once max_average_window
-        samples have accumulated, keeping the running average as one
-        sample (simplified form of the reference num_updates rule)."""
+        optimizer.step()).  The window restarts once ``_window()`` samples
+        have accumulated, keeping the running average as one sample."""
         self._count += 1
+        self._total_steps += 1
         for p in self._params:
             self._sum[id(p)] += np.asarray(p._value, dtype=np.float64)
-        if self._count >= self.max_window:
+        if self._count >= self._window():
             for p in self._params:
                 self._sum[id(p)] = self._sum[id(p)] / self._count
             self._count = 1
@@ -47,6 +54,10 @@ class ModelAverage:
         """Swap averaged weights in (context-manager friendly)."""
         if self._count == 0:
             raise RuntimeError("ModelAverage.apply() before any step()")
+        if self._backup is not None:
+            raise RuntimeError(
+                "ModelAverage.apply() called twice without restore(); the "
+                "trained weights would be lost")
         self._backup = {id(p): np.asarray(p._value).copy()
                         for p in self._params}
         for p in self._params:

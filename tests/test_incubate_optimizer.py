@@ -57,7 +57,7 @@ def test_lookahead_k_boundary_resets_fast_to_slow():
 def test_model_average_apply_restore():
     net, opt, x, y = _setup()
     ma = ModelAverage(0.15, parameters=net.parameters(),
-                      min_average_window=2, max_average_window=10)
+                      min_average_window=10, max_average_window=20)
     snapshots = []
     for _ in range(3):
         loss = nn.functional.cross_entropy(net(x), y)
@@ -106,3 +106,34 @@ def test_lookahead_state_dict_roundtrip():
     np.testing.assert_allclose(
         la2._slow[id(p0)],
         la._slow[id(la.inner_optimizer._parameter_list[0])])
+
+
+def test_model_average_double_apply_guarded():
+    net, opt, x, y = _setup()
+    ma = ModelAverage(0.15, parameters=net.parameters(),
+                      min_average_window=10, max_average_window=20)
+    loss = nn.functional.cross_entropy(net(x), y)
+    loss.backward(); opt.step(); opt.clear_grad(); ma.step()
+    ma.apply(need_restore=False)
+    with pytest.raises(RuntimeError, match="twice"):
+        ma.apply()
+    ma.restore()
+
+
+def test_model_average_window_restart():
+    net, opt, x, y = _setup()
+    # window 1: every step restarts, folding the running average in as one
+    # sample -> recursive average avg_t = (avg_{t-1} + s_t) / 2
+    ma = ModelAverage(0.001, parameters=net.parameters(),
+                      min_average_window=1, max_average_window=2)
+    snaps = []
+    for _ in range(3):
+        loss = nn.functional.cross_entropy(net(x), y)
+        loss.backward(); opt.step(); opt.clear_grad(); ma.step()
+        snaps.append(np.asarray(net.weight._value).copy())
+    expected = snaps[0]
+    for s_ in snaps[1:]:
+        expected = (expected + s_) / 2
+    with ma.apply():
+        np.testing.assert_allclose(np.asarray(net.weight._value),
+                                   expected, atol=1e-6)

@@ -108,3 +108,41 @@ def test_moe_aux_loss_gradient_flows():
     g = layer.gate.gate.weight.grad
     assert g is not None
     assert float(g.abs().sum()) > 0
+
+
+def test_moe_grad_clip_matches_global_norm_locally():
+    import numpy as np
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.incubate.distributed.models.moe import (
+        ClipGradForMOEByGlobalNorm)
+    from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+    rng = np.random.default_rng(0)
+    params = []
+    for i, is_exp in enumerate([False, True, True]):
+        p = Tensor(rng.standard_normal(4).astype(np.float32),
+                   stop_gradient=False)
+        p.name = f"expert_{i}" if is_exp else f"dense_{i}"
+        g = Tensor(rng.standard_normal(4).astype(np.float32))
+        params.append((p, g))
+    clipped_moe = ClipGradForMOEByGlobalNorm(0.5)._clip(params)
+    clipped_ref = ClipGradByGlobalNorm(0.5)._clip(params)
+    # without a multi-rank moe group the result equals plain global norm
+    for (p1, g1), (p2, g2) in zip(clipped_moe, clipped_ref):
+        np.testing.assert_allclose(np.asarray(g1._value),
+                                   np.asarray(g2._value), atol=1e-6)
+    total = np.sqrt(sum(float((np.asarray(g._value) ** 2).sum())
+                        for _, g in clipped_moe))
+    assert total <= 0.5 + 1e-5
+
+
+def test_moe_grad_clip_custom_predicate():
+    import numpy as np
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.incubate.distributed.models.moe import (
+        ClipGradForMOEByGlobalNorm)
+    p = Tensor(np.ones(2, np.float32), stop_gradient=False)
+    g = Tensor(np.full(2, 10.0, np.float32))
+    clip = ClipGradForMOEByGlobalNorm(
+        1.0, is_expert_param_func=lambda prm: True)
+    (p2, g2), = clip._clip([(p, g)])
+    assert float(np.linalg.norm(np.asarray(g2._value))) <= 1.0 + 1e-6
