@@ -53,13 +53,4 @@ class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
             sq_expert = t._value
         sq = (sq_normal if sq_normal is not None else 0.0) + \
              (sq_expert if sq_expert is not None else 0.0)
-        global_norm = jnp.sqrt(sq)
-        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
-        out = []
-        for p, g in params_grads:
-            if g is None or not getattr(p, "need_clip", True):
-                out.append((p, g))
-                continue
-            out.append((p, Tensor((g._value * scale)
-                                  .astype(g._value.dtype))))
-        return out
+        return self._apply_scale(params_grads, sq)

@@ -51,6 +51,10 @@ class MoELayer(Layer):
                                           is_bias=True)
         self.b_out = self.create_parameter((num_experts, 1, d_model),
                                            is_bias=True)
+        # mark expert params by name so ClipGradForMOEByGlobalNorm's
+        # default predicate ("expert" in name) classifies them correctly
+        for attr in ("w_in", "w_out", "b_in", "b_out"):
+            getattr(self, attr).name = f"moe_expert_{attr}"
         if expert_axis is not None:
             from .....distributed.topology import get_global_mesh
             mesh = get_global_mesh()

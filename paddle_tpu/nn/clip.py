@@ -64,10 +64,9 @@ class ClipGradByGlobalNorm(ClipGradBase):
             sq = s if sq is None else sq + s
         return sq
 
-    def _clip(self, params_grads):
-        sq = self._global_norm_sq(params_grads)
-        if sq is None:
-            return params_grads
+    def _apply_scale(self, params_grads, sq):
+        """Scale every clippable grad by clip_norm / max(||g||, clip_norm)
+        computed from the given squared global norm."""
         global_norm = jnp.sqrt(sq)
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
         out = []
@@ -77,6 +76,12 @@ class ClipGradByGlobalNorm(ClipGradBase):
                 continue
             out.append((p, Tensor((g._value * scale).astype(g._value.dtype))))
         return out
+
+    def _clip(self, params_grads):
+        sq = self._global_norm_sq(params_grads)
+        if sq is None:
+            return params_grads
+        return self._apply_scale(params_grads, sq)
 
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0,

@@ -17,6 +17,7 @@ from .program import (  # noqa: F401
     default_main_program, default_startup_program, global_scope,
 )
 from . import nn  # noqa: F401
+from . import quantization  # noqa: F401
 from .control_flow import cond, while_loop, case, switch_case  # noqa: F401
 
 __all__ = [

@@ -1,0 +1,42 @@
+"""Static-graph quantization namespace (reference:
+``python/paddle/static/quantization``: PTQ/QAT for static programs).
+
+In this framework the static path is traced-and-compiled from the same
+layers, so static quantization IS the quantization package applied before
+tracing: quantize/convert the model with ``paddle.quantization`` and then
+``paddle.jit.save`` / ``Program`` capture the QDQ (or int8) graph.  The
+reference class names are provided as thin aliases so ported code finds
+them."""
+
+from __future__ import annotations
+
+from ..quantization import PTQ, QAT, QuantConfig
+from ..quantization.observers import (AbsmaxObserver,
+                                      MovingAverageAbsmaxObserver,
+                                      PerChannelAbsmaxObserver)
+
+__all__ = ["PTQ", "QAT", "QuantConfig", "quant_post_static",
+           "AbsmaxObserver", "MovingAverageAbsmaxObserver",
+           "PerChannelAbsmaxObserver"]
+
+
+def quant_post_static(model, calibration_loader, batch_nums=10,
+                      activation_observer=None, weight_bits=8):
+    """Post-training static quantization driver (reference
+    quant_post_static): calibrate on ``batch_nums`` batches and return
+    the converted int8 model."""
+    from ..quantization.config import quanter_factory
+
+    obs = activation_observer or AbsmaxObserver
+    ptq = PTQ(QuantConfig(
+        activation=obs,
+        weight=quanter_factory(PerChannelAbsmaxObserver,
+                               bit_length=weight_bits)))
+    # the caller's fp32 model stays untouched (reference semantics)
+    qmodel = ptq.quantize(model, inplace=False)
+    for i, batch in enumerate(calibration_loader):
+        if i >= batch_nums:
+            break
+        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+        qmodel(x)
+    return ptq.convert(qmodel)

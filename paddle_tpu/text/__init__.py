@@ -3,5 +3,6 @@ text dataset scaffolding; the reference's dataset downloads are gated on
 network, here they raise with a clear message in this air-gapped build)."""
 
 from .viterbi import viterbi_decode, ViterbiDecoder  # noqa: F401
+from . import datasets  # noqa: F401
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]

@@ -1,0 +1,90 @@
+"""Text datasets (reference: ``python/paddle/text/datasets/{imdb.py,
+uci_housing.py,conll05.py}``).  Zero-egress environment: synthetic data
+with the reference datasets' shapes/label spaces, generated
+deterministically — tokenized-sequence and regression pipelines exercise
+the same code paths as the real downloads."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "UCIHousing", "Conll05st"]
+
+
+class Imdb(Dataset):
+    """Binary sentiment over token-id sequences (vocab 5149 like the
+    real IMDB vocabulary after cutoff; fixed-length padded)."""
+
+    vocab_size = 5149
+    seq_len = 128
+
+    def __init__(self, mode="train", cutoff=150, size=None, seed=0):
+        self.mode = mode
+        self.size = size or (512 if mode == "train" else 128)
+        rng = np.random.default_rng(seed + (0 if mode == "train" else 1))
+        self.docs = rng.integers(1, self.vocab_size,
+                                 (self.size, self.seq_len)).astype(np.int64)
+        self.labels = rng.integers(0, 2, (self.size,)).astype(np.int64)
+        # plant a weak signal so classifiers can learn: positive docs get
+        # more of token 7
+        mask = self.labels == 1
+        self.docs[mask, :8] = 7
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return self.size
+
+
+class UCIHousing(Dataset):
+    """13-feature housing regression (reference feature count)."""
+
+    feature_dim = 13
+
+    def __init__(self, mode="train", size=None, seed=0):
+        self.mode = mode
+        self.size = size or (404 if mode == "train" else 102)
+        rng = np.random.default_rng(seed + (0 if mode == "train" else 1))
+        self.features = rng.standard_normal(
+            (self.size, self.feature_dim)).astype(np.float32)
+        w = rng.standard_normal(self.feature_dim).astype(np.float32)
+        self.labels = (self.features @ w +
+                       0.1 * rng.standard_normal(self.size)) \
+            .astype(np.float32)[:, None]
+
+    def __getitem__(self, idx):
+        return self.features[idx], self.labels[idx]
+
+    def __len__(self):
+        return self.size
+
+
+class Conll05st(Dataset):
+    """SRL-style sequence labeling: (word_ids, predicate, label_ids)
+    (reference conll05 schema, synthetic)."""
+
+    word_dict_len = 44068
+    label_dict_len = 59
+    predicate_dict_len = 3162
+    seq_len = 32
+
+    def __init__(self, mode="train", size=None, seed=0):
+        self.mode = mode
+        self.size = size or (256 if mode == "train" else 64)
+        rng = np.random.default_rng(seed + (0 if mode == "train" else 1))
+        self.words = rng.integers(0, self.word_dict_len,
+                                  (self.size, self.seq_len)).astype(np.int64)
+        self.predicates = rng.integers(0, self.predicate_dict_len,
+                                       (self.size,)).astype(np.int64)
+        self.labels = rng.integers(0, self.label_dict_len,
+                                   (self.size, self.seq_len)) \
+            .astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self.words[idx], self.predicates[idx], self.labels[idx]
+
+    def __len__(self):
+        return self.size

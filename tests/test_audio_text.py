@@ -175,3 +175,33 @@ def test_audio_dataset_spectrogram_feat_type():
     ds = TESS(mode="train", size=2, feat_type="spectrogram", n_fft=256)
     feat, _ = ds[0]
     assert feat.shape[0] == 129  # n_fft//2 + 1 freq bins
+
+
+def test_text_datasets_shapes_and_training_signal():
+    from paddle_tpu.text.datasets import Imdb, UCIHousing, Conll05st
+    imdb = Imdb(mode="train", size=32)
+    doc, label = imdb[0]
+    assert doc.shape == (128,) and label in (0, 1)
+    uci = UCIHousing(mode="test", size=16)
+    feat, y = uci[3]
+    assert feat.shape == (13,) and y.shape == (1,)
+    srl = Conll05st(size=8)
+    w, p, l = srl[0]
+    assert w.shape == (32,) and l.shape == (32,) and p.shape == ()
+
+
+def test_uci_housing_linear_regression_learns():
+    from paddle_tpu.text.datasets import UCIHousing
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.io import DataLoader
+    ds = UCIHousing(mode="train", size=64)
+    net = nn.Linear(13, 1)
+    opt = optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+    loader = DataLoader(ds, batch_size=32, shuffle=False)
+    first = last = None
+    for _ in range(5):
+        for feats, ys in loader:
+            loss = nn.functional.mse_loss(net(feats), ys)
+            loss.backward(); opt.step(); opt.clear_grad()
+            first = first or float(loss); last = float(loss)
+    assert last < first

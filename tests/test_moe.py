@@ -146,3 +146,14 @@ def test_moe_grad_clip_custom_predicate():
         1.0, is_expert_param_func=lambda prm: True)
     (p2, g2), = clip._clip([(p, g)])
     assert float(np.linalg.norm(np.asarray(g2._value))) <= 1.0 + 1e-6
+
+
+def test_moe_layer_params_marked_as_expert():
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    from paddle_tpu.incubate.distributed.models.moe.grad_clip import (
+        _is_expert_param)
+    layer = MoELayer(d_model=8, d_hidden=16, num_experts=2)
+    expert_params = [p for p in layer.parameters()
+                     if _is_expert_param(p)]
+    # all four stacked expert tensors are detected; gate weights are not
+    assert len(expert_params) == 4

@@ -205,3 +205,24 @@ def test_ptq_honors_weight_bits():
     assert layer._bits == 4
     qw = np.asarray(layer.qweight._value)
     assert qw.max() <= 7 and qw.min() >= -7  # int4 range
+
+
+def test_static_quant_post_static():
+    from paddle_tpu.static.quantization import quant_post_static
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.dataset import TensorDataset
+    model = _net()
+    model.eval()
+    rng = np.random.default_rng(11)
+    xs = paddle.to_tensor(rng.standard_normal((16, 3, 8, 8))
+                          .astype(np.float32))
+    try:
+        ds = TensorDataset([xs])
+        loader = DataLoader(ds, batch_size=4)
+    except Exception:
+        loader = [(xs[i * 4:(i + 1) * 4],) for i in range(4)]
+    qmodel = quant_post_static(model, loader, batch_nums=3)
+    names = [type(l).__name__ for l in qmodel.sublayers()]
+    assert "QuantizedConv2DInfer" in names and "QuantizedLinearInfer" in names
+    out = qmodel(xs[:2])
+    assert np.all(np.isfinite(np.asarray(out._value)))
