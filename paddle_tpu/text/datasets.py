@@ -22,7 +22,7 @@ class Imdb(Dataset):
 
     def __init__(self, mode="train", cutoff=150, size=None, seed=0):
         self.mode = mode
-        self.size = size or (512 if mode == "train" else 128)
+        self.size = (512 if mode == "train" else 128) if size is None else size
         rng = np.random.default_rng(seed + (0 if mode == "train" else 1))
         self.docs = rng.integers(1, self.vocab_size,
                                  (self.size, self.seq_len)).astype(np.int64)
@@ -46,7 +46,7 @@ class UCIHousing(Dataset):
 
     def __init__(self, mode="train", size=None, seed=0):
         self.mode = mode
-        self.size = size or (404 if mode == "train" else 102)
+        self.size = (404 if mode == "train" else 102) if size is None else size
         rng = np.random.default_rng(seed + (0 if mode == "train" else 1))
         self.features = rng.standard_normal(
             (self.size, self.feature_dim)).astype(np.float32)
@@ -73,7 +73,7 @@ class Conll05st(Dataset):
 
     def __init__(self, mode="train", size=None, seed=0):
         self.mode = mode
-        self.size = size or (256 if mode == "train" else 64)
+        self.size = (256 if mode == "train" else 64) if size is None else size
         rng = np.random.default_rng(seed + (0 if mode == "train" else 1))
         self.words = rng.integers(0, self.word_dict_len,
                                   (self.size, self.seq_len)).astype(np.int64)

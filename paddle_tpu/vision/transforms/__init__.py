@@ -96,3 +96,205 @@ class RandomCrop:
         top = np.random.randint(0, arr.shape[0] - h + 1)
         left = np.random.randint(0, arr.shape[1] - w + 1)
         return arr[top:top + h, left:left + w]
+
+
+class CenterCrop:
+    """Crop the central region (reference transforms.CenterCrop)."""
+
+    def __init__(self, size):
+        self.size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = self.size
+        if h > arr.shape[0] or w > arr.shape[1]:
+            raise ValueError(
+                f"CenterCrop size {self.size} exceeds image shape "
+                f"{arr.shape[:2]}")
+        top = (arr.shape[0] - h) // 2
+        left = (arr.shape[1] - w) // 2
+        return arr[top:top + h, left:left + w]
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[::-1].copy()
+        return img
+
+
+class Pad:
+    """Pad HWC/HW images on all (or per-side) borders."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, int):
+            padding = (padding, padding, padding, padding)  # l, t, r, b
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding
+        self.fill = fill
+        self.mode = padding_mode
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        l, t, r, b = self.padding
+        pad = [(t, b), (l, r)] + ([(0, 0)] if arr.ndim == 3 else [])
+        if self.mode == "constant":
+            return np.pad(arr, pad, constant_values=self.fill)
+        return np.pad(arr, pad, mode=self.mode)
+
+
+class Grayscale:
+    """HWC RGB -> grayscale with the ITU-R 601 luma weights."""
+
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        if arr.ndim == 2:
+            gray = arr
+        else:
+            gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+                    + 0.114 * arr[..., 2])
+        gray = gray.astype(np.asarray(img).dtype)
+        if self.num_output_channels == 3:
+            return np.stack([gray] * 3, axis=-1)
+        return gray[..., None]
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img).astype(np.float32)
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        return np.clip(arr * alpha, 0, 255).astype(np.asarray(img).dtype)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img).astype(np.float32)
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        mean = arr.mean()
+        return np.clip(mean + alpha * (arr - mean), 0, 255) \
+            .astype(np.asarray(img).dtype)
+
+
+class SaturationTransform:
+    """Blend with the grayscale image (standard saturation jitter)."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img).astype(np.float32)
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+                + 0.114 * arr[..., 2])[..., None]
+        return np.clip(gray + alpha * (arr - gray), 0, 255) \
+            .astype(np.asarray(img).dtype)
+
+
+class HueTransform:
+    """Shift hue in HSV space (value in [0, 0.5], reference range)."""
+
+    def __init__(self, value):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img).astype(np.float32) / 255.0
+        r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+        maxc = arr.max(-1)
+        minc = arr.min(-1)
+        v = maxc
+        span = np.where(maxc > 0, maxc - minc, 0.0)
+        s_ = np.where(maxc > 0, span / np.maximum(maxc, 1e-12), 0.0)
+        safe = np.maximum(span, 1e-12)
+        rc = (maxc - r) / safe
+        gc = (maxc - g) / safe
+        bc = (maxc - b) / safe
+        h = np.where(r == maxc, bc - gc,
+                     np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+        h = (h / 6.0) % 1.0
+        h = (h + np.random.uniform(-self.value, self.value)) % 1.0
+        i = (h * 6.0).astype(np.int32) % 6
+        f = h * 6.0 - np.floor(h * 6.0)
+        p_ = v * (1.0 - s_)
+        q_ = v * (1.0 - s_ * f)
+        t_ = v * (1.0 - s_ * (1.0 - f))
+        choices = [(v, t_, p_), (q_, v, p_), (p_, v, t_),
+                   (p_, q_, v), (t_, p_, v), (v, p_, q_)]
+        out = np.zeros_like(arr)
+        for idx, (rr, gg, bb) in enumerate(choices):
+            m = i == idx
+            out[..., 0][m] = rr[m]
+            out[..., 1][m] = gg[m]
+            out[..., 2][m] = bb[m]
+        return np.clip(out * 255.0, 0, 255).astype(np.asarray(img).dtype)
+
+
+class ColorJitter:
+    """Brightness/contrast/saturation/hue jitter (reference ColorJitter;
+    saturation blends with luma, hue shifts in HSV)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class RandomResizedCrop:
+    """Random area/aspect crop then resize (reference semantics,
+    nearest-neighbor resize)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = size if isinstance(size, (tuple, list)) else (size, size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        ih, iw = arr.shape[0], arr.shape[1]
+        area = ih * iw
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            aspect = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                              np.log(self.ratio[1])))
+            w = int(round(np.sqrt(target * aspect)))
+            h = int(round(np.sqrt(target / aspect)))
+            if 0 < w <= iw and 0 < h <= ih:
+                top = np.random.randint(0, ih - h + 1)
+                left = np.random.randint(0, iw - w + 1)
+                crop = arr[top:top + h, left:left + w]
+                return Resize(self.size)(crop)
+        return Resize(self.size)(CenterCrop((min(ih, iw),) * 2)(arr))
+
+
+__all__ += ["CenterCrop", "RandomVerticalFlip", "Pad", "Grayscale",
+            "BrightnessTransform", "ContrastTransform",
+            "SaturationTransform", "HueTransform", "ColorJitter",
+            "RandomResizedCrop"]

@@ -1,0 +1,77 @@
+"""Vision transforms (≙ test/legacy_test/test_transforms.py patterns)."""
+
+import numpy as np
+
+from paddle_tpu.vision import transforms as T
+
+
+def _img(h=32, w=32, c=3, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, (h, w, c), dtype=np.uint8)
+
+
+def test_center_crop_and_pad():
+    img = _img(32, 32)
+    out = T.CenterCrop(16)(img)
+    assert out.shape == (16, 16, 3)
+    np.testing.assert_array_equal(out, img[8:24, 8:24])
+    padded = T.Pad(2)(img)
+    assert padded.shape == (36, 36, 3)
+    assert (padded[:2] == 0).all()
+
+
+def test_flips_and_grayscale():
+    img = _img()
+    flipped = T.RandomVerticalFlip(prob=1.0)(img)
+    np.testing.assert_array_equal(flipped, img[::-1])
+    gray = T.Grayscale()(img)
+    assert gray.shape == (32, 32, 1)
+    gray3 = T.Grayscale(num_output_channels=3)(img)
+    assert gray3.shape == (32, 32, 3)
+    np.testing.assert_array_equal(gray3[..., 0], gray3[..., 1])
+
+
+def test_color_jitter_and_random_resized_crop():
+    np.random.seed(0)
+    img = _img()
+    out = T.ColorJitter(brightness=0.5, contrast=0.5)(img)
+    assert out.shape == img.shape and out.dtype == img.dtype
+    rrc = T.RandomResizedCrop(24)(img)
+    assert rrc.shape == (24, 24, 3)
+
+
+def test_compose_pipeline():
+    np.random.seed(1)
+    pipeline = T.Compose([
+        T.Resize(40), T.RandomCrop(32), T.RandomHorizontalFlip(),
+        T.ColorJitter(0.2, 0.2), T.ToTensor(),
+        T.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5]),
+    ])
+    out = pipeline(_img(48, 48))
+    assert out.shape == (3, 32, 32)
+    assert out.dtype == np.float32
+
+
+def test_saturation_and_hue_actually_transform():
+    np.random.seed(2)
+    img = _img(16, 16)
+    out_s = T.SaturationTransform(0.9)(img)
+    assert not np.array_equal(out_s, img)
+    out_h = T.HueTransform(0.4)(img)
+    assert not np.array_equal(out_h, img)
+    # hue shift preserves value channel (max of RGB)
+    np.testing.assert_allclose(out_h.max(-1).astype(np.int32),
+                               img.max(-1).astype(np.int32), atol=2)
+    out = T.ColorJitter(saturation=0.9)(img)
+    assert not np.array_equal(out, img)
+
+
+def test_center_crop_too_large_raises():
+    import pytest
+    with pytest.raises(ValueError, match="exceeds"):
+        T.CenterCrop(64)(_img(32, 32))
+
+
+def test_text_dataset_size_zero():
+    from paddle_tpu.text.datasets import Imdb
+    assert len(Imdb(size=0)) == 0
