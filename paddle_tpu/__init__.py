@@ -22,9 +22,10 @@ from .core.generator import seed, get_rng_state, set_rng_state, Generator
 from .core.flags import set_flags, get_flags
 from . import device
 from .core.device import (  # noqa: F401
-    set_device, get_device, CPUPlace, TPUPlace, is_compiled_with_cuda,
-    is_compiled_with_tpu, device_count,
+    set_device, get_device, CPUPlace, TPUPlace, CUDAPlace,
+    is_compiled_with_cuda, is_compiled_with_tpu, device_count,
 )
+import jax.numpy as _jnp
 
 # ---- ops (also patches Tensor methods) ----
 from .tensor import *  # noqa: F401,F403
@@ -59,14 +60,64 @@ from .framework.io import save, load  # noqa: F401
 from .framework import random as framework_random  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi.dynamic_flops import flops  # noqa: F401
+from .hapi.model_summary import summary  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
+from . import version  # noqa: F401
+
+__version__ = version.full_version
+dtype = _jnp.dtype  # the dtype class (paddle.dtype)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Configure numpy/Tensor repr printing (reference
+    paddle.set_printoptions subset)."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def iinfo(dtype_):
+    import numpy as _np
+    from .core.dtypes import convert_dtype
+    return _np.iinfo(_np.dtype(str(convert_dtype(dtype_))))
+
+
+def finfo(dtype_):
+    import numpy as _np
+    from .core.dtypes import convert_dtype
+    d = convert_dtype(dtype_)
+    if str(d) in ("bfloat16", "float8_e4m3fn", "float8_e5m2"):
+        import ml_dtypes
+        return ml_dtypes.finfo(str(d))
+    return _np.finfo(_np.dtype(str(d)))
+
+
+def get_cuda_rng_state():
+    """Accelerator RNG state (one logical generator in this build;
+    aliases the framework RNG state helpers)."""
+    return [get_rng_state()]
+
+
+def set_cuda_rng_state(state_list):
+    if state_list:
+        set_rng_state(state_list[0])
 from .autograd.py_layer import PyLayer  # noqa: F401
 
 grad = _tape_grad
 
 disable_static = lambda: None  # dygraph is the default and only eager mode
 enable_static = lambda: None   # static mode == jit tracing; see paddle_tpu.jit
-
-__version__ = "0.1.0"
 
 def in_dynamic_mode() -> bool:
     """True when executing eagerly (not inside a jit trace)."""

@@ -105,6 +105,13 @@ def current_place() -> Place:
     return _current_place
 
 
+def CUDAPlace(dev_id: int = 0) -> Place:
+    """API-parity constructor: in this TPU build "cuda" names the
+    accelerator, so CUDAPlace maps to the TPU place (the cuda shim in
+    paddle_tpu.device does the same for device strings)."""
+    return Place("tpu", dev_id)
+
+
 def is_compiled_with_cuda() -> bool:  # API parity: this build has no CUDA
     return False
 

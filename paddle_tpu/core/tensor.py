@@ -141,6 +141,21 @@ class Tensor:
         return Tensor(jax.device_put(self._value, jax.devices("cpu")[0]),
                       stop_gradient=self.stop_gradient)
 
+    def cuda(self, device_id=None, blocking=True):
+        """API parity: move to the accelerator (TPU in this build)."""
+        devs = jax.devices()
+        idx = 0 if device_id is None else min(int(device_id), len(devs) - 1)
+        return Tensor(jax.device_put(self._value, devs[idx]),
+                      stop_gradient=self.stop_gradient)
+
+    def pin_memory(self):
+        """API parity: host staging buffers are managed by the runtime's
+        double-buffered transfers; returns self."""
+        return self
+
+    def element_size(self):
+        return int(jnp.dtype(self._value.dtype).itemsize)
+
     def to(self, *args, **kwargs):
         # accepts dtype or device strings like the reference's Tensor.to
         out = self

@@ -1,0 +1,58 @@
+"""Standalone ``paddle.summary`` (reference: ``python/paddle/hapi/
+model_summary.py``): per-layer table with output shapes (when an input
+size is given) and parameter counts; returns the totals dict."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn import Layer
+
+__all__ = ["summary"]
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    rows = []
+    hooks = []
+
+    def make_hook(name):
+        def hook(layer, inputs, output):
+            out = output[0] if isinstance(output, (tuple, list)) else output
+            shape = list(getattr(out, "shape", [])) or ["-"]
+            n_params = sum(p.size for p in layer.parameters(
+                include_sublayers=False)) if hasattr(
+                layer, "parameters") else 0
+            rows.append((name, type(layer).__name__, shape, n_params))
+        return hook
+
+    if input_size is not None or input is not None:
+        for name, sub in net.named_sublayers():
+            hooks.append(sub.register_forward_post_hook(make_hook(name)))
+        was_training = net.training
+        net.eval()
+        try:
+            if input is None:
+                import paddle_tpu as paddle
+                dtype = (dtypes[0] if dtypes else "float32")
+                input = paddle.to_tensor(
+                    np.zeros(tuple(input_size), dtype))
+            net(input)
+        finally:
+            for h in hooks:
+                h.remove()
+            if was_training:
+                net.train()
+
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters()
+                    if not p.stop_gradient)
+    header = f"{'Layer':<32}{'Type':<24}{'Output Shape':<20}{'Params':>10}"
+    print(header)
+    print("-" * len(header))
+    for name, tname, shape, n in rows:
+        print(f"{name:<32}{tname:<24}{str(shape):<20}{n:>10}")
+    print("-" * len(header))
+    print(f"Total params: {total}")
+    print(f"Trainable params: {trainable}")
+    print(f"Non-trainable params: {total - trainable}")
+    return {"total_params": int(total), "trainable_params": int(trainable)}

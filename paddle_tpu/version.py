@@ -1,0 +1,26 @@
+"""Version info (reference: generated ``python/paddle/version/__init__.py``
+— full_version/major/minor/patch/rc plus build-capability probes)."""
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "tpu-native-rebuild"
+
+cuda_version = "False"   # this build targets TPU; no CUDA toolkit
+cudnn_version = "False"
+tensorrt_version = "False"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -141,10 +141,17 @@ class Pad:
     def __call__(self, img):
         arr = np.asarray(img)
         l, t, r, b = self.padding
-        pad = [(t, b), (l, r)] + ([(0, 0)] if arr.ndim == 3 else [])
-        if self.mode == "constant":
-            return np.pad(arr, pad, constant_values=self.fill)
-        return np.pad(arr, pad, mode=self.mode)
+        pad2d = [(t, b), (l, r)]
+        if self.mode != "constant":
+            pad = pad2d + ([(0, 0)] if arr.ndim == 3 else [])
+            return np.pad(arr, pad, mode=self.mode)
+        if isinstance(self.fill, (tuple, list)) and arr.ndim == 3:
+            # per-channel fill color (reference accepts RGB tuples)
+            return np.stack(
+                [np.pad(arr[..., c], pad2d, constant_values=self.fill[c])
+                 for c in range(arr.shape[-1])], axis=-1)
+        pad = pad2d + ([(0, 0)] if arr.ndim == 3 else [])
+        return np.pad(arr, pad, constant_values=self.fill)
 
 
 class Grayscale:
@@ -166,27 +173,40 @@ class Grayscale:
         return gray[..., None]
 
 
+def _jitter_range(value):
+    """Reference semantics: scalar v -> [max(0, 1-v), 1+v]; (lo, hi) tuple
+    passes through.  Returns None when the jitter is a no-op."""
+    if isinstance(value, (tuple, list)):
+        lo, hi = float(value[0]), float(value[1])
+        if lo == hi == 1.0:
+            return None
+        return (lo, hi)
+    if value == 0:
+        return None
+    return (max(0.0, 1.0 - value), 1.0 + value)
+
+
 class BrightnessTransform:
     def __init__(self, value):
-        self.value = value
+        self.range = _jitter_range(value)
 
     def __call__(self, img):
-        if self.value == 0:
+        if self.range is None:
             return img
         arr = np.asarray(img).astype(np.float32)
-        alpha = 1 + np.random.uniform(-self.value, self.value)
+        alpha = np.random.uniform(*self.range)
         return np.clip(arr * alpha, 0, 255).astype(np.asarray(img).dtype)
 
 
 class ContrastTransform:
     def __init__(self, value):
-        self.value = value
+        self.range = _jitter_range(value)
 
     def __call__(self, img):
-        if self.value == 0:
+        if self.range is None:
             return img
         arr = np.asarray(img).astype(np.float32)
-        alpha = 1 + np.random.uniform(-self.value, self.value)
+        alpha = np.random.uniform(*self.range)
         mean = arr.mean()
         return np.clip(mean + alpha * (arr - mean), 0, 255) \
             .astype(np.asarray(img).dtype)
@@ -196,13 +216,13 @@ class SaturationTransform:
     """Blend with the grayscale image (standard saturation jitter)."""
 
     def __init__(self, value):
-        self.value = value
+        self.range = _jitter_range(value)
 
     def __call__(self, img):
-        if self.value == 0:
+        if self.range is None:
             return img
         arr = np.asarray(img).astype(np.float32)
-        alpha = 1 + np.random.uniform(-self.value, self.value)
+        alpha = np.random.uniform(*self.range)
         gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
                 + 0.114 * arr[..., 2])[..., None]
         return np.clip(gray + alpha * (arr - gray), 0, 255) \
@@ -213,12 +233,18 @@ class HueTransform:
     """Shift hue in HSV space (value in [0, 0.5], reference range)."""
 
     def __init__(self, value):
-        if not 0 <= value <= 0.5:
-            raise ValueError("hue value must be in [0, 0.5]")
-        self.value = value
+        if isinstance(value, (tuple, list)):
+            lo, hi = float(value[0]), float(value[1])
+            if not -0.5 <= lo <= hi <= 0.5:
+                raise ValueError("hue range must lie in [-0.5, 0.5]")
+            self.range = None if lo == hi == 0.0 else (lo, hi)
+        else:
+            if not 0 <= value <= 0.5:
+                raise ValueError("hue value must be in [0, 0.5]")
+            self.range = None if value == 0 else (-value, value)
 
     def __call__(self, img):
-        if self.value == 0:
+        if self.range is None:
             return img
         arr = np.asarray(img).astype(np.float32) / 255.0
         r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
@@ -234,7 +260,7 @@ class HueTransform:
         h = np.where(r == maxc, bc - gc,
                      np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
         h = (h / 6.0) % 1.0
-        h = (h + np.random.uniform(-self.value, self.value)) % 1.0
+        h = (h + np.random.uniform(*self.range)) % 1.0
         i = (h * 6.0).astype(np.int32) % 6
         f = h * 6.0 - np.floor(h * 6.0)
         p_ = v * (1.0 - s_)

@@ -144,3 +144,32 @@ def test_hdfs_client_gated():
     from paddle_tpu.distributed.fleet.utils import HDFSClient
     with pytest.raises(RuntimeError, match="hadoop"):
         HDFSClient("/nonexistent/hadoop_home")
+
+
+def test_top_level_api_surface():
+    import paddle_tpu as paddle
+    assert paddle.__version__ == paddle.version.full_version
+    assert paddle.dtype is not None
+    assert paddle.CUDAPlace(0).is_tpu_place()  # cuda shim -> accelerator
+    fi = paddle.finfo("bfloat16")
+    assert fi.bits == 16
+    ii = paddle.iinfo("int32")
+    assert ii.max == 2**31 - 1
+    paddle.set_printoptions(precision=3)
+    st = paddle.get_cuda_rng_state()
+    paddle.set_cuda_rng_state(st)
+    t = paddle.to_tensor(np.array([1.0], np.float32))
+    assert t.element_size() == 4
+    assert t.pin_memory() is t
+    assert t.cuda() is not None
+    assert paddle.DataParallel is not None
+
+
+def test_paddle_summary_table(capsys):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    out = paddle.summary(net, (1, 4))
+    captured = capsys.readouterr().out
+    assert "Linear" in captured and "Total params" in captured
+    assert out["total_params"] == 4 * 8 + 8 + 8 * 2 + 2

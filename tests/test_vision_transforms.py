@@ -75,3 +75,23 @@ def test_center_crop_too_large_raises():
 def test_text_dataset_size_zero():
     from paddle_tpu.text.datasets import Imdb
     assert len(Imdb(size=0)) == 0
+
+
+def test_jitter_tuple_ranges_and_large_values():
+    np.random.seed(3)
+    img = _img(8, 8)
+    out = T.ColorJitter(brightness=(0.8, 1.2), contrast=(0.9, 1.1),
+                        saturation=(0.5, 1.5), hue=(-0.1, 0.1))(img)
+    assert out.shape == img.shape
+    # value > 1 must never produce negative alpha (no inverted images)
+    bt = T.BrightnessTransform(2.0)
+    for _ in range(10):
+        res = bt(np.full((4, 4, 3), 100, np.uint8))
+        assert res.min() >= 0
+
+
+def test_pad_per_channel_fill():
+    img = _img(4, 4)
+    out = T.Pad(1, fill=(255, 0, 7))(img)
+    assert out.shape == (6, 6, 3)
+    assert out[0, 0, 0] == 255 and out[0, 0, 1] == 0 and out[0, 0, 2] == 7
