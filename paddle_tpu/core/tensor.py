@@ -144,7 +144,11 @@ class Tensor:
     def cuda(self, device_id=None, blocking=True):
         """API parity: move to the accelerator (TPU in this build)."""
         devs = jax.devices()
-        idx = 0 if device_id is None else min(int(device_id), len(devs) - 1)
+        idx = 0 if device_id is None else int(device_id)
+        if not 0 <= idx < len(devs):
+            raise ValueError(
+                f"device_id {device_id} out of range (have {len(devs)} "
+                "device(s))")
         return Tensor(jax.device_put(self._value, devs[idx]),
                       stop_gradient=self.stop_gradient)
 

@@ -200,16 +200,6 @@ class Model:
         return self.network.parameters()
 
     def summary(self, input_size=None, dtype=None):
-        total = 0
-        trainable = 0
-        lines = []
-        for name, p in self.network.named_parameters():
-            n = p.size
-            total += n
-            if not p.stop_gradient:
-                trainable += n
-            lines.append(f"  {name}: {list(p.shape)} = {n}")
-        report = {"total_params": total, "trainable_params": trainable}
-        print("\n".join(lines))
-        print(f"Total params: {total}  Trainable: {trainable}")
-        return report
+        from .model_summary import summary as _summary
+        return _summary(self.network, input_size=input_size,
+                        dtypes=[dtype] if dtype else None)

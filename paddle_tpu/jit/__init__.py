@@ -11,6 +11,9 @@ at XLA-native speed.
 
 from .api import to_static, not_to_static, ignore_module, save, load, TranslatedLayer
 from .train_step import TrainStep
+from .bucketing import (BucketedFunction, bucketed, default_buckets,
+                        pad_to_bucket)
 
 __all__ = ["to_static", "not_to_static", "ignore_module", "save", "load",
-           "TranslatedLayer", "TrainStep"]
+           "TranslatedLayer", "TrainStep", "BucketedFunction", "bucketed",
+           "default_buckets", "pad_to_bucket"]

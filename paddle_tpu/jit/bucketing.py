@@ -1,0 +1,117 @@
+"""Dynamic-shape bucketing for jit compilation.
+
+SURVEY §7 "hard parts": the reference re-runs InferShape per step so any
+batch/sequence length works; XLA compiles per static shape.  The TPU
+policy is bucketing — pad dynamic axes up to a small set of bucket sizes
+so each bucket compiles once and every input reuses a cached executable.
+
+``pad_to_bucket`` is the primitive; ``BucketedFunction`` wraps a jitted
+callable with automatic padding + result cropping; padding masks let
+losses ignore padded positions.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["default_buckets", "pad_to_bucket", "BucketedFunction",
+           "bucketed"]
+
+
+def default_buckets(max_size: int, min_size: int = 8):
+    """Power-of-two buckets up to max_size (the standard recompile-bound
+    ladder: at most log2(max/min) executables per axis)."""
+    buckets = []
+    b = min_size
+    while b < max_size:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_size)
+    return buckets
+
+
+def _pick(size: int, buckets: Sequence[int]) -> int:
+    for b in sorted(buckets):
+        if size <= b:
+            return int(b)
+    raise ValueError(
+        f"size {size} exceeds the largest bucket {max(buckets)}; widen the "
+        "bucket ladder or truncate the input")
+
+
+def pad_to_bucket(x, axis: int, buckets: Sequence[int], pad_value=0):
+    """Pad ``x`` along ``axis`` up to the smallest bucket >= its size.
+    Returns (padded_tensor, original_size, mask) where mask is 1.0 for
+    real positions along that axis (shape: [bucket])."""
+    import jax.numpy as jnp
+
+    arr = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    size = arr.shape[axis]
+    target = _pick(size, buckets)
+    mask = jnp.asarray(
+        (np.arange(target) < size).astype(np.float32))
+    if target == size:
+        return (x if isinstance(x, Tensor) else Tensor(arr)), size, \
+            Tensor(mask)
+    pad = [(0, 0)] * arr.ndim
+    pad[axis] = (0, target - size)
+    padded = jnp.pad(arr, pad, constant_values=pad_value)
+    return Tensor(padded), size, Tensor(mask)
+
+
+class BucketedFunction:
+    """Wraps fn so every call pads the chosen axes to bucket sizes before
+    invoking (bounding the number of distinct compiled shapes) and crops
+    outputs back to the true size.
+
+    axes: {arg_index: (axis, buckets, pad_value)}.
+    crop: None (no cropping) or (out_axis,) — crops every output Tensor of
+    sufficient rank along that axis to the original (pre-pad) size of the
+    lowest-indexed bucketed argument; lower-rank outputs (e.g. a scalar
+    loss) pass through uncropped.
+    """
+
+    def __init__(self, fn: Callable, axes, crop=None):
+        self.fn = fn
+        self.axes = axes
+        self.crop = crop
+        self.compiled_shapes = set()
+
+    def __call__(self, *args):
+        args = list(args)
+        true_size = None
+        for idx in sorted(self.axes):
+            axis, buckets, pad_value = self.axes[idx]
+            args[idx], size, _ = pad_to_bucket(args[idx], axis, buckets,
+                                               pad_value)
+            if true_size is None:
+                true_size = size
+        shape_key = tuple(tuple(a.shape) if isinstance(a, Tensor) else None
+                          for a in args)
+        self.compiled_shapes.add(shape_key)
+        out = self.fn(*args)
+        if self.crop is None or true_size is None:
+            return out
+        (out_axis,) = self.crop
+
+        def crop_one(t):
+            if not isinstance(t, Tensor) or t.ndim <= out_axis:
+                return t  # scalars/low-rank outputs (losses) pass through
+            sl = [slice(None)] * t.ndim
+            sl[out_axis] = slice(0, true_size)
+            return Tensor(t._value[tuple(sl)])
+
+        if isinstance(out, (tuple, list)):
+            return type(out)(crop_one(o) for o in out)
+        return crop_one(out)
+
+
+def bucketed(axes, crop=None):
+    """Decorator form: @bucketed({0: (1, default_buckets(2048), 0)})."""
+    def wrap(fn):
+        return BucketedFunction(fn, axes, crop)
+    return wrap

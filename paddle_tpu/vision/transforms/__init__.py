@@ -178,6 +178,9 @@ def _jitter_range(value):
     passes through.  Returns None when the jitter is a no-op."""
     if isinstance(value, (tuple, list)):
         lo, hi = float(value[0]), float(value[1])
+        if lo < 0 or lo > hi:
+            raise ValueError(
+                f"jitter range must satisfy 0 <= lo <= hi, got ({lo}, {hi})")
         if lo == hi == 1.0:
             return None
         return (lo, hi)

@@ -1,0 +1,97 @@
+"""Dynamic-shape bucketing (SURVEY §7 hard part: XLA static shapes vs
+per-step InferShape — bucket ladder bounds recompiles)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import (BucketedFunction, bucketed, default_buckets,
+                            pad_to_bucket)
+
+
+def test_default_buckets_ladder():
+    assert default_buckets(128, 8) == [8, 16, 32, 64, 128]
+    assert default_buckets(100, 8)[-1] == 100
+
+
+def test_pad_to_bucket_and_mask():
+    x = paddle.to_tensor(np.ones((2, 11), np.float32))
+    padded, size, mask = pad_to_bucket(x, axis=1, buckets=[8, 16, 32])
+    assert tuple(padded.shape) == (2, 16) and size == 11
+    m = np.asarray(mask._value)
+    assert m[:11].all() and not m[11:].any()
+    # exact fit: no copy-pad
+    padded2, size2, _ = pad_to_bucket(x, axis=1, buckets=[11, 16])
+    assert tuple(padded2.shape) == (2, 11)
+
+
+def test_pad_to_bucket_overflow_raises():
+    x = paddle.to_tensor(np.ones((2, 64), np.float32))
+    with pytest.raises(ValueError, match="largest bucket"):
+        pad_to_bucket(x, axis=1, buckets=[8, 16])
+
+
+def test_bucketed_function_bounds_compiles():
+    import jax
+    traces = []
+
+    @jax.jit
+    def core(xv):
+        traces.append(tuple(xv.shape))
+        return xv * 2
+
+    bf = BucketedFunction(lambda x: paddle.to_tensor(core(x._value)),
+                          axes={0: (1, [8, 16], 0.0)}, crop=(1,))
+    for n in (3, 5, 7, 8):   # all map to bucket 8
+        out = bf(paddle.to_tensor(np.ones((1, n), np.float32)))
+        assert tuple(out.shape) == (1, n)
+        np.testing.assert_allclose(np.asarray(out._value), 2.0)
+    out = bf(paddle.to_tensor(np.ones((1, 12), np.float32)))  # bucket 16
+    assert tuple(out.shape) == (1, 12)
+    # exactly two distinct compiled shapes for five differently-sized calls
+    assert len(set(traces)) == 2
+    assert len(bf.compiled_shapes) == 2
+
+
+def test_bucketed_decorator_with_loss_mask():
+    from paddle_tpu import nn
+    emb = nn.Embedding(16, 4)
+
+    @bucketed(axes={0: (1, [8, 16], 0)}, crop=(1,))
+    def forward(ids):
+        return emb(ids)
+
+    ids = paddle.to_tensor(np.arange(5, dtype=np.int64)[None])
+    out = forward(ids)
+    assert tuple(out.shape) == (1, 5, 4)
+
+
+def test_crop_skips_scalar_outputs():
+    from paddle_tpu import nn
+    lin = nn.Linear(4, 4)
+
+    @bucketed(axes={0: (1, [8], 0.0)}, crop=(1,))
+    def fwd_with_loss(x):
+        out = lin(x)
+        return out, out.sum()
+
+    x = paddle.to_tensor(np.ones((1, 5, 4), np.float32))
+    out, loss = fwd_with_loss(x)
+    assert tuple(out.shape) == (1, 5, 4)
+    assert loss.ndim == 0  # passed through uncropped
+
+
+def test_jitter_tuple_validation():
+    from paddle_tpu.vision import transforms as T
+    import pytest
+    with pytest.raises(ValueError, match="lo <= hi"):
+        T.BrightnessTransform((1.5, 0.5))
+    with pytest.raises(ValueError, match="lo <= hi"):
+        T.ContrastTransform((-0.5, 0.5))
+
+
+def test_cuda_out_of_range_raises():
+    import pytest
+    t = paddle.to_tensor(np.ones(2, np.float32))
+    with pytest.raises(ValueError, match="out of range"):
+        t.cuda(99)
