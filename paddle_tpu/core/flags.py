@@ -70,6 +70,9 @@ def flag(name: str) -> Any:
 define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf in eager mode")
 define_flag("benchmark", False, "block on every op for accurate eager timing")
 define_flag("use_autotune", True, "enable pallas kernel autotuning cache")
+define_flag("use_int8_matmul_kernel", False,
+            "route int8-weight linears through the Pallas quantized matmul "
+            "(measured at parity with the XLA dequant+matmul on v5; opt-in)")
 define_flag("eager_log_level", 0, "verbosity of eager dispatch logging")
 define_flag("low_precision_op_list", 0, "record ops executed under AMP")
 define_flag("default_dtype", "float32", "default floating point dtype")

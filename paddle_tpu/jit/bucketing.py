@@ -49,16 +49,23 @@ def pad_to_bucket(x, axis: int, buckets: Sequence[int], pad_value=0):
     real positions along that axis (shape: [bucket])."""
     import jax.numpy as jnp
 
-    arr = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    is_t = isinstance(x, Tensor)
+    arr = x._value if is_t else jnp.asarray(x)
     size = arr.shape[axis]
     target = _pick(size, buckets)
     mask = jnp.asarray(
         (np.arange(target) < size).astype(np.float32))
     if target == size:
-        return (x if isinstance(x, Tensor) else Tensor(arr)), size, \
-            Tensor(mask)
+        return (x if is_t else Tensor(arr)), size, Tensor(mask)
     pad = [(0, 0)] * arr.ndim
     pad[axis] = (0, target - size)
+    if is_t and not x.stop_gradient:
+        # keep the tape linkage for differentiable inputs
+        from ..core.dispatch import dispatch
+        padded_t = dispatch(
+            "bucket_pad",
+            lambda a: jnp.pad(a, pad, constant_values=pad_value), (x,))
+        return padded_t, size, Tensor(mask)
     padded = jnp.pad(arr, pad, constant_values=pad_value)
     return Tensor(padded), size, Tensor(mask)
 
@@ -103,6 +110,11 @@ class BucketedFunction:
                 return t  # scalars/low-rank outputs (losses) pass through
             sl = [slice(None)] * t.ndim
             sl[out_axis] = slice(0, true_size)
+            if not t.stop_gradient:
+                # tape-recorded slice keeps gradients flowing to the fn
+                from ..core.dispatch import dispatch
+                return dispatch("bucket_crop",
+                                lambda a: a[tuple(sl)], (t,))
             return Tensor(t._value[tuple(sl)])
 
         if isinstance(out, (tuple, list)):

@@ -92,6 +92,22 @@ class QuantizedLinearInfer(Layer):
         self._bits = bits
 
     def forward(self, x):
+        from ...ops.pallas import quantized_matmul as pallas_qmm
+        if pallas_qmm.should_use_pallas(x, self.qweight):
+            from ...core.dispatch import dispatch
+            has_bias = self.bias is not None
+
+            def impl(a, qw, s, *rest):
+                out = pallas_qmm.quantized_matmul(a, qw, s)
+                if rest:
+                    out = out + rest[0].astype(out.dtype)
+                return out
+
+            args = (x, self.qweight, self.weight_scale) + \
+                ((self.bias,) if has_bias else ())
+            mask = [False, True, True] + ([False] if has_bias else [])
+            return dispatch("quantized_linear", impl, args,
+                            nondiff_mask=mask)
         w = Tensor(_dequant(self.qweight._value, self.weight_scale._value,
                             axis=-1))
         return F.linear(x, w, self.bias)

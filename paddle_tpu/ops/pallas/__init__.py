@@ -8,3 +8,4 @@ from . import rms_norm  # noqa: F401
 from . import rope  # noqa: F401
 from . import fused_optimizer  # noqa: F401
 from . import autotune  # noqa: F401
+from . import quantized_matmul  # noqa: F401

@@ -1,0 +1,117 @@
+"""Int8-weight matmul Pallas kernel.
+
+TPU analogue of the reference's int8 cutlass epilogues
+(``paddle/phi/kernels/fusion/cutlass``): ``y = x @ (W_int8 * scale)``
+with the weight dequantized int8->bf16 in VMEM and the per-output-channel
+scale applied as an epilogue on the fp32 accumulator.
+
+Measured on the real chip (2026-07-30): parity with XLA's fused
+dequant+matmul at both prefill (M=256, K=N=4096) and decode (M=16,
+K=N=8192) shapes — XLA also streams int8 from HBM and fuses the upcast.
+The kernel therefore ships as an **opt-in** (FLAGS_use_int8_matmul_kernel)
+building block / autotune target rather than the default path.
+Interpret mode keeps CPU CI on the same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ._common import on_tpu, pallas_enabled
+
+BLOCK_M = 256
+BLOCK_N = 256
+
+
+def should_use_pallas(x, qweight) -> bool:
+    from ...core.flags import flag
+    if not flag("use_int8_matmul_kernel"):
+        return False
+    if not pallas_enabled():
+        return False
+    if x.ndim < 2 or qweight.ndim != 2:
+        return False
+    k, n = qweight.shape
+    m = 1
+    for s in x.shape[:-1]:
+        m *= s
+    return (k % 128 == 0 and n % 128 == 0 and m >= 8
+            and x.shape[-1] == k)
+
+
+def _kernel(x_ref, qw_ref, scale_ref, y_ref):
+    x = x_ref[:]
+    # int8 -> the activation dtype in VMEM: bf16 activations keep the MXU
+    # at full bf16 rate, fp32 activations keep full precision; the
+    # accumulator is fp32 either way
+    w = qw_ref[:].astype(x.dtype)
+    acc = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    # scales arrive as a [1, bn] row (2-D keeps Mosaic's 128-lane tiling)
+    y_ref[:] = (acc * scale_ref[:]).astype(y_ref.dtype)
+
+
+def _qmm_impl(x2, qweight, scales2, out_dtype):
+    m, k = x2.shape
+    n = qweight.shape[1]
+    # N blocks must tile N exactly (gate guarantees n % 128 == 0)
+    bn = BLOCK_N if n % BLOCK_N == 0 else 128
+    # M is padded up to a whole number of blocks (bounded VMEM per block)
+    bm = min(BLOCK_M, max(8, m))
+    pad_m = (-m) % bm
+    if pad_m:
+        x2 = jnp.pad(x2, ((0, pad_m), (0, 0)))
+    mp = m + pad_m
+    y = pl.pallas_call(
+        _kernel,
+        grid=(mp // bm, n // bn),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, n), out_dtype),
+        interpret=not on_tpu(),
+    )(x2, qweight, scales2)
+    return y[:m]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _qmm(x2, qweight, scales2, out_dtype):
+    return _qmm_impl(x2, qweight, scales2, out_dtype)
+
+
+def _qmm_fwd(x2, qweight, scales2, out_dtype):
+    return _qmm_impl(x2, qweight, scales2, out_dtype), (qweight, scales2)
+
+
+def _qmm_bwd(out_dtype, res, g):
+    # dx = g @ (W_int8 * scale)^T — plain XLA; weights/scales nondiff
+    qweight, scales2 = res
+    w = qweight.astype(jnp.float32) * scales2
+    dx = g.astype(jnp.float32) @ w.T
+    return dx, None, None
+
+
+_qmm.defvjp(_qmm_fwd, _qmm_bwd)
+
+
+def quantized_matmul(x, qweight, scales, out_dtype=None):
+    """x: [..., K] float; qweight: [K, N] int8; scales: [N] fp32.
+    Returns [..., N] in out_dtype (defaults to x dtype).  Differentiable
+    w.r.t. x (custom vjp; weights are frozen int8)."""
+    shape = x.shape
+    k, n = qweight.shape
+    if n % 128:
+        raise ValueError(
+            f"quantized_matmul: N ({n}) must be a multiple of 128")
+    x2 = x.reshape(-1, k)
+    out_dtype = out_dtype or x.dtype
+    scales2 = jnp.asarray(scales, jnp.float32).reshape(1, n)
+    y = _qmm(x2, qweight, scales2, jnp.dtype(out_dtype))
+    return y.reshape(shape[:-1] + (n,))

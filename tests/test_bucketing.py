@@ -95,3 +95,22 @@ def test_cuda_out_of_range_raises():
     t = paddle.to_tensor(np.ones(2, np.float32))
     with pytest.raises(ValueError, match="out of range"):
         t.cuda(99)
+
+
+def test_bucketed_crop_keeps_gradients():
+    from paddle_tpu import nn
+    lin = nn.Linear(4, 4)
+
+    @bucketed(axes={0: (1, [8], 0.0)}, crop=(1,))
+    def fwd(x):
+        return lin(x)
+
+    x = paddle.to_tensor(np.ones((1, 5, 4), np.float32),
+                         stop_gradient=False)
+    out = fwd(x)
+    out.sum().backward()
+    assert lin.weight.grad is not None
+    assert float(np.abs(np.asarray(lin.weight.grad._value)).sum()) > 0
+    # padded positions contribute zero input grad
+    gx = np.asarray(x.grad._value)
+    assert gx.shape == (1, 5, 4)

@@ -274,3 +274,87 @@ def test_autotune_array_kwargs_hashable():
     out = tuned(jnp.ones((2,)), bias=jnp.ones((2,)))
     np.testing.assert_allclose(np.asarray(out), 3.0)
     at.clear_cache()
+
+
+def test_quantized_matmul_matches_dequant_reference():
+    from paddle_tpu.ops.pallas import quantized_matmul as qmm
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.standard_normal((16, 128)), jnp.float32)
+    w = rng.standard_normal((128, 256)).astype(np.float32)
+    scales = (np.abs(w).max(axis=0) / 127).astype(np.float32)
+    qw = jnp.asarray(np.clip(np.round(w / scales[None, :]), -127, 127),
+                     jnp.int8)
+    out = qmm.quantized_matmul(x, qw, jnp.asarray(scales))
+    ref = x @ (np.asarray(qw, np.float32) * scales[None, :])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-3, rtol=1e-4)
+
+
+def test_quantized_matmul_ragged_m_and_3d():
+    from paddle_tpu.ops.pallas import quantized_matmul as qmm
+    rng = np.random.default_rng(10)
+    x = jnp.asarray(rng.standard_normal((2, 5, 128)), jnp.float32)
+    qw = jnp.asarray(rng.integers(-127, 128, (128, 128)), jnp.int8)
+    scales = jnp.full((128,), 0.01, jnp.float32)
+    out = qmm.quantized_matmul(x, qw, scales)
+    assert out.shape == (2, 5, 128)
+    ref = np.asarray(x).reshape(-1, 128) @ (
+        np.asarray(qw, np.float32) * 0.01)
+    np.testing.assert_allclose(np.asarray(out).reshape(-1, 128), ref,
+                               atol=1e-3, rtol=1e-4)
+
+
+def test_quantized_linear_infer_routes_to_kernel(monkeypatch):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.ops.pallas import quantized_matmul as qmm
+    from paddle_tpu.quantization import QAT, QuantConfig
+    from paddle_tpu.quantization.quanters import (
+        FakeQuanterChannelWiseAbsMaxObserver)
+    net = nn.Sequential(nn.Linear(128, 128))
+    infer = QAT(QuantConfig(
+        activation=None,
+        weight=FakeQuanterChannelWiseAbsMaxObserver)).convert(
+        QAT(QuantConfig(activation=None,
+                        weight=FakeQuanterChannelWiseAbsMaxObserver))
+        .quantize(net))
+    x = paddle.to_tensor(np.random.default_rng(11)
+                         .standard_normal((8, 128)).astype(np.float32))
+    ref = np.asarray(infer(x)._value)  # XLA dequant path on CPU
+    from paddle_tpu.core.flags import set_flags
+    set_flags({"use_int8_matmul_kernel": True})
+    monkeypatch.setattr(qmm, "pallas_enabled", lambda: True)
+    monkeypatch.setattr(qmm, "on_tpu", lambda: False)  # interpret mode
+    try:
+        out = np.asarray(infer(x)._value)  # kernel path
+    finally:
+        set_flags({"use_int8_matmul_kernel": False})
+    np.testing.assert_allclose(out, ref, atol=1e-3, rtol=1e-3)
+
+
+def test_quantized_matmul_ragged_n_and_padded_m():
+    from paddle_tpu.ops.pallas import quantized_matmul as qmm
+    rng = np.random.default_rng(12)
+    # n=384 (not a 256 multiple) and m=10 (ragged) both must be exact
+    x = jnp.asarray(rng.standard_normal((10, 128)), jnp.float32)
+    qw = jnp.asarray(rng.integers(-127, 128, (128, 384)), jnp.int8)
+    scales = jnp.full((384,), 0.02, jnp.float32)
+    out = qmm.quantized_matmul(x, qw, scales)
+    ref = np.asarray(x) @ (np.asarray(qw, np.float32) * 0.02)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-3, rtol=1e-4)
+    assert np.isfinite(np.asarray(out)).all()
+    with pytest.raises(ValueError, match="multiple of 128"):
+        qmm.quantized_matmul(x, jnp.zeros((128, 100), jnp.int8),
+                             jnp.ones((100,)))
+
+
+def test_quantized_matmul_differentiable_x():
+    from paddle_tpu.ops.pallas import quantized_matmul as qmm
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.standard_normal((8, 128)), jnp.float32)
+    qw = jnp.asarray(rng.integers(-127, 128, (128, 128)), jnp.int8)
+    scales = jnp.full((128,), 0.01, jnp.float32)
+
+    g = jax.grad(lambda a: jnp.sum(qmm.quantized_matmul(a, qw, scales)))(x)
+    ref = np.sum(np.asarray(qw, np.float32) * 0.01, axis=1)
+    np.testing.assert_allclose(np.asarray(g)[0], ref, atol=1e-4, rtol=1e-4)
