@@ -177,7 +177,8 @@ class Fleet:
         return self._ps_server
 
     def init_worker(self, *args, **kwargs):
-        """Connect a PS client to the first configured server endpoint."""
+        """Connect to the configured server endpoints (a single
+        PSClient, or a ShardedPSClient spanning all of them)."""
         from ..ps import PSClient
         eps = (self._role_maker.get_pserver_endpoints()
                if self._role_maker else [])
@@ -185,10 +186,9 @@ class Fleet:
             raise RuntimeError(
                 "init_worker(): no PADDLE_PSERVERS_IP_PORT_LIST endpoints")
         if len(eps) > 1:
-            raise NotImplementedError(
-                "init_worker(): table sharding across multiple parameter "
-                f"servers is not supported yet (got {len(eps)} endpoints); "
-                "launch with --server_num 1")
+            from ..ps import ShardedPSClient
+            self._ps_client = ShardedPSClient(eps)
+            return self._ps_client
         host, port = eps[0].rsplit(":", 1)
         self._ps_client = PSClient(host, int(port))
         return self._ps_client

@@ -126,10 +126,6 @@ def _spawn_ps(args):
     procs (TRAINING_ROLE=PSERVER) then trainer procs with the server
     endpoint list in the env contract."""
     os.makedirs(args.log_dir, exist_ok=True)
-    if args.server_num > 1:
-        raise SystemExit(
-            "--server_num > 1: table sharding across multiple parameter "
-            "servers is not supported yet; use --server_num 1")
     if args.nnodes > 1:
         raise SystemExit(
             "PS mode (--server_num) is single-node only for now; "

@@ -22,7 +22,8 @@ from ...autograd.py_layer import PyLayer
 from ...core.tensor import Tensor
 from ...nn import Layer
 
-__all__ = ["PSServer", "PSClient", "SparseEmbedding", "DensePSParameter"]
+__all__ = ["PSServer", "PSClient", "ShardedPSClient",
+           "SparseEmbedding", "DensePSParameter"]
 
 
 class PSServer:
@@ -171,3 +172,83 @@ class DensePSParameter:
         g = np.asarray(grad._value if isinstance(grad, Tensor) else grad)
         self.client.push_dense_grad(self.table_id, g.reshape(-1),
                                     self.learning_rate)
+
+
+class ShardedPSClient:
+    """Client over multiple parameter servers (reference: the brpc client
+    shards sparse keys across server instances, ps/service/ps_client.h).
+
+    Sharding rules: sparse keys are mixed with a 64-bit multiplicative
+    hash before ``% n`` (stride-patterned id spaces would otherwise
+    collapse onto one server); dense tables live whole on server
+    ``table_id % n``.  The surface matches :class:`PSClient` so
+    SparseEmbedding/DensePSParameter work unchanged.
+    """
+
+    def __init__(self, endpoints, timeout_s: float = 30.0):
+        if not endpoints:
+            raise ValueError("ShardedPSClient needs at least one endpoint")
+        self._clients = []
+        for ep in endpoints:
+            host, port = ep.rsplit(":", 1)
+            self._clients.append(PSClient(host, int(port), timeout_s))
+        self._n = len(self._clients)
+        self._sparse_dims = {}
+
+    # dense: whole table on one server -----------------------------------
+    def _dense_owner(self, table_id):
+        return self._clients[table_id % self._n]
+
+    def create_dense_table(self, table_id, dim, init=None):
+        self._dense_owner(table_id).create_dense_table(table_id, dim, init)
+
+    def pull_dense(self, table_id):
+        return self._dense_owner(table_id).pull_dense(table_id)
+
+    def push_dense_grad(self, table_id, grad, lr):
+        self._dense_owner(table_id).push_dense_grad(table_id, grad, lr)
+
+    def set_dense(self, table_id, values):
+        self._dense_owner(table_id).set_dense(table_id, values)
+
+    # sparse: rows hashed across all servers ------------------------------
+    def create_sparse_table(self, table_id, dim, init_scale=0.01, seed=0):
+        for c in self._clients:
+            c.create_sparse_table(table_id, dim, init_scale, seed)
+        self._sparse_dims[table_id] = dim
+
+    def _partition(self, keys):
+        keys = np.ascontiguousarray(keys, np.uint64)
+        # splitmix-style mixing: decorrelates strided id spaces from % n
+        with np.errstate(over="ignore"):
+            mixed = keys * np.uint64(0x9E3779B97F4A7C15)
+        owner = ((mixed >> np.uint64(33)) % np.uint64(self._n)) \
+            .astype(np.int64)
+        return keys, owner
+
+    def pull_sparse(self, table_id, keys):
+        keys, owner = self._partition(keys)
+        dim = self._sparse_dims[table_id]
+        out = np.empty((keys.size, dim), np.float32)
+        for s in range(self._n):
+            idx = np.nonzero(owner == s)[0]
+            if idx.size:
+                out[idx] = self._clients[s].pull_sparse(table_id,
+                                                        keys[idx])
+        return out
+
+    def push_sparse_grad(self, table_id, keys, grads, lr):
+        keys, owner = self._partition(keys)
+        grads = np.ascontiguousarray(grads, np.float32)
+        for s in range(self._n):
+            idx = np.nonzero(owner == s)[0]
+            if idx.size:
+                self._clients[s].push_sparse_grad(table_id, keys[idx],
+                                                  grads[idx], lr)
+
+    def sparse_table_size(self, table_id):
+        return sum(c.sparse_table_size(table_id) for c in self._clients)
+
+    def close(self):
+        for c in self._clients:
+            c.close()

@@ -110,6 +110,9 @@ def quantized_matmul(x, qweight, scales, out_dtype=None):
     if n % 128:
         raise ValueError(
             f"quantized_matmul: N ({n}) must be a multiple of 128")
+    if shape[-1] != k:
+        raise ValueError(
+            f"quantized_matmul: x last dim ({shape[-1]}) != weight K ({k})")
     x2 = x.reshape(-1, k)
     out_dtype = out_dtype or x.dtype
     scales2 = jnp.asarray(scales, jnp.float32).reshape(1, n)

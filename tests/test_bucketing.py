@@ -111,6 +111,13 @@ def test_bucketed_crop_keeps_gradients():
     out.sum().backward()
     assert lin.weight.grad is not None
     assert float(np.abs(np.asarray(lin.weight.grad._value)).sum()) > 0
-    # padded positions contribute zero input grad
+    # every real row's input grad equals the column-sum of W (d sum(xW+b)/dx)
     gx = np.asarray(x.grad._value)
     assert gx.shape == (1, 5, 4)
+    expected_row = np.asarray(lin.weight._value).sum(axis=1)
+    np.testing.assert_allclose(gx[0], np.tile(expected_row, (5, 1)),
+                               atol=1e-5)
+    # weight grad only accumulates from the 5 real rows (pad rows are 0
+    # input, so d/dW = sum over rows of x^T g = 5 * ones outer ones)
+    np.testing.assert_allclose(np.asarray(lin.weight.grad._value),
+                               np.full((4, 4), 5.0), atol=1e-5)

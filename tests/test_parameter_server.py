@@ -242,3 +242,97 @@ def test_fleet_ps_mode_default_role_maker(monkeypatch):
     f = Fleet()
     f.init(is_collective=False)
     assert f.is_server()
+
+
+def test_sharded_ps_client_two_servers():
+    from paddle_tpu.distributed.ps import PSServer, ShardedPSClient
+    s1, s2 = PSServer(0), PSServer(0)
+    try:
+        client = ShardedPSClient([f"127.0.0.1:{s1.port}",
+                                  f"127.0.0.1:{s2.port}"])
+        # dense: whole tables per server by table_id % n
+        client.create_dense_table(0, 4, init=np.ones(4, np.float32))
+        client.create_dense_table(1, 4, init=2 * np.ones(4, np.float32))
+        np.testing.assert_allclose(client.pull_dense(0), 1.0)
+        np.testing.assert_allclose(client.pull_dense(1), 2.0)
+        client.push_dense_grad(1, np.ones(4, np.float32), lr=0.5)
+        np.testing.assert_allclose(client.pull_dense(1), 1.5)
+
+        # sparse: keys hashed across both servers, order preserved
+        client.create_sparse_table(5, 4, init_scale=0.0)
+        keys = np.array([2, 3, 4, 5, 10, 11], np.uint64)
+        rows = client.pull_sparse(5, keys)
+        assert rows.shape == (6, 4)
+        grads = np.arange(24, dtype=np.float32).reshape(6, 4)
+        client.push_sparse_grad(5, keys, grads, lr=1.0)
+        back = client.pull_sparse(5, keys)
+        np.testing.assert_allclose(back, -grads, atol=1e-6)
+        # both servers actually hold rows
+        assert client.sparse_table_size(5) == 6
+        assert 0 < client._clients[0].sparse_table_size(5) < 6
+        client.close()
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_sharded_sparse_embedding_trains():
+    from paddle_tpu.distributed.ps import (PSServer, ShardedPSClient,
+                                           SparseEmbedding)
+    s1, s2 = PSServer(0), PSServer(0)
+    try:
+        client = ShardedPSClient([f"127.0.0.1:{s1.port}",
+                                  f"127.0.0.1:{s2.port}"])
+        emb = SparseEmbedding(client, table_id=7, embedding_dim=4,
+                              learning_rate=0.1, init_scale=0.0)
+        ids = paddle.to_tensor(np.array([[1, 2, 3, 4]], np.int64))
+        emb(ids).sum().backward()
+        rows = client.pull_sparse(7, np.array([1, 2, 3, 4], np.uint64))
+        np.testing.assert_allclose(rows, -0.1 * np.ones((4, 4)), atol=1e-6)
+        client.close()
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_launch_two_servers(tmp_path):
+    import subprocess, sys, textwrap, os as _os
+    script = tmp_path / "ps2_job.py"
+    script.write_text(textwrap.dedent("""
+        import time
+        import numpy as np
+        from paddle_tpu.distributed.fleet import fleet
+
+        fleet.init(is_collective=False)
+        if fleet.is_server():
+            fleet.init_server(); fleet.run_server()
+        else:
+            client = None
+            for _ in range(50):
+                try:
+                    client = fleet.init_worker(); break
+                except OSError:
+                    time.sleep(0.2)
+            client.create_sparse_table(1, 4, init_scale=0.0)
+            keys = np.arange(1, 9, dtype=np.uint64)
+            client.push_sparse_grad(1, keys,
+                                    np.ones((8, 4), np.float32), lr=1.0)
+            rows = client.pull_sparse(1, keys)
+            assert np.allclose(rows, -1.0), rows
+            fleet.stop_worker()
+            print("TRAINER2_OK")
+    """))
+    log_dir = str(tmp_path / "logs")
+    env = dict(_os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--server_num", "2", "--trainer_num", "1",
+         "--log_dir", log_dir, str(script)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo_root)
+    trainer_log = open(_os.path.join(log_dir, "trainerlog.0")).read()
+    assert proc.returncode == 0, (proc.stdout, proc.stderr, trainer_log)
+    assert "TRAINER2_OK" in trainer_log
