@@ -113,6 +113,7 @@ def set_cuda_rng_state(state_list):
     if state_list:
         set_rng_state(state_list[0])
 from .autograd.py_layer import PyLayer  # noqa: F401
+from .nn.lazy import LazyGuard  # noqa: F401
 
 grad = _tape_grad
 

@@ -189,11 +189,21 @@ class ShardedPSClient:
         if not endpoints:
             raise ValueError("ShardedPSClient needs at least one endpoint")
         self._clients = []
-        for ep in endpoints:
-            host, port = ep.rsplit(":", 1)
-            self._clients.append(PSClient(host, int(port), timeout_s))
+        try:
+            for ep in endpoints:
+                host, port = ep.rsplit(":", 1)
+                self._clients.append(PSClient(host, int(port), timeout_s))
+        except Exception:
+            # don't leak sockets when a later endpoint is still booting
+            # (workers retry init_worker in a loop during startup)
+            for c in self._clients:
+                c.close()
+            raise
         self._n = len(self._clients)
         self._sparse_dims = {}
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(max_workers=self._n) \
+            if self._n > 1 else None
 
     # dense: whole table on one server -----------------------------------
     def _dense_owner(self, table_id):
@@ -226,29 +236,48 @@ class ShardedPSClient:
             .astype(np.int64)
         return keys, owner
 
+    def _fanout(self, fns):
+        """Run one callable per server concurrently (latency ~max, not
+        ~sum — each PSClient has its own socket+lock)."""
+        if self._pool is None or len(fns) <= 1:
+            return [fn() for fn in fns]
+        futures = [self._pool.submit(fn) for fn in fns]
+        return [f.result() for f in futures]
+
     def pull_sparse(self, table_id, keys):
         keys, owner = self._partition(keys)
         dim = self._sparse_dims[table_id]
         out = np.empty((keys.size, dim), np.float32)
+        work = []
         for s in range(self._n):
             idx = np.nonzero(owner == s)[0]
             if idx.size:
-                out[idx] = self._clients[s].pull_sparse(table_id,
-                                                        keys[idx])
+                work.append((idx, lambda s=s, idx=idx:
+                             self._clients[s].pull_sparse(table_id,
+                                                          keys[idx])))
+        results = self._fanout([fn for _, fn in work])
+        for (idx, _), rows in zip(work, results):
+            out[idx] = rows
         return out
 
     def push_sparse_grad(self, table_id, keys, grads, lr):
         keys, owner = self._partition(keys)
         grads = np.ascontiguousarray(grads, np.float32)
+        work = []
         for s in range(self._n):
             idx = np.nonzero(owner == s)[0]
             if idx.size:
-                self._clients[s].push_sparse_grad(table_id, keys[idx],
-                                                  grads[idx], lr)
+                work.append(lambda s=s, idx=idx:
+                            self._clients[s].push_sparse_grad(
+                                table_id, keys[idx], grads[idx], lr))
+        self._fanout(work)
 
     def sparse_table_size(self, table_id):
         return sum(c.sparse_table_size(table_id) for c in self._clients)
 
     def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
         for c in self._clients:
             c.close()

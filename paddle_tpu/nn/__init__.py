@@ -3,6 +3,7 @@
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from . import quant  # noqa: F401
+from .lazy import LazyGuard, in_lazy_mode  # noqa: F401
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
                    ClipGradByValue, clip_grad_norm_, clip_grad_value_)
 from .layer.activation import *  # noqa: F401,F403

@@ -139,7 +139,9 @@ class Layer:
         dtype = convert_dtype(dtype) or self._dtype
         init = attr.initializer or default_initializer or (
             Constant(0.0) if is_bias else XavierUniform())
-        value = init(tuple(int(s) for s in shape), dtype)
+        from ..lazy import lazy_init_scope
+        with lazy_init_scope():
+            value = init(tuple(int(s) for s in shape), dtype)
         p = Parameter(value, trainable=attr.trainable, name=attr.name)
         p.optimize_attr["learning_rate"] = attr.learning_rate
         p.regularizer = attr.regularizer

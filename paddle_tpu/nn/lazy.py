@@ -1,0 +1,53 @@
+"""Lazy parameter initialization.
+
+Capability analogue of ``paddle.LazyGuard``
+(reference: python/paddle/nn/initializer/lazy_init.py — defer parameter
+materialization so huge models can be constructed before sharding).  The
+TPU design: parameters created under the guard are placed in **host (CPU)
+memory** instead of accelerator HBM; they move to the device (or to their
+sharded placement) the first time compute touches them or when an
+explicit ``shard_tensor``/``device_put`` assigns their layout.  This is
+the deferral that matters on TPU — a 70B model's fp32 init fits in host
+RAM while the mesh placement decides where each shard lives.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["LazyGuard", "in_lazy_mode"]
+
+_LAZY = False
+
+
+def in_lazy_mode() -> bool:
+    return _LAZY
+
+
+class LazyGuard:
+    """with LazyGuard(): model = BigModel()  -> params live on host."""
+
+    def __enter__(self):
+        global _LAZY
+        self._prev = _LAZY
+        _LAZY = True
+        return self
+
+    def __exit__(self, *exc):
+        global _LAZY
+        _LAZY = self._prev
+        return False
+
+
+def lazy_init_scope():
+    """Context under which parameter initializers run: in lazy mode the
+    whole init computation executes with the CPU as JAX's default device,
+    so the values are *born* in host RAM (never touching HBM — the point
+    of lazy init for models larger than a chip); otherwise a no-op."""
+    import contextlib
+    if not _LAZY:
+        return contextlib.nullcontext()
+    cpus = jax.devices("cpu")
+    if not cpus:
+        return contextlib.nullcontext()
+    return jax.default_device(cpus[0])
