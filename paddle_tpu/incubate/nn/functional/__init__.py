@@ -130,10 +130,84 @@ def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train",
     return dropout(x, p, training=training, mode=mode) + y
 
 
-def masked_multihead_attention(x, cache_kv=None, src_mask=None, **kwargs):
-    raise NotImplementedError(
-        "masked_multihead_attention (decode-step fused kernel) lands with the "
-        "inference engine; use scaled_dot_product_attention with a KV cache")
+def masked_multihead_attention(x, cache_kv=None, src_mask=None,
+                               sequence_lengths=None, num_heads=None,
+                               **kwargs):
+    """Fused decode-step attention (reference:
+    ``python/paddle/incubate/nn/functional/masked_multihead_attention.py``
+    over ``paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel``):
+    one new token per sequence attends over a growing KV cache.
+
+    x: [B, 3*H*D] packed qkv for the current step.
+    cache_kv: [2, B, H, max_seq, D] (k/v planes, written at the step slot).
+    sequence_lengths: [B] int — how many tokens are already cached (the
+    new token is written at this index).  Defaults to 0 (first step).
+    src_mask: optional additive mask [B, 1, 1, max_seq] (or broadcastable).
+
+    Returns (out [B, H*D], updated cache_kv).  Static-shape: the cache
+    stays [max_seq] and masking hides future slots — the TPU-friendly
+    formulation of the reference's in-place growing cache.
+    """
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    max_seq = cache_kv.shape[3]
+    h = cache_kv.shape[2]
+    d = cache_kv.shape[4]
+    if num_heads is not None and num_heads != h:
+        raise ValueError(
+            f"num_heads ({num_heads}) != cache heads ({h})")
+    if x.shape[-1] != 3 * h * d:
+        raise ValueError(
+            f"x last dim ({x.shape[-1]}) != 3*H*D ({3 * h * d})")
+
+    tensors = [x, cache_kv]
+    has_mask = src_mask is not None
+    if has_mask:
+        tensors.append(src_mask)
+    has_len = sequence_lengths is not None
+    if has_len:
+        tensors.append(sequence_lengths)
+
+    def impl(xa, cache, *rest):
+        r = list(rest)
+        mask = r.pop(0) if has_mask else None
+        seq_lens = (r.pop(0).astype(jnp.int32) if has_len
+                    else jnp.zeros((xa.shape[0],), jnp.int32))
+        b = xa.shape[0]
+        # cache-full guard: the new token must have a slot; clamp writes
+        # to the last slot (callers keep seq_lens < max_seq, the
+        # reference precondition)
+        seq_lens = jnp.minimum(seq_lens, max_seq - 1)
+        qkv = xa.reshape(b, 3, h, d)
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B, H, D]
+        # write the new k/v at each sequence's current slot (one-hot
+        # scatter keeps shapes static for XLA)
+        slot = jax.nn.one_hot(seq_lens, max_seq, dtype=cache.dtype)
+        k_cache = cache[0] * (1.0 - slot[:, None, :, None]) + \
+            k_new[:, :, None, :] * slot[:, None, :, None]
+        v_cache = cache[1] * (1.0 - slot[:, None, :, None]) + \
+            v_new[:, :, None, :] * slot[:, None, :, None]
+        # attend over slots [0, seq_len] (the just-written one included)
+        logits = jnp.einsum("bhd,bhsd->bhs", q, k_cache) / jnp.sqrt(
+            jnp.asarray(d, jnp.float32)).astype(q.dtype)
+        positions = jnp.arange(max_seq)[None, :]
+        valid = positions <= seq_lens[:, None]            # [B, S]
+        logits = jnp.where(valid[:, None, :], logits, -1e30)
+        if mask is not None:
+            # mask is [B|1, 1, 1, max_seq] or broadcastable: collapse the
+            # middle singleton dims and broadcast over (B, H, S)
+            m = jnp.asarray(mask)
+            m = m.reshape(m.shape[0], 1, m.shape[-1])[..., :max_seq]
+            logits = logits + m.astype(logits.dtype)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1) \
+            .astype(q.dtype)
+        out = jnp.einsum("bhs,bhsd->bhd", probs, v_cache)
+        new_cache = jnp.stack([k_cache, v_cache], axis=0)
+        return out.reshape(b, h * d), new_cache
+
+    nondiff = [False, False] + ([True] * (len(tensors) - 2))
+    return dispatch("masked_multihead_attention", impl, tuple(tensors),
+                    nondiff_mask=nondiff)
 
 
 def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,

@@ -1,0 +1,81 @@
+"""masked_multihead_attention decode-step correctness vs a full-context
+attention reference (≙ test/legacy_test/test_masked_multihead_attention_op)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn.functional import masked_multihead_attention
+
+
+def _ref_step(qkv_steps, t):
+    """Full recompute reference: attention of step t's q over k/v[0..t]."""
+    q = qkv_steps[t][:, 0]                       # [B, H, D]
+    ks = np.stack([s[:, 1] for s in qkv_steps[:t + 1]], axis=2)  # B,H,t+1,D
+    vs = np.stack([s[:, 2] for s in qkv_steps[:t + 1]], axis=2)
+    d = q.shape[-1]
+    logits = np.einsum("bhd,bhsd->bhs", q, ks) / np.sqrt(d)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    out = np.einsum("bhs,bhsd->bhd", probs, vs)
+    return out.reshape(q.shape[0], -1)
+
+
+def test_mmha_matches_full_recompute_over_steps():
+    b, h, d, max_seq, steps = 2, 4, 16, 8, 5
+    rng = np.random.default_rng(0)
+    cache = paddle.to_tensor(np.zeros((2, b, h, max_seq, d), np.float32))
+    qkv_steps = []
+    for t in range(steps):
+        qkv = rng.standard_normal((b, 3, h, d)).astype(np.float32)
+        qkv_steps.append(qkv)
+        x = paddle.to_tensor(qkv.reshape(b, 3 * h * d))
+        lens = paddle.to_tensor(np.full(b, t, np.int64))
+        out, cache = masked_multihead_attention(
+            x, cache, sequence_lengths=lens)
+        ref = _ref_step(qkv_steps, t)
+        np.testing.assert_allclose(np.asarray(out._value), ref, atol=2e-5,
+                                   rtol=1e-4)
+
+
+def test_mmha_first_step_defaults_and_mask():
+    b, h, d, max_seq = 1, 2, 8, 4
+    rng = np.random.default_rng(1)
+    qkv = rng.standard_normal((b, 3, h, d)).astype(np.float32)
+    x = paddle.to_tensor(qkv.reshape(b, 3 * h * d))
+    cache = paddle.to_tensor(np.zeros((2, b, h, max_seq, d), np.float32))
+    out, cache2 = masked_multihead_attention(x, cache)
+    # single token attends only itself -> out == v
+    np.testing.assert_allclose(np.asarray(out._value),
+                               qkv[:, 2].reshape(b, -1), atol=1e-5)
+    # cache slot 0 holds k/v
+    np.testing.assert_allclose(np.asarray(cache2._value)[0, :, :, 0],
+                               qkv[:, 1], atol=1e-6)
+
+
+def test_mmha_validates_shapes():
+    import pytest
+    cache = paddle.to_tensor(np.zeros((2, 1, 2, 4, 8), np.float32))
+    with pytest.raises(ValueError, match="3\\*H\\*D"):
+        masked_multihead_attention(
+            paddle.to_tensor(np.zeros((1, 10), np.float32)), cache)
+    with pytest.raises(ValueError, match="cache_kv"):
+        masked_multihead_attention(
+            paddle.to_tensor(np.zeros((1, 48), np.float32)))
+
+
+def test_mmha_broadcastable_mask_and_full_cache_clamp():
+    b, h, d, max_seq = 2, 2, 8, 4
+    rng = np.random.default_rng(2)
+    qkv = rng.standard_normal((b, 3, h, d)).astype(np.float32)
+    x = paddle.to_tensor(qkv.reshape(b, 3 * h * d))
+    cache = paddle.to_tensor(np.zeros((2, b, h, max_seq, d), np.float32))
+    mask = paddle.to_tensor(np.zeros((1, 1, 1, max_seq), np.float32))
+    out, _ = masked_multihead_attention(x, cache, src_mask=mask)
+    np.testing.assert_allclose(np.asarray(out._value),
+                               qkv[:, 2].reshape(b, -1), atol=1e-5)
+    # cache full: the write clamps to the last slot, new token included
+    lens = paddle.to_tensor(np.full(b, max_seq, np.int64))
+    out2, cache2 = masked_multihead_attention(
+        x, cache, sequence_lengths=lens)
+    np.testing.assert_allclose(np.asarray(cache2._value)[0, :, :, -1],
+                               qkv[:, 1], atol=1e-6)
