@@ -31,6 +31,12 @@ __all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
 
 MODEL_AXIS = "model"
 
+# Leading (batch/seq) dims of activation constraints stay UNCONSTRAINED so
+# GSPMD preserves whatever dp/sharding layout the caller established; pinning
+# them to None (replicated) forces an involuntary full rematerialization
+# (batch-sharded -> replicated reshard) on every constrained activation.
+_U = PartitionSpec.UNCONSTRAINED
+
 
 def _annotate(param, spec):
     param._dist_attr = spec
@@ -101,7 +107,7 @@ class ColumnParallelLinear(Layer):
         if not self.gather_output:
             # keep activations sharded along the model axis (last dim)
             ndim = out.ndim
-            out = _constrain(out, PartitionSpec(*([None] * (ndim - 1)),
+            out = _constrain(out, PartitionSpec(*([_U] * (ndim - 1)),
                                                 MODEL_AXIS))
         return out
 
@@ -127,12 +133,13 @@ class RowParallelLinear(Layer):
     def forward(self, x):
         if self.input_is_parallel:
             ndim = x.ndim
-            x = _constrain(x, PartitionSpec(*([None] * (ndim - 1)), MODEL_AXIS))
+            x = _constrain(x, PartitionSpec(*([_U] * (ndim - 1)), MODEL_AXIS))
         # contraction dim sharded -> GSPMD inserts the allreduce the
         # reference does via mp_allreduce (mp_ops.py:285)
         out = F.linear(x, self.weight, self.bias)
         ndim = out.ndim
-        return _constrain(out, PartitionSpec(*([None] * ndim)))
+        # last dim un-sharded (the allreduce point); batch dims stay free
+        return _constrain(out, PartitionSpec(*([_U] * (ndim - 1)), None))
 
 
 class ParallelCrossEntropy(Layer):

@@ -171,9 +171,42 @@ def shard_optimizer(optimizer, shard_fn=None):
 
 
 def shard_first_divisible_dim(spec, shape, axis_size, axis_name="sharding"):
-    """Shared ZeRO layout rule: mark the first unsharded dim divisible by
-    ``axis_size`` with ``axis_name``.  Used for both stage-3 param sharding
-    and optimizer-state sharding so the two layouts always agree."""
+    """Shared ZeRO layout rule, used for both stage-3 param sharding and
+    optimizer-state sharding so the two layouts always agree.
+
+    Prefer STACKING ``axis_name`` onto a dim that is already sharded (the
+    flatten-shard layout): for a Megatron-sharded table like a
+    VocabParallelEmbedding weight (model, None), producing
+    (('model','sharding'), None) keeps the hidden dim unsharded, so the
+    embedding-output cotangent never needs a batch->hidden reshard (which
+    the SPMD partitioner can only do by involuntary full rematerialization
+    through the gather's call boundary).  Fall back to the first unsharded
+    dim divisible by ``axis_size``."""
+    mesh = None
+    try:
+        from .topology import get_global_mesh
+        mesh = get_global_mesh()
+    except Exception:
+        pass
+    for i, s in enumerate(shape):
+        if spec[i] is None or spec[i] == axis_name:
+            continue
+        existing = spec[i] if isinstance(spec[i], tuple) else (spec[i],)
+        if axis_name in existing:
+            continue
+        # without a mesh the existing axes' sizes are unknown — skip the
+        # stacking rule rather than risk an indivisible layout
+        existing_size = 0
+        if mesh is not None:
+            try:
+                existing_size = 1
+                for a in existing:
+                    existing_size *= mesh.shape[a]
+            except Exception:
+                existing_size = 0
+        if existing_size and s % (existing_size * axis_size) == 0:
+            spec[i] = existing + (axis_name,)
+            return spec
     for i, s in enumerate(shape):
         if spec[i] is None and s % axis_size == 0 and s >= axis_size:
             spec[i] = axis_name

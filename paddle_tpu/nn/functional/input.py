@@ -18,6 +18,9 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     ``paddle/phi/kernels/selected_rows/embedding_grad_kernel.cc``)."""
 
     def impl(w, idx):
+        # jnp.take's default fill mode returns NaN rows for out-of-range
+        # token ids — a mis-tokenized batch fails loudly within one step
+        # instead of silently training on a clamped row
         out = jnp.take(w, idx.astype(jnp.int32), axis=0)
         if padding_idx is not None:
             mask = (idx == padding_idx)[..., None]
