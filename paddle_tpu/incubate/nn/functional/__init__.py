@@ -17,7 +17,7 @@ from ....nn.functional.norm import layer_norm, rms_norm
 
 __all__ = ["fused_rms_norm", "fused_layer_norm", "fused_linear",
            "fused_rotary_position_embedding", "rotary_position_embedding",
-           "fused_dropout_add", "masked_multihead_attention",
+           "llama_rope", "fused_dropout_add", "masked_multihead_attention",
            "memory_efficient_attention", "fused_bias_act",
            "swiglu"]
 
@@ -60,59 +60,123 @@ def swiglu(x, y=None, name=None):
     return dispatch("swiglu", impl, (x,))
 
 
-def _apply_rope(q, k, cos, sin):
-    def rotate_half(v):
-        v1, v2 = jnp.split(v, 2, axis=-1)
-        return jnp.concatenate([-v2, v1], axis=-1)
+def _rotate_half(v):
+    v1, v2 = jnp.split(v, 2, axis=-1)
+    return jnp.concatenate([-v2, v1], axis=-1)
 
-    q_out = q * cos + rotate_half(q) * sin
-    k_out = k * cos + rotate_half(k) * sin
-    return q_out, k_out
+
+def _rotate_every_two(v):
+    """NeoX adjacent-pair rotation helper: rot[2i] = -v[2i+1],
+    rot[2i+1] = v[2i]."""
+    v_even = v[..., 0::2]
+    v_odd = v[..., 1::2]
+    return jnp.stack([-v_odd, v_even], axis=-1).reshape(v.shape)
+
+
+def llama_rope(q, k, rotary_emb_base=10000.0, position_ids=None):
+    """HF-Llama rotate_half RoPE with concat(freqs, freqs) tables — the hot
+    path used by the Llama/GPT models.  Half-table form feeds the Pallas
+    kernel directly (ops/pallas/rope.py).  q/k: [B, S, H, D]."""
+    from ....ops.pallas import rope as pallas_rope
+    d = q.shape[-1]
+    s = q.shape[1]
+    inv_freq = 1.0 / (rotary_emb_base ** (jnp.arange(0, d, 2,
+                                                     dtype=jnp.float32) / d))
+    if position_ids is not None:
+        pos = position_ids._value if hasattr(position_ids, "_value") \
+            else jnp.asarray(position_ids)
+        freqs = pos[..., None].astype(jnp.float32) * inv_freq  # [B,S,d/2]
+        cos_h = jnp.cos(freqs)[:, :, None, :]
+        sin_h = jnp.sin(freqs)[:, :, None, :]
+    else:
+        t = jnp.arange(s, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv_freq)
+        cos_h = jnp.cos(freqs)[None, :, None, :]
+        sin_h = jnp.sin(freqs)[None, :, None, :]
+
+    def rotate_one(xa):
+        if position_ids is None and pallas_rope.should_use_pallas(xa):
+            return pallas_rope.apply_rope(xa, cos_h, sin_h)
+        xf = xa.astype(jnp.float32)
+        cos2 = jnp.concatenate([cos_h, cos_h], axis=-1)
+        sin2 = jnp.concatenate([sin_h, sin_h], axis=-1)
+        return (xf * cos2 + _rotate_half(xf) * sin2).astype(xa.dtype)
+
+    def impl(qa, ka):
+        return rotate_one(qa), rotate_one(ka)
+
+    return dispatch("llama_rope", impl, (q, k))
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     position_ids=None, use_neox_rotary_style=True,
                                     time_major=False, rotary_emb_base=10000.0):
-    """RoPE (reference fused_rotary_position_embedding).  q/k: [B, S, H, D]."""
-    from ....ops.pallas import rope as pallas_rope
-    tables_built_here = sin is None or cos is None
-    if tables_built_here:
+    """RoPE with reference parity semantics
+    (``python/paddle/incubate/nn/functional/fused_rotary_position_embedding.py``
+    over ``paddle/phi/kernels/fusion/gpu/fused_rope_utils.h``).
+
+    q/k/v: [B, S, H, D] (or [S, B, H, D] when ``time_major``).
+
+    Conventions (verified against the reference kernel + its unit test
+    ``test/legacy_test/test_fused_rotary_position_embedding.py``):
+
+    - Internally-built tables use the INTERLEAVED layout — adjacent slots
+      share a frequency: table[j] uses exponent (j//2*2)/D
+      (``fused_rope_utils.h`` VectorizedGetSinCos, flag_sin_cos=false).
+      User tables are consumed in the same layout, element-by-element.
+    - ``use_neox_rotary_style=True``: adjacent-pair rotation
+      out[2i]   = x[2i]*cos[2i]   - x[2i+1]*sin[2i]
+      out[2i+1] = x[2i+1]*cos[2i+1] + x[2i]*sin[2i+1]
+      (RotateEveryTwo kernel; test ``mult_qkv``).
+    - ``use_neox_rotary_style=False``: rotate_half
+      out = x*cos + concat(-x[D/2:], x[:D/2])*sin
+      (RotateHalf kernel; test ``mult_qkv_rotate_half``).
+
+    Every tensor passed (q, and optionally k and v) is rotated; the return
+    matches the inputs that were given.
+    """
+    seq_axis = 0 if time_major else 1
+    if sin is None or cos is None:
         d = q.shape[-1]
-        s = q.shape[1]
-        inv_freq = 1.0 / (rotary_emb_base ** (jnp.arange(0, d, 2,
-                                                         dtype=jnp.float32) / d))
+        s = q.shape[seq_axis]
+        # interleaved table: exponent (j//2*2)/d for slot j
+        exps = (jnp.arange(d, dtype=jnp.float32) // 2) * 2.0 / d
+        inv_freq = 1.0 / (rotary_emb_base ** exps)          # [D]
         t = jnp.arange(s, dtype=jnp.float32)
-        freqs = jnp.outer(t, inv_freq)
-        emb = jnp.concatenate([freqs, freqs], axis=-1)
-        cos_arr = jnp.cos(emb)[None, :, None, :]
-        sin_arr = jnp.sin(emb)[None, :, None, :]
+        emb = jnp.outer(t, inv_freq)                        # [S, D]
+        cos_arr = jnp.cos(emb)
+        sin_arr = jnp.sin(emb)
     else:
         cos_arr = cos._value if hasattr(cos, "_value") else jnp.asarray(cos)
         sin_arr = sin._value if hasattr(sin, "_value") else jnp.asarray(sin)
-        if cos_arr.ndim == 2:
-            cos_arr = cos_arr[None, :, None, :]
-            sin_arr = sin_arr[None, :, None, :]
+        cos_arr = cos_arr.reshape(-1, cos_arr.shape[-1]).astype(jnp.float32)
+        sin_arr = sin_arr.reshape(-1, sin_arr.shape[-1]).astype(jnp.float32)
 
-    # Pallas path: the half-split kernel matches rotate_half exactly when
-    # the table is the NeoX concat(freqs, freqs) layout — guaranteed when
-    # we built the tables here (user-provided tables stay on XLA since
-    # verifying cos[:d/2] == cos[d/2:] would force a device sync).
-    d_half = cos_arr.shape[-1] // 2
-    use_pallas = (tables_built_here and use_neox_rotary_style
-                  and pallas_rope.should_use_pallas(q))
-    cos_h = cos_arr[..., :d_half]
-    sin_h = sin_arr[..., :d_half]
+    if position_ids is not None:
+        pos = position_ids._value if hasattr(position_ids, "_value") \
+            else jnp.asarray(position_ids)
+        cos_t = cos_arr[pos.astype(jnp.int32)]              # [B, S, D]
+        sin_t = sin_arr[pos.astype(jnp.int32)]
+        if time_major:
+            cos_t = jnp.swapaxes(cos_t, 0, 1)
+            sin_t = jnp.swapaxes(sin_t, 0, 1)
+        cos_t = cos_t[:, :, None, :]
+        sin_t = sin_t[:, :, None, :]
+    else:
+        if time_major:
+            cos_t = cos_arr[:, None, None, :]
+            sin_t = sin_arr[:, None, None, :]
+        else:
+            cos_t = cos_arr[None, :, None, :]
+            sin_t = sin_arr[None, :, None, :]
 
-    # the reference rotates every tensor passed (q, and optionally k and
-    # v); return a tuple matching the inputs that were given
-    present = [t for t in (q, k, v) if t is not None]
+    rotate = _rotate_every_two if use_neox_rotary_style else _rotate_half
+
+    present = [t_ for t_ in (q, k, v) if t_ is not None]
 
     def rotate_one(xa):
-        if use_pallas:
-            return pallas_rope.apply_rope(xa, cos_h, sin_h)
-        xo, _ = _apply_rope(xa.astype(jnp.float32), xa.astype(jnp.float32),
-                            cos_arr, sin_arr)
-        return xo.astype(xa.dtype)
+        xf = xa.astype(jnp.float32)
+        return (xf * cos_t + rotate(xf) * sin_t).astype(xa.dtype)
 
     def impl(*arrs):
         outs = tuple(rotate_one(a) for a in arrs)
@@ -194,10 +258,18 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None,
         valid = positions <= seq_lens[:, None]            # [B, S]
         logits = jnp.where(valid[:, None, :], logits, -1e30)
         if mask is not None:
-            # mask is [B|1, 1, 1, max_seq] or broadcastable: collapse the
-            # middle singleton dims and broadcast over (B, H, S)
+            # mask is [B|1, 1, 1, L] with L <= max_seq (the reference's
+            # growing-length mask) or broadcastable: collapse the middle
+            # singleton dims and right-pad to max_seq with zeros — the
+            # valid-position mask above already hides slots beyond each
+            # sequence's length, so the pad value never reaches softmax
             m = jnp.asarray(mask)
-            m = m.reshape(m.shape[0], 1, m.shape[-1])[..., :max_seq]
+            m = m.reshape(m.shape[0], 1, m.shape[-1])
+            if m.shape[-1] > max_seq:
+                m = m[..., :max_seq]
+            elif m.shape[-1] < max_seq:
+                m = jnp.pad(m, ((0, 0), (0, 0),
+                                (0, max_seq - m.shape[-1])))
             logits = logits + m.astype(logits.dtype)
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1) \
             .astype(q.dtype)

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 
 from .. import nn
 from ..nn import functional as F
-from ..incubate.nn.functional import (fused_rotary_position_embedding, swiglu)
+from ..incubate.nn.functional import llama_rope, swiglu
 from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy)
@@ -44,6 +44,10 @@ class LlamaConfig:
     tensor_parallel: bool = False
     sequence_parallel: bool = False
     recompute: bool = False
+    # jax.checkpoint policy name for recompute ("dots" saves weight-matmul
+    # outputs and recomputes attention/elementwise — see
+    # distributed/utils._resolve_policy); None = full remat
+    recompute_policy: Optional[str] = None
     dtype: str = "float32"
 
     @property
@@ -107,8 +111,8 @@ class LlamaAttention(nn.Layer):
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
-        q, k = fused_rotary_position_embedding(
-            q, k, rotary_emb_base=self.config.rope_theta)
+        q, k = llama_rope(q, k, rotary_emb_base=self.config.rope_theta,
+                          position_ids=position_ids)
         if cache is not None:
             from ..tensor.manipulation import concat
             k = concat([cache[0], k], axis=1)
@@ -152,6 +156,7 @@ class LlamaDecoderLayer(nn.Layer):
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
                                                    config.rms_norm_eps)
         self._recompute = config.recompute
+        self._recompute_policy = config.recompute_policy
 
     def _forward_impl(self, x, position_ids=None, attention_mask=None):
         h = x + self.self_attn(self.input_layernorm(x), position_ids,
@@ -162,7 +167,8 @@ class LlamaDecoderLayer(nn.Layer):
         if self._recompute and self.training:
             from ..distributed.utils import recompute
             return recompute(self._forward_impl, x, position_ids,
-                             attention_mask)
+                             attention_mask,
+                             policy=self._recompute_policy)
         return self._forward_impl(x, position_ids, attention_mask)
 
 

@@ -87,15 +87,20 @@ def _qmm(x2, qweight, scales2, out_dtype):
 
 
 def _qmm_fwd(x2, qweight, scales2, out_dtype):
-    return _qmm_impl(x2, qweight, scales2, out_dtype), (qweight, scales2)
+    # zero-size array carries the primal dtype through the residual pytree
+    # (a raw np.dtype is not a valid JAX pytree leaf)
+    return _qmm_impl(x2, qweight, scales2, out_dtype), \
+        (qweight, scales2, jnp.zeros((0,), x2.dtype))
 
 
 def _qmm_bwd(out_dtype, res, g):
-    # dx = g @ (W_int8 * scale)^T — plain XLA; weights/scales nondiff
-    qweight, scales2 = res
+    # dx = g @ (W_int8 * scale)^T — plain XLA; weights/scales nondiff.
+    # Cast back to the primal dtype: custom_vjp cotangents must match the
+    # primal aval (bf16 activations would otherwise get fp32 cotangents).
+    qweight, scales2, x_proto = res
     w = qweight.astype(jnp.float32) * scales2
     dx = g.astype(jnp.float32) @ w.T
-    return dx, None, None
+    return dx.astype(x_proto.dtype), None, None
 
 
 _qmm.defvjp(_qmm_fwd, _qmm_bwd)

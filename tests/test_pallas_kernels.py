@@ -214,22 +214,107 @@ def test_autotune_kill_switch():
         set_flags({"use_autotune": True})
 
 
-def test_fused_rope_uses_pallas_convention_equivalence():
-    # public API result must be identical whether the kernel or the XLA
-    # rotate_half path runs (they only diverge if conventions mismatch)
+def _ref_interleaved_tables(seq, d, sign=1):
+    """Reference get_sin_cos_tensor (test_fused_rotary_position_embedding.py:62):
+    interleaved layout, adjacent slots share a frequency; even sin slots
+    carry ``sign``."""
+    inv = 1.0 / (10000.0 ** (np.arange(0, d, 2, dtype=np.float32) / d))
+    vals = np.outer(np.arange(seq, dtype=np.float32), inv)   # [S, d/2]
+    sin = np.empty((seq, d), np.float32)
+    cos = np.empty((seq, d), np.float32)
+    sin[:, 0::2] = sign * np.sin(vals)
+    sin[:, 1::2] = np.sin(vals)
+    cos[:, 0::2] = np.cos(vals)
+    cos[:, 1::2] = np.cos(vals)
+    return sin, cos
+
+
+def _ref_mult_qkv(x, cos, sin):
+    """Reference mult_qkv: NeoX interleaved rotation."""
+    rot = np.stack([x[..., 1::2], x[..., 0::2]], axis=-1).reshape(x.shape)
+    return x * cos + rot * sin
+
+
+def _ref_mult_qkv_rotate_half(x, cos, sin):
+    d = x.shape[-1]
+    rot = np.concatenate([-x[..., d // 2:], x[..., :d // 2]], axis=-1)
+    return x * cos + rot * sin
+
+
+def test_fused_rope_neox_matches_reference():
+    # use_neox_rotary_style=True (default): interleaved adjacent-pair
+    # rotation with interleaved tables (reference mult_qkv + sign=-1)
     import paddle_tpu as paddle
     from paddle_tpu.incubate.nn.functional import (
         fused_rotary_position_embedding)
     rng = np.random.default_rng(7)
-    q = paddle.to_tensor(rng.standard_normal((1, 16, 2, 64))
-                         .astype(np.float32))
-    k = paddle.to_tensor(rng.standard_normal((1, 16, 2, 64))
-                         .astype(np.float32))
+    s, d = 16, 64
+    q = paddle.to_tensor(rng.standard_normal((1, s, 2, d)).astype(np.float32))
+    k = paddle.to_tensor(rng.standard_normal((1, s, 2, d)).astype(np.float32))
     qo, ko = fused_rotary_position_embedding(q, k)
-    # reference rotate_half computed directly
-    d = 64
+    sin, cos = _ref_interleaved_tables(s, d, sign=-1)
+    ref = _ref_mult_qkv(np.asarray(q._value),
+                        cos[None, :, None, :], sin[None, :, None, :])
+    np.testing.assert_allclose(np.asarray(qo._value), ref, atol=1e-5)
+    refk = _ref_mult_qkv(np.asarray(k._value),
+                         cos[None, :, None, :], sin[None, :, None, :])
+    np.testing.assert_allclose(np.asarray(ko._value), refk, atol=1e-5)
+
+
+def test_fused_rope_rotate_half_matches_reference():
+    # use_neox_rotary_style=False: rotate_half with the same interleaved
+    # internal tables (reference mult_qkv_rotate_half + sign=+1)
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn.functional import (
+        fused_rotary_position_embedding)
+    rng = np.random.default_rng(9)
+    s, d = 8, 32
+    q = paddle.to_tensor(rng.standard_normal((2, s, 2, d)).astype(np.float32))
+    qo = fused_rotary_position_embedding(q, use_neox_rotary_style=False)
+    sin, cos = _ref_interleaved_tables(s, d, sign=1)
+    ref = _ref_mult_qkv_rotate_half(np.asarray(q._value),
+                                    cos[None, :, None, :],
+                                    sin[None, :, None, :])
+    np.testing.assert_allclose(np.asarray(qo._value), ref, atol=1e-5)
+
+
+def test_fused_rope_user_tables_and_position_ids():
+    # user-provided [1, S, 1, D] tables (sign=+1 layout) + scrambled
+    # position_ids must match the reference python impl
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn.functional import (
+        fused_rotary_position_embedding)
+    rng = np.random.default_rng(11)
+    s, d = 8, 16
+    q = paddle.to_tensor(rng.standard_normal((2, s, 2, d)).astype(np.float32))
+    sin, cos = _ref_interleaved_tables(s, d, sign=1)
+    pos = np.stack([rng.permutation(s), rng.permutation(s)]).astype(np.int64)
+    qo = fused_rotary_position_embedding(
+        q, sin=paddle.to_tensor(sin[None, :, None, :]),
+        cos=paddle.to_tensor(cos[None, :, None, :]),
+        position_ids=paddle.to_tensor(pos))
+    # reference comparison: the python impl builds sign=-1 tables and uses
+    # the non-negating mult_qkv; the fused op receives sign=+1 tables and
+    # negates inside the NeoX rotation — both give the same result
+    sin_m, cos_m = _ref_interleaved_tables(s, d, sign=-1)
+    cos_g = cos_m[pos][:, :, None, :]   # [B, S, 1, D]
+    sin_g = sin_m[pos][:, :, None, :]
+    ref = _ref_mult_qkv(np.asarray(q._value), cos_g, sin_g)
+    np.testing.assert_allclose(np.asarray(qo._value), ref, atol=1e-5)
+
+
+def test_llama_rope_hf_convention_and_pallas_equivalence():
+    # llama_rope = HF rotate_half with concat(freqs, freqs) tables; the
+    # Pallas kernel path and the XLA path must agree
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn.functional import llama_rope
+    rng = np.random.default_rng(13)
+    s, d = 16, 64
+    q = paddle.to_tensor(rng.standard_normal((1, s, 2, d)).astype(np.float32))
+    k = paddle.to_tensor(rng.standard_normal((1, s, 2, d)).astype(np.float32))
+    qo, ko = llama_rope(q, k)
     inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
-    freqs = np.outer(np.arange(16, dtype=np.float32), inv)
+    freqs = np.outer(np.arange(s, dtype=np.float32), inv)
     emb = np.concatenate([freqs, freqs], -1)[None, :, None, :]
     cos, sin = np.cos(emb), np.sin(emb)
     qn = np.asarray(q._value)
