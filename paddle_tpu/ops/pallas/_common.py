@@ -5,6 +5,7 @@ from __future__ import annotations
 import functools
 
 import jax
+import numpy as np
 
 from ...core.flags import flag
 
@@ -30,11 +31,16 @@ def _pallas_compiles() -> bool:
         def k(x_ref, o_ref):
             o_ref[:] = x_ref[:] * 2.0
 
-        out = pl.pallas_call(
-            k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
-        )(jnp.ones((8, 128), jnp.float32))
-        out.block_until_ready()
-        return bool(out[0, 0] == 2.0)
+        # ensure_compile_time_eval: the probe's first call may happen while
+        # a jit/grad trace is active (e.g. inside TrainStep tracing); without
+        # it jnp.ones would be a tracer and the probe would spuriously fail,
+        # caching False and silently disabling every Pallas kernel
+        with jax.ensure_compile_time_eval():
+            out = pl.pallas_call(
+                k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            )(jnp.ones((8, 128), jnp.float32))
+            ok = bool(np.asarray(out)[0, 0] == 2.0)
+        return ok
     except Exception:
         return False
 

@@ -43,6 +43,13 @@ def should_use_pallas(query, causal=False, dropout=0.0, key=None) -> bool:
     b, s, h, d = query.shape
     if not (s >= 128 and d in (64, 128, 256) and s % 128 == 0):
         return False
+    if on_tpu() and s < 4096:
+        # measured on v5e (llama-1B class, b8 s2048, bf16): XLA's fused
+        # attention wins by ~5-10% end-to-end at short sequences — the
+        # O(s^2) probs fit in HBM and XLA's bwd reuses them, while the
+        # flash bwd recomputes scores twice.  The kernel takes over where
+        # probs materialization (34 GB at s=8192) stops being an option.
+        return False
     if key is not None:
         sk = key.shape[1]
         # kernel semantics assume the self-attention layout: equal q/k
@@ -61,11 +68,14 @@ def should_use_pallas(query, causal=False, dropout=0.0, key=None) -> bool:
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_k,
                 scale, causal, block_q):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [block_q, d]
+    # matmul operands stay in the input dtype (bf16 in training — the MXU
+    # runs bf16 at full rate, fp32 at ~1/4); accumulation and softmax
+    # statistics are fp32 via preferred_element_type
+    q = q_ref[0]                                       # [block_q, d]
 
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros_like(q)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
 
     n_kb = seq_k // block_k
     if causal:
@@ -80,10 +90,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_k,
 
     def body(kb, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -93,7 +103,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_k,
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -109,8 +119,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_k,
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                block_k, seq_k, scale, causal, block_q):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0][:, 0]
     delta = delta_ref[0][:, 0]
 
@@ -121,10 +131,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         jnp.int32, (block_q, block_k), 0)
 
     def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -133,18 +143,21 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        return dq + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, upper, body, jnp.zeros_like(q))
+    dq = jax.lax.fori_loop(0, upper, body,
+                           jnp.zeros((block_q, q.shape[-1]), jnp.float32))
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, *, block_q, seq_q, scale, causal, block_k):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                   # [block_k, d]
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]                                       # [block_k, d]
+    v = v_ref[0]
+    d = k.shape[-1]
 
     n_qb = seq_q // block_q
     lower = (ki * block_k) // block_q if causal else 0
@@ -153,32 +166,33 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :] \
-            .astype(jnp.float32) * scale
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[0, pl.ds(qb * block_q, block_q), 0]
         delta = delta_ref[0, pl.ds(qb * block_q, block_q), 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                   # [bq, bk]
         dv_new = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
         dk_new = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
-    dk, dv = jax.lax.fori_loop(lower, n_qb, body,
-                               (jnp.zeros_like(k), jnp.zeros_like(v)))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dk, dv = jax.lax.fori_loop(
+        lower, n_qb, body,
+        (jnp.zeros((block_k, d), jnp.float32),
+         jnp.zeros((block_k, d), jnp.float32)))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
@@ -293,6 +307,10 @@ def flash_attention(q, k, v, causal=False, block_q=None, block_k=None):
     sk = k.shape[1]
     hk = k.shape[2]
     if hk != hq:
+        if hq % hk:
+            raise ValueError(
+                f"flash_attention: q heads ({hq}) must be a multiple of "
+                f"kv heads ({hk}) for GQA broadcast")
         rep = hq // hk
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)

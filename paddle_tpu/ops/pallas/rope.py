@@ -19,20 +19,28 @@ from jax.experimental import pallas as pl
 from ._common import on_tpu, pallas_enabled
 
 
+def _pick_block_s(s, h, d):
+    """Sequence-block size keeping the kernel's fp32 working set (input,
+    output, halves, temporaries ~ 6 block-sized arrays) under ~4 MB of the
+    ~16 MB per-core VMEM.  None when no even divisor fits (odd s too big)."""
+    bs = s
+    while 6 * bs * h * d * 4 > (4 << 20) and bs % 2 == 0:
+        bs //= 2
+    return bs if 6 * bs * h * d * 4 <= (4 << 20) else None
+
+
 def should_use_pallas(q) -> bool:
     if not pallas_enabled():
         return False
     if not (q.ndim == 4 and q.shape[-1] % 2 == 0 and q.shape[-1] >= 64):
         return False
-    # the kernel maps one [1, s, h, d] block per grid step: keep the fp32
-    # working set (input + output + halves) inside the ~16 MB VMEM budget
     b, s, h, d = q.shape
-    return 3 * s * h * d * 4 <= 12 * 1024 * 1024
+    return _pick_block_s(s, h, d) is not None
 
 
 def _rope_kernel(x_ref, cos_ref, sin_ref, y_ref):
-    x = x_ref[:].astype(jnp.float32)        # [1, s, h, d]
-    cos = cos_ref[:].astype(jnp.float32)    # [1, s, 1, d//2]
+    x = x_ref[:].astype(jnp.float32)        # [1, block_s, h, d]
+    cos = cos_ref[:].astype(jnp.float32)    # [1, block_s, 1, d//2]
     sin = sin_ref[:].astype(jnp.float32)
     d = x.shape[-1]
     x1 = x[..., : d // 2]
@@ -43,16 +51,19 @@ def _rope_kernel(x_ref, cos_ref, sin_ref, y_ref):
 
 
 def _rope_call(x, cos, sin):
-    b = x.shape[0]
+    b, s, h, d = x.shape
+    bs = _pick_block_s(s, h, d)
+    if bs is None:  # gate normally prevents this; direct callers fall back
+        bs = s
     return pl.pallas_call(
         _rope_kernel,
-        grid=(b,),
+        grid=(b, s // bs),
         in_specs=[
-            pl.BlockSpec((1,) + x.shape[1:], lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((1,) + cos.shape[1:], lambda i: (0, 0, 0, 0)),
-            pl.BlockSpec((1,) + sin.shape[1:], lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((1, bs, h, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, bs, 1, d // 2), lambda i, j: (0, j, 0, 0)),
+            pl.BlockSpec((1, bs, 1, d // 2), lambda i, j: (0, j, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1,) + x.shape[1:], lambda i: (i, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, bs, h, d), lambda i, j: (i, j, 0, 0)),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         interpret=not on_tpu(),
     )(x, cos, sin)
