@@ -246,6 +246,15 @@ class Tensor:
         return self._value.shape[0]
 
     def __bool__(self):
+        import jax
+        if isinstance(self._value, jax.core.Tracer):
+            raise TypeError(
+                "bool() on a traced Tensor: Python control flow over "
+                "tensor values inside a compiled region needs dy2static "
+                "conversion — decorate the function with "
+                "paddle.jit.to_static (its source must be available; "
+                "REPL/stdin-defined functions cannot be converted) or use "
+                "paddle.static.nn.cond/while_loop explicitly.")
         return bool(np.asarray(self._value))
 
     def __int__(self):

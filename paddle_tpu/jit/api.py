@@ -46,6 +46,12 @@ class StaticFunction:
     def __init__(self, function, input_spec=None, build_strategy=None,
                  backend=None, full_graph=True):
         functools.update_wrapper(self, function)
+        if full_graph:
+            # dy2static: rewrite Python if/while/for-range over tensor
+            # predicates into lax control flow (see jit/dy2static.py; the
+            # reference's AST transformer stack)
+            from .dy2static import convert_to_static
+            function = convert_to_static(function)
         self._function = function
         self._input_spec = input_spec
         self._cache = {}

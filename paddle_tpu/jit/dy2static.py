@@ -1,0 +1,612 @@
+"""dy2static: AST conversion of Python control flow for to_static.
+
+Capability analogue of the reference's dy2static transformer stack
+(``python/paddle/jit/dy2static/ifelse_transformer.py``,
+``loop_transformer.py``, ``convert_operators.py`` — ~20 AST transformers +
+the SOT bytecode path).  The TPU-native design is much smaller because the
+heavy lifting is done at RUNTIME by :mod:`paddle_tpu.static.control_flow`:
+
+- every ``if``/``while``/``for range()`` statement is rewritten into a call
+  to a ``convert_*`` helper, passing the (possibly-undefined) local
+  variables the construct reads/writes;
+- at runtime the helper checks whether the predicate is a jax tracer: a
+  concrete predicate executes the chosen branch directly (exact eager
+  semantics, side effects included), a traced predicate lowers to
+  ``lax.cond`` / ``lax.while_loop`` via static/control_flow.py;
+- constructs the converter cannot express under tracing (break/continue,
+  one-sided early returns) are left as plain Python but their predicate is
+  wrapped in :func:`assert_not_traced`, which raises a clear error naming
+  the construct instead of jax's opaque TracerBoolConversionError.
+
+This mirrors the reference's split between compile-time transformers and
+``_jst`` runtime converters (``python/paddle/jit/dy2static/convert_call_func.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+_JST = "__ptpu_jst__"
+
+
+class Undefined:
+    """Placeholder for a local that is not yet bound at the control-flow
+    site (reference: dy2static UndefinedVar)."""
+
+    _singleton = None
+
+    def __new__(cls):
+        if cls._singleton is None:
+            cls._singleton = super().__new__(cls)
+        return cls._singleton
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEFINED = Undefined()
+
+
+def _unwrap(v):
+    return v._value if isinstance(v, Tensor) else v
+
+
+def _is_tracer(v):
+    return isinstance(_unwrap(v), jax.core.Tracer)
+
+
+# ---------------------------------------------------------------------------
+# runtime converters (the _jst namespace inside transformed code)
+# ---------------------------------------------------------------------------
+
+def convert_ifelse(pred, true_fn, false_fn, in_values):
+    """if/else over possibly-traced predicate.
+
+    true_fn/false_fn take ``in_values`` (current values of the locals the
+    branches read) and return the tuple of locals the branches assign.
+    """
+    if _is_tracer(pred):
+        from ..static.control_flow import cond
+        return cond(pred, lambda: true_fn(*in_values),
+                    lambda: false_fn(*in_values))
+    if bool(_unwrap(pred)):
+        return true_fn(*in_values)
+    return false_fn(*in_values)
+
+
+def convert_while(cond_fn, body_fn, loop_vars):
+    """while over possibly-traced condition; loop_vars is a tuple of the
+    locals carried across iterations.  Returns the final loop_vars."""
+    first = cond_fn(*loop_vars)
+    if _is_tracer(first) or any(_is_tracer(v) for v in loop_vars):
+        from ..static.control_flow import while_loop
+        out = while_loop(cond_fn, body_fn, list(loop_vars))
+        return tuple(out)
+    vars_ = tuple(loop_vars)
+    cont = bool(_unwrap(first))
+    while cont:
+        vars_ = tuple(body_fn(*vars_))
+        cont = bool(_unwrap(cond_fn(*vars_)))
+    return vars_
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    l = lhs_fn()
+    if _is_tracer(l):
+        return Tensor(jnp.logical_and(jnp.asarray(_unwrap(l)).astype(bool),
+                                      jnp.asarray(_unwrap(rhs_fn()))
+                                      .astype(bool)))
+    if not bool(_unwrap(l)):
+        return l  # python short-circuit semantics
+    return rhs_fn()
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    l = lhs_fn()
+    if _is_tracer(l):
+        return Tensor(jnp.logical_or(jnp.asarray(_unwrap(l)).astype(bool),
+                                     jnp.asarray(_unwrap(rhs_fn()))
+                                     .astype(bool)))
+    if bool(_unwrap(l)):
+        return l
+    return rhs_fn()
+
+
+def convert_logical_not(v):
+    if _is_tracer(v):
+        return Tensor(jnp.logical_not(jnp.asarray(_unwrap(v)).astype(bool)))
+    return not bool(_unwrap(v))
+
+
+def assert_not_traced(pred, construct):
+    """Clear trace-time error for constructs dy2static cannot convert."""
+    if _is_tracer(pred):
+        raise NotImplementedError(
+            f"to_static: {construct} cannot be converted to XLA control "
+            "flow. Restructure without break/continue/one-sided return, "
+            "or compute the predicate outside the traced function. "
+            "(reference analogue: dy2static loop/return transformers)")
+    return pred
+
+
+def range_final(i_after, start, step):
+    """Post-loop fixup for converted ``for i in range()``: the while form
+    leaves i at the first FAILING value; Python leaves it at the last
+    YIELDED value (and unbound when the range was empty)."""
+    if _is_tracer(i_after) or _is_tracer(start) or _is_tracer(step):
+        return i_after - step  # traced zero-trip + post-loop read is UB
+    if _unwrap(i_after) == _unwrap(start):
+        return UNDEFINED  # zero iterations: Python leaves i unbound
+    return i_after - step
+
+
+def range_cond(i, stop, step):
+    """Sign-aware range continuation test usable both ways."""
+    if _is_tracer(i) or _is_tracer(stop) or _is_tracer(step):
+        iv, sv, stv = (jnp.asarray(_unwrap(x)) for x in (i, stop, step))
+        return Tensor(jnp.where(stv > 0, iv < sv, iv > sv))
+    iv, sv, stv = _unwrap(i), _unwrap(stop), _unwrap(step)
+    return iv < sv if stv > 0 else iv > sv
+
+
+# ---------------------------------------------------------------------------
+# AST analysis helpers
+# ---------------------------------------------------------------------------
+
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+
+
+def _walk_scope(node):
+    """Yield nodes of the statement without descending into nested defs
+    (a nested def is yielded but its body — with its own returns, stores,
+    loads — belongs to the inner scope and is never entered)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, _SCOPE_BARRIERS):
+            continue
+        for child in ast.iter_child_nodes(n):
+            stack.append(child)
+
+
+def _names(nodes, ctx_types):
+    out = set()
+    for root in nodes:
+        for n in _walk_scope(root):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ctx_types):
+                out.add(n.id)
+    return out
+
+
+def _stores(nodes):
+    return _names(nodes, (ast.Store,))
+
+
+def _loads(nodes):
+    return _names(nodes, (ast.Load,))
+
+
+def _has_node(nodes, kinds):
+    for root in nodes:
+        for n in _walk_scope(root):
+            if isinstance(n, kinds):
+                return True
+    return False
+
+
+def _loop_controls_for_body(body):
+    """break/continue belonging to THIS loop (not nested loops)."""
+    def scan(stmts):
+        for s in stmts:
+            if isinstance(s, (ast.Break, ast.Continue)):
+                return True
+            if isinstance(s, (ast.For, ast.While, *_SCOPE_BARRIERS)):
+                continue
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                sub = getattr(s, field, None)
+                if sub:
+                    if field == "handlers":
+                        if any(scan(h.body) for h in sub):
+                            return True
+                    elif scan(sub):
+                        return True
+        return False
+    return scan(body)
+
+
+def _ends_with_return(body):
+    return bool(body) and isinstance(body[-1], ast.Return)
+
+
+# ---------------------------------------------------------------------------
+# code-construction helpers
+# ---------------------------------------------------------------------------
+
+def _name_load(n):
+    return ast.Name(id=n, ctx=ast.Load())
+
+
+def _name_store(n):
+    return ast.Name(id=n, ctx=ast.Store())
+
+
+def _jst_attr(fn_name):
+    return ast.Attribute(value=_name_load(_JST), attr=fn_name,
+                         ctx=ast.Load())
+
+
+def _guard_defined(names):
+    """try: name \n except (NameError, UnboundLocalError): name = UNDEFINED"""
+    stmts = []
+    for n in sorted(names):
+        stmts.append(ast.Try(
+            body=[ast.Expr(value=_name_load(n))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple(elts=[_name_load("NameError"),
+                                     _name_load("UnboundLocalError")],
+                               ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(targets=[_name_store(n)],
+                                 value=_jst_attr("UNDEFINED"))])],
+            orelse=[], finalbody=[]))
+    return stmts
+
+
+def _tuple_load(names):
+    return ast.Tuple(elts=[_name_load(n) for n in names], ctx=ast.Load())
+
+
+def _tuple_store(names):
+    return ast.Tuple(elts=[_name_store(n) for n in names], ctx=ast.Store())
+
+
+def _return_tuple(names):
+    return ast.Return(value=_tuple_load(names))
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+# ---------------------------------------------------------------------------
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites if/while/for statements in one function scope.  Nested
+    function defs are left untouched (convert them separately)."""
+
+    def __init__(self, local_names):
+        self.locals = set(local_names)
+        self.n = 0
+
+    def _uid(self, kind):
+        self.n += 1
+        return f"__ptpu_{kind}_{self.n}"
+
+    # do not descend into nested scopes
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def _convert_test(self, test):
+        """Convert and/or/not over possibly-traced values inside a
+        predicate expression (short-circuit preserved when concrete)."""
+        if isinstance(test, ast.BoolOp):
+            sub = [self._convert_test(v) for v in test.values]
+            fn = ("convert_logical_and" if isinstance(test.op, ast.And)
+                  else "convert_logical_or")
+            expr = sub[0]
+            for rhs in sub[1:]:
+                expr = ast.Call(
+                    func=_jst_attr(fn),
+                    args=[ast.Lambda(args=_empty_args(), body=expr),
+                          ast.Lambda(args=_empty_args(), body=rhs)],
+                    keywords=[])
+            return expr
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return ast.Call(func=_jst_attr("convert_logical_not"),
+                            args=[self._convert_test(test.operand)],
+                            keywords=[])
+        return test
+
+    # ---- if ----------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        branches = node.body + node.orelse
+        has_return = _has_node(branches, (ast.Return,))
+        test = self._convert_test(node.test)
+
+        if _loop_controls_for_body(branches):
+            # break/continue belong to an enclosing loop: hoisting the
+            # branch into a function would be a SyntaxError.  Leave the if
+            # as Python; the enclosing loop is likewise left unconverted
+            # (its body contains the jump), so the predicate guard below
+            # gives the clear trace-time error.
+            node.test = ast.Call(
+                func=_jst_attr("assert_not_traced"),
+                args=[test, ast.Constant(
+                    value="'if' containing break/continue")],
+                keywords=[])
+            return node
+
+        if has_return:
+            both_return = (_ends_with_return(node.body)
+                           and node.orelse and _ends_with_return(node.orelse))
+            if not both_return:
+                # leave as Python; raise clearly if the pred is traced
+                node.test = ast.Call(
+                    func=_jst_attr("assert_not_traced"),
+                    args=[test, ast.Constant(
+                        value="'if' with a one-sided return")],
+                    keywords=[])
+                return node
+            # both branches return: branch fns keep their returns
+            in_vars = sorted((_loads(branches) | _loads([node.test]))
+                             & self.locals)
+            tname, fname = self._uid("true_fn"), self._uid("false_fn")
+            t_def = _make_funcdef(tname, in_vars, node.body)
+            f_def = _make_funcdef(fname, in_vars, node.orelse)
+            call = ast.Call(
+                func=_jst_attr("convert_ifelse"),
+                args=[test, _name_load(tname), _name_load(fname),
+                      _tuple_load(in_vars)],
+                keywords=[])
+            return (_guard_defined(in_vars) +
+                    [t_def, f_def, ast.Return(value=call)])
+
+        stores = sorted(_stores(branches))
+        self.locals.update(stores)
+        in_vars = sorted(((_loads(branches) | _loads([node.test]))
+                          & self.locals) | set(stores))
+        out_vars = stores
+        if not out_vars:
+            # pure side-effect if (e.g. list.append) — run under convert
+            # with no outputs
+            tname, fname = self._uid("true_fn"), self._uid("false_fn")
+            t_def = _make_funcdef(tname, in_vars,
+                                  node.body + [_return_tuple([])])
+            f_def = _make_funcdef(fname, in_vars,
+                                  (node.orelse or [ast.Pass()]) +
+                                  [_return_tuple([])])
+            call = ast.Call(func=_jst_attr("convert_ifelse"),
+                            args=[test, _name_load(tname), _name_load(fname),
+                                  _tuple_load(in_vars)],
+                            keywords=[])
+            return (_guard_defined(in_vars) +
+                    [t_def, f_def, ast.Expr(value=call)])
+
+        tname, fname = self._uid("true_fn"), self._uid("false_fn")
+        t_def = _make_funcdef(tname, in_vars,
+                              node.body + [_return_tuple(out_vars)])
+        f_def = _make_funcdef(fname, in_vars,
+                              (node.orelse or [ast.Pass()]) +
+                              [_return_tuple(out_vars)])
+        call = ast.Call(func=_jst_attr("convert_ifelse"),
+                        args=[test, _name_load(tname), _name_load(fname),
+                              _tuple_load(in_vars)],
+                        keywords=[])
+        assign = ast.Assign(targets=[_tuple_store(out_vars)], value=call)
+        return _guard_defined(set(in_vars) | set(out_vars)) + \
+            [t_def, f_def, assign]
+
+    # ---- while -------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        test = self._convert_test(node.test)
+        unsupported = (_has_node(node.body, (ast.Return,))
+                       or _loop_controls_for_body(node.body)
+                       or node.orelse)
+        if unsupported:
+            node.test = ast.Call(
+                func=_jst_attr("assert_not_traced"),
+                args=[test, ast.Constant(
+                    value="'while' with break/continue/return/else")],
+                keywords=[])
+            return node
+
+        stores = sorted(_stores(node.body))
+        self.locals.update(stores)
+        loop_vars = sorted((set(stores) |
+                            (_loads([node.test]) & self.locals)))
+        cname, bname = self._uid("while_cond"), self._uid("while_body")
+        c_def = _make_funcdef(cname, loop_vars, [ast.Return(value=test)])
+        b_def = _make_funcdef(bname, loop_vars,
+                              node.body + [_return_tuple(loop_vars)])
+        call = ast.Call(func=_jst_attr("convert_while"),
+                        args=[_name_load(cname), _name_load(bname),
+                              _tuple_load(loop_vars)],
+                        keywords=[])
+        assign = ast.Assign(targets=[_tuple_store(loop_vars)], value=call)
+        return _guard_defined(loop_vars) + [c_def, b_def, assign]
+
+    # ---- for range() -------------------------------------------------
+    def visit_For(self, node):
+        self.generic_visit(node)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and 1 <= len(node.iter.args) <= 3
+                    and isinstance(node.target, ast.Name))
+        unsupported = (_has_node(node.body, (ast.Return,))
+                       or _loop_controls_for_body(node.body)
+                       or node.orelse)
+        if not is_range or unsupported:
+            return node  # plain python iteration (unrolls under trace)
+
+        args = node.iter.args
+        if len(args) == 1:
+            start, stop, step = ast.Constant(value=0), args[0], \
+                ast.Constant(value=1)
+        elif len(args) == 2:
+            start, stop, step = args[0], args[1], ast.Constant(value=1)
+        else:
+            start, stop, step = args
+
+        ivar = node.target.id
+        start_v = self._uid("start")
+        stop_v = self._uid("stop")
+        step_v = self._uid("step")
+        self.locals.update({ivar, start_v, stop_v, step_v})
+        pre = [ast.Assign(targets=[_name_store(start_v)], value=start),
+               ast.Assign(targets=[_name_store(stop_v)], value=stop),
+               ast.Assign(targets=[_name_store(step_v)], value=step),
+               ast.Assign(targets=[_name_store(ivar)],
+                          value=_name_load(start_v))]
+
+        stores = sorted(set(_stores(node.body)) | {ivar})
+        self.locals.update(stores)
+        loop_vars = sorted(set(stores) | {ivar, stop_v, step_v})
+        test = ast.Call(func=_jst_attr("range_cond"),
+                        args=[_name_load(ivar), _name_load(stop_v),
+                              _name_load(step_v)],
+                        keywords=[])
+        incr = ast.Assign(
+            targets=[_name_store(ivar)],
+            value=ast.BinOp(left=_name_load(ivar), op=ast.Add(),
+                            right=_name_load(step_v)))
+        cname, bname = self._uid("for_cond"), self._uid("for_body")
+        c_def = _make_funcdef(cname, loop_vars, [ast.Return(value=test)])
+        b_def = _make_funcdef(bname, loop_vars,
+                              node.body + [incr, _return_tuple(loop_vars)])
+        call = ast.Call(func=_jst_attr("convert_while"),
+                        args=[_name_load(cname), _name_load(bname),
+                              _tuple_load(loop_vars)],
+                        keywords=[])
+        assign = ast.Assign(targets=[_tuple_store(loop_vars)], value=call)
+        fixup = ast.Assign(
+            targets=[_name_store(ivar)],
+            value=ast.Call(func=_jst_attr("range_final"),
+                           args=[_name_load(ivar), _name_load(start_v),
+                                 _name_load(step_v)],
+                           keywords=[]))
+        return pre + \
+            _guard_defined(set(loop_vars) - {ivar, start_v, stop_v, step_v}) \
+            + [c_def, b_def, assign, fixup]
+
+
+def _empty_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+def _make_funcdef(name, argnames, body):
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=a, annotation=None) for a in argnames],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[]),
+        body=body or [ast.Pass()],
+        decorator_list=[],
+        returns=None)
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+import weakref
+
+# Keyed on the FUNCTION OBJECT (weakly), not fn.__code__: two closures
+# produced by the same factory share one code object but capture different
+# cell values, which the conversion bakes into its globals snapshot.
+_CONVERT_CACHE = weakref.WeakKeyDictionary()
+
+
+def _cache_put(fn, converted):
+    try:
+        _CONVERT_CACHE[fn] = converted
+    except TypeError:
+        pass
+
+
+def _needs_conversion(tree):
+    return any(isinstance(node, (ast.If, ast.While, ast.For))
+               for node in ast.walk(tree))
+
+
+def convert_to_static(fn):
+    """AST-convert a function's Python control flow for tracing.  Returns
+    the converted function, or ``fn`` unchanged when there is nothing to
+    convert or the source is unavailable (builtins, REPL lambdas)."""
+    try:
+        cached = _CONVERT_CACHE.get(fn)
+    except TypeError:
+        cached = None  # non-weakref-able callables (builtins, partials)
+    if cached is not None:
+        return cached
+    try:
+        source = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(source)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    if not isinstance(tree.body[0], (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+        return fn
+    func_def = tree.body[0]
+    if not _needs_conversion(func_def):
+        _cache_put(fn, fn)
+        return fn
+    func_def.decorator_list = []
+
+    arg_names = {a.arg for a in (func_def.args.posonlyargs +
+                                 func_def.args.args +
+                                 func_def.args.kwonlyargs)}
+    if func_def.args.vararg:
+        arg_names.add(func_def.args.vararg.arg)
+    if func_def.args.kwarg:
+        arg_names.add(func_def.args.kwarg.arg)
+    local_names = arg_names | _stores(func_def.body)
+
+    transformer = _ControlFlowTransformer(local_names)
+    func_def.body = [transformer.visit(s) for s in func_def.body]
+    # flatten lists returned by statement replacements
+    def _flatten(stmts):
+        out = []
+        for s in stmts:
+            if isinstance(s, list):
+                out.extend(_flatten(s))
+            else:
+                out.append(s)
+        return out
+    func_def.body = _flatten(func_def.body)
+    ast.fix_missing_locations(tree)
+
+    glb = dict(getattr(fn, "__globals__", {}))
+    import sys
+    glb[_JST] = sys.modules[__name__]
+    if getattr(fn, "__closure__", None):
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+    try:
+        code = compile(tree, filename=f"<dy2static:{fn.__name__}>",
+                       mode="exec")
+        exec(code, glb)
+        converted = glb[func_def.name]
+    except Exception:
+        return fn  # conversion must never break a function that traces fine
+    converted = functools.wraps(fn)(converted)
+    converted.__ptpu_dy2static__ = True
+    _cache_put(fn, converted)
+    return converted

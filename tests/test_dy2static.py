@@ -1,0 +1,289 @@
+"""dy2static control-flow conversion tests.
+
+Model: the reference's test/dygraph_to_static parity suite — each test
+checks that a to_static-converted function with Python control flow over
+tensor predicates matches its eager execution.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x, dtype=np.float32))
+
+
+def test_tensor_if_matches_eager():
+    def f(x):
+        if x.mean() > 0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y
+
+    static_f = to_static(f)
+    for sign in (1.0, -1.0):
+        x = _t([sign * 1.5, sign * 0.5])
+        np.testing.assert_allclose(np.asarray(static_f(x)._value),
+                                   np.asarray(f(x)._value), rtol=1e-6)
+
+
+def test_if_model_layer():
+    # VERDICT done-criterion: a model whose forward branches on data
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.mean() > 0:
+                out = paddle.nn.functional.relu(h)
+            else:
+                out = h * 0.1
+            return out
+
+    paddle.seed(0)
+    net = Net()
+    x = _t(np.random.default_rng(0).standard_normal((2, 4)))
+    eager = net(x)
+    static_net = to_static(net)
+    out = static_net(x)
+    np.testing.assert_allclose(np.asarray(out._value),
+                               np.asarray(eager._value), rtol=1e-5)
+
+
+def test_if_without_else_and_new_var():
+    def f(x):
+        y = x
+        if x.sum() > 0:
+            y = y + 10.0
+        return y
+
+    static_f = to_static(f)
+    for v in ([1.0, 2.0], [-1.0, -2.0]):
+        x = _t(v)
+        np.testing.assert_allclose(np.asarray(static_f(x)._value),
+                                   np.asarray(f(x)._value))
+
+
+def test_while_tensor_cond():
+    def f(x):
+        s = x
+        while s.sum() < 100.0:
+            s = s * 2.0
+        return s
+
+    static_f = to_static(f)
+    x = _t([1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(static_f(x)._value),
+                               np.asarray(f(x)._value))
+
+
+def test_for_range_traced_bound():
+    # range() over a traced scalar bound -> lax.while_loop
+    def f(x, n):
+        acc = x
+        for i in range(n):
+            acc = acc + 1.0
+        return acc
+
+    static_f = to_static(f)
+    x = _t([0.0, 0.0])
+    n = paddle.to_tensor(np.int32(5))
+    np.testing.assert_allclose(np.asarray(static_f(x, n)._value),
+                               np.asarray([5.0, 5.0]))
+
+
+def test_for_range_python_bound():
+    def f(x):
+        acc = x * 0.0
+        for i in range(3):
+            acc = acc + x
+        return acc
+
+    static_f = to_static(f)
+    x = _t([1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(static_f(x)._value),
+                               np.asarray(f(x)._value))
+
+
+def test_for_range_post_loop_var_matches_python():
+    # Python leaves the loop variable at the last yielded value
+    def f(x):
+        for i in range(3):
+            x = x + i
+        return x * i
+
+    static_f = to_static(f)
+    x = _t([1.0])
+    np.testing.assert_allclose(np.asarray(static_f(x)._value),
+                               np.asarray(f(x)._value))
+
+
+def test_closure_factory_not_cross_cached():
+    # two closures from one factory share a code object but must convert
+    # independently (cache is per function object)
+    def make(scale):
+        def f(x):
+            if x.mean() > 0:
+                y = x * scale
+            else:
+                y = x
+            return y
+        return f
+
+    a = to_static(make(2.0))
+    b = to_static(make(3.0))
+    x = _t([1.0])
+    np.testing.assert_allclose(np.asarray(a(x)._value), [2.0])
+    np.testing.assert_allclose(np.asarray(b(x)._value), [3.0])
+
+
+def test_nested_if_in_while():
+    def f(x):
+        s = x
+        while s.sum() < 50.0:
+            if s.mean() > 5.0:
+                s = s + 10.0
+            else:
+                s = s * 2.0
+        return s
+
+    static_f = to_static(f)
+    x = _t([1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(static_f(x)._value),
+                               np.asarray(f(x)._value))
+
+
+def test_both_branches_return():
+    def f(x):
+        if x.mean() > 0:
+            return x * 2.0
+        else:
+            return x - 1.0
+
+    static_f = to_static(f)
+    for sign in (1.0, -1.0):
+        x = _t([sign, sign * 2.0])
+        np.testing.assert_allclose(np.asarray(static_f(x)._value),
+                                   np.asarray(f(x)._value))
+
+
+def test_one_sided_return_clear_error():
+    def f(x):
+        if x.mean() > 0:
+            return x * 2.0
+        return x - 1.0
+
+    static_f = to_static(f)
+    with pytest.raises(Exception) as ei:
+        static_f(_t([1.0]))
+    assert "one-sided return" in str(ei.value) or \
+        "convert" in str(ei.value).lower()
+
+
+def test_break_concrete_ok_traced_clear_error():
+    def f(x, limit):
+        s = x
+        while s.sum() < limit:
+            s = s * 2.0
+            if s.max() > 30.0:
+                break
+        return s
+
+    # concrete python limit works (predicate concrete in eager call, but
+    # under to_static the args are traced -> clear error)
+    assert float(f(_t([1.0]), 100.0).sum()) > 0
+    static_f = to_static(f)
+    with pytest.raises(NotImplementedError) as ei:
+        static_f(_t([1.0]), _t(100.0))
+    assert "break" in str(ei.value) or "while" in str(ei.value)
+
+
+def test_logical_ops_in_predicate():
+    def f(x):
+        if x.mean() > 0 and x.max() < 10.0:
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    static_f = to_static(f)
+    for v in ([1.0, 2.0], [-1.0, 2.0], [1.0, 20.0]):
+        x = _t(v)
+        np.testing.assert_allclose(np.asarray(static_f(x)._value),
+                                   np.asarray(f(x)._value))
+
+
+def test_not_in_predicate():
+    def f(x):
+        if not (x.mean() > 0):
+            y = x * 3.0
+        else:
+            y = x
+        return y
+
+    static_f = to_static(f)
+    for sign in (1.0, -1.0):
+        x = _t([sign])
+        np.testing.assert_allclose(np.asarray(static_f(x)._value),
+                                   np.asarray(f(x)._value))
+
+
+def test_var_defined_only_in_branches():
+    def f(x):
+        if x.mean() > 0:
+            z = x * 2.0
+        else:
+            z = x * -3.0
+        return z + 1.0
+
+    static_f = to_static(f)
+    for sign in (1.0, -1.0):
+        x = _t([sign, sign])
+        np.testing.assert_allclose(np.asarray(static_f(x)._value),
+                                   np.asarray(f(x)._value))
+
+
+def test_grad_through_converted_if():
+    # converted control flow must be differentiable (cond has a transpose)
+    def f(x):
+        if x.mean() > 0:
+            y = (x * x).sum()
+        else:
+            y = (x * 3.0).sum()
+        return y
+
+    import jax
+    from paddle_tpu.jit.dy2static import convert_to_static
+    from paddle_tpu.core.tensor import Tensor
+    conv = convert_to_static(f)
+
+    def pure(xa):
+        out = conv(Tensor(xa))
+        return out._value if isinstance(out, Tensor) else out
+
+    import jax.numpy as jnp
+    for sign in (1.0, -1.0):
+        xa = jnp.asarray([sign * 1.0, sign * 2.0])
+        g = jax.grad(pure)(xa)
+        expected = 2 * xa if sign > 0 else jnp.full_like(xa, 3.0)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(expected),
+                                   rtol=1e-6)
+
+
+def test_conversion_cache_and_unconvertible_passthrough():
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def plain(x):
+        return x + 1
+
+    assert convert_to_static(plain) is plain  # nothing to convert
+    assert convert_to_static(plain) is plain  # cached
+
+    # builtins have no source: passthrough, no crash
+    assert convert_to_static(len) is len
