@@ -72,8 +72,24 @@ class PyLayer:
                            (g._value if isinstance(g, Tensor) else jnp.asarray(g)))
             return tuple(out)
 
+        def vjp_tensor_fn(ct_tensors):
+            # create_graph path: run the user backward with recording ON so
+            # the ops inside it become tape nodes and the returned grads
+            # are differentiable again
+            with _tape.enable_grad():
+                grads = cls.backward(ctx, *ct_tensors)
+            grads = grads if isinstance(grads, tuple) else (grads,)
+            out = []
+            gi = iter(grads)
+            for t in in_tensors:
+                g = next(gi, None)
+                out.append(None if g is None else
+                           (g if isinstance(g, Tensor) else Tensor(jnp.asarray(g))))
+            return tuple(out)
+
         node = _tape.TapeNode(cls.__name__, in_tensors, vjp_fn,
-                              len(out_avals), out_avals)
+                              len(out_avals), out_avals,
+                              vjp_tensor_fn=vjp_tensor_fn)
         wrapped = []
         slot = 0
         for o in outs:

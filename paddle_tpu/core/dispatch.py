@@ -177,7 +177,7 @@ def _dispatch_impl(op_name, impl, tensor_args, nondiff_mask, n_diff_outputs):
 
     out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
     node = _tape.TapeNode(op_name, in_tensors, vjp_fn, len(outs), out_avals,
-                          out_is_tuple=isinstance(out, tuple))
+                          out_is_tuple=isinstance(out, tuple), f=f)
 
     if n_diff_outputs is None:
         n_diff_outputs = len(outs)

@@ -82,22 +82,37 @@ def set_grad_enabled_ctx(mode: bool):
 
 
 class TapeNode:
-    """One recorded op: inputs (Tensors), a vjp closure, and output slots."""
+    """One recorded op: inputs (Tensors), a vjp closure, and output slots.
+
+    ``f`` is the forward closure over the diff input arrays — kept so a
+    ``create_graph=True`` backward can RE-dispatch the vjp as a recorded
+    op (re-linearized via ``jax.vjp(f, ...)``), making the cotangent
+    computation itself differentiable (reference:
+    ``paddle.grad(..., create_graph=True)``,
+    ``python/paddle/base/dygraph/base.py:600``).
+    """
 
     __slots__ = (
         "op_name", "inputs", "vjp_fn", "n_outputs", "out_avals",
-        "out_is_tuple", "_out_cotangents", "_pending", "released",
+        "out_is_tuple", "f", "vjp_tensor_fn", "_out_cotangents", "_pending",
+        "released",
     )
 
     def __init__(self, op_name: str, inputs: Sequence[Any], vjp_fn: Callable,
                  n_outputs: int, out_avals: List[Any],
-                 out_is_tuple: bool = False):
+                 out_is_tuple: bool = False, f: Callable = None,
+                 vjp_tensor_fn: Callable = None):
         self.op_name = op_name
         self.inputs = list(inputs)          # input Tensors (strong refs)
         self.vjp_fn = vjp_fn
         self.n_outputs = n_outputs
         self.out_avals = out_avals          # ShapeDtypeStruct per output
         self.out_is_tuple = out_is_tuple    # primal returned a tuple pytree
+        self.f = f                          # forward closure (diff args)
+        # create_graph alternative for nodes without a re-traceable f
+        # (PyLayer): takes Tensor cotangents, runs the user backward with
+        # recording ON, returns Tensor grads
+        self.vjp_tensor_fn = vjp_tensor_fn
         self._out_cotangents = None
         self._pending = 0
         self.released = False
@@ -105,6 +120,8 @@ class TapeNode:
     def release(self):
         self.vjp_fn = None
         self.inputs = []
+        self.f = None
+        self.vjp_tensor_fn = None
         self.released = True
 
 
@@ -112,6 +129,41 @@ def _zero_cotangent(aval):
     if jnp.issubdtype(aval.dtype, jnp.inexact):
         return jnp.zeros(aval.shape, aval.dtype)
     return np.zeros(aval.shape, jax.dtypes.float0)
+
+
+def _vjp_as_recorded_op(node, cts):
+    """create_graph backward step: evaluate the node's vjp as a DISPATCHED
+    op (re-linearized with jax.vjp from the stored forward closure), so the
+    produced cotangents carry their own tape nodes and are differentiable.
+    Returns a tuple of Tensors aligned with node.inputs."""
+    from .dispatch import dispatch
+    from .tensor import Tensor
+
+    n_out = node.n_outputs
+    f, out_is_tuple = node.f, node.out_is_tuple
+    # float0 cotangents (non-inexact outputs) stay fixed closure-side
+    var_idx = [i for i in range(n_out)
+               if not _is_float0(cts[i] if not isinstance(cts[i], Tensor)
+                                 else cts[i]._value)]
+    fixed = {i: cts[i] for i in range(n_out) if i not in set(var_idx)}
+    var_cts = [cts[i] if isinstance(cts[i], Tensor) else Tensor(cts[i])
+               for i in var_idx]
+    n_var = len(var_cts)
+
+    def impl(*arrays):
+        ct_arrays = arrays[:n_var]
+        prim = arrays[n_var:]
+        full, vi = [], iter(ct_arrays)
+        for i in range(n_out):
+            full.append(fixed[i] if i in fixed else next(vi))
+        _, vjp_fn = jax.vjp(f, *prim)
+        res = vjp_fn(tuple(full) if out_is_tuple else full[0])
+        return tuple(res) if len(res) > 1 else res[0]
+
+    with enable_grad():
+        out = dispatch("grad::" + node.op_name, impl,
+                       tuple(var_cts) + tuple(node.inputs))
+    return out if isinstance(out, tuple) else (out,)
 
 
 def _is_float0(x) -> bool:
@@ -130,15 +182,14 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
          create_graph=False, allow_unused=False):
     """``paddle.grad`` analogue: return grads of ``outputs`` w.r.t ``inputs``.
 
-    create_graph is currently unsupported in the eager tape (use the
-    functional API / :func:`paddle_tpu.incubate.autograd` for higher-order).
+    With ``create_graph=True`` every vjp evaluation is itself dispatched
+    as a recorded op, so the returned grads carry tape nodes and can be
+    differentiated again (gradient-penalty training, grad-of-grad).
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is not supported by the eager tape; "
-            "use the functional jax.grad path (paddle_tpu.jit) instead")
-    grads = _run_backward(outputs, grad_outputs, retain_graph,
-                          inputs=list(inputs), accumulate_into_grad=False)
+    grads = _run_backward(outputs, grad_outputs,
+                          retain_graph or create_graph,
+                          inputs=list(inputs), accumulate_into_grad=False,
+                          create_graph=create_graph)
     out = []
     for t, g in zip(inputs, grads):
         if g is None and not allow_unused:
@@ -150,7 +201,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
 
 
 def _run_backward(root_tensors, grad_tensors, retain_graph, inputs,
-                  accumulate_into_grad):
+                  accumulate_into_grad, create_graph=False):
     from .tensor import Tensor  # cycle-free at call time
 
     roots = [root_tensors] if isinstance(root_tensors, Tensor) else list(root_tensors)
@@ -202,6 +253,8 @@ def _run_backward(root_tensors, grad_tensors, retain_graph, inputs,
             gval = jnp.ones(t._value.shape, t._value.dtype)
         else:
             gval = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        if create_graph:
+            gval = g if isinstance(g, Tensor) else Tensor(gval)
         node = t._node
         if node is not None and id(node) in nodes:
             slot = t._out_index
@@ -222,7 +275,21 @@ def _run_backward(root_tensors, grad_tensors, retain_graph, inputs,
             ct if ct is not None else _zero_cotangent(aval)
             for ct, aval in zip(node._out_cotangents, node.out_avals)
         ]
-        in_cts = node.vjp_fn(tuple(cts) if node.out_is_tuple else cts[0])
+        if create_graph and node.f is not None:
+            in_cts = _vjp_as_recorded_op(node, cts)
+        elif create_graph and node.vjp_tensor_fn is not None:
+            ct_tensors = [c if isinstance(c, Tensor) else
+                          (c if _is_float0(c) else Tensor(c)) for c in cts]
+            in_cts = node.vjp_tensor_fn(ct_tensors)
+        elif create_graph:
+            raise NotImplementedError(
+                f"create_graph=True cannot differentiate through op "
+                f"'{node.op_name}': its backward is an opaque closure "
+                "(no re-traceable forward). Rebuild the graph with "
+                "dispatch-recorded ops or a PyLayer.")
+        else:
+            raw = [c._value if isinstance(c, Tensor) else c for c in cts]
+            in_cts = node.vjp_fn(tuple(raw) if node.out_is_tuple else raw[0])
         node._out_cotangents = None
 
         node_inputs = node.inputs
@@ -231,9 +298,12 @@ def _run_backward(root_tensors, grad_tensors, retain_graph, inputs,
                 continue
             # tensor-level hooks fire on the produced cotangent
             for hook in inp._grad_hooks:
-                new_g = hook(inp._wrap_grad(g))
+                new_g = hook(g if isinstance(g, Tensor) else
+                             inp._wrap_grad(g))
                 if new_g is not None:
-                    g = new_g._value if isinstance(new_g, Tensor) else jnp.asarray(new_g)
+                    g = new_g if create_graph and isinstance(new_g, Tensor) \
+                        else (new_g._value if isinstance(new_g, Tensor)
+                              else jnp.asarray(new_g))
             pnode = inp._node
             if pnode is not None and id(pnode) in nodes:
                 slot = inp._out_index
@@ -261,5 +331,10 @@ def _run_backward(root_tensors, grad_tensors, retain_graph, inputs,
         out = []
         for t in inputs:
             entry = tensor_grads.get(id(t))
-            out.append(None if entry is None else t._wrap_grad(entry[1]))
+            if entry is None:
+                out.append(None)
+            elif isinstance(entry[1], Tensor):
+                out.append(entry[1])       # create_graph: keeps its node
+            else:
+                out.append(t._wrap_grad(entry[1]))
         return out

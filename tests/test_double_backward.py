@@ -1,0 +1,141 @@
+"""create_graph=True (double backward) in the eager tape.
+
+Reference capability: ``paddle.grad(..., create_graph=True)``
+(``python/paddle/base/dygraph/base.py:600``), exercised by
+gradient-penalty training (WGAN-GP style).
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_grad_of_grad_polynomial():
+    # f(x) = x^3 -> f' = 3x^2 -> f'' = 6x
+    x = paddle.to_tensor(np.float32([2.0, -1.5]), stop_gradient=False)
+    y = (x * x * x).sum()
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(np.asarray(g1._value),
+                               3 * np.float32([2.0, -1.5]) ** 2, rtol=1e-6)
+    (g2,) = paddle.grad(g1.sum(), [x])
+    np.testing.assert_allclose(np.asarray(g2._value),
+                               6 * np.float32([2.0, -1.5]), rtol=1e-6)
+
+
+def test_grad_of_grad_matches_jax_matmul_tanh():
+    import jax
+    import jax.numpy as jnp
+
+    wn = np.random.default_rng(0).standard_normal((3, 3)).astype(np.float32)
+    xn = np.random.default_rng(1).standard_normal((3,)).astype(np.float32)
+
+    def f(x):
+        return jnp.sum(jnp.tanh(wn @ x))
+
+    expected_g = jax.grad(f)(jnp.asarray(xn))
+    expected_gg = jax.grad(lambda x: jnp.sum(jax.grad(f)(x)))(jnp.asarray(xn))
+
+    x = paddle.to_tensor(xn, stop_gradient=False)
+    w = paddle.to_tensor(wn)
+    y = paddle.tanh(paddle.matmul(w, x)).sum()
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(np.asarray(g1._value),
+                               np.asarray(expected_g), rtol=1e-5)
+    (g2,) = paddle.grad(g1.sum(), [x])
+    np.testing.assert_allclose(np.asarray(g2._value),
+                               np.asarray(expected_gg), rtol=1e-5)
+
+
+def test_second_order_through_layers():
+    import jax
+    import jax.numpy as jnp
+
+    paddle.seed(7)
+    lin = paddle.nn.Linear(4, 1)
+    x = paddle.to_tensor(
+        np.random.default_rng(2).standard_normal((2, 4)).astype(np.float32),
+        stop_gradient=False)
+    y = paddle.nn.functional.softplus(lin(x)).sum()
+    (gx,) = paddle.grad(y, [x], create_graph=True)
+    gp = (gx * gx).sum()            # gradient-penalty style scalar
+    gp.backward()                   # second backward into leaf params
+    assert lin.weight.grad is not None
+    assert np.isfinite(np.asarray(lin.weight.grad._value)).all()
+
+    # cross-check the double derivative with jax
+    wv = np.asarray(lin.weight._value)
+    bv = np.asarray(lin.bias._value)
+    xv = np.asarray(x._value)
+
+    def jf(w):
+        out = jax.nn.softplus(jnp.asarray(xv) @ w + bv).sum()
+        return out
+
+    def penalty(w):
+        gx_ = jax.grad(lambda xx: jax.nn.softplus(xx @ w + bv).sum())(
+            jnp.asarray(xv))
+        return jnp.sum(gx_ * gx_)
+
+    expected = jax.grad(penalty)(jnp.asarray(wv))
+    np.testing.assert_allclose(np.asarray(lin.weight.grad._value),
+                               np.asarray(expected), rtol=1e-4, atol=1e-6)
+
+
+def test_gradient_penalty_training_step_decreases():
+    # WGAN-GP-flavored: loss = f(x) + lambda * (||grad_x f|| - 1)^2
+    paddle.seed(0)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(4, 8), paddle.nn.Tanh(), paddle.nn.Linear(8, 1))
+    opt = paddle.optimizer.Adam(learning_rate=5e-2,
+                                parameters=net.parameters())
+    rng = np.random.default_rng(3)
+    xv = rng.standard_normal((8, 4)).astype(np.float32)
+
+    def penalty_loss():
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        out = net(x).sum()
+        (gx,) = paddle.grad(out, [x], create_graph=True)
+        gnorm = (gx * gx).sum(axis=-1).sqrt()
+        return ((gnorm - 1.0) ** 2).mean()
+
+    first = float(penalty_loss())
+    for _ in range(25):
+        loss = penalty_loss()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    last = float(penalty_loss())
+    assert last < first * 0.5, (first, last)
+
+
+def test_pylayer_create_graph():
+    # PyLayer backward runs with recording ON under create_graph, so its
+    # grads are differentiable again (cube: f'=3x^2, f''=6x)
+    from paddle_tpu.autograd import PyLayer
+
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            return g * 3.0 * x * x
+
+    x = paddle.to_tensor(np.float32([2.0]), stop_gradient=False)
+    y = Cube.apply(x).sum()
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(np.asarray(g1._value), [12.0])
+    (g2,) = paddle.grad(g1.sum(), [x])
+    np.testing.assert_allclose(np.asarray(g2._value), [12.0])  # 6x = 12
+
+
+def test_retain_graph_implied_by_create_graph():
+    x = paddle.to_tensor(np.float32([1.0]), stop_gradient=False)
+    y = (x * x).sum()
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    # graph still alive: differentiate the same y-chain again via g1
+    (g2,) = paddle.grad(g1.sum(), [x])
+    np.testing.assert_allclose(np.asarray(g2._value), [2.0])
