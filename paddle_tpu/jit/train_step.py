@@ -63,7 +63,13 @@ def _functional_adam(p, g, state, lr, hp):
 
 class TrainStep:
     def __init__(self, model, loss_fn: Callable, optimizer: Optimizer,
-                 mesh=None, in_shardings=None, donate: bool = True):
+                 mesh=None, in_shardings=None, donate: bool = True,
+                 accumulate_steps: int = 1, accumulate_avg: bool = True):
+        """``accumulate_steps=k`` enables in-graph gradient merge
+        (reference fleet gradient_merge meta-optimizer): every call
+        accumulates grads into fp32 buffers; the optimizer applies them
+        on each k-th call under ``lax.cond`` (averaged when
+        ``accumulate_avg``) — zero host-side branching."""
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -74,6 +80,12 @@ class TrainStep:
         self._compiled = None
         self._batch_sharding_cache = _UNSET
         self._update_fn, self._hypers = self._select_update(optimizer)
+        if accumulate_steps < 1:
+            raise ValueError(
+                f"accumulate_steps must be >= 1, got {accumulate_steps}")
+        self._accum_steps = accumulate_steps
+        self._accum_avg = accumulate_avg
+        self._gm_state = None
 
     def _select_update(self, opt):
         if isinstance(opt, AdamW):
@@ -161,9 +173,14 @@ class TrainStep:
         mesh = self._mesh()
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
+            # unannotated params pin REPLICATED: ZeRO stage-1/2 updates run
+            # on opt-state shards, and this pin is the stage-1 post-update
+            # all-gather — without it XLA would leave the new params
+            # sharded (silently promoting the layout to stage-3)
             param_pins = [
                 NamedSharding(mesh, PartitionSpec(*p._dist_attr))
-                if p._dist_attr is not None else None
+                if p._dist_attr is not None
+                else NamedSharding(mesh, PartitionSpec())
                 for p in params
             ]
             state_pins = [NamedSharding(mesh, self._opt_state_spec(p, mesh))
@@ -172,6 +189,18 @@ class TrainStep:
             param_pins = [None] * len(params)
             state_pins = [None] * len(params)
 
+        # ZeRO stage-2/3: gradients take the opt-state sharding (see the
+        # constraint below at the value_and_grad site)
+        grad_pins = None
+        if mesh is not None and getattr(
+                self.optimizer, "_group_sharded_level", None) in (
+                    "os_g", "p_g_os"):
+            grad_pins = [
+                pin if pin is not None and any(
+                    e is not None for e in self._opt_state_spec(p, mesh))
+                else None
+                for p, pin in zip(params, state_pins)]
+
         def pin(arr, sharding, like_shape):
             if sharding is None or arr.shape != like_shape:
                 return arr
@@ -179,7 +208,11 @@ class TrainStep:
 
         buffers = self._buffers
 
-        def compiled(p_values, opt_state, rng_key, lr, b_values, *inputs):
+        accum_steps = self._accum_steps
+        accum_avg = self._accum_avg
+
+        def compiled(p_values, opt_state, gm_state, rng_key, lr, b_values,
+                     *inputs):
             def loss_of(pv):
                 saved = [p._value for p in params]
                 saved_b = [b._value for b in buffers]
@@ -209,24 +242,65 @@ class TrainStep:
 
             (loss, (aux, new_b)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(list(p_values))
-            if grad_clip is not None and hasattr(grad_clip, "clip_norm"):
-                gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                          for g in grads)
-                gnorm = jnp.sqrt(gsq)
-                cn = grad_clip.clip_norm
-                scale = cn / jnp.maximum(gnorm, cn)
-                grads = [g * scale.astype(g.dtype) for g in grads]
-            new_p, new_s = [], []
-            for i, (p, g, s) in enumerate(zip(p_values, grads, opt_state)):
-                np_, ns_ = update_fn(p, g, s, lr, hypers)
-                np_ = pin(np_, param_pins[i], p.shape)
-                ns_ = {k: pin(v, state_pins[i], p.shape)
-                       for k, v in ns_.items()}
-                new_p.append(np_)
-                new_s.append(ns_)
-            return new_p, new_s, loss, aux, new_b
+            if grad_pins is not None:
+                # ZeRO stage-2/3 (os_g / p_g_os): pin each gradient to its
+                # optimizer-state sharding so XLA reduce-scatters the grad
+                # once and the whole update runs on 1/N shards — gradients
+                # never materialize replicated (reference
+                # group_sharded_stage2 reduce-scatter hooks)
+                grads = [g if gpin is None else
+                         jax.lax.with_sharding_constraint(g, gpin)
+                         for g, gpin in zip(grads, grad_pins)]
+            def apply_update(p_vals, grads_in, opt_in):
+                gs = list(grads_in)
+                if grad_clip is not None and hasattr(grad_clip, "clip_norm"):
+                    gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                              for g in gs)
+                    gnorm = jnp.sqrt(gsq)
+                    cn = grad_clip.clip_norm
+                    scale = cn / jnp.maximum(gnorm, cn)
+                    gs = [g * scale.astype(g.dtype) for g in gs]
+                new_p, new_s = [], []
+                for i, (p, g, s) in enumerate(zip(p_vals, gs, opt_in)):
+                    np_, ns_ = update_fn(p, g, s, lr, hypers)
+                    np_ = pin(np_, param_pins[i], p.shape)
+                    ns_ = {k: pin(v, state_pins[i], p.shape)
+                           for k, v in ns_.items()}
+                    new_p.append(np_)
+                    new_s.append(ns_)
+                return new_p, new_s
 
-        jit_kwargs = dict(donate_argnums=(0, 1))
+            if accum_steps == 1:
+                new_p, new_s = apply_update(p_values, grads, opt_state)
+                return new_p, new_s, gm_state, loss, aux, new_b
+
+            # gradient merge: accumulate into fp32 buffers; the optimizer
+            # fires on every accum_steps-th call under lax.cond (reference
+            # gradient_merge_optimizer's conditional block)
+            acc = [a + g.astype(jnp.float32)
+                   for a, g in zip(gm_state["acc"], grads)]
+            count = gm_state["count"] + 1
+            fire = (count % accum_steps) == 0
+
+            def fire_branch(operands):
+                p_vals, opt_in, acc_in = operands
+                gscale = (1.0 / accum_steps) if accum_avg else 1.0
+                gs = [(a * gscale).astype(p.dtype)
+                      for a, p in zip(acc_in, p_vals)]
+                new_p, new_s = apply_update(p_vals, gs, opt_in)
+                return (new_p, new_s, [jnp.zeros_like(a) for a in acc_in])
+
+            def hold_branch(operands):
+                p_vals, opt_in, acc_in = operands
+                return (list(p_vals), list(opt_in), list(acc_in))
+
+            new_p, new_s, new_acc = jax.lax.cond(
+                fire, fire_branch, hold_branch,
+                (list(p_values), list(opt_state), acc))
+            return (new_p, new_s, {"acc": new_acc, "count": count},
+                    loss, aux, new_b)
+
+        jit_kwargs = dict(donate_argnums=(0, 1, 2))
         self._compiled = jax.jit(compiled, **jit_kwargs)
 
     def _batch_sharding(self):
@@ -265,17 +339,27 @@ class TrainStep:
             return arr
         return jax.device_put(arr, sharding)
 
+    def _init_gm_state(self):
+        if self._accum_steps == 1:
+            return ()
+        return {"acc": [self._place(jnp.zeros(p._value.shape, jnp.float32),
+                                    self._opt_state_sharding(p))
+                        for p in self._params],
+                "count": jnp.zeros((), jnp.int32)}
+
     def __call__(self, *inputs):
         if self._state is None:
             self._state = self._init_state()
+            self._gm_state = self._init_gm_state()
             self._build()
         arrays = [self._shard_batch(i) for i in inputs]
         key = _generator.default_generator().next_key()
         lr = jnp.float32(self.optimizer.get_lr())
         p_values = [p._value for p in self._params]
         b_values = [b._value for b in self._buffers]
-        new_p, self._state, loss, aux, new_b = self._compiled(
-            p_values, self._state, key, lr, b_values, *arrays)
+        new_p, self._state, self._gm_state, loss, aux, new_b = self._compiled(
+            p_values, self._state, self._gm_state, key, lr, b_values,
+            *arrays)
         for p, v in zip(self._params, new_p):
             p._value = v
         for b, v in zip(self._buffers, new_b):
