@@ -101,7 +101,9 @@ def main():
             break
         except Exception as e:  # OOM -> walk down the ladder
             if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
-                last_err = e
+                # keep only the message: the traceback's _run frame pins
+                # the failed config's params/opt state in HBM
+                last_err = str(e)[:500]
                 continue
             raise
     else:
@@ -150,7 +152,7 @@ def _run(cfg, batch, seq, steps, dtype, peak_flops, on_tpu):
     pallas_in_hlo = False
     try:
         lowered = step._compiled.lower(
-            [p._value for p in step._params], step._state,
+            [p._value for p in step._params], step._state, step._gm_state,
             jax.random.PRNGKey(0), jnp.float32(1e-4),
             [b._value for b in step._buffers],
             tokens._value, labels._value)

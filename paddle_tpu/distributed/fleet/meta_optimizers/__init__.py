@@ -1,4 +1,6 @@
 from .hybrid_parallel_optimizer import HybridParallelOptimizer
 from .dygraph_sharding_optimizer import DygraphShardingOptimizer
+from .localsgd_optimizer import LocalSGDOptimizer
 
-__all__ = ["HybridParallelOptimizer", "DygraphShardingOptimizer"]
+__all__ = ["HybridParallelOptimizer", "DygraphShardingOptimizer",
+           "LocalSGDOptimizer"]

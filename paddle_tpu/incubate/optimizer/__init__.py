@@ -4,5 +4,8 @@ ModelAverage EMA-style parameter averaging with apply/restore."""
 
 from .lookahead import LookAhead
 from .modelaverage import ModelAverage
+from .lars_momentum import LarsMomentumOptimizer
+from .gradient_merge import GradientMergeOptimizer
 
-__all__ = ["LookAhead", "ModelAverage"]
+__all__ = ["LookAhead", "ModelAverage", "LarsMomentumOptimizer",
+           "GradientMergeOptimizer"]

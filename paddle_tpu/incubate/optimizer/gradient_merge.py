@@ -1,0 +1,77 @@
+"""Gradient merge — micro-batch gradient accumulation (reference:
+``python/paddle/distributed/fleet/meta_optimizers/gradient_merge_optimizer.py``
+over ``paddle/fluid/optimizer GradientMergeOptimizer``).
+
+Eager semantics: call ``step()`` after every micro-batch ``backward()``;
+gradients accumulate into fp32 buffers and the inner optimizer applies
+them every ``k_steps`` calls (averaged when ``avg``).  Between merges the
+parameters do not move, mirroring the reference's conditional update
+block.  For the fully-compiled path see
+``paddle_tpu.jit.TrainStep(accumulate_steps=k)``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["GradientMergeOptimizer"]
+
+
+class GradientMergeOptimizer:
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        if k_steps < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+        self._inner = inner_optimizer
+        self._k = k_steps
+        self._avg = avg
+        self._count = 0
+        self._acc = {}  # id(param) -> fp32 accumulation buffer
+
+    # passthrough surface used by training loops
+    @property
+    def inner_optimizer(self):
+        return self._inner
+
+    def get_lr(self):
+        return self._inner.get_lr()
+
+    def set_lr(self, lr):
+        return self._inner.set_lr(lr)
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner.set_state_dict(state)
+
+    def clear_grad(self, set_to_zero=True):
+        return self._inner.clear_grad(set_to_zero)
+
+    @property
+    def _parameter_list(self):
+        return self._inner._parameter_list
+
+    def step(self):
+        self._count += 1
+        merge_now = self._count % self._k == 0
+        for p in self._inner._parameter_list:
+            if p.grad is None:
+                continue
+            g = p.grad._value.astype(jnp.float32)
+            key = id(p)
+            self._acc[key] = g if key not in self._acc else self._acc[key] + g
+        if not merge_now:
+            # swallow this micro-batch's grads so the inner optimizer never
+            # sees partial sums (reference zeroes grads in the cond block)
+            self._inner.clear_grad()
+            return
+        scale = 1.0 / self._k if self._avg else 1.0
+        for p in self._inner._parameter_list:
+            acc = self._acc.pop(id(p), None)
+            if acc is None:
+                continue
+            p._grad = Tensor((acc * scale).astype(p._value.dtype))
+        self._inner.step()
+        self._inner.clear_grad()

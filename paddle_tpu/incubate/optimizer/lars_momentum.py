@@ -1,0 +1,75 @@
+"""LARS momentum optimizer (reference:
+``python/paddle/incubate/optimizer/lars_momentum.py:22`` over the
+``lars_momentum`` kernel).
+
+Update rule (reference docstring):
+
+    local_lr = lr * lars_coeff * ||p|| / (||g|| + lars_weight_decay*||p||)
+    velocity = mu * velocity + local_lr * (g + lars_weight_decay * p)
+    p        = p - velocity
+
+When either norm is zero the local lr falls back to the global lr (the
+kernel's guard).  ``exclude_from_weight_decay`` drops the decay term (but
+keeps LARS scaling) for matching parameter names.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["LarsMomentumOptimizer"]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2),
+                   static_argnames=("with_decay",))
+def _lars_update(p, g, vel, lr, mu, coeff, wd, eps, rescale, with_decay):
+    gf = g.astype(jnp.float32) * rescale
+    pf = p.astype(jnp.float32)
+    p_norm = jnp.sqrt(jnp.sum(pf * pf))
+    g_norm = jnp.sqrt(jnp.sum(gf * gf))
+    wd_t = wd if with_decay else 0.0
+    denom = g_norm + wd_t * p_norm + eps
+    local_lr = jnp.where((p_norm > 0) & (g_norm > 0),
+                         lr * coeff * p_norm / denom, lr)
+    v_new = mu * vel + local_lr * (gf + wd_t * pf)
+    p_new = pf - v_new
+    return p_new.astype(p.dtype), v_new
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameter_list=None,
+                 parameters=None, regularization=None, grad_clip=None,
+                 name=None, exclude_from_weight_decay=None, epsilon=0,
+                 multi_precision=False, rescale_grad=1.0):
+        super().__init__(learning_rate, parameters or parameter_list,
+                         None, grad_clip, name, multi_precision)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._exclude = list(exclude_from_weight_decay or [])
+        self._epsilon = epsilon
+        self._rescale_grad = rescale_grad
+
+    def _create_accumulators(self, p):
+        self._add_accumulator("velocity", p, dtype=jnp.float32)
+
+    def _with_decay(self, p) -> bool:
+        name = getattr(p, "name", "") or ""
+        return not any(token in name for token in self._exclude)
+
+    def _append_optimize_op(self, p, grad, lr_, wd):
+        vel = self._get_accumulator("velocity", p)
+        p_new, v_new = _lars_update(
+            p._value, grad, vel, jnp.float32(lr_),
+            jnp.float32(self._momentum), jnp.float32(self._lars_coeff),
+            jnp.float32(self._lars_weight_decay),
+            jnp.float32(self._epsilon), jnp.float32(self._rescale_grad),
+            self._with_decay(p))
+        p._value = p_new
+        self._set_accumulator("velocity", p, v_new)
