@@ -1,0 +1,364 @@
+"""Sharding completion pass (analogue of
+``python/paddle/distributed/auto_parallel/static/completion.py``, 1,880 LoC
+of dist-attr propagation rules).
+
+TPU-native formulation: the user annotates a FEW tensors (inputs and one
+or two weights, via ``shard_tensor``); this pass traces the training
+function to a jaxpr and propagates PartitionSpecs through per-primitive
+rules until a fixed point, then returns completed specs for every
+parameter.  GSPMD handles intermediate activations at compile time — the
+pass's job is to place the *parameters* consistently so XLA's propagation
+never has to guess (the source of involuntary-rematerialization
+reshards).
+
+The key inference rule is bidirectional ``dot_general`` (the Megatron
+pattern): if an activation arrives with its contraction dim sharded over
+an axis, the matching weight dim gets that axis; if a weight's free dim
+is sharded, the activation/output inherit it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.extend import core as jcore
+
+__all__ = ["complete_param_specs", "propagate_jaxpr_specs"]
+
+
+Spec = Tuple  # tuple of (None | str | tuple[str, ...]) per dim
+
+
+def _merge_entry(a, b):
+    """Merge two dim entries; annotated (non-None) wins, first wins ties."""
+    if a is None:
+        return b
+    return a
+
+
+def _merge_spec(old: Optional[Spec], new: Optional[Spec]) -> Optional[Spec]:
+    if new is None:
+        return old
+    if old is None:
+        return tuple(new)
+    if len(old) != len(new):
+        return old
+    return tuple(_merge_entry(a, b) for a, b in zip(old, new))
+
+
+class _SpecEnv:
+    def __init__(self):
+        self.specs: Dict[jcore.Var, Spec] = {}
+        self.changed = False
+
+    def get(self, v) -> Optional[Spec]:
+        if isinstance(v, jcore.Literal):
+            return None
+        return self.specs.get(v)
+
+    def set(self, v, spec: Optional[Spec]):
+        if spec is None or isinstance(v, jcore.Literal):
+            return
+        if not any(e is not None for e in spec):
+            return
+        aval = v.aval
+        if len(spec) != getattr(aval, "ndim", -1):
+            return
+        merged = _merge_spec(self.specs.get(v), spec)
+        if merged != self.specs.get(v):
+            self.specs[v] = merged
+            self.changed = True
+
+
+def _dot_general_rule(eqn, env):
+    lhs, rhs = eqn.invars
+    out = eqn.outvars[0]
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    l_ndim = lhs.aval.ndim
+    r_ndim = rhs.aval.ndim
+    l_free = [d for d in range(l_ndim) if d not in lc and d not in lb]
+    r_free = [d for d in range(r_ndim) if d not in rc and d not in rb]
+
+    ls, rs, os = env.get(lhs), env.get(rhs), env.get(out)
+
+    # forward: out = [batch..., lhs_free..., rhs_free...]
+    out_spec = [None] * out.aval.ndim
+    pos = 0
+    for i, (db_l, db_r) in enumerate(zip(lb, rb)):
+        if ls is not None:
+            out_spec[pos] = _merge_entry(out_spec[pos], ls[db_l])
+        if rs is not None:
+            out_spec[pos] = _merge_entry(out_spec[pos], rs[db_r])
+        pos += 1
+    for d in l_free:
+        if ls is not None:
+            out_spec[pos] = ls[d]
+        pos += 1
+    for d in r_free:
+        if rs is not None:
+            out_spec[pos] = rs[d]
+        pos += 1
+    env.set(out, tuple(out_spec))
+
+    # backward into rhs: contraction dims take lhs's contraction sharding;
+    # free dims take the output's
+    rhs_spec = [None] * r_ndim
+    for cl, cr in zip(lc, rc):
+        if ls is not None:
+            rhs_spec[cr] = ls[cl]
+    if os is not None:
+        base = len(lb) + len(l_free)
+        for k, d in enumerate(r_free):
+            rhs_spec[d] = os[base + k]
+    for i, (db_l, db_r) in enumerate(zip(lb, rb)):
+        if os is not None:
+            rhs_spec[db_r] = os[i]
+    env.set(rhs, tuple(rhs_spec))
+
+    # backward into lhs (symmetric)
+    lhs_spec = [None] * l_ndim
+    for cl, cr in zip(lc, rc):
+        if rs is not None:
+            lhs_spec[cl] = rs[cr]
+    if os is not None:
+        base = len(lb)
+        for k, d in enumerate(l_free):
+            lhs_spec[d] = os[base + k]
+    for i, (db_l, db_r) in enumerate(zip(lb, rb)):
+        if os is not None:
+            lhs_spec[db_l] = os[i]
+    env.set(lhs, tuple(lhs_spec))
+
+
+def _transpose_rule(eqn, env):
+    (x,), (out,) = eqn.invars, eqn.outvars
+    perm = eqn.params["permutation"]
+    xs = env.get(x)
+    if xs is not None:
+        env.set(out, tuple(xs[p] for p in perm))
+    os = env.get(out)
+    if os is not None:
+        inv = [0] * len(perm)
+        for i, p in enumerate(perm):
+            inv[p] = i
+        env.set(x, tuple(os[inv[d]] for d in range(len(perm))))
+
+
+def _reshape_dim_map(src_shape, dst_shape):
+    """Map src dims -> dst dims when every dim survives as a whole factor
+    (merging/splitting of size-1 dims and clean prefix matches).  Returns
+    dict src_dim -> dst_dim or None when ambiguous."""
+    mapping = {}
+    i = j = 0
+    while i < len(src_shape) and j < len(dst_shape):
+        if src_shape[i] == dst_shape[j]:
+            mapping[i] = j
+            i += 1
+            j += 1
+        elif src_shape[i] == 1:
+            i += 1
+        elif dst_shape[j] == 1:
+            j += 1
+        else:
+            return None  # genuine split/merge: stop propagation
+    return mapping
+
+
+def _reshape_rule(eqn, env):
+    (x,), (out,) = eqn.invars[:1], eqn.outvars
+    m = _reshape_dim_map(x.aval.shape, out.aval.shape)
+    if m is None:
+        return
+    xs = env.get(x)
+    if xs is not None:
+        spec = [None] * out.aval.ndim
+        for s, d in m.items():
+            spec[d] = xs[s]
+        env.set(out, tuple(spec))
+    os = env.get(out)
+    if os is not None:
+        spec = [None] * x.aval.ndim
+        for s, d in m.items():
+            spec[s] = os[d]
+        env.set(x, tuple(spec))
+
+
+def _broadcast_rule(eqn, env):
+    (x,), (out,) = eqn.invars, eqn.outvars
+    dims = eqn.params["broadcast_dimensions"]
+    xs = env.get(x)
+    if xs is not None:
+        spec = [None] * out.aval.ndim
+        for s, d in enumerate(dims):
+            if x.aval.shape[s] == out.aval.shape[d]:
+                spec[d] = xs[s]
+        env.set(out, tuple(spec))
+    os = env.get(out)
+    if os is not None:
+        spec = [None] * x.aval.ndim
+        for s, d in enumerate(dims):
+            if x.aval.shape[s] == out.aval.shape[d]:
+                spec[s] = os[d]
+        env.set(x, tuple(spec))
+
+
+def _reduce_rule(eqn, env):
+    (x,), (out,) = eqn.invars[:1], eqn.outvars
+    axes = eqn.params.get("axes")
+    if axes is None:
+        return
+    xs = env.get(x)
+    if xs is not None:
+        env.set(out, tuple(e for d, e in enumerate(xs) if d not in axes))
+    os = env.get(out)
+    if os is not None:
+        spec = []
+        it = iter(os)
+        for d in range(x.aval.ndim):
+            spec.append(None if d in axes else next(it))
+        env.set(x, tuple(spec))
+
+
+def _elementwise_rule(eqn, env):
+    outs = eqn.outvars
+    if not outs:
+        return
+    out = outs[0]
+    shape = getattr(out.aval, "shape", None)
+    if shape is None:
+        return
+    # same-shape peers share the full spec
+    peers = [v for v in list(eqn.invars) + [out]
+             if getattr(v.aval, "shape", None) == shape]
+    best = None
+    for v in peers:
+        best = _merge_spec(best, env.get(v))
+    if best is not None:
+        for v in peers:
+            env.set(v, best)
+    # broadcast-compatible operands (same ndim, dims equal or 1): share
+    # per-dim entries on the non-broadcast dims — this is how a bias
+    # vector inherits its layer's column sharding through the add
+    if best is None:
+        return
+    for v in eqn.invars:
+        vshape = getattr(v.aval, "shape", None)
+        if vshape is None or vshape == shape or len(vshape) != len(shape):
+            continue
+        if not all(a == b or a == 1 for a, b in zip(vshape, shape)):
+            continue
+        env.set(v, tuple(None if a == 1 else e
+                         for a, e in zip(vshape, best)))
+
+
+def _subjaxpr_of(eqn):
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        sub = eqn.params.get(key)
+        if sub is not None:
+            return sub
+    return None
+
+
+_ELEMENTWISE = {
+    "add", "sub", "mul", "div", "max", "min", "pow", "tanh", "exp", "log",
+    "logistic", "rsqrt", "sqrt", "neg", "abs", "sign", "select_n",
+    "integer_pow", "convert_element_type", "stop_gradient", "copy",
+    "erf", "sin", "cos", "and", "or", "xor", "not", "eq", "ne", "lt", "le",
+    "gt", "ge", "where", "clamp", "square",
+}
+
+
+def propagate_jaxpr_specs(jaxpr: jcore.Jaxpr,
+                          invar_specs: Sequence[Optional[Spec]],
+                          max_iters: int = 32) -> Dict[jcore.Var, Spec]:
+    """Fixed-point propagation over one jaxpr; returns specs for all vars
+    (invars included — the completed parameter placements)."""
+    env = _SpecEnv()
+    for v, s in zip(jaxpr.invars, invar_specs):
+        if s is not None:
+            env.set(v, tuple(s))
+
+    def run_eqn(eqn):
+        prim = eqn.primitive.name
+        sub = _subjaxpr_of(eqn)
+        if sub is not None:
+            inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+            n = min(len(inner.invars), len(eqn.invars))
+            for outer, v_in in zip(eqn.invars[:n], inner.invars[:n]):
+                s = env.get(outer)
+                if s is not None:
+                    env.set(v_in, s)
+            for ie in inner.eqns:
+                run_eqn(ie)
+            for outer, v_out in zip(eqn.outvars, inner.outvars):
+                s = env.get(v_out) if not isinstance(v_out, jcore.Literal) \
+                    else None
+                if s is not None:
+                    env.set(outer, s)
+                so = env.get(outer)
+                if so is not None and not isinstance(v_out, jcore.Literal):
+                    env.set(v_out, so)
+            # let outer->inner invar info flow back out too
+            for outer, v_in in zip(eqn.invars[:n], inner.invars[:n]):
+                s = env.get(v_in)
+                if s is not None:
+                    env.set(outer, s)
+            return
+        if prim == "dot_general":
+            _dot_general_rule(eqn, env)
+        elif prim == "transpose":
+            _transpose_rule(eqn, env)
+        elif prim == "reshape":
+            _reshape_rule(eqn, env)
+        elif prim == "broadcast_in_dim":
+            _broadcast_rule(eqn, env)
+        elif prim in ("reduce_sum", "reduce_max", "reduce_min",
+                      "reduce_prod", "argmax", "argmin"):
+            _reduce_rule(eqn, env)
+        elif prim in _ELEMENTWISE:
+            _elementwise_rule(eqn, env)
+        # unknown primitives: no rule — propagation stops there (safe)
+
+    for _ in range(max_iters):
+        env.changed = False
+        for eqn in jaxpr.eqns:
+            run_eqn(eqn)
+        if not env.changed:
+            break
+    return env.specs
+
+
+def complete_param_specs(fn, params, example_inputs, mesh=None):
+    """Trace ``fn(param_arrays, *input_arrays)`` and complete parameter
+    specs from the sparse annotations found on ``params`` (Tensor
+    ``_dist_attr``) and on the example inputs.
+
+    Returns a list of PartitionSpec-compatible tuples aligned with
+    ``params`` (None where nothing was inferred).
+    """
+    from ...core.tensor import Tensor
+
+    p_arrays = [p._value for p in params]
+    in_arrays = [x._value if isinstance(x, Tensor) else np.asarray(x)
+                 for x in example_inputs]
+    closed = jax.make_jaxpr(
+        lambda pv, *xs: fn(pv, *xs))(p_arrays, *in_arrays)
+    jaxpr = closed.jaxpr
+
+    invar_specs = []
+    for p in params:
+        invar_specs.append(tuple(p._dist_attr)
+                           if p._dist_attr is not None else None)
+    for x in example_inputs:
+        spec = getattr(x, "_dist_attr", None)
+        invar_specs.append(tuple(spec) if spec is not None else None)
+    specs = propagate_jaxpr_specs(jaxpr, invar_specs)
+
+    out = []
+    for v in jaxpr.invars[:len(params)]:
+        s = specs.get(v)
+        out.append(s if s is not None and any(e is not None for e in s)
+                   else None)
+    return out

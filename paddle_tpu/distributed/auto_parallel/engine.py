@@ -44,6 +44,94 @@ class Engine:
         self._metrics = metrics or []
         self._strategy = strategy or Strategy()
         self._train_step = None
+        self._completed = False
+
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train",
+                init_parameters=True):
+        """Run the completion pass from the user's sparse annotations
+        (reference Engine.prepare -> Planner/Completer).  ``inputs_spec``/
+        ``labels_spec``: InputSpec-like objects (``.shape``/``.dtype``)
+        or example Tensors used to trace the program."""
+        import jax.numpy as jnp
+
+        def example(spec):
+            if spec is None:
+                return None
+            if isinstance(spec, Tensor):
+                return spec
+            if isinstance(spec, (list, tuple)):
+                spec = spec[0]
+            if isinstance(spec, Tensor):
+                return spec
+            shape = [1 if (d is None or d == -1) else d for d in spec.shape]
+            dtype = getattr(spec, "dtype", "float32")
+            if "int" in str(dtype):
+                return Tensor(jnp.zeros(shape, jnp.int32))
+            return Tensor(jnp.zeros(shape, jnp.float32))
+
+        x = example(inputs_spec)
+        y = example(labels_spec)
+        if x is not None:
+            self._complete(x, y)
+        return self
+
+    def _complete(self, x, y):
+        """Propagate shardings from annotated tensors to every parameter
+        (completion.py); place completed params on the mesh."""
+        if self._completed:
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ..topology import get_global_mesh
+        from .completion import complete_param_specs
+        from ...core.tape import no_grad
+
+        mesh = get_global_mesh()
+        if mesh is None:
+            return
+        params = [p for p in self._model.parameters() if not p.stop_gradient]
+        annotated = [p for p in params if p._dist_attr is not None]
+        input_annotated = getattr(x, "_dist_attr", None) is not None or \
+            (y is not None and getattr(y, "_dist_attr", None) is not None)
+        if not annotated and not input_annotated:
+            return
+
+        model, loss = self._model, self._loss
+
+        def fn(pv, xa, *ya):
+            saved = [p._value for p in params]
+            try:
+                for p, a in zip(params, pv):
+                    p._value = a
+                with no_grad():
+                    out = model(Tensor(xa))
+                    if loss is not None and ya:
+                        out = loss(out, Tensor(ya[0]))
+                return out._value if isinstance(out, Tensor) else out
+            finally:
+                for p, s in zip(params, saved):
+                    p._value = s
+
+        inputs = [x] if y is None else [x, y]
+        try:
+            specs = complete_param_specs(fn, params, inputs, mesh)
+        except Exception:
+            # completion is best-effort (GSPMD defaults still work) — but
+            # mark it done so fit() doesn't re-trace the model every batch
+            self._completed = True
+            return
+        for p, s in zip(params, specs):
+            if s is None or p._dist_attr is not None:
+                continue
+            if any(e is not None for e in s):
+                p._dist_attr = tuple(s)
+                if not isinstance(p._value, jax.core.Tracer):
+                    try:
+                        p._value = jax.device_put(
+                            p._value, NamedSharding(mesh, PartitionSpec(*s)))
+                    except Exception:
+                        pass
+        self._completed = True
 
     def _ensure_step(self):
         if self._train_step is None:
@@ -61,7 +149,6 @@ class Engine:
         from ...io import DataLoader
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=True)
-        self._ensure_step()
         history = []
         for epoch in range(epochs):
             for step, batch in enumerate(loader):
@@ -69,6 +156,9 @@ class Engine:
                     x, y = batch[0], batch[-1]
                 else:
                     x, y = batch, None
+                if not self._completed:
+                    self._complete(x, y)
+                self._ensure_step()
                 if self._train_step:
                     loss = self._train_step(x, y)
                 else:
