@@ -23,7 +23,9 @@ from ...core.tensor import Tensor
 from ...nn import Layer
 
 __all__ = ["PSServer", "PSClient", "ShardedPSClient",
-           "SparseEmbedding", "DensePSParameter"]
+           "SparseEmbedding", "DensePSParameter", "AsyncCommunicator"]
+
+from .communicator import AsyncCommunicator  # noqa: E402
 
 
 class PSServer:
@@ -58,9 +60,28 @@ class PSClient:
             self._c.set_dense(table_id, np.asarray(init, np.float32))
 
     def create_sparse_table(self, table_id: int, dim: int,
-                            init_scale: float = 0.01, seed: int = 0):
-        self._c.create_sparse(table_id, dim, init_scale, seed)
+                            init_scale: float = 0.01, seed: int = 0,
+                            sgd_rule: str = "sgd", eps: float = 1e-8,
+                            max_mem_rows: int = 0, spill_path: str = ""):
+        """``sgd_rule``: "sgd" (naive) or "adagrad" (per-feature
+        accumulators, reference sparse_sgd_rule.h SparseAdaGradSGDRule).
+        ``max_mem_rows``>0 caps resident rows; colder rows spill to
+        ``spill_path`` with LRU eviction (reference ssd_sparse_table.h)."""
+        rules = {"sgd": 0, "naive": 0, "adagrad": 1}
+        if sgd_rule not in rules:
+            raise ValueError(f"sgd_rule must be one of {list(rules)}, "
+                             f"got {sgd_rule!r}")
+        if max_mem_rows > 0 and not spill_path:
+            raise ValueError(
+                "create_sparse_table: max_mem_rows needs a spill_path")
+        self._c.create_sparse(table_id, dim, init_scale, seed,
+                              rules[sgd_rule], eps, max_mem_rows,
+                              spill_path)
         self._sparse_dims[table_id] = dim
+
+    def sparse_mem_rows(self, table_id: int) -> int:
+        """Rows currently resident in server memory (spilled excluded)."""
+        return self._c.sparse_mem_rows(table_id)
 
     # dense ------------------------------------------------------------
     def pull_dense(self, table_id: int):
@@ -222,10 +243,17 @@ class ShardedPSClient:
         self._dense_owner(table_id).set_dense(table_id, values)
 
     # sparse: rows hashed across all servers ------------------------------
-    def create_sparse_table(self, table_id, dim, init_scale=0.01, seed=0):
-        for c in self._clients:
-            c.create_sparse_table(table_id, dim, init_scale, seed)
+    def create_sparse_table(self, table_id, dim, init_scale=0.01, seed=0,
+                            **kwargs):
+        spill = kwargs.pop("spill_path", "")
+        for i, c in enumerate(self._clients):
+            c.create_sparse_table(
+                table_id, dim, init_scale, seed,
+                spill_path=f"{spill}.shard{i}" if spill else "", **kwargs)
         self._sparse_dims[table_id] = dim
+
+    def sparse_mem_rows(self, table_id):
+        return sum(c.sparse_mem_rows(table_id) for c in self._clients)
 
     def _partition(self, keys):
         keys = np.ascontiguousarray(keys, np.uint64)

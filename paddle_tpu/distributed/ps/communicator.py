@@ -1,0 +1,145 @@
+"""Async PS communicator (reference:
+``paddle/fluid/distributed/ps/service/communicator/communicator.h`` —
+AsyncCommunicator: send queues per variable, a background thread batching
+and merging gradients before pushing to the servers).
+
+Trainer threads call :meth:`push_dense`/:meth:`push_sparse`, which
+enqueue and return immediately; the communicator thread drains the queue,
+MERGES pending gradients (dense: summed; sparse: concatenated and
+pre-summed by key) and issues the actual client pushes.  ``flush`` (and
+``stop``) drain everything synchronously — the reference's barrier
+semantics before pull/evaluation.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["AsyncCommunicator"]
+
+
+class _DensePush:
+    __slots__ = ("table", "grad", "lr")
+
+    def __init__(self, table, grad, lr):
+        self.table, self.grad, self.lr = table, grad, lr
+
+
+class _SparsePush:
+    __slots__ = ("table", "keys", "grads", "lr")
+
+    def __init__(self, table, keys, grads, lr):
+        self.table, self.keys, self.grads, self.lr = table, keys, grads, lr
+
+
+class AsyncCommunicator:
+    def __init__(self, client, queue_size: int = 1024,
+                 merge_size: int = 8):
+        """``merge_size``: max pending pushes merged into one wire
+        request (reference send_merge_var_nums)."""
+        self._client = client
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._merge = max(1, merge_size)
+        self._err = None
+        self._running = True
+        # in-flight counter (queued + being-processed): a queue-emptiness
+        # signal would race with push (enqueue after the worker's empty
+        # check would slip past flush)
+        self._pending = 0
+        self._cv = threading.Condition()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _enqueue(self, item):
+        self._raise_if_failed()
+        with self._cv:
+            self._pending += 1
+        self._q.put(item)
+
+    # -- trainer-facing (non-blocking) --------------------------------
+    def push_dense(self, table_id, grad, lr):
+        self._enqueue(_DensePush(int(table_id),
+                                 np.asarray(grad, np.float32).reshape(-1),
+                                 float(lr)))
+
+    def push_sparse(self, table_id, keys, grads, lr):
+        self._enqueue(_SparsePush(int(table_id),
+                                  np.ascontiguousarray(keys, np.uint64),
+                                  np.ascontiguousarray(grads, np.float32),
+                                  float(lr)))
+
+    def flush(self, timeout: float = 60.0):
+        """Block until every queued push reached the servers."""
+        with self._cv:
+            if not self._cv.wait_for(lambda: self._pending == 0, timeout):
+                raise TimeoutError("AsyncCommunicator.flush timed out")
+        self._raise_if_failed()
+
+    def stop(self):
+        if self._running:
+            self.flush()
+            self._running = False
+            self._q.put(None)
+            self._thread.join(timeout=10.0)
+
+    # -- background thread --------------------------------------------
+    def _raise_if_failed(self):
+        if self._err is not None:
+            raise RuntimeError(
+                f"AsyncCommunicator background push failed: {self._err}")
+
+    def _drain_batch(self, first):
+        batch = [first]
+        while len(batch) < self._merge:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                self._q.put(None)  # keep the stop sentinel
+                break
+            batch.append(item)
+        return batch
+
+    def _send(self, batch):
+        # merge dense by (table, lr): grads sum (linear updates commute)
+        dense = {}
+        sparse = {}
+        for it in batch:
+            if isinstance(it, _DensePush):
+                key = (it.table, it.lr)
+                dense[key] = it.grad if key not in dense \
+                    else dense[key] + it.grad
+            else:
+                key = (it.table, it.lr)
+                sparse.setdefault(key, []).append(it)
+        for (table, lr), grad in dense.items():
+            self._client.push_dense_grad(table, grad, lr)
+        for (table, lr), items in sparse.items():
+            keys = np.concatenate([it.keys for it in items])
+            grads = np.concatenate([it.grads for it in items], axis=0)
+            # pre-sum duplicate keys: one row per key on the wire
+            order = np.argsort(keys, kind="stable")
+            keys_sorted = keys[order]
+            uniq, start = np.unique(keys_sorted, return_index=True)
+            summed = np.add.reduceat(grads[order], start, axis=0)
+            self._client.push_sparse_grad(table, uniq, summed, lr)
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                break
+            batch = self._drain_batch(item)
+            try:
+                self._send(batch)
+            except Exception as e:  # surface on the trainer thread
+                self._err = e
+            finally:
+                with self._cv:
+                    self._pending -= len(batch)
+                    if self._pending <= 0:
+                        self._cv.notify_all()

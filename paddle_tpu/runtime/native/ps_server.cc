@@ -12,24 +12,33 @@
 // Wire protocol (little-endian), one request per round trip:
 //   u8 op | i32 table | u64 n | u64 dim | f64 lr | payload
 //     op=1 CREATE_DENSE                 payload: -
-//     op=2 CREATE_SPARSE  lr=init_scale payload: u64 seed
+//     op=2 CREATE_SPARSE  lr=init_scale payload: u64 seed | u8 rule |
+//          f64 eps | u64 max_mem_rows | u32 path_len | path bytes
+//          (rule: 0=naive SGD, 1=adagrad per-feature; max_mem_rows>0
+//           enables LRU spill-to-disk at `path` — the SSD table,
+//           reference ssd_sparse_table.h; rules: sparse_sgd_rule.h)
 //     op=3 PULL_DENSE                   payload: -
 //     op=4 SET_DENSE                    payload: dim floats
 //     op=5 PUSH_DENSE                   payload: dim floats (grad)
 //     op=6 PULL_SPARSE                  payload: n u64 keys
 //     op=7 PUSH_SPARSE                  payload: n u64 keys, n*dim floats
-//     op=8 SPARSE_SIZE                  payload: -
+//     op=8 SPARSE_SIZE                  payload: -     (total keys)
+//     op=9 SPARSE_MEM_ROWS              payload: -     (in-memory keys)
 //   response: i64 status_or_len | payload (floats / u64)
 
 #include "ptpu_runtime.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <sys/time.h>
 #include <unistd.h>
+
+#include <cmath>
+#include <list>
 
 #include <atomic>
 #include <cstring>
@@ -76,20 +85,112 @@ struct SparseTable {
   int64_t dim = 0;
   double init_scale = 0.0;
   uint64_t seed = 0;
+  uint8_t rule = 0;        // 0 = naive SGD, 1 = adagrad per-feature
+  double eps = 1e-8;       // adagrad epsilon
+  size_t max_mem_rows = 0; // 0 = unbounded (no spill)
+  std::string spill_path;
+  int spill_fd = -1;
+  uint64_t spill_end = 0;
+  // row storage width: dim weights (+ dim adagrad accumulators)
+  size_t width() const { return (size_t)dim * (rule == 1 ? 2 : 1); }
+
   std::unordered_map<uint64_t, std::vector<float>> rows;
+  std::unordered_map<uint64_t, uint64_t> spilled;  // key -> file offset
+  // LRU over in-memory keys: front = most recent
+  std::list<uint64_t> lru;
+  std::unordered_map<uint64_t, std::list<uint64_t>::iterator> lru_pos;
+
+  ~SparseTable() {
+    if (spill_fd >= 0) ::close(spill_fd);
+  }
+
+  void touch(uint64_t key) {
+    auto it = lru_pos.find(key);
+    if (it != lru_pos.end()) lru.erase(it->second);
+    lru.push_front(key);
+    lru_pos[key] = lru.begin();
+  }
+
+  void maybe_evict() {
+    if (max_mem_rows == 0 || spill_fd < 0) return;
+    while (rows.size() > max_mem_rows && !lru.empty()) {
+      uint64_t victim = lru.back();
+      lru.pop_back();
+      lru_pos.erase(victim);
+      auto it = rows.find(victim);
+      if (it == rows.end()) continue;
+      uint64_t off;
+      bool new_slot = false;
+      auto sp = spilled.find(victim);
+      if (sp != spilled.end()) {
+        off = sp->second;  // reuse the key's slot
+      } else {
+        off = spill_end;
+        new_slot = true;
+      }
+      ssize_t want = (ssize_t)(width() * sizeof(float));
+      ssize_t wrote = ::pwrite(spill_fd, it->second.data(), (size_t)want,
+                               (off_t)off);
+      if (wrote != want) {
+        // disk full/short write: keep the row in memory rather than
+        // silently losing trained values; stop evicting this round
+        touch(victim);
+        break;
+      }
+      if (new_slot) {
+        spill_end += (uint64_t)want;
+        spilled[victim] = off;
+      }
+      rows.erase(it);
+    }
+  }
 
   std::vector<float>& row(uint64_t key) {
     auto it = rows.find(key);
-    if (it != rows.end()) return it->second;
-    std::vector<float> v((size_t)dim);
-    if (init_scale != 0.0) {
+    if (it != rows.end()) {
+      touch(key);
+      return it->second;
+    }
+    std::vector<float> v(width(), 0.f);
+    auto sp = spilled.find(key);
+    bool loaded = false;
+    if (sp != spilled.end() && spill_fd >= 0) {
+      ssize_t want = (ssize_t)(width() * sizeof(float));
+      loaded = ::pread(spill_fd, v.data(), (size_t)want,
+                       (off_t)sp->second) == want;
+    }
+    if (!loaded && sp == spilled.end() && init_scale != 0.0) {
       // per-key deterministic init: same key -> same row on any server
       std::mt19937_64 gen(seed ^ (key * 0x9e3779b97f4a7c15ULL));
       std::uniform_real_distribution<float> dist((float)-init_scale,
                                                  (float)init_scale);
-      for (auto& x : v) x = dist(gen);
+      for (int64_t j = 0; j < dim; ++j) v[(size_t)j] = dist(gen);
     }
-    return rows.emplace(key, std::move(v)).first->second;
+    auto& ref = rows.emplace(key, std::move(v)).first->second;
+    touch(key);
+    maybe_evict();
+    return ref;
+  }
+
+  size_t total_keys() {
+    size_t n = rows.size();
+    for (auto& kv : spilled)
+      if (rows.find(kv.first) == rows.end()) ++n;
+    return n;
+  }
+
+  // apply the accessor rule for one pushed gradient row
+  void apply(std::vector<float>& r, const float* g, double lr) {
+    if (rule == 1) {
+      float* w = r.data();
+      float* acc = r.data() + dim;
+      for (int64_t j = 0; j < dim; ++j) {
+        acc[j] += g[j] * g[j];
+        w[j] -= (float)(lr * g[j] / (std::sqrt((double)acc[j]) + eps));
+      }
+    } else {
+      for (int64_t j = 0; j < dim; ++j) r[(size_t)j] -= (float)lr * g[j];
+    }
   }
 };
 
@@ -144,14 +245,46 @@ void ps_handle_conn(PSServer* s, int fd) {
         break;
       }
       case 2: {  // CREATE_SPARSE
-        uint64_t seed;
-        if (!ps_recv_all(fd, &seed, 8)) return;
+        uint64_t seed, max_mem_rows;
+        uint8_t rule;
+        double eps;
+        uint32_t path_len;
+        if (!ps_recv_all(fd, &seed, 8) || !ps_recv_all(fd, &rule, 1) ||
+            !ps_recv_all(fd, &eps, 8) ||
+            !ps_recv_all(fd, &max_mem_rows, 8) ||
+            !ps_recv_all(fd, &path_len, 4))
+          return;
+        std::string path(path_len, '\0');
+        if (path_len && !ps_recv_all(fd, path.data(), path_len)) return;
         std::lock_guard<std::mutex> l(s->tables_mu);
         auto& t = s->sparse[table];
         if (!t) t = std::make_unique<SparseTable>();
+        {
+          std::lock_guard<std::mutex> tl(t->mu);
+          bool nonempty = !t->rows.empty() || !t->spilled.empty();
+          if (nonempty &&
+              ((uint64_t)t->dim != dim || t->rule != rule)) {
+            // changing dim/rule would misinterpret existing row storage
+            // (adagrad rows are 2*dim wide) — reject reconfiguration
+            ps_reply_status(fd, -5);
+            break;
+          }
+        }
         t->dim = (int64_t)dim;
         t->init_scale = lr;  // lr field carries init_scale for op=2
         t->seed = seed;
+        t->rule = rule;
+        t->eps = eps;
+        t->max_mem_rows = (size_t)max_mem_rows;
+        t->spill_path = path;
+        if (max_mem_rows > 0 && !path.empty() && t->spill_fd < 0) {
+          t->spill_fd = ::open(path.c_str(), O_CREAT | O_RDWR | O_TRUNC,
+                               0600);
+          if (t->spill_fd < 0) {
+            ps_reply_status(fd, -4);
+            break;
+          }
+        }
         ps_reply_status(fd, 0);
         break;
       }
@@ -230,14 +363,23 @@ void ps_handle_conn(PSServer* s, int fd) {
           std::lock_guard<std::mutex> l(t->mu);
           for (uint64_t i = 0; i < n; ++i) {
             auto& row = t->row(keys[i]);
-            for (uint64_t j = 0; j < dim; ++j)
-              row[j] -= (float)lr * grads[i * dim + j];
+            t->apply(row, grads.data() + i * dim, lr);
           }
         }
         ps_reply_status(fd, 0);
         break;
       }
-      case 8: {  // SPARSE_SIZE
+      case 8: {  // SPARSE_SIZE (all keys, spilled included)
+        SparseTable* t = s->sparse_table(table);
+        if (!t) {
+          ps_reply_status(fd, -2);
+          break;
+        }
+        std::lock_guard<std::mutex> l(t->mu);
+        ps_reply_status(fd, (int64_t)t->total_keys());
+        break;
+      }
+      case 9: {  // SPARSE_MEM_ROWS (in-memory rows only)
         SparseTable* t = s->sparse_table(table);
         if (!t) {
           ps_reply_status(fd, -2);
@@ -400,13 +542,28 @@ int ptpu_ps_create_dense(int64_t c, int32_t table, int64_t dim) {
 }
 
 int ptpu_ps_create_sparse(int64_t c, int32_t table, int64_t dim,
-                          double init_scale, uint64_t seed) {
+                          double init_scale, uint64_t seed, uint8_t rule,
+                          double eps, uint64_t max_mem_rows,
+                          const char* spill_path) {
   int fd = ps_client_fd(c);
   if (fd < 0) return PTPU_ERR;
   if (!ps_send_header(fd, 2, table, 0, (uint64_t)dim, init_scale))
     return PTPU_ERR;
-  if (!ps_send_all(fd, &seed, 8)) return PTPU_ERR;
+  uint32_t path_len =
+      spill_path ? (uint32_t)strlen(spill_path) : 0;
+  if (!ps_send_all(fd, &seed, 8) || !ps_send_all(fd, &rule, 1) ||
+      !ps_send_all(fd, &eps, 8) || !ps_send_all(fd, &max_mem_rows, 8) ||
+      !ps_send_all(fd, &path_len, 4))
+    return PTPU_ERR;
+  if (path_len && !ps_send_all(fd, spill_path, path_len)) return PTPU_ERR;
   return ps_recv_status(fd) == 0 ? PTPU_OK : PTPU_ERR;
+}
+
+int64_t ptpu_ps_sparse_mem_rows(int64_t c, int32_t table) {
+  int fd = ps_client_fd(c);
+  if (fd < 0) return -1;
+  if (!ps_send_header(fd, 9, table, 0, 0, 0.0)) return -1;
+  return ps_recv_status(fd);
 }
 
 int ptpu_ps_pull_dense(int64_t c, int32_t table, float* out, int64_t dim) {

@@ -97,8 +97,12 @@ void ptpu_ps_server_stop(int64_t h);
 int64_t ptpu_ps_client_create(const char* host, int port, double timeout_s);
 void ptpu_ps_client_destroy(int64_t h);
 int ptpu_ps_create_dense(int64_t c, int32_t table, int64_t dim);
+// rule: 0=naive SGD, 1=adagrad per-feature (eps).  max_mem_rows>0 caps
+// in-memory rows with LRU spill to `spill_path` (the SSD sparse table).
 int ptpu_ps_create_sparse(int64_t c, int32_t table, int64_t dim,
-                          double init_scale, uint64_t seed);
+                          double init_scale, uint64_t seed, uint8_t rule,
+                          double eps, uint64_t max_mem_rows,
+                          const char* spill_path);
 int ptpu_ps_pull_dense(int64_t c, int32_t table, float* out, int64_t dim);
 int ptpu_ps_set_dense(int64_t c, int32_t table, const float* val,
                       int64_t dim);
@@ -110,7 +114,8 @@ int ptpu_ps_pull_sparse(int64_t c, int32_t table, const uint64_t* keys,
 int ptpu_ps_push_sparse(int64_t c, int32_t table, const uint64_t* keys,
                         int64_t n, int64_t dim, const float* grads,
                         double lr);
-int64_t ptpu_ps_sparse_size(int64_t c, int32_t table);  // #rows
+int64_t ptpu_ps_sparse_size(int64_t c, int32_t table);  // #keys (total)
+int64_t ptpu_ps_sparse_mem_rows(int64_t c, int32_t table);  // in-memory
 
 #if defined(__cplusplus)
 }  // extern "C"

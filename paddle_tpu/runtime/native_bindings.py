@@ -411,7 +411,8 @@ _u64p = ctypes.POINTER(ctypes.c_uint64)
 _lib.ptpu_ps_create_dense.restype = _i32
 _lib.ptpu_ps_create_dense.argtypes = [_i64, _i32, _i64]
 _lib.ptpu_ps_create_sparse.restype = _i32
-_lib.ptpu_ps_create_sparse.argtypes = [_i64, _i32, _i64, _dbl, _u64]
+_lib.ptpu_ps_create_sparse.argtypes = [_i64, _i32, _i64, _dbl, _u64,
+                                       ctypes.c_uint8, _dbl, _u64, _chp]
 _lib.ptpu_ps_pull_dense.restype = _i32
 _lib.ptpu_ps_pull_dense.argtypes = [_i64, _i32, _fltp, _i64]
 _lib.ptpu_ps_set_dense.restype = _i32
@@ -425,6 +426,8 @@ _lib.ptpu_ps_push_sparse.argtypes = [_i64, _i32, _u64p, _i64, _i64, _fltp,
                                      _dbl]
 _lib.ptpu_ps_sparse_size.restype = _i64
 _lib.ptpu_ps_sparse_size.argtypes = [_i64, _i32]
+_lib.ptpu_ps_sparse_mem_rows.restype = _i64
+_lib.ptpu_ps_sparse_mem_rows.argtypes = [_i64, _i32]
 
 
 class PSServerHandle:
@@ -484,12 +487,22 @@ class PSClientHandle:
                         "create_dense")
 
     def create_sparse(self, table: int, dim: int, init_scale: float = 0.0,
-                      seed: int = 0):
+                      seed: int = 0, rule: int = 0, eps: float = 1e-8,
+                      max_mem_rows: int = 0, spill_path: str = ""):
         with self._lock:
             self._check(
-                _lib.ptpu_ps_create_sparse(self._h, table, dim,
-                                           init_scale, seed),
+                _lib.ptpu_ps_create_sparse(
+                    self._h, table, dim, init_scale, seed, rule, eps,
+                    max_mem_rows,
+                    spill_path.encode() if spill_path else None),
                 "create_sparse")
+
+    def sparse_mem_rows(self, table: int) -> int:
+        with self._lock:
+            n = int(_lib.ptpu_ps_sparse_mem_rows(self._h, table))
+        if n < 0:
+            raise RuntimeError("parameter server: sparse_mem_rows failed")
+        return n
 
     def pull_dense(self, table: int, dim: int):
         import numpy as np
