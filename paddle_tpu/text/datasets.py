@@ -14,14 +14,31 @@ __all__ = ["Imdb", "UCIHousing", "Conll05st"]
 
 
 class Imdb(Dataset):
-    """Binary sentiment over token-id sequences (vocab 5149 like the
-    real IMDB vocabulary after cutoff; fixed-length padded)."""
+    """Binary sentiment (reference ``python/paddle/text/datasets/imdb.py``).
+
+    ``data_file`` given: parse the real aclImdb tar — tokenize the
+    train split, build the frequency-cutoff word dict (ids ordered by
+    descending frequency, last id = OOV like the reference), then encode
+    the requested split; docs come back as variable-length int64 arrays,
+    labels 0=pos 1=neg.  Without a path: synthetic token sequences with
+    the real vocab size (this environment cannot download)."""
 
     vocab_size = 5149
     seq_len = 128
 
-    def __init__(self, mode="train", cutoff=150, size=None, seed=0):
+    def __init__(self, data_file=None, mode="train", cutoff=150, size=None,
+                 seed=0):
         self.mode = mode
+        if data_file:
+            # one pass over the archive: tokenize train (for the dict)
+            # and the requested split together
+            token_docs = self._load_tokens(data_file, {"train", mode})
+            self.word_idx = self._build_dict(token_docs["train"], cutoff)
+            self.docs, self.labels = self._encode(token_docs[mode], mode,
+                                                  data_file)
+            self.size = len(self.docs)
+            return
+        self.word_idx = None
         self.size = (512 if mode == "train" else 128) if size is None else size
         rng = np.random.default_rng(seed + (0 if mode == "train" else 1))
         self.docs = rng.integers(1, self.vocab_size,
@@ -31,6 +48,60 @@ class Imdb(Dataset):
         # more of token 7
         mask = self.labels == 1
         self.docs[mask, :8] = 7
+
+    @staticmethod
+    def _tokenize(text):
+        import re
+        return re.sub(r"[^a-z ]", "",
+                      text.lower().replace("<br />", " ")).split()
+
+    def _load_tokens(self, data_file, splits):
+        """ONE scan of the tar: {split: [(senti_label, tokens), ...]}."""
+        import re
+        import tarfile
+        pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        out = {s: [] for s in splits}
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                if not m.isfile():
+                    continue
+                match = pat.match(m.name)
+                if not match or match.group(1) not in splits:
+                    continue
+                label = 0 if match.group(2) == "pos" else 1
+                with tf.extractfile(m) as f:
+                    out[match.group(1)].append(
+                        (label, self._tokenize(
+                            f.read().decode("utf-8", errors="ignore"))))
+        return out
+
+    @staticmethod
+    def _build_dict(train_docs, cutoff):
+        from collections import Counter
+        freq = Counter()
+        for _, tokens in train_docs:
+            freq.update(tokens)
+        # reference semantics: keep words with frequency > cutoff
+        words = [w for w, c in freq.items() if c > cutoff]
+        # most frequent word -> id 0 (reference sorts by -count)
+        words.sort(key=lambda w: (-freq[w], w))
+        idx = {w: i for i, w in enumerate(words)}
+        idx["<unk>"] = len(idx)
+        return idx
+
+    def _encode(self, split_docs, mode, data_file):
+        unk = self.word_idx["<unk>"]
+        docs, labels = [], []
+        # pos docs first, then neg (reference ordering)
+        for label, tokens in sorted(split_docs, key=lambda lt: lt[0]):
+            docs.append(np.asarray(
+                [self.word_idx.get(t, unk) for t in tokens], np.int64))
+            labels.append(label)
+        if not docs:
+            raise ValueError(
+                f"Imdb: no aclImdb/{mode}/pos|neg/*.txt members in "
+                f"{data_file}")
+        return docs, np.asarray(labels, np.int64)
 
     def __getitem__(self, idx):
         return self.docs[idx], self.labels[idx]

@@ -1,15 +1,39 @@
-"""Vision datasets.  Zero-egress environment: synthetic datasets with the
-reference datasets' shapes/APIs (Cifar10/MNIST signatures), generated
-deterministically — the data pipeline and training loops exercise the same
-code paths as the real downloads."""
+"""Vision datasets (reference ``python/paddle/vision/datasets/cifar.py:41``,
+``mnist.py``).
+
+Two modes:
+
+- ``data_file``/``image_path`` given: parse the REAL archive formats —
+  CIFAR's pickled-batch tar.gz, MNIST's idx-ubyte gzip — exactly like the
+  reference parsers (``cifar.py _load_data``, ``mnist.py
+  _parse_dataset``).
+- no path (default): deterministic synthetic data with the real
+  shapes/label spaces.  This environment has zero egress, so
+  ``download=True`` raises with a pointer to the file-path mode rather
+  than pretending to fetch.
+"""
 
 from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
 
 import numpy as np
 
 from ...io import Dataset
 
 __all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST"]
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: download=True is unavailable in this environment "
+        "(zero egress). Pass data_file=/path/to/archive (CIFAR: the "
+        "cifar-*-python.tar.gz; MNIST: image_path/label_path idx-ubyte "
+        ".gz files) or use the synthetic default (no path).")
 
 
 class _SyntheticImages(Dataset):
@@ -28,6 +52,9 @@ class _SyntheticImages(Dataset):
         self.labels = rng.integers(0, self.num_classes, (self.size,),
                                    dtype=np.int64)
 
+    def _finish_init(self):
+        self.size = len(self.images)
+
     def __getitem__(self, idx):
         img = self.images[idx]
         if self.transform is not None:
@@ -40,19 +67,127 @@ class _SyntheticImages(Dataset):
         return self.size
 
 
-class Cifar10(_SyntheticImages):
+class _Cifar(_SyntheticImages):
+    _label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None, size=None, seed=0):
+        if data_file:
+            self.mode = mode
+            self.transform = transform
+            self.images, self.labels = self._parse(data_file, mode)
+            self._finish_init()
+        elif download:
+            _no_download(type(self).__name__)
+        else:
+            super().__init__(mode=mode, transform=transform, size=size,
+                             seed=seed)
+
+    def _members(self, mode):
+        raise NotImplementedError
+
+    def _parse(self, data_file, mode):
+        """Reference cifar.py: each tar member is a pickled dict with
+        b'data' ([N, 3072] uint8, CHW-flattened) and the label list."""
+        wanted = self._members(mode)
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            names = {os.path.basename(m.name): m for m in tf.getmembers()
+                     if m.isfile()}
+            for base in wanted:
+                if base not in names:
+                    continue
+                with tf.extractfile(names[base]) as f:
+                    batch = pickle.load(f, encoding="bytes")
+                data = np.asarray(batch[b"data"], np.uint8)
+                images.append(data.reshape(-1, 3, 32, 32)
+                              .transpose(0, 2, 3, 1))  # -> HWC
+                labels.append(np.asarray(batch[self._label_key], np.int64))
+        if not images:
+            raise ValueError(
+                f"{type(self).__name__}: no '{mode}' batches found in "
+                f"{data_file} (expected members like {wanted[0]})")
+        return np.concatenate(images), np.concatenate(labels)
+
+
+class Cifar10(_Cifar):
     num_classes = 10
     image_shape = (3, 32, 32)
+    _label_key = b"labels"
+
+    def _members(self, mode):
+        if mode == "train":
+            return [f"data_batch_{i}" for i in range(1, 6)]
+        return ["test_batch"]
 
 
-class Cifar100(_SyntheticImages):
+class Cifar100(_Cifar):
     num_classes = 100
     image_shape = (3, 32, 32)
+    _label_key = b"fine_labels"
+
+    def _members(self, mode):
+        return ["train"] if mode == "train" else ["test"]
+
+
+def _open_maybe_gz(path):
+    with open(path, "rb") as probe:
+        magic = probe.read(2)
+    return gzip.open(path, "rb") if magic == b"\x1f\x8b" else \
+        open(path, "rb")
 
 
 class MNIST(_SyntheticImages):
     num_classes = 10
     image_shape = (1, 28, 28)
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None, size=None,
+                 seed=0):
+        if bool(image_path) != bool(label_path):
+            raise ValueError(
+                "MNIST: image_path and label_path must be given together "
+                "(got only one) — a silent synthetic fallback would look "
+                "like real data")
+        if image_path and label_path:
+            self.mode = mode
+            self.transform = transform
+            self.images = self._parse_images(image_path)
+            self.labels = self._parse_labels(label_path)
+            if len(self.images) != len(self.labels):
+                raise ValueError(
+                    f"MNIST: {len(self.images)} images vs "
+                    f"{len(self.labels)} labels")
+            self._finish_init()
+        elif download and not (image_path or label_path):
+            _no_download(type(self).__name__)
+        else:
+            super().__init__(mode=mode, transform=transform, size=size,
+                             seed=seed)
+
+    @staticmethod
+    def _parse_images(path):
+        """idx3-ubyte: >u4 magic 2051 | count | rows | cols | pixels."""
+        with _open_maybe_gz(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(
+                    f"MNIST image file {path}: bad magic {magic} "
+                    "(want 2051)")
+            buf = f.read(n * rows * cols)
+        return np.frombuffer(buf, np.uint8).reshape(n, rows, cols, 1)
+
+    @staticmethod
+    def _parse_labels(path):
+        """idx1-ubyte: >u4 magic 2049 | count | labels."""
+        with _open_maybe_gz(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(
+                    f"MNIST label file {path}: bad magic {magic} "
+                    "(want 2049)")
+            buf = f.read(n)
+        return np.frombuffer(buf, np.uint8).astype(np.int64)
 
 
 class FashionMNIST(MNIST):

@@ -46,6 +46,72 @@ def check_output(op_fn, np_fn, inputs, atol=1e-5, rtol=1e-5, check_jit=True,
     return outs
 
 
+#: default tolerances per low-precision dtype (reference OpTest keeps
+#: per-dtype whitelists; bf16 has ~3 decimal digits)
+DTYPE_TOLS = {
+    "bfloat16": dict(atol=5e-2, rtol=2e-2),
+    "float16": dict(atol=1e-2, rtol=5e-3),
+    "float32": dict(atol=1e-5, rtol=1e-5),
+}
+
+
+def check_output_dtypes(op_fn, np_fn, inputs,
+                        dtypes=("float32", "bfloat16"), check_jit=False,
+                        tols=None):
+    """Dtype sweep (reference OpTest check_output over the registered
+    dtype list): run the op with inputs cast to each dtype and compare
+    against the fp64 numpy reference under per-dtype tolerances."""
+    import jax.numpy as jnp
+    ref_inputs = [np.asarray(a, np.float64)
+                  if np.issubdtype(np.asarray(a).dtype, np.floating)
+                  else np.asarray(a) for a in inputs]
+    expected = np_fn(*ref_inputs)
+    expected = expected if isinstance(expected, tuple) else (expected,)
+    for dt in dtypes:
+        tol = dict(DTYPE_TOLS.get(dt, DTYPE_TOLS["float32"]))
+        if tols:
+            tol.update(tols.get(dt, {}))
+        tensors = []
+        for a in inputs:
+            arr = np.asarray(a)
+            t = paddle.to_tensor(arr.astype(np.float32)
+                                 if np.issubdtype(arr.dtype, np.floating)
+                                 else arr)
+            if np.issubdtype(arr.dtype, np.floating):
+                t = t.astype(dt)
+            tensors.append(t)
+        out = op_fn(*tensors)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for o, e in zip(outs, expected):
+            got = np.asarray(jnp.asarray(o._value, jnp.float64))
+            np.testing.assert_allclose(
+                got, np.asarray(e, np.float64), **tol,
+                err_msg=f"dtype {dt} mismatch")
+
+
+def check_grad_dtype(op_fn, inputs, dtype="bfloat16", grad_input_idx=0,
+                     atol=1e-1, rtol=5e-2):
+    """Low-precision gradient check: the dtype-cast tape gradient must
+    track the fp32 tape gradient (numeric diff is meaningless at bf16)."""
+    def grad_of(dt):
+        tensors = []
+        for i, a in enumerate(inputs):
+            t = paddle.to_tensor(np.asarray(a, np.float32))
+            if dt != "float32":
+                t = t.astype(dt)
+            t.stop_gradient = i != grad_input_idx
+            tensors.append(t)
+        out = op_fn(*tensors)
+        out = out[0] if isinstance(out, (tuple, list)) else out
+        out.astype("float32").sum().backward()
+        g = tensors[grad_input_idx].grad
+        return np.asarray(g.astype("float32").numpy(), np.float64)
+
+    np.testing.assert_allclose(grad_of(dtype), grad_of("float32"),
+                               atol=atol, rtol=rtol,
+                               err_msg=f"{dtype} grad diverges from fp32")
+
+
 def check_grad(op_fn, inputs, grad_input_idx=0, eps=1e-3, atol=1e-2,
                rtol=1e-2, reduce_to_scalar=True):
     """Tape gradient vs numeric central difference."""

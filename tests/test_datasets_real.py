@@ -1,0 +1,229 @@
+"""Real dataset parsers on checked-in mini-fixtures (VERDICT item 10;
+reference python/paddle/vision/datasets/cifar.py:41, mnist.py,
+text/datasets/imdb.py) + bf16 per-op dtype sweeps."""
+
+import gzip
+import io
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ---------------------------------------------------------------------------
+# fixture builders (tiny but format-exact archives)
+# ---------------------------------------------------------------------------
+
+def _make_cifar10(path, n_per_batch=4):
+    rng = np.random.default_rng(0)
+    with tarfile.open(path, "w:gz") as tf:
+        for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+            batch = {
+                b"data": rng.integers(0, 256, (n_per_batch, 3072),
+                                      dtype=np.uint8),
+                b"labels": rng.integers(0, 10, n_per_batch).tolist(),
+            }
+            blob = pickle.dumps(batch)
+            info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    return path
+
+
+def _make_cifar100(path, n=6):
+    rng = np.random.default_rng(1)
+    with tarfile.open(path, "w:gz") as tf:
+        for name in ("train", "test"):
+            batch = {
+                b"data": rng.integers(0, 256, (n, 3072), dtype=np.uint8),
+                b"fine_labels": rng.integers(0, 100, n).tolist(),
+            }
+            blob = pickle.dumps(batch)
+            info = tarfile.TarInfo(f"cifar-100-python/{name}")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    return path
+
+
+def _make_mnist(img_path, lbl_path, n=5):
+    rng = np.random.default_rng(2)
+    imgs = rng.integers(0, 256, (n, 28, 28), dtype=np.uint8)
+    lbls = rng.integers(0, 10, n).astype(np.uint8)
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(lbls.tobytes())
+    return imgs, lbls
+
+
+def _make_imdb(path):
+    docs = {
+        "train/pos/0_9.txt": b"a great great movie",
+        "train/pos/1_8.txt": b"great fun",
+        "train/neg/0_2.txt": b"a terrible movie",
+        "train/neg/1_1.txt": b"terrible and boring",
+        "test/pos/0_10.txt": b"great movie",
+        "test/neg/0_1.txt": b"boring movie",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, text in docs.items():
+            info = tarfile.TarInfo(f"aclImdb/{name}")
+            info.size = len(text)
+            tf.addfile(info, io.BytesIO(text))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# parser tests
+# ---------------------------------------------------------------------------
+
+def test_cifar10_parses_real_archive(tmp_path):
+    from paddle_tpu.vision.datasets import Cifar10
+    arc = _make_cifar10(str(tmp_path / "cifar-10-python.tar.gz"))
+    train = Cifar10(data_file=arc, mode="train")
+    test = Cifar10(data_file=arc, mode="test")
+    assert len(train) == 20 and len(test) == 4   # 5 batches x 4
+    img, lbl = train[0]
+    assert img.shape == (3, 32, 32) and img.dtype == np.float32
+    assert 0 <= int(lbl) < 10
+    assert img.max() <= 1.0
+
+
+def test_cifar100_fine_labels(tmp_path):
+    from paddle_tpu.vision.datasets import Cifar100
+    arc = _make_cifar100(str(tmp_path / "cifar-100-python.tar.gz"))
+    ds = Cifar100(data_file=arc, mode="train")
+    labels = [int(ds[i][1]) for i in range(len(ds))]
+    assert max(labels) < 100
+
+
+def test_mnist_idx_parser_roundtrip(tmp_path):
+    from paddle_tpu.vision.datasets import MNIST
+    ip, lp = str(tmp_path / "img.gz"), str(tmp_path / "lbl.gz")
+    imgs, lbls = _make_mnist(ip, lp)
+    ds = MNIST(image_path=ip, label_path=lp)
+    assert len(ds) == 5
+    img, lbl = ds[3]
+    np.testing.assert_allclose(
+        img, (imgs[3][..., None].astype(np.float32) / 255.0)
+        .transpose(2, 0, 1))
+    assert int(lbl) == int(lbls[3])
+
+
+def test_mnist_bad_magic_raises(tmp_path):
+    from paddle_tpu.vision.datasets import MNIST
+    bad = str(tmp_path / "bad.gz")
+    with gzip.open(bad, "wb") as f:
+        f.write(struct.pack(">IIII", 1234, 1, 28, 28))
+    with pytest.raises(ValueError, match="magic"):
+        MNIST._parse_images(bad)
+
+
+def test_imdb_real_tar_word_dict_and_labels(tmp_path):
+    from paddle_tpu.text.datasets import Imdb
+    arc = _make_imdb(str(tmp_path / "aclImdb_v1.tar.gz"))
+    train = Imdb(data_file=arc, mode="train", cutoff=0)
+    # 'great' (3x) and 'movie'/'terrible'/'a' (2x) beat singletons
+    assert train.word_idx is not None
+    assert train.word_idx["great"] == 0  # most frequent -> id 0
+    assert len(train) == 4
+    assert sorted(train.labels.tolist()) == [0, 0, 1, 1]
+    test = Imdb(data_file=arc, mode="test", cutoff=0)
+    assert len(test) == 2
+    doc, lbl = test[0]
+    assert doc.dtype == np.int64 and doc.ndim == 1
+
+
+def test_download_raises_clearly():
+    from paddle_tpu.vision.datasets import Cifar10, MNIST
+    with pytest.raises(RuntimeError, match="zero egress"):
+        Cifar10(download=True)
+    with pytest.raises(RuntimeError, match="zero egress"):
+        MNIST(download=True)
+
+
+def test_synthetic_default_still_works():
+    from paddle_tpu.vision.datasets import Cifar10, MNIST
+    ds = Cifar10(mode="test")
+    assert len(ds) == 256
+    img, _ = ds[0]
+    assert img.shape == (3, 32, 32)
+    assert MNIST(mode="test")[0][0].shape == (1, 28, 28)
+
+
+def test_model_fit_on_parsed_cifar(tmp_path):
+    # the VERDICT capability: Model.fit(Cifar10(real file)) end to end
+    from paddle_tpu.vision.datasets import Cifar10
+    from paddle_tpu import nn
+    arc = _make_cifar10(str(tmp_path / "c10.tar.gz"))
+    ds = Cifar10(data_file=arc, mode="train")
+    net = nn.Sequential(nn.Flatten(), nn.Linear(3072, 10))
+    model = paddle.Model(net)
+    model.prepare(optimizer=paddle.optimizer.Adam(
+        learning_rate=1e-3, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss())
+    model.fit(ds, epochs=1, batch_size=4, verbose=0)
+
+
+# ---------------------------------------------------------------------------
+# bf16/fp16 per-op dtype sweeps (reference OpTest dtype lists)
+# ---------------------------------------------------------------------------
+
+def test_dtype_sweep_core_math_ops():
+    from op_test import check_output_dtypes
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((4, 8)).astype(np.float32)
+    b = rng.standard_normal((4, 8)).astype(np.float32)
+    m = rng.standard_normal((8, 4)).astype(np.float32)
+
+    check_output_dtypes(lambda x, y: x + y, lambda x, y: x + y, [a, b],
+                        dtypes=("float32", "bfloat16", "float16"))
+    check_output_dtypes(lambda x, y: x * y, lambda x, y: x * y, [a, b],
+                        dtypes=("float32", "bfloat16", "float16"))
+    check_output_dtypes(paddle.matmul, lambda x, y: x @ y, [a, m],
+                        dtypes=("float32", "bfloat16"))
+    check_output_dtypes(paddle.tanh, np.tanh, [a],
+                        dtypes=("float32", "bfloat16", "float16"))
+    check_output_dtypes(lambda x: paddle.nn.functional.softmax(x, axis=-1),
+                        lambda x: np.exp(x - x.max(-1, keepdims=True)) /
+                        np.exp(x - x.max(-1, keepdims=True))
+                        .sum(-1, keepdims=True),
+                        [a], dtypes=("float32", "bfloat16"))
+
+
+def test_dtype_sweep_nn_ops():
+    from op_test import check_output_dtypes
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, 6)).astype(np.float32)
+
+    import math
+
+    def np_relu(v):
+        return np.maximum(v, 0)
+
+    def np_erf(v):
+        return math.erf(v)
+
+    check_output_dtypes(paddle.nn.functional.relu, np_relu, [x],
+                        dtypes=("float32", "bfloat16", "float16"))
+    check_output_dtypes(
+        paddle.nn.functional.gelu,
+        lambda v: 0.5 * v * (1.0 + np.vectorize(np_erf)(v / np.sqrt(2.0))),
+        [x], dtypes=("float32", "bfloat16"))
+
+
+def test_bf16_grads_track_fp32():
+    from op_test import check_grad_dtype
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal((3, 5)).astype(np.float32)
+    m = rng.standard_normal((5, 3)).astype(np.float32)
+    check_grad_dtype(paddle.tanh, [a], dtype="bfloat16")
+    check_grad_dtype(paddle.matmul, [a, m], dtype="bfloat16",
+                     grad_input_idx=0)
