@@ -1,0 +1,7 @@
+"""Incubating distributed components (reference incubate/distributed):
+MoE models and the HeterPS-analogue HBM embedding cache."""
+
+from . import models  # noqa: F401
+from .heter_ps import HBMEmbedding
+
+__all__ = ["models", "HBMEmbedding"]

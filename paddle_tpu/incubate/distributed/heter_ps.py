@@ -1,0 +1,265 @@
+"""HeterPS analogue: device-resident hot-embedding cache over a cold
+store (reference ``paddle/fluid/framework/fleet/heter_ps/``: GPU-resident
+HashTables for hot features, pull/push against the CPU/SSD parameter
+server for the cold tail).
+
+TPU-native form: the hot table is ONE dense jax array ``[hot_rows, dim]``
+living in HBM (shardable over the mesh like any parameter), addressed
+through a host-side id->slot hash map; cold ids fall through to a
+:class:`paddle_tpu.distributed.ps.PSClient` (or an in-process dict when
+none is given).  Admission is frequency-based: every ``sync_interval``
+steps the most-frequent cold ids are promoted into HBM, evicting the
+least-recently-promoted slots (their rows are flushed back to the cold
+store first).  The hot path — gather + scatter-grad on the dense HBM
+table — is pure XLA; only the cold tail pays host round-trips.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...autograd.py_layer import PyLayer
+from ...core.tensor import Tensor
+from ...nn import Layer
+
+__all__ = ["HBMEmbedding"]
+
+
+class _DictColdStore:
+    """In-process cold store with PSClient's pull/push surface."""
+
+    def __init__(self, dim, init_scale=0.01, seed=0):
+        self.dim = dim
+        self.init_scale = init_scale
+        self.seed = seed
+        self.rows = {}
+
+    def _init_row(self, key):
+        rng = np.random.default_rng(self.seed ^ (int(key) * 0x9E3779B9))
+        return rng.uniform(-self.init_scale, self.init_scale,
+                           self.dim).astype(np.float32)
+
+    def pull(self, keys):
+        out = np.empty((len(keys), self.dim), np.float32)
+        for i, k in enumerate(keys):
+            k = int(k)
+            if k not in self.rows:
+                self.rows[k] = self._init_row(k)
+            out[i] = self.rows[k]
+        return out
+
+    def push_grad(self, keys, grads, lr):
+        for k, g in zip(keys, grads):
+            k = int(k)
+            if k not in self.rows:
+                self.rows[k] = self._init_row(k)
+            self.rows[k] = self.rows[k] - lr * g
+
+    def set_rows(self, keys, values):
+        for k, v in zip(keys, values):
+            self.rows[int(k)] = np.asarray(v, np.float32).copy()
+
+
+class _PSColdStore:
+    def __init__(self, client, table_id, dim):
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+
+    def pull(self, keys):
+        return self.client.pull_sparse(
+            self.table_id, np.asarray(keys, np.uint64))
+
+    def push_grad(self, keys, grads, lr):
+        self.client.push_sparse_grad(
+            self.table_id, np.asarray(keys, np.uint64),
+            np.asarray(grads, np.float32), lr)
+
+    def set_rows(self, keys, values):
+        # write-back = push of (old - new)/lr is fragile; PS tables are
+        # server-updated, so flushing evicted hot rows uses a lr=1 push of
+        # the delta from the server's current values
+        cur = self.pull(keys)
+        delta = cur - np.asarray(values, np.float32)
+        self.client.push_sparse_grad(
+            self.table_id, np.asarray(keys, np.uint64), delta, 1.0)
+
+
+class _HotLookup(PyLayer):
+    """Differentiable gather on the HBM table; backward scatter-adds into
+    the table's .grad so any optimizer updates the hot rows."""
+
+    @staticmethod
+    def forward(ctx, table, slots):
+        slots_np = np.asarray(slots._value if isinstance(slots, Tensor)
+                              else slots)
+        ctx.save_for_backward(table)
+        ctx.slots = slots_np
+        out = jnp.take(table._value, jnp.asarray(slots_np), axis=0)
+        return Tensor(out, stop_gradient=False)
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        (table,) = ctx.saved_tensor()
+        g = grad_out._value if isinstance(grad_out, Tensor) \
+            else jnp.asarray(grad_out)
+        gt = jnp.zeros_like(table._value).at[
+            jnp.asarray(ctx.slots)].add(g)
+        return Tensor(gt), None
+
+
+class HBMEmbedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, hot_rows=4096,
+                 ps_client=None, table_id=0, learning_rate=0.01,
+                 init_scale=0.01, sync_interval=100, seed=0):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.hot_rows = hot_rows
+        self.learning_rate = learning_rate
+        self.sync_interval = sync_interval
+        if ps_client is not None:
+            ps_client.create_sparse_table(table_id, embedding_dim,
+                                          init_scale=init_scale, seed=seed)
+            self.cold = _PSColdStore(ps_client, table_id, embedding_dim)
+        else:
+            self.cold = _DictColdStore(embedding_dim, init_scale, seed)
+        # the HBM-resident hot table: a real Parameter (sharded like any
+        # other under a mesh; optimizers update it locally)
+        from ...nn.initializer import Uniform
+        self.hot_table = self.create_parameter(
+            (hot_rows, embedding_dim),
+            default_initializer=Uniform(-init_scale, init_scale))
+        self._slot_of = {}         # id -> hot slot
+        self._id_of = {}           # hot slot -> id
+        self._free = list(range(hot_rows))
+        self._freq = Counter()     # admission statistics
+        self._promo_order = []     # FIFO of occupied slots for eviction
+        self._step = 0
+
+    # -- cache bookkeeping ---------------------------------------------
+    def _flush_slot(self, slot):
+        old_id = self._id_of.pop(slot)
+        del self._slot_of[old_id]
+        row = np.asarray(self.hot_table._value[slot])
+        self.cold.set_rows([old_id], [row])
+
+    def _admit(self, ids):
+        """Promote ids into free (or evicted) hot slots; load their rows
+        from the cold store into the HBM table."""
+        ids = [i for i in ids if i not in self._slot_of]
+        if not ids:
+            return
+        rows = self.cold.pull(ids)
+        slots = []
+        for i in ids:
+            if not self._free:
+                victim = self._promo_order.pop(0)
+                self._flush_slot(victim)
+                self._free.append(victim)
+            s = self._free.pop()
+            self._slot_of[i] = s
+            self._id_of[s] = i
+            self._promo_order.append(s)
+            slots.append(s)
+        tbl = self.hot_table._value
+        self.hot_table._value = tbl.at[jnp.asarray(slots)].set(
+            jnp.asarray(rows))
+
+    def sync_cache(self):
+        """Admission pass: promote the hottest cold ids seen since the
+        last sync (reference: pull_sparse_to_gpu build pass)."""
+        if not self._freq:
+            return
+        budget = max(self.hot_rows // 4, 1)
+        hottest = [i for i, _ in self._freq.most_common(budget)]
+        self._admit(hottest)
+        self._freq.clear()
+
+    # -- forward --------------------------------------------------------
+    def forward(self, ids):
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids)
+        flat = ids_np.reshape(-1)
+        if flat.size == 0:
+            return Tensor(jnp.zeros(
+                tuple(ids_np.shape) + (self.embedding_dim,), jnp.float32))
+        self._step += 1
+
+        hot_mask = np.asarray([int(i) in self._slot_of for i in flat])
+        cold_ids = flat[~hot_mask]
+        self._freq.update(int(i) for i in cold_ids)
+        if self._step % self.sync_interval == 0:
+            self.sync_cache()
+            hot_mask = np.asarray(
+                [int(i) in self._slot_of for i in flat])
+            cold_ids = flat[~hot_mask]
+
+        parts = []
+        if hot_mask.any():
+            slots = np.asarray([self._slot_of[int(i)]
+                                for i in flat[hot_mask]], np.int32)
+            hot_rows = _HotLookup.apply(self.hot_table, Tensor(slots))
+            parts.append(_expand_rows(
+                hot_rows, np.nonzero(hot_mask)[0], flat.size))
+        if (~hot_mask).any():
+            cold_rows = self.cold.pull(list(cold_ids))
+            cold_full = np.zeros((flat.size, self.embedding_dim),
+                                 np.float32)
+            cold_full[~hot_mask] = cold_rows
+            parts.append(_ColdLookup.apply(
+                Tensor(jnp.asarray(cold_full)), self._cold_hook(),
+                self, cold_ids, np.nonzero(~hot_mask)[0], flat.size))
+        result = parts[0] if len(parts) == 1 else parts[0] + parts[1]
+        return result.reshape(list(ids_np.shape) + [self.embedding_dim])
+
+    def _cold_hook(self):
+        if not hasattr(self, "_hook_param"):
+            self._hook_param = self.create_parameter([1], is_bias=True)
+        return self._hook_param
+
+    # introspection ------------------------------------------------------
+    @property
+    def resident_ids(self):
+        return set(self._slot_of)
+
+
+def _expand_rows(rows, scatter_idx, total):
+    """Differentiable scatter of [k, d] rows into [total, d] zeros."""
+    from ...core.dispatch import dispatch
+
+    def impl(r, idx):
+        return jnp.zeros((total, r.shape[-1]), r.dtype).at[idx].set(r)
+
+    return dispatch("hbm_scatter_rows", impl, (rows, Tensor(scatter_idx)),
+                    nondiff_mask=[False, True])
+
+
+class _ColdLookup(PyLayer):
+    """Cold rows enter as constants; backward pushes their grads to the
+    cold store (the reference's push path for CPU-resident features)."""
+
+    @staticmethod
+    def forward(ctx, rows_full, hook, layer, cold_ids, positions, total):
+        ctx.layer = layer
+        ctx.cold_ids = cold_ids
+        ctx.positions = positions
+        return Tensor(rows_full._value, stop_gradient=False)
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        g = np.asarray(grad_out._value if isinstance(grad_out, Tensor)
+                       else grad_out)
+        layer = ctx.layer
+        if ctx.cold_ids.size:
+            grads = g[ctx.positions]
+            # pre-sum duplicate cold ids
+            order = np.argsort(ctx.cold_ids, kind="stable")
+            keys_sorted = ctx.cold_ids[order]
+            uniq, start = np.unique(keys_sorted, return_index=True)
+            summed = np.add.reduceat(grads[order], start, axis=0)
+            layer.cold.push_grad(list(uniq), summed, layer.learning_rate)
+        return None, Tensor(np.zeros(1, np.float32))

@@ -12,13 +12,15 @@ backends:
 """
 
 from .profiler import (  # noqa: F401
-    Profiler, ProfilerState, ProfilerTarget, RecordEvent, SummaryView,
+    DeviceSummaryView, Profiler, ProfilerState, ProfilerTarget,
+    RecordEvent, SummaryView,
     make_scheduler, export_chrome_tracing, load_profiler_result,
 )
 from .utils import record_function  # noqa: F401
 
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
-    "SummaryView", "make_scheduler", "export_chrome_tracing",
+    "SummaryView", "DeviceSummaryView", "make_scheduler",
+    "export_chrome_tracing",
     "load_profiler_result", "record_function",
 ]

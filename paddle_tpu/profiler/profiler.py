@@ -159,6 +159,81 @@ def load_profiler_result(path: str):
         return json.load(f)["traceEvents"]
 
 
+class DeviceSummaryView:
+    """Per-op DEVICE-time statistics parsed from the jax.profiler capture
+    (analogue of ``python/paddle/profiler/profiler_statistic.py``'s
+    kernel/op summary tables).  Aggregates the XLA op events on the
+    device lanes of the chrome trace that jax writes next to the xplane
+    dump."""
+
+    def __init__(self, trace_dir: str):
+        self.trace_dir = trace_dir
+        self._events = self._load(trace_dir)
+
+    @staticmethod
+    def _load(trace_dir):
+        import glob
+        import gzip
+        import json
+
+        events = []
+        for path in glob.glob(os.path.join(
+                trace_dir, "**", "*.trace.json.gz"), recursive=True):
+            with gzip.open(path, "rt") as f:
+                data = json.load(f)
+            raw = data.get("traceEvents", [])
+            # pid -> process name from metadata events
+            pid_names = {}
+            for e in raw:
+                if e.get("ph") == "M" and e.get("name") == "process_name":
+                    pid_names[e.get("pid")] = \
+                        e.get("args", {}).get("name", "")
+            device_pids = {p for p, n in pid_names.items()
+                           if any(k in n for k in
+                                  ("TPU", "GPU", "device", "Device"))}
+            for e in raw:
+                if e.get("ph") != "X" or "dur" not in e:
+                    continue
+                if device_pids and e.get("pid") not in device_pids:
+                    continue
+                events.append(e)
+        return events
+
+    def rows(self):
+        stats = {}
+        for e in self._events:
+            name = e.get("name", "?")
+            dur = float(e.get("dur", 0.0))  # microseconds
+            s = stats.setdefault(name, [0, 0.0, 0.0, float("inf")])
+            s[0] += 1
+            s[1] += dur
+            s[2] = max(s[2], dur)
+            s[3] = min(s[3], dur)
+        total = sum(s[1] for s in stats.values()) or 1.0
+        out = []
+        for name, (calls, tot, mx, mn) in sorted(
+                stats.items(), key=lambda kv: -kv[1][1]):
+            out.append({
+                "name": name, "calls": calls,
+                "total_ms": tot / 1e3, "avg_ms": tot / calls / 1e3,
+                "max_ms": mx / 1e3, "min_ms": mn / 1e3,
+                "ratio": tot / total,
+            })
+        return out
+
+    def table(self, limit: int = 30) -> str:
+        rows = self.rows()[:limit]
+        header = (f"{'Device op':<48}{'Calls':>8}{'Total(ms)':>12}"
+                  f"{'Avg(ms)':>12}{'Ratio':>8}")
+        lines = [header, "-" * len(header)]
+        for r in rows:
+            lines.append(
+                f"{r['name'][:47]:<48}{r['calls']:>8}"
+                f"{r['total_ms']:>12.3f}{r['avg_ms']:>12.3f}"
+                f"{r['ratio']:>8.1%}")
+        return "\n".join(lines)
+
+
 class Profiler:
     """Reference-parity profiler driver.
 
@@ -268,3 +343,13 @@ class Profiler:
     def device_trace_dir(self):
         """Directory with the XLA xplane dump (TensorBoard-viewable)."""
         return self._device_trace_dir
+
+    def device_summary(self) -> "DeviceSummaryView":
+        """Per-op device-time table from the capture (reference
+        profiler_statistic.py kernel summary).  Requires a device target
+        in ``targets`` and a completed record window."""
+        if self._device_trace_dir is None:
+            raise RuntimeError(
+                "device_summary(): no device capture — profile with "
+                "targets=[ProfilerTarget.TPU] and complete a record step")
+        return DeviceSummaryView(self._device_trace_dir)

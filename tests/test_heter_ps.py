@@ -1,0 +1,101 @@
+"""HeterPS-analogue HBM embedding cache (VERDICT missing item 9;
+reference paddle/fluid/framework/fleet/heter_ps/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.distributed import HBMEmbedding
+
+
+def test_cold_then_hot_lookup_consistent():
+    paddle.seed(0)
+    emb = HBMEmbedding(100, 4, hot_rows=8, sync_interval=1,
+                       learning_rate=0.0)
+    ids = paddle.to_tensor(np.asarray([5, 7, 5], np.int64))
+    first = np.asarray(emb(ids)._value)
+    # sync happened (interval=1): 5 and 7 should now be resident
+    assert {5, 7} <= emb.resident_ids
+    second = np.asarray(emb(ids)._value)
+    np.testing.assert_allclose(second, first, rtol=1e-6)
+    # duplicate id rows identical
+    np.testing.assert_allclose(first[0], first[2])
+
+
+def test_admission_promotes_hottest():
+    paddle.seed(1)
+    emb = HBMEmbedding(1000, 4, hot_rows=8, sync_interval=100)
+    rng = np.random.default_rng(0)
+    # id 42 appears every batch; noise ids appear once
+    for step in range(99):
+        ids = np.concatenate([[42], rng.integers(100, 1000, 3)])
+        emb(paddle.to_tensor(ids.astype(np.int64)))
+    emb.sync_cache()
+    assert 42 in emb.resident_ids
+
+
+def test_eviction_flushes_rows_to_cold_store():
+    paddle.seed(2)
+    emb = HBMEmbedding(100, 4, hot_rows=2, sync_interval=1,
+                       learning_rate=0.0)
+    a = np.asarray(emb(paddle.to_tensor(np.asarray([1], np.int64)))._value)
+    emb(paddle.to_tensor(np.asarray([2], np.int64)))
+    # cache is full (1, 2); admitting 3 and 4 evicts 1 and 2
+    emb(paddle.to_tensor(np.asarray([3], np.int64)))
+    emb(paddle.to_tensor(np.asarray([4], np.int64)))
+    assert len(emb.resident_ids) <= 2
+    # evicted id 1 must read back the same row from the cold store
+    b = np.asarray(emb(paddle.to_tensor(np.asarray([1], np.int64)))._value)
+    np.testing.assert_allclose(b, a, rtol=1e-6)
+
+
+def test_hot_rows_train_via_optimizer():
+    paddle.seed(3)
+    emb = HBMEmbedding(50, 4, hot_rows=8, sync_interval=1,
+                       learning_rate=0.1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=emb.parameters())
+    ids = paddle.to_tensor(np.asarray([9], np.int64))
+    emb(ids)  # admit 9
+    assert 9 in emb.resident_ids
+    before = np.asarray(emb(ids)._value).copy()
+    loss = (emb(ids) ** 2).sum()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    after = np.asarray(emb(ids)._value)
+    assert not np.allclose(after, before)  # hot row moved
+    # direction: gradient of sum(x^2) is 2x -> row shrinks
+    assert (np.abs(after) <= np.abs(before) + 1e-6).all()
+
+
+def test_cold_rows_train_via_push():
+    paddle.seed(4)
+    emb = HBMEmbedding(50, 4, hot_rows=2, sync_interval=10**9,
+                       learning_rate=0.1)  # never promote
+    ids = paddle.to_tensor(np.asarray([11], np.int64))
+    before = np.asarray(emb(ids)._value).copy()
+    loss = (emb(ids) ** 2).sum()
+    loss.backward()
+    after = np.asarray(emb(ids)._value)
+    # push-on-backward already applied SGD on the cold store
+    np.testing.assert_allclose(after, before - 0.1 * 2 * before, rtol=1e-5)
+
+
+def test_over_ps_client_cold_store():
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+    server = PSServer(0)
+    client = PSClient("127.0.0.1", server.port)
+    try:
+        paddle.seed(5)
+        emb = HBMEmbedding(100, 4, hot_rows=8, ps_client=client,
+                           table_id=7, sync_interval=1, learning_rate=0.0)
+        ids = paddle.to_tensor(np.asarray([3, 4], np.int64))
+        first = np.asarray(emb(ids)._value)
+        assert {3, 4} <= emb.resident_ids
+        second = np.asarray(emb(ids)._value)
+        np.testing.assert_allclose(second, first, rtol=1e-6)
+        assert client.sparse_table_size(7) >= 2
+    finally:
+        client.close()
+        server.stop()

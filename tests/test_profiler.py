@@ -98,3 +98,38 @@ def test_profiler_step_scheduler_tuple():
     recording = [s in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
                  for s in seen]
     assert recording == [False, True, True, False]
+
+
+def test_device_summary_parses_capture(tmp_path):
+    # synthetic jax-profiler-style chrome trace: device pid 2, host pid 1
+    import gzip
+    import json
+    from paddle_tpu.profiler import DeviceSummaryView
+
+    d = tmp_path / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    trace = {"traceEvents": [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 2, "tid": 1, "name": "fusion.1",
+         "ts": 0, "dur": 1500.0},
+        {"ph": "X", "pid": 2, "tid": 1, "name": "fusion.1",
+         "ts": 2000, "dur": 500.0},
+        {"ph": "X", "pid": 2, "tid": 1, "name": "dot.7",
+         "ts": 3000, "dur": 1000.0},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "host_thing",
+         "ts": 0, "dur": 9999.0},
+    ]}
+    with gzip.open(d / "machine.trace.json.gz", "wt") as f:
+        json.dump(trace, f)
+
+    view = DeviceSummaryView(str(tmp_path))
+    rows = view.rows()
+    names = {r["name"]: r for r in rows}
+    assert "host_thing" not in names          # host lane filtered out
+    assert names["fusion.1"]["calls"] == 2
+    assert abs(names["fusion.1"]["total_ms"] - 2.0) < 1e-9
+    assert rows[0]["name"] == "fusion.1"      # sorted by total desc
+    assert "fusion.1" in view.table()
