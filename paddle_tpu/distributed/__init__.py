@@ -29,6 +29,7 @@ from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .utils import recompute  # noqa: F401
+from .eager_comm import init_eager_comm, get_eager_comm  # noqa: F401
 from . import launch  # noqa: F401
 from . import rpc  # noqa: F401
 from . import ps  # noqa: F401

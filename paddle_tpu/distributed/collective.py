@@ -9,9 +9,12 @@ TPU-native semantics: collectives are *compiler* operations.  Inside a
 to XLA collectives riding ICI (psum/all_gather/ppermute/reduce_scatter).
 Outside such a region on a single process they are identities over the one
 logical array — matching the reference's behavior when world_size == 1.
-Calling a cross-axis collective eagerly with a >1 axis raises, directing the
-user to the shard_map context — there is deliberately no eager NCCL-style
-data plane on TPU (SURVEY §5 "Distributed communication backend").
+Outside compiled regions with a >1-process world, an eager STORE-BACKED
+data plane (``eager_comm.py``, Gloo-analogue over the native TCPStore)
+carries the reference's eager semantics — multi-process debugging,
+LocalSGD parameter averaging, small host-side synchronization.  Install
+it with ``paddle_tpu.distributed.init_eager_comm()``; without it,
+cross-process eager collectives raise with that pointer.
 """
 
 from __future__ import annotations
@@ -77,6 +80,47 @@ class _Task:
         return True
 
 
+def _not_in_group(group) -> bool:
+    """Reference semantics: collectives on a group this rank is not a
+    member of are no-ops."""
+    ranks = getattr(group, "ranks", None)
+    if not ranks:
+        return False
+    from .env import get_rank
+    return get_rank() not in ranks
+
+
+def _eager_plane(group):
+    """Store-backed eager data plane when installed and world > 1.
+    Subgroups get a SCOPED plane (group-local rank/world + key prefix)
+    over the same store, so a 2-rank group inside a 4-rank world never
+    blocks on non-members."""
+    if _world_size(group) <= 1:
+        return None
+    from .eager_comm import EagerComm, get_eager_comm
+    base = get_eager_comm()
+    if base is None:
+        return None
+    ranks = getattr(group, "ranks", None)
+    if group is None or not ranks:
+        return base
+    cached = getattr(group, "_eager_plane", None)
+    if cached is None:
+        from .env import get_rank
+        gid = getattr(group, "id", id(group))
+        cached = EagerComm(base.store, ranks.index(get_rank()),
+                           len(ranks), prefix=f"ec/g{gid}")
+        group._eager_plane = cached
+    return cached
+
+
+_NO_PLANE_MSG = (
+    "{name} across a >1-rank group outside shard_map/pjit needs the eager "
+    "data plane: call paddle_tpu.distributed.init_eager_comm() after "
+    "init_parallel_env() (store-backed, for host-side/debug use), or run "
+    "the step compiled where XLA collectives apply.")
+
+
 def _collective(name, x, group, inside_fn, identity_ok=True):
     axis = _axis_of(group)
     if axis is not None and _in_shard_map(axis):
@@ -91,6 +135,8 @@ def _collective(name, x, group, inside_fn, identity_ok=True):
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    if _not_in_group(group):
+        return _Task()
     axis = _axis_of(group)
     if axis is not None and _in_shard_map(axis):
         def inside(a, ax):
@@ -110,9 +156,18 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         if isinstance(tensor, Tensor):
             tensor._in_place_update(out)
         return _Task()
-    if _world_size(group) == 1 or axis is None:
+    if _world_size(group) == 1:
         return _Task()
-    raise RuntimeError("all_reduce outside shard_map on a >1 group; see docs")
+    plane = _eager_plane(group)
+    if plane is not None:
+        import numpy as np
+        arr = np.asarray(tensor._value if isinstance(tensor, Tensor)
+                         else tensor)
+        reduced = plane.all_reduce(arr, op)
+        if isinstance(tensor, Tensor):
+            tensor._in_place_update(Tensor(jnp.asarray(reduced)))
+        return _Task()
+    raise RuntimeError(_NO_PLANE_MSG.format(name="all_reduce"))
 
 
 def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -135,16 +190,26 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         if isinstance(tensor_list, list):
             tensor_list.append(tensor)
         return _Task()
-    raise RuntimeError("all_gather outside shard_map on a >1 group")
+    plane = _eager_plane(group)
+    if plane is not None:
+        import numpy as np
+        arr = np.asarray(tensor._value if isinstance(tensor, Tensor)
+                         else tensor)
+        for peer in plane.all_gather(arr):
+            tensor_list.append(Tensor(jnp.asarray(peer)))
+        return _Task()
+    raise RuntimeError(_NO_PLANE_MSG.format(name="all_gather"))
 
 
 def all_gather_object(object_list, obj, group=None):
     if _world_size(group) == 1:
         object_list.append(obj)
         return
-    raise NotImplementedError(
-        "all_gather_object requires a multi-process store; use the "
-        "coordination-service KV store (paddle_tpu.distributed.store)")
+    plane = _eager_plane(group)
+    if plane is not None:
+        object_list.extend(plane.all_gather_object(obj))
+        return
+    raise RuntimeError(_NO_PLANE_MSG.format(name="all_gather_object"))
 
 
 def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
@@ -209,8 +274,20 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
 
 
 def broadcast(tensor, src, group=None, sync_op=True):
-    # SPMD: all shards already hold replicated values; broadcast is identity
-    # within a program.  Cross-process eager broadcast uses the coord service.
+    # SPMD in-program: all shards already hold replicated values — identity.
+    if _not_in_group(group):
+        return _Task()
+    plane = _eager_plane(group)
+    if plane is not None:
+        import numpy as np
+        if isinstance(tensor, Tensor):
+            if isinstance(tensor._value, jax.core.Tracer):
+                return _Task()
+            out = plane.broadcast(np.asarray(tensor._value), src)
+            tensor._in_place_update(Tensor(jnp.asarray(out)))
+        else:  # raw numpy arrays are mutated in place
+            arr = np.asarray(tensor)
+            np.copyto(arr, plane.broadcast(arr, src))
     return _Task()
 
 
@@ -238,13 +315,28 @@ def send(tensor, dst=0, group=None, sync_op=True):
             "paddle_tpu.distributed.p2p.ppermute_send_recv (collective_permute)")
     if _world_size(group) == 1:
         return _Task()
-    raise RuntimeError("eager send requires multi-process transfer")
+    plane = _eager_plane(group)
+    if plane is not None:
+        import numpy as np
+        plane.send(np.asarray(tensor._value if isinstance(tensor, Tensor)
+                              else tensor), dst)
+        return _Task()
+    raise RuntimeError(_NO_PLANE_MSG.format(name="send"))
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     if _world_size(group) == 1:
         return _Task()
-    raise RuntimeError("eager recv requires multi-process transfer")
+    plane = _eager_plane(group)
+    if plane is not None:
+        import numpy as np
+        out = plane.recv(src)
+        if isinstance(tensor, Tensor):
+            tensor._in_place_update(Tensor(jnp.asarray(out)))
+        else:
+            np.copyto(np.asarray(tensor), out)
+        return _Task()
+    raise RuntimeError(_NO_PLANE_MSG.format(name="recv"))
 
 
 isend = send

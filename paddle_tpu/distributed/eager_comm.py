@@ -1,0 +1,182 @@
+"""Eager (outside-compiled-region) collectives over the native TCPStore —
+the Gloo-style data plane of the reference
+(``python/paddle/distributed/communication/all_reduce.py`` working eagerly
+through ProcessGroupGloo/NCCL).
+
+On TPU the high-performance path is always the compiled XLA collective;
+this store-backed plane exists for the reference's eager semantics:
+multi-process host-side coordination, debugging runs, small-tensor
+synchronization (e.g. LocalSGD parameter averaging), and CPU CI.  Every
+rank posts its buffer under a sequence-numbered key and reads its peers'
+— O(world^2) traffic through the store server, correct and simple, not a
+throughput path (the reference's Gloo backend has the same shape).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+
+import numpy as np
+
+__all__ = ["EagerComm", "get_eager_comm", "init_eager_comm"]
+
+_comm = None
+_lock = threading.Lock()
+
+
+class EagerComm:
+    def __init__(self, store, rank: int, world: int, prefix: str = "ec"):
+        self.store = store
+        self.rank = rank
+        self.world = world
+        self.prefix = prefix
+        self._seq = 0
+
+    def _key(self, seq, rank, tag=""):
+        return f"{self.prefix}/{seq}{tag}/{rank}"
+
+    def _next(self):
+        self._seq += 1
+        return self._seq
+
+    # -- primitives -----------------------------------------------------
+    def _post_and_collect(self, payload: bytes, seq, tag="") -> list:
+        self.store.set(self._key(seq, self.rank, tag), payload)
+        out = []
+        for r in range(self.world):
+            out.append(self.store.get(self._key(seq, r, tag)))
+        # GC: the LAST rank to finish reading tombstones the payloads
+        # (1-byte markers); without it a long run accumulates every
+        # historical buffer in the store server
+        done = self.store.add(f"{self.prefix}/done/{seq}{tag}", 1)
+        if done == self.world:
+            for r in range(self.world):
+                self.store.set(self._key(seq, r, tag), b"\0")
+        return out
+
+    def all_reduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
+        seq = self._next()
+        arr = np.ascontiguousarray(array)
+        blobs = self._post_and_collect(
+            pickle.dumps((arr.dtype.str, arr.shape, arr.tobytes())), seq)
+        acc = None
+        for blob in blobs:
+            dt, shape, raw = pickle.loads(blob)
+            peer = np.frombuffer(raw, np.dtype(dt)).reshape(shape)
+            if acc is None:
+                acc = peer.astype(np.float64) \
+                    if np.issubdtype(peer.dtype, np.floating) else \
+                    peer.copy()
+            elif op in ("sum", "avg"):
+                acc = acc + peer
+            elif op == "max":
+                acc = np.maximum(acc, peer)
+            elif op == "min":
+                acc = np.minimum(acc, peer)
+            elif op == "prod":
+                acc = acc * peer
+            else:
+                raise ValueError(f"unsupported reduce op {op!r}")
+        if op == "avg":
+            acc = acc / self.world
+        return np.asarray(acc, arr.dtype)
+
+    def all_gather(self, array: np.ndarray) -> list:
+        seq = self._next()
+        arr = np.ascontiguousarray(array)
+        blobs = self._post_and_collect(
+            pickle.dumps((arr.dtype.str, arr.shape, arr.tobytes())), seq)
+        out = []
+        for blob in blobs:
+            dt, shape, raw = pickle.loads(blob)
+            out.append(np.frombuffer(raw, np.dtype(dt)).reshape(shape)
+                       .copy())
+        return out
+
+    def all_gather_object(self, obj) -> list:
+        seq = self._next()
+        blobs = self._post_and_collect(pickle.dumps(obj), seq, tag="o")
+        return [pickle.loads(b) for b in blobs]
+
+    def broadcast(self, array: np.ndarray, src: int) -> np.ndarray:
+        seq = self._next()
+        if self.rank == src:
+            arr = np.ascontiguousarray(array)
+            self.store.set(self._key(seq, src, "b"),
+                           pickle.dumps((arr.dtype.str, arr.shape,
+                                         arr.tobytes())))
+        blob = self.store.get(self._key(seq, src, "b"))
+        dt, shape, raw = pickle.loads(blob)
+        done = self.store.add(f"{self.prefix}/done/{seq}b", 1)
+        if done == self.world:
+            self.store.set(self._key(seq, src, "b"), b"\0")
+        return np.frombuffer(raw, np.dtype(dt)).reshape(shape).copy()
+
+    def send(self, array: np.ndarray, dst: int, tag: int = 0):
+        # per-pair store counters sequence repeated sends under one tag
+        # (matching call order on both sides), so no message is lost or
+        # read twice
+        idx = self.store.add(
+            f"{self.prefix}/p2ps/{self.rank}->{dst}/{tag}", 1)
+        arr = np.ascontiguousarray(array)
+        self.store.set(f"{self.prefix}/p2p/{self.rank}->{dst}/{tag}/{idx}",
+                       pickle.dumps((arr.dtype.str, arr.shape,
+                                     arr.tobytes())))
+
+    def recv(self, src: int, tag: int = 0) -> np.ndarray:
+        idx = self.store.add(
+            f"{self.prefix}/p2pr/{src}->{self.rank}/{tag}", 1)
+        key = f"{self.prefix}/p2p/{src}->{self.rank}/{tag}/{idx}"
+        blob = self.store.get(key)
+        dt, shape, raw = pickle.loads(blob)
+        self.store.set(key, b"\0")  # GC the payload
+        return np.frombuffer(raw, np.dtype(dt)).reshape(shape).copy()
+
+    def barrier(self):
+        seq = self._next()
+        n = self.store.add(f"{self.prefix}/bar/{seq}", 1)
+        while n < self.world:
+            import time
+            time.sleep(0.002)
+            n = self.store.add(f"{self.prefix}/bar/{seq}", 0)
+
+
+def init_eager_comm(store=None, rank=None, world=None):
+    """Install the eager data plane.  Without arguments, bootstraps from
+    the launcher env (MASTER_ADDR + PADDLE_EAGER_STORE_PORT, rank 0 hosts
+    the store server)."""
+    global _comm
+    with _lock:
+        if store is not None:
+            from .env import get_rank, get_world_size
+            _comm = EagerComm(store,
+                              get_rank() if rank is None else rank,
+                              get_world_size() if world is None else world)
+            return _comm
+        from .env import get_rank, get_world_size
+        rank = get_rank() if rank is None else rank
+        world = get_world_size() if world is None else world
+        if world <= 1:
+            _comm = None
+            return None
+        from ..runtime import TCPStore, TCPStoreServer
+        addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
+        port = int(os.environ.get(
+            "PADDLE_EAGER_STORE_PORT",
+            int(os.environ.get("MASTER_PORT", "8787")) + 17))
+        if rank == 0:
+            server = TCPStoreServer(port)
+            _comm_server_keepalive.append(server)
+            port = server.port
+        client = TCPStore(addr, port)
+        _comm = EagerComm(client, rank, world)
+        return _comm
+
+
+_comm_server_keepalive: list = []
+
+
+def get_eager_comm():
+    return _comm
