@@ -20,7 +20,91 @@ import sys
 import time
 
 __all__ = ["ElasticStatus", "LauncherInterface", "ElasticManager",
-           "enable_elastic", "launch_elastic"]
+           "MembershipRegistry", "enable_elastic", "launch_elastic"]
+
+
+class MembershipRegistry:
+    """Live-node registry over the native TCPStore — the ETCD-registry
+    analogue the reference manager watches
+    (``fleet/elastic/manager.py:126`` watches an etcd prefix of pods).
+
+    Each node slot heartbeats an atomic counter
+    (``{prefix}/hb/{slot}``); a node is ALIVE when its counter advanced
+    since the previous poll.  ``poll()`` returns the member set and a
+    scale event ("scale_up"/"scale_down") when membership changed —
+    counters avoid needing key listing or TTLs on the store.
+    """
+
+    def __init__(self, store, node_id: int, max_nodes: int = 64,
+                 prefix: str = "elastic", heartbeat_interval: float = 0.5):
+        self.store = store
+        self.node_id = int(node_id)
+        self.max_nodes = max_nodes
+        self.prefix = prefix
+        self.heartbeat_interval = heartbeat_interval
+        self._beating = False
+        self._thread = None
+        self._last_counts = {}
+
+    def _key(self, slot):
+        return f"{self.prefix}/hb/{slot}"
+
+    # -- node side ------------------------------------------------------
+    def register(self):
+        """Start heartbeating this node's slot."""
+        import threading
+        if self._beating:
+            return
+        self._beating = True
+        self.store.add(self._key(self.node_id), 1)
+
+        def beat():
+            while self._beating:
+                try:
+                    self.store.add(self._key(self.node_id), 1)
+                except Exception:
+                    pass
+                time.sleep(self.heartbeat_interval)
+
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+
+    def deregister(self):
+        self._beating = False
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    # -- manager side ---------------------------------------------------
+    def _counts(self):
+        out = {}
+        for slot in range(self.max_nodes):
+            try:
+                out[slot] = self.store.add(self._key(slot), 0)
+            except Exception:
+                out[slot] = 0
+        return out
+
+    def snapshot(self):
+        """Prime the alive-detection baseline."""
+        self._last_counts = self._counts()
+
+    def members(self):
+        """Nodes whose heartbeat advanced since the last poll (call at a
+        period longer than the heartbeat interval)."""
+        now = self._counts()
+        alive = sorted(s for s, c in now.items()
+                       if c > self._last_counts.get(s, 0))
+        self._last_counts = now
+        return alive
+
+    def poll(self, prev_members):
+        """(members, event): event is "scale_up"/"scale_down"/None."""
+        cur = self.members()
+        prev = sorted(prev_members)
+        if cur == prev:
+            return cur, None
+        return cur, ("scale_up" if len(cur) > len(prev) else "scale_down")
 
 
 class ElasticStatus:
@@ -50,9 +134,9 @@ class LauncherInterface:
                 p.kill()
         self.procs = []
 
-    def launch(self):
+    def launch(self, env=None):
         cmd = list(self.args)
-        self.procs.append(subprocess.Popen(cmd))
+        self.procs.append(subprocess.Popen(cmd, env=env))
 
     def watch(self):
         """Poll worker status: None while running, else an ElasticStatus."""
@@ -70,27 +154,67 @@ class LauncherInterface:
 
 
 class ElasticManager:
-    """Supervise a training command; on worker failure restart it (up to
-    ``max_restart``), mirroring the reference's pod-level restart loop."""
+    """Supervise a training command; restart on worker failure (up to
+    ``max_restart``) AND on membership scale events when a
+    :class:`MembershipRegistry` is attached — the reference's pod-watch
+    restart loop, with the new world size exported to the relaunched job
+    via ``PADDLE_TRAINERS_NUM``."""
 
-    def __init__(self, cmd, max_restart: int = 3, poll_interval: float = 0.5):
+    def __init__(self, cmd, max_restart: int = 3, poll_interval: float = 0.5,
+                 registry: "MembershipRegistry" = None):
         self.cmd = list(cmd)
         self.max_restart = max_restart
         self.poll_interval = poll_interval
         self.restarts = 0
         self.launcher = None
+        self.registry = registry
+        self.events = []           # (event, members) history
+        self._members = []
+
+    def _watch_membership(self):
+        if self.registry is None:
+            return None
+        # rate-limit: members() needs polls spaced well past the heartbeat
+        # interval (a same-speed poll can miss a live node's beat and
+        # thrash restart/scale events forever)
+        min_gap = max(self.poll_interval,
+                      3.0 * self.registry.heartbeat_interval)
+        now = time.time()
+        if now - getattr(self, "_last_member_poll", 0.0) < min_gap:
+            return None
+        self._last_member_poll = now
+        cur, event = self.registry.poll(self._members)
+        self._members = cur
+        if event is not None:
+            self.events.append((event, list(cur)))
+            return ElasticStatus.RESTART
+        return None
 
     def run(self) -> str:
+        if self.registry is not None:
+            self.registry.snapshot()
+            time.sleep(self.registry.heartbeat_interval * 2)
+            self._members = self.registry.members()
         while True:
+            env = dict(os.environ)
+            if self.registry is not None and self._members:
+                env["PADDLE_TRAINERS_NUM"] = str(len(self._members))
             self.launcher = LauncherInterface(self.cmd)
-            self.launcher.launch()
+            self.launcher.launch(env=env)
             status = None
             while status is None:
                 time.sleep(self.poll_interval)
                 status = self.launcher.watch()
+                if status is None:
+                    status = self._watch_membership()
             if status == ElasticStatus.COMPLETED:
                 return ElasticStatus.COMPLETED
             self.launcher.stop()
+            if status == ElasticStatus.RESTART:
+                print(f"[elastic] membership changed -> "
+                      f"{len(self._members)} node(s); restarting",
+                      file=sys.stderr)
+                continue  # scale events do not consume restart budget
             self.restarts += 1
             if self.restarts > self.max_restart:
                 return ElasticStatus.ERROR

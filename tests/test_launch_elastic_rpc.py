@@ -134,3 +134,83 @@ def test_rpc_two_processes(tmp_path):
     outs = [p.communicate(timeout=120)[0] for p in procs]
     assert all(p.returncode == 0 for p in procs), outs
     assert any("rpc-ok" in o for o in outs), outs
+
+
+def test_membership_registry_scale_events():
+    import time
+    from paddle_tpu.distributed.fleet.elastic import MembershipRegistry
+    from paddle_tpu.runtime import TCPStore, TCPStoreServer
+
+    server = TCPStoreServer(0)
+    try:
+        mgr_reg = MembershipRegistry(
+            TCPStore("127.0.0.1", server.port), node_id=-1, max_nodes=4,
+            heartbeat_interval=0.05)
+        n0 = MembershipRegistry(TCPStore("127.0.0.1", server.port), 0,
+                                max_nodes=4, heartbeat_interval=0.05)
+        n1 = MembershipRegistry(TCPStore("127.0.0.1", server.port), 1,
+                                max_nodes=4, heartbeat_interval=0.05)
+        mgr_reg.snapshot()
+        n0.register()
+        time.sleep(0.2)
+        members, event = mgr_reg.poll([])
+        assert members == [0] and event == "scale_up"
+
+        n1.register()
+        time.sleep(0.2)
+        members, event = mgr_reg.poll(members)
+        assert members == [0, 1] and event == "scale_up"
+
+        # node 1 dies: heartbeats stop, next polls drop it
+        n1.deregister()
+        time.sleep(0.3)
+        mgr_reg.members()           # settle the baseline past the last beat
+        time.sleep(0.3)
+        members, event = mgr_reg.poll([0, 1])
+        assert members == [0] and event == "scale_down", (members, event)
+
+        n0.deregister()
+    finally:
+        server.stop()
+
+
+def test_elastic_manager_records_scale_event(tmp_path):
+    import sys
+    import textwrap
+    import threading
+    import time
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      MembershipRegistry)
+    from paddle_tpu.runtime import TCPStore, TCPStoreServer
+
+    server = TCPStoreServer(0)
+    try:
+        # a long-running worker script (killed by the scale restart)
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent("""
+            import time
+            time.sleep(30)
+        """))
+        reg = MembershipRegistry(TCPStore("127.0.0.1", server.port), -1,
+                                 max_nodes=4, heartbeat_interval=0.05)
+        n0 = MembershipRegistry(TCPStore("127.0.0.1", server.port), 0,
+                                max_nodes=4, heartbeat_interval=0.05)
+        n0.register()
+        mgr = ElasticManager([sys.executable, str(script)],
+                             poll_interval=0.1, registry=reg)
+        t = threading.Thread(target=mgr.run, daemon=True)
+        t.start()
+        time.sleep(0.8)
+        n1 = MembershipRegistry(TCPStore("127.0.0.1", server.port), 1,
+                                max_nodes=4, heartbeat_interval=0.05)
+        n1.register()            # scale-up while the job runs
+        deadline = time.time() + 10
+        while not mgr.events and time.time() < deadline:
+            time.sleep(0.1)
+        assert mgr.events and mgr.events[0][0] == "scale_up"
+        assert 1 in mgr.events[0][1]
+        mgr.exit()
+        n0.deregister()
+        n1.deregister()
+    finally:
+        server.stop()
