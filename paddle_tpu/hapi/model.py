@@ -22,26 +22,67 @@ __all__ = ["Model"]
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) else (
+            [inputs] if inputs is not None else None)
+        self._labels = labels
         self._optimizer = None
         self._loss = None
         self._metrics: List[Metric] = []
         self.stop_training = False
         self._train_step = None
+        self._amp_level = None
+        self._scaler = None
 
     # ---- configuration ----
     def prepare(self, optimizer=None, loss=None, metrics=None, jit=True,
                 amp_configs=None):
+        """``amp_configs``: "O1"/"O2" or a dict with "level" (+ optional
+        GradScaler kwargs under "scaler") — reference Model.prepare's AMP
+        contract.  O1 wraps the eager forward in auto_cast; O2 additionally
+        runs the compiled step in bf16 with master weights."""
         self._optimizer = optimizer
         self._loss = loss
         if metrics is not None:
             self._metrics = metrics if isinstance(metrics, list) else [metrics]
         self._use_jit = jit
+        if amp_configs is not None:
+            if isinstance(amp_configs, str):
+                self._amp_level = amp_configs
+            else:
+                self._amp_level = amp_configs.get("level", "O1")
+            if self._amp_level not in ("O0", "O1", "O2"):
+                raise ValueError(
+                    f"amp level must be O0/O1/O2, got {self._amp_level!r}")
+            if self._amp_level == "O2":
+                self.network.to(dtype="bfloat16")
+                if optimizer is not None:
+                    optimizer._multi_precision = True
+            if self._amp_level in ("O1",) and not jit:
+                from .. import amp as _amp
+                self._scaler = _amp.GradScaler()
         return self
 
-    def _make_loader(self, data, batch_size, shuffle):
+    def _fleet_world(self):
+        """Data-parallel process world when fleet/launch is active."""
+        try:
+            from ..distributed import get_world_size
+            return get_world_size()
+        except Exception:
+            return 1
+
+    def _make_loader(self, data, batch_size, shuffle, num_workers=0):
         if data is None or isinstance(data, DataLoader):
             return data
-        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        if self._fleet_world() > 1:
+            # fleet-aware fit: each process reads its shard (reference
+            # hapi model distributed fit uses DistributedBatchSampler)
+            from ..io import DistributedBatchSampler
+            sampler = DistributedBatchSampler(
+                data, batch_size=batch_size, shuffle=shuffle)
+            return DataLoader(data, batch_sampler=sampler,
+                              num_workers=num_workers)
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers)
 
     def _compute_loss(self, outputs, labels):
         outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
@@ -63,9 +104,19 @@ class Model:
             [labels] if labels is not None else [])
         if self._use_jit and self._train_step is None:
             from ..jit.train_step import TrainStep
+            amp_level = self._amp_level
 
             def loss_fn(net, *args):
                 n_in = len(inputs)
+                if amp_level == "O1":
+                    # the dispatch-level cast hook applies while TRACING,
+                    # so O1 autocast composes with the compiled step (bf16
+                    # matmuls, fp32 master math — no loss scaling needed
+                    # for bf16)
+                    from .. import amp as _amp
+                    with _amp.auto_cast(level="O1"):
+                        outs = net(*args[:n_in])
+                        return self._compute_loss(outs, list(args[n_in:]))
                 outs = net(*args[:n_in])
                 return self._compute_loss(outs, list(args[n_in:]))
 
@@ -77,8 +128,22 @@ class Model:
         if self._train_step:
             loss = self._train_step(*inputs, *labels)
             return [float(np.asarray(loss._value))]
-        outputs = self.network(*inputs)
-        loss = self._compute_loss(outputs, labels)
+        if self._amp_level == "O1":
+            from .. import amp as _amp
+            with _amp.auto_cast(level="O1"):
+                outputs = self.network(*inputs)
+                loss = self._compute_loss(outputs, labels)
+            if self._scaler is not None:
+                scaled = self._scaler.scale(loss)
+                scaled.backward()
+                if update:
+                    self._scaler.step(self._optimizer)
+                    self._scaler.update()
+                    self._optimizer.clear_grad()
+                return [float(np.asarray(loss._value))]
+        else:
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
         loss.backward()
         if update:
             self._optimizer.step()
@@ -115,7 +180,8 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None):
-        loader = self._make_loader(train_data, batch_size, shuffle)
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers)
         eval_loader = self._make_loader(eval_data, batch_size, False)
         cbks = config_callbacks(callbacks, model=self, epochs=epochs,
                                 log_freq=log_freq, verbose=verbose,
@@ -183,9 +249,23 @@ class Model:
 
     # ---- persistence / info ----
     def save(self, path, training=True):
+        """training=True: checkpoint (params + optimizer state).
+        training=False: export the INFERENCE artifact via jit.save using
+        the InputSpecs given at construction (reference Model.save's
+        dual behavior, hapi/model.py _save_inference_model)."""
+        if not training:
+            if self._inputs is None:
+                raise ValueError(
+                    "Model.save(training=False) exports an inference "
+                    "model and needs input specs: Model(net, "
+                    "inputs=[InputSpec(...)])")
+            from ..jit.api import save as jit_save
+            self.network.eval()
+            jit_save(self.network, path, input_spec=list(self._inputs))
+            return
         from ..framework.io import save as fsave
         fsave(self.network.state_dict(), path + ".pdparams")
-        if training and self._optimizer is not None:
+        if self._optimizer is not None:
             fsave(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):

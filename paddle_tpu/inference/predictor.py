@@ -19,7 +19,23 @@ import numpy as np
 
 
 class Config:
-    """≙ paddle_infer.Config (analysis_config.cc)."""
+    """≙ paddle_infer.Config (analysis_config.cc).
+
+    Knobs with real effect on this backend:
+
+    - ``set_compilation_cache_dir`` — persistent XLA executable cache
+      (≙ serialized TRT engines).
+    - ``enable_memory_optim`` — donate input device buffers to the
+      executable so XLA reuses them for outputs (≙ memory-reuse passes).
+    - ``set_tpu_device_id`` / ``set_device_id`` — place weights and run
+      on a specific local device.
+    - precision is an EXPORT-TIME property on TPU: pass
+      ``precision="bfloat16"`` to ``paddle.jit.save`` — the knob readers
+      (``precision_mode``) report what the artifact was exported with.
+    - graph passes: XLA's fixed pipeline subsumes the reference's IR pass
+      registry; ``pass_builder().all_passes()`` reports that honestly,
+      ``switch_ir_optim``/``delete_pass`` are accepted no-ops.
+    """
 
     def __init__(self, prog_file: Optional[str] = None,
                  params_file: Optional[str] = None):
@@ -30,9 +46,12 @@ class Config:
             self._prefix = prog_file
         self._params_file = params_file
         self._cache_dir: Optional[str] = None
-        self._memory_optim = True
+        self._memory_optim = False
         self._glog_info = False
         self._device = None
+        self._device_id = 0
+        self._ir_optim = True
+        self._math_threads = None
 
     def set_model(self, prefix: str, params_file: Optional[str] = None):
         self._prefix = prefix
@@ -42,7 +61,11 @@ class Config:
         return self._prefix
 
     def enable_memory_optim(self, flag: bool = True):
+        """Donate input buffers to the executable (XLA reuses them)."""
         self._memory_optim = flag
+
+    def memory_optim_enabled(self) -> bool:
+        return self._memory_optim
 
     def disable_glog_info(self):
         self._glog_info = False
@@ -51,18 +74,57 @@ class Config:
         """Persistent XLA executable cache (≙ TRT engine serialization)."""
         self._cache_dir = path
 
-    def enable_tpu(self):
+    def enable_tpu(self, device_id: int = 0):
         self._device = "tpu"
+        self._device_id = device_id
 
-    def enable_use_gpu(self, *a, **k):  # accepted for API parity
+    def set_tpu_device_id(self, device_id: int):
+        self._device_id = device_id
+
+    set_device_id = set_tpu_device_id
+
+    def tpu_device_id(self) -> int:
+        return self._device_id
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       *a, **k):  # accepted for API parity
         self._device = "tpu"
+        self._device_id = device_id
 
     def disable_gpu(self):
         self._device = "cpu"
 
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._math_threads = int(n)
+
+    def cpu_math_library_num_threads(self) -> int:
+        return self._math_threads or 1
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag  # XLA's pipeline is not individually gated
+
+    def ir_optim(self) -> bool:
+        return self._ir_optim
+
+    def delete_pass(self, name: str):
+        pass  # XLA has no user-deletable pass registry
+
+    def pass_builder(self):
+        cfg = self
+
+        class _PassBuilder:
+            def all_passes(self):
+                return ["xla:fixed-pipeline(fusion,layout,rematerialization)"]
+
+            def delete_pass(self, name):
+                pass
+
+        return _PassBuilder()
+
     def summary(self) -> str:
-        return (f"Config(model={self._prefix!r}, device={self._device}, "
-                f"cache_dir={self._cache_dir!r})")
+        return (f"Config(model={self._prefix!r}, device={self._device}"
+                f":{self._device_id}, cache_dir={self._cache_dir!r}, "
+                f"memory_optim={self._memory_optim})")
 
 
 class _IOHandle:
@@ -99,7 +161,7 @@ class Predictor:
         self.config = config
         if _shared is not None:
             (self._exported, self._param_values, self._in_spec,
-             self._compiled) = _shared
+             self._compiled, self._precision) = _shared
         else:
             prefix = config.model_dir()
             if prefix is None:
@@ -115,11 +177,27 @@ class Predictor:
                 self._exported = jax_export.deserialize(f.read())
             with open(prefix + ".ptpu_params", "rb") as f:
                 meta = pickle.load(f)
-            self._param_values = [jnp.asarray(v) for v in meta["values"]]
+            device = None
+            try:
+                devices = jax.devices()
+                if 0 <= config._device_id < len(devices):
+                    device = devices[config._device_id]
+            except Exception:
+                pass
+            self._param_values = [
+                jax.device_put(jnp.asarray(v), device) if device is not None
+                else jnp.asarray(v) for v in meta["values"]]
             self._in_spec = meta["in_spec"]
+            self._precision = meta.get("precision")
             exported = self._exported
+            jit_kwargs = {}
+            if config._memory_optim and self._in_spec:
+                # donate input buffers: XLA may write outputs in place
+                jit_kwargs["donate_argnums"] = tuple(
+                    range(1, 1 + len(self._in_spec)))
             self._compiled = jax.jit(
-                lambda pv, *ins: exported.call(pv, *ins))
+                lambda pv, *ins: exported.call(pv, *ins), **jit_kwargs)
+        self._precision = getattr(self, "_precision", None)
         self._inputs: Dict[str, _IOHandle] = {}
         self._outputs: Dict[str, _IOHandle] = {}
         self._out_values: Optional[tuple] = None
@@ -146,11 +224,16 @@ class Predictor:
     def run(self, inputs: Optional[List] = None):
         """Execute the compiled program. Either feed via input handles
         (reference style) or pass arrays directly and get arrays back."""
+        donating = self.config._memory_optim
         if inputs is not None:
             arrays = [getattr(a, "_value", None) if hasattr(a, "_value")
                       else jnp.asarray(a) for a in inputs]
             arrays = [a if a is not None else jnp.asarray(b)
                       for a, b in zip(arrays, inputs)]
+            if donating:
+                # donation invalidates the fed buffers; callers own these
+                # arrays (paddle Tensors), so feed defensive copies
+                arrays = [jnp.array(a, copy=True) for a in arrays]
         else:
             arrays = []
             for name, h in self._inputs.items():
@@ -158,6 +241,12 @@ class Predictor:
                     raise RuntimeError(f"input {name!r} not set; call "
                                        "copy_from_cpu first")
                 arrays.append(h._array)
+            if donating:
+                # staged device buffers are predictor-owned (copy_from_cpu
+                # staged them); mark them consumed so a second run()
+                # cannot feed donated (deleted) buffers
+                for h in self._inputs.values():
+                    h._array = None
         with self._lock:
             out = self._compiled(self._param_values, *arrays)
         outs = out if isinstance(out, (tuple, list)) else (out,)
@@ -177,12 +266,18 @@ class Predictor:
             # graph load; we materialize them on first demand)
             raise RuntimeError("no outputs yet; call run() first")
 
+    def precision_mode(self) -> Optional[str]:
+        """Export-time compute precision of the loaded artifact (set via
+        paddle.jit.save(precision=...)); None = full precision."""
+        return self._precision
+
     def clone(self) -> "Predictor":
         """Share weights + executable with a new handle (per-thread serving,
         ≙ AnalysisPredictor::Clone)."""
         return Predictor(self.config,
                          _shared=(self._exported, self._param_values,
-                                  self._in_spec, self._compiled))
+                                  self._in_spec, self._compiled,
+                                  self._precision))
 
 
 def create_predictor(config: Config) -> Predictor:

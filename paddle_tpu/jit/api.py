@@ -219,16 +219,30 @@ class TranslatedLayer:
         return {str(i): Tensor(a) for i, a in enumerate(self._state)}
 
 
-def save(layer, path, input_spec=None, **configs):
+def save(layer, path, input_spec=None, precision=None, **configs):
     """Serialize a compiled inference program + weights.
 
     TPU-native analogue of paddle.jit.save (reference python/paddle/jit/api.py
     save): the forward is exported to portable StableHLO via jax.export, the
     weights to a pickle — loadable without the model's Python class.
+
+    ``precision``: export-time compute dtype ("bfloat16"/"float16") — float
+    params are cast and float inputs converted at the program boundary, so
+    the exported StableHLO computes natively in the low precision.  This is
+    where the reference's inference precision conversion happens
+    (convert_to_mixed_precision / analysis_config precision modes); on TPU
+    precision is a property of the traced program, chosen at export.
     """
     from ..nn.layer.layers import Layer
     if input_spec is None:
         raise ValueError("jit.save requires input_spec on TPU (static shapes)")
+    if precision is not None:
+        from ..core.dtypes import convert_dtype
+        precision = str(convert_dtype(precision))
+        if precision not in ("bfloat16", "float16"):
+            raise ValueError(
+                f"jit.save precision must be bfloat16/float16, got "
+                f"{precision!r}")
     specs = [s if isinstance(s, InputSpec) else InputSpec(s.shape, s.dtype)
              for s in input_spec]
     if isinstance(layer, Layer):
@@ -243,8 +257,18 @@ def save(layer, path, input_spec=None, **configs):
 
     names = [k for k, _ in params]
     values = [v._value for _, v in params]
+    if precision is not None:
+        pdt = jnp.dtype(precision)
+        values = [v.astype(pdt) if jnp.issubdtype(v.dtype, jnp.floating)
+                  else v for v in values]
 
     def pure(p_values, *inputs):
+        if precision is not None:
+            pdt_ = jnp.dtype(precision)
+            inputs = tuple(
+                i.astype(pdt_) if jnp.issubdtype(
+                    jnp.asarray(i).dtype, jnp.floating) else i
+                for i in inputs)
         from ..nn.layer.layers import Layer as _L
         if isinstance(layer, _L):
             saved = {}
@@ -298,7 +322,8 @@ def save(layer, path, input_spec=None, **configs):
     with open(path + ".ptpu_params", "wb") as f:
         pickle.dump({"names": names,
                      "values": [np.asarray(v) for v in values],
-                     "in_spec": [(s.shape, str(s.dtype)) for s in specs]}, f)
+                     "in_spec": [(s.shape, str(s.dtype)) for s in specs],
+                     "precision": precision}, f)
 
 
 def load(path, **configs):

@@ -246,3 +246,51 @@ def test_train_step_compiled_matches_eager():
         np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-4)
     np.testing.assert_allclose(net1.weight.numpy(), net2.weight.numpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_model_amp_o1_and_o2_and_inference_export(tmp_path):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    xs = paddle.to_tensor(rng.standard_normal((16, 8)).astype(np.float32))
+    ys = paddle.to_tensor(rng.standard_normal((16, 1)).astype(np.float32))
+    import paddle_tpu.io as io
+    ds = io.TensorDataset([xs, ys])
+
+    # O1 eager path with GradScaler
+    net1 = nn.Linear(8, 1)
+    m1 = paddle.Model(net1)
+    m1.prepare(optimizer=paddle.optimizer.Adam(
+        learning_rate=1e-2, parameters=net1.parameters()),
+        loss=nn.MSELoss(), jit=False, amp_configs="O1")
+    m1.fit(ds, epochs=1, batch_size=8, verbose=0)
+
+    # O2: network runs bf16 with master weights in the compiled step
+    net2 = nn.Linear(8, 1)
+    m2 = paddle.Model(net2)
+    m2.prepare(optimizer=paddle.optimizer.Adam(
+        learning_rate=1e-2, parameters=net2.parameters()),
+        loss=nn.MSELoss(), amp_configs={"level": "O2"})
+    assert str(net2.weight._value.dtype) == "bfloat16"
+    m2.fit(ds, epochs=1, batch_size=8, verbose=0)
+
+    # save(training=False) exports the inference artifact
+    net3 = nn.Linear(8, 1)
+    m3 = paddle.Model(net3, inputs=[InputSpec((2, 8), "float32")])
+    m3.prepare(loss=nn.MSELoss())
+    p = str(tmp_path / "infer")
+    m3.save(p, training=False)
+    loaded = paddle.jit.load(p)
+    x = paddle.to_tensor(rng.standard_normal((2, 8)).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(loaded(x)._value),
+                               np.asarray(net3(x)._value), atol=1e-5)
+
+    # save(training=False) without specs raises clearly
+    m4 = paddle.Model(nn.Linear(2, 2))
+    import pytest
+    with pytest.raises(ValueError, match="input spec"):
+        m4.save(str(tmp_path / "x"), training=False)

@@ -84,3 +84,46 @@ def test_compilation_cache_dir(saved_model, tmp_path):
     outs = predictor.run([x])
     np.testing.assert_allclose(outs[0], expected, rtol=1e-5, atol=1e-5)
     assert os.path.isdir(cache)
+
+
+def test_bf16_export_precision_and_config_knobs(tmp_path):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    net.eval()
+    p = str(tmp_path / "m_bf16")
+    paddle.jit.save(net, p, input_spec=[InputSpec((2, 8), "float32")],
+                    precision="bfloat16")
+    p32 = str(tmp_path / "m_fp32")
+    paddle.jit.save(net, p32, input_spec=[InputSpec((2, 8), "float32")])
+
+    cfg = Config(p)
+    cfg.enable_memory_optim(True)
+    cfg.set_tpu_device_id(0)
+    cfg.set_cpu_math_library_num_threads(2)
+    assert cfg.memory_optim_enabled() and cfg.tpu_device_id() == 0
+    assert "xla" in cfg.pass_builder().all_passes()[0]
+    pred = create_predictor(cfg)
+    assert pred.precision_mode() == "bfloat16"
+
+    x = np.random.default_rng(0).standard_normal((2, 8)).astype(np.float32)
+    out_bf16 = pred.run([x])[0]
+    pred32 = create_predictor(Config(p32))
+    assert pred32.precision_mode() is None
+    out_fp32 = pred32.run([x])[0]
+    # bf16 program tracks fp32 within bf16 tolerance but not exactly
+    np.testing.assert_allclose(out_bf16.astype(np.float32), out_fp32,
+                               atol=0.1, rtol=0.05)
+    assert not np.array_equal(out_bf16.astype(np.float32), out_fp32)
+    # exported weights actually stored in bf16
+    import pickle
+    with open(p + ".ptpu_params", "rb") as f:
+        meta = pickle.load(f)
+    assert str(meta["values"][0].dtype) == "bfloat16"
+    # clone keeps precision metadata
+    assert pred.clone().precision_mode() == "bfloat16"
