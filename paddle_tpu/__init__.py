@@ -56,6 +56,7 @@ from . import audio  # noqa: F401
 from . import text  # noqa: F401
 from . import utils  # noqa: F401
 from . import onnx  # noqa: F401
+from . import strings  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .framework import random as framework_random  # noqa: F401
 from .hapi.model import Model  # noqa: F401

@@ -1,0 +1,129 @@
+"""String tensors (capability analogue of
+``paddle/phi/kernels/strings/``: strings_empty, strings_copy,
+strings_lower_upper over pstring arrays with the unicode tables in
+``unicode.h``).
+
+Strings are host data — no accelerator represents them — so the
+TPU-native form is a numpy object-array container with the reference's
+kernel surface: :func:`empty`, :func:`copy`, :func:`lower`,
+:func:`upper` (full unicode via Python's str, which subsumes the
+reference's hand-rolled unicode case tables), plus ``to_string_tensor``
+/ ``as_list`` conversions used by data pipelines.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "to_string_tensor", "empty", "empty_like",
+           "copy", "lower", "upper"]
+
+
+class StringTensor:
+    """Dense n-d array of variable-length unicode strings."""
+
+    def __init__(self, data, name=None):
+        if isinstance(data, StringTensor):
+            arr = data._data.copy()
+        else:
+            arr = np.asarray(data, dtype=object)
+            flat = arr.reshape(-1)
+            for i, v in enumerate(flat):
+                if isinstance(v, bytes):
+                    flat[i] = v.decode("utf-8")
+                elif not isinstance(v, str):
+                    raise TypeError(
+                        f"StringTensor elements must be str/bytes, got "
+                        f"{type(v).__name__}")
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    def numpy(self):
+        return self._data
+
+    def as_list(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, str):
+            return out
+        return StringTensor(out)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d StringTensor")
+        return self._data.shape[0]
+
+    def __eq__(self, other):
+        other_data = other._data if isinstance(other, StringTensor) \
+            else np.asarray(other, dtype=object)
+        return np.asarray(self._data == other_data)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"
+
+    def lower(self, use_utf8_encoding=True):
+        return lower(self, use_utf8_encoding)
+
+    def upper(self, use_utf8_encoding=True):
+        return upper(self, use_utf8_encoding)
+
+
+def to_string_tensor(data, name=None) -> StringTensor:
+    """≙ core.to_string_tensor / strings creation path."""
+    return StringTensor(data, name=name)
+
+
+def empty(shape, name=None) -> StringTensor:
+    """≙ strings_empty_kernel: a shape-sized tensor of empty strings."""
+    arr = np.empty(tuple(shape), dtype=object)
+    arr.reshape(-1)[:] = ""
+    return StringTensor(arr, name=name)
+
+
+def empty_like(x, name=None) -> StringTensor:
+    return empty(x.shape, name=name)
+
+
+def copy(src: StringTensor) -> StringTensor:
+    """≙ strings_copy_kernel (deep copy)."""
+    return StringTensor(src)
+
+
+def _map(x, fn):
+    x = x if isinstance(x, StringTensor) else StringTensor(x)
+    out = np.empty(x._data.shape, dtype=object)
+    of, sf = out.reshape(-1), x._data.reshape(-1)
+    for i, v in enumerate(sf):
+        of[i] = fn(v)
+    return StringTensor(out)
+
+
+def lower(x, use_utf8_encoding=True, name=None) -> StringTensor:
+    """≙ strings_lower_upper_kernel StringLower.  ``use_utf8_encoding``
+    False restricts to ASCII case mapping (the reference's non-utf8
+    mode); True applies full unicode lowering."""
+    if use_utf8_encoding:
+        return _map(x, str.lower)
+    return _map(x, lambda s: "".join(
+        c.lower() if c.isascii() else c for c in s))
+
+
+def upper(x, use_utf8_encoding=True, name=None) -> StringTensor:
+    if use_utf8_encoding:
+        return _map(x, str.upper)
+    return _map(x, lambda s: "".join(
+        c.upper() if c.isascii() else c for c in s))

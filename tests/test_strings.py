@@ -1,0 +1,54 @@
+"""String tensors (reference paddle/phi/kernels/strings/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import strings
+
+
+def test_create_shape_and_index():
+    st = strings.to_string_tensor([["Hello", "World"], ["Foo", "Bar"]])
+    assert st.shape == [2, 2] and st.size == 4
+    assert st[0, 1] == "World"
+    assert st[1].as_list() == ["Foo", "Bar"]
+    assert len(st) == 2
+
+
+def test_bytes_decode_and_type_error():
+    st = strings.to_string_tensor([b"caf\xc3\xa9"])
+    assert st[0] == "café"
+    with pytest.raises(TypeError, match="str/bytes"):
+        strings.to_string_tensor([1, 2])
+
+
+def test_empty_and_copy():
+    e = strings.empty([2, 3])
+    assert e.shape == [2, 3] and e[0, 0] == ""
+    src = strings.to_string_tensor(["a"])
+    dup = strings.copy(src)
+    dup._data[0] = "b"
+    assert src[0] == "a"  # deep copy
+    assert strings.empty_like(src).shape == [1]
+
+
+def test_lower_upper_unicode():
+    st = strings.to_string_tensor(["HeLLo", "ÀÉÎ", "ß", "İstanbul"])
+    low = strings.lower(st)
+    assert low.as_list() == ["hello", "àéî", "ß", "i̇stanbul"]
+    up = st.upper()
+    assert up[0] == "HELLO" and up[1] == "ÀÉÎ"
+    assert up[2] == "SS"  # full unicode case mapping
+
+
+def test_ascii_only_mode():
+    st = strings.to_string_tensor(["AbÉ"])
+    low = strings.lower(st, use_utf8_encoding=False)
+    assert low[0] == "abÉ"  # non-ascii untouched in ascii mode
+    assert strings.upper(st, use_utf8_encoding=False)[0] == "ABÉ"
+
+
+def test_equality_elementwise():
+    a = strings.to_string_tensor(["x", "y"])
+    b = strings.to_string_tensor(["x", "z"])
+    np.testing.assert_array_equal(a == b, [True, False])
