@@ -114,3 +114,67 @@ def clear_cache():
 def cache_info():
     """{(name, signature): (winning_config, seconds)} snapshot."""
     return dict(_CACHE)
+
+
+# ---------------------------------------------------------------------------
+# persistent schedule cache (the CINN auto_schedule analogue: searched
+# kernel configs survive the process, since every TPU compile is seconds)
+# ---------------------------------------------------------------------------
+
+def _persist_path():
+    import os
+    return os.environ.get(
+        "PTPU_AUTOTUNE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "autotune.json"))
+
+
+_PERSIST_MEMO: Dict[Tuple[str, str], object] = {}
+
+
+def persistent_get(key: str):
+    import json
+    path = _persist_path()
+    memo_key = (path, key)
+    if memo_key in _PERSIST_MEMO:
+        return _PERSIST_MEMO[memo_key]
+    try:
+        with open(path) as f:
+            value = json.load(f).get(key)
+    except (OSError, ValueError):
+        value = None
+    # memoize (including misses): best_blocks consults this on every
+    # eager attention call — disk I/O must not be on the hot path
+    _PERSIST_MEMO[memo_key] = value
+    return value
+
+
+def persistent_put(key: str, value):
+    import json
+    import os
+    import tempfile
+    path = _persist_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    # re-read immediately before replace + unique temp name: concurrent
+    # tuners (multi-host, parallel tests) each merge the freshest snapshot
+    # and never share a torn temp file; last writer wins per whole file
+    data = {}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        pass
+    data[key] = value
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                               prefix=".autotune-", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _PERSIST_MEMO[(path, key)] = value

@@ -443,3 +443,40 @@ def test_quantized_matmul_differentiable_x():
     g = jax.grad(lambda a: jnp.sum(qmm.quantized_matmul(a, qw, scales)))(x)
     ref = np.sum(np.asarray(qw, np.float32) * 0.01, axis=1)
     np.testing.assert_allclose(np.asarray(g)[0], ref, atol=1e-4, rtol=1e-4)
+
+
+def test_flash_block_schedule_search_and_persistence(tmp_path, monkeypatch):
+    # the CINN-auto_schedule analogue: enumerate feasible block configs,
+    # time them (interpret mode on CPU — mechanics, not speed), persist
+    # the winner, and have flash_attention pick it up at trace time
+    import os
+    monkeypatch.setenv("PTPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "autotune.json"))
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    cands = fa._block_candidates(512, 512)
+    assert (128, 128) in cands and (512, 512) in cands
+    assert all(512 % bq == 0 and 512 % bk == 0 for bq, bk in cands)
+
+    best, secs = fa.tune_flash_blocks(1, 256, 2, 64, iters=1)
+    assert best in fa._block_candidates(256, 256)
+    assert os.path.exists(tmp_path / "autotune.json")
+    # trace-time lookup returns the persisted winner
+    assert fa.best_blocks(256, 256, 64, "bfloat16", True) == best
+    # unrelated shapes fall back to defaults
+    assert fa.best_blocks(1024, 1024, 64, "bfloat16", True) == (512, 512)
+
+
+def test_default_blocks_divide_any_gate_legal_seq():
+    # seq 640/768/1920 pass the gate (s % 128 == 0) but are not multiples
+    # of 512 — default block choice must still divide them
+    from paddle_tpu.ops.pallas import flash_attention as fa2
+    for s in (640, 768, 896, 1920, 2048, 256, 128):
+        bq, bk = fa2.best_blocks(s, s, 64, "float32", True)
+        assert s % bq == 0 and s % bk == 0, (s, bq, bk)
+    # and the kernel actually runs at such a shape (interpret mode)
+    import numpy as np
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, 640, 2, 64)), jnp.float32)
+    out = fa2.flash_attention(q, q, q, causal=True)
+    assert out.shape == (1, 640, 2, 64)
