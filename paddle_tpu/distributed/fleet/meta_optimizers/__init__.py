@@ -1,6 +1,7 @@
 from .hybrid_parallel_optimizer import HybridParallelOptimizer
 from .dygraph_sharding_optimizer import DygraphShardingOptimizer
 from .localsgd_optimizer import LocalSGDOptimizer
+from .dgc_optimizer import DGCMomentumOptimizer
 
 __all__ = ["HybridParallelOptimizer", "DygraphShardingOptimizer",
-           "LocalSGDOptimizer"]
+           "LocalSGDOptimizer", "DGCMomentumOptimizer"]

@@ -23,9 +23,10 @@ from ...core.tensor import Tensor
 from ...nn import Layer
 
 __all__ = ["PSServer", "PSClient", "ShardedPSClient",
-           "SparseEmbedding", "DensePSParameter", "AsyncCommunicator"]
+           "SparseEmbedding", "DensePSParameter", "AsyncCommunicator",
+           "GeoCommunicator"]
 
-from .communicator import AsyncCommunicator  # noqa: E402
+from .communicator import AsyncCommunicator, GeoCommunicator  # noqa: E402
 
 
 class PSServer:

@@ -143,3 +143,65 @@ class AsyncCommunicator:
                     self._pending -= len(batch)
                     if self._pending <= 0:
                         self._cv.notify_all()
+
+
+class GeoCommunicator:
+    """Geo-SGD trainer mode (reference ``ps/communicator/communicator.h``
+    GeoCommunicator + ``fleet/meta_optimizers`` a_sync with k_steps>0):
+    workers train LOCALLY for ``push_every`` steps, then exchange only the
+    parameter DELTA since the last sync — the server accumulates deltas
+    from every worker (its table is the shared model), and the worker
+    rebases onto the fresh server state.  Staleness-tolerant, one
+    round-trip per k steps instead of per step.
+    """
+
+    def __init__(self, client, parameters, base_table_id: int = 1000,
+                 push_every: int = 10):
+        import jax.numpy as jnp
+        import numpy as np
+        self._client = client
+        self._params = list(parameters)
+        self._push_every = max(1, int(push_every))
+        self._tables = {}
+        self._snapshots = {}
+        self._count = 0
+        for i, p in enumerate(self._params):
+            tid = base_table_id + i
+            vals = np.asarray(p._value, np.float32).reshape(-1)
+            # create is idempotent server-side (existing same-dim tables
+            # keep their values); a late-joining worker ADOPTS the server
+            # state instead of wiping accumulated training progress
+            client.create_dense_table(tid, vals.size)
+            server_vals = client.pull_dense(tid)
+            if not np.any(server_vals):
+                client.set_dense(tid, vals)  # fresh table: seed with init
+            else:
+                p._value = jnp.asarray(
+                    server_vals.reshape(p._value.shape), p._value.dtype)
+            self._tables[id(p)] = tid
+            # snapshot what the param ACTUALLY stores post-cast, so low
+            # precision params don't push rounding noise as deltas
+            self._snapshots[id(p)] = np.asarray(
+                p._value, np.float32).reshape(-1).copy()
+
+    def step(self):
+        """Call once per optimizer step; syncs every push_every calls."""
+        self._count += 1
+        if self._count % self._push_every == 0:
+            self.sync()
+
+    def sync(self):
+        import jax.numpy as jnp
+        import numpy as np
+        for p in self._params:
+            tid = self._tables[id(p)]
+            local = np.asarray(p._value, np.float32).reshape(-1)
+            delta = local - self._snapshots[id(p)]
+            # server computes w -= lr * grad; lr=1, grad=-delta -> w += delta
+            self._client.push_dense_grad(tid, -delta, lr=1.0)
+            fresh = self._client.pull_dense(tid)
+            p._value = jnp.asarray(
+                fresh.reshape(p._value.shape), p._value.dtype)
+            # snapshot the post-cast value (see __init__)
+            self._snapshots[id(p)] = np.asarray(
+                p._value, np.float32).reshape(-1).copy()

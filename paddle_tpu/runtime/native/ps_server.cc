@@ -236,11 +236,13 @@ void ps_handle_conn(PSServer* s, int fd) {
         !ps_recv_all(fd, &dim, 8) || !ps_recv_all(fd, &lr, 8))
       break;
     switch (op) {
-      case 1: {  // CREATE_DENSE
+      case 1: {  // CREATE_DENSE (idempotent: re-creating an existing
+                 // same-dim table keeps its values — a late-joining
+                 // worker must not wipe trained state)
         std::lock_guard<std::mutex> l(s->tables_mu);
         auto& t = s->dense[table];
         if (!t) t = std::make_unique<DenseTable>();
-        t->data.assign((size_t)dim, 0.f);
+        if (t->data.size() != dim) t->data.assign((size_t)dim, 0.f);
         ps_reply_status(fd, 0);
         break;
       }

@@ -112,6 +112,26 @@ def check_grad_dtype(op_fn, inputs, dtype="bfloat16", grad_input_idx=0,
                                err_msg=f"{dtype} grad diverges from fp32")
 
 
+def check_inplace(op_fn, inplace_fn, inputs, atol=1e-6, rtol=1e-6):
+    """Inplace-variant check (reference OpTest check_inplace_output_with_
+    place): the x_() form must produce the out-of-place result AND mutate
+    the receiver object in place (on TPU: the Tensor facade rebinds its
+    buffer; object identity and visible value must both hold)."""
+    t_out = [paddle.to_tensor(np.asarray(a)) for a in inputs]
+    expected = op_fn(*t_out)
+
+    t_in = [paddle.to_tensor(np.asarray(a)) for a in inputs]
+    receiver = t_in[0]
+    ret = inplace_fn(*t_in)
+    np.testing.assert_allclose(_to_np(receiver), _to_np(expected),
+                               atol=atol, rtol=rtol,
+                               err_msg="inplace mutated value mismatch")
+    if ret is not None:
+        assert ret is receiver, \
+            "inplace op must return the receiver object"
+    return receiver
+
+
 def check_grad(op_fn, inputs, grad_input_idx=0, eps=1e-3, atol=1e-2,
                rtol=1e-2, reduce_to_scalar=True):
     """Tape gradient vs numeric central difference."""

@@ -227,3 +227,18 @@ def test_bf16_grads_track_fp32():
     check_grad_dtype(paddle.tanh, [a], dtype="bfloat16")
     check_grad_dtype(paddle.matmul, [a, m], dtype="bfloat16",
                      grad_input_idx=0)
+
+
+def test_inplace_op_variants():
+    from op_test import check_inplace
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((3, 4)).astype(np.float32)
+    b = rng.standard_normal((3, 4)).astype(np.float32)
+
+    check_inplace(lambda x, y: x + y, lambda x, y: x.add_(y), [a, b])
+    check_inplace(lambda x: x * 2.5, lambda x: x.scale_(2.5), [a])
+    check_inplace(lambda x: paddle.clip(x, -0.5, 0.5),
+                  lambda x: x.clip_(-0.5, 0.5), [a])
+    check_inplace(lambda x, y: x - y, lambda x, y: x.subtract_(y), [a, b])
+    check_inplace(lambda x: paddle.zeros_like(x),
+                  lambda x: x.zero_(), [a])

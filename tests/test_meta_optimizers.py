@@ -170,3 +170,80 @@ def test_localsgd_param_average_math(monkeypatch):
         pd.all_reduce = real
     # (w*2)/2 == w
     np.testing.assert_allclose(np.asarray(lin.weight._value), w0, rtol=1e-6)
+
+
+def test_dgc_sparsity_and_momentum_correction():
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        DGCMomentumOptimizer)
+
+    paddle.seed(5)
+    lin = nn.Linear(10, 10, bias_attr=False)  # 100 entries
+    opt = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                               sparsity=[0.9],
+                               parameters=lin.parameters())
+    w0 = np.asarray(lin.weight._value).copy()
+    lin(paddle.ones([2, 10])).sum().backward()
+    opt.step()
+    w1 = np.asarray(lin.weight._value)
+    changed = (np.abs(w1 - w0) > 1e-12).sum()
+    # 90% sparsity on 100 entries -> ~10 updated
+    assert changed <= 12, changed
+
+    # residual accumulation: entries not sent keep accumulating and are
+    # eventually exchanged — after enough steps every entry moved
+    for _ in range(30):
+        opt.clear_grad()
+        lin(paddle.ones([2, 10])).sum().backward()
+        opt.step()
+    wN = np.asarray(lin.weight._value)
+    assert (np.abs(wN - w0) > 1e-9).all()
+
+
+def test_dgc_rampup_schedule_and_dense_warmup():
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        DGCMomentumOptimizer)
+
+    lin = nn.Linear(4, 4, bias_attr=False)
+    opt = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.0,
+                               rampup_begin_step=2, rampup_step=1,
+                               sparsity=[0.5, 0.75],
+                               parameters=lin.parameters())
+    assert opt.current_sparsity() == 0.0   # dense warmup
+    w0 = np.asarray(lin.weight._value).copy()
+    lin(paddle.ones([1, 4])).sum().backward()
+    opt.step()
+    # dense step: every entry moved
+    w1 = np.asarray(lin.weight._value)
+    assert (np.abs(w1 - w0) > 1e-12).all()  # every entry moved (dense)
+    assert opt.current_sparsity() == 0.0
+    opt._step_count = 2
+    assert opt.current_sparsity() == 0.5
+    opt._step_count = 3
+    assert opt.current_sparsity() == 0.75
+    opt._step_count = 99
+    assert opt.current_sparsity() == 0.75
+
+
+def test_dgc_converges_on_regression():
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        DGCMomentumOptimizer)
+
+    paddle.seed(6)
+    rng = np.random.default_rng(1)
+    xv = rng.standard_normal((32, 8)).astype(np.float32)
+    wtrue = rng.standard_normal((8, 1)).astype(np.float32)
+    yv = xv @ wtrue
+    x = paddle.to_tensor(xv)
+    y = paddle.to_tensor(yv)
+    lin = nn.Linear(8, 1, bias_attr=False)
+    opt = DGCMomentumOptimizer(learning_rate=0.05, momentum=0.9,
+                               sparsity=[0.75],
+                               parameters=lin.parameters())
+    first = float(((lin(x) - y) ** 2).mean())
+    for _ in range(60):
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    last = float(((lin(x) - y) ** 2).mean())
+    assert last < first * 0.1, (first, last)

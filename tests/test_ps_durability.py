@@ -136,3 +136,58 @@ def test_reconfiguring_nonempty_table_rejected(ps):
                                    sgd_rule="adagrad")
     # same-config re-create is fine (idempotent worker startup)
     client.create_sparse_table(10, 4, init_scale=0.0)
+
+
+def test_geo_communicator_delta_sync(ps):
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.ps import GeoCommunicator
+
+    _, client = ps
+    paddle.seed(0)
+    lin = nn.Linear(4, 4, bias_attr=False)
+    geo = GeoCommunicator(client, lin.parameters(), base_table_id=500,
+                          push_every=2)
+    w0 = np.asarray(lin.weight._value).copy()
+
+    # local training between syncs
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    lin(paddle.ones([2, 4])).sum().backward()
+    opt.step(); opt.clear_grad()
+    geo.step()          # count 1: no sync yet
+    server_w = client.pull_dense(500).reshape(4, 4)
+    np.testing.assert_allclose(server_w, w0, rtol=1e-6)  # still the init
+
+    lin(paddle.ones([2, 4])).sum().backward()
+    opt.step(); opt.clear_grad()
+    geo.step()          # count 2: delta pushed, fresh pulled
+    server_w = client.pull_dense(500).reshape(4, 4)
+    np.testing.assert_allclose(server_w, np.asarray(lin.weight._value),
+                               rtol=1e-6)
+
+
+def test_geo_communicator_two_workers_accumulate(ps):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.ps import GeoCommunicator
+
+    _, client = ps
+    paddle.seed(1)
+    a = nn.Linear(2, 2, bias_attr=False)
+    b = nn.Linear(2, 2, bias_attr=False)
+    b.weight._value = a.weight._value  # same init (like same-seed workers)
+    ga = GeoCommunicator(client, a.parameters(), base_table_id=600,
+                         push_every=1)
+    gb = GeoCommunicator(client, b.parameters(), base_table_id=600,
+                         push_every=1)
+    w0 = np.asarray(a.weight._value).copy()
+
+    import jax.numpy as jnp
+    a.weight._value = a.weight._value + 1.0   # worker A's local progress
+    ga.step()                                  # pushes +1
+    b.weight._value = b.weight._value + 2.0   # worker B's local progress
+    gb.step()                                  # pushes +2 and pulls A's too
+    np.testing.assert_allclose(np.asarray(b.weight._value), w0 + 3.0,
+                               rtol=1e-6)     # both deltas accumulated
