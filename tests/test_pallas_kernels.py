@@ -480,3 +480,30 @@ def test_default_blocks_divide_any_gate_legal_seq():
     q = jnp.asarray(rng.standard_normal((1, 640, 2, 64)), jnp.float32)
     out = fa2.flash_attention(q, q, q, causal=True)
     assert out.shape == (1, 640, 2, 64)
+
+
+def test_flash_gqa_native_gradients_match_repeat_reference():
+    # native GQA (kv index maps + revisit-accumulated dk/dv) must equal
+    # the repeat-then-dense formulation for forward AND all gradients
+    rng = np.random.default_rng(21)
+    b, s, hq, hk, d = 2, 256, 4, 2, 64
+    q = jnp.asarray(rng.standard_normal((b, s, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, hk, d)), jnp.float32)
+
+    def loss_native(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        kr = jnp.repeat(k, hq // hk, axis=2)
+        vr = jnp.repeat(v, hq // hk, axis=2)
+        return jnp.sum(_ref_attention(q, kr, vr, True) ** 2)
+
+    np.testing.assert_allclose(float(loss_native(q, k, v)),
+                               float(loss_ref(q, k, v)), rtol=1e-5)
+    gn = jax.grad(loss_native, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gn, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-4, rtol=2e-3,
+                                   err_msg=f"d{name} mismatch (native GQA)")
