@@ -165,24 +165,30 @@ class GeoCommunicator:
         self._tables = {}
         self._snapshots = {}
         self._count = 0
+        # explicit initialized-marker table: an all-zero trained table must
+        # not be mistaken for a fresh one (create is idempotent server-side)
+        self._marker_tid = base_table_id + 999983
+        client.create_dense_table(self._marker_tid, 1)
+        fresh = not np.any(client.pull_dense(self._marker_tid))
         for i, p in enumerate(self._params):
             tid = base_table_id + i
             vals = np.asarray(p._value, np.float32).reshape(-1)
-            # create is idempotent server-side (existing same-dim tables
-            # keep their values); a late-joining worker ADOPTS the server
-            # state instead of wiping accumulated training progress
             client.create_dense_table(tid, vals.size)
-            server_vals = client.pull_dense(tid)
-            if not np.any(server_vals):
-                client.set_dense(tid, vals)  # fresh table: seed with init
+            if fresh:
+                client.set_dense(tid, vals)  # first worker seeds the init
             else:
+                # late-joining worker ADOPTS accumulated server state
                 p._value = jnp.asarray(
-                    server_vals.reshape(p._value.shape), p._value.dtype)
+                    client.pull_dense(tid).reshape(p._value.shape),
+                    p._value.dtype)
             self._tables[id(p)] = tid
             # snapshot what the param ACTUALLY stores post-cast, so low
             # precision params don't push rounding noise as deltas
             self._snapshots[id(p)] = np.asarray(
                 p._value, np.float32).reshape(-1).copy()
+        if fresh:
+            client.set_dense(self._marker_tid,
+                             np.ones(1, np.float32))
 
     def step(self):
         """Call once per optimizer step; syncs every push_every calls."""
