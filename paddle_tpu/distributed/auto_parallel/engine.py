@@ -18,6 +18,26 @@ import numpy as np
 from ...core.tensor import Tensor
 
 
+def _spec_is_valid(spec, shape, mesh):
+    """A propagated spec is usable only if no mesh axis is reused across
+    dims, every named axis exists on the mesh, and every sharded dim is
+    divisible by the product of its axis sizes."""
+    seen = set()
+    for dim, axes in zip(shape, spec):
+        if axes is None:
+            continue
+        axes = axes if isinstance(axes, (tuple, list)) else (axes,)
+        size = 1
+        for ax in axes:
+            if ax in seen or ax not in mesh.shape:
+                return False
+            seen.add(ax)
+            size *= mesh.shape[ax]
+        if size == 0 or dim % size != 0:
+            return False
+    return True
+
+
 class Strategy:
     """Analogue of auto_parallel Strategy (subset of switches)."""
 
@@ -123,14 +143,19 @@ class Engine:
         for p, s in zip(params, specs):
             if s is None or p._dist_attr is not None:
                 continue
-            if any(e is not None for e in s):
+            if not any(e is not None for e in s):
+                continue
+            if not _spec_is_valid(s, p.shape, mesh):
+                continue
+            if isinstance(p._value, jax.core.Tracer):
                 p._dist_attr = tuple(s)
-                if not isinstance(p._value, jax.core.Tracer):
-                    try:
-                        p._value = jax.device_put(
-                            p._value, NamedSharding(mesh, PartitionSpec(*s)))
-                    except Exception:
-                        pass
+                continue
+            try:
+                p._value = jax.device_put(
+                    p._value, NamedSharding(mesh, PartitionSpec(*s)))
+            except Exception:
+                continue  # rejected placement must not leave a stale attr
+            p._dist_attr = tuple(s)
         self._completed = True
 
     def _ensure_step(self):

@@ -176,6 +176,8 @@ def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    if _not_in_group(group):
+        return _Task()
     ax_name = _axis_of(group)
     if ax_name is not None and _in_shard_map(ax_name):
         out = dispatch(
@@ -202,6 +204,8 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
 
 
 def all_gather_object(object_list, obj, group=None):
+    if _not_in_group(group):
+        return
     if _world_size(group) == 1:
         object_list.append(obj)
         return
@@ -214,6 +218,8 @@ def all_gather_object(object_list, obj, group=None):
 
 def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
+    if _not_in_group(group):
+        return _Task()
     ax_name = _axis_of(group)
     src = tensor_or_tensor_list
     if isinstance(src, (list, tuple)):
@@ -235,6 +241,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
 
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    if _not_in_group(group):
+        return _Task()
     ax_name = _axis_of(group)
     from ..tensor.manipulation import concat, split
     n = _world_size(group)
@@ -256,6 +264,8 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
+    if _not_in_group(group):
+        return _Task()
     ax_name = _axis_of(group)
     if ax_name is not None and _in_shard_map(ax_name):
         out = dispatch(
@@ -292,6 +302,8 @@ def broadcast(tensor, src, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if _not_in_group(group):
+        return _Task()
     if _world_size(group) == 1:
         if tensor_list:
             tensor._in_place_update(tensor_list[0])
@@ -308,6 +320,8 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
+    if _not_in_group(group):
+        return _Task()
     ax_name = _axis_of(group)
     if ax_name is not None and _in_shard_map(ax_name):
         raise RuntimeError(
@@ -325,6 +339,8 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    if _not_in_group(group):
+        return _Task()
     if _world_size(group) == 1:
         return _Task()
     plane = _eager_plane(group)

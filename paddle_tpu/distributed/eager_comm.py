@@ -168,8 +168,19 @@ def init_eager_comm(store=None, rank=None, world=None):
             int(os.environ.get("MASTER_PORT", "8787")) + 17))
         if rank == 0:
             server = TCPStoreServer(port)
+            if server.port != port:
+                # Non-zero ranks dial the env-derived port; silently binding
+                # elsewhere would strand them. Fail fast on rank 0 instead.
+                bound = server.port
+                try:
+                    server.stop()
+                except Exception:
+                    pass
+                raise RuntimeError(
+                    f"eager-comm store port {port} is busy (server bound "
+                    f"{bound}); set PADDLE_EAGER_STORE_PORT to a free "
+                    "port on every rank")
             _comm_server_keepalive.append(server)
-            port = server.port
         client = TCPStore(addr, port)
         _comm = EagerComm(client, rank, world)
         return _comm

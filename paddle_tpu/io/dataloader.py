@@ -4,16 +4,20 @@ Host pipeline, two worker modes mirroring the reference's
 ``_DataLoaderIterSingleProcess`` / ``_DataLoaderIterMultiProcess``
 (``dataloader_iter.py:358``):
 
-- ``num_workers>0`` (default): forked WORKER PROCESSES with per-worker
-  index queues and a shared result queue — decode-heavy, GIL-bound
-  ``__getitem__`` pipelines scale across cores.  Order is restored with a
-  reorder buffer; worker crashes are detected by exit-code polling instead
-  of hanging.  Workers are forked (like the reference/torch on POSIX) so
-  datasets need no pickling; children must not touch jax/device state —
-  fetch+collate stay numpy-only, and jax's fork warning is expected.
-- ``use_process_workers=False``: worker threads running the fetch through
-  the native C++ WorkQueue/BlockingQueue pair — right when the transform
-  is numpy-bound (GIL released) and fork cost matters.
+- process mode: forked WORKER PROCESSES with per-worker index queues and
+  a shared result queue — decode-heavy, GIL-bound ``__getitem__``
+  pipelines scale across cores.  Order is restored with a reorder buffer;
+  worker crashes are detected by exit-code polling instead of hanging.
+  Workers are forked (like the reference/torch on POSIX) so datasets need
+  no pickling; children must not touch jax/device state — fetch+collate
+  stay numpy-only.  Because forking after the TPU runtime is live is
+  unsafe, this mode auto-enables only while no non-CPU JAX backend has
+  been initialized (``use_process_workers=None`` default); pass ``True``
+  to request it explicitly (falls back to threads with a warning when
+  unsafe) or ``False`` to force threads.
+- thread mode: worker threads running the fetch through the native C++
+  WorkQueue/BlockingQueue pair — right when the transform is numpy-bound
+  (GIL released) and fork cost matters, and always safe.
 
 The iterator converts numpy batches to device Tensors on the consumer
 side in both modes.
@@ -24,6 +28,7 @@ from __future__ import annotations
 import multiprocessing as mp
 import queue
 import threading
+import warnings
 from typing import Callable, Optional
 
 import jax.numpy as jnp
@@ -36,6 +41,19 @@ from .sampler import BatchSampler
 __all__ = ["DataLoader", "default_collate_fn", "get_worker_info"]
 
 _worker_info = threading.local()
+
+
+def _fork_is_safe():
+    """True while every JAX backend initialized in this process is the CPU
+    one — forking with libtpu/grpc threads live can deadlock the child."""
+    try:
+        from jax._src import xla_bridge
+        backends = getattr(xla_bridge, "_backends", None)
+        if backends is None:  # private API moved: assume unsafe
+            return False
+        return all(name == "cpu" for name in backends)
+    except Exception:
+        return False
 
 
 def get_worker_info():
@@ -99,7 +117,7 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False, use_process_workers=True):
+                 persistent_workers=False, use_process_workers=None):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
@@ -309,13 +327,33 @@ class DataLoader:
             result_q.cancel_join_thread()
             result_q.close()
 
+    def _resolve_process_workers(self):
+        """Forking a process whose TPU runtime (libtpu/grpc threads) is live
+        can deadlock or crash the child, so process workers are only used
+        when every initialized JAX backend is the CPU one. use_process_workers
+        None=auto, True=requested (falls back with a warning when unsafe),
+        False=threads."""
+        if self.use_process_workers is False:
+            return False
+        safe = _fork_is_safe()
+        if self.use_process_workers and not safe:
+            fallback = ("sequential in-process iteration" if self._iterable
+                        else "native thread workers")
+            warnings.warn(
+                "DataLoader(use_process_workers=True) but a non-CPU JAX "
+                "backend is already initialized in this process; forking now "
+                f"is unsafe — falling back to {fallback}.",
+                RuntimeWarning)
+        return safe
+
     def __iter__(self):
+        use_proc = self.num_workers > 0 and self._resolve_process_workers()
         if self._iterable:
-            if self.num_workers > 0 and self.use_process_workers:
+            if use_proc:
                 return self._iter_iterable_multiprocess()
             return self._iter_iterable()
         if self.num_workers > 0:
-            if self.use_process_workers:
+            if use_proc:
                 return self._iter_multiprocess()
             return self._iter_workers()
         return self._iter_sync()

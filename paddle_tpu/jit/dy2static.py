@@ -137,15 +137,24 @@ def assert_not_traced(pred, construct):
     return pred
 
 
-def range_final(i_after, start, step):
+def range_final(i_after, start, stop, step):
     """Post-loop fixup for converted ``for i in range()``: the while form
     leaves i at the first FAILING value; Python leaves it at the last
-    YIELDED value (and unbound when the range was empty)."""
-    if _is_tracer(i_after) or _is_tracer(start) or _is_tracer(step):
-        return i_after - step  # traced zero-trip + post-loop read is UB
-    if _unwrap(i_after) == _unwrap(start):
-        return UNDEFINED  # zero iterations: Python leaves i unbound
-    return i_after - step
+    YIELDED value (and unbound when the range was empty).  When the bounds
+    are concrete the trip count is statically known even if the body traced,
+    so exact Python semantics apply; with traced bounds a zero-trip loop
+    yields ``start`` (documented deviation — "unbound" has no traced
+    representation) instead of the out-of-range ``start - step``."""
+    if not (_is_tracer(start) or _is_tracer(stop) or _is_tracer(step)):
+        trip = len(range(int(_unwrap(start)), int(_unwrap(stop)),
+                         int(_unwrap(step))))
+        if trip == 0:
+            return UNDEFINED  # zero iterations: Python leaves i unbound
+        return i_after - step
+    iv = jnp.asarray(_unwrap(i_after))
+    sv = jnp.asarray(_unwrap(start))
+    out = jnp.where(iv == sv, sv, iv - jnp.asarray(_unwrap(step)))
+    return Tensor(out) if isinstance(i_after, Tensor) else out
 
 
 def range_cond(i, stop, step):
@@ -493,7 +502,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             targets=[_name_store(ivar)],
             value=ast.Call(func=_jst_attr("range_final"),
                            args=[_name_load(ivar), _name_load(start_v),
-                                 _name_load(step_v)],
+                                 _name_load(stop_v), _name_load(step_v)],
                            keywords=[]))
         return pre + \
             _guard_defined(set(loop_vars) - {ivar, start_v, stop_v, step_v}) \
