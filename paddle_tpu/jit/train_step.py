@@ -30,17 +30,18 @@ _UNSET = object()
 
 
 def _functional_sgd(p, g, state, lr, hp):
-    return p - lr * g.astype(p.dtype), state
+    # fp32 lr must not promote a bf16 param: cast the delta, not the result
+    return p - (lr * g).astype(p.dtype), state
 
 
 def _functional_momentum(p, g, state, lr, hp):
     v = state["velocity"]
-    g = g.astype(p.dtype)
+    g = g.astype(v.dtype)
     v_new = hp["momentum"] * v + g
     if hp["nesterov"]:
-        p_new = p - lr * (g + hp["momentum"] * v_new)
+        p_new = p - (lr * (g + hp["momentum"] * v_new)).astype(p.dtype)
     else:
-        p_new = p - lr * v_new
+        p_new = p - (lr * v_new).astype(p.dtype)
     return p_new, {"velocity": v_new}
 
 
@@ -301,6 +302,7 @@ class TrainStep:
                     loss, aux, new_b)
 
         jit_kwargs = dict(donate_argnums=(0, 1, 2))
+        self._step_fn = compiled
         self._compiled = jax.jit(compiled, **jit_kwargs)
 
     def _batch_sharding(self):
@@ -346,6 +348,54 @@ class TrainStep:
                                     self._opt_state_sharding(p))
                         for p in self._params],
                 "count": jnp.zeros((), jnp.int32)}
+
+    def run_steps(self, *inputs, steps: int):
+        """Run ``steps`` consecutive train steps on the SAME batch inside
+        ONE compiled call (``lax.scan`` over the step body, fresh RNG key
+        per iteration, constant lr).  Amortizes per-dispatch host latency —
+        benchmarking/microbenchmark use; real epochs feed fresh batches
+        through ``__call__``.  Returns the last step's loss."""
+        if self._state is None:
+            self._state = self._init_state()
+            self._gm_state = self._init_gm_state()
+            self._build()
+        if not hasattr(self, "_multi_cache"):
+            self._multi_cache = {}
+        fn = self._multi_cache.get(steps)
+        if fn is None:
+            step_fn = self._step_fn
+
+            def multi(p_values, opt_state, gm_state, key, lr, b_values,
+                      *inp):
+                def body(carry, i):
+                    p, s, gm, b, k = carry
+                    k = jax.random.fold_in(k, i)
+                    new_p, new_s, new_gm, loss, _aux, new_b = step_fn(
+                        p, s, gm, k, lr, b, *inp)
+                    return (list(new_p), list(new_s), new_gm,
+                            list(new_b), k), loss
+
+                carry0 = (list(p_values), list(opt_state), gm_state,
+                          list(b_values), key)
+                (p, s, gm, b, _k), losses = jax.lax.scan(
+                    body, carry0, jnp.arange(steps))
+                return p, s, gm, losses[-1], b
+
+            fn = jax.jit(multi, donate_argnums=(0, 1, 2))
+            self._multi_cache[steps] = fn
+        arrays = [self._shard_batch(i) for i in inputs]
+        key = _generator.default_generator().next_key()
+        lr = jnp.float32(self.optimizer.get_lr())
+        p_values = [p._value for p in self._params]
+        b_values = [b._value for b in self._buffers]
+        new_p, self._state, self._gm_state, loss, new_b = fn(
+            p_values, self._state, self._gm_state, key, lr, b_values,
+            *arrays)
+        for p, v in zip(self._params, new_p):
+            p._value = v
+        for b, v in zip(self._buffers, new_b):
+            b._value = v
+        return Tensor(loss)
 
     def __call__(self, *inputs):
         if self._state is None:

@@ -81,11 +81,28 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     use_batch_stats = training and not (use_global_stats is True)
 
     if use_batch_stats:
-        # compute batch stats eagerly so we can update the running buffers
+        # compute batch stats eagerly so we can update the running buffers.
+        # Shifted one-pass moments: sum(x) and sum((x-k)^2) reduce in ONE
+        # fused pass over the activation (mean-then-var needs two sequential
+        # passes — at conv activation sizes each pass is a full HBM sweep).
+        # k is one sample per channel, so the cancellation term
+        # (mean - k)^2 is O(var) and fp32 stays accurate even when
+        # mean >> std (plain E[x^2]-E[x]^2 catastrophically cancels there).
+        # k carries stop_gradient: dvar/dk == 0 analytically, so the grad is
+        # exact AND backward avoids a scatter into the sampled positions.
         def stats_impl(a):
+            n = a.size // a.shape[c_axis]
+            idx = tuple(slice(None) if i == c_axis else slice(0, 1)
+                        for i in range(a.ndim))
+            # slice the RAW input (a tiny [C] read) — slicing the converted
+            # fp32 array would make XLA materialize the whole fp32 copy
+            k = jax.lax.stop_gradient(a[idx]).astype(jnp.float32)
             af = a.astype(jnp.float32)
-            m = jnp.mean(af, axis=reduce_axes)
-            v = jnp.var(af, axis=reduce_axes)
+            s = jnp.sum(af, axis=reduce_axes)
+            ss = jnp.sum(jnp.square(af - k), axis=reduce_axes)
+            m = s / n
+            md = m - k.reshape(m.shape)
+            v = jnp.maximum(ss / n - md * md, 0.0)
             return m, v
 
         bmean, bvar = dispatch("batch_norm_stats", stats_impl, (x,))

@@ -279,3 +279,47 @@ def test_attention_dropout_applied_in_training():
                                                training=False)
     np.testing.assert_allclose(np.asarray(out_eval._value),
                                np.asarray(out_eval2._value))
+
+
+def test_batch_norm_stats_no_catastrophic_cancellation():
+    # shifted one-pass moments must stay accurate when mean >> std
+    # (plain E[x^2]-E[x]^2 collapses the variance to ~0 here)
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import functional as F
+    rng = np.random.default_rng(0)
+    xa = (rng.standard_normal((8, 5, 7, 7)) * 0.01 + 500).astype(np.float32)
+    m_ref = xa.mean(axis=(0, 2, 3))
+    v_ref = xa.var(axis=(0, 2, 3))
+    rm = paddle.to_tensor(np.zeros(5, np.float32))
+    rv = paddle.to_tensor(np.ones(5, np.float32))
+    F.batch_norm(paddle.to_tensor(xa), rm, rv, training=True, momentum=0.0)
+    np.testing.assert_allclose(np.asarray(rm._value), m_ref, rtol=1e-6)
+    # std/mean = 2e-5 here: a few % variance error is the fp32 limit of the
+    # shifted one-pass form; the unshifted form is ~100% wrong (clamps to 0)
+    np.testing.assert_allclose(np.asarray(rv._value), v_ref, rtol=5e-2)
+
+
+def test_batch_norm_training_grad_parity():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import functional as F
+    rng = np.random.default_rng(0)
+    W = jnp.asarray(rng.standard_normal((4, 5, 6, 6)).astype(np.float32))
+
+    def ours(xv):
+        rm = paddle.to_tensor(np.zeros(5, np.float32))
+        rv = paddle.to_tensor(np.ones(5, np.float32))
+        out = F.batch_norm(paddle.Tensor(xv), rm, rv, training=True)
+        return (out._value * W).sum()
+
+    def ref(xv):
+        m = xv.mean(axis=(0, 2, 3), keepdims=True)
+        v = ((xv - m) ** 2).mean(axis=(0, 2, 3), keepdims=True)
+        return (((xv - m) * jax.lax.rsqrt(v + 1e-5)) * W).sum()
+
+    xs = jnp.asarray(rng.standard_normal((4, 5, 6, 6)).astype(np.float32)
+                     * 2 + 3)
+    np.testing.assert_allclose(np.asarray(jax.grad(ours)(xs)),
+                               np.asarray(jax.grad(ref)(xs)),
+                               rtol=1e-3, atol=1e-5)
