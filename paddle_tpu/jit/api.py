@@ -245,15 +245,24 @@ def save(layer, path, input_spec=None, precision=None, **configs):
                 f"{precision!r}")
     specs = [s if isinstance(s, InputSpec) else InputSpec(s.shape, s.dtype)
              for s in input_spec]
+    from .dy2static import convert_to_static
     if isinstance(layer, Layer):
         layer.eval()
         params = [(k, v) for k, v in layer.state_dict().items()]
         fn = layer.forward
         if isinstance(fn, StaticFunction):
             fn = functools.partial(fn._function, layer)
+        else:
+            # convert Python control flow in forward like the reference
+            # jit.save does (its to_static program translation)
+            raw = getattr(fn, "__func__", None)
+            if raw is not None:
+                conv = convert_to_static(raw)
+                if conv is not raw:
+                    fn = functools.partial(conv, layer)
     else:
         params = []
-        fn = layer
+        fn = convert_to_static(layer) if callable(layer) else layer
 
     names = [k for k, _ in params]
     values = [v._value for _, v in params]

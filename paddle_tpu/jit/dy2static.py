@@ -13,10 +13,21 @@ heavy lifting is done at RUNTIME by :mod:`paddle_tpu.static.control_flow`:
   concrete predicate executes the chosen branch directly (exact eager
   semantics, side effects included), a traced predicate lowers to
   ``lax.cond`` / ``lax.while_loop`` via static/control_flow.py;
-- constructs the converter cannot express under tracing (break/continue,
-  one-sided early returns) are left as plain Python but their predicate is
-  wrapped in :func:`assert_not_traced`, which raises a clear error naming
-  the construct instead of jax's opaque TracerBoolConversionError.
+- ``break``/``continue`` lower to loop-carried guard booleans before
+  conversion (reference ``break_continue_transformer.py:88``): jumps become
+  flag assignments, trailing statements get ``if not flag`` guards, the
+  loop condition gains ``and not break_flag``, and ``for i in range()``
+  loops rewrite to an explicit iterator-variable while form so the loop
+  variable lands on the break iteration's value exactly like Python;
+- early returns restructure via else-absorption (reference
+  ``return_transformer.py:122``): an ``if`` whose branch tail-returns
+  absorbs the trailing statements into its other branch, so every path
+  tail-returns and the both-branches-return conversion applies;
+- the few constructs still inexpressible under tracing (``return`` inside
+  a traced loop, jumps inside try/with) are left as plain Python but their
+  predicate is wrapped in :func:`assert_not_traced`, which raises a clear
+  error naming the construct instead of jax's opaque
+  TracerBoolConversionError.
 
 This mirrors the reference's split between compile-time transformers and
 ``_jst`` runtime converters (``python/paddle/jit/dy2static/convert_call_func.py``).
@@ -82,19 +93,60 @@ def convert_ifelse(pred, true_fn, false_fn, in_values):
     return false_fn(*in_values)
 
 
+def _zero_like(probe):
+    """A zero-valued init matching a probe value's type (for loop carries
+    that are assigned before read every iteration)."""
+    if isinstance(probe, Tensor):
+        return Tensor(jnp.zeros_like(probe._value))
+    if isinstance(probe, bool):
+        return False
+    if isinstance(probe, (int, float)):
+        return type(probe)(0)
+    if probe is UNDEFINED or probe is None:
+        return probe
+    return jnp.zeros_like(jnp.asarray(_unwrap(probe)))
+
+
+def _traced_while(cond_fn, body_fn, loop_vars):
+    from ..static.control_flow import while_loop
+    if any(v is UNDEFINED for v in loop_vars):
+        # body-local temps (e.g. a nested loop's iterator/guard flags)
+        # are unbound at loop entry but assigned before read every
+        # iteration: probe one body evaluation for their types and
+        # start them at zero.  A genuine read-before-assign of the
+        # unbound local raises inside the probe, as it should.
+        # NOTE: the probe runs one extra (traced) body evaluation;
+        # functionalized bodies are pure, but a body that mutates
+        # closed-over Python state (e.g. list.append) sees one extra
+        # call — an accepted trace-time hazard, like jax re-tracing
+        probe = body_fn(*loop_vars)
+        loop_vars = tuple(
+            _zero_like(p) if v is UNDEFINED else v
+            for v, p in zip(loop_vars, probe))
+    out = while_loop(cond_fn, body_fn, list(loop_vars))
+    return tuple(out)
+
+
 def convert_while(cond_fn, body_fn, loop_vars):
     """while over possibly-traced condition; loop_vars is a tuple of the
-    locals carried across iterations.  Returns the final loop_vars."""
+    locals carried across iterations.  Returns the final loop_vars.
+
+    Tracedness follows the CONDITION: a concrete condition runs the loop
+    eagerly (which unrolls under an outer trace — traced loop vars flow
+    through fine, and python-only body ops like list indexing keep
+    working); the moment the condition becomes traced, the remaining
+    iterations lower to lax.while_loop from the current state."""
     first = cond_fn(*loop_vars)
-    if _is_tracer(first) or any(_is_tracer(v) for v in loop_vars):
-        from ..static.control_flow import while_loop
-        out = while_loop(cond_fn, body_fn, list(loop_vars))
-        return tuple(out)
+    if _is_tracer(first):
+        return _traced_while(cond_fn, body_fn, loop_vars)
     vars_ = tuple(loop_vars)
     cont = bool(_unwrap(first))
     while cont:
         vars_ = tuple(body_fn(*vars_))
-        cont = bool(_unwrap(cond_fn(*vars_)))
+        nxt = cond_fn(*vars_)
+        if _is_tracer(nxt):
+            return _traced_while(cond_fn, body_fn, vars_)
+        cont = bool(_unwrap(nxt))
     return vars_
 
 
@@ -124,6 +176,13 @@ def convert_logical_not(v):
     if _is_tracer(v):
         return Tensor(jnp.logical_not(jnp.asarray(_unwrap(v)).astype(bool)))
     return not bool(_unwrap(v))
+
+
+def concrete_true(v):
+    """True only for a CONCRETELY truthy value — traced values yield False
+    (used by lowered non-range for loops to execute a real ``break`` when
+    the guard flag is concrete)."""
+    return (not _is_tracer(v)) and bool(_unwrap(v))
 
 
 def assert_not_traced(pred, construct):
@@ -237,6 +296,24 @@ def _ends_with_return(body):
     return bool(body) and isinstance(body[-1], ast.Return)
 
 
+def _parse_range_for(node):
+    """(start, stop, step) AST nodes when ``node`` is ``for <Name> in
+    range(...)`` with 1-3 positional args, else None."""
+    if not (isinstance(node.iter, ast.Call)
+            and isinstance(node.iter.func, ast.Name)
+            and node.iter.func.id == "range"
+            and not node.iter.keywords
+            and 1 <= len(node.iter.args) <= 3
+            and isinstance(node.target, ast.Name)):
+        return None
+    args = node.iter.args
+    if len(args) == 1:
+        return ast.Constant(value=0), args[0], ast.Constant(value=1)
+    if len(args) == 2:
+        return args[0], args[1], ast.Constant(value=1)
+    return args[0], args[1], args[2]
+
+
 # ---------------------------------------------------------------------------
 # code-construction helpers
 # ---------------------------------------------------------------------------
@@ -281,6 +358,291 @@ def _tuple_store(names):
 
 def _return_tuple(names):
     return ast.Return(value=_tuple_load(names))
+
+
+# ---------------------------------------------------------------------------
+# pass 1: early-return restructuring (else-absorption)
+# ---------------------------------------------------------------------------
+
+def _all_paths_return(stmts):
+    """Deep tail check: every execution path through this block ends in a
+    Return (an If counts when both branches do)."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return bool(last.orelse) and _all_paths_return(last.body) \
+            and _all_paths_return(last.orelse)
+    return False
+
+
+def _restructure_returns(stmts):
+    """Rewrite a block so every Return sits in tail position: an If whose
+    branch tail-returns absorbs the trailing statements into the other
+    branch (reference return/early_return transformers).  Dead code after
+    a Return is dropped.  Does not descend into loops or nested defs —
+    loop-internal returns keep the assert_not_traced fallback."""
+    out = []
+    for i, s in enumerate(stmts):
+        rest = stmts[i + 1:]
+        if isinstance(s, ast.Return):
+            out.append(s)
+            return out  # rest is unreachable
+        if isinstance(s, ast.If) and _has_node([s], (ast.Return,)):
+            body = _restructure_returns(s.body)
+            orelse = _restructure_returns(s.orelse) if s.orelse else []
+            b_ret, o_ret = _all_paths_return(body), _all_paths_return(orelse)
+            if b_ret and o_ret:
+                out.append(ast.If(test=s.test, body=body, orelse=orelse))
+                return out  # rest unreachable
+            if b_ret and rest:
+                out.append(ast.If(
+                    test=s.test, body=body,
+                    orelse=_restructure_returns(orelse + rest)))
+                return out
+            if o_ret and rest:
+                out.append(ast.If(
+                    test=s.test, body=_restructure_returns(body + rest),
+                    orelse=orelse))
+                return out
+            out.append(ast.If(test=s.test, body=body, orelse=orelse))
+            continue
+        out.append(s)
+    return out
+
+
+def _lower_returns(func_def):
+    """Normalize ``func_def.body`` so all returns are tail-position.  Adds
+    an explicit ``return None`` for the implicit fall-through when the
+    function mixes returning and non-returning paths."""
+    body = func_def.body
+    if not _has_node(body, (ast.Return,)):
+        return
+    restructured = _restructure_returns(body)
+    if not _all_paths_return(restructured):
+        restructured = _restructure_returns(
+            restructured + [ast.Return(value=ast.Constant(value=None))])
+    func_def.body = restructured
+
+
+# ---------------------------------------------------------------------------
+# pass 2: break/continue lowering (guard-flag dataflow)
+# ---------------------------------------------------------------------------
+
+class _JumpLowering(ast.NodeTransformer):
+    """Rewrites loops containing break/continue (or an else clause) into
+    guard-flag form with no jump statements (reference
+    break_continue_transformer.py:88):
+
+    - ``break`` -> ``flag = True``; trailing statements of every enclosing
+      block up to the loop get an ``if not flag`` guard; the loop condition
+      gains ``and not flag``;
+    - ``continue`` -> same with a per-iteration flag reset at body top;
+    - ``for i in range(...)`` rewrites to an explicit iterator-variable
+      while loop (i assigned from the iterator at body top, so after a
+      break ``i`` holds the break iteration's value exactly like Python);
+    - non-range ``for`` keeps its header and guards the whole body with
+      ``if not break_flag`` (iterations after a break are no-ops);
+    - ``while``/``for`` ``else`` clauses run under ``if not break_flag``.
+
+    Loops whose jumps sit inside try/with, or that contain ``return``, are
+    left untouched (assert_not_traced fallback)."""
+
+    def __init__(self):
+        self.n = 0
+
+    def _fresh(self, kind):
+        self.n += 1
+        return f"__ptpu_low_{kind}_{self.n}"
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def _jumps_unlowerable(self, body):
+        """Jumps inside try/with (this loop's jumps only) can't be
+        guard-lowered."""
+        def scan(stmts, in_guarded):
+            for s in stmts:
+                if isinstance(s, (ast.Break, ast.Continue)) and in_guarded:
+                    return True
+                if isinstance(s, (ast.For, ast.While, *_SCOPE_BARRIERS)):
+                    continue
+                guarded = in_guarded or isinstance(s, (ast.Try, ast.With))
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(s, field, None)
+                    if sub and scan(sub, guarded):
+                        return True
+                for h in getattr(s, "handlers", []) or []:
+                    if scan(h.body, guarded):
+                        return True
+            return False
+        return scan(body, False)
+
+    def _lower_block(self, stmts, brk, cont):
+        out = []
+        for i, s in enumerate(stmts):
+            rest = stmts[i + 1:]
+            if isinstance(s, ast.Break):
+                out.append(ast.Assign(targets=[_name_store(brk)],
+                                      value=ast.Constant(value=True)))
+                return out  # rest unreachable
+            if isinstance(s, ast.Continue):
+                out.append(ast.Assign(targets=[_name_store(cont)],
+                                      value=ast.Constant(value=True)))
+                return out
+            if isinstance(s, ast.If) and _loop_controls_for_body([s]):
+                new_if = ast.If(
+                    test=s.test,
+                    body=self._lower_block(s.body, brk, cont) or [ast.Pass()],
+                    orelse=self._lower_block(s.orelse, brk, cont))
+                out.append(new_if)
+                if rest:
+                    flags = [_name_load(brk)]
+                    if cont is not None:
+                        flags.append(_name_load(cont))
+                    guard = ast.UnaryOp(
+                        op=ast.Not(),
+                        operand=(flags[0] if len(flags) == 1 else
+                                 ast.BoolOp(op=ast.Or(), values=flags)))
+                    out.append(ast.If(
+                        test=guard,
+                        body=self._lower_block(rest, brk, cont) or
+                        [ast.Pass()],
+                        orelse=[]))
+                return out
+            out.append(s)
+        return out
+
+    def _loop_prep(self, node):
+        """Common gating + flag allocation.  Returns None when the loop
+        must stay untouched."""
+        has_jumps = _loop_controls_for_body(node.body)
+        if not has_jumps and not node.orelse:
+            return None
+        if _has_node(node.body, (ast.Return,)) or \
+                self._jumps_unlowerable(node.body):
+            return None
+        brk = self._fresh("brk")
+        has_cont = self._has_continue(node.body)
+        cont = self._fresh("cont") if has_cont else None
+        return brk, cont
+
+    @staticmethod
+    def _has_continue(body):
+        def scan(stmts):
+            for s in stmts:
+                if isinstance(s, ast.Continue):
+                    return True
+                if isinstance(s, (ast.For, ast.While, *_SCOPE_BARRIERS)):
+                    continue
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(s, field, None)
+                    if sub and scan(sub):
+                        return True
+                for h in getattr(s, "handlers", []) or []:
+                    if scan(h.body):
+                        return True
+            return False
+        return scan(body)
+
+    def _finish(self, out, node, brk):
+        if node.orelse:
+            out.append(ast.If(
+                test=ast.UnaryOp(op=ast.Not(), operand=_name_load(brk)),
+                body=node.orelse, orelse=[]))
+        return out
+
+    def visit_While(self, node):
+        self.generic_visit(node)  # inner loops first
+        prep = self._loop_prep(node)
+        if prep is None:
+            return node
+        brk, cont = prep
+        body = ([ast.Assign(targets=[_name_store(cont)],
+                            value=ast.Constant(value=False))]
+                if cont else [])
+        body += self._lower_block(node.body, brk, cont) or [ast.Pass()]
+        # flag first: after a break the original condition must not be
+        # re-evaluated (it may crash or repeat side effects)
+        test = ast.BoolOp(op=ast.And(), values=[
+            ast.UnaryOp(op=ast.Not(), operand=_name_load(brk)),
+            node.test])
+        out = [ast.Assign(targets=[_name_store(f)],
+                          value=ast.Constant(value=False))
+               for f in ([brk] + ([cont] if cont else []))]
+        out.append(ast.While(test=test, body=body, orelse=[]))
+        return self._finish(out, node, brk)
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        prep = self._loop_prep(node)
+        if prep is None:
+            return node
+        brk, cont = prep
+        lowered = self._lower_block(node.body, brk, cont) or [ast.Pass()]
+        reset = ([ast.Assign(targets=[_name_store(cont)],
+                             value=ast.Constant(value=False))]
+                 if cont else [])
+        init_brk = [ast.Assign(targets=[_name_store(f)],
+                               value=ast.Constant(value=False))
+                    for f in ([brk] + ([cont] if cont else []))]
+
+        rng = _parse_range_for(node)
+        if rng is None:
+            # keep the iterator and guard the body; a REAL break fires when
+            # the flag is concretely True (stops consuming the iterator —
+            # critical for infinite/shared generators), while a traced flag
+            # leaves concrete_true False and the finite iterator unrolls
+            # with a no-op guarded body
+            body = reset + [
+                ast.If(test=ast.UnaryOp(op=ast.Not(),
+                                        operand=_name_load(brk)),
+                       body=lowered, orelse=[]),
+                ast.If(test=ast.Call(func=_jst_attr("concrete_true"),
+                                     args=[_name_load(brk)], keywords=[]),
+                       body=[ast.Break()], orelse=[]),
+            ]
+            out = init_brk + [
+                ast.For(target=node.target, iter=node.iter, body=body,
+                        orelse=[])]
+            return self._finish(out, node, brk)
+
+        start, stop, step = rng
+        ivar = node.target.id
+        itv, stopv, stepv = (self._fresh("it"), self._fresh("stop"),
+                             self._fresh("step"))
+        pre = [ast.Assign(targets=[_name_store(itv)], value=start),
+               ast.Assign(targets=[_name_store(stopv)], value=stop),
+               ast.Assign(targets=[_name_store(stepv)], value=step),
+               # pre-bind the loop var so traced zero-trip loops have a
+               # carried value (post-zero-trip reads see start — documented
+               # deviation from Python's unbound)
+               ast.Assign(targets=[_name_store(ivar)],
+                          value=_name_load(itv))] + init_brk
+        test = ast.BoolOp(op=ast.And(), values=[
+            ast.Call(func=_jst_attr("range_cond"),
+                     args=[_name_load(itv), _name_load(stopv),
+                           _name_load(stepv)], keywords=[]),
+            ast.UnaryOp(op=ast.Not(), operand=_name_load(brk))])
+        body = reset + [
+            ast.Assign(targets=[_name_store(ivar)], value=_name_load(itv)),
+            ast.Assign(targets=[_name_store(itv)],
+                       value=ast.BinOp(left=_name_load(itv), op=ast.Add(),
+                                       right=_name_load(stepv))),
+        ] + lowered
+        out = pre + [ast.While(test=test, body=body, orelse=[])]
+        return self._finish(out, node, brk)
 
 
 # ---------------------------------------------------------------------------
@@ -446,27 +808,14 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     # ---- for range() -------------------------------------------------
     def visit_For(self, node):
         self.generic_visit(node)
-        is_range = (isinstance(node.iter, ast.Call)
-                    and isinstance(node.iter.func, ast.Name)
-                    and node.iter.func.id == "range"
-                    and not node.iter.keywords
-                    and 1 <= len(node.iter.args) <= 3
-                    and isinstance(node.target, ast.Name))
+        rng = _parse_range_for(node)
         unsupported = (_has_node(node.body, (ast.Return,))
                        or _loop_controls_for_body(node.body)
                        or node.orelse)
-        if not is_range or unsupported:
+        if rng is None or unsupported:
             return node  # plain python iteration (unrolls under trace)
 
-        args = node.iter.args
-        if len(args) == 1:
-            start, stop, step = ast.Constant(value=0), args[0], \
-                ast.Constant(value=1)
-        elif len(args) == 2:
-            start, stop, step = args[0], args[1], ast.Constant(value=1)
-        else:
-            start, stop, step = args
-
+        start, stop, step = rng
         ivar = node.target.id
         start_v = self._uid("start")
         stop_v = self._uid("stop")
@@ -562,6 +911,12 @@ def convert_to_static(fn):
         cached = None  # non-weakref-able callables (builtins, partials)
     if cached is not None:
         return cached
+    code = getattr(fn, "__code__", None)
+    if code is not None and "__class__" in code.co_freevars:
+        # zero-arg super() needs the compiler-provided __class__ cell,
+        # which a module-level recompile cannot reproduce — leave the
+        # function unconverted rather than break it at call time
+        return fn
     try:
         source = textwrap.dedent(inspect.getsource(fn))
         tree = ast.parse(source)
@@ -575,6 +930,17 @@ def convert_to_static(fn):
         _cache_put(fn, fn)
         return fn
     func_def.decorator_list = []
+
+    # pass 1: early-return restructuring; pass 2: break/continue lowering.
+    # Both are pure AST->AST and must run before the control-flow
+    # transformer so it only ever sees jump-free loops and tail returns.
+    _lower_returns(func_def)
+    jl = _JumpLowering()
+    lowered_body = []
+    for s in func_def.body:
+        r = jl.visit(s)
+        lowered_body.extend(r if isinstance(r, list) else [r])
+    func_def.body = lowered_body
 
     arg_names = {a.arg for a in (func_def.args.posonlyargs +
                                  func_def.args.args +
