@@ -262,7 +262,18 @@ def save(layer, path, input_spec=None, precision=None, **configs):
                     fn = functools.partial(conv, layer)
     else:
         params = []
-        fn = convert_to_static(layer) if callable(layer) else layer
+        fn = layer
+        if callable(fn):
+            bound_self = getattr(fn, "__self__", None)
+            raw = getattr(fn, "__func__", None)
+            if bound_self is not None and raw is not None:
+                # bound method: convert the underlying function and rebind
+                # self, else traced inputs would shift into the self slot
+                conv = convert_to_static(raw)
+                if conv is not raw:
+                    fn = functools.partial(conv, bound_self)
+            else:
+                fn = convert_to_static(fn)
 
     names = [k for k, _ in params]
     values = [v._value for _, v in params]

@@ -190,8 +190,10 @@ def assert_not_traced(pred, construct):
     if _is_tracer(pred):
         raise NotImplementedError(
             f"to_static: {construct} cannot be converted to XLA control "
-            "flow. Restructure without break/continue/one-sided return, "
-            "or compute the predicate outside the traced function. "
+            "flow (break/continue and one-sided returns ARE converted; "
+            "the remaining unsupported forms are `return` inside a traced "
+            "loop and jumps inside try/with). Hoist the return out of the "
+            "loop or compute the predicate outside the traced function. "
             "(reference analogue: dy2static loop/return transformers)")
     return pred
 
@@ -272,24 +274,32 @@ def _has_node(nodes, kinds):
     return False
 
 
-def _loop_controls_for_body(body):
-    """break/continue belonging to THIS loop (not nested loops)."""
-    def scan(stmts):
+def _scan_loop_jumps(body, kinds, only_guarded=False):
+    """True when a statement of ``kinds`` belonging to THIS loop level
+    occurs in ``body`` (nested loops keep their own jumps; nested defs are
+    barriers).  ``only_guarded=True`` matches only occurrences inside
+    try/with — the forms the guard-flag lowering cannot express."""
+    def scan(stmts, guarded):
         for s in stmts:
-            if isinstance(s, (ast.Break, ast.Continue)):
+            if isinstance(s, kinds) and (guarded or not only_guarded):
                 return True
             if isinstance(s, (ast.For, ast.While, *_SCOPE_BARRIERS)):
                 continue
-            for field in ("body", "orelse", "finalbody", "handlers"):
+            g = guarded or isinstance(s, (ast.Try, ast.With))
+            for field in ("body", "orelse", "finalbody"):
                 sub = getattr(s, field, None)
-                if sub:
-                    if field == "handlers":
-                        if any(scan(h.body) for h in sub):
-                            return True
-                    elif scan(sub):
-                        return True
+                if sub and scan(sub, g):
+                    return True
+            for h in getattr(s, "handlers", []) or []:
+                if scan(h.body, g):
+                    return True
         return False
-    return scan(body)
+    return scan(body, False)
+
+
+def _loop_controls_for_body(body):
+    """break/continue belonging to THIS loop (not nested loops)."""
+    return _scan_loop_jumps(body, (ast.Break, ast.Continue))
 
 
 def _ends_with_return(body):
@@ -472,22 +482,8 @@ class _JumpLowering(ast.NodeTransformer):
     def _jumps_unlowerable(self, body):
         """Jumps inside try/with (this loop's jumps only) can't be
         guard-lowered."""
-        def scan(stmts, in_guarded):
-            for s in stmts:
-                if isinstance(s, (ast.Break, ast.Continue)) and in_guarded:
-                    return True
-                if isinstance(s, (ast.For, ast.While, *_SCOPE_BARRIERS)):
-                    continue
-                guarded = in_guarded or isinstance(s, (ast.Try, ast.With))
-                for field in ("body", "orelse", "finalbody"):
-                    sub = getattr(s, field, None)
-                    if sub and scan(sub, guarded):
-                        return True
-                for h in getattr(s, "handlers", []) or []:
-                    if scan(h.body, guarded):
-                        return True
-            return False
-        return scan(body, False)
+        return _scan_loop_jumps(body, (ast.Break, ast.Continue),
+                                only_guarded=True)
 
     def _lower_block(self, stmts, brk, cont):
         out = []
@@ -540,21 +536,7 @@ class _JumpLowering(ast.NodeTransformer):
 
     @staticmethod
     def _has_continue(body):
-        def scan(stmts):
-            for s in stmts:
-                if isinstance(s, ast.Continue):
-                    return True
-                if isinstance(s, (ast.For, ast.While, *_SCOPE_BARRIERS)):
-                    continue
-                for field in ("body", "orelse", "finalbody"):
-                    sub = getattr(s, field, None)
-                    if sub and scan(sub):
-                        return True
-                for h in getattr(s, "handlers", []) or []:
-                    if scan(h.body):
-                        return True
-            return False
-        return scan(body)
+        return _scan_loop_jumps(body, (ast.Continue,))
 
     def _finish(self, out, node, brk):
         if node.orelse:
@@ -604,11 +586,19 @@ class _JumpLowering(ast.NodeTransformer):
             # the flag is concretely True (stops consuming the iterator —
             # critical for infinite/shared generators), while a traced flag
             # leaves concrete_true False and the finite iterator unrolls
-            # with a no-op guarded body
+            # with a no-op guarded body.  A shadow tracks the loop variable
+            # of the last UN-broken iteration so post-loop reads see the
+            # break iteration's item exactly like Python (the For header
+            # keeps rebinding the target on the no-op iterations).
+            shadow = (self._fresh("item")
+                      if isinstance(node.target, ast.Name) else None)
+            guarded = ([ast.Assign(targets=[_name_store(shadow)],
+                                   value=_name_load(node.target.id))]
+                       if shadow else []) + lowered
             body = reset + [
                 ast.If(test=ast.UnaryOp(op=ast.Not(),
                                         operand=_name_load(brk)),
-                       body=lowered, orelse=[]),
+                       body=guarded, orelse=[]),
                 ast.If(test=ast.Call(func=_jst_attr("concrete_true"),
                                      args=[_name_load(brk)], keywords=[]),
                        body=[ast.Break()], orelse=[]),
@@ -616,6 +606,18 @@ class _JumpLowering(ast.NodeTransformer):
             out = init_brk + [
                 ast.For(target=node.target, iter=node.iter, body=body,
                         orelse=[])]
+            if shadow:
+                # zero-trip loops leave both names unbound: restore the
+                # target from the shadow only when the shadow exists
+                out.append(ast.Try(
+                    body=[ast.Assign(targets=[_name_store(node.target.id)],
+                                     value=_name_load(shadow))],
+                    handlers=[ast.ExceptHandler(
+                        type=ast.Tuple(elts=[_name_load("NameError"),
+                                             _name_load("UnboundLocalError")],
+                                       ctx=ast.Load()),
+                        name=None, body=[ast.Pass()])],
+                    orelse=[], finalbody=[]))
             return self._finish(out, node, brk)
 
         start, stop, step = rng

@@ -140,16 +140,11 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
             (jnp.arange(max_seqlen_k)[None, None, None, :] <
              lens_k[:, None, None, None]), 0.0, -1e30)
         out = _xla_attention(qd, kd, vd, mask=mask, causal=causal, scale=scale)
-        # repack
-        def scatter_seq(dense_i, cu, total):
-            return dense_i  # returned dense; caller reshapes
-
         # pack back to [total_q, H, D]
-        def one_out(i):
-            return out[i]
         total_q = q.shape[0]
         flat = out.reshape(-1, out.shape[-2], out.shape[-1])
-        pos = (cu_q[:, None] + jnp.arange(max_seqlen_q)[None, :]).reshape(-1)
+        pos = (cu_q[:-1, None] +
+               jnp.arange(max_seqlen_q)[None, :]).reshape(-1)
         valid = (jnp.arange(max_seqlen_q)[None, :] <
                  (cu_q[1:] - cu_q[:-1])[:, None]).reshape(-1)
         res = jnp.zeros_like(q)

@@ -486,3 +486,41 @@ def test_jit_save_super_forward(tmp_path):
     out = paddle.jit.load(str(tmp_path / "m"))(x)
     np.testing.assert_allclose(np.asarray(ref._value),
                                np.asarray(out._value), rtol=1e-5)
+
+
+def test_noniterator_for_break_loop_var_traced():
+    # traced break over a python list: the loop variable must land on the
+    # break iteration's item, not the final item
+    def f(x):
+        w = 0.0
+        for w in [0.1, 0.2, 0.3]:
+            if x.sum() < w:
+                break
+        return x * w
+
+    static_f = to_static(f)
+    for v in ([0.01, 0.01], [0.15, 0.0], [5.0, 5.0]):
+        np.testing.assert_allclose(np.asarray(static_f(_t(v))._value),
+                                   np.asarray(f(_t(v))._value), rtol=1e-6)
+
+
+def test_jit_save_bound_method(tmp_path):
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.s = 2.0
+
+        def forward(self, x):
+            y = x
+            for i in range(2):
+                y = y + self.s
+            return y
+
+    net = Net()
+    x = _t([1.0, 2.0])
+    ref = net(x)
+    paddle.jit.save(net.forward, str(tmp_path / "m"),
+                    input_spec=[paddle.static.InputSpec([2], "float32")])
+    out = paddle.jit.load(str(tmp_path / "m"))(x)
+    np.testing.assert_allclose(np.asarray(ref._value),
+                               np.asarray(out._value), rtol=1e-6)
