@@ -330,14 +330,11 @@ def propagate_jaxpr_specs(jaxpr: jcore.Jaxpr,
     return env.specs
 
 
-def complete_param_specs(fn, params, example_inputs, mesh=None):
-    """Trace ``fn(param_arrays, *input_arrays)`` and complete parameter
-    specs from the sparse annotations found on ``params`` (Tensor
-    ``_dist_attr``) and on the example inputs.
-
-    Returns a list of PartitionSpec-compatible tuples aligned with
-    ``params`` (None where nothing was inferred).
-    """
+def trace_and_complete(fn, params, example_inputs):
+    """Trace ``fn(param_arrays, *input_arrays)`` and run completion.
+    Returns ``(jaxpr, invar_specs, completed_param_specs)`` — the jaxpr
+    and annotation-aligned invar specs feed the cost model's plan search
+    (cost_model.choose_param_plan)."""
     from ...core.tensor import Tensor
 
     p_arrays = [p._value for p in params]
@@ -361,4 +358,15 @@ def complete_param_specs(fn, params, example_inputs, mesh=None):
         s = specs.get(v)
         out.append(s if s is not None and any(e is not None for e in s)
                    else None)
-    return out
+    return jaxpr, invar_specs, out
+
+
+def complete_param_specs(fn, params, example_inputs, mesh=None):
+    """Trace ``fn(param_arrays, *input_arrays)`` and complete parameter
+    specs from the sparse annotations found on ``params`` (Tensor
+    ``_dist_attr``) and on the example inputs.
+
+    Returns a list of PartitionSpec-compatible tuples aligned with
+    ``params`` (None where nothing was inferred).
+    """
+    return trace_and_complete(fn, params, example_inputs)[2]

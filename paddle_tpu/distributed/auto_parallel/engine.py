@@ -53,6 +53,10 @@ class Strategy:
         self.sharding = _Flag()
         self.gradient_merge = _Flag()
         self.pipeline = _Flag()
+        # cost-model plan SELECTION (reference parallel_tuner role):
+        # when enabled, parameters the completion pass leaves unplaced are
+        # assigned row/column/replicated splits by the static estimator
+        self.auto_search = _Flag()
 
 
 class Engine:
@@ -103,7 +107,6 @@ class Engine:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec
         from ..topology import get_global_mesh
-        from .completion import complete_param_specs
         from ...core.tape import no_grad
 
         mesh = get_global_mesh()
@@ -113,7 +116,9 @@ class Engine:
         annotated = [p for p in params if p._dist_attr is not None]
         input_annotated = getattr(x, "_dist_attr", None) is not None or \
             (y is not None and getattr(y, "_dist_attr", None) is not None)
-        if not annotated and not input_annotated:
+        auto_on = bool(getattr(self._strategy, "auto_search", None)
+                       and self._strategy.auto_search.enable)
+        if not annotated and not input_annotated and not auto_on:
             return
 
         model, loss = self._model, self._loss
@@ -134,12 +139,30 @@ class Engine:
 
         inputs = [x] if y is None else [x, y]
         try:
-            specs = complete_param_specs(fn, params, inputs, mesh)
+            from .completion import trace_and_complete
+            jaxpr, invar_specs, specs = trace_and_complete(fn, params,
+                                                           inputs)
         except Exception:
             # completion is best-effort (GSPMD defaults still work) — but
             # mark it done so fit() doesn't re-trace the model every batch
             self._completed = True
             return
+        if auto_on and any(s is None for s in specs):
+            try:
+                from .cost_model import choose_param_plan
+                # seed the search with whatever completion inferred, plus
+                # the annotated input specs at the tail
+                base = list(specs) + list(invar_specs[len(params):])
+                plan_axis = next(
+                    (a for a in ("mp", "model") if mesh.shape.get(a, 1) > 1),
+                    None)
+                if plan_axis is not None:
+                    planned = choose_param_plan(
+                        jaxpr, params, base, mesh, axis=plan_axis,
+                        param_count=len(params))
+                    specs = planned[:len(params)]
+            except Exception:
+                pass  # planning is best-effort on top of completion
         for p, s in zip(params, specs):
             if s is None or p._dist_attr is not None:
                 continue

@@ -1,0 +1,160 @@
+"""Static cost model + Engine plan selection (VERDICT r2 item 4;
+reference auto_parallel/static/cost/estimate_cost.py + parallel_tuner).
+
+The done-criterion test: on the 8-device mesh, the Engine's auto-chosen
+plan for an MLP block must match the hand-annotated Megatron plan — both
+in the chosen PartitionSpecs and in the compiled HLO's collective bytes.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+from paddle_tpu.distributed.auto_parallel.cost_model import (
+    choose_param_plan, estimate_plan_cost, hlo_collective_bytes)
+
+
+def _mlp(h=256, inter=4096, bias=False):
+    # large enough that TP's per-device FLOPs saving beats the all-reduce
+    # cost under the estimator's v5e constants (tiny matmuls genuinely
+    # favor replication — the model is honest about that)
+    paddle.seed(0)
+    l1 = nn.Linear(h, inter, bias_attr=bias)
+    l2 = nn.Linear(inter, h, bias_attr=bias)
+    return l1, l2, nn.Sequential(l1, nn.GELU(), l2)
+
+
+def _trace(model, params, x):
+    import jax
+
+    def fn(pv, xa):
+        saved = [p._value for p in params]
+        try:
+            for p, a in zip(params, pv):
+                p._value = a
+            return model(paddle.Tensor(xa))._value
+        finally:
+            for p, s in zip(params, saved):
+                p._value = s
+
+    return jax.make_jaxpr(lambda pv, xa: fn(pv, xa))(
+        [p._value for p in params], x._value).jaxpr
+
+
+def test_estimator_megatron_algebra():
+    # column-parallel first matmul: no comm; row-parallel second: one
+    # all_reduce of the output
+    l1, l2, model = _mlp()
+    params = [l1.weight, l2.weight]
+    x = paddle.randn([512, 256])
+    jaxpr = _trace(model, params, x)
+    mesh_shape = {"model": 8}
+
+    col_row = estimate_plan_cost(
+        jaxpr, [(None, "model"), ("model", None), None], mesh_shape,
+        param_count=2)
+    assert col_row.comm_bytes > 0  # the down-proj psum
+    kinds = {k for k, _, _ in col_row.breakdown}
+    assert kinds == {"all_reduce"}
+
+    col_only = estimate_plan_cost(
+        jaxpr, [(None, "model"), None, None], mesh_shape, param_count=2)
+    # replicated down-proj stores 8x the param bytes for the same
+    # compute/comm — the full Megatron plan must rank strictly cheaper
+    assert col_row.total() < col_only.total()
+    repl = estimate_plan_cost(jaxpr, [None, None, None], mesh_shape,
+                              param_count=2)
+    assert col_row.total() < repl.total()
+    # per-device flops shrink 8x vs replicated
+    assert col_row.flops_per_device < repl.flops_per_device / 4
+
+
+def test_choose_param_plan_finds_megatron():
+    l1, l2, model = _mlp()
+    params = [l1.weight, l2.weight]
+    x = paddle.randn([512, 256])
+    jaxpr = _trace(model, params, x)
+
+    class _FakeMesh:
+        shape = {"model": 8}
+
+    plan = choose_param_plan(jaxpr, params, [None, None, None], _FakeMesh(),
+                             axis="model", param_count=2)
+    assert plan[0] == (None, "model"), plan
+    assert plan[1] == ("model", None), plan
+
+
+def test_hlo_collective_bytes_parser():
+    text = """
+  %ar = f32[4,16]{1,0} all-reduce(f32[4,16]{1,0} %x), replica_groups={}
+  %ag = bf16[8,32]{1,0} all-gather(bf16[4,32]{1,0} %y), dimensions={0}
+"""
+    got = hlo_collective_bytes(text)
+    assert got["all-reduce"] == 4 * 16 * 4
+    assert got["all-gather"] == 8 * 32 * 2
+
+
+def test_engine_auto_plan_matches_hand_plan_hlo():
+    """Done-criterion: auto-chosen plan == hand-annotated Megatron plan,
+    verified down to the compiled HLO's collective bytes on the 8-device
+    mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def build(annotate):
+        hcg = dist.HybridCommunicateGroup(mp=8)
+        l1, l2, model = _mlp()
+        if annotate:
+            l1.weight._dist_attr = (None, "model")
+            l2.weight._dist_attr = ("model", None)
+        strategy = dist.auto_parallel.Strategy()
+        strategy.auto_search.enable = not annotate
+        eng = dist.auto_parallel.Engine(
+            model=model, loss=nn.MSELoss(),
+            optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                           parameters=model.parameters()),
+            strategy=strategy)
+        x = paddle.randn([512, 256])
+        y = paddle.randn([512, 256])
+        eng._complete(x, y)
+        return model, l1, l2, x
+
+    def compiled_bytes(model, l1, l2, x):
+        mesh = dist.get_global_mesh()
+        params = [l1.weight, l2.weight]
+
+        def fwd(pv, xa):
+            saved = [p._value for p in params]
+            try:
+                for p, a in zip(params, pv):
+                    p._value = a
+                return model(paddle.Tensor(xa))._value
+            finally:
+                for p, s in zip(params, saved):
+                    p._value = s
+
+        in_sh = ([NamedSharding(mesh, PartitionSpec(*p._dist_attr))
+                  for p in params],
+                 NamedSharding(mesh, PartitionSpec()))
+        jf = jax.jit(fwd, in_shardings=in_sh)
+        txt = jf.lower([p._value for p in params],
+                       x._value).compile().as_text()
+        return hlo_collective_bytes(txt)
+
+    try:
+        model_a, a1, a2, xa = build(annotate=False)  # auto
+        # the planner must land on the Megatron pattern
+        assert a1.weight._dist_attr == (None, "model"), a1.weight._dist_attr
+        assert a2.weight._dist_attr == ("model", None), a2.weight._dist_attr
+        auto_bytes = compiled_bytes(model_a, a1, a2, xa)
+        dist.set_global_mesh(None)
+
+        model_h, h1, h2, xh = build(annotate=True)  # hand
+        hand_bytes = compiled_bytes(model_h, h1, h2, xh)
+        assert auto_bytes == hand_bytes, (auto_bytes, hand_bytes)
+        # Megatron MLP forward: exactly one all-reduce's worth of bytes
+        assert auto_bytes.get("all-reduce", 0) > 0
+    finally:
+        dist.set_global_mesh(None)
