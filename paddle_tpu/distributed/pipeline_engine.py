@@ -15,9 +15,15 @@ interceptors).  Design (scaling-book collective-permute pipelining):
   the compute of tick t+1 — the steady-state overlap the reference builds
   with P2P threads comes from the compiler schedule.
 - ``jax.grad`` through the scan+ppermute yields the backward pipeline
-  automatically (reversed scan, transposed permutes): a GPipe schedule,
-  with per-stage rematerialization via ``jax.checkpoint`` standing in for
-  the reference's recompute-in-1F1B memory profile.
+  automatically (reversed scan, transposed permutes): a GPipe schedule —
+  simple and fully differentiable, but its stashed activations scale with
+  n_microbatches.
+- ``pipeline_train_step_1f1b`` is the memory-bounded training schedule
+  (reference 1F1B, ``pipeline_parallel.py:387``): one scan whose ticks
+  each run a forward unit AND a backward unit (explicit per-tick
+  ``jax.vjp``, residuals never cross ticks), with a statically simulated
+  per-rank schedule and an O(pp) circular stash — in-flight activations
+  are bounded by the pipeline depth, not the microbatch count.
 
 Stages must be shape-homogeneous (stage_fn: (stage_params, x) -> y with y
 shaped like x) — the transformer-decoder case; embedding/head run outside
@@ -131,6 +137,226 @@ class PipelineStageRunner:
     def __call__(self, stacked_params, micro_xs):
         return pipeline_apply(self.stage_fn, stacked_params, micro_xs,
                               self.n_stages, self.mesh, self.remat)
+
+
+def simulate_1f1b_schedule(n_stages: int, n_micro: int):
+    """Statically simulate the 1F1B schedule (reference
+    ``PipelineParallel._forward_backward_pipeline``'s warmup/steady/
+    cooldown phases, ``pipeline_parallel.py:387``).
+
+    Each tick, a rank may run one forward unit and one backward unit.
+    Rank r stashes at most ``2(S - r) - 1`` microbatch inputs: with both
+    units sharing a tick, a microbatch's cotangent returns 2(S - 1 - r)
+    ticks after its forward, so this admission cap (not the sequential
+    1F1B ``S - r``) is what sustains one microbatch per tick while keeping
+    the stash O(pipeline depth), constant in n_micro.  Backwards fire as
+    soon as the cotangent arrived (last rank: same tick as its forward).
+
+    Returns int32 numpy arrays ``(fwd_m, bwd_m, fwd_in, bwd_in)`` of shape
+    [T, S]: the microbatch forwarded/backwarded by rank r at tick t (-1 =
+    idle), and the microbatch whose activation/cotangent ARRIVES on the
+    wire at tick t (sent at t-1 by the neighbor).
+    """
+    import numpy as np
+
+    S, M = n_stages, n_micro
+    fwd_tick = [[-1] * M for _ in range(S)]
+    bwd_tick = [[-1] * M for _ in range(S)]
+    next_f = [0] * S
+    next_b = [0] * S
+    fwd_sched, bwd_sched = [], []
+    t = 0
+    while any(nb < M for nb in next_b):
+        if t > 4 * (M + S) + 8:  # schedule must close; bug otherwise
+            raise RuntimeError("1F1B schedule did not converge")
+        fs, bs = [-1] * S, [-1] * S
+        for r in range(S):
+            m = next_f[r]
+            cap = max(1, 2 * (S - r) - 1)
+            if m < M and (m - next_b[r]) < cap and \
+                    (r == 0 or (fwd_tick[r - 1][m] >= 0
+                                and fwd_tick[r - 1][m] < t)):
+                fs[r] = m
+                fwd_tick[r][m] = t
+                next_f[r] += 1
+        for r in range(S - 1, -1, -1):
+            m = next_b[r]
+            if m >= M:
+                continue
+            if r == S - 1:
+                ready = fwd_tick[r][m] >= 0 and fwd_tick[r][m] <= t
+            else:
+                ready = bwd_tick[r + 1][m] >= 0 and bwd_tick[r + 1][m] < t
+            if ready:
+                bs[r] = m
+                bwd_tick[r][m] = t
+                next_b[r] += 1
+        fwd_sched.append(fs)
+        bwd_sched.append(bs)
+        t += 1
+
+    T = len(fwd_sched)
+    fwd_m = np.asarray(fwd_sched, np.int32)
+    bwd_m = np.asarray(bwd_sched, np.int32)
+    fwd_in = np.full((T, S), -1, np.int32)
+    bwd_in = np.full((T, S), -1, np.int32)
+    for tt in range(1, T):
+        for r in range(S):
+            if r > 0 and fwd_m[tt - 1, r - 1] >= 0:
+                fwd_in[tt, r] = fwd_m[tt - 1, r - 1]
+            if r < S - 1 and bwd_m[tt - 1, r + 1] >= 0:
+                bwd_in[tt, r] = bwd_m[tt - 1, r + 1]
+    return fwd_m, bwd_m, fwd_in, bwd_in
+
+
+def pipeline_train_step_1f1b(stage_fn: Callable, loss_fn: Callable,
+                             stacked_params: Any, micro_xs, micro_labels,
+                             n_stages: int, mesh: Mesh,
+                             remat: bool = True):
+    """1F1B pipeline training step: returns ``(mean_loss, param_grads)``
+    with per-device in-flight activations bounded by the pipeline depth.
+
+    stage_fn(stage_params, x) -> y (same shape as x);
+    loss_fn(y, label_mb) -> scalar (evaluated on the LAST stage's output).
+    stacked_params: pytree, leaves [n_stages, ...]; micro_xs
+    [n_micro, micro, ...]; micro_labels [n_micro, ...] aligned with xs.
+    param_grads come back stacked like ``stacked_params``.
+
+    Memory: the scan carries circular [2S, micro, ...] stash/wire
+    buffers (2S slots because up to 2(S-r)-1 microbatches are in flight
+    per rank) — constant in n_micro.  What stays O(batch) is the INPUT
+    feed: ``micro_xs``/``micro_labels`` are replicated to every pipe rank
+    (only rank 0 reads xs, rank S-1 reads labels) — that is the caller's
+    batch, present in any trainer pipelined or not, and it is argument
+    memory, not the schedule's stashed-activation term this engine bounds.  The
+    backward unit re-runs the stage forward inside ``jax.vjp`` each tick
+    (recompute-in-1F1B, the reference's recompute interval), so residuals
+    never cross scan ticks.
+    """
+    S = n_stages
+    n_micro = micro_xs.shape[0]
+    fwd_m, bwd_m, fwd_in, bwd_in = simulate_1f1b_schedule(S, n_micro)
+    total_ticks = fwd_m.shape[0]
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    perm_f = [(i, (i + 1) % S) for i in range(S)]
+    perm_b = [(i, (i - 1) % S) for i in range(S)]
+
+    f_m = jnp.asarray(fwd_m)
+    b_m = jnp.asarray(bwd_m)
+    f_in = jnp.asarray(fwd_in)
+    b_in = jnp.asarray(bwd_in)
+
+    def inner(params, xs, labels):
+        my_params = jax.tree_util.tree_map(lambda l: l[0], params)
+        r = jax.lax.axis_index(PIPE_AXIS)
+        is_first = r == 0
+        is_last = r == S - 1
+
+        zero_mb = jnp.zeros_like(xs[0])
+        n_slots = 2 * S
+        stash0 = _pvary(jnp.zeros((n_slots,) + xs.shape[1:], xs.dtype),
+                        (PIPE_AXIS,))
+        wire_a0 = _pvary(zero_mb, (PIPE_AXIS,))
+        wire_c0 = _pvary(zero_mb, (PIPE_AXIS,))
+        grads0 = jax.tree_util.tree_map(
+            lambda l: _pvary(jnp.zeros_like(l[0]), (PIPE_AXIS,)), params)
+        loss0 = _pvary(jnp.zeros((), jnp.float32), (PIPE_AXIS,))
+
+        def sched(tab, t):
+            row = jax.lax.dynamic_index_in_dim(tab, t, axis=0,
+                                               keepdims=False)
+            return jax.lax.dynamic_index_in_dim(row, r, axis=0,
+                                                keepdims=False)
+
+        def tick(carry, t):
+            wire_a, wire_c, in_acts, in_cots, stash, grads, loss = carry
+            fm = sched(f_m, t)
+            bm = sched(b_m, t)
+            fin = sched(f_in, t)
+            bin_ = sched(b_in, t)
+
+            # deliver last tick's wire traffic into the circular buffers
+            in_acts = jnp.where(
+                fin >= 0,
+                jax.lax.dynamic_update_index_in_dim(
+                    in_acts, wire_a, jnp.maximum(fin, 0) % n_slots, axis=0),
+                in_acts)
+            in_cots = jnp.where(
+                bin_ >= 0,
+                jax.lax.dynamic_update_index_in_dim(
+                    in_cots, wire_c, jnp.maximum(bin_, 0) % n_slots, axis=0),
+                in_cots)
+
+            # ---- forward unit ----
+            fm_c = jnp.maximum(fm, 0)
+            x_local = jax.lax.dynamic_index_in_dim(xs, fm_c, axis=0,
+                                                   keepdims=False)
+            x_wire = jax.lax.dynamic_index_in_dim(in_acts, fm_c % n_slots,
+                                              axis=0,
+                                                  keepdims=False)
+            x_in = jnp.where(is_first, x_local, x_wire)
+            out_f = fn(my_params, x_in)
+            stash = jnp.where(
+                fm >= 0,
+                jax.lax.dynamic_update_index_in_dim(
+                    stash, x_in, fm_c % n_slots, axis=0),
+                stash)
+
+            # ---- backward unit (explicit vjp; residuals die with the
+            # tick — this is the 1F1B recompute) ----
+            bm_c = jnp.maximum(bm, 0)
+            x_saved = jax.lax.dynamic_index_in_dim(stash, bm_c % n_slots,
+                                               axis=0,
+                                                   keepdims=False)
+            y, vjp_fn = jax.vjp(fn, my_params, x_saved)
+            label_mb = jax.lax.dynamic_index_in_dim(labels, bm_c, axis=0,
+                                                    keepdims=False)
+            loss_m, dy_loss = jax.value_and_grad(loss_fn)(y, label_mb)
+            cot_wire = jax.lax.dynamic_index_in_dim(in_cots, bm_c % n_slots,
+                                                    axis=0, keepdims=False)
+            cot = jnp.where(is_last, dy_loss, cot_wire)
+            dp, dx = vjp_fn(cot)
+            live = bm >= 0
+            grads = jax.tree_util.tree_map(
+                lambda g, d: g + jnp.where(live, d, jnp.zeros_like(d)),
+                grads, dp)
+            loss = loss + jnp.where(live & is_last, loss_m, 0.0)
+
+            # ---- wires for next tick ----
+            wire_a = jax.lax.ppermute(out_f, PIPE_AXIS, perm_f)
+            wire_c = jax.lax.ppermute(dx, PIPE_AXIS, perm_b)
+            return (wire_a, wire_c, in_acts, in_cots, stash, grads,
+                    loss), None
+
+        carry0 = (wire_a0, wire_c0, stash0, stash0, stash0, grads0, loss0)
+        (_, _, _, _, _, grads, loss), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(total_ticks))
+        # loss lives on the last rank; grads live per rank — return the
+        # microbatch-MEAN loss and matching grads, stacked over pipe
+        loss_all = jax.lax.psum(loss, PIPE_AXIS) / n_micro
+        grads_out = jax.tree_util.tree_map(lambda g: g[None] / n_micro,
+                                           grads)
+        return loss_all, grads_out
+
+    n_dims_x = micro_xs.ndim
+    sm = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(
+            jax.tree_util.tree_map(lambda _: PartitionSpec(PIPE_AXIS),
+                                   stacked_params),
+            PartitionSpec(*([None] * n_dims_x)),
+            PartitionSpec(*([None] * micro_labels.ndim)),
+        ),
+        out_specs=(
+            PartitionSpec(),
+            jax.tree_util.tree_map(lambda _: PartitionSpec(PIPE_AXIS),
+                                   stacked_params),
+        ),
+        axis_names={PIPE_AXIS},
+    )
+    return sm(stacked_params, micro_xs, micro_labels)
 
 
 def pipeline_apply_interleaved(stage_fn: Callable, stacked_params: Any,

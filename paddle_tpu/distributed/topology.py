@@ -47,11 +47,16 @@ def get_global_mesh() -> Optional[Mesh]:
 
 def pvary(x, axes):
     """Mark x as varying over manual mesh axes (pcast on new jax, pvary on
-    old); shared by the shard_map-based engines (pipeline, ring attention)."""
+    old); idempotent — already-varying values pass through.  Shared by the
+    shard_map-based engines (pipeline, ring attention)."""
     try:
         return jax.lax.pcast(x, axes, to="varying")
     except (AttributeError, TypeError):
         return jax.lax.pvary(x, axes)
+    except ValueError as e:
+        if "from=varying" in str(e):
+            return x
+        raise
 
 
 class CommunicateTopology:
