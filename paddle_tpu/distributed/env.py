@@ -75,13 +75,18 @@ def init_parallel_env():
         return ParallelEnv()
     n_procs = int(os.environ.get("PADDLE_TRAINERS_NUM",
                                  os.environ.get("WORLD_SIZE", 1)))
-    if n_procs > 1 and jax.process_count() == 1:
-        addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
-        port = os.environ.get("MASTER_PORT", "8787")
-        pid = int(os.environ.get("PADDLE_TRAINER_ID",
-                                 os.environ.get("RANK", 0)))
-        jax.distributed.initialize(
-            coordinator_address=f"{addr}:{port}",
-            num_processes=n_procs, process_id=pid)
+    if n_procs > 1:
+        # must not touch jax.process_count()/devices() here: any backend
+        # query initializes XLA and makes jax.distributed.initialize
+        # impossible — is_initialized() checks the coordination client
+        # without touching backends
+        if not jax.distributed.is_initialized():
+            addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
+            port = os.environ.get("MASTER_PORT", "8787")
+            pid = int(os.environ.get("PADDLE_TRAINER_ID",
+                                     os.environ.get("RANK", 0)))
+            jax.distributed.initialize(
+                coordinator_address=f"{addr}:{port}",
+                num_processes=n_procs, process_id=pid)
     _initialized = True
     return ParallelEnv()

@@ -1,0 +1,86 @@
+"""Cross-process rendezvous through distributed/env.py (VERDICT r2 weak
+item 8; reference spawn-with-env pattern of
+``test/legacy_test/test_dist_base.py:962``).
+
+Spawns a real 2-process CPU cluster: each child gets the launcher env
+contract (MASTER_ADDR/PORT, PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM),
+calls ``init_parallel_env`` — which must route into
+``jax.distributed.initialize`` — and asserts the global view (process
+count, global device count, cross-process device enumeration).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_CHILD = r"""
+import os, sys
+sys.path.insert(0, __REPO__)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+from jax._src import xla_bridge as _xb
+# drop the axon plugin factory WITHOUT initializing a backend:
+# jax.distributed.initialize must run before any backend init
+jax.config.update("jax_platforms", "cpu")
+for name in list(getattr(_xb, "_backend_factories", {})):
+    if name not in ("cpu", "tpu"):
+        _xb._backend_factories.pop(name, None)
+from paddle_tpu.distributed.env import init_parallel_env, get_rank, \
+    get_world_size
+env = init_parallel_env()
+assert jax.process_count() == 2, jax.process_count()
+assert get_world_size() == 2, get_world_size()
+assert get_rank() == int(os.environ["PADDLE_TRAINER_ID"])
+# the global device list spans both processes
+assert len(jax.devices()) >= 2, jax.devices()
+procs = sorted({d.process_index for d in jax.devices()})
+assert procs == [0, 1], procs
+# local devices belong to this process only
+assert all(d.process_index == jax.process_index()
+           for d in jax.local_devices())
+print("RENDEZVOUS_OK", get_rank())
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_cpu_rendezvous():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _free_port()
+    code = _CHILD.replace("__REPO__", repr(repo))
+    children = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+        })
+        env.pop("XLA_FLAGS", None)  # children use 1 device each
+        children.append(subprocess.Popen(
+            [sys.executable, "-c", code], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for c in children:
+        try:
+            out, _ = c.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for k in children:
+                k.kill()
+            pytest.fail("rendezvous timed out")
+        outs.append(out)
+    for rank, (c, out) in enumerate(zip(children, outs)):
+        assert c.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
+        assert f"RENDEZVOUS_OK {rank}" in out, out[-2000:]
