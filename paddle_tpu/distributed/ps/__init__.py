@@ -84,6 +84,25 @@ class PSClient:
         """Rows currently resident in server memory (spilled excluded)."""
         return self._c.sparse_mem_rows(table_id)
 
+    # graph tables (reference common_graph_table.h:501: the PS serves the
+    # graph STRUCTURE; node features ride the sparse tables) ------------
+    def create_graph_table(self, table_id: int, seed: int = 0):
+        self._c.create_graph(table_id, seed)
+
+    def add_graph_edges(self, table_id: int, src, dst):
+        """Append directed edges src[i] -> dst[i] (call twice with swapped
+        args for an undirected graph)."""
+        self._c.graph_add_edges(table_id, src, dst)
+
+    def sample_neighbors(self, table_id: int, nodes, sample_size: int):
+        """[len(nodes), sample_size] uint64 neighbor ids sampled with
+        replacement server-side; isolated nodes echo themselves
+        (self-loop convention — reference graph_sample_neighbors)."""
+        return self._c.graph_sample_neighbors(table_id, nodes, sample_size)
+
+    def node_degree(self, table_id: int, nodes):
+        return self._c.graph_degree(table_id, nodes)
+
     # dense ------------------------------------------------------------
     def pull_dense(self, table_id: int):
         return self._c.pull_dense(table_id, self._dense_dims[table_id])

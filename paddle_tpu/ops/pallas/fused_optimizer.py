@@ -28,39 +28,100 @@ def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, t_ref,
     g = g_ref[:].astype(jnp.float32)
     m = m_ref[:]
     v = v_ref[:]
-    lr = lr_ref[0]
-    t = t_ref[0]
+    lr = lr_ref[0, 0]  # (1,1) scalar ref: Mosaic rejects 1-D scalar blocks
+    t = t_ref[0, 0]
     p = p * (1.0 - lr * wd)
     m_new = beta1 * m + (1.0 - beta1) * g
     v_new = beta2 * v + (1.0 - beta2) * g * g
-    m_hat = m_new / (1.0 - beta1 ** t)
-    v_hat = v_new / (1.0 - beta2 ** t)
+    # beta ** t via exp/log: Mosaic has no dynamic-exponent pow lowering
+    import math
+    b1t = jnp.exp(t * math.log(beta1))
+    b2t = jnp.exp(t * math.log(beta2))
+    m_hat = m_new / (1.0 - b1t)
+    v_hat = v_new / (1.0 - b2t)
     p_out[:] = (p - lr * m_hat / (jnp.sqrt(v_hat) + epsilon)) \
         .astype(p_out.dtype)
     m_out[:] = m_new
     v_out[:] = v_new
 
 
+def adamw_sig(numel, dtype):
+    import numpy as np
+    return f"{numel}/{np.dtype(dtype)}"
+
+
+_LANES = 512  # row width of the internal 2-D view (Mosaic-friendly)
+
+
+def _adamw_call(flat_p, flat_g, flat_m, flat_v, lr_arr, t_arr,
+                beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.01,
+                chunk=None):
+    """chunk=0/None-with-no-winner: whole-array kernel; chunk>0: grid over
+    row blocks of ``chunk`` elements (bounded VMEM per program — the
+    searchable schedule).  Internally the flat arrays are viewed as
+    [rows, 512]: Mosaic wants >=2-D lane-tiled refs on TPU."""
+    numel = flat_p.shape[0]
+    if chunk is None:
+        from .schedule_search import get_schedule
+        hit = get_schedule("fused_adamw", adamw_sig(numel, flat_p.dtype))
+        if hit is not None:
+            chunk = int(hit)
+        else:
+            # untuned default: bounded chunk — the whole-array form is
+            # VMEM-infeasible beyond ~1M params (measured; BASELINE.md)
+            chunk = 0 if numel <= (1 << 19) else (1 << 19)
+    kernel = functools.partial(_adamw_kernel, beta1=beta1, beta2=beta2,
+                               epsilon=epsilon, wd=wd)
+
+    pad = (-numel) % _LANES
+    def to2d(a):
+        if pad:
+            a = jnp.pad(a, (0, pad))
+        return a.reshape(-1, _LANES)
+
+    p2, g2, m2, v2 = map(to2d, (flat_p, flat_g, flat_m, flat_v))
+    rows = p2.shape[0]
+    out_shapes = [
+        jax.ShapeDtypeStruct(p2.shape, p2.dtype),
+        jax.ShapeDtypeStruct(p2.shape, jnp.float32),
+        jax.ShapeDtypeStruct(p2.shape, jnp.float32),
+    ]
+    row_blk = max(1, min(rows, chunk // _LANES)) if chunk else 0
+    while row_blk > 1 and rows % row_blk != 0:
+        row_blk -= 1  # round down to a divisor, never to whole-array
+    if not row_blk or row_blk >= rows:
+        outs = pl.pallas_call(
+            kernel,
+            out_shape=out_shapes,
+            input_output_aliases={0: 0, 2: 1, 3: 2},
+            interpret=not on_tpu(),
+        )(p2, g2, m2, v2, lr_arr, t_arr)
+    else:
+        spec = pl.BlockSpec((row_blk, _LANES), lambda i: (i, 0))
+        scalar = pl.BlockSpec((1, 1), lambda i: (0, 0))
+        outs = pl.pallas_call(
+            kernel,
+            grid=(rows // row_blk,),
+            in_specs=[spec, spec, spec, spec, scalar, scalar],
+            out_specs=[spec, spec, spec],
+            out_shape=out_shapes,
+            input_output_aliases={0: 0, 2: 1, 3: 2},
+            interpret=not on_tpu(),
+        )(p2, g2, m2, v2, lr_arr, t_arr)
+    return tuple(o.reshape(-1)[:numel] for o in outs)
+
+
 def fused_adamw_update(p, g, m, v, lr, step, beta1=0.9, beta2=0.999,
-                       epsilon=1e-8, weight_decay=0.01):
+                       epsilon=1e-8, weight_decay=0.01, chunk=None):
     """One fused AdamW step.  p/g: param dtype; m/v: fp32 moments;
     lr: scalar; step: 1-based int step count.  Returns (p', m', v')."""
     flat_p = p.reshape(-1)
     flat_g = g.reshape(-1)
     flat_m = m.reshape(-1)
     flat_v = v.reshape(-1)
-    lr_arr = jnp.asarray([lr], jnp.float32)
-    t_arr = jnp.asarray([step], jnp.float32)
-    kernel = functools.partial(_adamw_kernel, beta1=beta1, beta2=beta2,
-                               epsilon=epsilon, wd=weight_decay)
-    p2, m2, v2 = pl.pallas_call(
-        kernel,
-        out_shape=[
-            jax.ShapeDtypeStruct(flat_p.shape, flat_p.dtype),
-            jax.ShapeDtypeStruct(flat_m.shape, jnp.float32),
-            jax.ShapeDtypeStruct(flat_v.shape, jnp.float32),
-        ],
-        input_output_aliases={0: 0, 2: 1, 3: 2},
-        interpret=not on_tpu(),
-    )(flat_p, flat_g, flat_m, flat_v, lr_arr, t_arr)
+    lr_arr = jnp.asarray([[lr]], jnp.float32)
+    t_arr = jnp.asarray([[step]], jnp.float32)
+    p2, m2, v2 = _adamw_call(flat_p, flat_g, flat_m, flat_v, lr_arr, t_arr,
+                             beta1=beta1, beta2=beta2, epsilon=epsilon,
+                             wd=weight_decay, chunk=chunk)
     return p2.reshape(p.shape), m2.reshape(m.shape), v2.reshape(v.shape)

@@ -55,13 +55,24 @@ def _kernel(x_ref, qw_ref, scale_ref, y_ref):
     y_ref[:] = (acc * scale_ref[:]).astype(y_ref.dtype)
 
 
-def _qmm_impl(x2, qweight, scales2, out_dtype):
+def qmm_sig(m, k, n, dtype):
+    import numpy as np
+    return f"{m}x{k}x{n}/{np.dtype(dtype)}"
+
+
+def _qmm_impl(x2, qweight, scales2, out_dtype, block_m=None, block_n=None):
     m, k = x2.shape
     n = qweight.shape[1]
+    if block_m is None and block_n is None:
+        from .schedule_search import get_schedule
+        hit = get_schedule("quantized_matmul", qmm_sig(m, k, n, x2.dtype))
+        if hit:
+            block_m, block_n = int(hit[0]), int(hit[1])
     # N blocks must tile N exactly (gate guarantees n % 128 == 0)
-    bn = BLOCK_N if n % BLOCK_N == 0 else 128
+    bn = block_n if block_n and n % block_n == 0 else \
+        (BLOCK_N if n % BLOCK_N == 0 else 128)
     # M is padded up to a whole number of blocks (bounded VMEM per block)
-    bm = min(BLOCK_M, max(8, m))
+    bm = block_m if block_m else min(BLOCK_M, max(8, m))
     pad_m = (-m) % bm
     if pad_m:
         x2 = jnp.pad(x2, ((0, pad_m), (0, 0)))

@@ -21,6 +21,11 @@ from ._common import on_tpu, pallas_enabled
 BLOCK_ROWS = 256
 
 
+def rms_sig(n, d, dtype):
+    import numpy as np
+    return f"{n}x{d}/{np.dtype(dtype)}"
+
+
 def _pick_rows(n: int) -> int:
     """Largest divisor of n that is <= BLOCK_ROWS and a multiple of 8
     (the fp32 sublane tile)."""
@@ -29,6 +34,16 @@ def _pick_rows(n: int) -> int:
         if n % r == 0:
             best = r
     return best
+
+
+def _resolve_rows(n: int, d: int, dtype) -> int:
+    """Searched winner for this shape/dtype/chip (schedule_search), else
+    the heuristic default."""
+    from .schedule_search import get_schedule
+    hit = get_schedule("rms_norm", rms_sig(n, d, dtype))
+    if hit and n % int(hit) == 0:
+        return int(hit)
+    return _pick_rows(n) or n
 
 
 def should_use_pallas(x) -> bool:
@@ -52,9 +67,10 @@ def _fwd_kernel(x_ref, w_ref, y_ref, *, epsilon):
     y_ref[:] = (x * rrms * w_ref[:].astype(jnp.float32)).astype(y_ref.dtype)
 
 
-def _rms_fwd_impl(x2, w, epsilon):
+def _rms_fwd_impl(x2, w, epsilon, rows=None):
     n, d = x2.shape
-    rows = _pick_rows(n) or n
+    if rows is None:
+        rows = _resolve_rows(n, d, x2.dtype)
     return pl.pallas_call(
         functools.partial(_fwd_kernel, epsilon=epsilon),
         grid=(n // rows,),

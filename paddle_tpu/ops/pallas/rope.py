@@ -50,9 +50,21 @@ def _rope_kernel(x_ref, cos_ref, sin_ref, y_ref):
     y_ref[:] = jnp.concatenate([y1, y2], axis=-1).astype(y_ref.dtype)
 
 
-def _rope_call(x, cos, sin):
+def rope_sig(b, s, h, d, dtype):
+    import numpy as np
+    return f"{b}x{s}x{h}x{d}/{np.dtype(dtype)}"
+
+
+def _rope_call(x, cos, sin, block_s=None):
     b, s, h, d = x.shape
-    bs = _pick_block_s(s, h, d)
+    bs = block_s
+    if bs is None:
+        from .schedule_search import get_schedule
+        hit = get_schedule("rope", rope_sig(b, s, h, d, x.dtype))
+        if hit and s % int(hit) == 0:
+            bs = int(hit)
+    if bs is None:
+        bs = _pick_block_s(s, h, d)
     if bs is None:  # gate normally prevents this; direct callers fall back
         bs = s
     return pl.pallas_call(

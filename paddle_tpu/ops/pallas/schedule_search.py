@@ -1,0 +1,191 @@
+"""Offline Pallas schedule search for every kernel in the pack.
+
+Generalization of the flash-attention block search (the CINN
+``auto_schedule`` role, ``paddle/cinn/auto_schedule/search_space/
+search_space.h:41``): each kernel exposes its block-size space here, the
+harness times every feasible candidate EAGERLY on the real device and
+persists the winner keyed by ``kernel/shape/dtype/chip`` — kernels then
+consult the store at trace time (timing is impossible inside jit), and
+fall back to their measured-default heuristics on a miss.
+
+Run ``python tools/tune_pallas_schedules.py`` on the chip to (re)search
+the bench shapes; winners land in the same persistent autotune cache the
+flash search uses (~/.cache/paddle_tpu/autotune.json or
+$PTPU_AUTOTUNE_CACHE).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from .autotune import _time_once, persistent_get, persistent_put
+
+__all__ = ["chip_kind", "get_schedule", "put_schedule", "tune_kernel",
+           "tune_rms_norm", "tune_rope", "tune_quantized_matmul",
+           "tune_fused_adamw", "tune_bench_shapes"]
+
+
+def chip_kind() -> str:
+    import jax
+    try:
+        dev = jax.devices()[0]
+        if dev.platform in ("tpu", "axon"):
+            return str(getattr(dev, "device_kind", dev.platform)) \
+                .replace(" ", "_")
+    except Exception:
+        pass
+    return "interpret"
+
+
+def _key(kernel: str, sig: str) -> str:
+    return f"sched/{kernel}/{sig}/{chip_kind()}"
+
+
+def get_schedule(kernel: str, sig: str):
+    """Winner config for (kernel, shape-sig) on THIS chip, or None."""
+    return persistent_get(_key(kernel, sig))
+
+
+def put_schedule(kernel: str, sig: str, config):
+    persistent_put(_key(kernel, sig), config)
+
+
+def tune_kernel(kernel: str, sig: str, make_fn: Callable,
+                candidates: Sequence, args: Tuple,
+                iters: int = 3):
+    """Time ``make_fn(*candidate)(*args)`` for every candidate, persist
+    the winner, return ``(best_config, table)`` where table is
+    ``[(config, seconds | None)]`` (None = candidate failed to compile/
+    run, e.g. VMEM overflow)."""
+    import jax
+    table: List = []
+    errors: List = []
+    best, best_t = None, float("inf")
+    for cand in candidates:
+        cand_t = cand if isinstance(cand, tuple) else (cand,)
+        try:
+            t = _time_candidate(make_fn(*cand_t), args, iters=iters)
+        except Exception as e:
+            table.append((cand, None))
+            errors.append((cand, str(e)[:200]))
+            continue
+        table.append((cand, t))
+        if t < best_t:
+            best, best_t = cand, t
+    if best is not None:
+        put_schedule(kernel, sig, best)
+    if best is None and errors:
+        print(f"tune_kernel({kernel}/{sig}): all candidates failed; "
+              f"first error: {errors[0]}")
+    return best, table
+
+
+def _time_candidate(fn, args, iters: int = 3):
+    """Per-candidate timing: jit once (the timed region measures RUNTIME,
+    not lowering/compilation).  On a tunnelled PJRT backend each call
+    carries a constant per-dispatch latency (~ms); it is the SAME constant
+    for every candidate of a kernel, so the ranking — all the search needs
+    — is unaffected, while absolute times are upper bounds."""
+    import jax
+
+    jfn = jax.jit(fn)
+    return _time_once(jfn, args, {}, warmup=2, iters=max(iters, 5))
+
+
+# ---------------------------------------------------------------------------
+# per-kernel spaces
+# ---------------------------------------------------------------------------
+
+def _divisors_of(n: int, step: int, lo: int, hi: int) -> List[int]:
+    return [r for r in range(lo, min(hi, n) + 1, step) if n % r == 0]
+
+
+def tune_rms_norm(n: int, d: int, dtype="bfloat16", iters: int = 3):
+    """Search the row-block size of the fused RMSNorm kernel for a
+    [n, d] input."""
+    import jax.numpy as jnp
+
+    from .rms_norm import _rms_fwd_impl, rms_sig
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((n, d)), dtype)
+    w = jnp.asarray(rng.standard_normal((d,)), dtype)
+    cands = _divisors_of(n, 8, 8, 2048) or [n]
+    return tune_kernel(
+        "rms_norm", rms_sig(n, d, x.dtype),
+        lambda rows: functools.partial(_rms_fwd_impl, epsilon=1e-6,
+                                       rows=rows),
+        cands, (x, w), iters=iters)
+
+
+def tune_rope(b: int, s: int, h: int, d: int, dtype="bfloat16",
+              iters: int = 3):
+    """Search the sequence-block size of the fused RoPE kernel."""
+    import jax.numpy as jnp
+
+    from .rope import _rope_call, rope_sig
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((b, s, h, d)), dtype)
+    cos = jnp.asarray(rng.standard_normal((1, s, 1, d // 2)), jnp.float32)
+    sin = jnp.asarray(rng.standard_normal((1, s, 1, d // 2)), jnp.float32)
+    cands = [bs for bs in _divisors_of(s, 1, 1, s)
+             if bs == s or bs % 8 == 0]
+    return tune_kernel(
+        "rope", rope_sig(b, s, h, d, x.dtype),
+        lambda bs: functools.partial(_rope_call, block_s=bs),
+        cands, (x, cos, sin), iters=iters)
+
+
+def tune_quantized_matmul(m: int, k: int, n: int, dtype="bfloat16",
+                          iters: int = 3):
+    """Search (block_m, block_n) of the int8 weight matmul."""
+    import jax.numpy as jnp
+
+    from .quantized_matmul import _qmm_impl, qmm_sig
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((m, k)), dtype)
+    qw = jnp.asarray(rng.integers(-127, 127, (k, n)), jnp.int8)
+    scales = jnp.asarray(rng.uniform(0.01, 0.02, (1, n)), jnp.float32)
+    bm_c = [bm for bm in (8, 64, 128, 256, 512) if bm <= m]
+    bn_c = [bn for bn in (128, 256, 512) if n % bn == 0]
+    cands = [(bm, bn) for bm in bm_c for bn in bn_c]
+    return tune_kernel(
+        "quantized_matmul", qmm_sig(m, k, n, x.dtype),
+        lambda bm, bn: functools.partial(_qmm_impl, out_dtype=x.dtype,
+                                         block_m=bm, block_n=bn),
+        cands, (x, qw, scales), iters=iters)
+
+
+def tune_fused_adamw(numel: int, dtype="bfloat16", iters: int = 3):
+    """Search the flat chunk size of the fused AdamW update."""
+    import jax.numpy as jnp
+
+    from .fused_optimizer import _adamw_call, adamw_sig
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(rng.standard_normal(numel), dtype)
+    g = jnp.asarray(rng.standard_normal(numel), dtype)
+    m = jnp.zeros((numel,), jnp.float32)
+    v = jnp.zeros((numel,), jnp.float32)
+    lr = jnp.asarray([[1e-3]], jnp.float32)
+    t = jnp.asarray([[1.0]], jnp.float32)
+    cands = [c for c in (1 << 15, 1 << 17, 1 << 19, 1 << 21, 0)
+             if c == 0 or c < numel]  # 0 = whole-array (no grid)
+    return tune_kernel(
+        "fused_adamw", adamw_sig(numel, p.dtype),
+        lambda chunk: functools.partial(_adamw_call, chunk=chunk),
+        cands, (p, g, m, v, lr, t), iters=iters)
+
+
+def tune_bench_shapes(iters: int = 3) -> Dict[str, Tuple]:
+    """Search every kernel at its bench.py / flagship-model shapes.
+    Returns {kernel/sig: (best, table)} for reporting."""
+    out = {}
+    # Llama 1.1B bench: hidden 2048, b8 s2048 -> rms rows over 16384 rows
+    out["rms_norm/16384x2048"] = tune_rms_norm(16384, 2048, iters=iters)
+    out["rope/8x2048x32x64"] = tune_rope(8, 2048, 32, 64, iters=iters)
+    out["quantized_matmul/2048x2048x8192"] = tune_quantized_matmul(
+        2048, 2048, 8192, iters=iters)
+    out["fused_adamw/4194304"] = tune_fused_adamw(1 << 22, iters=iters)
+    return out

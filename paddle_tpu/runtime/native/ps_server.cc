@@ -24,6 +24,15 @@
 //     op=7 PUSH_SPARSE                  payload: n u64 keys, n*dim floats
 //     op=8 SPARSE_SIZE                  payload: -     (total keys)
 //     op=9 SPARSE_MEM_ROWS              payload: -     (in-memory keys)
+//     op=10 CREATE_GRAPH  n=seed        payload: -
+//          (graph tables: adjacency lists served with neighbor sampling,
+//           reference common_graph_table.h:501)
+//     op=11 GRAPH_ADD_EDGES             payload: n u64 src | n u64 dst
+//     op=12 GRAPH_SAMPLE  dim=k         payload: n u64 nodes
+//          response: n*k u64 neighbors (with replacement; isolated nodes
+//          echo themselves — the self-loop convention)
+//     op=13 GRAPH_DEGREE                payload: n u64 nodes
+//          response: n u64 degrees
 //   response: i64 status_or_len | payload (floats / u64)
 
 #include "ptpu_runtime.h"
@@ -78,6 +87,16 @@ bool ps_recv_all(int fd, void* data, size_t len) {
 struct DenseTable {
   std::mutex mu;
   std::vector<float> data;
+};
+
+// Adjacency table with neighbor sampling (reference
+// common_graph_table.h:501 / heter_ps/graph_gpu_ps_table.h — the PS side
+// of GNN training: trainers pull sampled neighborhoods, features ride the
+// existing sparse tables / HBMEmbedding).
+struct GraphTable {
+  std::mutex mu;
+  std::unordered_map<uint64_t, std::vector<uint64_t>> adj;
+  std::mt19937_64 rng;
 };
 
 struct SparseTable {
@@ -206,6 +225,13 @@ struct PSServer {
   std::mutex tables_mu;
   std::map<int32_t, std::unique_ptr<DenseTable>> dense;
   std::map<int32_t, std::unique_ptr<SparseTable>> sparse;
+  std::map<int32_t, std::unique_ptr<GraphTable>> graph;
+
+  GraphTable* graph_table(int32_t id) {
+    std::lock_guard<std::mutex> l(tables_mu);
+    auto it = graph.find(id);
+    return it == graph.end() ? nullptr : it->second.get();
+  }
 
   DenseTable* dense_table(int32_t id) {
     std::lock_guard<std::mutex> l(tables_mu);
@@ -389,6 +415,81 @@ void ps_handle_conn(PSServer* s, int fd) {
         }
         std::lock_guard<std::mutex> l(t->mu);
         ps_reply_status(fd, (int64_t)t->rows.size());
+        break;
+      }
+      case 10: {  // CREATE_GRAPH (idempotent; n = rng seed)
+        std::lock_guard<std::mutex> l(s->tables_mu);
+        if (!s->graph.count(table)) {
+          auto t = std::make_unique<GraphTable>();
+          t->rng.seed(n ? n : 0x9e3779b97f4a7c15ull);
+          s->graph[table] = std::move(t);
+        }
+        ps_reply_status(fd, 0);
+        break;
+      }
+      case 11: {  // GRAPH_ADD_EDGES
+        std::vector<uint64_t> src(n), dst(n);
+        if (!ps_recv_all(fd, src.data(), n * 8) ||
+            !ps_recv_all(fd, dst.data(), n * 8))
+          return;
+        GraphTable* t = s->graph_table(table);
+        if (!t) {
+          ps_reply_status(fd, -2);
+          break;
+        }
+        std::lock_guard<std::mutex> l(t->mu);
+        for (uint64_t i = 0; i < n; ++i)
+          t->adj[src[i]].push_back(dst[i]);
+        ps_reply_status(fd, 0);
+        break;
+      }
+      case 12: {  // GRAPH_SAMPLE (dim = k neighbors per node)
+        std::vector<uint64_t> nodes(n);
+        if (!ps_recv_all(fd, nodes.data(), n * 8))
+          return;
+        GraphTable* t = s->graph_table(table);
+        if (!t) {
+          ps_reply_status(fd, -2);
+          break;
+        }
+        std::vector<uint64_t> out(n * dim);
+        {
+          std::lock_guard<std::mutex> l(t->mu);
+          for (uint64_t i = 0; i < n; ++i) {
+            auto it = t->adj.find(nodes[i]);
+            if (it == t->adj.end() || it->second.empty()) {
+              for (uint64_t j = 0; j < dim; ++j)
+                out[i * dim + j] = nodes[i];  // isolated: self-loop
+            } else {
+              const auto& nb = it->second;
+              for (uint64_t j = 0; j < dim; ++j)
+                out[i * dim + j] = nb[t->rng() % nb.size()];
+            }
+          }
+        }
+        ps_reply_status(fd, (int64_t)(out.size() * 8));
+        ps_send_all(fd, out.data(), out.size() * 8);
+        break;
+      }
+      case 13: {  // GRAPH_DEGREE
+        std::vector<uint64_t> nodes(n);
+        if (!ps_recv_all(fd, nodes.data(), n * 8))
+          return;
+        GraphTable* t = s->graph_table(table);
+        if (!t) {
+          ps_reply_status(fd, -2);
+          break;
+        }
+        std::vector<uint64_t> out(n);
+        {
+          std::lock_guard<std::mutex> l(t->mu);
+          for (uint64_t i = 0; i < n; ++i) {
+            auto it = t->adj.find(nodes[i]);
+            out[i] = it == t->adj.end() ? 0 : it->second.size();
+          }
+        }
+        ps_reply_status(fd, (int64_t)(out.size() * 8));
+        ps_send_all(fd, out.data(), out.size() * 8);
         break;
       }
       default:
@@ -625,6 +726,46 @@ int64_t ptpu_ps_sparse_size(int64_t c, int32_t table) {
   if (fd < 0) return -1;
   if (!ps_send_header(fd, 8, table, 0, 0, 0.0)) return -1;
   return ps_recv_status(fd);
+}
+
+int ptpu_ps_create_graph(int64_t c, int32_t table, uint64_t seed) {
+  int fd = ps_client_fd(c);
+  if (fd < 0) return PTPU_ERR;
+  if (!ps_send_header(fd, 10, table, seed, 0, 0.0)) return PTPU_ERR;
+  return ps_recv_status(fd) == 0 ? PTPU_OK : PTPU_ERR;
+}
+
+int ptpu_ps_graph_add_edges(int64_t c, int32_t table, const uint64_t* src,
+                            const uint64_t* dst, int64_t n) {
+  int fd = ps_client_fd(c);
+  if (fd < 0) return PTPU_ERR;
+  if (!ps_send_header(fd, 11, table, (uint64_t)n, 0, 0.0)) return PTPU_ERR;
+  if (!ps_send_all(fd, src, (size_t)n * 8)) return PTPU_ERR;
+  if (!ps_send_all(fd, dst, (size_t)n * 8)) return PTPU_ERR;
+  return ps_recv_status(fd) == 0 ? PTPU_OK : PTPU_ERR;
+}
+
+int ptpu_ps_graph_sample(int64_t c, int32_t table, const uint64_t* nodes,
+                         int64_t n, int64_t k, uint64_t* out) {
+  int fd = ps_client_fd(c);
+  if (fd < 0) return PTPU_ERR;
+  if (!ps_send_header(fd, 12, table, (uint64_t)n, (uint64_t)k, 0.0))
+    return PTPU_ERR;
+  if (!ps_send_all(fd, nodes, (size_t)n * 8)) return PTPU_ERR;
+  int64_t len = ps_recv_status(fd);
+  if (len != n * k * 8) return PTPU_ERR;
+  return ps_recv_all(fd, out, (size_t)len) ? PTPU_OK : PTPU_ERR;
+}
+
+int ptpu_ps_graph_degree(int64_t c, int32_t table, const uint64_t* nodes,
+                         int64_t n, uint64_t* out) {
+  int fd = ps_client_fd(c);
+  if (fd < 0) return PTPU_ERR;
+  if (!ps_send_header(fd, 13, table, (uint64_t)n, 0, 0.0)) return PTPU_ERR;
+  if (!ps_send_all(fd, nodes, (size_t)n * 8)) return PTPU_ERR;
+  int64_t len = ps_recv_status(fd);
+  if (len != n * 8) return PTPU_ERR;
+  return ps_recv_all(fd, out, (size_t)len) ? PTPU_OK : PTPU_ERR;
 }
 
 }  // extern "C"

@@ -116,6 +116,16 @@ int ptpu_ps_push_sparse(int64_t c, int32_t table, const uint64_t* keys,
                         double lr);
 int64_t ptpu_ps_sparse_size(int64_t c, int32_t table);  // #keys (total)
 int64_t ptpu_ps_sparse_mem_rows(int64_t c, int32_t table);  // in-memory
+// Graph tables (reference common_graph_table.h:501): adjacency lists
+// served with with-replacement neighbor sampling (isolated nodes echo
+// themselves) and degree queries.
+int ptpu_ps_create_graph(int64_t c, int32_t table, uint64_t seed);
+int ptpu_ps_graph_add_edges(int64_t c, int32_t table, const uint64_t* src,
+                            const uint64_t* dst, int64_t n);
+int ptpu_ps_graph_sample(int64_t c, int32_t table, const uint64_t* nodes,
+                         int64_t n, int64_t k, uint64_t* out /* n*k */);
+int ptpu_ps_graph_degree(int64_t c, int32_t table, const uint64_t* nodes,
+                         int64_t n, uint64_t* out /* n */);
 
 #if defined(__cplusplus)
 }  // extern "C"

@@ -428,6 +428,14 @@ _lib.ptpu_ps_sparse_size.restype = _i64
 _lib.ptpu_ps_sparse_size.argtypes = [_i64, _i32]
 _lib.ptpu_ps_sparse_mem_rows.restype = _i64
 _lib.ptpu_ps_sparse_mem_rows.argtypes = [_i64, _i32]
+_lib.ptpu_ps_create_graph.restype = _i32
+_lib.ptpu_ps_create_graph.argtypes = [_i64, _i32, _u64]
+_lib.ptpu_ps_graph_add_edges.restype = _i32
+_lib.ptpu_ps_graph_add_edges.argtypes = [_i64, _i32, _u64p, _u64p, _i64]
+_lib.ptpu_ps_graph_sample.restype = _i32
+_lib.ptpu_ps_graph_sample.argtypes = [_i64, _i32, _u64p, _i64, _i64, _u64p]
+_lib.ptpu_ps_graph_degree.restype = _i32
+_lib.ptpu_ps_graph_degree.argtypes = [_i64, _i32, _u64p, _i64, _u64p]
 
 
 class PSServerHandle:
@@ -566,3 +574,46 @@ class PSClientHandle:
         if n < 0:
             raise RuntimeError("parameter server: sparse_size failed")
         return n
+
+    # graph tables (reference common_graph_table.h:501) ----------------
+    def create_graph(self, table: int, seed: int = 0):
+        with self._lock:
+            self._check(_lib.ptpu_ps_create_graph(self._h, table, seed),
+                        "create_graph")
+
+    def graph_add_edges(self, table: int, src, dst):
+        import numpy as np
+        s = np.ascontiguousarray(src, np.uint64)
+        d = np.ascontiguousarray(dst, np.uint64)
+        if s.size != d.size:
+            raise ValueError("graph_add_edges: src/dst length mismatch")
+        with self._lock:
+            self._check(
+                _lib.ptpu_ps_graph_add_edges(
+                    self._h, table, s.ctypes.data_as(_u64p),
+                    d.ctypes.data_as(_u64p), s.size),
+                "graph_add_edges")
+
+    def graph_sample_neighbors(self, table: int, nodes, k: int):
+        import numpy as np
+        nd = np.ascontiguousarray(nodes, np.uint64)
+        out = np.empty((nd.size, k), np.uint64)
+        with self._lock:
+            self._check(
+                _lib.ptpu_ps_graph_sample(
+                    self._h, table, nd.ctypes.data_as(_u64p), nd.size, k,
+                    out.ctypes.data_as(_u64p)),
+                "graph_sample_neighbors")
+        return out
+
+    def graph_degree(self, table: int, nodes):
+        import numpy as np
+        nd = np.ascontiguousarray(nodes, np.uint64)
+        out = np.empty(nd.size, np.uint64)
+        with self._lock:
+            self._check(
+                _lib.ptpu_ps_graph_degree(
+                    self._h, table, nd.ctypes.data_as(_u64p), nd.size,
+                    out.ctypes.data_as(_u64p)),
+                "graph_degree")
+        return out

@@ -121,9 +121,22 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
     if dtype is not None:
         from ..core.dtypes import convert_dtype
         vals = vals.astype(convert_dtype(dtype))
-    bcsr = jsparse.BCSR((vals, _as_array(cols).astype(jnp.int32),
-                         _as_array(crows).astype(jnp.int32)),
-                        shape=tuple(shape))
+    crows_a = _as_array(crows).astype(jnp.int32)
+    cols_a = _as_array(cols).astype(jnp.int32)
+    if len(shape) == 3 and crows_a.ndim == 1:
+        # paddle convention: batched CSR arrives flattened
+        # (crows [b*(rows+1)], cols/values [total_nnz]); jax BCSR wants
+        # batch-shaped components with UNIFORM per-batch nnz
+        b, rows = int(shape[0]), int(shape[1])
+        crows_a = crows_a.reshape(b, rows + 1)
+        per = np.asarray(crows_a[:, -1])
+        if not (per == per[0]).all():
+            raise ValueError(
+                "batched CSR needs a uniform nnz per batch on TPU "
+                "(jax BCSR layout); pad rows or use COO")
+        cols_a = cols_a.reshape(b, -1)
+        vals = vals.reshape(b, -1)
+    bcsr = jsparse.BCSR((vals, cols_a, crows_a), shape=tuple(shape))
     return SparseCsrTensor(bcsr)
 
 
@@ -182,12 +195,4 @@ def relu(x, name=None):
     return Tensor(jnp.maximum(_as_array(x), 0))
 
 
-class _SparseNN:
-    """paddle.sparse.nn facade (ReLU module)."""
-
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
-
-
-nn = _SparseNN()
+from . import nn  # noqa: E402,F401  (full sparse.nn layer tree)

@@ -1,0 +1,136 @@
+"""paddle.sparse.nn layer tree (analogue of
+``python/paddle/sparse/nn/layer/``: conv.py Conv3D:239/SubmConv3D:509/
+Conv2D:374/SubmConv2D:649, norm.py BatchNorm:24/SyncBatchNorm:207,
+pooling.py MaxPool3D:20, activation.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layer.layers import Layer
+from . import functional  # noqa: F401
+from . import functional as F
+
+__all__ = ["Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D", "BatchNorm",
+           "SyncBatchNorm", "MaxPool3D", "ReLU", "ReLU6", "LeakyReLU",
+           "Softmax"]
+
+
+class _SparseConvNd(Layer):
+    _subm = False
+    _ndim = 3
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None,
+                 key=None):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * self._ndim
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        from ...nn.initializer import XavierUniform
+        self.weight = self.create_parameter(
+            (*self.kernel_size, in_channels, out_channels),
+            attr=weight_attr, default_initializer=XavierUniform())
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter((out_channels,),
+                                              attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        fn = {(2, False): F.conv2d, (2, True): F.subm_conv2d,
+              (3, False): F.conv3d, (3, True): F.subm_conv3d}[
+                  (self._ndim, self._subm)]
+        return fn(x, self.weight, self.bias, self.stride, self.padding)
+
+
+class Conv3D(_SparseConvNd):
+    _ndim, _subm = 3, False
+
+
+class SubmConv3D(_SparseConvNd):
+    _ndim, _subm = 3, True
+
+
+class Conv2D(_SparseConvNd):
+    _ndim, _subm = 2, False
+
+
+class SubmConv2D(_SparseConvNd):
+    _ndim, _subm = 2, True
+
+
+class BatchNorm(Layer):
+    """Batch norm over the stored values' channel dim (reference sparse
+    BatchNorm subclasses dense BatchNorm1D on values — statistics run
+    over ACTIVE sites only, by design)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr,
+                               use_global_stats=use_global_stats)
+
+    def forward(self, x):
+        import jax.experimental.sparse as jsparse
+
+        from .. import SparseCooTensor
+        from ...core.tensor import Tensor
+        vals = self._bn(Tensor(x._bcoo.data))
+        return SparseCooTensor(jsparse.BCOO(
+            (vals._value.astype(x._bcoo.data.dtype), x._bcoo.indices),
+            shape=x._bcoo.shape))
+
+
+class SyncBatchNorm(BatchNorm):
+    """On TPU, batch-norm stats inside pjit already reduce across the data
+    axis (GSPMD inserts the cross-replica psum) — SyncBatchNorm is the
+    default semantics, so this is BatchNorm (reference norm.py:207)."""
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)

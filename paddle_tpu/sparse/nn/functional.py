@@ -1,0 +1,370 @@
+"""Sparse-NN functionals over BCOO (analogue of
+``python/paddle/sparse/nn/functional/``: conv.py:207/313/425/529,
+pooling.py:22, transformer.py:22, activation.py).
+
+TPU-native formulation: sparse convolutions use a host-built RULEBOOK
+(the same structure the reference's GPU kernels build on device,
+``paddle/phi/kernels/sparse/gpu/conv_kernel.cu``) — for each kernel
+offset, the (input-site -> output-site) pairs are gathered once on the
+host from the COO coordinates, and the COMPUTE is a batched
+gather + [n_k, Cin] @ [Cin, Cout] matmul + scatter-add per offset, which
+rides the MXU.  Coordinates are data-dependent, so these ops are
+EAGER-ONLY (like every dynamic-output-shape op in this framework); the
+dense-masked attention path is fully traceable.
+
+Layout follows the reference: activations are channels-LAST
+(``[N, D, H, W, C]`` dense shape, indices over the leading dims), conv
+weights are ``[*kernel, C_in, C_out]``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from .. import SparseCooTensor, sparse_coo_tensor
+
+__all__ = ["conv2d", "conv3d", "subm_conv2d", "subm_conv3d", "max_pool3d",
+           "relu", "relu6", "leaky_relu", "softmax", "attention"]
+
+
+def _norm_seq(v, n):
+    if isinstance(v, (list, tuple)):
+        out = [int(i) for i in v]
+        return out * n if len(out) == 1 else out
+    return [int(v)] * n
+
+
+def _coords_values(x: SparseCooTensor):
+    """Host coordinates [nnz, k] + device values [nnz, C]."""
+    bcoo = x._bcoo
+    coords = np.asarray(bcoo.indices)
+    vals = bcoo.data
+    if vals.ndim == 1:
+        raise ValueError(
+            "sparse nn ops expect the channels-dense COO layout: indices "
+            "over [N, *spatial], values [nnz, C] (build via "
+            "sparse_coo_tensor with [1+spatial, nnz] indices and 2-D "
+            "values)")
+    return coords, vals
+
+
+def _assert_eager(coords, name):
+    if not isinstance(coords, np.ndarray):
+        raise NotImplementedError(
+            f"sparse {name} builds its rulebook from concrete coordinates "
+            "and cannot run under jit/trace (reference GPU rulebook "
+            "construction is likewise data-dependent)")
+
+
+def _require_defaults(name, dilation, groups):
+    if _norm_seq(dilation, 3)[0] != 1 or any(
+            d != 1 for d in _norm_seq(dilation, 3)):
+        raise NotImplementedError(f"sparse {name}: dilation != 1 is not "
+                                  "implemented")
+    if groups != 1:
+        raise NotImplementedError(f"sparse {name}: groups != 1 is not "
+                                  "implemented")
+
+
+def _rulebook_conv(x: SparseCooTensor, weight, bias, stride, padding,
+                   subm: bool, name: str):
+    """Shared sparse-conv engine.  x dense shape [N, *spatial, Cin];
+    weight [*kernel, Cin, Cout]."""
+    n_sp = weight.ndim - 2
+    kernel = weight.shape[:n_sp]
+    stride = _norm_seq(stride, n_sp)
+    padding = _norm_seq(padding, n_sp)
+    if subm and any(s != 1 for s in stride):
+        raise ValueError(f"{name}: submanifold conv requires stride 1")
+
+    coords, vals = _coords_values(x)
+    _assert_eager(coords, name)
+    dense_shape = x.shape
+    spatial = dense_shape[1:1 + n_sp]
+    cout = weight.shape[-1]
+
+    if subm:
+        out_spatial = list(spatial)
+        out_coords = coords
+    else:
+        out_spatial = [
+            (spatial[i] + 2 * padding[i] - kernel[i]) // stride[i] + 1
+            for i in range(n_sp)]
+
+    def keys_of(c_arr, sp):
+        # batch-major mixed radix site key
+        key = c_arr[:, 0].astype(np.int64)
+        for i in range(n_sp):
+            key = key * sp[i] + c_arr[:, 1 + i].astype(np.int64)
+        return key
+
+    # ONE pass builds the rulebook: for each kernel offset, the
+    # (input row, output site key) pairs that contribute through it
+    offsets = list(np.ndindex(*kernel))
+    in_sp = coords[:, 1:1 + n_sp].astype(np.int64)
+    batch = coords[:, 0].astype(np.int64)
+    rule = []  # per offset: (src_rows, out_keys) or None
+    for off in offsets:
+        oc = in_sp + np.asarray(padding) - np.asarray(off)
+        ok = np.ones(len(coords), bool)
+        for i in range(n_sp):
+            ok &= (oc[:, i] % stride[i] == 0)
+        oc2 = oc // np.asarray(stride)
+        for i in range(n_sp):
+            ok &= (oc2[:, i] >= 0) & (oc2[:, i] < out_spatial[i])
+        if not ok.any():
+            rule.append(None)
+            continue
+        okey = batch[ok]
+        for i in range(n_sp):
+            okey = okey * out_spatial[i] + oc2[ok, i]
+        rule.append((np.nonzero(ok)[0], okey))
+
+    if subm:
+        out_keys = keys_of(coords, spatial)
+        out_index = {k: i for i, k in enumerate(out_keys.tolist())}
+        n_out = coords.shape[0]
+    else:
+        # output sites = union of keys the rulebook reaches
+        all_keys = np.unique(np.concatenate(
+            [r[1] for r in rule if r is not None] or
+            [np.zeros(0, np.int64)]))
+        out_index = {int(k): i for i, k in enumerate(all_keys)}
+        n_out = len(all_keys)
+        # decode keys back to coordinates (batch-major mixed radix)
+        out_coords = np.zeros((n_out, n_sp + 1), np.int64)
+        rem = all_keys.copy()
+        for i in range(n_sp - 1, -1, -1):
+            out_coords[:, 1 + i] = rem % out_spatial[i]
+            rem = rem // out_spatial[i]
+        out_coords[:, 0] = rem
+
+    out_vals = jnp.zeros((max(n_out, 1), cout),
+                         jnp.result_type(vals.dtype, weight.dtype))
+    w = weight.reshape((-1,) + weight.shape[n_sp:])
+    for oi, r in enumerate(rule):
+        if r is None:
+            continue
+        src, okeys = r
+        tgt = np.asarray([out_index.get(int(k), -1) for k in okeys])
+        sel = tgt >= 0
+        if not sel.any():
+            continue
+        contrib = vals[jnp.asarray(src[sel])] @ w[oi]
+        out_vals = out_vals.at[jnp.asarray(tgt[sel])].add(
+            contrib.astype(out_vals.dtype))
+    if bias is not None:
+        b = bias._value if isinstance(bias, Tensor) else jnp.asarray(bias)
+        out_vals = out_vals + b
+    out_shape = (dense_shape[0], *out_spatial, cout)
+    return sparse_coo_tensor(
+        np.ascontiguousarray(out_coords.T), out_vals[:n_out],
+        shape=out_shape)
+
+
+def _weight_arr(weight):
+    return weight._value if isinstance(weight, Tensor) else \
+        jnp.asarray(weight)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse conv3d (reference sparse/nn/functional/conv.py:207)."""
+    _require_defaults("conv3d", dilation, groups)
+    return _rulebook_conv(x, _weight_arr(weight), bias, stride, padding,
+                          subm=False, name="conv3d")
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold sparse conv3d: output sites == input sites
+    (reference sparse/nn/functional/conv.py:313)."""
+    _require_defaults("subm_conv3d", dilation, groups)
+    return _rulebook_conv(x, _weight_arr(weight), bias, stride, padding,
+                          subm=True, name="subm_conv3d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    _require_defaults("conv2d", dilation, groups)
+    return _rulebook_conv(x, _weight_arr(weight), bias, stride, padding,
+                          subm=False, name="conv2d")
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    _require_defaults("subm_conv2d", dilation, groups)
+    return _rulebook_conv(x, _weight_arr(weight), bias, stride, padding,
+                          subm=True, name="subm_conv2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """Sparse max pooling over active sites (reference
+    sparse/nn/functional/pooling.py:22)."""
+    kernel = _norm_seq(kernel_size, 3)
+    stride = _norm_seq(stride if stride is not None else kernel_size, 3)
+    padding = _norm_seq(padding, 3)
+    coords, vals = _coords_values(x)
+    _assert_eager(coords, "max_pool3d")
+    dense_shape = x.shape
+    spatial = dense_shape[1:4]
+    out_spatial = [
+        (spatial[i] + 2 * padding[i] - kernel[i]) // stride[i] + 1
+        for i in range(3)]
+
+    # each active input site maps into every window that covers it;
+    # reductions run as ONE segment_max over all (src, window) pairs
+    in_sp = coords[:, 1:4].astype(np.int64)
+    batch = coords[:, 0].astype(np.int64)
+    srcs, okeys = [], []
+    for off in np.ndindex(*kernel):
+        oc = in_sp + np.asarray(padding) - np.asarray(off)
+        ok = np.ones(len(coords), bool)
+        for i in range(3):
+            ok &= (oc[:, i] % stride[i] == 0)
+        oc2 = oc // np.asarray(stride)
+        for i in range(3):
+            ok &= (oc2[:, i] >= 0) & (oc2[:, i] < out_spatial[i])
+        if not ok.any():
+            continue
+        key = batch[ok]
+        for i in range(3):
+            key = key * out_spatial[i] + oc2[ok, i]
+        srcs.append(np.nonzero(ok)[0])
+        okeys.append(key)
+    if not srcs:
+        out_coords = np.zeros((4, 0), np.int64)
+        out_vals = jnp.zeros((0, dense_shape[-1]), vals.dtype)
+    else:
+        src = np.concatenate(srcs)
+        key = np.concatenate(okeys)
+        uniq, seg = np.unique(key, return_inverse=True)
+        out_vals = jax.ops.segment_max(vals[jnp.asarray(src)],
+                                       jnp.asarray(seg),
+                                       num_segments=len(uniq))
+        out_coords = np.zeros((len(uniq), 4), np.int64)
+        rem = uniq.copy()
+        for i in range(2, -1, -1):
+            out_coords[:, 1 + i] = rem % out_spatial[i]
+            rem = rem // out_spatial[i]
+        out_coords[:, 0] = rem
+        out_coords = out_coords.T
+    return sparse_coo_tensor(
+        out_coords, out_vals,
+        shape=(dense_shape[0], *out_spatial, dense_shape[-1]))
+
+
+def relu(x, name=None):
+    from .. import relu as _relu
+    return _relu(x)
+
+
+def relu6(x, name=None):
+    b = x._bcoo
+    import jax.experimental.sparse as jsparse
+    return SparseCooTensor(jsparse.BCOO(
+        (jnp.clip(b.data, 0, 6), b.indices), shape=b.shape))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    b = x._bcoo
+    import jax.experimental.sparse as jsparse
+    return SparseCooTensor(jsparse.BCOO(
+        (jnp.where(b.data > 0, b.data, negative_slope * b.data),
+         b.indices), shape=b.shape))
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over the stored values (reference sparse softmax
+    semantics: normalize over the nonzeros of each row of the last two
+    dense dims)."""
+    from .. import SparseCsrTensor
+    if isinstance(x, SparseCsrTensor):
+        crows = np.asarray(x.crows()._value)
+        vals = x.values()._value
+        if crows.ndim == 2:  # batched [B, S, S] CSR
+            b = crows.shape[0]
+            segs = np.diff(crows, axis=1)          # [B, S]
+            flat_segs = segs.reshape(-1)
+            row_ids = np.repeat(np.arange(flat_segs.size), flat_segs)
+            flat_vals = vals.reshape(-1)
+            r = jnp.asarray(row_ids)
+            n_rows = flat_segs.size
+            mx = jax.ops.segment_max(flat_vals, r, num_segments=n_rows)
+            e = jnp.exp(flat_vals - mx[r])
+            den = jax.ops.segment_sum(e, r, num_segments=n_rows)
+            out_vals = (e / den[r]).reshape(vals.shape)
+            from .. import sparse_csr_tensor
+            return sparse_csr_tensor(crows.reshape(-1),
+                                     np.asarray(x.cols()._value).reshape(-1),
+                                     out_vals.reshape(-1), x.shape)
+        segs = np.diff(crows)
+        row_ids = np.repeat(np.arange(len(segs)), segs)
+        r = jnp.asarray(row_ids)
+        mx = jax.ops.segment_max(vals, r, num_segments=len(segs))
+        e = jnp.exp(vals - mx[r])
+        den = jax.ops.segment_sum(e, r, num_segments=len(segs))
+        out_vals = e / den[r]
+        from .. import sparse_csr_tensor
+        return sparse_csr_tensor(crows, x.cols()._value, out_vals, x.shape)
+    coords, vals = _coords_values(x)
+    _assert_eager(coords, "softmax")
+    # group by all but the last sparse dim
+    keys = [tuple(map(int, row[:-1])) for row in coords]
+    uniq = {k: i for i, k in enumerate(dict.fromkeys(keys))}
+    row_ids = np.asarray([uniq[k] for k in keys])
+    r = jnp.asarray(row_ids)
+    n_rows = len(uniq)
+    mx = jax.ops.segment_max(vals, r, num_segments=n_rows)
+    e = jnp.exp(vals - mx[r])
+    den = jax.ops.segment_sum(e, r, num_segments=n_rows)
+    out_vals = e / den[r]
+    import jax.experimental.sparse as jsparse
+    return SparseCooTensor(jsparse.BCOO((out_vals, x._bcoo.indices),
+                                        shape=x._bcoo.shape))
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse attention (reference sparse/nn/functional/transformer.py:22):
+    scores are computed only where ``sparse_mask`` (CSR, [B*H, S, S]) has
+    entries.  TPU-native: dense-masked QK^T — the mask pattern becomes an
+    additive -inf mask, softmax/AV run dense (the fast path on MXU);
+    results match the reference's sparse kernel at the stored positions.
+    """
+    q = query._value if isinstance(query, Tensor) else jnp.asarray(query)
+    k = key._value if isinstance(key, Tensor) else jnp.asarray(key)
+    v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+    b, h, s, d = q.shape
+    crows = np.asarray(sparse_mask.crows()._value).reshape(b * h, s + 1)
+    cols = np.asarray(sparse_mask.cols()._value).reshape(b * h, -1)
+    mask = np.zeros((b * h, s, s), bool)
+    per = crows[:, -1]
+    for i in range(b * h):
+        my_cols = cols[i, :per[i]]
+        rows = np.repeat(np.arange(s), np.diff(crows[i]))
+        mask[i, rows, my_cols] = True
+    mask = jnp.asarray(mask.reshape(b, h, s, s))
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k) / math.sqrt(d)
+    neg = jnp.asarray(-1e30, scores.dtype)
+    scores = jnp.where(mask, scores, neg)
+    if key_padding_mask is not None:
+        kp = key_padding_mask._value if isinstance(key_padding_mask,
+                                                   Tensor) else \
+            jnp.asarray(key_padding_mask)
+        scores = scores + kp[:, None, None, :].astype(scores.dtype)
+    if attn_mask is not None:
+        am = attn_mask._value if isinstance(attn_mask, Tensor) else \
+            jnp.asarray(attn_mask)
+        scores = scores + am.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(mask, probs, 0.0)  # fully-masked rows -> zeros
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, v)
+    return Tensor(out)

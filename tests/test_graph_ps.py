@@ -1,0 +1,111 @@
+"""Graph PS tables + neighbor sampling (VERDICT r2 item 8; reference
+``paddle/fluid/distributed/ps/table/common_graph_table.h:501`` and the GPU
+graph table ``heter_ps/graph_gpu_ps_table.h``): adjacency served by the
+native PS with with-replacement sampling, driving a small GraphSAGE-style
+model end to end."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+from paddle_tpu.distributed.ps import PSClient, PSServer
+
+
+@pytest.fixture()
+def ps():
+    server = PSServer(0)
+    client = PSClient("127.0.0.1", server.port)
+    yield client
+    server.stop()
+
+
+def test_graph_table_sample_and_degree(ps):
+    ps.create_graph_table(0, seed=7)
+    src = [0, 0, 1, 2, 2, 2]
+    dst = [1, 2, 0, 0, 1, 3]
+    ps.add_graph_edges(0, src, dst)
+    deg = ps.node_degree(0, [0, 1, 2, 3, 9])
+    assert list(deg) == [2, 1, 3, 0, 0]
+    nb = ps.sample_neighbors(0, [0, 1, 2], 8)
+    assert nb.shape == (3, 8)
+    assert set(nb[0]) <= {1, 2}
+    assert set(nb[1]) == {0}
+    assert set(nb[2]) <= {0, 1, 3}
+    # isolated / unknown nodes echo themselves
+    nb_iso = ps.sample_neighbors(0, [3, 42], 4)
+    assert set(nb_iso[0]) == {3}
+    assert set(nb_iso[1]) == {42}
+
+
+def test_graph_sampling_distribution(ps):
+    ps.create_graph_table(1, seed=3)
+    # node 0 has neighbors 1 and 2; with replacement both should appear
+    ps.add_graph_edges(1, [0] * 2, [1, 2])
+    nb = ps.sample_neighbors(1, [0], 64)
+    assert {1, 2} == set(nb[0])
+
+
+def test_graphsage_two_communities_trains(ps):
+    """GraphSAGE-style training loop: sample neighbors from the PS graph
+    table, aggregate mean neighbor features, classify the community.
+    Mirrors the reference's PGL+graph-PS training split: structure on the
+    PS, features/model on the trainer."""
+    rng = np.random.default_rng(0)
+    n_per, d = 16, 8
+    n = 2 * n_per
+    # two dense communities with sparse cross links
+    src, dst = [], []
+    for c in (0, 1):
+        base = c * n_per
+        for i in range(n_per):
+            for j in rng.choice(n_per, 4, replace=False):
+                if i != j:
+                    src.append(base + i)
+                    dst.append(base + int(j))
+    src += [0, n_per]
+    dst += [n_per, 0]
+    ps.create_graph_table(2, seed=11)
+    ps.add_graph_edges(2, src, dst)
+    ps.add_graph_edges(2, dst, src)  # undirected
+
+    # node features: community-correlated + noise
+    feats = rng.standard_normal((n, d)).astype(np.float32) * 0.5
+    feats[:n_per, 0] += 1.0
+    feats[n_per:, 0] -= 1.0
+    labels = np.asarray([0] * n_per + [1] * n_per, np.int64)
+
+    paddle.seed(0)
+    w_self = nn.Linear(d, 16)
+    w_neigh = nn.Linear(d, 16)
+    head = nn.Linear(16, 2)
+    params = (list(w_self.parameters()) + list(w_neigh.parameters()) +
+              list(head.parameters()))
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=params)
+
+    k = 6
+    losses = []
+    for step in range(30):
+        batch = rng.choice(n, 16, replace=False)
+        nb = ps.sample_neighbors(2, batch, k)          # [16, k] from PS
+        x_self = paddle.to_tensor(feats[batch])
+        x_neigh = paddle.to_tensor(
+            feats[nb.astype(np.int64)].mean(axis=1))   # mean aggregator
+        h = F.relu(w_self(x_self) + w_neigh(x_neigh))
+        logits = head(h)
+        y = paddle.to_tensor(labels[batch])
+        loss = F.cross_entropy(logits, y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss._value))
+
+    assert losses[-1] < losses[0] * 0.5, losses
+    # final accuracy on all nodes
+    nb = ps.sample_neighbors(2, np.arange(n), k)
+    h = F.relu(w_self(paddle.to_tensor(feats)) +
+               w_neigh(paddle.to_tensor(
+                   feats[nb.astype(np.int64)].mean(axis=1))))
+    pred = np.asarray(head(h)._value).argmax(-1)
+    assert (pred == labels).mean() >= 0.9

@@ -1,0 +1,225 @@
+"""Sparse nn (VERDICT r2 item 7; reference python/paddle/sparse/nn/):
+submanifold + standard sparse conv, sparse BN/pooling/activations, sparse
+attention — each checked against a dense-masked reference."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _random_sparse(shape_sp, c, density=0.3, seed=0):
+    """Channels-dense COO: dense shape (*shape_sp, c)."""
+    rng = np.random.default_rng(seed)
+    mask = rng.random(shape_sp) < density
+    coords = np.argwhere(mask)  # [nnz, len(shape_sp)]
+    vals = rng.standard_normal((len(coords), c)).astype(np.float32)
+    st = sparse.sparse_coo_tensor(coords.T, vals,
+                                  shape=(*shape_sp, c))
+    dense = np.zeros((*shape_sp, c), np.float32)
+    dense[tuple(coords.T)] = vals
+    return st, dense, mask
+
+
+def _dense_conv3d(x_ndhwc, w, stride, padding):
+    dn = lax.conv_dimension_numbers(x_ndhwc.shape, w.shape,
+                                    ("NDHWC", "DHWIO", "NDHWC"))
+    return lax.conv_general_dilated(
+        jnp.asarray(x_ndhwc), jnp.asarray(w),
+        window_strides=(stride,) * 3,
+        padding=[(padding, padding)] * 3, dimension_numbers=dn)
+
+
+def test_subm_conv3d_matches_masked_dense():
+    st, dense, mask = _random_sparse((2, 5, 5, 5), 4)
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((3, 3, 3, 4, 6)).astype(np.float32) * 0.2
+    out = sparse.nn.functional.subm_conv3d(st, paddle.to_tensor(w),
+                                           padding=1)
+    # submanifold: out sites == in sites; values equal the dense conv at
+    # those sites (inactive inputs contribute zero either way)
+    ref = np.asarray(_dense_conv3d(dense, w, 1, 1))
+    got = np.asarray(out.to_dense()._value)
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(got[mask], ref[mask], rtol=1e-4, atol=1e-5)
+    # and zero where inactive
+    assert np.abs(got[~mask]).max() == 0.0
+
+
+def test_conv3d_matches_dense():
+    st, dense, mask = _random_sparse((1, 6, 6, 6), 3, density=0.2, seed=2)
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((3, 3, 3, 3, 5)).astype(np.float32) * 0.2
+    out = sparse.nn.functional.conv3d(st, paddle.to_tensor(w), stride=1,
+                                      padding=0)
+    ref = np.asarray(_dense_conv3d(dense, w, 1, 0))
+    got = np.asarray(out.to_dense()._value)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv3d_stride2_and_bias():
+    st, dense, mask = _random_sparse((1, 6, 6, 6), 3, density=0.25, seed=4)
+    rng = np.random.default_rng(5)
+    w = rng.standard_normal((2, 2, 2, 3, 4)).astype(np.float32) * 0.3
+    b = rng.standard_normal((4,)).astype(np.float32)
+    out = sparse.nn.functional.conv3d(st, paddle.to_tensor(w),
+                                      bias=paddle.to_tensor(b), stride=2)
+    ref = np.asarray(_dense_conv3d(dense, w, 2, 0))
+    got = np.asarray(out.to_dense()._value)
+    # bias applies at ACTIVE output sites only (reference sparse semantics)
+    active = np.abs(got).sum(axis=-1) > 0
+    np.testing.assert_allclose(got[active], (ref + b)[active],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_subm_conv2d_layer():
+    paddle.seed(0)
+    layer = sparse.nn.SubmConv2D(3, 8, kernel_size=3, padding=1)
+    st, dense, mask = _random_sparse((2, 7, 7), 3, seed=6)
+    out = layer(st)
+    assert tuple(out.shape) == (2, 7, 7, 8)
+    w = np.asarray(layer.weight._value)
+    b = np.asarray(layer.bias._value)
+    dn = lax.conv_dimension_numbers((2, 7, 7, 3), w.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    ref = np.asarray(lax.conv_general_dilated(
+        jnp.asarray(dense), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=dn)) + b
+    got = np.asarray(out.to_dense()._value)
+    np.testing.assert_allclose(got[mask], ref[mask], rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_max_pool3d():
+    st, dense, mask = _random_sparse((1, 4, 4, 4), 2, density=0.5, seed=7)
+    out = sparse.nn.functional.max_pool3d(st, 2, stride=2)
+    got = np.asarray(out.to_dense()._value)
+    # dense reference pooling over ACTIVE values only: -inf at inactive
+    neg = np.where(mask[..., None], dense, -np.inf)
+    ref = neg.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(2, 4, 6))
+    ref_t = np.transpose(ref, (0, 1, 2, 3, 4))
+    active_out = np.isfinite(ref_t).all(axis=-1) & (
+        mask.reshape(1, 2, 2, 2, 2, 2, 2).any(axis=(2, 4, 6)))
+    np.testing.assert_allclose(got[active_out], ref_t[active_out],
+                               rtol=1e-5)
+
+
+def test_sparse_batchnorm_stats_over_active_sites():
+    paddle.seed(0)
+    st, dense, mask = _random_sparse((2, 5, 5, 5), 4, seed=8)
+    bn = sparse.nn.BatchNorm(4)
+    bn.train()
+    out = bn(st)
+    vals = np.asarray(st.values()._value)
+    ref = (vals - vals.mean(0)) / np.sqrt(vals.var(0) + 1e-5)
+    np.testing.assert_allclose(np.asarray(out.values()._value), ref,
+                               rtol=1e-3, atol=1e-4)
+    # sync variant shares the semantics
+    assert isinstance(sparse.nn.SyncBatchNorm(4), sparse.nn.BatchNorm)
+
+
+def test_sparse_activations():
+    st, dense, mask = _random_sparse((1, 4, 4), 3, seed=9)
+    r = sparse.nn.ReLU()(st)
+    np.testing.assert_allclose(np.asarray(r.values()._value),
+                               np.maximum(np.asarray(st.values()._value),
+                                          0))
+    l = sparse.nn.LeakyReLU(0.1)(st)
+    v = np.asarray(st.values()._value)
+    np.testing.assert_allclose(np.asarray(l.values()._value),
+                               np.where(v > 0, v, 0.1 * v), rtol=1e-6)
+    r6 = sparse.nn.ReLU6()(st)
+    np.testing.assert_allclose(np.asarray(r6.values()._value),
+                               np.clip(v, 0, 6))
+
+
+def test_sparse_softmax_csr():
+    rng = np.random.default_rng(10)
+    # 3x4 CSR with irregular rows
+    crows = np.asarray([0, 2, 2, 5])
+    cols = np.asarray([0, 3, 1, 2, 3])
+    vals = rng.standard_normal(5).astype(np.float32)
+    csr = sparse.sparse_csr_tensor(crows, cols, vals, (3, 4))
+    out = sparse.nn.Softmax()(csr)
+    ov = np.asarray(out.values()._value)
+    r0 = np.exp(vals[:2] - vals[:2].max())
+    np.testing.assert_allclose(ov[:2], r0 / r0.sum(), rtol=1e-5)
+    r2 = np.exp(vals[2:] - vals[2:].max())
+    np.testing.assert_allclose(ov[2:], r2 / r2.sum(), rtol=1e-5)
+
+
+def test_sparse_attention_matches_masked_dense():
+    rng = np.random.default_rng(11)
+    b, h, s, d = 1, 2, 8, 4
+    q = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    k = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    v = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    # banded sparse mask (same pattern per head)
+    mask = np.zeros((s, s), bool)
+    for i in range(s):
+        for j in range(max(0, i - 2), min(s, i + 1)):
+            mask[i, j] = True
+    crows_one = np.concatenate([[0], np.cumsum(mask.sum(1))])
+    cols_one = np.concatenate([np.nonzero(mask[i])[0] for i in range(s)])
+    crows = np.concatenate([crows_one for _ in range(b * h)])
+    cols = np.concatenate([cols_one for _ in range(b * h)])
+    sp = sparse.sparse_csr_tensor(
+        crows, cols, np.ones(len(cols) * 1, np.float32).repeat(1),
+        (b * h, s, s))
+    out = sparse.nn.functional.attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v), sp)
+    scores = np.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(d)
+    scores = np.where(mask, scores, -1e30)
+    probs = np.exp(scores - scores.max(-1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+    ref = np.einsum("bhst,bhtd->bhsd", probs, v)
+    np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_sparse_conv_chain_trains_shapes():
+    """A small submanifold network end-to-end (layer composition)."""
+    paddle.seed(1)
+    net_in, _, _ = _random_sparse((2, 6, 6, 6), 3, seed=12)
+    c1 = sparse.nn.SubmConv3D(3, 8, 3, padding=1)
+    bn = sparse.nn.BatchNorm(8)
+    act = sparse.nn.ReLU()
+    pool = sparse.nn.MaxPool3D(2, stride=2)
+    h = pool(act(bn(c1(net_in))))
+    assert tuple(h.shape) == (2, 3, 3, 3, 8)
+    assert h.nnz() > 0
+
+
+def test_sparse_conv_rejects_dilation_groups():
+    st, _, _ = _random_sparse((1, 4, 4, 4), 2, seed=13)
+    w = paddle.to_tensor(np.zeros((3, 3, 3, 2, 2), np.float32))
+    with pytest.raises(NotImplementedError):
+        sparse.nn.functional.conv3d(st, w, dilation=2)
+    with pytest.raises(NotImplementedError):
+        sparse.nn.functional.subm_conv3d(st, w, groups=2)
+
+
+def test_sparse_softmax_batched_csr():
+    rng = np.random.default_rng(14)
+    s = 4
+    mask = np.tril(np.ones((s, s), bool))
+    crows_one = np.concatenate([[0], np.cumsum(mask.sum(1))])
+    cols_one = np.concatenate([np.nonzero(mask[i])[0] for i in range(s)])
+    b = 2
+    crows = np.concatenate([crows_one] * b)
+    cols = np.concatenate([cols_one] * b)
+    vals = rng.standard_normal(b * len(cols_one)).astype(np.float32)
+    csr = sparse.sparse_csr_tensor(crows, cols, vals, (b, s, s))
+    out = sparse.nn.Softmax()(csr)
+    ov = np.asarray(out.values()._value).reshape(b, -1)
+    vv = vals.reshape(b, -1)
+    ptr = crows_one
+    for bi in range(b):
+        for r in range(s):
+            seg = vv[bi, ptr[r]:ptr[r + 1]]
+            e = np.exp(seg - seg.max())
+            np.testing.assert_allclose(ov[bi, ptr[r]:ptr[r + 1]],
+                                       e / e.sum(), rtol=1e-5)

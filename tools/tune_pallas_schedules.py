@@ -1,0 +1,32 @@
+"""Offline Pallas schedule search over the bench/flagship shapes.
+
+Run ON THE CHIP (plain `python tools/tune_pallas_schedules.py`); winners
+persist to the autotune cache keyed kernel/shape/dtype/chip and are picked
+up by the kernels at trace time.  Prints the searched-vs-default table for
+BASELINE.md.
+"""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from paddle_tpu.ops.pallas.schedule_search import (chip_kind,
+                                                       tune_bench_shapes)
+    print(f"chip: {chip_kind()}")
+    results = tune_bench_shapes(iters=5)
+    for name, (best, table) in results.items():
+        print(f"\n== {name} ==  winner: {best}")
+        ok = [(c, t) for c, t in table if t is not None]
+        ok.sort(key=lambda ct: ct[1])
+        for c, t in ok:
+            print(f"  {str(c):>14}  {t * 1e3:8.3f} ms")
+        failed = [c for c, t in table if t is None]
+        if failed:
+            print(f"  failed (VMEM/compile): {failed}")
+
+
+if __name__ == "__main__":
+    main()
