@@ -21,7 +21,7 @@ from typing import Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
 
-from .autotune import _time_once, persistent_get, persistent_put
+from .autotune import _sync, _time_once, persistent_get, persistent_put
 
 __all__ = ["chip_kind", "get_schedule", "put_schedule", "tune_kernel",
            "tune_rms_norm", "tune_rope", "tune_quantized_matmul",
@@ -55,15 +55,19 @@ def put_schedule(kernel: str, sig: str, config):
 
 def tune_kernel(kernel: str, sig: str, make_fn: Callable,
                 candidates: Sequence, args: Tuple,
-                iters: int = 3):
-    """Time ``make_fn(*candidate)(*args)`` for every candidate, persist
-    the winner, return ``(best_config, table)`` where table is
-    ``[(config, seconds | None)]`` (None = candidate failed to compile/
-    run, e.g. VMEM overflow)."""
-    import jax
+                iters: int = 3, default=None, min_gain: float = 0.05):
+    """Time ``make_fn(*candidate)(*args)`` for every candidate; persist
+    the winner ONLY when it beats the kernel's default config by more
+    than ``min_gain`` (per-dispatch tunnel latency is a constant that
+    cancels in ranking but still leaves ~noise-floor jitter — a winner
+    within the noise of the default is not a real win, and persisting it
+    can hurt in-model where the standalone timing context differs).
+    Returns ``(best_config, table)``; table entries are
+    ``(config, seconds | None)`` (None = failed to compile/run)."""
     table: List = []
     errors: List = []
     best, best_t = None, float("inf")
+    default_t = None
     for cand in candidates:
         cand_t = cand if isinstance(cand, tuple) else (cand,)
         try:
@@ -73,10 +77,20 @@ def tune_kernel(kernel: str, sig: str, make_fn: Callable,
             errors.append((cand, str(e)[:200]))
             continue
         table.append((cand, t))
+        if cand == default:
+            default_t = t
         if t < best_t:
             best, best_t = cand, t
-    if best is not None:
+    keep = best is not None and (
+        default is None or default_t is None or
+        best_t < default_t * (1.0 - min_gain))
+    if keep:
         put_schedule(kernel, sig, best)
+    elif best is not None:
+        # below the noise floor vs the default: make sure no stale winner
+        # overrides the heuristic
+        put_schedule(kernel, sig, None)
+        best = default if default_t is not None else best
     if best is None and errors:
         print(f"tune_kernel({kernel}/{sig}): all candidates failed; "
               f"first error: {errors[0]}")
@@ -84,15 +98,52 @@ def tune_kernel(kernel: str, sig: str, make_fn: Callable,
 
 
 def _time_candidate(fn, args, iters: int = 3):
-    """Per-candidate timing: jit once (the timed region measures RUNTIME,
-    not lowering/compilation).  On a tunnelled PJRT backend each call
-    carries a constant per-dispatch latency (~ms); it is the SAME constant
-    for every candidate of a kernel, so the ranking — all the search needs
-    — is unaffected, while absolute times are upper bounds."""
+    """Per-candidate timing: jit once, then measure DEVICE time from the
+    xplane profiler trace (sum of leaf device ops / iters).  Wall clock
+    through a tunnelled PJRT backend carries multi-ms dispatch/fetch
+    jitter that swamps sub-ms kernels and flips rankings between runs —
+    device totals are immune to it.  Falls back to wall clock where no
+    profiler trace is available (CPU interpret mode)."""
     import jax
 
     jfn = jax.jit(fn)
-    return _time_once(jfn, args, {}, warmup=2, iters=max(iters, 5))
+    iters = max(iters, 5)
+    # compile + warm, and keep the wall measurement as the fallback
+    wall = _time_once(jfn, args, {}, warmup=2, iters=iters)
+    try:
+        dev = jax.devices()[0]
+        if dev.platform not in ("tpu", "axon"):
+            return wall
+        import re
+        import shutil
+        import tempfile
+
+        from ...profiler.profiler import DeviceSummaryView
+        tdir = tempfile.mkdtemp(prefix="ptpu_sched_")
+        try:
+            jax.profiler.start_trace(tdir)
+            try:
+                out = None
+                for _ in range(iters):
+                    out = jfn(*args)
+                _sync(out)
+            finally:
+                # a leaked global trace would poison every later candidate
+                # (start_trace fails -> wall-clock mixes with device time)
+                jax.profiler.stop_trace()
+            total = 0.0
+            for row in DeviceSummaryView(tdir).rows():
+                name = row["name"]
+                if name.startswith("jit_") or re.fullmatch(r"\d+", name):
+                    continue  # container lanes double-count children
+                total += row["total_ms"]
+            if total > 0:
+                return total / 1e3 / iters
+        finally:
+            shutil.rmtree(tdir, ignore_errors=True)
+    except Exception:
+        pass
+    return wall
 
 
 # ---------------------------------------------------------------------------
@@ -108,16 +159,19 @@ def tune_rms_norm(n: int, d: int, dtype="bfloat16", iters: int = 3):
     [n, d] input."""
     import jax.numpy as jnp
 
-    from .rms_norm import _rms_fwd_impl, rms_sig
+    from .rms_norm import _pick_rows, _rms_fwd_impl, rms_sig
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((n, d)), dtype)
     w = jnp.asarray(rng.standard_normal((d,)), dtype)
     cands = _divisors_of(n, 8, 8, 2048) or [n]
+    default = _pick_rows(n) or n
+    if default not in cands:
+        cands.append(default)
     return tune_kernel(
         "rms_norm", rms_sig(n, d, x.dtype),
         lambda rows: functools.partial(_rms_fwd_impl, epsilon=1e-6,
                                        rows=rows),
-        cands, (x, w), iters=iters)
+        cands, (x, w), iters=iters, default=default)
 
 
 def tune_rope(b: int, s: int, h: int, d: int, dtype="bfloat16",
@@ -125,17 +179,20 @@ def tune_rope(b: int, s: int, h: int, d: int, dtype="bfloat16",
     """Search the sequence-block size of the fused RoPE kernel."""
     import jax.numpy as jnp
 
-    from .rope import _rope_call, rope_sig
+    from .rope import _pick_block_s, _rope_call, rope_sig
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((b, s, h, d)), dtype)
     cos = jnp.asarray(rng.standard_normal((1, s, 1, d // 2)), jnp.float32)
     sin = jnp.asarray(rng.standard_normal((1, s, 1, d // 2)), jnp.float32)
     cands = [bs for bs in _divisors_of(s, 1, 1, s)
              if bs == s or bs % 8 == 0]
+    default = _pick_block_s(s, h, d) or s
+    if default not in cands:
+        cands.append(default)
     return tune_kernel(
         "rope", rope_sig(b, s, h, d, x.dtype),
         lambda bs: functools.partial(_rope_call, block_s=bs),
-        cands, (x, cos, sin), iters=iters)
+        cands, (x, cos, sin), iters=iters, default=default)
 
 
 def tune_quantized_matmul(m: int, k: int, n: int, dtype="bfloat16",
@@ -148,14 +205,19 @@ def tune_quantized_matmul(m: int, k: int, n: int, dtype="bfloat16",
     x = jnp.asarray(rng.standard_normal((m, k)), dtype)
     qw = jnp.asarray(rng.integers(-127, 127, (k, n)), jnp.int8)
     scales = jnp.asarray(rng.uniform(0.01, 0.02, (1, n)), jnp.float32)
+    from .quantized_matmul import BLOCK_M, BLOCK_N
     bm_c = [bm for bm in (8, 64, 128, 256, 512) if bm <= m]
     bn_c = [bn for bn in (128, 256, 512) if n % bn == 0]
     cands = [(bm, bn) for bm in bm_c for bn in bn_c]
+    default = (min(BLOCK_M, max(8, m)),
+               BLOCK_N if n % BLOCK_N == 0 else 128)
+    if default not in cands:
+        cands.append(default)
     return tune_kernel(
         "quantized_matmul", qmm_sig(m, k, n, x.dtype),
         lambda bm, bn: functools.partial(_qmm_impl, out_dtype=x.dtype,
                                          block_m=bm, block_n=bn),
-        cands, (x, qw, scales), iters=iters)
+        cands, (x, qw, scales), iters=iters, default=default)
 
 
 def tune_fused_adamw(numel: int, dtype="bfloat16", iters: int = 3):
@@ -172,10 +234,13 @@ def tune_fused_adamw(numel: int, dtype="bfloat16", iters: int = 3):
     t = jnp.asarray([[1.0]], jnp.float32)
     cands = [c for c in (1 << 15, 1 << 17, 1 << 19, 1 << 21, 0)
              if c == 0 or c < numel]  # 0 = whole-array (no grid)
+    default = 0 if numel <= (1 << 19) else (1 << 19)
+    if default not in cands:
+        cands.append(default)
     return tune_kernel(
         "fused_adamw", adamw_sig(numel, p.dtype),
         lambda chunk: functools.partial(_adamw_call, chunk=chunk),
-        cands, (p, g, m, v, lr, t), iters=iters)
+        cands, (p, g, m, v, lr, t), iters=iters, default=default)
 
 
 def tune_bench_shapes(iters: int = 3) -> Dict[str, Tuple]:

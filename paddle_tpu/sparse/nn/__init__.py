@@ -32,6 +32,8 @@ class _SparseConvNd(Layer):
         self.kernel_size = tuple(kernel_size)
         self.stride = stride
         self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
         from ...nn.initializer import XavierUniform
         self.weight = self.create_parameter(
             (*self.kernel_size, in_channels, out_channels),
@@ -45,7 +47,10 @@ class _SparseConvNd(Layer):
         fn = {(2, False): F.conv2d, (2, True): F.subm_conv2d,
               (3, False): F.conv3d, (3, True): F.subm_conv3d}[
                   (self._ndim, self._subm)]
-        return fn(x, self.weight, self.bias, self.stride, self.padding)
+        # dilation/groups pass through so non-default values raise the
+        # functional's NotImplementedError instead of silently dropping
+        return fn(x, self.weight, self.bias, self.stride, self.padding,
+                  self.dilation, self.groups)
 
 
 class Conv3D(_SparseConvNd):

@@ -62,9 +62,8 @@ def _assert_eager(coords, name):
             "construction is likewise data-dependent)")
 
 
-def _require_defaults(name, dilation, groups):
-    if _norm_seq(dilation, 3)[0] != 1 or any(
-            d != 1 for d in _norm_seq(dilation, 3)):
+def _require_defaults(name, dilation, groups, ndim=3):
+    if any(d != 1 for d in _norm_seq(dilation, ndim)):
         raise NotImplementedError(f"sparse {name}: dilation != 1 is not "
                                   "implemented")
     if groups != 1:
@@ -128,14 +127,15 @@ def _rulebook_conv(x: SparseCooTensor, weight, bias, stride, padding,
 
     if subm:
         out_keys = keys_of(coords, spatial)
-        out_index = {k: i for i, k in enumerate(out_keys.tolist())}
+        order = np.argsort(out_keys, kind="stable")
+        sorted_keys = out_keys[order]
         n_out = coords.shape[0]
     else:
         # output sites = union of keys the rulebook reaches
         all_keys = np.unique(np.concatenate(
             [r[1] for r in rule if r is not None] or
             [np.zeros(0, np.int64)]))
-        out_index = {int(k): i for i, k in enumerate(all_keys)}
+        sorted_keys, order = all_keys, np.arange(len(all_keys))
         n_out = len(all_keys)
         # decode keys back to coordinates (batch-major mixed radix)
         out_coords = np.zeros((n_out, n_sp + 1), np.int64)
@@ -152,7 +152,13 @@ def _rulebook_conv(x: SparseCooTensor, weight, bias, stride, padding,
         if r is None:
             continue
         src, okeys = r
-        tgt = np.asarray([out_index.get(int(k), -1) for k in okeys])
+        # vectorized key -> row resolution (a python dict lookup here is
+        # O(kernel_volume * nnz) interpreted ops per forward)
+        pos = np.searchsorted(sorted_keys, okeys)
+        pos = np.clip(pos, 0, len(sorted_keys) - 1)
+        hit = sorted_keys[pos] == okeys if len(sorted_keys) else \
+            np.zeros(len(okeys), bool)
+        tgt = np.where(hit, order[pos], -1)
         sel = tgt >= 0
         if not sel.any():
             continue
@@ -176,7 +182,7 @@ def _weight_arr(weight):
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NDHWC", name=None):
     """Sparse conv3d (reference sparse/nn/functional/conv.py:207)."""
-    _require_defaults("conv3d", dilation, groups)
+    _require_defaults("conv3d", dilation, groups, ndim=3)
     return _rulebook_conv(x, _weight_arr(weight), bias, stride, padding,
                           subm=False, name="conv3d")
 
@@ -185,21 +191,21 @@ def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
                 groups=1, data_format="NDHWC", key=None, name=None):
     """Submanifold sparse conv3d: output sites == input sites
     (reference sparse/nn/functional/conv.py:313)."""
-    _require_defaults("subm_conv3d", dilation, groups)
+    _require_defaults("subm_conv3d", dilation, groups, ndim=3)
     return _rulebook_conv(x, _weight_arr(weight), bias, stride, padding,
                           subm=True, name="subm_conv3d")
 
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NHWC", name=None):
-    _require_defaults("conv2d", dilation, groups)
+    _require_defaults("conv2d", dilation, groups, ndim=2)
     return _rulebook_conv(x, _weight_arr(weight), bias, stride, padding,
                           subm=False, name="conv2d")
 
 
 def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
                 groups=1, data_format="NHWC", key=None, name=None):
-    _require_defaults("subm_conv2d", dilation, groups)
+    _require_defaults("subm_conv2d", dilation, groups, ndim=2)
     return _rulebook_conv(x, _weight_arr(weight), bias, stride, padding,
                           subm=True, name="subm_conv2d")
 

@@ -226,3 +226,49 @@ def test_static_quant_post_static():
     assert "QuantizedConv2DInfer" in names and "QuantizedLinearInfer" in names
     out = qmodel(xs[:2])
     assert np.all(np.isfinite(np.asarray(out._value)))
+
+
+def test_int8_ptq_through_predictor(tmp_path):
+    """End-to-end int8 serving (VERDICT r2 item 10): PTQ-calibrate ->
+    convert -> jit.save -> Predictor run; int8 outputs stay close to the
+    float model's."""
+    import os
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.quantization import PTQ, QuantConfig
+    from paddle_tpu.quantization.observers import AbsmaxObserver
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(32, 64), nn.GELU(), nn.Linear(64, 16))
+    net.eval()
+    rng = np.random.default_rng(0)
+    calib = [paddle.to_tensor(rng.standard_normal((8, 32))
+                              .astype(np.float32)) for _ in range(4)]
+    ref_out = net(calib[0])
+
+    qcfg = QuantConfig(activation=AbsmaxObserver, weight=None)
+    ptq = PTQ(qcfg)
+    ptq.quantize(net)
+    for batch in calib:
+        net(batch)
+    ptq.convert(net)
+    from paddle_tpu.nn.quant.quant_layers import QuantizedLinearInfer
+    assert any(isinstance(s, QuantizedLinearInfer) for s in net.sublayers())
+
+    q_out = net(calib[0])
+    err = np.abs(np.asarray(q_out._value) - np.asarray(ref_out._value))
+    rel = err.max() / (np.abs(np.asarray(ref_out._value)).max() + 1e-9)
+    assert rel < 0.05, rel  # int8 quantization error bound
+
+    # export + serve through the Predictor
+    prefix = str(tmp_path / "int8_model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[paddle.static.InputSpec([8, 32],
+                                                        "float32")])
+    cfg = Config(prefix)
+    pred = create_predictor(cfg)
+    out = pred.run([np.asarray(calib[0]._value)])[0]
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(q_out._value), rtol=1e-4,
+                               atol=1e-5)
