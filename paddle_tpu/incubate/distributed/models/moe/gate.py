@@ -49,15 +49,23 @@ def _dense_dispatch(gates, top_idx, top_gates, num_experts, capacity):
 
 class TopKGate(Layer):
     def __init__(self, d_model, num_experts, top_k=2, capacity_factor=1.25,
-                 weight_attr=None):
+                 weight_attr=None, dropless=False):
+        """``dropless=True``: expert capacity = num_tokens, so NO token is
+        ever dropped regardless of routing skew — exact MoE at the cost of
+        an [E, T, D] dispatch buffer (use for small/medium T*E; the
+        capacity-factor mode is the GShard production setting where
+        overflow tokens are dropped by construction)."""
         super().__init__()
         self.num_experts = num_experts
         self.top_k = top_k
         self.capacity_factor = capacity_factor
+        self.dropless = dropless
         self.gate = Linear(d_model, num_experts, weight_attr=weight_attr,
                            bias_attr=False)
 
     def capacity(self, num_tokens):
+        if self.dropless:
+            return int(num_tokens)
         cap = int(self.capacity_factor * num_tokens * self.top_k /
                   self.num_experts)
         return max(cap, self.top_k)

@@ -33,14 +33,14 @@ class MoELayer(Layer):
     def __init__(self, d_model, d_hidden, num_experts, top_k=2,
                  capacity_factor=1.25, gate: Optional[Layer] = None,
                  activation: str = "gelu", expert_axis: Optional[str] = None,
-                 name=None):
+                 dropless: bool = False, name=None):
         super().__init__()
         self.d_model = d_model
         self.d_hidden = d_hidden
         self.num_experts = num_experts
         self.activation = activation
         self.gate = gate or TopKGate(d_model, num_experts, top_k,
-                                     capacity_factor)
+                                     capacity_factor, dropless=dropless)
         from .....nn.initializer import XavierUniform
         init = XavierUniform()
         self.w_in = self.create_parameter((num_experts, d_model, d_hidden),
