@@ -231,9 +231,15 @@ def choose_param_plan(jaxpr, params, base_specs, mesh, axis: str = "mp",
 
 
 _HLO_COLL = re.compile(
-    r"=\s*\(?(\w+)\[([\d,]*)\](?:\{[\d,]*\})?[^=]*?\s"
-    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
-    r"(?:-start)?\(")
+    # result text = everything between `=` and the op kind on the same
+    # line (lazy; shape syntax never contains a kind name) — robust to
+    # arbitrary tuple nesting and TPU tiled layouts like {0:T(8,128)}
+    r"=\s*(?P<res>[^\n]*?)\s*"
+    r"(?P<kind>all-reduce|all-gather|reduce-scatter|collective-permute"
+    r"|all-to-all)"
+    r"(?P<start>-start)?\(")
+
+_HLO_SHAPE = re.compile(r"(\w+)\[([\d,]*)\]")
 
 _DTYPE_BYTES = {"f64": 8, "f32": 4, "s32": 4, "u32": 4, "bf16": 2,
                 "f16": 2, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
@@ -242,12 +248,30 @@ _DTYPE_BYTES = {"f64": 8, "f32": 4, "s32": 4, "u32": 4, "bf16": 2,
 
 def hlo_collective_bytes(hlo_text: str) -> Dict[str, float]:
     """Total bytes per collective kind parsed from HLO text — the ground
-    truth the static estimate is validated against in tests."""
+    truth the static estimate is validated against in tests.
+
+    Tuple-shaped results (multi-operand collectives, e.g.
+    ``= (f32[..], f32[..]) all-reduce(...)``) sum every element.  Async
+    ``-start`` handling is per kind: all-reduce-start's tuple is all
+    outputs (one per operand — counted whole), while all-gather /
+    collective-permute / all-to-all -start tuples alias the input
+    buffers in their first half (only the destination half counts),
+    with u32 context scalars dropped by dtype (a scalar f32[] payload
+    stays).  The matching ``-done`` op carries no shape of its own
+    (it never matches because the kind must be followed by ``(``).
+    """
     out: Dict[str, float] = {}
     for m in _HLO_COLL.finditer(hlo_text):
-        dtype, dims, kind = m.group(1), m.group(2), m.group(3)
-        elems = math.prod(int(d) for d in dims.split(",") if d) if dims \
-            else 1
-        nbytes = elems * _DTYPE_BYTES.get(dtype, 4)
+        res, kind = m.group("res"), m.group("kind")
+        shapes = _HLO_SHAPE.findall(res)
+        if m.group("start") and res.startswith("("):
+            shapes = [s for s in shapes if not (s[0] == "u32" and not s[1])]
+            if kind != "all-reduce" and len(shapes) >= 2:
+                shapes = shapes[len(shapes) // 2:]
+        nbytes = 0.0
+        for dtype, dims in shapes:
+            elems = math.prod(int(d) for d in dims.split(",") if d) \
+                if dims else 1
+            nbytes += elems * _DTYPE_BYTES.get(dtype, 4)
         out[kind] = out.get(kind, 0.0) + nbytes
     return out

@@ -120,6 +120,18 @@ def _traced_while(cond_fn, body_fn, loop_vars):
         # closed-over Python state (e.g. list.append) sees one extra
         # call — an accepted trace-time hazard, like jax re-tracing
         probe = body_fn(*loop_vars)
+        for v, p in zip(loop_vars, probe):
+            if v is UNDEFINED and p is UNDEFINED:
+                # e.g. a local only assigned under a traced conditional:
+                # one body evaluation cannot determine its type, and
+                # lax.while_loop would fail on the sentinel with an
+                # opaque structure error — raise the clear message here.
+                raise NotImplementedError(
+                    "dy2static: a variable carried by a traced while "
+                    "loop is unbound at loop entry and still unbound "
+                    "after one loop iteration (it is only assigned "
+                    "under a traced conditional). Initialize it before "
+                    "the loop.")
         loop_vars = tuple(
             _zero_like(p) if v is UNDEFINED else v
             for v, p in zip(loop_vars, probe))
@@ -485,23 +497,32 @@ class _JumpLowering(ast.NodeTransformer):
         return _scan_loop_jumps(body, (ast.Break, ast.Continue),
                                 only_guarded=True)
 
-    def _lower_block(self, stmts, brk, cont):
+    def _lower_block(self, stmts, brk, cont, on_jump=None):
+        """on_jump: nullary callable returning fresh statements inserted
+        before each break/continue flag set (the for-loop shadow capture
+        — Python's post-loop loop-variable value is its value AT the jump
+        site, body mutations included)."""
         out = []
         for i, s in enumerate(stmts):
             rest = stmts[i + 1:]
             if isinstance(s, ast.Break):
+                if on_jump is not None:
+                    out.extend(on_jump())
                 out.append(ast.Assign(targets=[_name_store(brk)],
                                       value=ast.Constant(value=True)))
                 return out  # rest unreachable
             if isinstance(s, ast.Continue):
+                if on_jump is not None:
+                    out.extend(on_jump())
                 out.append(ast.Assign(targets=[_name_store(cont)],
                                       value=ast.Constant(value=True)))
                 return out
             if isinstance(s, ast.If) and _loop_controls_for_body([s]):
                 new_if = ast.If(
                     test=s.test,
-                    body=self._lower_block(s.body, brk, cont) or [ast.Pass()],
-                    orelse=self._lower_block(s.orelse, brk, cont))
+                    body=self._lower_block(s.body, brk, cont, on_jump)
+                    or [ast.Pass()],
+                    orelse=self._lower_block(s.orelse, brk, cont, on_jump))
                 out.append(new_if)
                 if rest:
                     flags = [_name_load(brk)]
@@ -513,7 +534,7 @@ class _JumpLowering(ast.NodeTransformer):
                                  ast.BoolOp(op=ast.Or(), values=flags)))
                     out.append(ast.If(
                         test=guard,
-                        body=self._lower_block(rest, brk, cont) or
+                        body=self._lower_block(rest, brk, cont, on_jump) or
                         [ast.Pass()],
                         orelse=[]))
                 return out
@@ -572,7 +593,6 @@ class _JumpLowering(ast.NodeTransformer):
         if prep is None:
             return node
         brk, cont = prep
-        lowered = self._lower_block(node.body, brk, cont) or [ast.Pass()]
         reset = ([ast.Assign(targets=[_name_store(cont)],
                              value=ast.Constant(value=False))]
                  if cont else [])
@@ -586,15 +606,31 @@ class _JumpLowering(ast.NodeTransformer):
             # the flag is concretely True (stops consuming the iterator —
             # critical for infinite/shared generators), while a traced flag
             # leaves concrete_true False and the finite iterator unrolls
-            # with a no-op guarded body.  A shadow tracks the loop variable
-            # of the last UN-broken iteration so post-loop reads see the
-            # break iteration's item exactly like Python (the For header
-            # keeps rebinding the target on the no-op iterations).
-            shadow = (self._fresh("item")
-                      if isinstance(node.target, ast.Name) else None)
-            guarded = ([ast.Assign(targets=[_name_store(shadow)],
-                                   value=_name_load(node.target.id))]
-                       if shadow else []) + lowered
+            # with a no-op guarded body.  Shadows track the loop variables
+            # (every Store-context Name in the target, so tuple-unpacking
+            # works; subscript/attribute targets read their base/index —
+            # Load ctx — and get no shadow) so post-loop reads see what
+            # Python sees: the value AT the jump site (body mutations
+            # included — capture runs at each break/continue and at the
+            # end of an un-jumped iteration, while the For header keeps
+            # rebinding the target on the no-op post-break iterations).
+            tgt_names = [n.id for n in ast.walk(node.target)
+                         if isinstance(n, ast.Name)
+                         and isinstance(n.ctx, ast.Store)]
+            shadows = [(nm, self._fresh("item")) for nm in tgt_names]
+
+            def capture():
+                return [ast.Assign(targets=[_name_store(sh)],
+                                   value=_name_load(nm))
+                        for nm, sh in shadows]
+            # top capture keeps the shadow bound on every active
+            # iteration (a jump-site capture alone would be branch-local
+            # inside a traced conditional — UNDEFINED on the other arm);
+            # the end/jump-site captures overwrite it with the value at
+            # the jump site so body mutations are kept
+            guarded = capture() + (self._lower_block(
+                list(node.body) + capture(), brk, cont,
+                on_jump=capture) or [ast.Pass()])
             body = reset + [
                 ast.If(test=ast.UnaryOp(op=ast.Not(),
                                         operand=_name_load(brk)),
@@ -606,12 +642,13 @@ class _JumpLowering(ast.NodeTransformer):
             out = init_brk + [
                 ast.For(target=node.target, iter=node.iter, body=body,
                         orelse=[])]
-            if shadow:
+            if shadows:
                 # zero-trip loops leave both names unbound: restore the
-                # target from the shadow only when the shadow exists
+                # targets from the shadows only when the shadows exist
                 out.append(ast.Try(
-                    body=[ast.Assign(targets=[_name_store(node.target.id)],
-                                     value=_name_load(shadow))],
+                    body=[ast.Assign(targets=[_name_store(nm)],
+                                     value=_name_load(sh))
+                          for nm, sh in shadows],
                     handlers=[ast.ExceptHandler(
                         type=ast.Tuple(elts=[_name_load("NameError"),
                                              _name_load("UnboundLocalError")],
@@ -621,6 +658,7 @@ class _JumpLowering(ast.NodeTransformer):
             return self._finish(out, node, brk)
 
         start, stop, step = rng
+        lowered = self._lower_block(node.body, brk, cont) or [ast.Pass()]
         ivar = node.target.id
         itv, stopv, stepv = (self._fresh("it"), self._fresh("stop"),
                              self._fresh("step"))

@@ -33,10 +33,12 @@ def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, t_ref,
     p = p * (1.0 - lr * wd)
     m_new = beta1 * m + (1.0 - beta1) * g
     v_new = beta2 * v + (1.0 - beta2) * g * g
-    # beta ** t via exp/log: Mosaic has no dynamic-exponent pow lowering
+    # beta ** t via exp/log: Mosaic has no dynamic-exponent pow lowering.
+    # beta==0 is legal (0**t == 0 for t>=1, so the bias-correction
+    # denominator is exactly 1.0) but log(0) raises at trace time.
     import math
-    b1t = jnp.exp(t * math.log(beta1))
-    b2t = jnp.exp(t * math.log(beta2))
+    b1t = jnp.exp(t * math.log(beta1)) if beta1 > 0 else jnp.float32(0.0)
+    b2t = jnp.exp(t * math.log(beta2)) if beta2 > 0 else jnp.float32(0.0)
     m_hat = m_new / (1.0 - b1t)
     v_hat = v_new / (1.0 - b2t)
     p_out[:] = (p - lr * m_hat / (jnp.sqrt(v_hat) + epsilon)) \

@@ -96,6 +96,43 @@ def test_hlo_collective_bytes_parser():
     assert got["all-gather"] == 8 * 32 * 2
 
 
+def test_hlo_collective_bytes_tuple_shapes():
+    # multi-operand collectives have tuple results: every element counts;
+    # async -start results alias inputs in the first half — only the
+    # destination half counts, and the -done op carries no shape
+    text = """
+  %ar = (f32[4,16]{1,0}, bf16[8]{0}) all-reduce(f32[4,16] %x, bf16[8] %y)
+  %st = (f32[32]{0}, f32[32]{0}) all-gather-start(f32[16]{0} %z)
+  %dn = f32[32]{0} all-gather-done((f32[32],f32[32]) %st)
+  %n = ((f32[16]{0}, s32[16]{0}), (f32[64]{0}, s32[64]{0})) all-to-all-start(f32[16] %a, s32[16] %b)
+"""
+    got = hlo_collective_bytes(text)
+    assert got["all-reduce"] == 4 * 16 * 4 + 8 * 2
+    assert got["all-gather"] == 32 * 4
+    # nested tuple: only the destination half of the leaves counts
+    assert got["all-to-all"] == 64 * 4 + 64 * 4
+    # collective-permute-start: u32[] context scalars must not be
+    # mistaken for the destination buffer; TPU tiled layouts (parens at
+    # depth 2) must not break the match
+    cps = ("%cps = (f32[16]{0:T(8,128)}, f32[16]{0:T(8,128)}, "
+           "u32[]{:S(2)}, u32[]{:S(2)}) "
+           "collective-permute-start(f32[16]{0} %p)")
+    assert hlo_collective_bytes(cps)["collective-permute"] == 16 * 4
+    # scalar payloads survive the context filter
+    scps = ("%s = (f32[], f32[], u32[]{:S(2)}, u32[]{:S(2)}) "
+            "collective-permute-start(f32[] %p)")
+    assert hlo_collective_bytes(scps)["collective-permute"] == 4
+    # all-reduce-start's tuple is all outputs (one per operand): no
+    # halving — every element counts
+    ars = ("%ars = (f32[128]{0}, f32[64]{0}) "
+           "all-reduce-start(f32[128] %a, f32[64] %b)")
+    assert hlo_collective_bytes(ars)["all-reduce"] == (128 + 64) * 4
+    # u32 PAYLOAD buffers are data, only u32[] scalars are contexts
+    uag = ("%ag = (u32[1024]{0}, u32[2048]{0}) "
+           "all-gather-start(u32[1024]{0} %x)")
+    assert hlo_collective_bytes(uag)["all-gather"] == 2048 * 4
+
+
 def test_engine_auto_plan_matches_hand_plan_hlo():
     """Done-criterion: auto-chosen plan == hand-annotated Megatron plan,
     verified down to the compiled HLO's collective bytes on the 8-device

@@ -504,6 +504,86 @@ def test_noniterator_for_break_loop_var_traced():
                                    np.asarray(f(_t(v))._value), rtol=1e-6)
 
 
+def test_noniterator_for_break_tuple_target():
+    # tuple-unpacking for targets: after a break, ALL loop variables must
+    # land on the break iteration's items (shadow per name)
+    def f(x):
+        a, b = 0.0, 0.0
+        for a, b in [(0.1, 1.0), (0.2, 2.0), (0.3, 3.0)]:
+            if x.sum() < a:
+                break
+        return x * a + b
+
+    static_f = to_static(f)
+    for v in ([0.01, 0.01], [0.15, 0.0], [5.0, 5.0]):
+        np.testing.assert_allclose(np.asarray(static_f(_t(v))._value),
+                                   np.asarray(f(_t(v))._value), rtol=1e-6)
+
+
+def test_for_break_body_mutation_of_loop_var():
+    # Python's post-loop loop-variable value includes body mutations
+    # (value at the jump site / end of last iteration), with and
+    # without a break firing
+    def f(x):
+        for a in [1.0, 2.0, 3.0]:
+            a = a * 10.0
+            if x.sum() > a:
+                break
+        return x + a
+
+    static_f = to_static(f)
+    for v in ([100.0], [15.0], [0.5]):
+        np.testing.assert_allclose(np.asarray(static_f(_t(v))._value),
+                                   np.asarray(f(_t(v))._value), rtol=1e-6)
+
+
+def test_for_continue_body_mutation_of_loop_var():
+    def f(x):
+        for a in [1.0, 2.0, 3.0]:
+            a = a * 10.0
+            if a > 15.0:
+                continue
+            a = a + 0.5
+        return x + a
+
+    static_f = to_static(f)
+    np.testing.assert_allclose(np.asarray(static_f(_t([1.0]))._value),
+                               np.asarray(f(_t([1.0]))._value), rtol=1e-6)
+
+
+def test_for_subscript_target_break_no_clobber():
+    # subscript targets read their index/base (Load ctx): the break shadow
+    # must not restore them over body mutations
+    def f(x):
+        d = [0.0, 0.0, 0.0, 0.0]
+        i = 0
+        for d[i] in [1.0, 2.0, 3.0]:
+            i += 1
+            if d[0] > 100.0:
+                break
+        return x + i
+
+    static_f = to_static(f)
+    np.testing.assert_allclose(np.asarray(static_f(_t([1.0]))._value),
+                               np.asarray(f(_t([1.0]))._value), rtol=1e-6)
+
+
+def test_traced_while_undefined_carry_clear_error():
+    # a local only assigned under a conditional that is false during the
+    # type probe stays UNDEFINED; the descriptive dy2static error must
+    # fire instead of forwarding the sentinel into lax.while_loop
+    def f(x):
+        i = 0
+        while (x + i).sum() < 10.0:
+            if i > 5:
+                y = x * 2.0
+            i += 1
+        return y
+
+    with pytest.raises(NotImplementedError, match="unbound at loop entry"):
+        to_static(f)(_t([0.5, 0.5]))
+
+
 def test_jit_save_bound_method(tmp_path):
     class Net(paddle.nn.Layer):
         def __init__(self):

@@ -144,6 +144,24 @@ def test_fused_adamw_matches_reference():
     np.testing.assert_allclose(np.asarray(v2), np.asarray(vr), atol=1e-7)
 
 
+def test_fused_adamw_zero_beta():
+    # beta1=0 / beta2=0 are legal AdamW edge cases (bias-correction
+    # denominator is exactly 1): must not raise at trace time (log(0))
+    rng = np.random.default_rng(7)
+    n = 1024
+    p = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    g = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    m = jnp.zeros(n, jnp.float32)
+    v = jnp.zeros(n, jnp.float32)
+    lr, eps, wd = 1e-3, 1e-8, 0.0
+    p2, m2, v2 = fo.fused_adamw_update(p, g, m, v, lr, 3, 0.0, 0.0,
+                                       eps, wd)
+    # with beta1=beta2=0: m=g, v=g^2, hats equal them exactly
+    np.testing.assert_allclose(np.asarray(m2), np.asarray(g), atol=1e-7)
+    pr = p - lr * g / (jnp.sqrt(g * g) + eps)
+    np.testing.assert_allclose(np.asarray(p2), np.asarray(pr), atol=1e-6)
+
+
 def test_autotune_caches_winner():
     at.clear_cache()
     calls = []
