@@ -13,5 +13,6 @@ with Config, zero-copy IO handles, clone-per-thread). The redesign:
 """
 
 from .predictor import Config, Predictor, create_predictor  # noqa: F401
+from .llm import LLMPredictor  # noqa: F401
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+__all__ = ["Config", "Predictor", "create_predictor", "LLMPredictor"]

@@ -26,6 +26,7 @@ from ..incubate.nn.functional import llama_rope, swiglu
 from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy)
+from .generation import GenerationMixin
 
 
 @dataclass
@@ -106,13 +107,25 @@ class LlamaAttention(nn.Layer):
             self.v_proj = nn.Linear(h, kv_out, bias_attr=False)
             self.o_proj = nn.Linear(h, h, bias_attr=False)
 
-    def forward(self, x, position_ids=None, attention_mask=None, cache=None):
+    def _qkv_rope(self, x, position_ids=None):
+        """Project + rotate.  Head counts derive from the projected width
+        so tensor-parallel shards (local heads) reshape correctly."""
         b, s, _ = x.shape
-        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
-        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
-        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+        hq = q.shape[-1] // self.head_dim
+        hkv = k.shape[-1] // self.head_dim
+        q = q.reshape([b, s, hq, self.head_dim])
+        k = k.reshape([b, s, hkv, self.head_dim])
+        v = v.reshape([b, s, hkv, self.head_dim])
         q, k = llama_rope(q, k, rotary_emb_base=self.config.rope_theta,
                           position_ids=position_ids)
+        return q, k, v
+
+    def forward(self, x, position_ids=None, attention_mask=None, cache=None):
+        b, s, _ = x.shape
+        q, k, v = self._qkv_rope(x, position_ids)
         if cache is not None:
             from ..tensor.manipulation import concat
             k = concat([cache[0], k], axis=1)
@@ -121,9 +134,34 @@ class LlamaAttention(nn.Layer):
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attention_mask,
             is_causal=attention_mask is None)
-        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        out = out.reshape([b, s, -1])
         out = self.o_proj(out)
         return (out, cache) if cache is not None else out
+
+    def prefill(self, x, position_ids=None):
+        """Causal forward that also returns the post-RoPE K/V planes
+        ([B, S, H_kv, D] arrays) for the generation cache."""
+        b, s, _ = x.shape
+        q, k, v = self._qkv_rope(x, position_ids)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = self.o_proj(out.reshape([b, s, -1]))
+        return out, (k._value, v._value)
+
+    def decode_step(self, x, kv, lens):
+        """One cached decode step (the masked_multihead_attention role,
+        GQA-aware).  x: [B, 1, hidden]; kv: (k_cache, v_cache) static
+        [B, S_max, H_kv, D] buffers; lens: [B] write slot / last valid
+        index.  Returns (out [B, 1, hidden], updated kv)."""
+        from .generation import cache_scatter, cached_decode_attention
+        k_cache, v_cache = kv
+        q, k, v = self._qkv_rope(x, lens[:, None])
+        k_cache = cache_scatter(k_cache, lens, k._value[:, 0])
+        v_cache = cache_scatter(v_cache, lens, v._value[:, 0])
+        out = cached_decode_attention(q._value[:, 0], k_cache, v_cache,
+                                      lens)
+        from ..core.tensor import Tensor
+        out = self.o_proj(Tensor(out[:, None, :]))
+        return out, (k_cache, v_cache)
 
 
 class LlamaMLP(nn.Layer):
@@ -171,6 +209,18 @@ class LlamaDecoderLayer(nn.Layer):
                              policy=self._recompute_policy)
         return self._forward_impl(x, position_ids, attention_mask)
 
+    def prefill(self, x, position_ids=None):
+        attn_out, kv = self.self_attn.prefill(self.input_layernorm(x),
+                                              position_ids)
+        h = x + attn_out
+        return h + self.mlp(self.post_attention_layernorm(h)), kv
+
+    def decode_step(self, x, kv, lens):
+        attn_out, kv = self.self_attn.decode_step(self.input_layernorm(x),
+                                                  kv, lens)
+        h = x + attn_out
+        return h + self.mlp(self.post_attention_layernorm(h)), kv
+
 
 class LlamaModel(nn.Layer):
     def __init__(self, config: LlamaConfig):
@@ -194,7 +244,7 @@ class LlamaModel(nn.Layer):
         return self.norm(x)
 
 
-class LlamaForCausalLM(nn.Layer):
+class LlamaForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -217,6 +267,53 @@ class LlamaForCausalLM(nn.Layer):
             loss = LlamaPretrainingCriterion(self.config)(logits, labels)
             return loss, logits
         return logits
+
+    # -- GenerationMixin surface (models/generation.py; the reference
+    # fused_multi_transformer_op.cu decode-serving role) --
+    def kv_cache_spec(self):
+        return (self.config.num_hidden_layers,
+                self.config.num_key_value_heads, self.config.head_dim)
+
+    def prefill(self, ids, lens, kvs):
+        """Prompt pass: write prompt K/V into the static caches; return
+        the last-valid-position logits only (the [B, S, vocab] logits
+        tensor is never materialized — decode needs one row)."""
+        import jax
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        b, s = ids.shape
+        hidden, new_kvs = self._prefill_hidden(Tensor(ids))
+        out_kvs = []
+        for (kc, vc), (k, v) in zip(kvs, new_kvs):
+            kc = jax.lax.dynamic_update_slice(
+                kc, k.astype(kc.dtype), (0, 0, 0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v.astype(vc.dtype), (0, 0, 0, 0))
+            out_kvs.append((kc, vc))
+        h = hidden._value
+        last = h[jnp.arange(b), lens - 1]                     # [B, hidden]
+        logits = self.lm_head(Tensor(last[:, None, :]))._value[:, 0]
+        return logits, out_kvs
+
+    def _prefill_hidden(self, x_ids):
+        x = self.llama.embed_tokens(x_ids)
+        kvs = []
+        for layer in self.llama.layers:
+            x, kv = layer.prefill(x)
+            kvs.append(kv)
+        return self.llama.norm(x), kvs
+
+    def decode_step(self, tokens, lens, kvs):
+        """One cached decode step over all layers. tokens: [B] int32."""
+        from ..core.tensor import Tensor
+        x = self.llama.embed_tokens(Tensor(tokens[:, None]))
+        new_kvs = []
+        for layer, kv in zip(self.llama.layers, kvs):
+            x, kv = layer.decode_step(x, kv, lens)
+            new_kvs.append(kv)
+        x = self.llama.norm(x)
+        logits = self.lm_head(x)._value[:, 0]
+        return logits, new_kvs
 
 
 class LlamaPretrainingCriterion(nn.Layer):

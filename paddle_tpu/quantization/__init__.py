@@ -19,10 +19,10 @@ from .quanters import (BaseQuanter, FakeQuanterWithAbsMaxObserver,
                        FakeQuanterChannelWiseAbsMaxObserver,
                        quantize_tensor, dequantize_tensor, fake_quant)
 from .qat import QAT
-from .ptq import PTQ
+from .ptq import PTQ, weight_only_quantize
 
 __all__ = [
-    "QuantConfig", "QAT", "PTQ",
+    "QuantConfig", "QAT", "PTQ", "weight_only_quantize",
     "BaseObserver", "AbsmaxObserver", "MovingAverageAbsmaxObserver",
     "PerChannelAbsmaxObserver",
     "BaseQuanter", "FakeQuanterWithAbsMaxObserver",

@@ -30,6 +30,46 @@ class _ObservedLayer(Layer):
         return self.inner(*args, **kwargs)
 
 
+def weight_only_quantize(model: Layer, inplace: bool = True,
+                         skip=None) -> Layer:
+    """Weight-only int8 conversion for serving: every ``nn.Linear``
+    becomes a ``QuantizedLinearInfer`` (int8 weights + per-out-channel
+    scales, activations stay float).  No calibration pass — decode
+    serving is weight-streaming bound, and halving weight bytes is the
+    whole win (reference analogue: the weight-only int8 mode of
+    ``fused_multi_transformer_int8_op.cu`` / TRT weight-only PTQ).
+
+    ``skip(qualified_name, layer) -> bool`` excludes layers (e.g. the
+    lm_head, commonly kept float for accuracy).
+    """
+    if not inplace:
+        import copy
+        model = copy.deepcopy(model)
+    converted = 0
+
+    def rec(layer: Layer, prefix: str):
+        nonlocal converted
+        for name, sub in list(layer._sub_layers.items()):
+            qual = f"{prefix}.{name}" if prefix else name
+            if isinstance(sub, Linear):
+                if skip is not None and skip(qual, sub):
+                    continue
+                layer._sub_layers[name] = _freeze(
+                    QuantedLinear(sub, None, None))
+                converted += 1
+            else:
+                rec(sub, qual)
+
+    rec(model, "")
+    if converted == 0:
+        raise ValueError(
+            "weight_only_quantize converted no layers — the model has no "
+            "nn.Linear sublayers (tensor-parallel Column/RowParallelLinear "
+            "are not yet supported for int8 serving; quantize the "
+            "unsharded model)")
+    return model
+
+
 class PTQ:
     def __init__(self, config: QuantConfig):
         self._config = config

@@ -1,0 +1,143 @@
+"""LLMPredictor KV-cache serving session: deterministic tokens, session
+incrementality, artifact save/load parity (the reference
+fused_multi_transformer + AnalysisPredictor decode-serving role)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.inference import LLMPredictor
+
+
+def _net():
+    cfg = models.tiny_llama_config()
+    net = models.LlamaForCausalLM(cfg)
+    net.eval()
+    return cfg, net
+
+
+def test_session_matches_generate():
+    cfg, net = _net()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 6))
+    pred = LLMPredictor(net, batch=2, prompt_len=6, max_cache_len=32,
+                        steps_per_call=4, compute_dtype="float32")
+    got = pred.generate(paddle.to_tensor(ids), max_new_tokens=9)
+    want = np.asarray(net.generate(paddle.to_tensor(ids), max_new_tokens=9,
+                                   max_cache_len=32,
+                                   compute_dtype="float32")._value)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_session_is_incremental():
+    cfg, net = _net()
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, cfg.vocab_size, (1, 5))
+    pred = LLMPredictor(net, batch=1, prompt_len=5, max_cache_len=24,
+                        steps_per_call=3, compute_dtype="float32")
+    first = pred.start(ids)
+    a = pred.decode(2)
+    b = pred.decode(3)
+    whole = LLMPredictor(net, batch=1, prompt_len=5, max_cache_len=24,
+                         steps_per_call=3, compute_dtype="float32")
+    want = whole.generate(ids, max_new_tokens=6)
+    got = np.concatenate([first[:, None], a, b], axis=1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_artifact_roundtrip_deterministic(tmp_path):
+    cfg, net = _net()
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, cfg.vocab_size, (2, 4))
+    pred = LLMPredictor(net, batch=2, prompt_len=4, max_cache_len=16,
+                        steps_per_call=4, compute_dtype="float32")
+    want = pred.generate(ids, max_new_tokens=8)
+    path = str(tmp_path / "llama_serve")
+    pred.save(path)
+    loaded = LLMPredictor.load(path)
+    got = loaded.generate(ids, max_new_tokens=8)
+    np.testing.assert_array_equal(got, want)
+    # and again: deterministic across calls
+    np.testing.assert_array_equal(loaded.generate(ids, max_new_tokens=8),
+                                  want)
+
+
+def test_weight_only_int8_session():
+    # int8 weight-only serving: Linears become QuantizedLinearInfer
+    # (buffers, not params — the session must carry them), generation
+    # is deterministic, and tiny-model logits stay close to float
+    from paddle_tpu.quantization import weight_only_quantize
+    from paddle_tpu.nn.quant.quant_layers import QuantizedLinearInfer
+    cfg, net = _net()
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, cfg.vocab_size, (1, 5))
+    float_logits = np.asarray(net(paddle.to_tensor(ids))._value)[:, -1]
+    qnet = weight_only_quantize(net, inplace=False,
+                                skip=lambda name, l: name == "lm_head")
+    assert isinstance(qnet.llama.layers[0].self_attn.q_proj,
+                      QuantizedLinearInfer)
+    assert not isinstance(qnet.lm_head, QuantizedLinearInfer)
+    q_logits = np.asarray(qnet(paddle.to_tensor(ids))._value)[:, -1]
+    rel = np.abs(q_logits - float_logits).max() / \
+        (np.abs(float_logits).max() + 1e-9)
+    assert rel < 0.12, f"int8 weight-only logits drifted {rel:.3f}"
+    pred = LLMPredictor(qnet, batch=1, prompt_len=5, max_cache_len=16,
+                        steps_per_call=4, compute_dtype="float32")
+    got = pred.generate(ids, max_new_tokens=6)
+    assert got.shape == (1, 6)
+    np.testing.assert_array_equal(pred.generate(ids, max_new_tokens=6),
+                                  got)
+
+
+def test_session_guards():
+    cfg, net = _net()
+    pred = LLMPredictor(net, batch=1, prompt_len=4, max_cache_len=8,
+                        steps_per_call=2, compute_dtype="float32")
+    with pytest.raises(RuntimeError, match="start"):
+        pred.decode(1)
+    with pytest.raises(ValueError, match="prompt must be"):
+        pred.start(np.zeros((2, 4), np.int64))
+    pred.start(np.zeros((1, 4), np.int64))
+    with pytest.raises(ValueError, match="max_cache_len"):
+        pred.decode(100)
+    assert pred.decode(0).shape == (1, 0)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        pred.generate(np.zeros((1, 4), np.int64), max_new_tokens=0)
+    with pytest.raises(ValueError, match="prompt_len"):
+        LLMPredictor(net, batch=1, prompt_len=8, max_cache_len=4)
+
+
+def test_generate_zero_tokens_raises():
+    cfg, net = _net()
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        net.generate(paddle.to_tensor(np.zeros((1, 4), np.int64)),
+                     max_new_tokens=0)
+
+
+def test_seq_lens_range_validation():
+    cfg, net = _net()
+    ids = np.zeros((1, 4), np.int64)
+    with pytest.raises(ValueError, match="seq_lens"):
+        net.generate(paddle.to_tensor(ids), max_new_tokens=2,
+                     seq_lens=np.array([5]))
+    with pytest.raises(ValueError, match="seq_lens"):
+        net.generate(paddle.to_tensor(ids), max_new_tokens=2,
+                     seq_lens=np.array([0]))
+    pred = LLMPredictor(net, batch=1, prompt_len=4, max_cache_len=8,
+                        steps_per_call=2)
+    with pytest.raises(ValueError, match="seq_lens"):
+        pred.start(ids, seq_lens=np.array([9]))
+
+
+def test_weight_only_quantize_rejects_no_linear():
+    from paddle_tpu.quantization import weight_only_quantize
+    import paddle_tpu.nn as nn
+
+    class NoLinear(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.n = nn.RMSNorm(8)
+
+    with pytest.raises(ValueError, match="no .*Linear|converted no"):
+        weight_only_quantize(NoLinear())
