@@ -97,6 +97,11 @@ def main():
         secondary["ocr_rec_infer"] = _bench_ocr(on_tpu, peak_flops)
     except Exception as e:
         secondary["ocr_rec_infer"] = {"error": str(e)[:300]}
+    gc.collect()
+    try:
+        secondary["llm_decode"] = _bench_decode(on_tpu)
+    except Exception as e:
+        secondary["llm_decode"] = {"error": str(e)[:300]}
     result["secondary"] = secondary
     print(json.dumps(result))
 
@@ -354,6 +359,155 @@ def _bench_ocr(on_tpu, peak_flops):
         "batch": batch, "image": [32, width], "dtype": dtype,
         "fwd_gflops_per_image": round(fwd_flops / batch / 1e9, 3),
     }
+
+
+def _bench_decode(on_tpu):
+    """Cached-KV autoregressive serving (the fused_multi_transformer
+    role): decode tokens/s at b1 and b32, prefill tokens/s, bf16 and
+    weight-only int8.  Decode is weight-streaming bound — the roofline
+    is tokens/s ~= B * HBM_BW / (weight_bytes + B*kv_sweep_bytes) — so
+    achieved GB/s is reported alongside.
+
+    Timing: one generate() call is ONE dispatch (prefill + lax.scan);
+    decode sec/token comes from the differential between two
+    max_new_tokens settings at the SAME max_cache_len (identical
+    per-step cost), so tunnel dispatch/fetch constants cancel.  Prefill
+    is timed by a chained scan of the serving prefill program.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import GenerationConfig, model_arrays
+    from paddle_tpu.inference.llm import _build_serving_fns
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=8192, num_hidden_layers=16,
+                          num_attention_heads=32, num_key_value_heads=8,
+                          max_position_embeddings=4096)
+        prompt, n_small, n_large = 128, 32, 160
+        cache_ladder = [2048, 1024, 512]
+        batches = (1, 32)
+        compute_dtype = "bfloat16"
+    else:
+        cfg = LlamaConfig(vocab_size=1024, hidden_size=256,
+                          intermediate_size=704, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=512)
+        prompt, n_small, n_large = 16, 4, 12
+        cache_ladder = [64]
+        batches = (1, 4)
+        compute_dtype = "float32"
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)   # f32-stored; cast hoisted per call
+    model.eval()
+    rng = np.random.default_rng(0)
+
+    n_params = sum(p.size for p in model.parameters())
+    n_embed = model.llama.embed_tokens.weight.size
+    n_head_w = model.lm_head.weight.size
+    kv_slot_bytes = (cfg.num_hidden_layers * 2 * cfg.num_key_value_heads *
+                     cfg.head_dim * 2)          # bf16 cache, k+v
+
+    def measure(tag, weight_bytes):
+        per_b = {}
+        for b in batches:
+            ids = paddle.to_tensor(
+                rng.integers(0, cfg.vocab_size, (b, prompt))
+                .astype(np.int32))
+            last = None
+            for cache_len in cache_ladder:
+                try:
+                    def run(n):
+                        toks = model.generate(
+                            ids, max_new_tokens=n,
+                            max_cache_len=cache_len,
+                            compute_dtype=compute_dtype)
+                        np.asarray(toks._value)   # true sync on axon
+                    run(n_small)
+                    t0 = time.perf_counter()
+                    run(n_small)
+                    t_s = time.perf_counter() - t0
+                    run(n_large)
+                    t0 = time.perf_counter()
+                    run(n_large)
+                    t_l = time.perf_counter() - t0
+                    step_s = (t_l - t_s) / (n_large - n_small)
+                    swept = weight_bytes + b * cache_len * kv_slot_bytes
+                    last = {
+                        "decode_tokens_per_s": round(b / step_s, 1),
+                        "step_ms": round(step_s * 1e3, 3),
+                        "cache_len": cache_len,
+                        "achieved_GBps": round(swept / step_s / 1e9, 1),
+                    }
+                    break
+                except Exception as e:
+                    if "RESOURCE_EXHAUSTED" in str(e) or \
+                            "Out of memory" in str(e):
+                        continue
+                    raise
+            if last is None:
+                raise RuntimeError("no decode config fit in memory")
+            # prefill: chained scan of the serving prefill program; the
+            # carry mixes in the emitted token AND a cache slice so
+            # neither the forward nor the cache writes can be DCE'd
+            gcfg = GenerationConfig(compute_dtype=compute_dtype)
+            prefill, _ = _build_serving_fns(model, b, last["cache_len"],
+                                            gcfg, 1)
+            params, buffers = model_arrays(model)
+            pb = [p._value for p in params] + [bf._value for bf in buffers]
+            lens0 = jnp.full((b,), prompt, jnp.int32)
+
+            def chained(pbv, ids_a, k):
+                def body(carry, _):
+                    out = prefill(pbv, carry, lens0)
+                    tok0, kc0 = out[0], out[3]
+                    feed = (tok0[:, None] +
+                            kc0[:, 0, 0, :1].astype(jnp.int32))
+                    return (carry + feed) % cfg.vocab_size, tok0[0]
+                _, toks = jax.lax.scan(body, ids_a, None, length=k)
+                return toks.sum()
+
+            jc = jax.jit(chained, static_argnums=2)
+
+            def prun(k):
+                np.asarray(jc(pb, ids._value, k))
+
+            kp = (2, 6) if on_tpu else (1, 3)
+            prun(kp[0])
+            t0 = time.perf_counter()
+            prun(kp[0])
+            tp_s = time.perf_counter() - t0
+            prun(kp[1])
+            t0 = time.perf_counter()
+            prun(kp[1])
+            tp_l = time.perf_counter() - t0
+            pre_s = (tp_l - tp_s) / (kp[1] - kp[0])
+            last["prefill_ms"] = round(pre_s * 1e3, 2)
+            last["prefill_tokens_per_s"] = round(b * prompt / pre_s, 1)
+            per_b[f"b{b}"] = last
+        return per_b
+
+    out = {"config": {"params": int(n_params), "prompt": prompt,
+                      "dtype": compute_dtype,
+                      "n_small": n_small, "n_large": n_large}}
+    # bf16: weights stream as the hoisted bf16 copy (2 B/param, embedding
+    # excluded: decode gathers one row)
+    out["bf16"] = measure("bf16", (n_params - n_embed) * 2)
+    # weight-only int8: Linears stream 1 B/param; lm_head kept float
+    from paddle_tpu.quantization import weight_only_quantize
+    weight_only_quantize(model, skip=lambda name, l: name == "lm_head")
+    model._generate_exe_cache = {}
+    paddle.set_flags({"FLAGS_use_int8_matmul_kernel": True})
+    try:
+        out["int8"] = measure(
+            "int8", (n_params - n_embed - n_head_w) * 1 + n_head_w * 2)
+    finally:
+        paddle.set_flags({"FLAGS_use_int8_matmul_kernel": False})
+    return out
 
 
 if __name__ == "__main__":

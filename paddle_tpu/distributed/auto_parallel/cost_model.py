@@ -41,19 +41,31 @@ __all__ = ["PlanCost", "estimate_plan_cost", "choose_param_plan",
 class PlanCost:
     flops_per_device: float = 0.0
     comm_bytes: float = 0.0
+    comm_count: int = 0
     param_bytes_per_device: float = 0.0
     breakdown: list = field(default_factory=list)
 
     def total(self, flops_per_s=197e12, bw_bytes_per_s=1.8e11,
-              hbm_bytes_per_s=8.2e11) -> float:
+              hbm_bytes_per_s=8.2e11, alpha_s=2e-6) -> float:
         """Scalar rank: compute time + ICI comm time + per-device param
         HBM read time (v5e nominal constants; only the RATIO matters for
         ranking).  The HBM term makes sharded storage strictly beat
         replicated storage when compute and comm tie (e.g. row-split vs
-        replicated down-projection against a column-sharded activation)."""
+        replicated down-projection against a column-sharded activation).
+        Collectives carry an alpha + beta*n latency model (reference
+        ``auto_parallel/static/cost/comm_op_cost.py:21``): ``alpha_s``
+        per collective launch on top of the byte term, so a plan
+        spraying many small collectives loses to one moving the same
+        bytes in fewer ops."""
         return (self.flops_per_device / flops_per_s +
                 self.comm_bytes / bw_bytes_per_s +
+                self.comm_count * alpha_s +
                 self.param_bytes_per_device / hbm_bytes_per_s)
+
+    def _add_comm(self, kind, opname, nbytes):
+        self.comm_bytes += nbytes
+        self.comm_count += 1
+        self.breakdown.append((kind, opname, nbytes))
 
 
 def _axes_of(entry) -> Tuple[str, ...]:
@@ -122,10 +134,8 @@ def _dot_cost(eqn, specs, mesh_shape, cost):
                     na = _axes_size(reused, mesh_shape)
                     vbytes = math.prod(var.aval.shape) * _dtype_size(
                         var.aval)
-                    gb = vbytes * (na - 1) / na
-                    cost.comm_bytes += gb
-                    cost.breakdown.append(
-                        ("all_gather", eqn.primitive.name, gb))
+                    cost._add_comm("all_gather", eqn.primitive.name,
+                                   vbytes * (na - 1) / na)
 
     for cl, cr in zip(lc, rc):
         al, ar = _axes_of(ls[cl]), _axes_of(rs[cr])
@@ -137,9 +147,8 @@ def _dot_cost(eqn, specs, mesh_shape, cost):
             lbytes = math.prod(lshape) * _dtype_size(lhs.aval)
             rbytes = math.prod(rshape) * _dtype_size(rhs.aval)
             na = _axes_size(al if lbytes < rbytes else ar, mesh_shape)
-            gb = min(lbytes, rbytes) * (na - 1) / na
-            cost.comm_bytes += gb
-            cost.breakdown.append(("all_gather", eqn.primitive.name, gb))
+            cost._add_comm("all_gather", eqn.primitive.name,
+                           min(lbytes, rbytes) * (na - 1) / na)
             continue
         axes = al or ar
         na = _axes_size(axes, mesh_shape)
@@ -150,9 +159,59 @@ def _dot_cost(eqn, specs, mesh_shape, cost):
             out_axes = {a for e in (specs.get(out) or ())
                         for a in _axes_of(e)} - contract_axes
             local_out = out_bytes / max(_axes_size(out_axes, mesh_shape), 1)
-            ab = 2 * (na - 1) / na * local_out
-            cost.comm_bytes += ab
-            cost.breakdown.append(("all_reduce", eqn.primitive.name, ab))
+            cost._add_comm("all_reduce", eqn.primitive.name,
+                           2 * (na - 1) / na * local_out)
+
+
+def _conv_cost(eqn, specs, mesh_shape, cost):
+    """conv_general_dilated pricing (reference prices every op —
+    ``comp_op_cost.py``; attention needs no special case here: on the
+    planning trace it lowers to dot_generals, which are priced above).
+
+    FLOPs = 2 * out_elems * (Cin/groups) * kernel_volume, divided by
+    every mesh axis sharding either operand.  An input-feature split is
+    a contraction split -> ring all_reduce of the output.  Spatial
+    shardings would need halo exchanges; they are not modeled (the
+    planner never proposes them — batch/feature splits dominate on
+    TPU), so their comm cost conservatively prices as a contraction.
+    """
+    lhs, rhs = eqn.invars[:2]
+    out = eqn.outvars[0]
+    dn = eqn.params["dimension_numbers"]
+    groups = int(eqn.params.get("feature_group_count", 1))
+    ls = specs.get(lhs) or (None,) * lhs.aval.ndim
+    rs = specs.get(rhs) or (None,) * rhs.aval.ndim
+
+    out_elems = math.prod(out.aval.shape) if out.aval.shape else 1
+    cin = lhs.aval.shape[dn.lhs_spec[1]]
+    kernel_vol = math.prod(rhs.aval.shape[i] for i in dn.rhs_spec[2:])
+    total_flops = 2.0 * out_elems * (cin // max(groups, 1)) * kernel_vol
+
+    sharding_axes = set()
+    for e in tuple(ls) + tuple(rs):
+        sharding_axes.update(_axes_of(e))
+    nshard = _axes_size(sharding_axes, mesh_shape)
+    cost.flops_per_device += total_flops / max(nshard, 1)
+
+    # contraction axes: input-feature dim on either operand, and any
+    # spatial sharding (halo-needing — priced as a reduce)
+    contract_axes = set(_axes_of(ls[dn.lhs_spec[1]]))
+    contract_axes.update(_axes_of(rs[dn.rhs_spec[1]]))
+    for d in dn.lhs_spec[2:]:
+        contract_axes.update(_axes_of(ls[d]))
+    for d in dn.rhs_spec[2:]:
+        # kernel-spatial weight splits also need halo/reduce traffic —
+        # price them as contractions so the planner never "wins" by
+        # sharding a kh/kw dim for free
+        contract_axes.update(_axes_of(rs[d]))
+    na = _axes_size(contract_axes, mesh_shape)
+    if na > 1:
+        out_bytes = out_elems * _dtype_size(out.aval)
+        out_axes = {a for e in (specs.get(out) or ())
+                    for a in _axes_of(e)} - contract_axes
+        local_out = out_bytes / max(_axes_size(out_axes, mesh_shape), 1)
+        cost._add_comm("all_reduce", eqn.primitive.name,
+                       2 * (na - 1) / na * local_out)
 
 
 def estimate_plan_cost(jaxpr, invar_specs: Sequence[Optional[tuple]],
@@ -182,6 +241,8 @@ def estimate_plan_cost(jaxpr, invar_specs: Sequence[Optional[tuple]],
                 walk(sub.jaxpr if hasattr(sub, "jaxpr") else sub)
             elif eqn.primitive.name == "dot_general":
                 _dot_cost(eqn, specs, mesh_shape, cost)
+            elif eqn.primitive.name == "conv_general_dilated":
+                _conv_cost(eqn, specs, mesh_shape, cost)
 
     walk(jaxpr)
     return cost
@@ -208,13 +269,17 @@ def choose_param_plan(jaxpr, params, base_specs, mesh, axis: str = "mp",
         if chosen[i] is not None:
             continue
         shape = p._value.shape if hasattr(p, "_value") else p.shape
-        if len(shape) != 2:
+        if len(shape) < 2:
             continue
+        # candidates: replicated, plus a single-axis split on each dim
+        # that divides evenly (covers Linear row/col, conv Cout/Cin and
+        # stacked-expert leading dims)
         candidates = [None]
-        if shape[0] % nax == 0:
-            candidates.append((axis, None))
-        if shape[1] % nax == 0:
-            candidates.append((None, axis))
+        for d, s in enumerate(shape):
+            if s % nax == 0 and s >= nax:
+                spec = [None] * len(shape)
+                spec[d] = axis
+                candidates.append(tuple(spec))
         if len(candidates) == 1:
             continue
         best, best_cost = None, None

@@ -65,6 +65,13 @@ class Undefined:
 
 UNDEFINED = Undefined()
 
+# return-value slot sentinel for returns lowered inside traced loops
+# (``rv = RET_UNSET`` before the loop; the slot is only read when the
+# paired return-flag is True).  A DISTINCT instance from UNDEFINED: only
+# this sentinel opts into select-with-zero-fill in convert_ifelse —
+# genuinely unbound user locals must keep erroring.
+RET_UNSET = Undefined.__new__(type("RetUnset", (Undefined,), {}))
+
 
 def _unwrap(v):
     return v._value if isinstance(v, Tensor) else v
@@ -78,6 +85,57 @@ def _is_tracer(v):
 # runtime converters (the _jst namespace inside transformed code)
 # ---------------------------------------------------------------------------
 
+def _select_with_unset(pred, true_fn, false_fn, in_values):
+    """Traced if/else where a branch may yield the RET_UNSET sentinel
+    (a return-value slot not yet assigned): both branches run in the
+    current trace (they are pure generated fns) and leaves select
+    element-wise, with a sentinel SLOT on one side zero-filled with the
+    other side's whole pytree structure (so ``return a, b`` in a loop
+    works — the slot adopts the tuple shape).  Validity is tracked by
+    the paired return flag, so the zeros are never observed (reference
+    analogue: RETURN_NO_VALUE init in return_transformer.py:122)."""
+    from ..core.pytree import flatten_tensors, unflatten_tensors
+    out_t = true_fn(*in_values)
+    out_f = false_fn(*in_values)
+    pv = jnp.asarray(_unwrap(pred)).astype(bool).reshape(())
+
+    def select_slot(t, f):
+        if t is RET_UNSET and f is RET_UNSET:
+            return RET_UNSET
+        if t is RET_UNSET:
+            t = jax.tree_util.tree_map(
+                lambda v: Tensor(jnp.zeros_like(_unwrap(v)))
+                if isinstance(v, Tensor) else jnp.zeros_like(v), f,
+                is_leaf=lambda v: isinstance(v, Tensor))
+        elif f is RET_UNSET:
+            f = jax.tree_util.tree_map(
+                lambda v: Tensor(jnp.zeros_like(_unwrap(v)))
+                if isinstance(v, Tensor) else jnp.zeros_like(v), t,
+                is_leaf=lambda v: isinstance(v, Tensor))
+        raw_t, td_t, fl_t = flatten_tensors(t)
+        raw_f, td_f, fl_f = flatten_tensors(f)
+        if td_t != td_f:
+            raise ValueError(
+                "control flow: branches must return the same pytree "
+                f"structure (got {td_t} vs {td_f})")
+        leaves = [jnp.where(pv, a, b) for a, b in zip(raw_t, raw_f)]
+        flags = [ft or ff for ft, ff in zip(fl_t, fl_f)]
+        return unflatten_tensors(leaves, td_t, flags)
+
+    if isinstance(out_t, (tuple, list)) and \
+            isinstance(out_f, (tuple, list)) and len(out_t) == len(out_f):
+        # locals tuples from generated branch fns: select slot-wise so a
+        # RET_UNSET slot can adopt the other side's nested structure
+        return tuple(select_slot(t, f) for t, f in zip(out_t, out_f))
+    return select_slot(out_t, out_f)
+
+
+def _contains_unset(values):
+    from ..core.pytree import flatten_tensors
+    return any(leaf is RET_UNSET
+               for leaf in flatten_tensors(tuple(values))[0])
+
+
 def convert_ifelse(pred, true_fn, false_fn, in_values):
     """if/else over possibly-traced predicate.
 
@@ -85,6 +143,8 @@ def convert_ifelse(pred, true_fn, false_fn, in_values):
     branches read) and return the tuple of locals the branches assign.
     """
     if _is_tracer(pred):
+        if _contains_unset(in_values):
+            return _select_with_unset(pred, true_fn, false_fn, in_values)
         from ..static.control_flow import cond
         return cond(pred, lambda: true_fn(*in_values),
                     lambda: false_fn(*in_values))
@@ -95,7 +155,10 @@ def convert_ifelse(pred, true_fn, false_fn, in_values):
 
 def _zero_like(probe):
     """A zero-valued init matching a probe value's type (for loop carries
-    that are assigned before read every iteration)."""
+    that are assigned before read every iteration).  Tuples (e.g. a
+    multi-value return slot) zero element-wise, keeping the structure."""
+    if isinstance(probe, (tuple, list)):
+        return type(probe)(_zero_like(p) for p in probe)
     if isinstance(probe, Tensor):
         return Tensor(jnp.zeros_like(probe._value))
     if isinstance(probe, bool):
@@ -109,7 +172,7 @@ def _zero_like(probe):
 
 def _traced_while(cond_fn, body_fn, loop_vars):
     from ..static.control_flow import while_loop
-    if any(v is UNDEFINED for v in loop_vars):
+    if any(v is UNDEFINED or v is RET_UNSET for v in loop_vars):
         # body-local temps (e.g. a nested loop's iterator/guard flags)
         # are unbound at loop entry but assigned before read every
         # iteration: probe one body evaluation for their types and
@@ -121,7 +184,8 @@ def _traced_while(cond_fn, body_fn, loop_vars):
         # call — an accepted trace-time hazard, like jax re-tracing
         probe = body_fn(*loop_vars)
         for v, p in zip(loop_vars, probe):
-            if v is UNDEFINED and p is UNDEFINED:
+            if (v is UNDEFINED or v is RET_UNSET) and \
+                    (p is UNDEFINED or p is RET_UNSET):
                 # e.g. a local only assigned under a traced conditional:
                 # one body evaluation cannot determine its type, and
                 # lax.while_loop would fail on the sentinel with an
@@ -133,7 +197,7 @@ def _traced_while(cond_fn, body_fn, loop_vars):
                     "under a traced conditional). Initialize it before "
                     "the loop.")
         loop_vars = tuple(
-            _zero_like(p) if v is UNDEFINED else v
+            _zero_like(p) if (v is UNDEFINED or v is RET_UNSET) else v
             for v, p in zip(loop_vars, probe))
     out = while_loop(cond_fn, body_fn, list(loop_vars))
     return tuple(out)
@@ -447,6 +511,100 @@ def _lower_returns(func_def):
         restructured = _restructure_returns(
             restructured + [ast.Return(value=ast.Constant(value=None))])
     func_def.body = restructured
+
+
+# ---------------------------------------------------------------------------
+# pass 0: return-inside-loop lowering (return flag + value slot)
+# ---------------------------------------------------------------------------
+
+class _ReturnInLoopLowering(ast.NodeTransformer):
+    """Lowers ``return <expr>`` inside loops into flag dataflow the later
+    passes can convert (reference
+    ``python/paddle/jit/dy2static/return_transformer.py:122`` — their
+    RETURN_NO_VALUE init plays the role of our RET_UNSET sentinel):
+
+        __ret_flag = False          # before the loop
+        __ret_val  = _jst.RET_UNSET
+        for/while ...:
+            ... __ret_flag = True; __ret_val = expr; break ...
+        if __ret_flag:
+            return __ret_val        # pass 1 else-absorbs; pass 2 lowers
+                                    # the injected break
+
+    One flag/value pair per function; nested loops compose because the
+    inner loop's synthesized post-loop ``if __ret_flag: return __ret_val``
+    is itself a return inside the outer loop, which the outer visit
+    lowers to ``if __ret_flag: __ret_flag = True; ... break`` — i.e. a
+    plain flag-break cascade.  Bare ``return`` (no value) keeps the
+    existing clear trace-time error path.  Returns inside a loop's
+    ``else`` clause are function-scope (they run after the loop) and are
+    left to passes 1/2.
+    """
+
+    def __init__(self):
+        self.flag = "__ptpu_ret_flag"
+        self.val = "__ptpu_ret_val"
+        self.used = False
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def _replace_returns(self, stmts):
+        """Replace value-returns in this statement list (descending into
+        If branches; loops at this depth were already visited bottom-up
+        and contain no returns).  Returns None when a bare return is
+        found (caller leaves the loop unlowered)."""
+        out = []
+        for s in stmts:
+            if isinstance(s, ast.Return):
+                if s.value is None:
+                    return None
+                out.append(ast.Assign(targets=[_name_store(self.flag)],
+                                      value=ast.Constant(value=True)))
+                out.append(ast.Assign(targets=[_name_store(self.val)],
+                                      value=s.value))
+                out.append(ast.Break())
+                return out  # rest unreachable
+            if isinstance(s, ast.If):
+                body = self._replace_returns(s.body)
+                orelse = self._replace_returns(s.orelse)
+                if body is None or orelse is None:
+                    return None
+                out.append(ast.If(test=s.test, body=body or [ast.Pass()],
+                                  orelse=orelse))
+                continue
+            out.append(s)
+        return out
+
+    def _lower_loop(self, node):
+        self.generic_visit(node)  # inner loops first (bottom-up)
+        if not _has_node(node.body, (ast.Return,)):
+            return node
+        new_body = self._replace_returns(node.body)
+        if new_body is None:
+            return node  # bare return: keep the clear fallback error
+        node.body = new_body or [ast.Pass()]
+        self.used = True
+        init = [ast.Assign(targets=[_name_store(self.flag)],
+                           value=ast.Constant(value=False)),
+                ast.Assign(targets=[_name_store(self.val)],
+                           value=_jst_attr("RET_UNSET"))]
+        post = ast.If(test=_name_load(self.flag),
+                      body=[ast.Return(value=_name_load(self.val))],
+                      orelse=[])
+        return init + [node, post]
+
+    visit_While = _lower_loop
+    visit_For = _lower_loop
 
 
 # ---------------------------------------------------------------------------
@@ -970,6 +1128,16 @@ def convert_to_static(fn):
         _cache_put(fn, fn)
         return fn
     func_def.decorator_list = []
+
+    # pass 0: returns inside loops -> flag/value slots + break (must run
+    # first so pass 1 sees the synthesized post-loop return-if and pass 2
+    # sees the injected break).
+    ril = _ReturnInLoopLowering()
+    ril_body = []
+    for s in func_def.body:
+        r = ril.visit(s)
+        ril_body.extend(r if isinstance(r, list) else [r])
+    func_def.body = ril_body
 
     # pass 1: early-return restructuring; pass 2: break/continue lowering.
     # Both are pure AST->AST and must run before the control-flow

@@ -14,8 +14,8 @@ __all__ = [
     "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
     "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
     "log_loss", "square_error_cost", "sigmoid_focal_loss", "dice_loss",
-    "ctc_loss", "poisson_nll_loss", "multi_label_soft_margin_loss",
-    "soft_margin_loss",
+    "ctc_loss", "rnnt_loss", "poisson_nll_loss",
+    "multi_label_soft_margin_loss", "soft_margin_loss",
 ]
 
 
@@ -381,4 +381,93 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     return dispatch("ctc_loss", impl,
                     (log_probs, labels, input_lengths, label_lengths),
+                    nondiff_mask=[False, True, True, True])
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss as a lax.scan dynamic program (reference:
+    ``python/paddle/nn/functional/loss.py:1955`` binding the external
+    warp-transducer library via ``phi/kernels/impl/warprnnt_kernel_impl.h``).
+
+    ``input``: [B, Tmax, Umax+1, V] UNNORMALIZED logits — like
+    warp-transducer, log_softmax is applied internally.  ``label``:
+    [B, Umax] int; per-sequence lengths in ``input_lengths`` /
+    ``label_lengths``.
+
+    DP formulation (one scan over T, inner scan over U for the
+    within-row label recurrence — the lattice cell (t, u) sums the
+    blank arc from (t-1, u) and the label arc from (t, u-1)):
+
+        alpha[0, 0] = 0
+        alpha[0, u] = alpha[0, u-1] + lp_label[0, u-1]
+        alpha[t, u] = logaddexp(alpha[t-1, u] + lp_blank[t-1, u],
+                                alpha[t, u-1] + lp_label[t, u-1])
+        loss = -(alpha[T-1, U] + lp_blank[T-1, U])
+
+    FastEmit (arXiv:2010.11148) follows warp-transducer's formulation —
+    label-emission GRADIENTS scale by (1 + lambda) while the loss value
+    is the standard NLL; implemented as the STE-style
+    ``lp + lambda*(lp - stop_gradient(lp))`` on the label arcs.
+    Gradients w.r.t. ``input`` flow through the scans via autodiff (the
+    reference ships a hand-written backward kernel instead).
+    """
+    if reduction not in ("none", "mean", "sum"):
+        raise ValueError(
+            f"rnnt_loss reduction must be none/mean/sum, got {reduction!r}")
+
+    def impl(acts, lbl, in_len, lbl_len):
+        if acts.ndim != 4:
+            raise ValueError(
+                f"rnnt_loss input must be [B, Tmax, Umax+1, V], got "
+                f"rank {acts.ndim}")
+        B, T, U1, V = acts.shape
+        U = U1 - 1
+        if lbl.shape != (B, U):
+            raise ValueError(
+                f"rnnt_loss label must be [B, {U}] for input U+1={U1}, "
+                f"got {list(lbl.shape)}")
+        lp = jax.nn.log_softmax(acts.astype(jnp.float32), axis=-1)
+        lp_blank = lp[:, :, :, blank]                       # [B, T, U+1]
+        # label arc at (t, u) consumes label[u]: [B, T, U]
+        lbl_idx = jnp.broadcast_to(lbl.astype(jnp.int32)[:, None, :, None],
+                                   (B, T, U, 1))
+        lp_label = jnp.take_along_axis(lp[:, :, :U, :], lbl_idx,
+                                       axis=3)[..., 0]
+        if fastemit_lambda:
+            lp_label = lp_label + fastemit_lambda * (
+                lp_label - jax.lax.stop_gradient(lp_label))
+
+        # t = 0 row: pure label arcs
+        alpha0 = jnp.concatenate(
+            [jnp.zeros((B, 1), lp.dtype),
+             jnp.cumsum(lp_label[:, 0, :], axis=1)], axis=1)  # [B, U+1]
+
+        def row(alpha_prev, t):
+            from_blank = alpha_prev + lp_blank[:, t - 1, :]   # [B, U+1]
+            lab_t = lp_label[:, t, :]                         # [B, U]
+
+            def cell(a, u):
+                a = jnp.logaddexp(from_blank[:, u], a + lab_t[:, u - 1])
+                return a, a
+
+            _, rest = jax.lax.scan(cell, from_blank[:, 0],
+                                   jnp.arange(1, U1))
+            new = jnp.concatenate(
+                [from_blank[:, :1], rest.T], axis=1) if U else from_blank
+            return new, new
+
+        _, rows = jax.lax.scan(row, alpha0, jnp.arange(1, T))
+        all_rows = jnp.concatenate([alpha0[None], rows], axis=0)  # [T,B,U+1]
+
+        t_idx = jnp.clip(in_len.astype(jnp.int32) - 1, 0, T - 1)
+        u_idx = jnp.clip(lbl_len.astype(jnp.int32), 0, U)
+        barange = jnp.arange(B)
+        alpha_final = all_rows[t_idx, barange, u_idx]
+        final_blank = lp_blank[barange, t_idx, u_idx]
+        loss = -(alpha_final + final_blank)
+        return _reduce_loss(loss.astype(acts.dtype), reduction)
+
+    return dispatch("rnnt_loss", impl,
+                    (input, label, input_lengths, label_lengths),
                     nondiff_mask=[False, True, True, True])

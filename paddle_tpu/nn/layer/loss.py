@@ -9,7 +9,8 @@ __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "BCEWithLogitsLoss", "SmoothL1Loss", "KLDivLoss",
            "MarginRankingLoss", "HingeEmbeddingLoss", "CosineEmbeddingLoss",
            "TripletMarginLoss", "SigmoidFocalLoss", "SoftMarginLoss",
-           "MultiLabelSoftMarginLoss", "PoissonNLLLoss", "CTCLoss"]
+           "MultiLabelSoftMarginLoss", "PoissonNLLLoss", "CTCLoss",
+           "RNNTLoss"]
 
 
 class CrossEntropyLoss(Layer):
@@ -188,6 +189,25 @@ class PoissonNLLLoss(Layer):
 
     def forward(self, input, label):
         return F.poisson_nll_loss(input, label, *self.args)
+
+
+class RNNTLoss(Layer):
+    """RNN-Transducer loss layer (reference
+    ``python/paddle/nn/layer/loss.py:1261`` over warp-transducer; see
+    ``F.rnnt_loss`` for the lax.scan DP formulation)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank,
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)
 
 
 class CTCLoss(Layer):

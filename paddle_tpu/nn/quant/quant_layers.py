@@ -93,7 +93,10 @@ class QuantizedLinearInfer(Layer):
 
     def forward(self, x):
         from ...ops.pallas import quantized_matmul as pallas_qmm
-        if pallas_qmm.should_use_pallas(x, self.qweight):
+        # Pallas qmm only at decode-sized M (it re-streams the weight per
+        # M-block — see should_use_pallas); larger M takes XLA's fused
+        # int8-upcast matmul, which reads the int8 weight once
+        if pallas_qmm.should_use_pallas(x, self.qweight, max_m=64):
             from ...core.dispatch import dispatch
             has_bias = self.bias is not None
 
@@ -108,8 +111,12 @@ class QuantizedLinearInfer(Layer):
             mask = [False, True, True] + ([False] if has_bias else [])
             return dispatch("quantized_linear", impl, args,
                             nondiff_mask=mask)
+        # dequant INTO the activation dtype: bf16 activations keep the
+        # MXU at bf16 rate and XLA fuses the int8 read + upcast into the
+        # dot (an f32 dequant would halve matmul rate and double bytes)
+        xv = x._value if hasattr(x, "_value") else x
         w = Tensor(_dequant(self.qweight._value, self.weight_scale._value,
-                            axis=-1))
+                            axis=-1).astype(xv.dtype))
         return F.linear(x, w, self.bias)
 
 

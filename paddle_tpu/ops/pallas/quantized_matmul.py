@@ -27,7 +27,13 @@ BLOCK_M = 256
 BLOCK_N = 256
 
 
-def should_use_pallas(x, qweight) -> bool:
+def should_use_pallas(x, qweight, max_m=None) -> bool:
+    """max_m: callers serving matmuls (QuantizedLinearInfer) cap M at
+    decode-sized rows — the kernel streams the whole [K, bn] weight
+    block per M-block, so at prefill-sized M the weight re-read
+    multiplies (measured 13x slower than XLA's fused int8 upcast at
+    M=4096, K=8192 on v5e); at decode M (one weight sweep) it is at the
+    weight-streaming roofline."""
     from ...core.flags import flag
     if not flag("use_int8_matmul_kernel"):
         return False
@@ -39,6 +45,8 @@ def should_use_pallas(x, qweight) -> bool:
     m = 1
     for s in x.shape[:-1]:
         m *= s
+    if max_m is not None and m > max_m:
+        return False
     return (k % 128 == 0 and n % 128 == 0 and m >= 8
             and x.shape[-1] == k)
 
@@ -72,7 +80,27 @@ def _qmm_impl(x2, qweight, scales2, out_dtype, block_m=None, block_n=None):
     bn = block_n if block_n and n % block_n == 0 else \
         (BLOCK_N if n % BLOCK_N == 0 else 128)
     # M is padded up to a whole number of blocks (bounded VMEM per block)
-    bm = block_m if block_m else min(BLOCK_M, max(8, m))
+    if block_m:
+        bm = block_m
+    else:
+        # power-of-two bm (sublane-aligned for every dtype) nearest m
+        bm = 8
+        while bm * 2 <= min(BLOCK_M, m):
+            bm *= 2
+        # VMEM fit for the untuned default: the kernel holds x[bm,K]
+        # (act dtype) + w[K,bn] int8 + fp32 acc/out [bm,bn], and Pallas
+        # double-buffers the streamed inputs — large K (e.g. the 8192
+        # MLP width) overflows the 16 MB scoped limit at bm=256
+        # (measured on v5e; the OOM named this site)
+        act_bytes = jnp.dtype(x2.dtype).itemsize
+
+        def vmem(bmx, bnx):
+            return 2 * (bmx * k * act_bytes + k * bnx) + 8 * bmx * bnx
+        budget = 12 << 20
+        while bm > 8 and vmem(bm, bn) > budget:
+            bm //= 2
+        while bn > 128 and vmem(bm, bn) > budget:
+            bn //= 2
     pad_m = (-m) % bm
     if pad_m:
         x2 = jnp.pad(x2, ((0, pad_m), (0, 0)))

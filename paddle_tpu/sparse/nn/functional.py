@@ -62,23 +62,18 @@ def _assert_eager(coords, name):
             "construction is likewise data-dependent)")
 
 
-def _require_defaults(name, dilation, groups, ndim=3):
-    if any(d != 1 for d in _norm_seq(dilation, ndim)):
-        raise NotImplementedError(f"sparse {name}: dilation != 1 is not "
-                                  "implemented")
-    if groups != 1:
-        raise NotImplementedError(f"sparse {name}: groups != 1 is not "
-                                  "implemented")
-
-
 def _rulebook_conv(x: SparseCooTensor, weight, bias, stride, padding,
-                   subm: bool, name: str):
+                   subm: bool, name: str, dilation=1, groups=1):
     """Shared sparse-conv engine.  x dense shape [N, *spatial, Cin];
-    weight [*kernel, Cin, Cout]."""
+    weight [*kernel, Cin/groups, Cout].  Dilation scales the rulebook's
+    offset enumeration; groups block the channel matmul (reference
+    kernel takes both: ``paddle/phi/kernels/sparse/gpu/conv_kernel.cu:75``)."""
     n_sp = weight.ndim - 2
     kernel = weight.shape[:n_sp]
     stride = _norm_seq(stride, n_sp)
     padding = _norm_seq(padding, n_sp)
+    dilation = _norm_seq(dilation, n_sp)
+    groups = int(groups)
     if subm and any(s != 1 for s in stride):
         raise ValueError(f"{name}: submanifold conv requires stride 1")
 
@@ -87,13 +82,23 @@ def _rulebook_conv(x: SparseCooTensor, weight, bias, stride, padding,
     dense_shape = x.shape
     spatial = dense_shape[1:1 + n_sp]
     cout = weight.shape[-1]
+    cin = dense_shape[-1]
+    if groups < 1 or cin % groups or cout % groups:
+        raise ValueError(
+            f"{name}: groups ({groups}) must divide C_in ({cin}) and "
+            f"C_out ({cout})")
+    if weight.shape[-2] * groups != cin:
+        raise ValueError(
+            f"{name}: weight C_in/groups dim ({weight.shape[-2]}) != "
+            f"C_in/groups ({cin}//{groups})")
 
     if subm:
         out_spatial = list(spatial)
         out_coords = coords
     else:
         out_spatial = [
-            (spatial[i] + 2 * padding[i] - kernel[i]) // stride[i] + 1
+            (spatial[i] + 2 * padding[i]
+             - dilation[i] * (kernel[i] - 1) - 1) // stride[i] + 1
             for i in range(n_sp)]
 
     def keys_of(c_arr, sp):
@@ -110,7 +115,9 @@ def _rulebook_conv(x: SparseCooTensor, weight, bias, stride, padding,
     batch = coords[:, 0].astype(np.int64)
     rule = []  # per offset: (src_rows, out_keys) or None
     for off in offsets:
-        oc = in_sp + np.asarray(padding) - np.asarray(off)
+        # dilation scales each kernel offset's spatial displacement
+        oc = in_sp + np.asarray(padding) - np.asarray(off) * \
+            np.asarray(dilation)
         ok = np.ones(len(coords), bool)
         for i in range(n_sp):
             ok &= (oc[:, i] % stride[i] == 0)
@@ -148,6 +155,8 @@ def _rulebook_conv(x: SparseCooTensor, weight, bias, stride, padding,
     out_vals = jnp.zeros((max(n_out, 1), cout),
                          jnp.result_type(vals.dtype, weight.dtype))
     w = weight.reshape((-1,) + weight.shape[n_sp:])
+    cin_g = cin // groups
+    cout_g = cout // groups
     for oi, r in enumerate(rule):
         if r is None:
             continue
@@ -162,7 +171,17 @@ def _rulebook_conv(x: SparseCooTensor, weight, bias, stride, padding,
         sel = tgt >= 0
         if not sel.any():
             continue
-        contrib = vals[jnp.asarray(src[sel])] @ w[oi]
+        gathered = vals[jnp.asarray(src[sel])]
+        if groups == 1:
+            contrib = gathered @ w[oi]
+        else:
+            # blocked channel matmul: group g's input slice hits its own
+            # [cin_g, cout_g] weight block (output channels partitioned
+            # into consecutive per-group blocks, the dense convention)
+            contrib = jnp.einsum(
+                "ngc,cgo->ngo",
+                gathered.reshape(-1, groups, cin_g),
+                w[oi].reshape(cin_g, groups, cout_g)).reshape(-1, cout)
         out_vals = out_vals.at[jnp.asarray(tgt[sel])].add(
             contrib.astype(out_vals.dtype))
     if bias is not None:
@@ -182,32 +201,32 @@ def _weight_arr(weight):
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NDHWC", name=None):
     """Sparse conv3d (reference sparse/nn/functional/conv.py:207)."""
-    _require_defaults("conv3d", dilation, groups, ndim=3)
     return _rulebook_conv(x, _weight_arr(weight), bias, stride, padding,
-                          subm=False, name="conv3d")
+                          subm=False, name="conv3d", dilation=dilation,
+                          groups=groups)
 
 
 def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
                 groups=1, data_format="NDHWC", key=None, name=None):
     """Submanifold sparse conv3d: output sites == input sites
     (reference sparse/nn/functional/conv.py:313)."""
-    _require_defaults("subm_conv3d", dilation, groups, ndim=3)
     return _rulebook_conv(x, _weight_arr(weight), bias, stride, padding,
-                          subm=True, name="subm_conv3d")
+                          subm=True, name="subm_conv3d", dilation=dilation,
+                          groups=groups)
 
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NHWC", name=None):
-    _require_defaults("conv2d", dilation, groups, ndim=2)
     return _rulebook_conv(x, _weight_arr(weight), bias, stride, padding,
-                          subm=False, name="conv2d")
+                          subm=False, name="conv2d", dilation=dilation,
+                          groups=groups)
 
 
 def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
                 groups=1, data_format="NHWC", key=None, name=None):
-    _require_defaults("subm_conv2d", dilation, groups, ndim=2)
     return _rulebook_conv(x, _weight_arr(weight), bias, stride, padding,
-                          subm=True, name="subm_conv2d")
+                          subm=True, name="subm_conv2d", dilation=dilation,
+                          groups=groups)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0,

@@ -286,7 +286,9 @@ def test_for_else_and_while_else():
                                    np.asarray(want._value))
 
 
-def test_return_inside_loop_concrete_ok_traced_clear_error():
+def test_return_inside_traced_while_loop():
+    # `return` inside a traced while lowers to a return-flag/value slot
+    # + break (reference return_transformer.py:122 RETURN_NO_VALUE form)
     def f(x, limit):
         s = x
         while s.sum() < limit:
@@ -295,11 +297,89 @@ def test_return_inside_loop_concrete_ok_traced_clear_error():
                 return s + 100.0
         return s
 
-    # concrete predicates: plain-python execution stays exact
     static_f = to_static(f)
-    with pytest.raises(NotImplementedError) as ei:
-        static_f(_t([1.0]), _t(100.0))
-    assert "while" in str(ei.value) or "return" in str(ei.value)
+    for v, lim in (([1.0], 100.0),   # inner return fires (32 > 30)
+                   ([1.0], 8.0),     # loop exits first
+                   ([50.0], 10.0)):  # zero-trip loop
+        got = np.asarray(static_f(_t(v), _t(lim))._value)
+        want = np.asarray(f(_t(v), _t(lim))._value)
+        np.testing.assert_allclose(got, want, rtol=1e-6,
+                                   err_msg=f"x={v} limit={lim}")
+
+
+def test_return_inside_for_loop_traced_cond():
+    def f(x):
+        for i in range(5):
+            if x.sum() > i:
+                return x * i
+        return x - 1.0
+
+    static_f = to_static(f)
+    for v in ([1.0, 2.0], [100.0, 1.0], [-5.0, 0.0]):
+        np.testing.assert_allclose(np.asarray(static_f(_t(v))._value),
+                                   np.asarray(f(_t(v))._value), rtol=1e-6)
+
+
+def test_return_inside_nested_loops():
+    def f(x):
+        for i in range(3):
+            for j in range(3):
+                if (x.sum() + i + j) > 4.0:
+                    return x * (i * 10 + j)
+        return x - 7.0
+
+    static_f = to_static(f)
+    for v in ([1.0, 2.0], [-9.0, 0.0], [9.0, 9.0]):
+        np.testing.assert_allclose(np.asarray(static_f(_t(v))._value),
+                                   np.asarray(f(_t(v))._value), rtol=1e-6)
+
+
+def test_return_inside_noniterator_for():
+    def f(x):
+        for w in [0.5, 1.5, 2.5]:
+            if x.sum() < w:
+                return x * w
+        return x * 0.0
+
+    static_f = to_static(f)
+    for v in ([0.4, 0.0], [2.0, 0.0], [9.0, 9.0]):
+        np.testing.assert_allclose(np.asarray(static_f(_t(v))._value),
+                                   np.asarray(f(_t(v))._value), rtol=1e-6)
+
+
+def test_tuple_return_inside_traced_loop():
+    # multi-value `return a, b` in a traced loop: the RET_UNSET slot
+    # must adopt the branch's tuple structure
+    def f(x, lim):
+        s = x
+        while s.sum() < lim:
+            s = s + s
+            if s.sum() > 30.0:
+                return s + 100.0, s
+        return s, s * 2.0
+
+    static_f = to_static(f)
+    for v, lim in (([1.0], 100.0), ([1.0], 8.0)):
+        got = static_f(_t(v), _t(lim))
+        want = f(_t(v), _t(lim))
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g._value),
+                                       np.asarray(w._value), rtol=1e-6,
+                                       err_msg=f"x={v} lim={lim}")
+
+
+def test_bare_return_inside_loop_keeps_clear_error():
+    # `return` with no value inside a traced loop stays on the clear
+    # fallback error path
+    def f(x):
+        for i in range(3):
+            if x.sum() > i:
+                return
+        return x
+
+    static_f = to_static(f)
+    with pytest.raises(NotImplementedError):
+        static_f(_t([5.0]))
 
 
 def test_logical_ops_in_predicate():

@@ -1,0 +1,190 @@
+"""rnnt_loss correctness: the canonical warp-transducer test vector, a
+brute-force path-enumeration reference, gradients by finite difference,
+ragged lengths, and reductions (reference:
+python/paddle/nn/functional/loss.py:1955 over warp-transducer)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _brute_force(lp_blank, lp_label, T, U):
+    """-log sum over all monotonic lattice paths (independent reference:
+    enumerates label-move placements instead of running a DP)."""
+    total = -np.inf
+    for label_pos in itertools.combinations(range(T - 1 + U), U):
+        t = u = 0
+        s = 0.0
+        for i in range(T - 1 + U):
+            if i in label_pos:
+                s += lp_label[t, u]
+                u += 1
+            else:
+                s += lp_blank[t, u]
+                t += 1
+        s += lp_blank[T - 1, U]
+        total = np.logaddexp(total, s)
+    return -total
+
+
+def _np_log_softmax(x):
+    m = x.max(-1, keepdims=True)
+    e = np.exp(x - m)
+    return x - m - np.log(e.sum(-1, keepdims=True))
+
+
+def test_warp_transducer_canonical_vector():
+    # the upstream warp-transducer unit test (test_cpu.cpp small_test):
+    # B1 T2 U2 V5, labels [1, 2], expected cost 4.495666
+    acts = np.array([[
+        [[0.1, 0.6, 0.1, 0.1, 0.1],
+         [0.1, 0.1, 0.6, 0.1, 0.1],
+         [0.1, 0.1, 0.2, 0.8, 0.1]],
+        [[0.1, 0.6, 0.1, 0.1, 0.1],
+         [0.1, 0.1, 0.2, 0.1, 0.1],
+         [0.7, 0.1, 0.2, 0.1, 0.1]],
+    ]], np.float32)
+    labels = np.array([[1, 2]], np.int32)
+    loss = F.rnnt_loss(paddle.to_tensor(acts), paddle.to_tensor(labels),
+                       paddle.to_tensor(np.array([2], np.int64)),
+                       paddle.to_tensor(np.array([2], np.int64)),
+                       blank=0, fastemit_lambda=0.0, reduction="sum")
+    np.testing.assert_allclose(float(loss), 4.495666, rtol=1e-5)
+
+
+def test_matches_brute_force_enumeration():
+    rng = np.random.default_rng(0)
+    B, T, U, V = 3, 4, 3, 6
+    acts = rng.standard_normal((B, T, U + 1, V)).astype(np.float32)
+    labels = rng.integers(1, V, (B, U)).astype(np.int32)
+    loss = F.rnnt_loss(paddle.to_tensor(acts), paddle.to_tensor(labels),
+                       paddle.to_tensor(np.full(B, T, np.int64)),
+                       paddle.to_tensor(np.full(B, U, np.int64)),
+                       blank=0, fastemit_lambda=0.0, reduction="none")
+    lp = _np_log_softmax(acts.astype(np.float64))
+    for b in range(B):
+        lp_blank = lp[b, :, :, 0]
+        lp_label = np.take_along_axis(
+            lp[b, :, :U, :], labels[b][None, :, None], axis=2)[..., 0]
+        want = _brute_force(lp_blank, lp_label, T, U)
+        np.testing.assert_allclose(np.asarray(loss._value)[b], want,
+                                   rtol=1e-5, err_msg=f"batch {b}")
+
+
+def test_ragged_lengths():
+    rng = np.random.default_rng(1)
+    B, T, U, V = 2, 5, 3, 4
+    acts = rng.standard_normal((B, T, U + 1, V)).astype(np.float32)
+    labels = rng.integers(1, V, (B, U)).astype(np.int32)
+    in_len = np.array([3, 5], np.int64)
+    lbl_len = np.array([1, 3], np.int64)
+    loss = F.rnnt_loss(paddle.to_tensor(acts), paddle.to_tensor(labels),
+                       paddle.to_tensor(in_len), paddle.to_tensor(lbl_len),
+                       fastemit_lambda=0.0, reduction="none")
+    lp = _np_log_softmax(acts.astype(np.float64))
+    for b in range(B):
+        tb, ub = int(in_len[b]), int(lbl_len[b])
+        lp_blank = lp[b, :tb, :ub + 1, 0]
+        lp_label = np.take_along_axis(
+            lp[b, :tb, :ub, :], labels[b, :ub][None, :, None],
+            axis=2)[..., 0]
+        want = _brute_force(lp_blank, lp_label, tb, ub)
+        np.testing.assert_allclose(np.asarray(loss._value)[b], want,
+                                   rtol=1e-5, err_msg=f"batch {b}")
+
+
+def test_gradient_finite_difference():
+    rng = np.random.default_rng(2)
+    B, T, U, V = 1, 3, 2, 4
+    acts = rng.standard_normal((B, T, U + 1, V)).astype(np.float64)
+    labels = rng.integers(1, V, (B, U)).astype(np.int32)
+    in_len = np.full(B, T, np.int64)
+    lbl_len = np.full(B, U, np.int64)
+
+    def f(a):
+        x = paddle.to_tensor(a)
+        x.stop_gradient = False
+        loss = F.rnnt_loss(x, paddle.to_tensor(labels),
+                           paddle.to_tensor(in_len),
+                           paddle.to_tensor(lbl_len),
+                           fastemit_lambda=0.0, reduction="sum")
+        return x, loss
+
+    x, loss = f(acts)
+    loss.backward()
+    grad = np.asarray(x.grad._value)
+    # jax computes in f32 (x64 off): eps large enough that the central
+    # difference clears f32 resolution, rtol sized to the O(eps^2) error
+    eps = 1e-3
+    for idx in [(0, 0, 0, 1), (0, 1, 1, 0), (0, 2, 2, 3), (0, 1, 0, 2)]:
+        ap = acts.copy()
+        ap[idx] += eps
+        am = acts.copy()
+        am[idx] -= eps
+        fd = (float(f(ap)[1]) - float(f(am)[1])) / (2 * eps)
+        np.testing.assert_allclose(grad[idx], fd, rtol=5e-3, atol=1e-5,
+                                   err_msg=str(idx))
+
+
+def test_fastemit_scales_label_gradient_not_value():
+    rng = np.random.default_rng(3)
+    acts = rng.standard_normal((1, 3, 3, 4)).astype(np.float32)
+    labels = np.array([[1, 2]], np.int32)
+    args = (paddle.to_tensor(labels),
+            paddle.to_tensor(np.array([3], np.int64)),
+            paddle.to_tensor(np.array([2], np.int64)))
+
+    def run(lam):
+        x = paddle.to_tensor(acts)
+        x.stop_gradient = False
+        loss = F.rnnt_loss(x, *args, fastemit_lambda=lam, reduction="sum")
+        loss.backward()
+        return float(loss), np.asarray(x.grad._value)
+
+    v0, g0 = run(0.0)
+    v1, g1 = run(0.5)
+    np.testing.assert_allclose(v0, v1, rtol=1e-6)   # value unchanged
+    assert np.abs(g1 - g0).max() > 1e-4             # gradients differ
+
+
+def test_rnnt_loss_layer():
+    import paddle_tpu.nn as nn
+    rng = np.random.default_rng(5)
+    acts = rng.standard_normal((1, 2, 3, 5)).astype(np.float32)
+    labels = np.array([[1, 2]], np.int32)
+    layer = nn.RNNTLoss(reduction="sum", fastemit_lambda=0.0)
+    got = float(layer(paddle.to_tensor(acts), paddle.to_tensor(labels),
+                      paddle.to_tensor(np.array([2], np.int64)),
+                      paddle.to_tensor(np.array([2], np.int64))))
+    want = float(F.rnnt_loss(paddle.to_tensor(acts),
+                             paddle.to_tensor(labels),
+                             paddle.to_tensor(np.array([2], np.int64)),
+                             paddle.to_tensor(np.array([2], np.int64)),
+                             fastemit_lambda=0.0, reduction="sum"))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_reductions_and_validation():
+    rng = np.random.default_rng(4)
+    acts = rng.standard_normal((2, 3, 2, 4)).astype(np.float32)
+    labels = np.array([[1], [2]], np.int32)
+    ils = paddle.to_tensor(np.full(2, 3, np.int64))
+    lls = paddle.to_tensor(np.full(2, 1, np.int64))
+    a = paddle.to_tensor(acts)
+    lb = paddle.to_tensor(labels)
+    none = np.asarray(F.rnnt_loss(a, lb, ils, lls,
+                                  reduction="none")._value)
+    s = float(F.rnnt_loss(a, lb, ils, lls, reduction="sum"))
+    m = float(F.rnnt_loss(a, lb, ils, lls, reduction="mean"))
+    np.testing.assert_allclose(s, none.sum(), rtol=1e-6)
+    np.testing.assert_allclose(m, none.sum() / 2, rtol=1e-6)
+    with pytest.raises(ValueError, match="reduction"):
+        F.rnnt_loss(a, lb, ils, lls, reduction="bogus")
+    with pytest.raises(ValueError, match="rank"):
+        F.rnnt_loss(paddle.to_tensor(acts[0]), lb, ils, lls)
+    with pytest.raises(ValueError, match="label"):
+        F.rnnt_loss(a, paddle.to_tensor(labels[:, :0]), ils, lls)

@@ -193,13 +193,76 @@ def test_sparse_conv_chain_trains_shapes():
     assert h.nnz() > 0
 
 
-def test_sparse_conv_rejects_dilation_groups():
-    st, _, _ = _random_sparse((1, 4, 4, 4), 2, seed=13)
-    w = paddle.to_tensor(np.zeros((3, 3, 3, 2, 2), np.float32))
-    with pytest.raises(NotImplementedError):
-        sparse.nn.functional.conv3d(st, w, dilation=2)
-    with pytest.raises(NotImplementedError):
-        sparse.nn.functional.subm_conv3d(st, w, groups=2)
+def _dense_conv3d_full(x_ndhwc, w, stride, padding, dilation=1, groups=1):
+    dn = lax.conv_dimension_numbers(x_ndhwc.shape, w.shape,
+                                    ("NDHWC", "DHWIO", "NDHWC"))
+    return lax.conv_general_dilated(
+        jnp.asarray(x_ndhwc), jnp.asarray(w),
+        window_strides=(stride,) * 3,
+        padding=[(padding, padding)] * 3,
+        rhs_dilation=(dilation,) * 3,
+        dimension_numbers=dn, feature_group_count=groups)
+
+
+def test_conv3d_dilation_matches_dense():
+    st, dense, mask = _random_sparse((1, 7, 7, 7), 3, density=0.25,
+                                     seed=13)
+    rng = np.random.default_rng(14)
+    w = rng.standard_normal((3, 3, 3, 3, 4)).astype(np.float32) * 0.2
+    out = sparse.nn.functional.conv3d(st, paddle.to_tensor(w), dilation=2)
+    ref = np.asarray(_dense_conv3d_full(dense, w, 1, 0, dilation=2))
+    got = np.asarray(out.to_dense()._value)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_subm_conv3d_dilation_matches_dense():
+    st, dense, mask = _random_sparse((1, 7, 7, 7), 3, density=0.3, seed=15)
+    rng = np.random.default_rng(16)
+    w = rng.standard_normal((3, 3, 3, 3, 4)).astype(np.float32) * 0.2
+    # dilated subm: pad = dilation * (k // 2) keeps out sites == in sites
+    out = sparse.nn.functional.subm_conv3d(st, paddle.to_tensor(w),
+                                           padding=2, dilation=2)
+    ref = np.asarray(_dense_conv3d_full(dense, w, 1, 2, dilation=2))
+    got = np.asarray(out.to_dense()._value)
+    np.testing.assert_allclose(got[mask], ref[mask], rtol=1e-4, atol=1e-5)
+    assert np.abs(got[~mask]).max() == 0.0
+
+
+def test_conv3d_groups_matches_dense():
+    st, dense, mask = _random_sparse((1, 6, 6, 6), 4, density=0.25,
+                                     seed=17)
+    rng = np.random.default_rng(18)
+    # groups=2: weight [*k, Cin/groups, Cout]
+    w = rng.standard_normal((2, 2, 2, 2, 6)).astype(np.float32) * 0.3
+    out = sparse.nn.functional.conv3d(st, paddle.to_tensor(w), groups=2)
+    # dense reference weight for feature_group_count: [*k, Cin/g, Cout]
+    ref = np.asarray(_dense_conv3d_full(dense, w, 1, 0, groups=2))
+    got = np.asarray(out.to_dense()._value)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv3d_groups_dilation_stride_combined():
+    st, dense, mask = _random_sparse((1, 8, 8, 8), 4, density=0.2, seed=19)
+    rng = np.random.default_rng(20)
+    w = rng.standard_normal((3, 3, 3, 2, 4)).astype(np.float32) * 0.2
+    out = sparse.nn.functional.conv3d(st, paddle.to_tensor(w), stride=2,
+                                      padding=1, dilation=2, groups=2)
+    ref = np.asarray(_dense_conv3d_full(dense, w, 2, 1, dilation=2,
+                                        groups=2))
+    got = np.asarray(out.to_dense()._value)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_conv_groups_validation():
+    st, _, _ = _random_sparse((1, 4, 4, 4), 3, seed=21)
+    w = paddle.to_tensor(np.zeros((3, 3, 3, 3, 4), np.float32))
+    with pytest.raises(ValueError, match="groups"):
+        sparse.nn.functional.conv3d(st, w, groups=2)  # 3 % 2 != 0
+    w_bad = paddle.to_tensor(np.zeros((3, 3, 3, 3, 4), np.float32))
+    with pytest.raises(ValueError, match="C_in"):
+        # weight Cin/groups dim inconsistent with groups=1 channel count
+        sparse.nn.functional.conv3d(
+            _random_sparse((1, 4, 4, 4), 6, seed=22)[0], w_bad)
 
 
 def test_sparse_softmax_batched_csr():
