@@ -72,10 +72,31 @@ class CallbackList:
 
 
 class ProgBarLogger(Callback):
+    """Training progress (reference hapi ProgBarLogger): verbose=1 is an
+    in-place progress bar with ETA and samples/s; verbose=2 prints a
+    line every ``log_freq`` steps with throughput."""
+
     def __init__(self, log_freq=1, verbose=2):
         super().__init__()
         self.log_freq = log_freq
         self.verbose = verbose
+
+    def _fmt(self, logs):
+        return ", ".join(f"{k}: {v:.4f}" if isinstance(v, float)
+                         else f"{k}: {v}"
+                         for k, v in (logs or {}).items())
+
+    def _rate_eta(self):
+        dur = max(time.time() - self._start, 1e-9)
+        ips = None
+        bs = self.params.get("batch_size")
+        if bs:
+            ips = self.steps * bs / dur
+        total = self.params.get("steps")
+        eta = None
+        if total:
+            eta = dur / max(self.steps, 1) * (total - self.steps)
+        return ips, eta
 
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch
@@ -84,17 +105,29 @@ class ProgBarLogger(Callback):
 
     def on_train_batch_end(self, step, logs=None):
         self.steps += 1
-        if self.verbose and self.steps % self.log_freq == 0:
-            items = ", ".join(f"{k}: {v:.4f}" if isinstance(v, float)
-                              else f"{k}: {v}" for k, v in (logs or {}).items())
-            print(f"epoch {self.epoch} step {step}: {items}")
+        if not self.verbose:
+            return
+        ips, eta = self._rate_eta()
+        extra = ""
+        if ips is not None:
+            extra += f", {ips:.1f} samples/s"
+        if eta is not None:
+            extra += f", ETA {eta:.0f}s"
+        if self.verbose == 1:
+            total = self.params.get("steps")
+            frac = f"{self.steps}/{total}" if total else f"{self.steps}"
+            print(f"\repoch {self.epoch} [{frac}] "
+                  f"{self._fmt(logs)}{extra}   ", end="", flush=True)
+        elif self.steps % self.log_freq == 0:
+            print(f"epoch {self.epoch} step {step}: "
+                  f"{self._fmt(logs)}{extra}")
 
     def on_epoch_end(self, epoch, logs=None):
+        if self.verbose == 1:
+            print()
         if self.verbose:
             dur = time.time() - self._start
-            items = ", ".join(f"{k}: {v:.4f}" if isinstance(v, float)
-                              else f"{k}: {v}" for k, v in (logs or {}).items())
-            print(f"epoch {epoch} done in {dur:.1f}s: {items}")
+            print(f"epoch {epoch} done in {dur:.1f}s: {self._fmt(logs)}")
 
 
 class ModelCheckpoint(Callback):

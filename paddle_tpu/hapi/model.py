@@ -96,8 +96,25 @@ class Model:
             return batch[:-1], batch[-1:]
         return (batch,), ()
 
+    def _update_train_metrics(self, outputs, labels):
+        """Reference hapi computes metrics on TRAIN batches too; returns
+        the accumulated values ([] when no metrics configured)."""
+        if not self._metrics:
+            return []
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        vals = []
+        for m in self._metrics:
+            res = m.compute(*outs, *labels)
+            m.update(res)
+            vals.append(m.accumulate())
+        return vals
+
     # ---- steps ----
     def train_batch(self, inputs, labels=None, update=True):
+        """Returns ``[loss]``, or ``([loss], metric_values)`` when
+        metrics were configured in ``prepare`` (reference Model.train_batch
+        contract).  In the compiled path the forward's outputs ride along
+        as TrainStep aux outputs so metrics cost no second forward."""
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if isinstance(labels, (list, tuple)) else (
@@ -105,9 +122,20 @@ class Model:
         if self._use_jit and self._train_step is None:
             from ..jit.train_step import TrainStep
             amp_level = self._amp_level
+            want_outputs = bool(self._metrics)
 
             def loss_fn(net, *args):
                 n_in = len(inputs)
+
+                def run():
+                    outs = net(*args[:n_in])
+                    loss = self._compute_loss(outs, list(args[n_in:]))
+                    if want_outputs:
+                        outs_t = outs if isinstance(outs, (list, tuple)) \
+                            else [outs]
+                        return (loss,) + tuple(outs_t)
+                    return loss
+
                 if amp_level == "O1":
                     # the dispatch-level cast hook applies while TRACING,
                     # so O1 autocast composes with the compiled step (bf16
@@ -115,10 +143,8 @@ class Model:
                     # for bf16)
                     from .. import amp as _amp
                     with _amp.auto_cast(level="O1"):
-                        outs = net(*args[:n_in])
-                        return self._compute_loss(outs, list(args[n_in:]))
-                outs = net(*args[:n_in])
-                return self._compute_loss(outs, list(args[n_in:]))
+                        return run()
+                return run()
 
             step = TrainStep(self.network, loss_fn, self._optimizer)
             if step._update_fn is not None:
@@ -126,8 +152,12 @@ class Model:
             else:
                 self._train_step = False  # unsupported optimizer: eager path
         if self._train_step:
-            loss = self._train_step(*inputs, *labels)
-            return [float(np.asarray(loss._value))]
+            out = self._train_step(*inputs, *labels)
+            if isinstance(out, tuple):
+                loss, outs = out[0], list(out[1:])
+                metrics = self._update_train_metrics(outs, labels)
+                return [float(np.asarray(loss._value))], metrics
+            return [float(np.asarray(out._value))]
         if self._amp_level == "O1":
             from .. import amp as _amp
             with _amp.auto_cast(level="O1"):
@@ -140,7 +170,7 @@ class Model:
                     self._scaler.step(self._optimizer)
                     self._scaler.update()
                     self._optimizer.clear_grad()
-                return [float(np.asarray(loss._value))]
+                return self._train_result(loss, outputs, labels)
         else:
             outputs = self.network(*inputs)
             loss = self._compute_loss(outputs, labels)
@@ -148,7 +178,13 @@ class Model:
         if update:
             self._optimizer.step()
             self._optimizer.clear_grad()
-        return [float(np.asarray(loss._value))]
+        return self._train_result(loss, outputs, labels)
+
+    def _train_result(self, loss, outputs, labels):
+        losses = [float(np.asarray(loss._value))]
+        if self._metrics:
+            return losses, self._update_train_metrics(outputs, labels)
+        return losses
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -183,7 +219,17 @@ class Model:
         loader = self._make_loader(train_data, batch_size, shuffle,
                                    num_workers)
         eval_loader = self._make_loader(eval_data, batch_size, False)
+        try:
+            n_steps = len(loader)
+        except TypeError:
+            n_steps = None
+        # a user-supplied DataLoader carries its own batch size; fit's
+        # batch_size argument only applied when WE built the loader
+        eff_bs = batch_size
+        if isinstance(train_data, DataLoader):
+            eff_bs = getattr(train_data, "batch_size", None)
         cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                batch_size=eff_bs, steps=n_steps,
                                 log_freq=log_freq, verbose=verbose,
                                 save_dir=save_dir, save_freq=save_freq,
                                 metrics=[n for m in self._metrics
@@ -200,8 +246,18 @@ class Model:
             for step, batch in enumerate(loader):
                 cbks.on_train_batch_begin(step)
                 ins, lbls = self._split_batch(batch)
-                losses = self.train_batch(list(ins), list(lbls))
+                out = self.train_batch(list(ins), list(lbls))
+                if isinstance(out, tuple):
+                    losses, mvals = out
+                else:
+                    losses, mvals = out, []
                 logs = {"loss": losses[0]}
+                for m, v in zip(self._metrics, mvals):
+                    names = m.name() if isinstance(m.name(), list) \
+                        else [m.name()]
+                    vals = v if isinstance(v, (list, tuple)) else [v]
+                    for n, val in zip(names, vals):
+                        logs[n] = val
                 cbks.on_train_batch_end(step, logs)
                 iters += 1
                 if num_iters is not None and iters >= num_iters:

@@ -102,6 +102,53 @@ class TopKGate(Layer):
                                        n_diff_outputs=2)
         return combine, disp, aux
 
+    def route(self, x):
+        """Sparse routing (the Megablocks-style alternative to the dense
+        [T, E, C] tensors): returns (eid [T,k], pos [T,k], w [T,k],
+        keep [T,k] bool, aux) with the SAME rank/capacity semantics as
+        ``forward`` — position = the token's arrival rank in its
+        expert's buffer, ``keep`` false for overflow.  The [T, E, C]
+        one-hots are never built: dispatch/combine become gather/scatter
+        instead of einsums whose FLOPs rival the experts themselves
+        (2*T*E*C*D — measured in BASELINE.md's MoE table)."""
+        from .....core.dispatch import dispatch as _dispatch
+        num_experts = self.num_experts
+        top_k = self.top_k
+        capacity = self.capacity(
+            x.shape[0] * (x.shape[1] if x.ndim == 3 else 1))
+
+        def impl(hidden, wg):
+            flat = hidden.reshape(-1, hidden.shape[-1])
+            logits = flat @ wg
+            gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            top_gates, top_idx = jax.lax.top_k(gates, top_k)
+            top_gates = top_gates / jnp.maximum(
+                jnp.sum(top_gates, -1, keepdims=True), 1e-9)
+            prev = jnp.zeros((num_experts,), jnp.float32)
+            poss, keeps = [], []
+            for slot in range(top_k):
+                onehot = jax.nn.one_hot(top_idx[:, slot], num_experts,
+                                        dtype=jnp.float32)
+                pos_in_e = jnp.cumsum(onehot, axis=0) - onehot + prev[None]
+                prev = prev + jnp.sum(onehot, axis=0)
+                pos = jnp.sum(pos_in_e * onehot, axis=1).astype(jnp.int32)
+                poss.append(pos)
+                keeps.append(pos < capacity)
+            me = jnp.mean(gates, axis=0)
+            ce = jnp.mean(jax.nn.one_hot(top_idx[:, 0], num_experts,
+                                         dtype=gates.dtype), axis=0)
+            aux = num_experts * jnp.sum(me * ce)
+            return (top_gates.astype(hidden.dtype),
+                    aux.astype(jnp.float32),
+                    top_idx.astype(jnp.int32),
+                    jnp.stack(poss, axis=1),
+                    jnp.stack(keeps, axis=1))
+
+        w, aux, eid, pos, keep = _dispatch("moe_gate_route", impl,
+                                           (x, self.gate.weight),
+                                           n_diff_outputs=2)
+        return eid, pos, w, keep, aux
+
 
 class NaiveGate(TopKGate):
     """Top-k softmax gate without aux loss emphasis (reference naive_gate)."""

@@ -33,14 +33,36 @@ class MoELayer(Layer):
     def __init__(self, d_model, d_hidden, num_experts, top_k=2,
                  capacity_factor=1.25, gate: Optional[Layer] = None,
                  activation: str = "gelu", expert_axis: Optional[str] = None,
-                 dropless: bool = False, name=None):
+                 dropless: bool = False, dispatch_mode: Optional[str] = None,
+                 name=None):
         super().__init__()
+        if dispatch_mode not in (None, "scatter", "dense"):
+            raise ValueError(
+                f"dispatch_mode must be scatter/dense/None, got "
+                f"{dispatch_mode!r}")
         self.d_model = d_model
         self.d_hidden = d_hidden
         self.num_experts = num_experts
         self.activation = activation
         self.gate = gate or TopKGate(d_model, num_experts, top_k,
                                      capacity_factor, dropless=dropless)
+        # scatter (Megablocks-style gather/matmul/scatter) is the
+        # single-device default: the dense [T,E,C] dispatch einsums cost
+        # 2*T*E*C*D FLOPs EACH — at bench scale that rivals the expert
+        # matmuls themselves and grows with E (capacity-sweep table in
+        # BASELINE.md).  The dense einsum remains the EP-sharded path
+        # (GSPMD lowers it to the reference's all-to-all) and the path
+        # for custom gates that only implement the dense forward
+        # contract (no route()/capacity()).
+        gate_routes = hasattr(self.gate, "route") and \
+            hasattr(self.gate, "capacity")
+        if dispatch_mode == "scatter" and not gate_routes:
+            raise ValueError(
+                "dispatch_mode='scatter' needs a gate with "
+                "route()/capacity() (TopKGate subclasses); this gate "
+                "only implements the dense forward contract")
+        self.dispatch_mode = dispatch_mode or \
+            ("scatter" if expert_axis is None and gate_routes else "dense")
         from .....nn.initializer import XavierUniform
         init = XavierUniform()
         self.w_in = self.create_parameter((num_experts, d_model, d_hidden),
@@ -68,6 +90,8 @@ class MoELayer(Layer):
         self.last_aux_loss = None
 
     def forward(self, x):
+        if self.dispatch_mode == "scatter":
+            return self._forward_scatter(x)
         combine, dispatch_mask, aux = self.gate(x)
         self.last_aux_loss = aux
         act_name = self.activation
@@ -96,3 +120,50 @@ class MoELayer(Layer):
             (x, combine, dispatch_mask, self.w_in, self.b_in, self.w_out,
              self.b_out),
             nondiff_mask=[False, False, True, False, False, False, False])
+
+    def _forward_scatter(self, x):
+        """Sparse dispatch: scatter tokens into the [E, C, D] expert
+        buffers by (expert id, capacity rank), batched expert matmuls,
+        gather+weight to combine.  O(T*k*D) dispatch/combine HBM traffic
+        instead of the dense path's 2*T*E*C*D einsum FLOPs; identical
+        routing/drop semantics (same gate ranks)."""
+        eid, pos, w, keep, aux = self.gate.route(x)
+        self.last_aux_loss = aux
+        act_name = self.activation
+        num_experts = self.num_experts
+        capacity = self.gate.capacity(
+            x.shape[0] * (x.shape[1] if x.ndim == 3 else 1))
+
+        def impl(hidden, wgt, eida, posa, keepa, wi, bi, wo, bo):
+            orig_shape = hidden.shape
+            flat = hidden.reshape(-1, orig_shape[-1])      # [T, D]
+            t = flat.shape[0]
+            k = eida.shape[1]
+            tok = jnp.repeat(jnp.arange(t), k)             # [T*k]
+            eidf = eida.reshape(-1)
+            # dropped tokens land in a C-th overflow row, sliced away
+            posf = jnp.where(keepa.reshape(-1), posa.reshape(-1), capacity)
+            buf = jnp.zeros((num_experts, capacity + 1, flat.shape[-1]),
+                            flat.dtype)
+            buf = buf.at[eidf, posf].set(flat[tok])
+            expert_in = buf[:, :capacity]                  # [E, C, D]
+            h = jnp.einsum("ecd,edf->ecf", expert_in, wi) + bi
+            if act_name == "gelu":
+                h = jax.nn.gelu(h)
+            elif act_name == "relu":
+                h = jax.nn.relu(h)
+            elif act_name == "silu":
+                h = jax.nn.silu(h)
+            expert_out = jnp.einsum("ecf,efd->ecd", h, wo) + bo
+            # combine: gather each slot's row, weight, zero the dropped
+            picked = expert_out[eida, posa]                # [T, k, D]
+            wmask = (wgt * keepa.astype(wgt.dtype))[..., None]
+            out = jnp.sum(picked * wmask.astype(picked.dtype), axis=1)
+            return out.reshape(orig_shape)
+
+        return _dispatch(
+            "moe_layer_scatter", impl,
+            (x, w, eid, pos, keep, self.w_in, self.b_in, self.w_out,
+             self.b_out),
+            nondiff_mask=[False, False, True, True, True,
+                          False, False, False, False])

@@ -19,7 +19,7 @@ __all__ = ["fused_rms_norm", "fused_layer_norm", "fused_linear",
            "fused_rotary_position_embedding", "rotary_position_embedding",
            "llama_rope", "fused_dropout_add", "masked_multihead_attention",
            "memory_efficient_attention", "fused_bias_act",
-           "swiglu"]
+           "swiglu", "fused_linear_cross_entropy"]
 
 
 def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
@@ -280,6 +280,76 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None,
     nondiff = [False, False] + ([True] * (len(tensors) - 2))
     return dispatch("masked_multihead_attention", impl, tuple(tensors),
                     nondiff_mask=nondiff)
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
+                               chunk=2048, name=None):
+    """lm_head matmul + softmax cross-entropy, chunked over tokens so the
+    full ``[N, vocab]`` logits tensor is NEVER materialized (at Llama
+    bench scale that tensor is 16k x 32k: 1 GB bf16 / 2 GB fp32 per
+    materialization, several HBM sweeps with the separate
+    lm_head->log_softmax->NLL pipeline).
+
+    Reference analogue: the fused softmax-cross-entropy path
+    (``python/paddle/distributed/fleet/layers/mpu/mp_ops.py:410``
+    ``_c_softmax_with_cross_entropy``'s memory story, single-device
+    form).  TPU formulation: ``lax.scan`` over token chunks of the
+    hidden states; each iteration computes chunk logits (bf16 matmul,
+    fp32 accumulation), the fp32 log-sum-exp, and the label NLL, under
+    ``jax.checkpoint`` so backward recomputes chunk logits instead of
+    storing them.  Peak extra memory = one chunk of logits.
+
+    hidden: [N, H] (or [B, S, H], flattened); weight: [H, V];
+    labels: [N] int.  Returns the mean NLL over non-ignored tokens.
+    """
+    # Tensors pass through to dispatch UNWRAPPED ONLY THERE — the tape
+    # records the op from the Tensor args (pre-unwrapping here would
+    # silently disconnect eager backward)
+    from ....core.dispatch import dispatch
+
+    def impl(ha, wa, la):
+        n = 1
+        for s in ha.shape[:-1]:
+            n *= s
+        hf = ha.reshape(n, ha.shape[-1])
+        lf = la.reshape(n).astype(jnp.int32)
+        c = min(chunk, n)
+        if n % c:
+            # pad to a whole number of chunks; padded rows are ignored
+            pad = c - n % c
+            hf = jnp.concatenate(
+                [hf, jnp.zeros((pad, hf.shape[-1]), hf.dtype)])
+            lf = jnp.concatenate(
+                [lf, jnp.full((pad,), ignore_index, jnp.int32)])
+        hc = hf.reshape(-1, c, hf.shape[-1])
+        lc = lf.reshape(-1, c)
+
+        @jax.checkpoint
+        def chunk_nll(h_c, l_c):
+            logits = jax.lax.dot_general(
+                h_c, wa, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [c, V] fp32
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            idx = jnp.clip(l_c, 0, wa.shape[-1] - 1)
+            picked = jnp.take_along_axis(
+                logits, idx[:, None], axis=1)[:, 0]
+            valid = l_c != ignore_index
+            nll = jnp.where(valid, lse - picked, 0.0)
+            return jnp.sum(nll), jnp.sum(valid)
+
+        def body(carry, xs):
+            s_nll, s_cnt = carry
+            h_c, l_c = xs
+            nll, cnt = chunk_nll(h_c, l_c)
+            return (s_nll + nll, s_cnt + cnt), None
+
+        (total, count), _ = jax.lax.scan(
+            body, (jnp.float32(0.0), jnp.int32(0)), (hc, lc))
+        return total / jnp.maximum(count, 1).astype(jnp.float32)
+
+    return dispatch("fused_linear_cross_entropy", impl,
+                    (hidden, weight, labels),
+                    nondiff_mask=[False, False, True])
 
 
 def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,

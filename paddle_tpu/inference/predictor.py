@@ -101,23 +101,43 @@ class Config:
         return self._math_threads or 1
 
     def switch_ir_optim(self, flag: bool = True):
-        self._ir_optim = flag  # XLA's pipeline is not individually gated
+        """False disables the predictor-level program passes (donation +
+        persistent compile cache); XLA's own fixed pipeline still runs —
+        it is the compiler, not a pass registry."""
+        self._ir_optim = flag
+        if not flag:
+            self._memory_optim = False
+            self._cache_dir = None
 
     def ir_optim(self) -> bool:
         return self._ir_optim
 
     def delete_pass(self, name: str):
-        pass  # XLA has no user-deletable pass registry
+        self.pass_builder().delete_pass(name)
 
     def pass_builder(self):
+        """The passes that actually exist in this serving stack, as a
+        controllable registry (the reference's 100-entry IR pass list is
+        subsumed by XLA's fixed pipeline; these are the knobs ABOVE it)."""
         cfg = self
 
         class _PassBuilder:
             def all_passes(self):
-                return ["xla:fixed-pipeline(fusion,layout,rematerialization)"]
+                passes = ["xla:fixed-pipeline(fusion,layout,"
+                          "rematerialization)"]
+                if cfg._memory_optim:
+                    passes.append("input_donation")
+                if cfg._cache_dir:
+                    passes.append("persistent_compile_cache")
+                return passes
 
             def delete_pass(self, name):
-                pass
+                if name == "input_donation":
+                    cfg._memory_optim = False
+                elif name == "persistent_compile_cache":
+                    cfg._cache_dir = None
+                # the XLA fixed pipeline is not deletable (it IS the
+                # compiler); unknown names are ignored like the reference
 
         return _PassBuilder()
 

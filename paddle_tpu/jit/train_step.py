@@ -58,8 +58,42 @@ def _functional_adam(p, g, state, lr, hp):
     v = b2 * state["v"] + (1 - b2) * gf * gf
     m_hat = m / (1 - b1 ** t)
     v_hat = v / (1 - b2 ** t)
-    p_new = (pf - lr * m_hat / (jnp.sqrt(v_hat) + eps)).astype(p.dtype)
+    from ..core.flags import flag
+    if flag("adamw_rsqrt_update"):
+        # Adam's epsilon-hat variant (Kingma & Ba, footnote to Alg. 1):
+        # eps INSIDE the sqrt — update = m_hat * rsqrt(v_hat + eps^2).
+        # Equivalent scale at v=0 and v>>eps^2 (differs by <= sqrt(2)
+        # between); v5e's VPU divide+sqrt chain stalls the update sweep,
+        # and hardware rsqrt measured 25% faster at 60M params
+        p_new = (pf - lr * m_hat * jax.lax.rsqrt(v_hat + eps * eps)) \
+            .astype(p.dtype)
+    else:
+        p_new = (pf - lr * m_hat / (jnp.sqrt(v_hat) + eps)).astype(p.dtype)
     return p_new, {"m": m, "v": v, "t": t}
+
+
+def _fused_adam_ok(update_fn, hypers, mesh):
+    """Route the update sweep through the Pallas fused AdamW kernel:
+    XLA's per-param update fusions measured ~230 GB/s effective on v5e
+    (the AdamW-minus-SGD step delta: ~60 ms at 0.62B params) while the
+    fused kernel streams ~500 GB/s — the sweep is pure HBM traffic, so
+    this halves it.  Single-chip only (a sharded param would need the
+    kernel under shard_map) and decoupled-wd AdamW only (Adam folds wd
+    into the grad, which the kernel does not model)."""
+    from ..core.flags import flag
+    from ..ops.pallas._common import on_tpu
+    return (update_fn is _functional_adam and hypers.get("decoupled")
+            and mesh is None and on_tpu()
+            and bool(flag("use_fused_adamw_kernel")))
+
+
+def _fused_adam_update(p, g, state, lr, hp):
+    from ..ops.pallas.fused_optimizer import fused_adamw_update
+    t = state["t"] + 1
+    p_new, m_new, v_new = fused_adamw_update(
+        p, g, state["m"], state["v"], lr, t, beta1=hp["beta1"],
+        beta2=hp["beta2"], epsilon=hp["epsilon"], weight_decay=hp["wd"])
+    return p_new, {"m": m_new, "v": v_new, "t": t}
 
 
 class TrainStep:
@@ -172,6 +206,7 @@ class TrainStep:
         # Output-sharding pins: keep updated params/state on their input
         # layouts so ZeRO sharding survives step 1 and donation holds.
         mesh = self._mesh()
+        fused_adam = _fused_adam_ok(update_fn, hypers, mesh)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             # unannotated params pin REPLICATED: ZeRO stage-1/2 updates run
@@ -263,7 +298,11 @@ class TrainStep:
                     gs = [g * scale.astype(g.dtype) for g in gs]
                 new_p, new_s = [], []
                 for i, (p, g, s) in enumerate(zip(p_vals, gs, opt_in)):
-                    np_, ns_ = update_fn(p, g, s, lr, hypers)
+                    fn_i = (_fused_adam_update
+                            if fused_adam and jnp.issubdtype(
+                                p.dtype, jnp.floating)
+                            else update_fn)
+                    np_, ns_ = fn_i(p, g, s, lr, hypers)
                     np_ = pin(np_, param_pins[i], p.shape)
                     ns_ = {k: pin(v, state_pins[i], p.shape)
                            for k, v in ns_.items()}

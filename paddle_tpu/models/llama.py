@@ -49,6 +49,14 @@ class LlamaConfig:
     # outputs and recomputes attention/elementwise — see
     # distributed/utils._resolve_policy); None = full remat
     recompute_policy: Optional[str] = None
+    # apply recompute_policy to every k-th layer only (the rest full-remat)
+    # — a memory/time dial when the policy's saves don't fit HBM for all
+    # layers (1 = every layer)
+    recompute_policy_stride: int = 1
+    # fuse lm_head + cross entropy (chunked over tokens, [N, vocab]
+    # logits never materialized — incubate fused_linear_cross_entropy);
+    # training-with-labels path only, single-device (TP uses ParallelCE)
+    fused_linear_loss: bool = False
     dtype: str = "float32"
 
     @property
@@ -134,6 +142,10 @@ class LlamaAttention(nn.Layer):
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attention_mask,
             is_causal=attention_mask is None)
+        # (the "save_attn" remat policy's tags live inside the flash
+        # custom_vjp — ops/pallas/flash_attention.py _flash_fwd — where
+        # the O and LSE residuals are; a tag here would save a second
+        # copy of O)
         out = out.reshape([b, s, -1])
         out = self.o_proj(out)
         return (out, cache) if cache is not None else out
@@ -185,7 +197,7 @@ class LlamaMLP(nn.Layer):
 
 
 class LlamaDecoderLayer(nn.Layer):
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, layer_idx: int = 0):
         super().__init__()
         self.self_attn = LlamaAttention(config)
         self.mlp = LlamaMLP(config)
@@ -194,7 +206,9 @@ class LlamaDecoderLayer(nn.Layer):
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
                                                    config.rms_norm_eps)
         self._recompute = config.recompute
-        self._recompute_policy = config.recompute_policy
+        stride = max(1, config.recompute_policy_stride)
+        self._recompute_policy = (config.recompute_policy
+                                  if layer_idx % stride == 0 else None)
 
     def _forward_impl(self, x, position_ids=None, attention_mask=None):
         h = x + self.self_attn(self.input_layernorm(x), position_ids,
@@ -233,8 +247,8 @@ class LlamaModel(nn.Layer):
             self.embed_tokens = nn.Embedding(config.vocab_size,
                                              config.hidden_size)
         self.layers = nn.LayerList(
-            [LlamaDecoderLayer(config)
-             for _ in range(config.num_hidden_layers)])
+            [LlamaDecoderLayer(config, layer_idx=i)
+             for i in range(config.num_hidden_layers)])
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
 
     def forward(self, input_ids, position_ids=None, attention_mask=None):
@@ -262,6 +276,14 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
     def forward(self, input_ids, position_ids=None, attention_mask=None,
                 labels=None):
         hidden = self.llama(input_ids, position_ids, attention_mask)
+        if labels is not None and self.config.fused_linear_loss and \
+                not self.config.tensor_parallel:
+            from ..incubate.nn.functional import fused_linear_cross_entropy
+            from ..core.tensor import Tensor
+            loss = fused_linear_cross_entropy(
+                hidden.reshape([-1, hidden.shape[-1]]),
+                self.lm_head.weight, labels.reshape([-1]), chunk=1024)
+            return loss if isinstance(loss, Tensor) else Tensor(loss)
         logits = self.lm_head(hidden)
         if labels is not None:
             loss = LlamaPretrainingCriterion(self.config)(logits, labels)

@@ -1,28 +1,19 @@
-"""paddle_tpu.onnx (analogue of ``python/paddle/onnx/export.py``, which
-bridges to the external paddle2onnx package).
+"""paddle_tpu.onnx (analogue of ``python/paddle/onnx/export.py:22``,
+which bridges to the external paddle2onnx package).
 
-This build is air-gapped and the ``onnx`` package is not installed, so
-``export`` is gated: it raises with a clear message pointing at the
-native serialization path — ``paddle.jit.save`` (StableHLO), the
-TPU-world deployment artifact.  (Graph emission would slot in here once
-an onnx runtime is available; nothing is traced before the gate.)
+This build is air-gapped (no ``onnx`` package), so the exporter writes
+the ONNX protobuf wire format directly: the layer's forward traces to a
+jaxpr and each primitive maps to an ONNX-13 op (``_export.py``), with
+weights as initializers.  The supported primitive subset covers the
+Linear/Conv/pool/activation model families; unsupported primitives
+raise with the primitive named.  ``paddle_tpu.onnx.runtime.run_model``
+is a numpy evaluator for the emitted subset — the environment's
+round-trip check (no onnxruntime here).
 """
 
 from __future__ import annotations
 
-__all__ = ["export"]
+from ._export import export  # noqa: F401
+from . import _runtime as runtime  # noqa: F401
 
-
-def export(layer, path: str, input_spec=None, opset_version: int = 11,
-           **configs):
-    try:
-        import onnx  # noqa: F401
-    except ImportError:
-        raise RuntimeError(
-            "paddle_tpu.onnx.export requires the 'onnx' package, which is "
-            "not available in this environment. Use paddle.jit.save for "
-            "the native (StableHLO) deployment artifact, or install onnx "
-            "to enable ONNX export.")
-    raise NotImplementedError(
-        "ONNX graph emission is not implemented in this build; use "
-        "paddle.jit.save (StableHLO) for deployment.")
+__all__ = ["export", "runtime"]

@@ -1,0 +1,95 @@
+"""Minimal protobuf wire-format encoder/decoder for the ONNX subset.
+
+The environment has no ``onnx`` package (and none may be installed), so
+the exporter writes the wire format directly — varints, length-delimited
+submessages, 32-bit floats — exactly as protobuf serializes, and the
+reader parses it back into {field_number: [values]} dicts.  Field
+numbers follow onnx/onnx.proto (IR version 8 era).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Union
+
+# wire types
+_VARINT = 0
+_I64 = 1
+_LEN = 2
+_I32 = 5
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64  # protobuf encodes negatives as 10-byte varints
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def f_int(field: int, value: int) -> bytes:
+    return tag(field, _VARINT) + _varint(int(value))
+
+
+def f_bytes(field: int, value: Union[bytes, str]) -> bytes:
+    if isinstance(value, str):
+        value = value.encode("utf-8")
+    return tag(field, _LEN) + _varint(len(value)) + value
+
+
+def f_msg(field: int, encoded: bytes) -> bytes:
+    return f_bytes(field, encoded)
+
+
+def f_float(field: int, value: float) -> bytes:
+    return tag(field, _I32) + struct.pack("<f", float(value))
+
+
+def decode(buf: bytes) -> Dict[int, List]:
+    """Parse one message level: {field: [raw values]} — varints as int,
+    length-delimited as bytes (decode nested levels by calling again),
+    32-bit as float."""
+    out: Dict[int, List] = {}
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == _VARINT:
+            v, i = _read_varint(buf, i)
+        elif wire == _LEN:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wire == _I32:
+            v = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wire == _I64:
+            v = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def _read_varint(buf: bytes, i: int):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7

@@ -1,0 +1,170 @@
+"""Tiny numpy evaluator for the exported ONNX subset.
+
+Runs the graphs ``_export.py`` emits — the round-trip check that the
+artifact is semantically correct without onnxruntime (absent from this
+environment).  Parses the wire format with ``_proto.decode``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import _proto as P
+
+_NP_DTYPES = {1: np.float32, 11: np.float64, 7: np.int64, 6: np.int32,
+              9: np.bool_, 10: np.float16}
+
+
+def _parse_tensor(buf: bytes) -> tuple:
+    msg = P.decode(buf)
+    dims = [int(d) for d in msg.get(1, [])]
+    dtype = _NP_DTYPES[int(msg[2][0])]
+    name = msg[8][0].decode()
+    arr = np.frombuffer(msg[9][0], dtype=dtype).reshape(dims)
+    return name, arr
+
+
+def _parse_attrs(node_msg) -> dict:
+    attrs = {}
+    for a in node_msg.get(5, []):
+        am = P.decode(a)
+        name = am[1][0].decode()
+        atype = int(am[20][0])
+        if atype == 2:
+            attrs[name] = int(am[3][0])
+        elif atype == 1:
+            attrs[name] = float(am[2][0])
+        elif atype == 7:
+            attrs[name] = [int(v) for v in am.get(8, [])]
+        else:
+            raise NotImplementedError(f"attr type {atype}")
+    return attrs
+
+
+def load_model(path: str) -> dict:
+    """-> {nodes: [(op, ins, outs, attrs)], initializers: {name: arr},
+    inputs: [name], outputs: [name], opset: int}"""
+    with open(path, "rb") as f:
+        model = P.decode(f.read())
+    graph = P.decode(model[7][0])
+    nodes = []
+    for n in graph.get(1, []):
+        nm = P.decode(n)
+        nodes.append((
+            nm[4][0].decode(),
+            [s.decode() for s in nm.get(1, [])],
+            [s.decode() for s in nm.get(2, [])],
+            _parse_attrs(nm),
+        ))
+    inits = dict(_parse_tensor(t) for t in graph.get(5, []))
+    ins = [P.decode(vi)[1][0].decode() for vi in graph.get(11, [])]
+    outs = [P.decode(vi)[1][0].decode() for vi in graph.get(12, [])]
+    opset = int(P.decode(model[8][0])[2][0])
+    return {"nodes": nodes, "initializers": inits, "inputs": ins,
+            "outputs": outs, "opset": opset,
+            "ir_version": int(model[1][0])}
+
+
+def _conv2d(x, w, b, attrs):
+    pads = attrs.get("pads", [0, 0, 0, 0])
+    sh, sw = attrs.get("strides", [1, 1])
+    dh, dw = attrs.get("dilations", [1, 1])
+    groups = attrs.get("group", 1)
+    n, cin, h, wd = x.shape
+    cout, cin_g, kh, kw = w.shape
+    x = np.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])))
+    eh = (kh - 1) * dh + 1
+    ew = (kw - 1) * dw + 1
+    oh = (x.shape[2] - eh) // sh + 1
+    ow = (x.shape[3] - ew) // sw + 1
+    out = np.zeros((n, cout, oh, ow), np.float32)
+    og = cout // groups
+    for gidx in range(groups):
+        xs = x[:, gidx * cin_g:(gidx + 1) * cin_g]
+        ws = w[gidx * og:(gidx + 1) * og]
+        for i in range(oh):
+            for j in range(ow):
+                patch = xs[:, :, i * sh:i * sh + eh:dh,
+                           j * sw:j * sw + ew:dw]
+                out[:, gidx * og:(gidx + 1) * og, i, j] = np.einsum(
+                    "nchw,ochw->no", patch, ws)
+    if b is not None:
+        out += b[None, :, None, None]
+    return out
+
+
+def _maxpool(x, attrs):
+    kh, kw = attrs["kernel_shape"]
+    sh, sw = attrs.get("strides", [kh, kw])
+    pads = attrs.get("pads", [0, 0, 0, 0])
+    x = np.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])),
+               constant_values=-np.inf)
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    out = np.full((n, c, oh, ow), -np.inf, np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = x[:, :, i * sh:i * sh + kh,
+                                j * sw:j * sw + kw].max(axis=(2, 3))
+    return out
+
+
+def run_model(path: str, *inputs) -> list:
+    m = load_model(path)
+    env = dict(m["initializers"])
+    for nm, arr in zip(m["inputs"], inputs):
+        env[nm] = np.asarray(arr)
+
+    simple = {
+        "Add": np.add, "Sub": np.subtract, "Mul": np.multiply,
+        "Div": np.divide, "Max": np.maximum, "Min": np.minimum,
+        "Neg": np.negative, "Exp": np.exp, "Log": np.log,
+        "Tanh": np.tanh, "Sqrt": np.sqrt, "Abs": np.abs,
+        "Greater": np.greater, "Less": np.less, "Equal": np.equal,
+        "Pow": np.power, "Identity": lambda x: x,
+        "Sigmoid": lambda x: 1.0 / (1.0 + np.exp(-x)),
+        "Floor": np.floor, "Sign": np.sign,
+    }
+    try:
+        from math import erf as _erf
+        simple["Erf"] = np.vectorize(_erf, otypes=[np.float32])
+    except ImportError:
+        pass
+
+    for op, ins, outs, attrs in m["nodes"]:
+        a = [env[i] for i in ins]
+        if op in simple:
+            r = simple[op](*a)
+        elif op == "MatMul":
+            r = a[0] @ a[1]
+        elif op == "Conv":
+            r = _conv2d(a[0], a[1], a[2] if len(a) > 2 else None, attrs)
+        elif op == "MaxPool":
+            r = _maxpool(a[0], attrs)
+        elif op == "Reshape":
+            r = a[0].reshape([int(d) for d in a[1]])
+        elif op == "Transpose":
+            r = np.transpose(a[0], attrs["perm"])
+        elif op == "Expand":
+            r = np.broadcast_to(a[0], [int(d) for d in a[1]])
+        elif op == "Where":
+            r = np.where(a[0], a[1], a[2])
+        elif op == "Cast":
+            r = a[0].astype(_NP_DTYPES[attrs["to"]])
+        elif op in ("ReduceSum", "ReduceMax", "ReduceMin"):
+            fn = {"ReduceSum": np.sum, "ReduceMax": np.max,
+                  "ReduceMin": np.min}[op]
+            # opset-13 ReduceSum carries axes as input; Max/Min as attr
+            axes = (tuple(int(d) for d in a[1]) if len(a) > 1
+                    else tuple(attrs["axes"]))
+            r = fn(a[0], axis=axes,
+                   keepdims=bool(attrs.get("keepdims", 0)))
+        elif op == "Concat":
+            r = np.concatenate(a, axis=attrs["axis"])
+        elif op == "Squeeze":
+            r = np.squeeze(a[0], axis=tuple(int(d) for d in a[1]))
+        else:
+            raise NotImplementedError(f"onnx runtime: op {op}")
+        env[outs[0]] = r
+    return [env[o] for o in m["outputs"]]

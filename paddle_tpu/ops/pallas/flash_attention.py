@@ -289,6 +289,97 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _onepass_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, dk_ref, dv_ref, *, block_k, seq_k, scale,
+                        causal, block_q):
+    """dq + dk + dv in ONE kernel: the softmax weights P are rebuilt once
+    per (q-block, k-block) pair instead of once in a dq pass and again
+    in a dkv pass.  Grid is (bh, q-blocks) with dk/dv as whole-[sk, d]
+    fp32 accumulators revisited across the q-block iterations (their
+    index_map is constant in qb, so the block stays resident in VMEM and
+    accumulates; Mosaic writes it back when bh changes)."""
+    qi = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0][:, 0]
+    delta = delta_ref[0][:, 0]
+    n_kb = seq_k // block_k
+    upper = (jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k,
+                         n_kb) if causal else n_kb)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                   # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dv_slice = jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, d]
+        dk_slice = jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        kslice = pl.ds(kb * block_k, block_k)
+        dv_ref[0, kslice, :] = dv_ref[0, kslice, :] + \
+            dv_slice.astype(dv_ref.dtype)
+        dk_ref[0, kslice, :] = dk_ref[0, kslice, :] + \
+            dk_slice.astype(dk_ref.dtype)
+        return dq + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, upper, body,
+                           jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_onepass(q3, k3, v3, do, lse, delta, causal, block_q,
+                       block_k):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_onepass_bwd_kernel, block_k=block_k, seq_k=sk,
+                          scale=scale, causal=causal, block_q=block_q),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANE), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANE), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+            jax.ShapeDtypeStruct(k3.shape, jnp.float32),
+            jax.ShapeDtypeStruct(v3.shape, jnp.float32),
+        ],
+        interpret=not on_tpu(),
+    )(q3, k3, v3, do, lse, delta)
+    return dq, dk.astype(k3.dtype), dv.astype(v3.dtype)
+
+
 def _heads_layout(x):
     """[B, S, H, D] -> [B*H, S, D]."""
     b, s, h, d = x.shape
@@ -336,17 +427,34 @@ def _flash_fwd_impl(q3, k3, v3, causal, block_q, block_k):
 
 def _flash_fwd(q3, k3, v3, causal, block_q, block_k):
     o, lse = _flash_fwd_impl(q3, k3, v3, causal, block_q, block_k)
-    return o, (q3, k3, v3, o, lse)
+    # tag BOTH softmax residuals for the "save_attn" remat policy
+    # (save_only_these_names): with o AND lse saved, backward's
+    # recompute stops at the q/k/v projections and never re-runs the
+    # flash forward kernel (lse is the residual that would otherwise
+    # force it).  The residual lse is stored COMPACT [bh, sq] — the
+    # kernel's 128-lane broadcast form is 128x bigger (268 MB/layer at
+    # bench scale, which OOMed HBM when saved) and is rebuilt in bwd.
+    from jax.ad_checkpoint import checkpoint_name
+    o = checkpoint_name(o, "attn_out")
+    lse_c = checkpoint_name(lse[:, :, 0], "attn_out")
+    return o, (q3, k3, v3, o, lse_c)
 
 
 def _flash_bwd(causal, block_q, block_k, res, do):
-    q3, k3, v3, o, lse = res
+    q3, k3, v3, o, lse_c = res
+    lse = jnp.broadcast_to(lse_c[:, :, None],
+                           (lse_c.shape[0], lse_c.shape[1], LANE))
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     scale = 1.0 / math.sqrt(d)
     delta = jnp.broadcast_to(
         jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                 axis=-1)[..., None], (bh, sq, LANE))     # lane-broadcast
+
+    from ...core.flags import flag
+    if flag("flash_onepass_bwd"):
+        return _flash_bwd_onepass(q3, k3, v3, do, lse, delta, causal,
+                                  block_q, block_k)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_k=block_k, seq_k=sk,

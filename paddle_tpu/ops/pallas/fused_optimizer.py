@@ -70,12 +70,23 @@ def _adamw_call(flat_p, flat_g, flat_m, flat_v, lr_arr, t_arr,
             chunk = int(hit)
         else:
             # untuned default: bounded chunk — the whole-array form is
-            # VMEM-infeasible beyond ~1M params (measured; BASELINE.md)
-            chunk = 0 if numel <= (1 << 19) else (1 << 19)
+            # VMEM-infeasible beyond ~1M params (measured; BASELINE.md).
+            # Per 512-lane row the kernel stages p+g+m+v in, p+m+v out,
+            # double-buffered: ~22.5 KB/row at bf16 params — 256-row
+            # blocks (128Ki elements) stay under ~6 MB of the 16 MB
+            # scoped VMEM (a 1024-row block OOMed at 22 MB on v5e)
+            chunk = 0 if numel <= (1 << 18) else (1 << 17)
     kernel = functools.partial(_adamw_kernel, beta1=beta1, beta2=beta2,
                                epsilon=epsilon, wd=wd)
 
-    pad = (-numel) % _LANES
+    # pad up to a whole number of row BLOCKS (not merely lanes): odd
+    # param sizes would otherwise force tiny non-tileable row blocks
+    # (Mosaic needs the sublane dim divisible by the dtype tile: 16 for
+    # bf16) — the padded tail computes garbage that is sliced away
+    row_blk = max(16, min(1 << 14, chunk // _LANES)) if chunk else 0
+    blk_elems = (row_blk or 1) * _LANES
+    pad = (-numel) % blk_elems
+
     def to2d(a):
         if pad:
             a = jnp.pad(a, (0, pad))
@@ -88,9 +99,6 @@ def _adamw_call(flat_p, flat_g, flat_m, flat_v, lr_arr, t_arr,
         jax.ShapeDtypeStruct(p2.shape, jnp.float32),
         jax.ShapeDtypeStruct(p2.shape, jnp.float32),
     ]
-    row_blk = max(1, min(rows, chunk // _LANES)) if chunk else 0
-    while row_blk > 1 and rows % row_blk != 0:
-        row_blk -= 1  # round down to a divisor, never to whole-array
     if not row_blk or row_blk >= rows:
         outs = pl.pallas_call(
             kernel,

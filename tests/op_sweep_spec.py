@@ -38,6 +38,14 @@ def _ctc_inputs():
     return (log_probs, labels, input_lengths, label_lengths), {}
 
 
+def _rnnt_inputs():
+    acts = _t(_rngf((1, 3, 3, 4), -1.0, 1.0, seed=5))
+    labels = _i((1, 2), 3, seed=6)
+    input_lengths = _t(np.asarray([3], np.int64))
+    label_lengths = _t(np.asarray([2], np.int64))
+    return (acts, labels, input_lengths, label_lengths), {}
+
+
 def _flash_unpadded_inputs():
     q = _t(_rngf((8, 2, 4), 0.3, 0.9, seed=1))
     k = _t(_rngf((8, 2, 4), 0.3, 0.9, seed=2))
@@ -218,6 +226,7 @@ CUSTOM_INPUTS = {
     "dice_loss": lambda: ((_f((3, 4)), _i((3, 1), 4)), {}),
     "square_error_cost": lambda: ((_f((3, 4)), _f((3, 4), seed=2)), {}),
     "ctc_loss": lambda: _ctc_inputs(),
+    "rnnt_loss": lambda: _rnnt_inputs(),
     "cross_entropy": lambda: ((_f((3, 5)), _i((3,), 5)), {}),
     "nll_loss": lambda: ((_t(np.log(_rngf((3, 5), 0.1, 0.9))),
                           _i((3,), 5)), {}),

@@ -248,6 +248,37 @@ def test_train_step_compiled_matches_eager():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_model_train_metrics_and_progress(capsys):
+    """Train-batch metrics (reference hapi computes metrics on train
+    batches; in the compiled path outputs ride as TrainStep aux) and
+    the ProgBar's throughput/ETA logging."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.metric import Accuracy
+
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    xs = paddle.to_tensor(rng.standard_normal((32, 8)).astype(np.float32))
+    ys = paddle.to_tensor(rng.integers(0, 4, (32, 1)))
+    import paddle_tpu.io as io
+    ds = io.TensorDataset([xs, ys])
+
+    net = nn.Linear(8, 4)
+    m = paddle.Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=1e-2, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+    out = m.train_batch([xs], [ys])
+    assert isinstance(out, tuple) and len(out) == 2
+    losses, mvals = out
+    assert 0.0 <= float(np.asarray(mvals[0])) <= 1.0
+    m.fit(ds, epochs=1, batch_size=8, verbose=2, log_freq=1)
+    captured = capsys.readouterr().out
+    assert "acc" in captured and "samples/s" in captured
+    assert "ETA" in captured
+
+
 def test_model_amp_o1_and_o2_and_inference_export(tmp_path):
     import numpy as np
     import paddle_tpu as paddle

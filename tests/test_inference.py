@@ -108,6 +108,15 @@ def test_bf16_export_precision_and_config_knobs(tmp_path):
     cfg.set_cpu_math_library_num_threads(2)
     assert cfg.memory_optim_enabled() and cfg.tpu_device_id() == 0
     assert "xla" in cfg.pass_builder().all_passes()[0]
+    # pass_builder controls the real predictor-level passes
+    assert "input_donation" in cfg.pass_builder().all_passes()
+    cfg.delete_pass("input_donation")
+    assert not cfg.memory_optim_enabled()
+    cfg.set_compilation_cache_dir(str(tmp_path / "cache"))
+    assert "persistent_compile_cache" in cfg.pass_builder().all_passes()
+    cfg.switch_ir_optim(False)
+    assert cfg._cache_dir is None
+    cfg.enable_memory_optim(True)
     pred = create_predictor(cfg)
     assert pred.precision_mode() == "bfloat16"
 
