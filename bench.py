@@ -102,6 +102,11 @@ def main():
         secondary["llm_decode"] = _bench_decode(on_tpu)
     except Exception as e:
         secondary["llm_decode"] = {"error": str(e)[:300]}
+    gc.collect()
+    try:
+        secondary["moe_block"] = _bench_moe(on_tpu)
+    except Exception as e:
+        secondary["moe_block"] = {"error": str(e)[:300]}
     result["secondary"] = secondary
     print(json.dumps(result))
 
@@ -184,7 +189,7 @@ def _run_llama(cfg, batch, seq, ks, dtype, peak_flops, on_tpu):
 
     if cfg.fused_linear_loss:
         def loss_fn(net, tokens, labels):
-            return net(tokens, labels=labels)
+            return net(tokens, labels=labels)[0]  # logits are None (fused)
     else:
         def loss_fn(net, tokens, labels):
             logits = net(tokens)
@@ -309,7 +314,11 @@ def _bench_ocr(on_tpu, peak_flops):
     from paddle_tpu.utils.flops import count_matmul_flops
 
     if on_tpu:
-        batch, width, dtype, ks = 512, 320, "bfloat16", (4, 16)
+        # wide differential interval: at ~7 ms/fwd a (4,16) spread is an
+        # ~84 ms delta, inside the tunnel's tens-of-ms jitter — measured
+        # 51k..83k img/s swings across runs (BASELINE.md reconciliation);
+        # (8,72) puts the delta at ~450 ms
+        batch, width, dtype, ks = 512, 320, "bfloat16", (8, 72)
     else:
         batch, width, dtype, ks = 8, 64, "float32", (2, 4)
 
@@ -375,6 +384,35 @@ def _bench_ocr(on_tpu, peak_flops):
     }
 
 
+def _bench_moe(on_tpu):
+    """MoE block forward (VERDICT r3 item 4): scatter vs dense dispatch
+    at Llama-block scale; tools/bench_moe.py has the full E/capacity
+    sweep (BASELINE.md table).  MFU counts EXPERT matmul FLOPs only —
+    the dense path's [T,E,C] dispatch einsums are overhead (they cost
+    2*T^2*k*cf*D FLOPs, independent of E, quadratic in tokens)."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "bench_moe", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools", "bench_moe.py"))
+    bm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bm)
+    if on_tpu:
+        kw = {}
+        peak = 197e12
+    else:
+        kw = dict(T=256, D=64, F=128, steps=(1, 3))
+        peak = 1e11
+    s_ms, C, flops = bm.bench_case(8, 1.25, "scatter", **kw)
+    d_ms, _, _ = bm.bench_case(8, 1.25, "dense", **kw)
+    return {
+        "experts": 8, "top_k": 2, "capacity_factor": 1.25, "capacity": C,
+        "scatter_fwd_ms": round(s_ms, 2), "dense_fwd_ms": round(d_ms, 2),
+        "expert_gflops": round(flops / 1e9, 1),
+        "scatter_mfu": round(flops / (s_ms / 1e3) / peak, 4),
+    }
+
+
 def _bench_decode(on_tpu):
     """Cached-KV autoregressive serving (the fused_multi_transformer
     role): decode tokens/s at b1 and b32, prefill tokens/s, bf16 and
@@ -401,7 +439,9 @@ def _bench_decode(on_tpu):
                           intermediate_size=8192, num_hidden_layers=16,
                           num_attention_heads=32, num_key_value_heads=8,
                           max_position_embeddings=4096)
-        prompt, n_small, n_large = 128, 32, 160
+        # wide differentials: at ~2-3 ms/step the delta must dwarf the
+        # tunnel's tens-of-ms jitter (same lesson as the OCR interval)
+        prompt, n_small, n_large = 128, 32, 288
         cache_ladder = [2048, 1024, 512]
         batches = (1, 32)
         compute_dtype = "bfloat16"
@@ -490,7 +530,9 @@ def _bench_decode(on_tpu):
             def prun(k):
                 np.asarray(jc(pb, ids._value, k))
 
-            kp = (2, 6) if on_tpu else (1, 3)
+            # per-prefill ms scales with b: short prefills need long
+            # chains for the delta to clear jitter
+            kp = ((8, 56) if b <= 4 else (4, 12)) if on_tpu else (1, 3)
             prun(kp[0])
             t0 = time.perf_counter()
             prun(kp[0])

@@ -1,15 +1,20 @@
-"""Eager (outside-compiled-region) collectives over the native TCPStore —
-the Gloo-style data plane of the reference
+"""Eager (outside-compiled-region) collectives — the Gloo-style data
+plane of the reference
 (``python/paddle/distributed/communication/all_reduce.py`` working eagerly
 through ProcessGroupGloo/NCCL).
 
-On TPU the high-performance path is always the compiled XLA collective;
-this store-backed plane exists for the reference's eager semantics:
-multi-process host-side coordination, debugging runs, small-tensor
-synchronization (e.g. LocalSGD parameter averaging), and CPU CI.  Every
-rank posts its buffer under a sequence-numbered key and reads its peers'
-— O(world^2) traffic through the store server, correct and simple, not a
-throughput path (the reference's Gloo backend has the same shape).
+Two transports:
+
+- **XLA-backed** (preferred, auto-selected when ``jax.distributed`` is
+  initialized and spans this world): array collectives run through
+  ``jax.experimental.multihost_utils`` — compiled allgather/psum over the
+  real interconnect with tree algorithms, O(world) per-rank traffic.
+  This is the scaling path (reference ProcessGroupNCCL's eager role).
+- **store relay** (fallback: no jax.distributed, or send/recv/objects):
+  every rank posts its buffer under a sequence-numbered key on the
+  native TCPStore and reads its peers' — O(world^2) through the store
+  server, correct and simple (the reference's Gloo-over-store shape);
+  fine for bootstrap and CI, not a throughput path.
 """
 
 from __future__ import annotations
@@ -26,13 +31,49 @@ _comm = None
 _lock = threading.Lock()
 
 
+def _xla_world_available(world: int) -> bool:
+    try:
+        import jax
+        return jax.process_count() == world and world > 1
+    except Exception:
+        return False
+
+
 class EagerComm:
-    def __init__(self, store, rank: int, world: int, prefix: str = "ec"):
+    def __init__(self, store, rank: int, world: int, prefix: str = "ec",
+                 use_xla=None):
         self.store = store
         self.rank = rank
         self.world = world
         self.prefix = prefix
         self._seq = 0
+        if use_xla is not None:
+            self.use_xla = bool(use_xla)
+        else:
+            # transport AGREEMENT round: each rank's local view (jax
+            # distributed up AND its jax process index == its comm rank)
+            # is posted through the store; XLA is used only when every
+            # rank can — a per-process decision could split the world
+            # across transports and deadlock the next collective
+            local_ok = _xla_world_available(world) and self._rank_is_jax()
+            if world <= 1:
+                self.use_xla = False
+            else:
+                try:
+                    self.store.set(f"{prefix}/xla_ok/{rank}",
+                                   b"1" if local_ok else b"0")
+                    self.use_xla = all(
+                        self.store.get(f"{prefix}/xla_ok/{r}") == b"1"
+                        for r in range(world))
+                except Exception:
+                    self.use_xla = False
+
+    def _rank_is_jax(self) -> bool:
+        try:
+            import jax
+            return jax.process_index() == self.rank
+        except Exception:
+            return False
 
     def _key(self, seq, rank, tag=""):
         return f"{self.prefix}/{seq}{tag}/{rank}"
@@ -40,6 +81,21 @@ class EagerComm:
     def _next(self):
         self._seq += 1
         return self._seq
+
+    # -- XLA transport (multi-process jax.distributed) ------------------
+    def _xla_ok(self) -> bool:
+        # use_xla was AGREED across the world at init (see __init__);
+        # a per-call re-check could diverge between ranks and deadlock
+        return self.use_xla
+
+    def _xla_allgather(self, array: np.ndarray) -> np.ndarray:
+        """[world, ...] gathered along a new leading axis — ONE compiled
+        allgather over the interconnect (tree algorithm), O(world)
+        per-rank traffic instead of the store relay's O(world^2)."""
+        from jax.experimental import multihost_utils
+        return np.asarray(
+            multihost_utils.process_allgather(
+                np.ascontiguousarray(array)))
 
     # -- primitives -----------------------------------------------------
     def _post_and_collect(self, payload: bytes, seq, tag="") -> list:
@@ -57,6 +113,23 @@ class EagerComm:
         return out
 
     def all_reduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
+        if self._xla_ok():
+            g = self._xla_allgather(array)
+            if np.issubdtype(g.dtype, np.floating):
+                g = g.astype(np.float64)
+            if op in ("sum", "avg"):
+                acc = g.sum(axis=0)
+                if op == "avg":
+                    acc = acc / self.world
+            elif op == "max":
+                acc = g.max(axis=0)
+            elif op == "min":
+                acc = g.min(axis=0)
+            elif op == "prod":
+                acc = g.prod(axis=0)
+            else:
+                raise ValueError(f"unsupported reduce op {op!r}")
+            return np.asarray(acc, np.asarray(array).dtype)
         seq = self._next()
         arr = np.ascontiguousarray(array)
         blobs = self._post_and_collect(
@@ -84,6 +157,9 @@ class EagerComm:
         return np.asarray(acc, arr.dtype)
 
     def all_gather(self, array: np.ndarray) -> list:
+        if self._xla_ok():
+            g = self._xla_allgather(array)
+            return [g[r].copy() for r in range(self.world)]
         seq = self._next()
         arr = np.ascontiguousarray(array)
         blobs = self._post_and_collect(
@@ -101,6 +177,11 @@ class EagerComm:
         return [pickle.loads(b) for b in blobs]
 
     def broadcast(self, array: np.ndarray, src: int) -> np.ndarray:
+        if self._xla_ok():
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.broadcast_one_to_all(
+                np.ascontiguousarray(array),
+                is_source=self.rank == src))
         seq = self._next()
         if self.rank == src:
             arr = np.ascontiguousarray(array)
@@ -135,6 +216,12 @@ class EagerComm:
         return np.frombuffer(raw, np.dtype(dt)).reshape(shape).copy()
 
     def barrier(self):
+        if self._xla_ok():
+            from jax.experimental import multihost_utils
+            self._seq += 1
+            multihost_utils.sync_global_devices(
+                f"{self.prefix}/bar/{self._seq}")
+            return
         seq = self._next()
         n = self.store.add(f"{self.prefix}/bar/{seq}", 1)
         while n < self.world:

@@ -17,28 +17,42 @@ from .....nn.layer.layers import Layer
 from .....nn.layer.common import Linear
 
 
+def _rank_positions(top_idx, num_experts, capacity, dtype=jnp.float32):
+    """Per-slot capacity positions for top-k routing: position of each
+    token within its expert's buffer = its arrival rank among tokens
+    routed to that expert (cumsum over the token dim, earlier slots
+    count first).  -> (positions [T, k] int32, keeps [T, k] bool,
+    onehots list of [T, E]).  THE routing rank semantics — shared by
+    the dense [T,E,C] dispatch and the sparse route() so the two paths
+    cannot diverge."""
+    k = top_idx.shape[1]
+    prev = jnp.zeros((num_experts,), dtype)
+    poss, keeps, onehots = [], [], []
+    for slot in range(k):
+        onehot = jax.nn.one_hot(top_idx[:, slot], num_experts, dtype=dtype)
+        pos_in_e = jnp.cumsum(onehot, axis=0) - onehot + prev[None]
+        prev = prev + jnp.sum(onehot, axis=0)
+        pos = jnp.sum(pos_in_e * onehot, axis=1).astype(jnp.int32)
+        poss.append(pos)
+        keeps.append(pos < capacity)
+        onehots.append(onehot)
+    return poss, keeps, onehots
+
+
 def _dense_dispatch(gates, top_idx, top_gates, num_experts, capacity):
     """Build combine/dispatch tensors from top-k assignments.
 
     gates: [T, E] softmax probs; top_idx/top_gates: [T, k].
-    Position of each token within its expert's capacity buffer = its rank
-    among tokens routed to that expert (cumsum over the token dim).
     """
-    T, E = gates.shape
     k = top_idx.shape[1]
-    masks = [jax.nn.one_hot(top_idx[:, s], E, dtype=gates.dtype)
-             for s in range(k)]
-    combine = jnp.zeros((T, E, capacity), gates.dtype)
-    prev_counts = jnp.zeros((E,), gates.dtype)  # tokens already placed per expert
+    poss, keeps, onehots = _rank_positions(top_idx, num_experts, capacity,
+                                           gates.dtype)
+    combine = jnp.zeros((gates.shape[0], num_experts, capacity),
+                        gates.dtype)
     for slot in range(k):
-        onehot = masks[slot]
+        onehot = onehots[slot]
         g = top_gates[:, slot]
-        # rank of this token within its expert's buffer: tokens routed to the
-        # same expert earlier in the token order + all earlier-slot traffic
-        pos_in_expert = jnp.cumsum(onehot, axis=0) - onehot + prev_counts[None]
-        prev_counts = prev_counts + jnp.sum(onehot, axis=0)
-        pos = jnp.sum(pos_in_expert * onehot, axis=1).astype(jnp.int32)  # [T]
-        keep = pos < capacity
+        pos, keep = poss[slot], keeps[slot]
         pos_oh = jax.nn.one_hot(jnp.where(keep, pos, capacity), capacity + 1,
                                 dtype=gates.dtype)[:, :capacity]  # [T, C]
         combine = combine + (g * keep)[:, None, None] * \
@@ -124,16 +138,8 @@ class TopKGate(Layer):
             top_gates, top_idx = jax.lax.top_k(gates, top_k)
             top_gates = top_gates / jnp.maximum(
                 jnp.sum(top_gates, -1, keepdims=True), 1e-9)
-            prev = jnp.zeros((num_experts,), jnp.float32)
-            poss, keeps = [], []
-            for slot in range(top_k):
-                onehot = jax.nn.one_hot(top_idx[:, slot], num_experts,
-                                        dtype=jnp.float32)
-                pos_in_e = jnp.cumsum(onehot, axis=0) - onehot + prev[None]
-                prev = prev + jnp.sum(onehot, axis=0)
-                pos = jnp.sum(pos_in_e * onehot, axis=1).astype(jnp.int32)
-                poss.append(pos)
-                keeps.append(pos < capacity)
+            poss, keeps, _ = _rank_positions(top_idx, num_experts,
+                                             capacity)
             me = jnp.mean(gates, axis=0)
             ce = jnp.mean(jax.nn.one_hot(top_idx[:, 0], num_experts,
                                          dtype=gates.dtype), axis=0)

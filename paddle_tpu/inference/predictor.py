@@ -33,8 +33,9 @@ class Config:
       ``precision="bfloat16"`` to ``paddle.jit.save`` — the knob readers
       (``precision_mode``) report what the artifact was exported with.
     - graph passes: XLA's fixed pipeline subsumes the reference's IR pass
-      registry; ``pass_builder().all_passes()`` reports that honestly,
-      ``switch_ir_optim``/``delete_pass`` are accepted no-ops.
+      registry; ``pass_builder()`` lists and deletes the REAL
+      predictor-level passes (input_donation, persistent_compile_cache)
+      and ``switch_ir_optim(False)`` gates them without erasing settings.
     """
 
     def __init__(self, prog_file: Optional[str] = None,
@@ -65,7 +66,14 @@ class Config:
         self._memory_optim = flag
 
     def memory_optim_enabled(self) -> bool:
-        return self._memory_optim
+        return self._effective_memory_optim()
+
+    # switch_ir_optim(False) gates these without erasing the settings
+    def _effective_memory_optim(self) -> bool:
+        return bool(self._ir_optim and self._memory_optim)
+
+    def _effective_cache_dir(self):
+        return self._cache_dir if self._ir_optim else None
 
     def disable_glog_info(self):
         self._glog_info = False
@@ -101,13 +109,11 @@ class Config:
         return self._math_threads or 1
 
     def switch_ir_optim(self, flag: bool = True):
-        """False disables the predictor-level program passes (donation +
-        persistent compile cache); XLA's own fixed pipeline still runs —
-        it is the compiler, not a pass registry."""
+        """False GATES the predictor-level program passes (donation +
+        persistent compile cache) without destroying their settings —
+        toggling back on restores them; XLA's own fixed pipeline always
+        runs (it is the compiler, not a pass registry)."""
         self._ir_optim = flag
-        if not flag:
-            self._memory_optim = False
-            self._cache_dir = None
 
     def ir_optim(self) -> bool:
         return self._ir_optim
@@ -125,9 +131,9 @@ class Config:
             def all_passes(self):
                 passes = ["xla:fixed-pipeline(fusion,layout,"
                           "rematerialization)"]
-                if cfg._memory_optim:
+                if cfg._effective_memory_optim():
                     passes.append("input_donation")
-                if cfg._cache_dir:
+                if cfg._effective_cache_dir():
                     passes.append("persistent_compile_cache")
                 return passes
 
@@ -186,10 +192,10 @@ class Predictor:
             prefix = config.model_dir()
             if prefix is None:
                 raise ValueError("Config has no model path")
-            if config._cache_dir:
-                os.makedirs(config._cache_dir, exist_ok=True)
+            if config._effective_cache_dir():
+                os.makedirs(config._effective_cache_dir(), exist_ok=True)
                 jax.config.update("jax_compilation_cache_dir",
-                                  config._cache_dir)
+                                  config._effective_cache_dir())
                 jax.config.update(
                     "jax_persistent_cache_min_compile_time_secs", 0.0)
             from jax import export as jax_export
@@ -211,7 +217,7 @@ class Predictor:
             self._precision = meta.get("precision")
             exported = self._exported
             jit_kwargs = {}
-            if config._memory_optim and self._in_spec:
+            if config._effective_memory_optim() and self._in_spec:
                 # donate input buffers: XLA may write outputs in place
                 jit_kwargs["donate_argnums"] = tuple(
                     range(1, 1 + len(self._in_spec)))
@@ -244,7 +250,7 @@ class Predictor:
     def run(self, inputs: Optional[List] = None):
         """Execute the compiled program. Either feed via input handles
         (reference style) or pass arrays directly and get arrays back."""
-        donating = self.config._memory_optim
+        donating = self.config._effective_memory_optim()
         if inputs is not None:
             arrays = [getattr(a, "_value", None) if hasattr(a, "_value")
                       else jnp.asarray(a) for a in inputs]

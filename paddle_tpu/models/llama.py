@@ -283,7 +283,11 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
             loss = fused_linear_cross_entropy(
                 hidden.reshape([-1, hidden.shape[-1]]),
                 self.lm_head.weight, labels.reshape([-1]), chunk=1024)
-            return loss if isinstance(loss, Tensor) else Tensor(loss)
+            loss = loss if isinstance(loss, Tensor) else Tensor(loss)
+            # keep the (loss, logits) unpacking contract of the standard
+            # path; logits are None BY DESIGN here — never materializing
+            # them is the point of the fused loss
+            return loss, None
         logits = self.lm_head(hidden)
         if labels is not None:
             loss = LlamaPretrainingCriterion(self.config)(logits, labels)

@@ -54,10 +54,6 @@ def _attr_ints(name, vs):
             b"".join(P.f_int(8, v) for v in vs) + P.f_int(20, 7))
 
 
-def _attr_float(name, v):
-    return P.f_bytes(1, name) + P.f_float(2, v) + P.f_int(20, 1)
-
-
 def _node(op_type, inputs, outputs, attrs=()):
     out = b"".join(P.f_bytes(1, i) for i in inputs)
     out += b"".join(P.f_bytes(2, o) for o in outputs)
@@ -258,11 +254,21 @@ def export(layer, path, input_spec=None, opset_version=_OPSET, **configs):
 
     if input_spec is None:
         raise ValueError("onnx.export requires input_spec")
+    if opset_version < _OPSET:
+        raise ValueError(
+            f"onnx.export emits opset-{_OPSET} constructs (ReduceSum/"
+            f"Squeeze axes-as-input); opset_version must be >= {_OPSET}, "
+            f"got {opset_version}")
     specs = []
     for s in input_spec:
         if isinstance(s, InputSpec):
-            specs.append((tuple(int(d) if d not in (None, -1) else 1
-                                for d in s.shape), np.dtype(s.dtype)))
+            if any(d in (None, -1) for d in s.shape):
+                raise NotImplementedError(
+                    "onnx.export traces static shapes; dynamic dims "
+                    f"(None/-1) in InputSpec {list(s.shape)} are not "
+                    "supported (they would silently bake as batch 1)")
+            specs.append((tuple(int(d) for d in s.shape),
+                          np.dtype(s.dtype)))
         else:
             arr = getattr(s, "_value", s)
             specs.append((tuple(arr.shape), np.dtype(str(arr.dtype))))

@@ -32,8 +32,6 @@ def _parse_attrs(node_msg) -> dict:
         atype = int(am[20][0])
         if atype == 2:
             attrs[name] = int(am[3][0])
-        elif atype == 1:
-            attrs[name] = float(am[2][0])
         elif atype == 7:
             attrs[name] = [int(v) for v in am.get(8, [])]
         else:

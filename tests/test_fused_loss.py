@@ -55,7 +55,9 @@ def test_llama_fused_loss_trains():
                                  parameters=net.parameters())
 
     def loss_fn(net, ids, labels):
-        return net(ids, labels=labels)
+        loss, logits = net(ids, labels=labels)
+        assert logits is None  # never materialized on the fused path
+        return loss
 
     step = TrainStep(net, loss_fn, opt)
     rng = np.random.default_rng(1)
@@ -75,7 +77,7 @@ def test_llama_fused_matches_unfused_loss_value():
     paddle.seed(3)
     net_u = models.LlamaForCausalLM(models.tiny_llama_config())
     lf = float(net_f(paddle.to_tensor(ids),
-                     labels=paddle.to_tensor(ids))._value)
+                     labels=paddle.to_tensor(ids))[0]._value)
     lu = float(net_u(paddle.to_tensor(ids),
                      labels=paddle.to_tensor(ids))[0]._value)
     np.testing.assert_allclose(lf, lu, rtol=1e-5)

@@ -114,9 +114,14 @@ def test_bf16_export_precision_and_config_knobs(tmp_path):
     assert not cfg.memory_optim_enabled()
     cfg.set_compilation_cache_dir(str(tmp_path / "cache"))
     assert "persistent_compile_cache" in cfg.pass_builder().all_passes()
-    cfg.switch_ir_optim(False)
-    assert cfg._cache_dir is None
     cfg.enable_memory_optim(True)
+    # ir_optim(False) GATES the passes; toggling back restores settings
+    cfg.switch_ir_optim(False)
+    assert not cfg.memory_optim_enabled()
+    assert "persistent_compile_cache" not in cfg.pass_builder().all_passes()
+    cfg.switch_ir_optim(True)
+    assert cfg.memory_optim_enabled()
+    assert "persistent_compile_cache" in cfg.pass_builder().all_passes()
     pred = create_predictor(cfg)
     assert pred.precision_mode() == "bfloat16"
 

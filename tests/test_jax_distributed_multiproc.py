@@ -41,6 +41,47 @@ assert procs == [0, 1], procs
 # local devices belong to this process only
 assert all(d.process_index == jax.process_index()
            for d in jax.local_devices())
+
+# eager collectives auto-select the XLA transport under jax.distributed
+# (tree allgather/psum instead of the O(world^2) store relay)
+import numpy as np
+from paddle_tpu.distributed.eager_comm import init_eager_comm
+
+
+class _BootstrapOnlyStore:
+    # permits only the one-time transport-agreement keys; any data-plane
+    # use of the relay fails the test
+    def __init__(self):
+        self._kv = {}
+
+    def set(self, key, val):
+        assert "/xla_ok/" in key, f"store relay used: set({key})"
+        self._kv[key] = val
+
+    def get(self, key):
+        assert "/xla_ok/" in key, f"store relay used: get({key})"
+        # this per-process stub answers the peer's agreement key with
+        # "1" (both ranks ARE xla-capable here); the real path shares
+        # one TCPStore for the agreement round
+        return self._kv.get(key, b"1")
+
+    def __getattr__(self, name):
+        raise AssertionError(f"store relay used ({name})")
+
+
+comm = init_eager_comm(store=_BootstrapOnlyStore(), rank=get_rank(),
+                       world=2)
+assert comm.use_xla and comm._xla_ok(), "XLA transport not selected"
+r = get_rank()
+s = comm.all_reduce(np.asarray([1.0 + r, 2.0]), op="sum")
+np.testing.assert_allclose(s, [3.0, 4.0])
+mx = comm.all_reduce(np.asarray([float(r)]), op="max")
+np.testing.assert_allclose(mx, [1.0])
+g = comm.all_gather(np.asarray([10 * (r + 1)]))
+np.testing.assert_allclose(np.concatenate(g), [10, 20])
+b = comm.broadcast(np.asarray([42.0 if r == 1 else 0.0]), src=1)
+np.testing.assert_allclose(b, [42.0])
+comm.barrier()
 print("RENDEZVOUS_OK", get_rank())
 """
 

@@ -341,3 +341,58 @@ def test_1f1b_memory_flat_in_n_micro():
     assert g16 >= g4 * 2.0, (g4, g16)
     # and at equal n_micro, 1F1B's working set is smaller
     assert t16 < g16, (t16, g16)
+
+
+def test_1f1b_throughput_not_pathological():
+    """Timing probe (VERDICT r3 weak #8): the 1F1B schedule's wall time
+    must stay in the same ballpark as GPipe+grad — a pathological
+    schedule (accidental serialization, quadratic re-execution) shows up
+    as a multiple, not a constant factor.  Relative probe on the 8-dev
+    CPU mesh (the single real chip cannot host a 2-stage mesh); 1F1B
+    runs ~n_micro+pp ticks of per-tick vjp vs GPipe's fused scan, so a
+    generous 4x bound catches pathology without flaking on CI wall
+    clock."""
+    import time
+
+    import numpy as np
+    from paddle_tpu.distributed.pipeline_engine import (
+        pipeline_apply, pipeline_train_step_1f1b, stack_stage_params)
+
+    n_stages, n_micro, mb, d = 4, 16, 8, 128
+    rng = np.random.default_rng(0)
+    Ws = [jnp.asarray(rng.standard_normal((d, d)).astype(np.float32) * 0.3)
+          for _ in range(n_stages)]
+    params = stack_stage_params([{"w": w} for w in Ws])
+    xs = jnp.asarray(
+        rng.standard_normal((n_micro, mb, d)).astype(np.float32))
+    labels = jnp.asarray(
+        rng.standard_normal((n_micro, mb, d)).astype(np.float32))
+    mesh = _mesh_pipe(n_stages)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    def loss_fn(y, lab):
+        return ((y - lab) ** 2).mean()
+
+    f_1f1b = jax.jit(lambda p, x, l: pipeline_train_step_1f1b(
+        stage_fn, loss_fn, p, x, l, n_stages, mesh))
+
+    def gpipe_loss(p, x, l):
+        ys = pipeline_apply(stage_fn, p, x, n_stages, mesh)
+        return ((ys - l) ** 2).mean()
+
+    f_gpipe = jax.jit(jax.value_and_grad(gpipe_loss))
+
+    def timed(f):
+        jax.block_until_ready(f(params, xs, labels))  # compile
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(params, xs, labels))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_1f1b = timed(f_1f1b)
+    t_gpipe = timed(f_gpipe)
+    assert t_1f1b <= t_gpipe * 4.0 + 0.05, (t_1f1b, t_gpipe)
