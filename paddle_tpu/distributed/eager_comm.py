@@ -49,24 +49,28 @@ class EagerComm:
         self._seq = 0
         if use_xla is not None:
             self.use_xla = bool(use_xla)
+        elif world <= 1:
+            self.use_xla = False
         else:
             # transport AGREEMENT round: each rank's local view (jax
             # distributed up AND its jax process index == its comm rank)
             # is posted through the store; XLA is used only when every
             # rank can — a per-process decision could split the world
-            # across transports and deadlock the next collective
+            # across transports and deadlock the next collective.  Keys
+            # are scoped by this rank's construction COUNT so
+            # re-initialization (e.g. before vs after
+            # jax.distributed.initialize) reads the matching round,
+            # never a stale vote; matched construction order across
+            # ranks is the same contract the collectives already
+            # require.  A store failure here must RAISE — a silent
+            # fallback on one rank would split transports.
             local_ok = _xla_world_available(world) and self._rank_is_jax()
-            if world <= 1:
-                self.use_xla = False
-            else:
-                try:
-                    self.store.set(f"{prefix}/xla_ok/{rank}",
-                                   b"1" if local_ok else b"0")
-                    self.use_xla = all(
-                        self.store.get(f"{prefix}/xla_ok/{r}") == b"1"
-                        for r in range(world))
-                except Exception:
-                    self.use_xla = False
+            epoch = self.store.add(f"{prefix}/xla_round/{rank}", 1)
+            self.store.set(f"{prefix}/xla_ok/{epoch}/{rank}",
+                           b"1" if local_ok else b"0")
+            self.use_xla = all(
+                self.store.get(f"{prefix}/xla_ok/{epoch}/{r}") == b"1"
+                for r in range(world))
 
     def _rank_is_jax(self) -> bool:
         try:

@@ -187,7 +187,7 @@ class Predictor:
         self.config = config
         if _shared is not None:
             (self._exported, self._param_values, self._in_spec,
-             self._compiled, self._precision) = _shared
+             self._compiled, self._precision, self._donating) = _shared
         else:
             prefix = config.model_dir()
             if prefix is None:
@@ -217,7 +217,13 @@ class Predictor:
             self._precision = meta.get("precision")
             exported = self._exported
             jit_kwargs = {}
-            if config._effective_memory_optim() and self._in_spec:
+            # SNAPSHOT the donation decision: it is baked into the
+            # compiled executable, so run() must not re-read the mutable
+            # config (a post-create switch_ir_optim(False) would skip
+            # the defensive input copies while XLA still donates)
+            self._donating = bool(config._effective_memory_optim()
+                                  and self._in_spec)
+            if self._donating:
                 # donate input buffers: XLA may write outputs in place
                 jit_kwargs["donate_argnums"] = tuple(
                     range(1, 1 + len(self._in_spec)))
@@ -250,7 +256,7 @@ class Predictor:
     def run(self, inputs: Optional[List] = None):
         """Execute the compiled program. Either feed via input handles
         (reference style) or pass arrays directly and get arrays back."""
-        donating = self.config._effective_memory_optim()
+        donating = self._donating
         if inputs is not None:
             arrays = [getattr(a, "_value", None) if hasattr(a, "_value")
                       else jnp.asarray(a) for a in inputs]
@@ -303,7 +309,7 @@ class Predictor:
         return Predictor(self.config,
                          _shared=(self._exported, self._param_values,
                                   self._in_spec, self._compiled,
-                                  self._precision))
+                                  self._precision, self._donating))
 
 
 def create_predictor(config: Config) -> Predictor:

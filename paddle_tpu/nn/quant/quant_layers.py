@@ -90,17 +90,31 @@ class QuantizedLinearInfer(Layer):
         self.out_features = out_features
         self._act_scale = act_scale
         self._bits = bits
+        # a following activation folded into the kernel epilogue by
+        # quantization.fuse_act_into_quant_linear ("gelu"/"relu"/"silu");
+        # the fused form is inference-only (no custom vjp)
+        self._fused_act = None
 
     def forward(self, x):
         from ...ops.pallas import quantized_matmul as pallas_qmm
-        # Pallas qmm only at decode-sized M (it re-streams the weight per
-        # M-block — see should_use_pallas); larger M takes XLA's fused
-        # int8-upcast matmul, which reads the int8 weight once
-        if pallas_qmm.should_use_pallas(x, self.qweight, max_m=64):
+        fused_act = self._fused_act
+        # Pallas qmm at decode-sized M always (it re-streams the weight
+        # per M-block — see should_use_pallas); with a fused epilogue the
+        # kernel also wins at serving M (the custom call is a fusion
+        # barrier, so XLA's path materializes the epilogue between
+        # kernels) — measured in BASELINE.md's int8 serving section.
+        # Capped at 512 rows: beyond that the per-M-block weight
+        # re-stream (the 13x prefill regression) outweighs the epilogue
+        max_m = 512 if fused_act else 64
+        if pallas_qmm.should_use_pallas(x, self.qweight, max_m=max_m):
             from ...core.dispatch import dispatch
             has_bias = self.bias is not None
 
             def impl(a, qw, s, *rest):
+                if fused_act:
+                    return pallas_qmm.quantized_matmul(
+                        a, qw, s, bias=rest[0] if rest else None,
+                        act=fused_act)
                 out = pallas_qmm.quantized_matmul(a, qw, s)
                 if rest:
                     out = out + rest[0].astype(out.dtype)
@@ -108,7 +122,13 @@ class QuantizedLinearInfer(Layer):
 
             args = (x, self.qweight, self.weight_scale) + \
                 ((self.bias,) if has_bias else ())
-            mask = [False, True, True] + ([False] if has_bias else [])
+            if fused_act:
+                # inference-only: the fused-epilogue kernel has no vjp,
+                # so every input is nondiff (a requires-grad bias would
+                # otherwise pull jax.vjp through the pallas call)
+                mask = [True] * len(args)
+            else:
+                mask = [False, True, True] + ([False] if has_bias else [])
             return dispatch("quantized_linear", impl, args,
                             nondiff_mask=mask)
         # dequant INTO the activation dtype: bf16 activations keep the
@@ -117,7 +137,13 @@ class QuantizedLinearInfer(Layer):
         xv = x._value if hasattr(x, "_value") else x
         w = Tensor(_dequant(self.qweight._value, self.weight_scale._value,
                             axis=-1).astype(xv.dtype))
-        return F.linear(x, w, self.bias)
+        out = F.linear(x, w, self.bias)
+        if fused_act:
+            # approximate=True matches the kernel epilogue's tanh GELU —
+            # outputs must not depend on which path the batch size takes
+            out = {"gelu": lambda t: F.gelu(t, True), "relu": F.relu,
+                   "silu": F.silu}[fused_act](out)
+        return out
 
 
 class QuantizedConv2DInfer(Layer):

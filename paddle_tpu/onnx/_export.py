@@ -254,11 +254,12 @@ def export(layer, path, input_spec=None, opset_version=_OPSET, **configs):
 
     if input_spec is None:
         raise ValueError("onnx.export requires input_spec")
-    if opset_version < _OPSET:
+    if not (_OPSET <= opset_version <= 17):
         raise ValueError(
             f"onnx.export emits opset-{_OPSET} constructs (ReduceSum/"
-            f"Squeeze axes-as-input); opset_version must be >= {_OPSET}, "
-            f"got {opset_version}")
+            "Squeeze axes-as-input, ReduceMax/Min axes-as-attribute — "
+            "the latter is invalid from opset 18); opset_version must "
+            f"be in [{_OPSET}, 17], got {opset_version}")
     specs = []
     for s in input_spec:
         if isinstance(s, InputSpec):

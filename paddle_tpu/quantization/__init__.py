@@ -19,10 +19,11 @@ from .quanters import (BaseQuanter, FakeQuanterWithAbsMaxObserver,
                        FakeQuanterChannelWiseAbsMaxObserver,
                        quantize_tensor, dequantize_tensor, fake_quant)
 from .qat import QAT
-from .ptq import PTQ, weight_only_quantize
+from .ptq import PTQ, fuse_act_into_quant_linear, weight_only_quantize
 
 __all__ = [
     "QuantConfig", "QAT", "PTQ", "weight_only_quantize",
+    "fuse_act_into_quant_linear",
     "BaseObserver", "AbsmaxObserver", "MovingAverageAbsmaxObserver",
     "PerChannelAbsmaxObserver",
     "BaseQuanter", "FakeQuanterWithAbsMaxObserver",

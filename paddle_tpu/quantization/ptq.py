@@ -70,6 +70,46 @@ def weight_only_quantize(model: Layer, inplace: bool = True,
     return model
 
 
+_FUSABLE_ACTS = {"GELU": "gelu", "ReLU": "relu", "Silu": "silu",
+                 "SiLU": "silu"}
+
+
+def fuse_act_into_quant_linear(model: Layer) -> int:
+    """Fold ``nn.Sequential``-adjacent activation layers (GELU/ReLU/Silu)
+    into the preceding ``QuantizedLinearInfer``'s kernel epilogue and
+    replace them with Identity.  The conv_bn-fuse/TRT-epilogue role
+    (reference ``conv_bn_fuse_pass.cc`` tradition): a Pallas custom call
+    is an XLA fusion barrier, so WITHOUT this the dequant+bias+act
+    materialize between kernels.  Returns the number of pairs fused.
+    The fused GELU uses the tanh approximation (Mosaic has no erf):
+    <= ~3e-3 absolute deviation from the exact form, under the int8
+    quantization error; ``approximate=True`` GELU layers fuse to the
+    same formula."""
+    from ..nn.layer.common import Identity
+    from ..nn.quant.quant_layers import QuantizedLinearInfer
+    fused = 0
+
+    def rec(layer: Layer):
+        nonlocal fused
+        from ..nn.layer.container import Sequential
+        if isinstance(layer, Sequential):
+            items = list(layer._sub_layers.items())
+            for (n1, a), (n2, b) in zip(items, items[1:]):
+                act = _FUSABLE_ACTS.get(type(b).__name__)
+                if act is None or not isinstance(a, QuantizedLinearInfer):
+                    continue
+                if a._fused_act is not None:
+                    continue
+                a._fused_act = act
+                layer._sub_layers[n2] = Identity()
+                fused += 1
+        for sub in layer._sub_layers.values():
+            rec(sub)
+
+    rec(model)
+    return fused
+
+
 class PTQ:
     def __init__(self, config: QuantConfig):
         self._config = config

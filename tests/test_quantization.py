@@ -228,6 +228,62 @@ def test_static_quant_post_static():
     assert np.all(np.isfinite(np.asarray(out._value)))
 
 
+def test_fused_epilogue_matches_unfused():
+    """dequant+bias+act inside the qmm kernel == separate linear+act
+    (interpret mode), for all three epilogues and both bias cases."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.ops.pallas.quantized_matmul import quantized_matmul
+    rng = np.random.default_rng(0)
+    m, k, n = 16, 128, 256
+    x = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    qw = jnp.asarray(rng.integers(-127, 127, (k, n)), jnp.int8)
+    scales = jnp.asarray(rng.uniform(0.01, 0.02, (n,)), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((n,)), jnp.float32)
+    base = np.asarray(quantized_matmul(x, qw, scales))
+    import jax
+    for act, ref in (("relu", lambda v: np.maximum(v, 0)),
+                     # kernel GELU is the tanh approximation (no erf in
+                     # Mosaic)
+                     ("gelu", lambda v: np.asarray(
+                         jax.nn.gelu(jnp.asarray(v), approximate=True))),
+                     ("silu", lambda v: v / (1 + np.exp(-v)))):
+        got = np.asarray(quantized_matmul(x, qw, scales, act=act))
+        np.testing.assert_allclose(got, ref(base), rtol=1e-5, atol=1e-5,
+                                   err_msg=act)
+        got_b = np.asarray(quantized_matmul(x, qw, scales, bias=bias,
+                                            act=act))
+        np.testing.assert_allclose(got_b, ref(base + np.asarray(bias)),
+                                   rtol=1e-5, atol=1e-5, err_msg=act)
+
+
+def test_fuse_act_pass_and_layer_parity():
+    """fuse_act_into_quant_linear folds Sequential (qlinear, act) pairs;
+    the fused model's outputs match the unfused conversion."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.quantization import (fuse_act_into_quant_linear,
+                                         weight_only_quantize)
+    from paddle_tpu.nn.quant.quant_layers import QuantizedLinearInfer
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(32, 64), nn.GELU(),
+                        nn.Linear(64, 32), nn.ReLU(),
+                        nn.Linear(32, 16), nn.Tanh())  # tanh NOT fusable
+    net.eval()
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((4, 32)).astype(np.float32))
+    weight_only_quantize(net)
+    want = np.asarray(net(x)._value)
+    n_fused = fuse_act_into_quant_linear(net)
+    assert n_fused == 2, n_fused
+    assert net[0]._fused_act == "gelu" and net[2]._fused_act == "relu"
+    assert type(net[1]).__name__ == "Identity"
+    assert isinstance(net[4], QuantizedLinearInfer) and \
+        net[4]._fused_act is None
+    got = np.asarray(net(x)._value)
+    # fused GELU is the tanh approximation: <= ~3e-3 absolute deviation
+    np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-2)
+
+
 def test_int8_ptq_through_predictor(tmp_path):
     """End-to-end int8 serving (VERDICT r2 item 10): PTQ-calibrate ->
     convert -> jit.save -> Predictor run; int8 outputs stay close to the
@@ -260,6 +316,12 @@ def test_int8_ptq_through_predictor(tmp_path):
     err = np.abs(np.asarray(q_out._value) - np.asarray(ref_out._value))
     rel = err.max() / (np.abs(np.asarray(ref_out._value)).max() + 1e-9)
     assert rel < 0.05, rel  # int8 quantization error bound
+
+    # fuse the GELU into the qmm epilogue: the Predictor serving path
+    # runs the fused kernel (tanh-approx GELU; tolerance below covers it)
+    from paddle_tpu.quantization import fuse_act_into_quant_linear
+    assert fuse_act_into_quant_linear(net) == 1
+    q_out = net(calib[0])
 
     # export + serve through the Predictor
     prefix = str(tmp_path / "int8_model")
