@@ -16,6 +16,7 @@ from .. import nn
 from ..nn import functional as F
 from ..tensor.creation import arange
 from ..tensor.manipulation import concat, unsqueeze
+from .generation import GenerationMixin
 from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy)
@@ -77,25 +78,49 @@ class GPTAttention(nn.Layer):
             self.out_proj = nn.Linear(h, h)
         self.dropout_p = config.attention_probs_dropout_prob
 
-    def forward(self, x, attention_mask=None, cache=None):
+    def forward(self, x, attention_mask=None):
+        # (cached decoding lives in prefill/decode_step below — the
+        # static-cache GenerationMixin path; the old concat-grow cache
+        # was removed with it)
         b, s, _ = x.shape
         qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads,
                                         self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if cache is not None:
-            k = concat([cache[0], k], axis=1)
-            v = concat([cache[1], v], axis=1)
-            cache = (k, v)
-        # causal whenever q covers the same span as k (full forward, or the
-        # prompt step of cached decoding where the cache starts empty); a
-        # single-token decode step attends to the whole cache, so no mask.
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attention_mask,
             dropout_p=self.dropout_p if self.training else 0.0,
-            is_causal=attention_mask is None and k.shape[1] == s)
+            is_causal=attention_mask is None)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         out = self.out_proj(out)
-        return (out, cache) if cache is not None else out
+        return out
+
+    def prefill(self, x):
+        """Causal forward returning the K/V planes ([B, S, H, D]) for
+        the static generation cache (models/generation.py)."""
+        b, s, _ = x.shape
+        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads,
+                                        self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = self.out_proj(out.reshape([b, s, -1]))
+        return out, (k._value, v._value)
+
+    def decode_step(self, x, kv, lens):
+        """One cached decode step (MHA: kv heads == q heads, so the GQA
+        grouped attention runs with group size 1)."""
+        from .generation import cache_scatter, cached_decode_attention
+        from ..core.tensor import Tensor
+        b = x.shape[0]
+        k_cache, v_cache = kv
+        qkv = self.qkv_proj(x).reshape([b, 1, 3, self.num_heads,
+                                        self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k_cache = cache_scatter(k_cache, lens, k._value[:, 0])
+        v_cache = cache_scatter(v_cache, lens, v._value[:, 0])
+        out = cached_decode_attention(q._value[:, 0], k_cache, v_cache,
+                                      lens)
+        out = self.out_proj(Tensor(out[:, None, :]))
+        return out, (k_cache, v_cache)
 
 
 class GPTMLP(nn.Layer):
@@ -126,20 +151,26 @@ class GPTDecoderLayer(nn.Layer):
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
         self._recompute = config.recompute
 
-    def _forward_impl(self, x, attention_mask=None, cache=None):
-        if cache is not None:
-            a, cache = self.attn(self.ln_1(x), attention_mask, cache)
-        else:
-            a = self.attn(self.ln_1(x), attention_mask)
-        x = x + self.dropout(a)
+    def _forward_impl(self, x, attention_mask=None):
+        x = x + self.dropout(self.attn(self.ln_1(x), attention_mask))
         x = x + self.mlp(self.ln_2(x))
-        return (x, cache) if cache is not None else x
+        return x
 
-    def forward(self, x, attention_mask=None, cache=None):
-        if self._recompute and self.training and cache is None:
+    def forward(self, x, attention_mask=None):
+        if self._recompute and self.training:
             from ..distributed.utils import recompute
             return recompute(self._forward_impl, x, attention_mask)
-        return self._forward_impl(x, attention_mask, cache)
+        return self._forward_impl(x, attention_mask)
+
+    def prefill(self, x):
+        a, kv = self.attn.prefill(self.ln_1(x))
+        x = x + self.dropout(a)
+        return x + self.mlp(self.ln_2(x)), kv
+
+    def decode_step(self, x, kv, lens):
+        a, kv = self.attn.decode_step(self.ln_1(x), kv, lens)
+        x = x + self.dropout(a)
+        return x + self.mlp(self.ln_2(x)), kv
 
 
 class GPTModel(nn.Layer):
@@ -158,26 +189,17 @@ class GPTModel(nn.Layer):
                                for _ in range(config.num_hidden_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
 
-    def forward(self, input_ids, position_ids=None, attention_mask=None,
-                caches=None):
+    def forward(self, input_ids, position_ids=None, attention_mask=None):
         b, s = input_ids.shape
         if position_ids is None:
-            start = 0 if caches is None else caches[0][0].shape[1]
-            position_ids = unsqueeze(
-                arange(start, start + s, dtype="int64"), 0)
+            position_ids = unsqueeze(arange(0, s, dtype="int64"), 0)
         x = self.drop(self.wte(input_ids) + self.wpe(position_ids))
-        new_caches = [] if caches is not None else None
-        for i, block in enumerate(self.h):
-            if caches is not None:
-                x, c = block(x, attention_mask, caches[i])
-                new_caches.append(c)
-            else:
-                x = block(x, attention_mask)
-        x = self.ln_f(x)
-        return (x, new_caches) if caches is not None else x
+        for block in self.h:
+            x = block(x, attention_mask)
+        return self.ln_f(x)
 
 
-class GPTForCausalLM(nn.Layer):
+class GPTForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.config = config
@@ -192,11 +214,7 @@ class GPTForCausalLM(nn.Layer):
         self.criterion = GPTPretrainingCriterion(config)
 
     def forward(self, input_ids, position_ids=None, attention_mask=None,
-                labels=None, caches=None):
-        if caches is not None:
-            hidden, caches = self.gpt(input_ids, position_ids,
-                                      attention_mask, caches)
-            return self.lm_head(hidden), caches
+                labels=None):
         hidden = self.gpt(input_ids, position_ids, attention_mask)
         logits = self.lm_head(hidden)
         if labels is not None:
@@ -204,23 +222,70 @@ class GPTForCausalLM(nn.Layer):
             return loss, logits
         return logits
 
-    def generate(self, input_ids, max_new_tokens: int = 16):
-        """Greedy decode with KV cache (static shapes per step)."""
-        from ..tensor.creation import zeros
-        b = input_ids.shape[0]
-        caches = [(zeros([b, 0, self.config.num_attention_heads,
-                          self.config.head_dim]),
-                   zeros([b, 0, self.config.num_attention_heads,
-                          self.config.head_dim]))
-                  for _ in range(self.config.num_hidden_layers)]
-        tokens = input_ids
-        cur = input_ids
-        for _ in range(max_new_tokens):
-            logits, caches = self.forward(cur, caches=caches)
-            nxt = logits[:, -1].argmax(axis=-1).reshape([b, 1]).astype("int64")
-            tokens = concat([tokens, nxt], axis=1)
-            cur = nxt
-        return tokens
+    # -- GenerationMixin surface (models/generation.py: static slot
+    # cache, ONE compiled dispatch for prefill + the whole decode scan;
+    # replaces the old eager concat-grow loop, which recompiled per
+    # step under jit and returned prompt+new instead of just new) --
+    def generate(self, input_ids, seq_lens=None, max_new_tokens=32, **kw):
+        import numpy as np
+        s = input_ids.shape[1]
+        limit = self.config.max_position_embeddings
+        if seq_lens is None:
+            max_len = s
+        else:
+            max_len = int(np.max(np.asarray(
+                getattr(seq_lens, "_value", seq_lens))))
+        # learned positions: an out-of-table lookup would silently clamp
+        # under jit and decode with a repeated position.  Prefill looks
+        # up arange(s); the last FED decode token sits at position
+        # max_len + max_new_tokens - 2 (ragged right-padded prompts only
+        # consume positions up to their true lengths)
+        if s > limit or max_len + max_new_tokens - 1 > limit:
+            raise ValueError(
+                f"generate: positions up to "
+                f"{max(s - 1, max_len + max_new_tokens - 2)} exceed "
+                f"max_position_embeddings ({limit})")
+        return GenerationMixin.generate(self, input_ids,
+                                        seq_lens=seq_lens,
+                                        max_new_tokens=max_new_tokens,
+                                        **kw)
+
+    def kv_cache_spec(self):
+        return (self.config.num_hidden_layers,
+                self.config.num_attention_heads, self.config.head_dim)
+
+    def prefill(self, ids, lens, kvs):
+        import jax
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        b, s = ids.shape
+        pos = unsqueeze(arange(0, s, dtype="int64"), 0)
+        x = self.gpt.drop(self.gpt.wte(Tensor(ids)) + self.gpt.wpe(pos))
+        out_kvs = []
+        for block, (kc, vc) in zip(self.gpt.h, kvs):
+            x, (k, v) = block.prefill(x)
+            kc = jax.lax.dynamic_update_slice(
+                kc, k.astype(kc.dtype), (0, 0, 0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v.astype(vc.dtype), (0, 0, 0, 0))
+            out_kvs.append((kc, vc))
+        h = self.gpt.ln_f(x)._value
+        last = h[jnp.arange(b), lens - 1]
+        logits = self.lm_head(Tensor(last[:, None, :]))._value[:, 0]
+        return logits, out_kvs
+
+    def decode_step(self, tokens, lens, kvs):
+        from ..core.tensor import Tensor
+        tok = Tensor(tokens[:, None])
+        pos = Tensor(lens[:, None].astype("int32"))
+        x = self.gpt.drop(self.gpt.wte(tok) + self.gpt.wpe(pos))
+        new_kvs = []
+        for block, kv in zip(self.gpt.h, kvs):
+            x, kv = block.decode_step(x, kv, lens)
+            new_kvs.append(kv)
+        x = self.gpt.ln_f(x)
+        logits = self.lm_head(x)._value[:, 0]
+        return logits, new_kvs
 
 
 class GPTPretrainingCriterion(nn.Layer):

@@ -98,6 +98,16 @@ class QuantizedLinearInfer(Layer):
     def forward(self, x):
         from ...ops.pallas import quantized_matmul as pallas_qmm
         fused_act = self._fused_act
+        use_fused_kernel = bool(fused_act)
+        if fused_act and isinstance(x, Tensor) and not x.stop_gradient:
+            from ...core.tape import is_grad_enabled
+            if is_grad_enabled():
+                # the fused-epilogue kernel has no vjp; an all-nondiff
+                # dispatch would return detached outputs and silently
+                # sever upstream gradients — take the differentiable
+                # dequant+linear+act fallback instead (same math, the
+                # XLA path)
+                use_fused_kernel = False
         # Pallas qmm at decode-sized M always (it re-streams the weight
         # per M-block — see should_use_pallas); with a fused epilogue the
         # kernel also wins at serving M (the custom call is a fusion
@@ -105,8 +115,9 @@ class QuantizedLinearInfer(Layer):
         # kernels) — measured in BASELINE.md's int8 serving section.
         # Capped at 512 rows: beyond that the per-M-block weight
         # re-stream (the 13x prefill regression) outweighs the epilogue
-        max_m = 512 if fused_act else 64
-        if pallas_qmm.should_use_pallas(x, self.qweight, max_m=max_m):
+        max_m = 512 if use_fused_kernel else 64
+        if (use_fused_kernel or not fused_act) and \
+                pallas_qmm.should_use_pallas(x, self.qweight, max_m=max_m):
             from ...core.dispatch import dispatch
             has_bias = self.bias is not None
 
