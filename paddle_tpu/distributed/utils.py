@@ -43,6 +43,12 @@ def _resolve_policy(policy):
         # bench scale vs "dots"'s ~700 MB/layer (OOM at 16 layers)
         "save_attn": jax.checkpoint_policies.save_only_these_names(
             "attn_out"),
+        # additionally save the MLP gate/up projections (+536 MB/layer at
+        # bench scale): backward skips the two [hidden, intermediate]
+        # matmul recomputes — apply via recompute_policy_stride/_alt to
+        # the layer subset that fits HBM
+        "save_attn_mlp": jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "mlp_gate_up"),
     }
     if policy not in policies:
         raise ValueError(

@@ -45,7 +45,49 @@ def _functional_momentum(p, g, state, lr, hp):
     return p_new, {"velocity": v_new}
 
 
-def _functional_adam(p, g, state, lr, hp):
+_SR_TILE = 1 << 16  # 64Ki u32 = 256 KB of noise per draw
+
+
+def _stochastic_round_bf16(x, key):
+    """Unbiased f32 -> bf16: add uniform 16-bit noise below the bf16
+    mantissa boundary, then truncate (E[result] == x; plain
+    round-to-nearest would bias an EMA that accumulates thousands of
+    sub-ULP updates).
+
+    Noise economics at 1.1B-param scale: threefry (jax.random.randint)
+    costs ~40 ms/step of generation, and a full-size rng_bit_generator
+    buffer is a 4.4 GB HBM transient (measured OOM).  Instead ONE small
+    hardware-RBG tile per store is broadcast across rows: every element
+    still sees uniform noise that is fresh each step (per-element
+    unbiasedness needs independence across STEPS, which the per-step
+    key provides; correlation across positions within one step does not
+    bias the EMA means)."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    bits = jax.lax.bitcast_convert_type(flat, jnp.uint32)
+    kd = jax.random.key_data(key).astype(jnp.uint32).reshape(-1)
+    seed = jnp.tile(kd, 2)[:4] if kd.size < 4 else kd[:4]
+    _, tile = jax.lax.rng_bit_generator(seed, (_SR_TILE,),
+                                        dtype=jnp.uint32)
+    pad = (-n) % _SR_TILE
+    if pad:
+        bits = jnp.pad(bits, (0, pad))
+    noise2 = (bits.reshape(-1, _SR_TILE) + (tile & jnp.uint32(0xFFFF))
+              [None, :]) & jnp.uint32(0xFFFF0000)
+    out = jax.lax.bitcast_convert_type(noise2.reshape(-1)[:n],
+                                       jnp.float32).astype(jnp.bfloat16)
+    return out.reshape(x.shape)
+
+
+def _store_moment(val_f32, like, key):
+    if like.dtype == jnp.float32:
+        return val_f32
+    if like.dtype == jnp.bfloat16 and key is not None:
+        return _stochastic_round_bf16(val_f32, key)
+    return val_f32.astype(like.dtype)
+
+
+def _functional_adam(p, g, state, lr, hp, key=None):
     gf = g.astype(jnp.float32)
     pf = p.astype(jnp.float32)
     b1, b2, eps, wd = hp["beta1"], hp["beta2"], hp["epsilon"], hp["wd"]
@@ -54,8 +96,8 @@ def _functional_adam(p, g, state, lr, hp):
     elif wd:
         gf = gf + wd * pf
     t = state["t"] + 1
-    m = b1 * state["m"] + (1 - b1) * gf
-    v = b2 * state["v"] + (1 - b2) * gf * gf
+    m = b1 * state["m"].astype(jnp.float32) + (1 - b1) * gf
+    v = b2 * state["v"].astype(jnp.float32) + (1 - b2) * gf * gf
     m_hat = m / (1 - b1 ** t)
     v_hat = v / (1 - b2 ** t)
     from ..core.flags import flag
@@ -69,7 +111,12 @@ def _functional_adam(p, g, state, lr, hp):
             .astype(p.dtype)
     else:
         p_new = (pf - lr * m_hat / (jnp.sqrt(v_hat) + eps)).astype(p.dtype)
-    return p_new, {"m": m, "v": v, "t": t}
+    if key is not None:
+        km, kv2 = jax.random.split(key)
+    else:
+        km = kv2 = None
+    return p_new, {"m": _store_moment(m, state["m"], km),
+                   "v": _store_moment(v, state["v"], kv2), "t": t}
 
 
 def _fused_adam_ok(update_fn, hypers, mesh):
@@ -82,6 +129,9 @@ def _fused_adam_ok(update_fn, hypers, mesh):
     into the grad, which the kernel does not model)."""
     from ..core.flags import flag
     from ..ops.pallas._common import on_tpu
+    # per-PARAM moment dtype is checked at the apply site (the kernel
+    # wants fp32 m/v, which every param except bf16-under-
+    # multi_precision=False has)
     return (update_fn is _functional_adam and hypers.get("decoupled")
             and mesh is None and on_tpu()
             and bool(flag("use_fused_adamw_kernel")))
@@ -123,16 +173,25 @@ class TrainStep:
         self._gm_state = None
 
     def _select_update(self, opt):
+        # multi_precision=False follows the reference contract: moments
+        # live in the PARAM dtype (paddle adamw kernel's mp_ branch is
+        # the fp32 path).  bf16 moments store via stochastic rounding —
+        # plain round-to-nearest would bias the EMAs; with SR the
+        # optimizer-state HBM sweep halves (BASELINE.md round 4)
         if isinstance(opt, AdamW):
             return _functional_adam, {
                 "beta1": opt._beta1, "beta2": opt._beta2,
                 "epsilon": opt._epsilon, "wd": opt._weight_decay,
-                "decoupled": True}
+                "decoupled": True,
+                "multi_precision": bool(getattr(opt, "_multi_precision",
+                                                True))}
         if isinstance(opt, Adam):
             return _functional_adam, {
                 "beta1": opt._beta1, "beta2": opt._beta2,
                 "epsilon": opt._epsilon, "wd": opt._weight_decay,
-                "decoupled": False}
+                "decoupled": False,
+                "multi_precision": bool(getattr(opt, "_multi_precision",
+                                                True))}
         if isinstance(opt, Momentum):
             return _functional_momentum, {
                 "momentum": opt._momentum, "nesterov": opt._use_nesterov}
@@ -187,8 +246,18 @@ class TrainStep:
             return self._place(arr, self._opt_state_sharding(p))
 
         if self._update_fn is _functional_adam:
-            return [{"m": zeros_like_placed(p, jnp.float32),
-                     "v": zeros_like_placed(p, jnp.float32),
+            # moment dtype: fp32 under multi_precision (default); with
+            # multi_precision=False, bf16 params get bf16 moments (the
+            # reference contract, stored via stochastic rounding).  fp16
+            # params STAY fp32: fp16's 5-bit exponent overflows v at
+            # |grad| > ~256, and the SR path is bf16-only
+            def mdt(p):
+                if self._hypers.get("multi_precision", True):
+                    return jnp.float32
+                return (jnp.bfloat16 if p._value.dtype == jnp.bfloat16
+                        else jnp.float32)
+            return [{"m": zeros_like_placed(p, mdt(p)),
+                     "v": zeros_like_placed(p, mdt(p)),
                      "t": jnp.zeros((), jnp.float32)} for p in self._params]
         if self._update_fn is _functional_momentum:
             return [{"velocity": zeros_like_placed(p)}
@@ -298,11 +367,23 @@ class TrainStep:
                     gs = [g * scale.astype(g.dtype) for g in gs]
                 new_p, new_s = [], []
                 for i, (p, g, s) in enumerate(zip(p_vals, gs, opt_in)):
+                    moments_f32 = not (isinstance(s, dict)
+                                       and s.get("m") is not None
+                                       and s["m"].dtype != jnp.float32)
                     fn_i = (_fused_adam_update
-                            if fused_adam and jnp.issubdtype(
-                                p.dtype, jnp.floating)
+                            if fused_adam and moments_f32
+                            and jnp.issubdtype(p.dtype, jnp.floating)
                             else update_fn)
-                    np_, ns_ = fn_i(p, g, s, lr, hypers)
+                    if fn_i is _functional_adam and isinstance(s, dict) \
+                            and s.get("m") is not None \
+                            and s["m"].dtype == jnp.bfloat16:
+                        # bf16 moments store via stochastic rounding —
+                        # a per-param key far from the dropout stream
+                        np_, ns_ = fn_i(p, g, s, lr, hypers,
+                                        key=jax.random.fold_in(
+                                            rng_key, 1 << 20 | i))
+                    else:
+                        np_, ns_ = fn_i(p, g, s, lr, hypers)
                     np_ = pin(np_, param_pins[i], p.shape)
                     ns_ = {k: pin(v, state_pins[i], p.shape)
                            for k, v in ns_.items()}

@@ -49,10 +49,12 @@ class LlamaConfig:
     # outputs and recomputes attention/elementwise — see
     # distributed/utils._resolve_policy); None = full remat
     recompute_policy: Optional[str] = None
-    # apply recompute_policy to every k-th layer only (the rest full-remat)
-    # — a memory/time dial when the policy's saves don't fit HBM for all
-    # layers (1 = every layer)
+    # apply recompute_policy to every k-th layer, recompute_policy_alt to
+    # the rest — a memory/time dial when the stronger policy's saves
+    # don't fit HBM for all layers (stride 1 = recompute_policy
+    # everywhere)
     recompute_policy_stride: int = 1
+    recompute_policy_alt: Optional[str] = None
     # fuse lm_head + cross entropy (chunked over tokens, [N, vocab]
     # logits never materialized — incubate fused_linear_cross_entropy);
     # training-with-labels path only, single-device (TP uses ParallelCE)
@@ -193,7 +195,15 @@ class LlamaMLP(nn.Layer):
             self.down_proj = nn.Linear(m, h, bias_attr=False)
 
     def forward(self, x):
-        return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+        from jax.ad_checkpoint import checkpoint_name
+        from ..core.tensor import Tensor
+        # tagged for the "save_attn_mlp" remat policy: with gate and up
+        # outputs saved, backward skips re-running the two big
+        # [hidden, intermediate] matmuls (their grads need BOTH)
+        g = Tensor(checkpoint_name(self.gate_proj(x)._value,
+                                   "mlp_gate_up"))
+        u = Tensor(checkpoint_name(self.up_proj(x)._value, "mlp_gate_up"))
+        return self.down_proj(swiglu(g, u))
 
 
 class LlamaDecoderLayer(nn.Layer):
@@ -208,7 +218,8 @@ class LlamaDecoderLayer(nn.Layer):
         self._recompute = config.recompute
         stride = max(1, config.recompute_policy_stride)
         self._recompute_policy = (config.recompute_policy
-                                  if layer_idx % stride == 0 else None)
+                                  if layer_idx % stride == 0
+                                  else config.recompute_policy_alt)
 
     def _forward_impl(self, x, position_ids=None, attention_mask=None):
         h = x + self.self_attn(self.input_layernorm(x), position_ids,
