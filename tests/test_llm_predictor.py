@@ -90,6 +90,24 @@ def test_weight_only_int8_session():
                                   got)
 
 
+def test_gpt_session_matches_generate():
+    # the serving session is model-agnostic: any GenerationMixin model
+    # (here GPT: MHA + learned positions) drives it
+    from paddle_tpu.models import GPTForCausalLM, tiny_gpt_config
+    cfg = tiny_gpt_config()
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    rng = np.random.default_rng(11)
+    ids = rng.integers(0, cfg.vocab_size, (2, 4))
+    pred = LLMPredictor(net, batch=2, prompt_len=4, max_cache_len=16,
+                        steps_per_call=3, compute_dtype="float32")
+    got = pred.generate(ids, max_new_tokens=6)
+    want = np.asarray(net.generate(paddle.to_tensor(ids),
+                                   max_new_tokens=6, max_cache_len=16,
+                                   compute_dtype="float32")._value)
+    np.testing.assert_array_equal(got, want)
+
+
 def test_session_guards():
     cfg, net = _net()
     pred = LLMPredictor(net, batch=1, prompt_len=4, max_cache_len=8,
