@@ -592,6 +592,13 @@ class _ReturnInLoopLowering(ast.NodeTransformer):
         new_body = self._replace_returns(node.body)
         if new_body is None:
             return node  # bare return: keep the clear fallback error
+        if _has_node(new_body, (ast.Return,)):
+            # A return survived the walk (nested in try/with, which
+            # _replace_returns does not descend into and pass 2 cannot
+            # lower anyway) — leave the loop untouched so the generic
+            # return-in-loop error path fires instead of injecting dead
+            # flag plumbing around a half-lowered loop.
+            return node
         node.body = new_body or [ast.Pass()]
         self.used = True
         init = [ast.Assign(targets=[_name_store(self.flag)],

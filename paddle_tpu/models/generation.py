@@ -144,6 +144,13 @@ def swap_call(params, buffers, p_values, b_values, compute_dtype, fn):
     (params cast to the serving dtype once — the hoisted fast-layout
     copy; buffers passed through uncast: int8 weights stay int8 and
     quant scales stay fp32)."""
+    if len(params) != len(p_values) or len(buffers) != len(b_values):
+        raise RuntimeError(
+            f"swap_call structure mismatch: captured {len(params)} params/"
+            f"{len(buffers)} buffers but got {len(p_values)}/{len(b_values)} "
+            "values — the model was structurally mutated (e.g. "
+            "weight_only_quantize) after a generate() program was compiled; "
+            "the stale executable cannot be reused")
     pv = _cast_params(p_values, compute_dtype)
     saved_p = [p._value for p in params]
     saved_b = [b._value for b in buffers]
@@ -200,16 +207,28 @@ class GenerationMixin:
     """
 
     def _generate_compiled(self, b, s_prompt, max_cache_len,
-                           cfg: GenerationConfig):
+                           cfg: GenerationConfig, arrays):
         cache = getattr(self, "_generate_exe_cache", None)
         if cache is None:
             cache = self._generate_exe_cache = {}
-        keyt = (b, s_prompt, max_cache_len, cfg)
+        params, buffers = arrays
+        # The compiled closure captures THESE param/buffer Tensor lists;
+        # key on their structure so a structural mutation (e.g.
+        # weight_only_quantize swapping Linears for quantized twins, which
+        # moves weights from params to buffers) misses the cache instead of
+        # silently mis-pairing values in swap_call.
+        struct = (tuple(id(p) for p in params),
+                  tuple(id(bf) for bf in buffers))
+        keyt = (b, s_prompt, max_cache_len, cfg, struct)
         hit = cache.get(keyt)
         if hit is not None:
             return hit
+        # Entries traced against a different param/buffer structure are
+        # permanently unreachable AND their closures pin the old weight
+        # lists on device — evict them instead of leaking executables.
+        for stale in [k for k in cache if k[4] != struct]:
+            del cache[stale]
 
-        params, buffers = model_arrays(self)
         n_layers, hkv, d = self.kv_cache_spec()
         cache_dtype = jnp.dtype(cfg.cache_dtype or cfg.compute_dtype)
         model = self
@@ -285,9 +304,20 @@ class GenerationMixin:
             eos_token_id=eos_token_id, pad_token_id=int(pad_token_id),
             compute_dtype=str(compute_dtype),
             cache_dtype=None if cache_dtype is None else str(cache_dtype))
-        fn = self._generate_compiled(b, s, int(max_cache_len), cfg)
-        key = jax.random.PRNGKey(seed)
         params, buffers = model_arrays(self)
-        toks, _ = fn([p._value for p in params],
-                     [bf._value for bf in buffers], ids, lens, key)
+        fn = self._generate_compiled(b, s, int(max_cache_len), cfg,
+                                     arrays=(params, buffers))
+        key = jax.random.PRNGKey(seed)
+        # Decode must never run dropout: force eval for the traced call
+        # (LLMPredictor already does model.eval(); the plain generate()
+        # entry point gets the same guarantee), restoring modes after.
+        saved_modes = [(layer, layer.training)
+                       for layer in self.sublayers(include_self=True)]
+        try:
+            self.eval()
+            toks, _ = fn([p._value for p in params],
+                         [bf._value for bf in buffers], ids, lens, key)
+        finally:
+            for layer, mode in saved_modes:
+                layer.training = mode
         return Tensor(toks)

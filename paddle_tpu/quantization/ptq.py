@@ -44,7 +44,15 @@ def weight_only_quantize(model: Layer, inplace: bool = True,
     """
     if not inplace:
         import copy
-        model = copy.deepcopy(model)
+        # Don't deepcopy compiled generate() executables (and the weight
+        # lists their closures pin) just to discard them below.
+        saved_cache = model.__dict__.pop("_generate_exe_cache", None)
+        original = model
+        try:
+            model = copy.deepcopy(model)
+        finally:
+            if saved_cache is not None:
+                original.__dict__["_generate_exe_cache"] = saved_cache
     converted = 0
 
     def rec(layer: Layer, prefix: str):
@@ -67,6 +75,10 @@ def weight_only_quantize(model: Layer, inplace: bool = True,
             "nn.Linear sublayers (tensor-parallel Column/RowParallelLinear "
             "are not yet supported for int8 serving; quantize the "
             "unsharded model)")
+    # Structural mutation invalidates any compiled generate() programs
+    # (their closures captured the pre-quantization param/buffer lists).
+    if getattr(model, "_generate_exe_cache", None):
+        model._generate_exe_cache.clear()
     return model
 
 

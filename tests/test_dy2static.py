@@ -684,3 +684,39 @@ def test_jit_save_bound_method(tmp_path):
     out = paddle.jit.load(str(tmp_path / "m"))(x)
     np.testing.assert_allclose(np.asarray(ref._value),
                                np.asarray(out._value), rtol=1e-6)
+
+def test_return_in_try_inside_loop_keeps_clear_error():
+    # ADVICE r4: a return nested in try/with inside a traced loop must
+    # leave the loop UNLOWERED (generic return-in-loop error path), not
+    # inject dead flag plumbing around a half-lowered loop.
+    def f(x, lim):
+        s = x
+        while s.sum() < lim:
+            s = s * 2.0
+            try:
+                if s.max() > 30.0:
+                    return s + 100.0
+            finally:
+                pass
+        return s
+
+    static_f = to_static(f)
+    with pytest.raises(NotImplementedError):
+        static_f(_t([1.0]), _t(100.0))
+
+
+def test_return_in_try_concrete_loop_still_works():
+    # With a CONCRETE (python-evaluable) loop the eager path handles
+    # try/finally returns natively — must keep working.
+    def f(x):
+        for i in range(4):
+            try:
+                if i == 2:
+                    return x * i
+            finally:
+                pass
+        return x - 1.0
+
+    static_f = to_static(f)
+    np.testing.assert_allclose(np.asarray(static_f(_t([3.0]))._value),
+                               np.asarray(f(_t([3.0]))._value))

@@ -104,3 +104,47 @@ def test_bf16_generate_runs_and_single_token():
     got32 = np.asarray(net.generate(paddle.to_tensor(ids), max_new_tokens=3,
                                     compute_dtype="bfloat16")._value)
     assert got32.shape == (2, 3)
+
+
+def test_generate_forces_eval_mode_and_restores():
+    """ADVICE r4: generate() must not run dropout even on a train-mode
+    model, and must restore per-layer modes afterward.  Uses GPT (which
+    HAS dropout gated on self.training) and clears the executable cache
+    between calls so a train-mode retrace would actually diverge."""
+    cfg = models.tiny_gpt_config()
+    net = models.GPTForCausalLM(cfg)
+    net.eval()
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, cfg.vocab_size, (1, 5))
+    ref = np.asarray(net.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                                  compute_dtype="float32")._value)
+    net.train()
+    net._generate_exe_cache.clear()  # force a retrace in train mode
+    got = np.asarray(net.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                                  compute_dtype="float32")._value)
+    np.testing.assert_array_equal(got, ref)
+    assert net.training  # mode restored
+    assert all(layer.training for layer in net.sublayers(include_self=True))
+
+
+def test_quantize_invalidates_generate_cache():
+    """ADVICE r4 (medium): structural mutation after a compiled generate()
+    must miss the executable cache (not silently mis-pair swapped values)."""
+    from paddle_tpu.quantization import weight_only_quantize
+    cfg, net = _net()
+    rng = np.random.default_rng(6)
+    ids = rng.integers(0, cfg.vocab_size, (1, 5))
+    _ = net.generate(paddle.to_tensor(ids), max_new_tokens=2,
+                     compute_dtype="float32")
+    assert net._generate_exe_cache
+    weight_only_quantize(net, skip=lambda q, l: "lm_head" in q)
+    assert not net._generate_exe_cache  # invalidated
+    out = np.asarray(net.generate(paddle.to_tensor(ids), max_new_tokens=2,
+                                  compute_dtype="float32")._value)
+    assert out.shape == (1, 2)
+
+
+def test_swap_call_structure_mismatch_raises():
+    from paddle_tpu.models.generation import swap_call
+    with pytest.raises(RuntimeError, match="structure mismatch"):
+        swap_call([], [], [1], [], "float32", lambda: None)
