@@ -17,8 +17,11 @@ cache tensors.  TPU formulation:
 - ``save()`` exports the prefill and decode-block programs as portable
   StableHLO (jax.export, same mechanism as ``paddle.jit.save``) plus a
   weights pickle; ``LLMPredictor.load()`` rebuilds the session without
-  the model's Python class.  Serving artifacts decode greedily —
-  deterministic tokens for a given prompt.
+  the model's Python class.  Artifacts carry the FULL decode
+  configuration: greedy, sampled (temperature/top-k with the PRNG key
+  threaded through the block programs), or beam search (the block ships
+  per-step token/parent/score planes; the host backtraces the beam tree
+  with ``gather_tree`` once at the end).
 """
 
 from __future__ import annotations
@@ -31,8 +34,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.generation import (GenerationConfig, decode_scan_body,
-                                 init_kv_cache, model_arrays, swap_call)
+from ..models.generation import (GenerationConfig, beam_scan_body,
+                                 decode_scan_body, init_kv_cache,
+                                 model_arrays, sample_token, swap_call,
+                                 _gather_tree_arrays)
 
 
 def _flatten_kvs(kvs):
@@ -50,10 +55,25 @@ def _unflatten_kvs(flat):
 def _build_serving_fns(model, batch, max_cache_len,
                        cfg: GenerationConfig, steps_per_call):
     """Pure (params, ...) -> (...) functions for prefill and one decode
-    block; the exported/jitted serving programs."""
+    block; the exported/jitted serving programs.
+
+    Three serving modes, all artifact-exportable (the reference's
+    AnalysisPredictor serves the full decode configuration from the
+    artifact alone — ``paddle/fluid/inference/api/analysis_predictor.h:94``):
+
+    - greedy / sampled (``cfg.do_sample``): the prefill emits the first
+      token and a threaded PRNG key; each block scans ``steps_per_call``
+      decode steps, splitting the key per step.
+    - beam (``cfg.num_beams > 1``): the prefill top-k-expands to
+      ``[B*K]`` cache rows; each block scans the beam body and emits
+      per-step (token, parent) pairs — the HOST accumulates them and
+      backtraces once at the end (beam results are only final after the
+      last step, so the block protocol ships the tree, not sequences).
+    """
     params, buffers = model_arrays(model)
     n_layers, hkv, d = model.kv_cache_spec()
     cache_dtype = jnp.dtype(cfg.cache_dtype or cfg.compute_dtype)
+    k = cfg.num_beams
 
     def _with_params(pb_values, fn):
         p_values = pb_values[:len(params)]
@@ -61,27 +81,68 @@ def _build_serving_fns(model, batch, max_cache_len,
         return swap_call(params, buffers, p_values, b_values,
                          cfg.compute_dtype, fn)
 
-    def prefill_pure(p_values, ids, lens):
+    if k > 1:
+        def prefill_pure(p_values, ids, lens):
+            def run():
+                kvs = init_kv_cache(n_layers, batch, max_cache_len, hkv,
+                                    d, cache_dtype)
+                logits, kvs = model.prefill(ids, lens, kvs)   # [B, V]
+                lp0 = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+                top_lp, tok0 = jax.lax.top_k(lp0, k)          # [B, K]
+                tok0 = tok0.astype(jnp.int32)
+                done0 = (jnp.zeros((batch, k), bool)
+                         if cfg.eos_token_id is None
+                         else tok0 == cfg.eos_token_id)
+                kvs = [(jnp.repeat(kc, k, axis=0),
+                        jnp.repeat(vc, k, axis=0)) for kc, vc in kvs]
+                lens_bk = jnp.repeat(lens, k, axis=0)
+                blen0 = jnp.ones((batch, k), jnp.int32)
+                return ((tok0, lens_bk, done0, top_lp, blen0)
+                        + tuple(_flatten_kvs(kvs)))
+            return _with_params(p_values, run)
+
+        def block_pure(p_values, tok, lens, done, lp, blen, *flat_kvs):
+            def run():
+                kvs = _unflatten_kvs(list(flat_kvs))
+                carry = (tok.reshape(-1), lens, kvs, lp, blen, done)
+                (tok_f, lens_f, kvs_f, lp_f, blen_f, done_f), \
+                    (toks, parents, lps, blens) = jax.lax.scan(
+                        beam_scan_body(model, cfg, batch, k), carry,
+                        None, length=steps_per_call)
+                # toks/parents/lps/blens: [steps, B, K] — per-step scores
+                # let the host truncate the tree mid-block and still pick
+                # the best beam at exactly max_new_tokens
+                return ((toks, parents, lps, blens,
+                         tok_f.reshape(batch, k), lens_f, done_f, lp_f,
+                         blen_f) + tuple(_flatten_kvs(kvs_f)))
+            return _with_params(p_values, run)
+
+        return prefill_pure, block_pure
+
+    def prefill_pure(p_values, ids, lens, key):
         def run():
             kvs = init_kv_cache(n_layers, batch, max_cache_len, hkv, d,
                                 cache_dtype)
             logits, kvs = model.prefill(ids, lens, kvs)
-            tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if cfg.do_sample:
+                key0, keyr = jax.random.split(key)
+            else:
+                key0 = keyr = key
+            tok0 = sample_token(logits, key0, cfg)
             done0 = (jnp.zeros((batch,), bool)
                      if cfg.eos_token_id is None
                      else tok0 == cfg.eos_token_id)
-            return (tok0, lens, done0) + tuple(_flatten_kvs(kvs))
+            return (tok0, lens, done0, keyr) + tuple(_flatten_kvs(kvs))
         return _with_params(p_values, run)
 
-    def block_pure(p_values, tok, lens, done, *flat_kvs):
+    def block_pure(p_values, tok, lens, done, key, *flat_kvs):
         def run():
             kvs = _unflatten_kvs(list(flat_kvs))
-            key = jax.random.PRNGKey(0)  # unused: serving cfg is greedy
-            (tok_f, lens_f, kvs, _, done_f), toks = jax.lax.scan(
+            (tok_f, lens_f, kvs_f, key_f, done_f), toks = jax.lax.scan(
                 decode_scan_body(model, cfg), (tok, lens, kvs, key, done),
                 None, length=steps_per_call)
-            return ((toks.T.astype(jnp.int32), tok_f, lens_f, done_f)
-                    + tuple(_flatten_kvs(kvs)))
+            return ((toks.T.astype(jnp.int32), tok_f, lens_f, done_f,
+                     key_f) + tuple(_flatten_kvs(kvs_f)))
         return _with_params(p_values, run)
 
     return prefill_pure, block_pure
@@ -99,6 +160,8 @@ class LLMPredictor:
     def __init__(self, model=None, *, batch, prompt_len,
                  max_cache_len=None, steps_per_call=16,
                  eos_token_id=None, pad_token_id=0,
+                 do_sample=False, temperature=1.0, top_k=0,
+                 num_beams=1, length_penalty=0.0,
                  compute_dtype="bfloat16", cache_dtype=None,
                  _loaded=None):
         self.batch = int(batch)
@@ -111,7 +174,13 @@ class LLMPredictor:
                 f"prompt_len + 1 ({self.prompt_len + 1}) — the cache "
                 "holds the prompt plus at least the first generated "
                 "token's K/V")
+        if num_beams > 1 and do_sample:
+            raise ValueError("num_beams > 1 with do_sample=True is not "
+                             "supported (beam search scores greedily)")
         self.cfg = GenerationConfig(
+            do_sample=bool(do_sample), temperature=float(temperature),
+            top_k=int(top_k), num_beams=int(num_beams),
+            length_penalty=float(length_penalty),
             eos_token_id=eos_token_id, pad_token_id=int(pad_token_id),
             compute_dtype=str(compute_dtype),
             cache_dtype=None if cache_dtype is None else str(cache_dtype))
@@ -139,8 +208,7 @@ class LLMPredictor:
             [bf._value for bf in buffers]
 
     # -- session --
-    def start(self, input_ids, seq_lens=None) -> np.ndarray:
-        """Prefill the prompt; returns the first generated token [B]."""
+    def _check_prompt(self, input_ids, seq_lens):
         ids = np.asarray(getattr(input_ids, "_value", input_ids))
         if ids.shape != (self.batch, self.prompt_len):
             raise ValueError(
@@ -156,17 +224,66 @@ class LLMPredictor:
             raise ValueError(
                 f"seq_lens must be [{self.batch}] ints in "
                 f"[1, {self.prompt_len}], got {lens.tolist()}")
-        out = self._prefill(self._param_values,
-                            jnp.asarray(ids, jnp.int32),
-                            jnp.asarray(lens, jnp.int32))
-        tok0, lens_d, done = out[0], out[1], out[2]
-        self._state = (tok0, lens_d, done, list(out[3:]))
+        return ids, lens
+
+    def start(self, input_ids, seq_lens=None, seed: int = 0) -> np.ndarray:
+        """Prefill the prompt; returns the first generated token [B]
+        (greedy/sampled) or the initial beams [B, K] (beam mode)."""
+        ids, lens = self._check_prompt(input_ids, seq_lens)
+        if self.cfg.num_beams > 1:
+            out = self._prefill(self._param_values,
+                                jnp.asarray(ids, jnp.int32),
+                                jnp.asarray(lens, jnp.int32))
+            tok0, lens_bk, done, lp, blen = out[:5]
+            self._state = (tok0, lens_bk, done, lp, blen, list(out[5:]))
+            # host-side beam tree: ids/parents/scores [T, B, K]
+            k = self.cfg.num_beams
+            self._tree_ids = [np.asarray(tok0)[None]]
+            self._tree_parents = [np.tile(
+                np.arange(k, dtype=np.int32)[None, None],
+                (1, self.batch, 1))]
+            self._tree_lp = [np.asarray(lp)[None]]
+            self._tree_blen = [np.asarray(blen)[None]]
+        else:
+            key = jnp.asarray(
+                np.asarray(jax.random.PRNGKey(seed), np.uint32))
+            out = self._prefill(self._param_values,
+                                jnp.asarray(ids, jnp.int32),
+                                jnp.asarray(lens, jnp.int32), key)
+            tok0, lens_d, done, key = out[0], out[1], out[2], out[3]
+            self._state = (tok0, lens_d, done, key, list(out[4:]))
         self._written = int(lens.max()) + 1
         self._pending = None
-        return np.asarray(tok0)
+        return np.asarray(out[0])
+
+    def _run_block(self):
+        if self.cfg.num_beams > 1:
+            tok, lens, done, lp, blen, flat = self._state
+            out = self._block(self._param_values, tok, lens, done, lp,
+                              blen, *flat)
+            toks, parents = np.asarray(out[0]), np.asarray(out[1])
+            self._tree_lp.append(np.asarray(out[2]))
+            self._tree_blen.append(np.asarray(out[3]))
+            self._state = (out[4], out[5], out[6], out[7], out[8],
+                           list(out[9:]))
+            self._tree_ids.append(toks)
+            self._tree_parents.append(parents)
+            return None  # beam tokens are final only after backtrace
+        tok, lens, done, key, flat = self._state
+        out = self._block(self._param_values, tok, lens, done, key, *flat)
+        toks = np.asarray(out[0])
+        self._state = (out[1], out[2], out[3], out[4], list(out[5:]))
+        return toks
 
     def decode(self, n: int) -> np.ndarray:
-        """Decode ``n`` more tokens; returns [B, n] int32."""
+        """Decode ``n`` more tokens; returns [B, n] int32.  Beam mode
+        has no incremental token stream (beams reorder retroactively):
+        use ``generate()``."""
+        if self.cfg.num_beams > 1:
+            raise RuntimeError(
+                "decode() is not available with num_beams > 1 — beam "
+                "tokens are only final after the last step's backtrace; "
+                "use generate(), which returns the best sequences")
         if self._state is None:
             raise RuntimeError("call start() before decode()")
         if n <= 0:
@@ -179,35 +296,67 @@ class LLMPredictor:
                 f"decoding {n} more tokens exceeds max_cache_len "
                 f"({self.max_cache_len}); session has written "
                 f"{self._written}")
-        tok, lens, done, flat = self._state
         chunks: List[np.ndarray] = ([] if self._pending is None
                                     else [self._pending])
         for _ in range(need_blocks):
-            out = self._block(self._param_values, tok, lens, done, *flat)
-            toks, tok, lens, done = out[0], out[1], out[2], out[3]
-            flat = list(out[4:])
-            chunks.append(np.asarray(toks))
+            chunks.append(self._run_block())
             self._written += self.steps_per_call
-        self._state = (tok, lens, done, flat)
         all_toks = np.concatenate(chunks, axis=1)
         self._pending = all_toks[:, n:] if all_toks.shape[1] > n else None
         return all_toks[:, :n]
 
     def generate(self, input_ids, seq_lens=None,
-                 max_new_tokens: int = 32) -> np.ndarray:
+                 max_new_tokens: int = 32, seed: int = 0) -> np.ndarray:
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
-        first = self.start(input_ids, seq_lens)
+        first = self.start(input_ids, seq_lens, seed=seed)
+        if self.cfg.num_beams > 1:
+            n_blocks = -(-(max_new_tokens - 1) // self.steps_per_call)
+            if self._written + n_blocks * self.steps_per_call \
+                    > self.max_cache_len + 1:
+                raise ValueError(
+                    f"decoding {max_new_tokens} tokens exceeds "
+                    f"max_cache_len ({self.max_cache_len})")
+            for _ in range(n_blocks):
+                self._run_block()
+                self._written += self.steps_per_call
+            return self._finalize_beams(max_new_tokens)
         if max_new_tokens == 1:
             return first[:, None]
         rest = self.decode(max_new_tokens - 1)
         return np.concatenate([first[:, None], rest], axis=1)
 
+    def _finalize_beams(self, max_new_tokens: int) -> np.ndarray:
+        """Backtrace the accumulated (token, parent) tree and return the
+        best beam per batch row under the length penalty."""
+        ids = jnp.asarray(
+            np.concatenate(self._tree_ids, axis=0)[:max_new_tokens])
+        parents = jnp.asarray(
+            np.concatenate(self._tree_parents, axis=0)[:max_new_tokens])
+        seqs = np.asarray(_gather_tree_arrays(ids, parents))  # [T, B, K]
+        # scores AT step T (not at the block boundary the scan ran to)
+        lp = np.concatenate(self._tree_lp, axis=0)[max_new_tokens - 1]
+        blen = np.concatenate(self._tree_blen,
+                              axis=0)[max_new_tokens - 1].astype(
+                                  np.float32)
+        if self.cfg.length_penalty:
+            scores = lp / (blen ** self.cfg.length_penalty)
+        else:
+            scores = lp
+        best = scores.argmax(-1)                              # [B]
+        return np.swapaxes(seqs, 0, 1)[
+            np.arange(self.batch), :, best].astype(np.int32)
+
     # -- artifact --
     def save(self, path: str):
         """Export prefill + decode-block as portable StableHLO plus a
-        weights pickle (one ``.ptpu_llm`` file)."""
+        weights pickle (one ``.ptpu_llm`` file).  The FULL decode
+        configuration — greedy, sampled (temperature/top-k, PRNG key
+        threaded through the artifact), or beam (num_beams, length
+        penalty) — is baked into the exported programs, so a loaded
+        artifact serves it without the model class (the reference's
+        AnalysisPredictor deployment contract)."""
         if self._model is None:
             raise RuntimeError("save() needs the in-process model")
         from jax import export as jax_export
@@ -217,14 +366,17 @@ class LLMPredictor:
         p_shapes = [jax.ShapeDtypeStruct(v.shape, v.dtype)
                     for v in self._param_values]
         b = self.batch
+        k = self.cfg.num_beams
         ids_s = jax.ShapeDtypeStruct((b, self.prompt_len), jnp.int32)
         i32 = jax.ShapeDtypeStruct((b,), jnp.int32)
         booln = jax.ShapeDtypeStruct((b,), jnp.bool_)
+        key_s = jax.ShapeDtypeStruct((2,), jnp.uint32)
         n_layers, hkv, d = self._model.kv_cache_spec()
         cache_dtype = jnp.dtype(self.cfg.cache_dtype
                                 or self.cfg.compute_dtype)
+        cache_rows = b * k
         kv_s = [jax.ShapeDtypeStruct(
-            (b, self.max_cache_len, hkv, d), cache_dtype)
+            (cache_rows, self.max_cache_len, hkv, d), cache_dtype)
             for _ in range(2 * n_layers)]
 
         def _export(fn, *shapes):
@@ -237,11 +389,22 @@ class LLMPredictor:
                 # (single-platform artifact); real export errors propagate
                 return jax_export.export(jitted)(*shapes).serialize()
 
-        pre_blob = _export(prefill, p_shapes, ids_s, i32)
-        blk_blob = _export(block, p_shapes, i32, i32, booln, *kv_s)
+        if k > 1:
+            bk_i32 = jax.ShapeDtypeStruct((b, k), jnp.int32)
+            bk_f32 = jax.ShapeDtypeStruct((b, k), jnp.float32)
+            bk_bool = jax.ShapeDtypeStruct((b, k), jnp.bool_)
+            rows_i32 = jax.ShapeDtypeStruct((cache_rows,), jnp.int32)
+            pre_blob = _export(prefill, p_shapes, ids_s, i32)
+            blk_blob = _export(block, p_shapes, bk_i32, rows_i32,
+                               bk_bool, bk_f32, bk_i32, *kv_s)
+        else:
+            pre_blob = _export(prefill, p_shapes, ids_s, i32, key_s)
+            blk_blob = _export(block, p_shapes, i32, i32, booln, key_s,
+                               *kv_s)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path + ".ptpu_llm", "wb") as f:
             pickle.dump({
+                "version": 2,  # v2: PRNG key threaded / beam planes
                 "prefill": pre_blob, "block": blk_blob,
                 "values": [np.asarray(v) for v in self._param_values],
                 "meta": {
@@ -250,6 +413,11 @@ class LLMPredictor:
                     "steps_per_call": self.steps_per_call,
                     "eos_token_id": self.cfg.eos_token_id,
                     "pad_token_id": self.cfg.pad_token_id,
+                    "do_sample": self.cfg.do_sample,
+                    "temperature": self.cfg.temperature,
+                    "top_k": self.cfg.top_k,
+                    "num_beams": self.cfg.num_beams,
+                    "length_penalty": self.cfg.length_penalty,
                     "compute_dtype": self.cfg.compute_dtype,
                     "cache_dtype": self.cfg.cache_dtype,
                 }}, f)
@@ -261,6 +429,13 @@ class LLMPredictor:
         from jax import export as jax_export
         with open(path + ".ptpu_llm", "rb") as f:
             blob = pickle.load(f)
+        if blob.get("version", 1) < 2:
+            raise ValueError(
+                "this .ptpu_llm artifact was saved by an older "
+                "LLMPredictor whose serving programs lack the threaded "
+                "PRNG key / beam planes — re-export it with save() "
+                "(the block call protocol changed; a silent load would "
+                "mis-slice the block outputs)")
         meta = blob["meta"]
         pre = jax_export.deserialize(blob["prefill"])
         blk = jax_export.deserialize(blob["block"])
@@ -271,8 +446,13 @@ class LLMPredictor:
             steps_per_call=meta["steps_per_call"],
             eos_token_id=meta["eos_token_id"],
             pad_token_id=meta["pad_token_id"],
+            do_sample=meta.get("do_sample", False),
+            temperature=meta.get("temperature", 1.0),
+            top_k=meta.get("top_k", 0),
+            num_beams=meta.get("num_beams", 1),
+            length_penalty=meta.get("length_penalty", 0.0),
             compute_dtype=meta["compute_dtype"],
             cache_dtype=meta["cache_dtype"],
-            _loaded=(lambda pv, ids, lens: pre.call(pv, ids, lens),
+            _loaded=(lambda pv, *a: pre.call(pv, *a),
                      lambda pv, *a: blk.call(pv, *a),
                      values))

@@ -62,6 +62,8 @@ class GenerationConfig:
     do_sample: bool = False
     temperature: float = 1.0
     top_k: int = 0                   # 0 = full softmax
+    num_beams: int = 1               # >1 = beam search (greedy scoring)
+    length_penalty: float = 0.0      # beam score /= len**alpha at selection
     eos_token_id: Optional[int] = None
     pad_token_id: int = 0
     compute_dtype: str = "bfloat16"  # serving precision; params cast once
@@ -191,6 +193,66 @@ def decode_scan_body(model, cfg: GenerationConfig):
     return body
 
 
+def beam_scan_body(model, cfg: GenerationConfig, b, k):
+    """Per-token beam-search scan body over a [B*K]-batched KV cache.
+
+    The beam-reorder step — the part greedy decode never exercises — is
+    a batched GATHER on every cache buffer (``cache[parent_rows]``),
+    exactly the role of the reference's cell-state gather in
+    ``python/paddle/nn/decode.py:544`` and the cache reordering of its
+    beam serving path.  All shapes static; one fused top-k over
+    ``K * vocab`` candidates per step.
+
+    carry = (tok [B*K], lens [B*K], kvs, log_probs [B,K],
+    beam_len [B,K], done [B,K]); emits (token [B,K], parent [B,K],
+    log_probs [B,K], beam_len [B,K]) per step — the per-step scores let
+    a block-serving host truncate the tree mid-block and still score
+    consistently (LLMPredictor); unused emits are DCE'd by XLA in the
+    single-scan generate path.
+    """
+    neg_inf = jnp.float32(-1e9)
+
+    def body(carry, _):
+        tok, lens_c, kvs_c, lp, blen, done = carry
+        logits_t, kvs_c = model.decode_step(tok, lens_c, kvs_c)  # [B*K,V]
+        vocab = logits_t.shape[-1]
+        step_lp = jax.nn.log_softmax(
+            logits_t.astype(jnp.float32), axis=-1).reshape(b, k, vocab)
+        if cfg.eos_token_id is not None:
+            # finished beams contribute exactly one candidate: EOS at
+            # zero added cost (score frozen)
+            only_eos = jnp.full((vocab,), neg_inf
+                                ).at[cfg.eos_token_id].set(0.0)
+            step_lp = jnp.where(done[:, :, None], only_eos[None, None, :],
+                                step_lp)
+        flat = (lp[:, :, None] + step_lp).reshape(b, k * vocab)
+        top_lp, top_idx = jax.lax.top_k(flat, k)                 # [B,K]
+        parent = top_idx // vocab
+        tok_idx = (top_idx % vocab).astype(jnp.int32)
+        rows = (jnp.arange(b)[:, None] * k + parent).reshape(-1)  # [B*K]
+        kvs_c = [(kc[rows], vc[rows]) for kc, vc in kvs_c]
+        lens_g = lens_c[rows]
+        barange = jnp.arange(b)[:, None]
+        done_g = done[barange, parent]
+        blen_g = blen[barange, parent]
+        if cfg.eos_token_id is not None:
+            emit = jnp.where(done_g, cfg.pad_token_id, tok_idx)
+            done_n = done_g | (tok_idx == cfg.eos_token_id)
+        else:
+            emit = tok_idx
+            done_n = done_g
+        lens_n = jnp.where(done_g.reshape(-1), lens_g, lens_g + 1)
+        blen_n = blen_g + (~done_g).astype(jnp.int32)
+        carry_n = (emit.reshape(-1), lens_n, kvs_c, top_lp, blen_n,
+                   done_n)
+        return carry_n, (emit, parent.astype(jnp.int32), top_lp, blen_n)
+    return body
+
+
+# single backtrace implementation, shared with nn.functional.gather_tree
+from ..nn.functional.decoding import _gather_tree_arrays  # noqa: E402
+
+
 class GenerationMixin:
     """Adds ``generate`` to a causal LM that implements
 
@@ -233,28 +295,75 @@ class GenerationMixin:
         cache_dtype = jnp.dtype(cfg.cache_dtype or cfg.compute_dtype)
         model = self
 
+        def run_greedy_or_sampled(ids, lens, key):
+            kvs = init_kv_cache(n_layers, b, max_cache_len, hkv, d,
+                                cache_dtype)
+            logits, kvs = model.prefill(ids, lens, kvs)
+            key0, keyr = (jax.random.split(key)
+                          if cfg.do_sample else (key, key))
+            tok0 = sample_token(logits, key0, cfg)
+            done0 = (jnp.zeros((b,), bool) if cfg.eos_token_id is None
+                     else tok0 == cfg.eos_token_id)
+
+            if cfg.max_new_tokens > 1:
+                (_, lens_f, _, _, _), rest = jax.lax.scan(
+                    decode_scan_body(model, cfg),
+                    (tok0, lens, kvs, keyr, done0), None,
+                    length=cfg.max_new_tokens - 1)
+                toks = jnp.concatenate(
+                    [tok0[:, None], rest.T.astype(jnp.int32)], axis=1)
+            else:
+                toks = tok0[:, None]
+                lens_f = lens
+            return toks, lens_f + 1  # prompt + emitted
+
+        def run_beam(ids, lens):
+            """Prefill once at batch B, expand the caches to B*K rows,
+            then scan the beam body; backtrace with gather_tree and pick
+            the best beam per batch under the length penalty."""
+            k = cfg.num_beams
+            kvs = init_kv_cache(n_layers, b, max_cache_len, hkv, d,
+                                cache_dtype)
+            logits, kvs = model.prefill(ids, lens, kvs)        # [B, V]
+            lp0 = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            top_lp, tok0 = jax.lax.top_k(lp0, k)               # [B, K]
+            tok0 = tok0.astype(jnp.int32)
+            done0 = (jnp.zeros((b, k), bool)
+                     if cfg.eos_token_id is None
+                     else tok0 == cfg.eos_token_id)
+            kvs = [(jnp.repeat(kc, k, axis=0), jnp.repeat(vc, k, axis=0))
+                   for kc, vc in kvs]
+            lens_bk = jnp.repeat(lens, k, axis=0)              # [B*K]
+            blen0 = jnp.ones((b, k), jnp.int32)
+            if cfg.max_new_tokens > 1:
+                carry = (tok0.reshape(-1), lens_bk, kvs, top_lp, blen0,
+                         done0)
+                (_, _, _, lp_f, blen_f, _), (toks, parents, _, _) = \
+                    jax.lax.scan(beam_scan_body(model, cfg, b, k), carry,
+                                 None, length=cfg.max_new_tokens - 1)
+                ids_seq = jnp.concatenate([tok0[None], toks], axis=0)
+                par_seq = jnp.concatenate(
+                    [jnp.tile(jnp.arange(k, dtype=jnp.int32)[None, None],
+                              (1, b, 1)), parents], axis=0)
+                seqs = _gather_tree_arrays(ids_seq, par_seq)  # [T, B, K]
+            else:
+                seqs = tok0[None]
+                lp_f, blen_f = top_lp, blen0
+            if cfg.length_penalty:
+                score = lp_f / (blen_f.astype(jnp.float32)
+                                ** jnp.float32(cfg.length_penalty))
+            else:
+                score = lp_f
+            best = jnp.argmax(score, axis=-1)                  # [B]
+            out = jnp.swapaxes(seqs, 0, 1)                     # [B, T, K]
+            toks_best = out[jnp.arange(b), :, best].astype(jnp.int32)
+            return toks_best, lens + blen_f[jnp.arange(b), best]
+
         def pure(p_values, b_values, ids, lens, key):
             def run():
-                kvs = init_kv_cache(n_layers, b, max_cache_len, hkv, d,
-                                    cache_dtype)
-                logits, kvs = model.prefill(ids, lens, kvs)
-                key0, keyr = (jax.random.split(key)
-                              if cfg.do_sample else (key, key))
-                tok0 = sample_token(logits, key0, cfg)
-                done0 = (jnp.zeros((b,), bool) if cfg.eos_token_id is None
-                         else tok0 == cfg.eos_token_id)
-
-                if cfg.max_new_tokens > 1:
-                    (_, lens_f, _, _, _), rest = jax.lax.scan(
-                        decode_scan_body(model, cfg),
-                        (tok0, lens, kvs, keyr, done0), None,
-                        length=cfg.max_new_tokens - 1)
-                    toks = jnp.concatenate(
-                        [tok0[:, None], rest.T.astype(jnp.int32)], axis=1)
-                else:
-                    toks = tok0[:, None]
-                    lens_f = lens
-                return toks, lens_f + 1  # prompt + emitted
+                if cfg.num_beams > 1:
+                    return run_beam(ids, lens)
+                return run_greedy_or_sampled(ids, lens, key)
             return swap_call(params, buffers, p_values, b_values,
                              cfg.compute_dtype, run)
 
@@ -263,9 +372,10 @@ class GenerationMixin:
         return compiled
 
     def generate(self, input_ids, seq_lens=None, max_new_tokens=32,
-                 do_sample=False, temperature=1.0, top_k=0,
-                 eos_token_id=None, pad_token_id=0, max_cache_len=None,
-                 compute_dtype="bfloat16", cache_dtype=None, seed=0):
+                 do_sample=False, temperature=1.0, top_k=0, num_beams=1,
+                 length_penalty=0.0, eos_token_id=None, pad_token_id=0,
+                 max_cache_len=None, compute_dtype="bfloat16",
+                 cache_dtype=None, seed=0):
         """Generate ``max_new_tokens`` tokens after the (right-padded)
         prompt ``input_ids [B, S]``; ``seq_lens [B]`` are true prompt
         lengths (default: full S).  Returns a Tensor [B, max_new_tokens]
@@ -278,6 +388,12 @@ class GenerationMixin:
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if num_beams < 1:
+            raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+        if num_beams > 1 and do_sample:
+            raise ValueError(
+                "num_beams > 1 is greedy beam search; do_sample=True is "
+                "not supported together with beams")
         ids = _unwrap(input_ids).astype(jnp.int32)
         b, s = ids.shape
         if seq_lens is None:
@@ -301,6 +417,8 @@ class GenerationMixin:
         cfg = GenerationConfig(
             max_new_tokens=int(max_new_tokens), do_sample=bool(do_sample),
             temperature=float(temperature), top_k=int(top_k),
+            num_beams=int(num_beams),
+            length_penalty=float(length_penalty),
             eos_token_id=eos_token_id, pad_token_id=int(pad_token_id),
             compute_dtype=str(compute_dtype),
             cache_dtype=None if cache_dtype is None else str(cache_dtype))

@@ -16,3 +16,4 @@ from .layer.norm import *  # noqa: F401,F403
 from .layer.pooling import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
+from .decode import Decoder, BeamSearchDecoder, dynamic_decode  # noqa: F401

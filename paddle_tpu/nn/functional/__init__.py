@@ -14,4 +14,5 @@ from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .input import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
+from .decoding import *  # noqa: F401,F403
 from .vision import *  # noqa: F401,F403

@@ -1,0 +1,51 @@
+"""Decoding functionals: ``gather_tree``.
+
+Reference parity: ``paddle.nn.functional.gather_tree`` (CUDA kernel
+``paddle/phi/kernels/gpu/gather_tree_kernel.cu`` — per-(batch, beam)
+thread chasing parent pointers backward through time).  TPU formulation:
+a REVERSE ``lax.scan`` over the time axis carrying the current parent
+index per (batch, beam); each step is one batched gather — vectorized,
+static-shape, jit-friendly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+
+__all__ = ["gather_tree"]
+
+
+def _gather_tree_arrays(idv, parv):
+    """The reverse-scan backtrace on raw [T, B, K] arrays — the single
+    implementation behind ``gather_tree`` and the compiled beam paths
+    (models/generation.py, inference/llm.py)."""
+    t, b, k = idv.shape
+    binds = jnp.arange(b)[:, None]
+
+    def body(parent, xs):
+        id_t, par_t = xs                       # [B, K] each
+        tok = id_t[binds, parent]
+        return par_t[binds, parent], tok
+
+    init = jnp.tile(jnp.arange(k, dtype=parv.dtype)[None], (b, 1))
+    _, toks = jax.lax.scan(body, init, (idv, parv), reverse=True)
+    return toks
+
+
+def gather_tree(ids, parents):
+    """Backtrace beam-search output ``ids [T, B, K]`` along
+    ``parents [T, B, K]`` into beam-consistent full sequences
+    ``[T, B, K]``: the k-th output sequence is the actual token path
+    ending at beam k of the last step."""
+
+    def impl(idv, parv):
+        if idv.ndim != 3 or idv.shape != parv.shape:
+            raise ValueError(
+                f"gather_tree expects ids and parents of equal shape "
+                f"[T, B, K], got {idv.shape} vs {parv.shape}")
+        return _gather_tree_arrays(idv, parv)
+
+    return dispatch("gather_tree", impl, (ids, parents))

@@ -110,6 +110,8 @@ CUSTOM_INPUTS = {
     "bitwise_right_shift": lambda: ((_i((3, 4), 63, dtype=np.int32),
                                      _i((3, 4), 3, 8, dtype=np.int32)), {}),
     "bincount": lambda: ((_i((10,), 5),), {}),
+    "gather_tree": lambda: ((_i((4, 2, 3), 9, dtype=np.int64),
+                             _i((4, 2, 3), 2, dtype=np.int64)), {}),
     "gcd": lambda: ((_i((4,), 12, dtype=np.int32),
                      _i((4,), 12, 8, dtype=np.int32)), {}),
     "lcm": lambda: ((_i((4,), 6, dtype=np.int32),

@@ -1,0 +1,260 @@
+"""Beam-search decoding: GenerationMixin.generate(num_beams=k) and
+nn.decode.BeamSearchDecoder/dynamic_decode vs a numpy reference beam
+search (the role of the reference's seq2seq decode tests over
+``python/paddle/nn/decode.py``)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models, nn
+
+
+# ---------------------------------------------------------------------------
+# numpy reference beam search over an arbitrary step function
+# ---------------------------------------------------------------------------
+
+def _log_softmax(x):
+    x = x - x.max(-1, keepdims=True)
+    return x - np.log(np.exp(x).sum(-1, keepdims=True))
+
+
+def _np_beam_search(first_logits, next_logits_fn, n_steps, k, eos=None,
+                    pad=0, alpha=0.0):
+    """Beam search for ONE sequence.  first_logits: [V]; next_logits_fn
+    (token_list) -> [V] logits after that continuation.  Mirrors the
+    mixin's semantics exactly: finished beams contribute one frozen-score
+    candidate and emit pad."""
+    v = first_logits.shape[-1]
+    lp0 = _log_softmax(first_logits[None])[0]
+    order = np.argsort(-lp0)[:k]
+    beams = [{"toks": [int(t)], "lp": float(lp0[t]),
+              "done": eos is not None and int(t) == eos, "blen": 1}
+             for t in order]
+    for _ in range(n_steps - 1):
+        flat = np.full((k, v), -np.inf)
+        for i, beam in enumerate(beams):
+            if beam["done"]:
+                flat[i, eos] = beam["lp"]
+            else:
+                lp = _log_softmax(
+                    next_logits_fn(beam["toks"])[None])[0]
+                flat[i] = beam["lp"] + lp
+        idx = np.argsort(-flat.reshape(-1))[:k]
+        new_beams = []
+        for j in idx:
+            parent, tok = int(j) // v, int(j) % v
+            src = beams[parent]
+            if src["done"]:
+                new_beams.append({"toks": src["toks"] + [pad],
+                                  "lp": float(flat.reshape(-1)[j]),
+                                  "done": True, "blen": src["blen"]})
+            else:
+                new_beams.append({
+                    "toks": src["toks"] + [tok],
+                    "lp": float(flat.reshape(-1)[j]),
+                    "done": eos is not None and tok == eos,
+                    "blen": src["blen"] + 1})
+        beams = new_beams
+    scores = [b["lp"] / (b["blen"] ** alpha) if alpha else b["lp"]
+              for b in beams]
+    return beams[int(np.argmax(scores))]["toks"]
+
+
+def _model_beam_ref(net, prompt, n, k, eos=None, pad=0, alpha=0.0):
+    def first():
+        logits = net(paddle.to_tensor(prompt[None]))
+        return np.asarray(logits._value, np.float32)[0, -1]
+
+    def nxt(toks):
+        seq = np.concatenate([prompt, np.asarray(toks, prompt.dtype)])
+        logits = net(paddle.to_tensor(seq[None]))
+        return np.asarray(logits._value, np.float32)[0, -1]
+
+    return _np_beam_search(first(), nxt, n, k, eos=eos, pad=pad,
+                           alpha=alpha)
+
+
+def _net(**kw):
+    cfg = models.tiny_llama_config(**kw)
+    net = models.LlamaForCausalLM(cfg)
+    net.eval()
+    return cfg, net
+
+
+# ---------------------------------------------------------------------------
+# GenerationMixin.generate(num_beams=k)
+# ---------------------------------------------------------------------------
+
+def test_beam_matches_numpy_reference():
+    cfg, net = _net()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 6))
+    got = np.asarray(net.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                                  num_beams=3,
+                                  compute_dtype="float32")._value)
+    assert got.shape == (2, 5)
+    for bi in range(2):
+        want = _model_beam_ref(net, ids[bi], 5, 3)
+        np.testing.assert_array_equal(got[bi], want,
+                                      err_msg=f"batch {bi}")
+
+
+def test_beam_with_eos_pads_and_reference():
+    cfg, net = _net()
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, cfg.vocab_size, (1, 5))
+    # find an eos that actually fires: take the greedy 3rd token
+    greedy = np.asarray(net.generate(paddle.to_tensor(ids),
+                                     max_new_tokens=6, num_beams=2,
+                                     compute_dtype="float32")._value)[0]
+    eos = int(greedy[2])
+    got = np.asarray(net.generate(
+        paddle.to_tensor(ids), max_new_tokens=6, num_beams=2,
+        eos_token_id=eos, pad_token_id=-7,
+        compute_dtype="float32")._value)[0]
+    want = _model_beam_ref(net, ids[0], 6, 2, eos=eos, pad=-7)
+    np.testing.assert_array_equal(got, want)
+    if eos in got.tolist():
+        after = got.tolist().index(eos) + 1
+        assert all(t == -7 for t in got.tolist()[after:])
+
+
+def test_beam_length_penalty_matches_reference():
+    cfg, net = _net()
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, cfg.vocab_size, (2, 4))
+    for alpha in (0.0, 1.0):
+        got = np.asarray(net.generate(
+            paddle.to_tensor(ids), max_new_tokens=4, num_beams=3,
+            length_penalty=alpha, compute_dtype="float32")._value)
+        for bi in range(2):
+            want = _model_beam_ref(net, ids[bi], 4, 3, alpha=alpha)
+            np.testing.assert_array_equal(
+                got[bi], want, err_msg=f"alpha={alpha} batch {bi}")
+
+
+def test_beam_one_equals_greedy():
+    cfg, net = _net()
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, cfg.vocab_size, (2, 5))
+    greedy = np.asarray(net.generate(paddle.to_tensor(ids),
+                                     max_new_tokens=4,
+                                     compute_dtype="float32")._value)
+    beam1 = np.asarray(net.generate(paddle.to_tensor(ids),
+                                    max_new_tokens=4, num_beams=1,
+                                    compute_dtype="float32")._value)
+    np.testing.assert_array_equal(greedy, beam1)
+
+
+def test_beam_rejects_sampling():
+    cfg, net = _net()
+    ids = np.zeros((1, 4), np.int64)
+    with pytest.raises(ValueError, match="do_sample"):
+        net.generate(paddle.to_tensor(ids), num_beams=2, do_sample=True)
+
+
+# ---------------------------------------------------------------------------
+# nn.functional.gather_tree
+# ---------------------------------------------------------------------------
+
+def test_gather_tree_manual_backtrace():
+    ids = np.array([[[2, 5]], [[6, 3]], [[1, 9]]], np.int64)  # [T=3,B=1,K=2]
+    parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int64)
+    got = np.asarray(nn.functional.gather_tree(
+        paddle.to_tensor(ids), paddle.to_tensor(parents))._value)
+    # backtrace beam 0 of last step: t2 tok 1 (parent 0) -> t1 tok 6
+    # (parent 1) -> t0 tok 5; beam 1: t2 tok 9 (parent 1) -> t1 tok 3
+    # (parent 0) -> t0 tok 2
+    want = np.array([[[5, 2]], [[6, 3]], [[1, 9]]], np.int64)
+    np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# nn.decode: BeamSearchDecoder + dynamic_decode over a cell
+# ---------------------------------------------------------------------------
+
+class _ToyCell(nn.Layer):
+    """Deterministic cell: h' = tanh(h + E[token]); logits = h' @ W."""
+
+    def __init__(self, vocab, hidden, seed=0):
+        super().__init__()
+        rng = np.random.default_rng(seed)
+        self.emb = paddle.to_tensor(
+            rng.normal(size=(vocab, hidden)).astype(np.float32))
+        self.w = paddle.to_tensor(
+            rng.normal(size=(hidden, vocab)).astype(np.float32))
+
+    def forward(self, inputs, states):
+        import jax.numpy as jnp
+        tok = inputs._value.astype(jnp.int32)
+        h = states._value
+        h2 = jnp.tanh(h + self.emb._value[tok])
+        logits = h2 @ self.w._value
+        return paddle.to_tensor(logits), paddle.to_tensor(h2)
+
+
+def _np_toy_beam(h0, emb, w, start, end, k, steps, pad=0):
+    """numpy beam search over the toy cell for one batch row."""
+    def roll(toks):
+        h = h0.copy()
+        for t in toks:
+            h = np.tanh(h + emb[t])
+        return h @ w
+
+    first = roll([start])
+    lp0 = _log_softmax(first[None])[0]
+
+    def nxt(toks):
+        return roll([start] + toks)
+
+    return _np_beam_search(first, nxt, steps, k, eos=end, pad=pad)
+
+
+def test_beam_search_decoder_dynamic_decode_parity():
+    vocab, hidden, k, B, steps = 11, 7, 3, 2, 5
+    cell = _ToyCell(vocab, hidden, seed=4)
+    rng = np.random.default_rng(5)
+    h0 = rng.normal(size=(B, hidden)).astype(np.float32)
+    end_token = vocab + 5  # never emitted: pure length-bounded decode
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=end_token,
+                               beam_size=k)
+    outs, _ = nn.dynamic_decode(dec, inits=paddle.to_tensor(h0),
+                                max_step_num=steps - 1)
+    got = np.asarray(outs._value)  # [B, T, K] batch-major
+    assert got.shape == (B, steps, k)
+    emb = np.asarray(cell.emb._value)
+    w = np.asarray(cell.w._value)
+    for bi in range(B):
+        want = _np_toy_beam(h0[bi], emb, w, start=1, end=end_token,
+                            k=k, steps=steps)
+        np.testing.assert_array_equal(
+            got[bi, :, 0], want, err_msg=f"batch {bi} best beam")
+
+
+def test_dynamic_decode_stops_on_end_token():
+    # beam_size=1: the single beam emits end_token at the first step, so
+    # the all-finished early exit must fire well before the step bound
+    vocab, hidden, k = 9, 5, 1
+    cell = _ToyCell(vocab, hidden, seed=6)
+    h0 = np.zeros((1, hidden), np.float32)
+    # choose end_token = the toy cell's first greedy emission so every
+    # beam finishes immediately
+    import jax.numpy as jnp
+    h1 = np.tanh(h0 + np.asarray(cell.emb._value)[1])
+    end_token = int(np.argmax(h1 @ np.asarray(cell.w._value)))
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=end_token,
+                               beam_size=k)
+    outs, _, lens = nn.dynamic_decode(dec, inits=paddle.to_tensor(h0),
+                                      max_step_num=50, return_length=True)
+    got = np.asarray(outs._value)
+    assert got.shape[1] < 50  # early exit, not the step bound
+    assert int(got[0, 0, 0]) == end_token
+
+
+def test_tile_beam_merge_with_batch():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    t = nn.BeamSearchDecoder.tile_beam_merge_with_batch(x, 2)
+    want = np.repeat(np.arange(6, dtype=np.float32).reshape(2, 3), 2,
+                     axis=0)
+    np.testing.assert_array_equal(np.asarray(t._value), want)

@@ -159,3 +159,62 @@ def test_weight_only_quantize_rejects_no_linear():
 
     with pytest.raises(ValueError, match="no .*Linear|converted no"):
         weight_only_quantize(NoLinear())
+
+
+def test_sampled_artifact_roundtrip(tmp_path):
+    """Sampled decode served FROM the artifact (round-4 gap): the key is
+    threaded through the exported programs, so a loaded artifact
+    reproduces the in-process sampled stream for the same seed."""
+    cfg, net = _net()
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, cfg.vocab_size, (2, 4))
+    pred = LLMPredictor(net, batch=2, prompt_len=4, max_cache_len=16,
+                        steps_per_call=4, do_sample=True, temperature=0.8,
+                        top_k=5, compute_dtype="float32")
+    want = pred.generate(ids, max_new_tokens=8, seed=11)
+    path = str(tmp_path / "llama_sampled")
+    pred.save(path)
+    loaded = LLMPredictor.load(path)
+    got = loaded.generate(ids, max_new_tokens=8, seed=11)
+    np.testing.assert_array_equal(got, want)
+    # a different seed must change the stream (it really is sampling)
+    other = loaded.generate(ids, max_new_tokens=8, seed=12)
+    assert not np.array_equal(got, other)
+    # token range sanity
+    assert (got >= 0).all() and (got < cfg.vocab_size).all()
+
+
+def test_beam_predictor_matches_mixin(tmp_path):
+    """Beam decode through the block-serving protocol (per-step
+    token/parent planes + host backtrace) must equal the single-scan
+    GenerationMixin beam path, including mid-block truncation, and
+    roundtrip through the saved artifact."""
+    cfg, net = _net()
+    rng = np.random.default_rng(4)
+    ids = rng.integers(0, cfg.vocab_size, (2, 5))
+    want = np.asarray(net.generate(
+        paddle.to_tensor(ids), max_new_tokens=6, num_beams=3,
+        max_cache_len=16, compute_dtype="float32")._value)
+    # steps_per_call=4 with max_new_tokens=6: the second block overshoots
+    # (host must truncate the tree and score at step 6 exactly)
+    pred = LLMPredictor(net, batch=2, prompt_len=5, max_cache_len=16,
+                        steps_per_call=4, num_beams=3,
+                        compute_dtype="float32")
+    got = pred.generate(ids, max_new_tokens=6)
+    np.testing.assert_array_equal(got, want)
+    path = str(tmp_path / "llama_beam")
+    pred.save(path)
+    loaded = LLMPredictor.load(path)
+    got2 = loaded.generate(ids, max_new_tokens=6)
+    np.testing.assert_array_equal(got2, want)
+
+
+def test_beam_predictor_decode_refused():
+    cfg, net = _net()
+    pred = LLMPredictor(net, batch=1, prompt_len=4, max_cache_len=16,
+                        num_beams=2, compute_dtype="float32")
+    with pytest.raises(RuntimeError, match="generate"):
+        pred.decode(3)
+    with pytest.raises(ValueError, match="do_sample"):
+        LLMPredictor(net, batch=1, prompt_len=4, max_cache_len=16,
+                     num_beams=2, do_sample=True)

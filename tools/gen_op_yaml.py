@@ -40,6 +40,7 @@ OP_MODULES = [
     "paddle_tpu.nn.functional.input",
     "paddle_tpu.nn.functional.vision",
     "paddle_tpu.nn.functional.attention",
+    "paddle_tpu.nn.functional.decoding",
 ]
 
 YAML_PATH = os.path.join(os.path.dirname(os.path.dirname(
