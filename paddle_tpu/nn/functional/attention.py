@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from ...core.dispatch import dispatch
 
 __all__ = ["scaled_dot_product_attention", "flash_attention",
-           "flash_attn_unpadded", "sdp_kernel"]
+           "flash_attn_unpadded", "sparse_attention", "sdp_kernel"]
 
 
 def _dropout_key():
@@ -169,3 +169,66 @@ class sdp_kernel:
 
     def __exit__(self, *a):
         return False
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Attention restricted to a CSR sparsity pattern (reference
+    ``python/paddle/nn/functional/sparse_attention.py`` over the
+    ``sparse_attention`` CUDA kernel, CUDA>=11.3 only there).
+
+    q/k/v: [B, H, S, D]; ``sparse_csr_offset`` [B, H, S+1] int32 row
+    offsets; ``sparse_csr_columns`` [B, H, nnz] int32 column indices.
+    ``key_padding_mask`` [B, S] / ``attn_mask`` [S, S]: 0 means masked
+    (the reference's convention).
+
+    TPU formulation: the CSR pattern is a LAYOUT descriptor, not a
+    compute schedule — the pattern is scattered into a dense boolean
+    mask once and the attention itself runs as dense masked QK^T /
+    softmax / AV on the MXU (block-sparse skipping only pays off when
+    whole 128-wide tiles drop; at that point use the Pallas flash kernel
+    with a block mask).  Results match the reference kernel at the
+    stored positions; softmax is over each row's stored columns only.
+    """
+
+    def impl(q, k, v, offset, cols, kp, am):
+        b, h, s, d = q.shape
+        nnz = cols.shape[-1]
+        idx = jnp.arange(nnz)
+        # row of each nnz slot = #(row starts <= slot): offset[..., 1:]
+        # is [B, H, S]; compare against slot ids -> [B, H, S, nnz]
+        rows = (idx[None, None, None, :]
+                >= offset[..., 1:, None]).sum(axis=-2)       # [B, H, nnz]
+        valid = idx[None, None, :] < offset[..., -1:]        # [B, H, nnz]
+        bidx = jnp.arange(b)[:, None, None]
+        hidx = jnp.arange(h)[None, :, None]
+        mask = jnp.zeros((b, h, s, s), bool)
+        mask = mask.at[bidx, hidx, rows,
+                       jnp.clip(cols, 0, s - 1)].max(valid)
+        scores = jnp.einsum("bhsd,bhtd->bhst", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(d))
+        if kp is not None:  # [B, S], 0 = masked key position
+            mask = mask & (kp[:, None, None, :] != 0)
+        if am is not None:  # [S, S], 0 = masked pair
+            mask = mask & (am[None, None, :, :] != 0)
+        neg = jnp.float32(-1e30)
+        scores = jnp.where(mask, scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1)
+        probs = jnp.where(mask, probs, 0.0)  # fully-masked rows -> 0
+        return jnp.einsum("bhst,bhtd->bhsd",
+                          probs.astype(q.dtype), v)
+
+    from ...core.tensor import Tensor as _T
+
+    def _opt(x):
+        return None if x is None else (
+            x._value if isinstance(x, _T) else jnp.asarray(x))
+
+    kp, am = _opt(key_padding_mask), _opt(attn_mask)
+    return dispatch(
+        "sparse_attention",
+        lambda q, k, v, o, c: impl(q, k, v, o, c, kp, am),
+        (query, key, value, sparse_csr_offset, sparse_csr_columns),
+        nondiff_mask=[False, False, False, True, True])

@@ -10,7 +10,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Imdb", "UCIHousing", "Conll05st"]
+__all__ = ["Imdb", "UCIHousing", "Conll05st", "WMT14", "WMT16",
+           "Movielens"]
 
 
 class Imdb(Dataset):
@@ -159,3 +160,312 @@ class Conll05st(Dataset):
 
     def __len__(self):
         return self.size
+
+
+_WMT_START, _WMT_END, _WMT_UNK = "<s>", "<e>", "<unk>"
+_WMT_UNK_IDX = 2
+
+
+class WMT14(Dataset):
+    """EN->FR translation pairs (reference
+    ``python/paddle/text/datasets/wmt14.py``): items are
+    ``(src_ids, trg_ids, trg_ids_next)`` int64 arrays; src wrapped in
+    <s>...<e>, trg_ids starts with <s>, trg_ids_next ends with <e>;
+    training pairs longer than 80 tokens are dropped.
+
+    ``data_file`` given: parse the real tar (members ``*src.dict``,
+    ``*trg.dict`` — one word per line, line number = id — and
+    ``{mode}/{mode}`` with tab-separated sentence pairs).  Without a
+    path: synthetic id sequences with the same marker conventions
+    (zero-egress environment)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 size=None, seed=0):
+        if mode not in ("train", "test", "gen"):
+            raise ValueError(
+                f"mode should be 'train', 'test' or 'gen', got {mode}")
+        self.mode = mode
+        if data_file:
+            if dict_size <= 0:
+                raise ValueError("dict_size must be positive when parsing "
+                                 "a real archive")
+            self.dict_size = dict_size
+            self._parse(data_file, mode, dict_size)
+            return
+        self.dict_size = dict_size if dict_size > 0 else 30000
+        self.src_dict = self.trg_dict = None
+        n = (512 if mode == "train" else 128) if size is None else size
+        rng = np.random.default_rng(
+            seed + {"train": 0, "test": 1, "gen": 2}[mode])
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for _ in range(n):
+            ls, lt = int(rng.integers(4, 30)), int(rng.integers(4, 30))
+            src = rng.integers(3, self.dict_size, ls)
+            trg = rng.integers(3, self.dict_size, lt)
+            self.src_ids.append(
+                np.concatenate([[0], src, [1]]).astype(np.int64))
+            self.trg_ids.append(
+                np.concatenate([[0], trg]).astype(np.int64))
+            self.trg_ids_next.append(
+                np.concatenate([trg, [1]]).astype(np.int64))
+
+    def _parse(self, data_file, mode, dict_size):
+        import tarfile
+
+        def to_dict(fd, size):
+            out = {}
+            for i, line in enumerate(fd):
+                if i >= size:
+                    break
+                out[line.strip().decode()] = i
+            return out
+
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            members = [m.name for m in tf.getmembers() if m.isfile()]
+
+            def one(suffix):
+                names = [n for n in members if n.endswith(suffix)]
+                if len(names) != 1:
+                    raise ValueError(
+                        f"WMT14: expected exactly one member ending "
+                        f"'{suffix}' in {data_file}, found {names}")
+                return names[0]
+
+            self.src_dict = to_dict(tf.extractfile(one("src.dict")),
+                                    dict_size)
+            self.trg_dict = to_dict(tf.extractfile(one("trg.dict")),
+                                    dict_size)
+            for line in tf.extractfile(one(f"{mode}/{mode}")):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [self.src_dict.get(w, _WMT_UNK_IDX)
+                       for w in ([_WMT_START] + parts[0].split()
+                                 + [_WMT_END])]
+                trg = [self.trg_dict.get(w, _WMT_UNK_IDX)
+                       for w in parts[1].split()]
+                if len(src) > 80 or len(trg) > 80:
+                    continue
+                self.src_ids.append(np.asarray(src, np.int64))
+                self.trg_ids.append(np.asarray(
+                    [self.trg_dict[_WMT_START]] + trg, np.int64))
+                self.trg_ids_next.append(np.asarray(
+                    trg + [self.trg_dict[_WMT_END]], np.int64))
+
+    def get_dict(self, reverse=False):
+        if self.src_dict is None:
+            raise ValueError("synthetic WMT14 has no word dictionaries")
+        if reverse:
+            return ({v: k for k, v in self.src_dict.items()},
+                    {v: k for k, v in self.trg_dict.items()})
+        return self.src_dict, self.trg_dict
+
+    def __getitem__(self, idx):
+        return (self.src_ids[idx], self.trg_ids[idx],
+                self.trg_ids_next[idx])
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT16(Dataset):
+    """EN<->DE translation (reference
+    ``python/paddle/text/datasets/wmt16.py``): same item triple as WMT14;
+    dictionaries are BUILT from the training split by descending
+    frequency with <s>/<e>/<unk> as ids 0/1/2; ``lang`` picks the source
+    column.  Archive layout: ``wmt16/{train,test,val}``, tab-separated
+    en<TAB>de lines."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", size=None, seed=0):
+        if mode not in ("train", "test", "val"):
+            raise ValueError(
+                f"mode should be 'train', 'test' or 'val', got {mode}")
+        if lang not in ("en", "de"):
+            raise ValueError(f"lang should be 'en' or 'de', got {lang}")
+        self.mode = mode
+        self.lang = lang
+        if data_file:
+            if src_dict_size <= 0 or trg_dict_size <= 0:
+                raise ValueError("src/trg_dict_size must be positive when "
+                                 "parsing a real archive")
+            import tarfile
+            with tarfile.open(data_file, "r:*") as tf:
+                en_dict, de_dict = self._build_dicts(
+                    tf, src_dict_size if lang == "en" else trg_dict_size,
+                    trg_dict_size if lang == "en" else src_dict_size)
+                self.src_dict = en_dict if lang == "en" else de_dict
+                self.trg_dict = de_dict if lang == "en" else en_dict
+                self._load(tf, mode)
+            return
+        self.src_dict = self.trg_dict = None
+        self.src_dict_size = src_dict_size if src_dict_size > 0 else 10000
+        self.trg_dict_size = trg_dict_size if trg_dict_size > 0 else 10000
+        n = (512 if mode == "train" else 128) if size is None else size
+        rng = np.random.default_rng(
+            seed + {"train": 0, "test": 1, "val": 2}[mode]
+            + (0 if lang == "en" else 3))
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for _ in range(n):
+            ls, lt = int(rng.integers(4, 30)), int(rng.integers(4, 30))
+            src = rng.integers(3, self.src_dict_size, ls)
+            trg = rng.integers(3, self.trg_dict_size, lt)
+            self.src_ids.append(
+                np.concatenate([[0], src, [1]]).astype(np.int64))
+            self.trg_ids.append(
+                np.concatenate([[0], trg]).astype(np.int64))
+            self.trg_ids_next.append(
+                np.concatenate([trg, [1]]).astype(np.int64))
+
+    @staticmethod
+    def _build_dicts(tf, en_dict_size, de_dict_size):
+        """Both language dictionaries from ONE pass over wmt16/train
+        (the train split is the big member; decompress it once)."""
+        from collections import Counter
+        en_freq, de_freq = Counter(), Counter()
+        for line in tf.extractfile("wmt16/train"):
+            parts = line.decode().strip().split("\t")
+            if len(parts) != 2:
+                continue
+            en_freq.update(parts[0].split())
+            de_freq.update(parts[1].split())
+
+        def to_dict(freq, dict_size):
+            words = [_WMT_START, _WMT_END, _WMT_UNK]
+            words += [w for w, _ in sorted(freq.items(),
+                                           key=lambda kv: (-kv[1], kv[0]))]
+            return {w: i for i, w in enumerate(words[:dict_size])}
+
+        return to_dict(en_freq, en_dict_size), to_dict(de_freq,
+                                                       de_dict_size)
+
+    def _load(self, tf, mode):
+        start_id = self.src_dict[_WMT_START]
+        end_id = self.src_dict[_WMT_END]
+        unk_id = self.src_dict[_WMT_UNK]
+        src_col = 0 if self.lang == "en" else 1
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for line in tf.extractfile(f"wmt16/{mode}"):
+            parts = line.decode().strip().split("\t")
+            if len(parts) != 2:
+                continue
+            src = [self.src_dict.get(w, unk_id)
+                   for w in parts[src_col].split()]
+            trg = [self.trg_dict.get(w, unk_id)
+                   for w in parts[1 - src_col].split()]
+            self.src_ids.append(np.asarray(
+                [start_id] + src + [end_id], np.int64))
+            self.trg_ids.append(np.asarray([start_id] + trg, np.int64))
+            self.trg_ids_next.append(np.asarray(trg + [end_id],
+                                                np.int64))
+
+    def get_dict(self, lang, reverse=False):
+        if self.src_dict is None:
+            raise ValueError("synthetic WMT16 has no word dictionaries")
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d
+
+    def __getitem__(self, idx):
+        return (self.src_ids[idx], self.trg_ids[idx],
+                self.trg_ids_next[idx])
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class Movielens(Dataset):
+    """ml-1m rating prediction (reference
+    ``python/paddle/text/datasets/movielens.py``): each item is the
+    8-field tuple ``([uid], [gender], [age_idx], [job], [mov_id],
+    [category_ids...], [title_ids...], [rating])`` with rating rescaled
+    to ``stars*2-5``; train/test split by a seeded random draw per
+    rating line (reference semantics).
+
+    ``data_file``: the real ml-1m zip (movies.dat/users.dat/ratings.dat,
+    ``::``-separated, latin-1).  Without a path: synthetic rows with the
+    real id spaces."""
+
+    age_table = [1, 18, 25, 35, 45, 50, 56]
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, size=None):
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode
+        if data_file:
+            self._parse(data_file, mode, test_ratio, rand_seed)
+            return
+        n = (1024 if mode == "train" else 128) if size is None else size
+        rng = np.random.default_rng(rand_seed + (mode == "test"))
+        self.data = []
+        for _ in range(n):
+            n_cat = int(rng.integers(1, 4))
+            n_title = int(rng.integers(1, 5))
+            self.data.append((
+                np.asarray([rng.integers(1, 6041)], np.int64),
+                np.asarray([rng.integers(0, 2)], np.int64),
+                np.asarray([rng.integers(0, len(self.age_table))],
+                           np.int64),
+                np.asarray([rng.integers(0, 21)], np.int64),
+                np.asarray([rng.integers(1, 3953)], np.int64),
+                rng.integers(0, 18, n_cat).astype(np.int64),
+                rng.integers(0, 5000, n_title).astype(np.int64),
+                np.asarray([float(rng.integers(1, 6)) * 2 - 5.0],
+                           np.float32),
+            ))
+
+    def _parse(self, data_file, mode, test_ratio, rand_seed):
+        import re
+        import zipfile
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        movies, users = {}, {}
+        title_words, categories = set(), set()
+        with zipfile.ZipFile(data_file) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, cats = line.decode("latin1").strip() \
+                        .split("::")
+                    cats = cats.split("|")
+                    categories.update(cats)
+                    m = pattern.match(title)
+                    title = m.group(1) if m else title
+                    movies[int(mid)] = (int(mid), title, cats)
+                    title_words.update(w.lower() for w in title.split())
+            title_dict = {w: i for i, w in enumerate(sorted(title_words))}
+            cat_dict = {c: i for i, c in enumerate(sorted(categories))}
+            with z.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job = line.decode("latin1").strip() \
+                        .split("::")[:4]
+                    users[int(uid)] = (
+                        int(uid), 0 if gender == "M" else 1,
+                        self.age_table.index(int(age)), int(job))
+            rng = np.random.default_rng(rand_seed)
+            is_test = mode == "test"
+            self.data = []
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (rng.random() < test_ratio) != is_test:
+                        continue
+                    uid, mid, stars = line.decode("latin1").strip() \
+                        .split("::")[:3]
+                    u = users[int(uid)]
+                    mid_i, title, cats = movies[int(mid)]
+                    self.data.append((
+                        np.asarray([u[0]], np.int64),
+                        np.asarray([u[1]], np.int64),
+                        np.asarray([u[2]], np.int64),
+                        np.asarray([u[3]], np.int64),
+                        np.asarray([mid_i], np.int64),
+                        np.asarray([cat_dict[c] for c in cats], np.int64),
+                        np.asarray([title_dict[w.lower()]
+                                    for w in title.split()], np.int64),
+                        np.asarray([float(stars) * 2 - 5.0], np.float32),
+                    ))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)

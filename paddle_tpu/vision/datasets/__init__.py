@@ -25,7 +25,8 @@ import numpy as np
 
 from ...io import Dataset
 
-__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST"]
+__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST",
+           "VOC2012", "Flowers"]
 
 
 def _no_download(name):
@@ -192,3 +193,159 @@ class MNIST(_SyntheticImages):
 
 class FashionMNIST(MNIST):
     pass
+
+
+class VOC2012(Dataset):
+    """Segmentation pairs (reference
+    ``python/paddle/vision/datasets/voc2012.py``): items are
+    ``(image, label)`` — RGB image and the class-index mask png (0..20,
+    255 = void border), both HWC/HW uint8 before transforms.
+
+    ``data_file``: the real VOCtrainval tar (ImageSets/Segmentation/
+    {mode}.txt lists the ids; JPEGImages/<id>.jpg +
+    SegmentationClass/<id>.png).  Without a path: synthetic image/mask
+    pairs with the 21-class label space."""
+
+    num_classes = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None, size=None, seed=0):
+        if mode not in ("train", "valid", "test"):
+            raise ValueError(
+                f"mode should be 'train', 'valid' or 'test', got {mode}")
+        self.mode = mode
+        self.transform = transform
+        if data_file:
+            self._open(data_file, mode)
+            return
+        if download:
+            _no_download(type(self).__name__)
+        self._tar = None
+        self.size = (128 if mode == "train" else 32) if size is None \
+            else size
+        rng = np.random.default_rng(
+            seed + {"train": 0, "valid": 1, "test": 2}[mode])
+        self._images = rng.integers(0, 256, (self.size, 64, 64, 3),
+                                    dtype=np.uint8)
+        masks = rng.integers(0, self.num_classes, (self.size, 64, 64))
+        masks[:, :2, :] = 255  # void border rows like real masks
+        self._masks = masks.astype(np.uint8)
+
+    def _open(self, data_file, mode):
+        import tarfile
+        # reference MODE_FLAG_MAP (voc2012.py:36): 'train' reads the
+        # trainval superset, 'test' reads train.txt, 'valid' reads val
+        split = {"train": "trainval", "valid": "val",
+                 "test": "train"}[mode]
+        self._tar = tarfile.open(data_file, "r:*")
+        members = {m.name: m for m in self._tar.getmembers()
+                   if m.isfile()}
+        list_name = [n for n in members if n.endswith(
+            f"ImageSets/Segmentation/{split}.txt")]
+        if len(list_name) != 1:
+            raise ValueError(
+                f"VOC2012: no ImageSets/Segmentation/{split}.txt in "
+                f"{data_file}")
+        ids = self._tar.extractfile(members[list_name[0]]) \
+            .read().decode().split()
+        root = list_name[0].split("ImageSets/")[0]
+        self._pairs = []
+        for i in ids:
+            jpg = f"{root}JPEGImages/{i}.jpg"
+            png = f"{root}SegmentationClass/{i}.png"
+            if jpg in members and png in members:
+                self._pairs.append((members[jpg], members[png]))
+        self.size = len(self._pairs)
+
+    def __getitem__(self, idx):
+        if self._tar is not None:
+            import io
+            from PIL import Image
+            jm, pm = self._pairs[idx]
+            img = np.asarray(Image.open(io.BytesIO(
+                self._tar.extractfile(jm).read())).convert("RGB"))
+            mask = np.asarray(Image.open(io.BytesIO(
+                self._tar.extractfile(pm).read())))
+        else:
+            img, mask = self._images[idx], self._masks[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return self.size
+
+
+class Flowers(Dataset):
+    """102-category flowers (reference
+    ``python/paddle/vision/datasets/flowers.py``): items are
+    ``(image, label)`` with the 1-based class id in an int64 [1] array.
+
+    Real files: ``data_file`` = 102flowers.tgz (jpg/image_NNNNN.jpg),
+    ``label_file`` = imagelabels.mat, ``setid_file`` = setid.mat
+    (trnid/valid/tstid index lists).  Without paths: synthetic images
+    over the real label space."""
+
+    num_classes = 102
+    # reference flowers.py:38 deliberately swaps trnid/tstid (the
+    # dataset's test split outnumbers train ~6x, so 'train' uses tstid)
+    _split_key = {"train": "tstid", "valid": "valid", "test": "trnid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend=None, size=None, seed=0):
+        if mode not in self._split_key:
+            raise ValueError(
+                f"mode should be 'train', 'valid' or 'test', got {mode}")
+        self.mode = mode
+        self.transform = transform
+        if data_file:
+            if not (label_file and setid_file):
+                raise ValueError("Flowers needs data_file + label_file + "
+                                 "setid_file together")
+            self._open(data_file, label_file, setid_file, mode)
+            return
+        if download:
+            _no_download(type(self).__name__)
+        self._tar = None
+        self.size = (256 if mode == "train" else 64) if size is None \
+            else size
+        rng = np.random.default_rng(
+            seed + {"train": 0, "valid": 1, "test": 2}[mode])
+        self._images = rng.integers(0, 256, (self.size, 64, 64, 3),
+                                    dtype=np.uint8)
+        self.labels = rng.integers(1, self.num_classes + 1,
+                                   (self.size,)).astype(np.int64)
+
+    def _open(self, data_file, label_file, setid_file, mode):
+        import tarfile
+        import scipy.io
+        self._tar = tarfile.open(data_file, "r:*")
+        self._members = {m.name: m for m in self._tar.getmembers()
+                         if m.isfile()}
+        self.labels = np.asarray(
+            scipy.io.loadmat(label_file)["labels"]).ravel() \
+            .astype(np.int64)
+        self.indexes = np.asarray(scipy.io.loadmat(setid_file)[
+            self._split_key[mode]]).ravel().astype(np.int64)
+        self.size = len(self.indexes)
+
+    def __getitem__(self, idx):
+        if self._tar is not None:
+            import io
+            from PIL import Image
+            index = int(self.indexes[idx])
+            name = "jpg/image_%05d.jpg" % index
+            img = np.asarray(Image.open(io.BytesIO(
+                self._tar.extractfile(self._members[name]).read()))
+                .convert("RGB"))
+            label = np.asarray([self.labels[index - 1]], np.int64)
+        else:
+            img = self._images[idx]
+            label = np.asarray([self.labels[idx]], np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size

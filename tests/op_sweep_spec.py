@@ -112,6 +112,11 @@ CUSTOM_INPUTS = {
     "bincount": lambda: ((_i((10,), 5),), {}),
     "gather_tree": lambda: ((_i((4, 2, 3), 9, dtype=np.int64),
                              _i((4, 2, 3), 2, dtype=np.int64)), {}),
+    "sparse_attention": lambda: ((_f((1, 2, 4, 8)), _f((1, 2, 4, 8), seed=2),
+                                  _f((1, 2, 4, 8), seed=3),
+                                  # full pattern: every row stores all 4 cols
+                                  _t(np.tile(np.arange(0, 17, 4, dtype=np.int32), (1, 2, 1))),
+                                  _t(np.tile(np.tile(np.arange(4, dtype=np.int32), 4), (1, 2, 1)))), {}),
     "gcd": lambda: ((_i((4,), 12, dtype=np.int32),
                      _i((4,), 12, 8, dtype=np.int32)), {}),
     "lcm": lambda: ((_i((4,), 6, dtype=np.int32),

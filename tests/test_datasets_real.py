@@ -242,3 +242,181 @@ def test_inplace_op_variants():
     check_inplace(lambda x, y: x - y, lambda x, y: x.subtract_(y), [a, b])
     check_inplace(lambda x: paddle.zeros_like(x),
                   lambda x: x.zero_(), [a])
+
+
+# ---------------------------------------------------------------------------
+# round-5 tail: WMT14/WMT16/Movielens/VOC2012/Flowers (VERDICT r4 item 10)
+# ---------------------------------------------------------------------------
+
+def _tar_add(tf, name, data: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+def test_wmt14_real_tar(tmp_path):
+    from paddle_tpu.text.datasets import WMT14
+    path = str(tmp_path / "wmt14.tgz")
+    src_dict = "<s>\n<e>\n<unk>\nhello\nworld\n"
+    trg_dict = "<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+    pairs = "hello world\tbonjour monde\nhello zzz\tmonde qqq\n"
+    long_pair = " ".join(["hello"] * 90) + "\tbonjour\n"  # dropped (>80)
+    with tarfile.open(path, "w:gz") as tf:
+        _tar_add(tf, "wmt14/src.dict", src_dict.encode())
+        _tar_add(tf, "wmt14/trg.dict", trg_dict.encode())
+        _tar_add(tf, "wmt14/train/train", (pairs + long_pair).encode())
+    d = WMT14(data_file=path, mode="train", dict_size=5)
+    assert len(d) == 2  # the >80 pair dropped
+    src, trg, trg_next = d[0]
+    # <s> hello world <e> = 0 3 4 1
+    np.testing.assert_array_equal(src, [0, 3, 4, 1])
+    np.testing.assert_array_equal(trg, [0, 3, 4])       # <s> bonjour monde
+    np.testing.assert_array_equal(trg_next, [3, 4, 1])  # bonjour monde <e>
+    src2 = d[1][0]
+    np.testing.assert_array_equal(src2, [0, 3, 2, 1])   # zzz -> <unk>=2
+    sd, td = d.get_dict()
+    assert sd["hello"] == 3 and td["monde"] == 4
+
+
+def test_wmt16_builds_dict_by_frequency(tmp_path):
+    from paddle_tpu.text.datasets import WMT16
+    path = str(tmp_path / "wmt16.tgz")
+    train = ("a a a b\tx x y\n" "a b c\tx z z\n")
+    test = "c a\tz y\n"
+    with tarfile.open(path, "w:gz") as tf:
+        _tar_add(tf, "wmt16/train", train.encode())
+        _tar_add(tf, "wmt16/test", test.encode())
+        _tar_add(tf, "wmt16/val", test.encode())
+    d = WMT16(data_file=path, mode="test", src_dict_size=10,
+              trg_dict_size=10, lang="en")
+    # en dict: markers 0..2 then a(4) b(2) c(1) -> a=3 b=4 c=5
+    sd = d.get_dict("en")
+    assert sd["a"] == 3 and sd["b"] == 4 and sd["c"] == 5
+    src, trg, trg_next = d[0]
+    np.testing.assert_array_equal(src, [0, 5, 3, 1])     # <s> c a <e>
+    td = d.get_dict("de")
+    # de dict: x(3) z(3) y(1) -> x=3 z=4 y=5 (count ties broken by word)
+    np.testing.assert_array_equal(trg, [0, td["z"], td["y"]])
+    np.testing.assert_array_equal(trg_next, [td["z"], td["y"], 1])
+    # lang='de' swaps the columns
+    d2 = WMT16(data_file=path, mode="test", src_dict_size=10,
+               trg_dict_size=10, lang="de")
+    np.testing.assert_array_equal(d2[0][0][1:-1] >= 3,
+                                  [True, True])
+
+
+def test_movielens_real_zip(tmp_path):
+    import zipfile
+    from paddle_tpu.text.datasets import Movielens
+    path = str(tmp_path / "ml-1m.zip")
+    movies = "1::Toy Story (1995)::Animation|Comedy\n" \
+             "2::Jumanji (1995)::Adventure\n"
+    users = "1::M::25::7::55455\n2::F::35::3::55117\n"
+    ratings = "".join(f"{u}::{m}::{r}::0\n"
+                      for u, m, r in [(1, 1, 5), (1, 2, 3), (2, 1, 4),
+                                      (2, 2, 1)] * 5)
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ml-1m/movies.dat", movies)
+        z.writestr("ml-1m/users.dat", users)
+        z.writestr("ml-1m/ratings.dat", ratings)
+    tr = Movielens(data_file=path, mode="train", test_ratio=0.3,
+                   rand_seed=0)
+    te = Movielens(data_file=path, mode="test", test_ratio=0.3,
+                   rand_seed=0)
+    assert len(tr) + len(te) == 20 and len(te) > 0
+    row = tr[0]
+    assert len(row) == 8
+    uid, gender, age, job, mid, cats, title, rating = row
+    assert uid.shape == (1,) and rating.dtype == np.float32
+    assert rating[0] in {2 * r - 5.0 for r in (1, 2, 3, 4, 5)}
+    assert gender[0] in (0, 1) and 0 <= age[0] < 7
+
+
+def _png_bytes(arr, mode):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr, mode=mode).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _jpg_bytes(arr):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr, mode="RGB").save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def test_voc2012_real_tar(tmp_path):
+    from paddle_tpu.vision.datasets import VOC2012
+    rng = np.random.default_rng(0)
+    path = str(tmp_path / "voc.tar")
+    root = "VOCdevkit/VOC2012/"
+    ids = ["2007_000001", "2007_000002"]
+    with tarfile.open(path, "w") as tf:
+        # reference MODE_FLAG_MAP: mode='train' reads trainval.txt,
+        # mode='test' reads train.txt, mode='valid' reads val.txt
+        _tar_add(tf, root + "ImageSets/Segmentation/trainval.txt",
+                 "\n".join(ids).encode())
+        _tar_add(tf, root + "ImageSets/Segmentation/train.txt",
+                 "\n".join(ids).encode())
+        _tar_add(tf, root + "ImageSets/Segmentation/val.txt",
+                 ids[0].encode())
+        for i in ids:
+            img = rng.integers(0, 256, (24, 32, 3), dtype=np.uint8)
+            mask = rng.integers(0, 21, (24, 32), dtype=np.uint8)
+            _tar_add(tf, root + f"JPEGImages/{i}.jpg", _jpg_bytes(img))
+            _tar_add(tf, root + f"SegmentationClass/{i}.png",
+                     _png_bytes(mask, "L"))
+    d = VOC2012(data_file=path, mode="train")
+    assert len(d) == 2
+    img, mask = d[0]
+    assert img.shape == (24, 32, 3) and mask.shape == (24, 32)
+    assert mask.max() <= 20
+    dv = VOC2012(data_file=path, mode="valid")
+    assert len(dv) == 1
+
+
+def test_flowers_real_files(tmp_path):
+    import scipy.io
+    from paddle_tpu.vision.datasets import Flowers
+    rng = np.random.default_rng(1)
+    tgz = str(tmp_path / "102flowers.tgz")
+    n = 6
+    with tarfile.open(tgz, "w:gz") as tf:
+        for i in range(1, n + 1):
+            img = rng.integers(0, 256, (20, 20, 3), dtype=np.uint8)
+            _tar_add(tf, "jpg/image_%05d.jpg" % i, _jpg_bytes(img))
+    labels = rng.integers(1, 103, n)
+    scipy.io.savemat(str(tmp_path / "imagelabels.mat"),
+                     {"labels": labels[None]})
+    scipy.io.savemat(str(tmp_path / "setid.mat"),
+                     {"trnid": np.array([[1, 3, 5]]),
+                      "valid": np.array([[2]]),
+                      "tstid": np.array([[4, 6]])})
+    # reference flowers.py:38 swaps the splits: mode='train' -> tstid,
+    # mode='test' -> trnid (the raw test split outnumbers train ~6x)
+    d = Flowers(data_file=tgz, label_file=str(tmp_path / "imagelabels.mat"),
+                setid_file=str(tmp_path / "setid.mat"), mode="train")
+    assert len(d) == 2
+    img, lab = d[0]
+    assert img.shape == (20, 20, 3)
+    assert lab[0] == labels[3]  # tstid starts at image_00004 -> labels[3]
+    t = Flowers(data_file=tgz, label_file=str(tmp_path / "imagelabels.mat"),
+                setid_file=str(tmp_path / "setid.mat"), mode="test")
+    assert len(t) == 3 and t[0][1][0] == labels[0]
+
+
+def test_new_datasets_synthetic_defaults_load():
+    from paddle_tpu.text.datasets import WMT14, WMT16, Movielens
+    from paddle_tpu.vision.datasets import VOC2012, Flowers
+    from paddle_tpu.io import DataLoader
+    for ds in (WMT14(mode="test", size=8), WMT16(mode="val", size=8),
+               Movielens(mode="test", size=8)):
+        assert len(ds) == 8 and len(ds[0]) in (3, 8)
+    voc = VOC2012(size=4)
+    fl = Flowers(size=4)
+    assert len(voc) == 4 and len(fl) == 4
+    # images batch through the loader
+    loader = DataLoader(fl, batch_size=2)
+    xb, yb = next(iter(loader))
+    assert list(xb.shape)[0] == 2

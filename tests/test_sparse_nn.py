@@ -286,3 +286,69 @@ def test_sparse_softmax_batched_csr():
             e = np.exp(seg - seg.max())
             np.testing.assert_allclose(ov[bi, ptr[r]:ptr[r + 1]],
                                        e / e.sum(), rtol=1e-5)
+
+
+def test_f_sparse_attention_matches_dense_masked():
+    """paddle.nn.functional.sparse_attention (reference
+    python/paddle/nn/functional/sparse_attention.py signature): CSR
+    offset/columns pattern == dense attention with the same boolean mask."""
+    import paddle_tpu.nn.functional as F
+    rng = np.random.default_rng(0)
+    b, h, s, d = 2, 3, 8, 16
+    q = rng.normal(size=(b, h, s, d)).astype(np.float32)
+    k = rng.normal(size=(b, h, s, d)).astype(np.float32)
+    v = rng.normal(size=(b, h, s, d)).astype(np.float32)
+    # random per-(b,h) banded-ish pattern with FIXED nnz (CSR contract)
+    mask = np.zeros((b, h, s, s), bool)
+    for bi in range(b):
+        for hi in range(h):
+            for r in range(s):
+                mask[bi, hi, r, rng.choice(s, 3, replace=False)] = True
+    nnz = mask[0, 0].sum()
+    offset = np.zeros((b, h, s + 1), np.int32)
+    cols = np.zeros((b, h, nnz), np.int32)
+    for bi in range(b):
+        for hi in range(h):
+            rr, cc = np.nonzero(mask[bi, hi])
+            offset[bi, hi, 1:] = np.cumsum(
+                np.bincount(rr, minlength=s)).astype(np.int32)
+            cols[bi, hi] = cc.astype(np.int32)
+    got = np.asarray(F.sparse_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(offset), paddle.to_tensor(cols))._value)
+    # dense reference
+    scores = np.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(d)
+    scores = np.where(mask, scores, -1e30)
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    p = np.where(mask, p, 0.0)
+    want = np.einsum("bhst,bhtd->bhsd", p, v)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_f_sparse_attention_masks_and_grad():
+    import paddle_tpu.nn.functional as F
+    rng = np.random.default_rng(1)
+    b, h, s, d = 1, 2, 6, 8
+    q = paddle.to_tensor(rng.normal(size=(b, h, s, d)).astype(np.float32),
+                         stop_gradient=False)
+    k = paddle.to_tensor(rng.normal(size=(b, h, s, d)).astype(np.float32))
+    v = paddle.to_tensor(rng.normal(size=(b, h, s, d)).astype(np.float32))
+    # full pattern so masks are the only restriction
+    offset = paddle.to_tensor(np.tile(
+        np.arange(0, s * s + 1, s, dtype=np.int32), (b, h, 1)))
+    cols = paddle.to_tensor(np.tile(
+        np.tile(np.arange(s, dtype=np.int32), s), (b, h, 1)))
+    kp = np.ones((b, s), np.float32); kp[0, -2:] = 0.0  # 0 = masked
+    am = np.tril(np.ones((s, s), np.float32))           # causal, 0 = masked
+    out = F.sparse_attention(q, k, v, offset, cols,
+                             key_padding_mask=paddle.to_tensor(kp),
+                             attn_mask=paddle.to_tensor(am))
+    arr = np.asarray(out._value)
+    assert arr.shape == (b, h, s, d) and np.isfinite(arr).all()
+    # row 0 attends only col 0 (causal + kp): equals v[..., 0, :]
+    np.testing.assert_allclose(arr[:, :, 0], np.asarray(v._value)[:, :, 0],
+                               rtol=1e-5, atol=1e-6)
+    out.sum().backward()
+    g = np.asarray(q.grad._value)
+    assert list(g.shape) == list(q.shape) and np.isfinite(g).all()
