@@ -22,6 +22,19 @@ from .....nn.layer.layers import Layer
 from .gate import TopKGate
 
 
+def _expert_ffn(x, wi, bi, wo, bo, act_name):
+    """Batched per-expert FFN on [E, C, D] buffers (shared by all three
+    dispatch paths)."""
+    h = jnp.einsum("ecd,edf->ecf", x, wi) + bi
+    if act_name == "gelu":
+        h = jax.nn.gelu(h)
+    elif act_name == "relu":
+        h = jax.nn.relu(h)
+    elif act_name == "silu":
+        h = jax.nn.silu(h)
+    return jnp.einsum("ecf,efd->ecd", h, wo) + bo
+
+
 class MoELayer(Layer):
     """Mixture-of-experts FFN block.
 
@@ -44,16 +57,18 @@ class MoELayer(Layer):
         self.d_hidden = d_hidden
         self.num_experts = num_experts
         self.activation = activation
+        self.expert_axis = expert_axis
         self.gate = gate or TopKGate(d_model, num_experts, top_k,
                                      capacity_factor, dropless=dropless)
-        # scatter (Megablocks-style gather/matmul/scatter) is the
-        # single-device default: the dense [T,E,C] dispatch einsums cost
-        # 2*T*E*C*D FLOPs EACH — at bench scale that rivals the expert
-        # matmuls themselves and grows with E (capacity-sweep table in
-        # BASELINE.md).  The dense einsum remains the EP-sharded path
-        # (GSPMD lowers it to the reference's all-to-all) and the path
-        # for custom gates that only implement the dense forward
-        # contract (no route()/capacity()).
+        # Dispatch-mode selection follows the MEASURED crossover
+        # (BASELINE.md round-4 on-chip sweep, T=8192/D=2048/F=8192/bf16):
+        # the dense [T,E,C] einsums cost 2*T*E*C*D FLOPs each but run at
+        # full MXU rate, and they beat the HBM-bound scatter only in the
+        # narrow band cf~1.25 with E<=16 (16.6 vs 21.8 ms at E=8, 16.9
+        # vs 20.5 at E=16); scatter wins at E=32 (13.6 vs 17.1), at
+        # cf=1.0 (11.4 vs 13.0), at cf=2.0 (17.7 vs 26.0), and always on
+        # memory ([E,C+1,D] vs two [T,E,C] one-hots).  Dense remains the
+        # only path for custom gates without route()/capacity().
         gate_routes = hasattr(self.gate, "route") and \
             hasattr(self.gate, "capacity")
         if dispatch_mode == "scatter" and not gate_routes:
@@ -61,8 +76,17 @@ class MoELayer(Layer):
                 "dispatch_mode='scatter' needs a gate with "
                 "route()/capacity() (TopKGate subclasses); this gate "
                 "only implements the dense forward contract")
-        self.dispatch_mode = dispatch_mode or \
-            ("scatter" if expert_axis is None and gate_routes else "dense")
+        if dispatch_mode is None:
+            if not gate_routes:
+                dispatch_mode = "dense"
+            else:
+                cf = getattr(self.gate, "capacity_factor", capacity_factor)
+                dense_band = (1.0 < float(cf) < 1.5
+                              and num_experts <= 16
+                              and not getattr(self.gate, "dropless",
+                                              dropless))
+                dispatch_mode = "dense" if dense_band else "scatter"
+        self.dispatch_mode = dispatch_mode
         from .....nn.initializer import XavierUniform
         init = XavierUniform()
         self.w_in = self.create_parameter((num_experts, d_model, d_hidden),
@@ -102,14 +126,7 @@ class MoELayer(Layer):
             # dispatch: [E, C, D] = disp^T . tokens
             expert_in = jnp.einsum("tec,td->ecd", disp.astype(flat.dtype),
                                    flat)
-            h = jnp.einsum("ecd,edf->ecf", expert_in, wi) + bi
-            if act_name == "gelu":
-                h = jax.nn.gelu(h)
-            elif act_name == "relu":
-                h = jax.nn.relu(h)
-            elif act_name == "silu":
-                h = jax.nn.silu(h)
-            expert_out = jnp.einsum("ecf,efd->ecd", h, wo) + bo
+            expert_out = _expert_ffn(expert_in, wi, bi, wo, bo, act_name)
             # combine: [T, D]
             out = jnp.einsum("tec,ecd->td", comb.astype(flat.dtype),
                              expert_out)
@@ -126,7 +143,23 @@ class MoELayer(Layer):
         buffers by (expert id, capacity rank), batched expert matmuls,
         gather+weight to combine.  O(T*k*D) dispatch/combine HBM traffic
         instead of the dense path's 2*T*E*C*D einsum FLOPs; identical
-        routing/drop semantics (same gate ranks)."""
+        routing/drop semantics (same gate ranks).  With ``expert_axis``
+        on a live mesh the dispatch runs EP-sharded (shard_map +
+        collectives — the reference's global_scatter/global_gather
+        dataflow, ``moe_utils.py:20``)."""
+        if self.expert_axis is not None:
+            from .....distributed.topology import get_global_mesh
+            mesh = get_global_mesh()
+            if mesh is not None and self.expert_axis in mesh.axis_names:
+                p = mesh.shape[self.expert_axis]
+                tokens = 1
+                for dim in x.shape[:-1]:
+                    tokens *= dim
+                # shard_map needs both the expert dim and the token dim
+                # evenly divisible; otherwise stay on the local path
+                if p > 1 and self.num_experts % p == 0 \
+                        and tokens % p == 0:
+                    return self._forward_scatter_sharded(x, mesh, p)
         eid, pos, w, keep, aux = self.gate.route(x)
         self.last_aux_loss = aux
         act_name = self.activation
@@ -147,14 +180,7 @@ class MoELayer(Layer):
                             flat.dtype)
             buf = buf.at[eidf, posf].set(flat[tok])
             expert_in = buf[:, :capacity]                  # [E, C, D]
-            h = jnp.einsum("ecd,edf->ecf", expert_in, wi) + bi
-            if act_name == "gelu":
-                h = jax.nn.gelu(h)
-            elif act_name == "relu":
-                h = jax.nn.relu(h)
-            elif act_name == "silu":
-                h = jax.nn.silu(h)
-            expert_out = jnp.einsum("ecf,efd->ecd", h, wo) + bo
+            expert_out = _expert_ffn(expert_in, wi, bi, wo, bo, act_name)
             # combine: gather each slot's row, weight, zero the dropped
             picked = expert_out[eida, posa]                # [T, k, D]
             wmask = (wgt * keepa.astype(wgt.dtype))[..., None]
@@ -163,6 +189,85 @@ class MoELayer(Layer):
 
         return _dispatch(
             "moe_layer_scatter", impl,
+            (x, w, eid, pos, keep, self.w_in, self.b_in, self.w_out,
+             self.b_out),
+            nondiff_mask=[False, False, True, True, True,
+                          False, False, False, False])
+
+    def _forward_scatter_sharded(self, x, mesh, p):
+        """EP-sharded scatter dispatch (reference
+        ``moe_layer.py:99/:149`` MoEScatter/MoEGather over
+        ``global_scatter``/``global_gather``, ``moe_utils.py:20``).
+
+        TPU formulation of the all-to-all dataflow: the gate routes
+        GLOBALLY (positions are ranks over all tokens, so every
+        (expert, slot<C) pair has exactly one owner), then under
+        ``shard_map`` over the ``ep`` axis:
+
+        - each rank position-scatters its local tokens into a full
+          [E, C, D] send buffer (other ranks' slots stay zero), and a
+          ``psum_scatter`` over the expert dim delivers [E/P, C, D]
+          per rank — summing one non-zero contribution per slot, this
+          IS ``global_scatter`` with static shapes (E*C*D bytes/rank on
+          ICI = cf * the ragged ideal);
+        - local experts run on their [E/P, C, D] batch;
+        - ``all_gather`` over the expert dim returns [E, C, D] and each
+          rank gathers/weights its own tokens' rows — ``global_gather``.
+
+        Exact parity with the single-device scatter path: same gate
+        ranks, same slot assignment, and each slot is one token's value
+        (the psum adds zeros), so results match bit-for-bit.
+        """
+        eid, pos, w, keep, aux = self.gate.route(x)
+        self.last_aux_loss = aux
+        act_name = self.activation
+        num_experts = self.num_experts
+        axis = self.expert_axis
+        capacity = self.gate.capacity(
+            x.shape[0] * (x.shape[1] if x.ndim == 3 else 1))
+
+        def impl(hidden, wgt, eida, posa, keepa, wi, bi, wo, bo):
+            orig_shape = hidden.shape
+            flat = hidden.reshape(-1, orig_shape[-1])      # [T, D]
+            kk = eida.shape[1]
+            eidf = eida.reshape(-1, kk)
+            posf = posa.reshape(-1, kk)
+            keepf = keepa.reshape(-1, kk)
+            wgtf = wgt.reshape(-1, kk)
+
+            def inner(flat_l, wgt_l, eid_l, pos_l, keep_l,
+                      wi_l, bi_l, wo_l, bo_l):
+                t_l = flat_l.shape[0]
+                tok = jnp.repeat(jnp.arange(t_l), kk)
+                slot = jnp.where(keep_l.reshape(-1), pos_l.reshape(-1),
+                                 capacity)
+                send = jnp.zeros((num_experts, capacity + 1,
+                                  flat_l.shape[-1]), flat_l.dtype)
+                send = send.at[eid_l.reshape(-1), slot].set(flat_l[tok])
+                send = send[:, :capacity]                  # [E, C, D]
+                # global_scatter: one owner per slot -> reduce-scatter
+                recv = jax.lax.psum_scatter(
+                    send, axis, scatter_dimension=0, tiled=True)
+                eout = _expert_ffn(recv, wi_l, bi_l, wo_l, bo_l, act_name)
+                # global_gather: replicate expert outputs, local pick
+                gath = jax.lax.all_gather(eout, axis, axis=0, tiled=True)
+                picked = gath[eid_l, pos_l]                # [t_l, k, D]
+                wmask = (wgt_l * keep_l.astype(wgt_l.dtype))[..., None]
+                return jnp.sum(picked * wmask.astype(picked.dtype),
+                               axis=1)
+
+            tspec = PartitionSpec(axis, None)
+            espec3 = PartitionSpec(axis, None, None)
+            out = jax.shard_map(
+                inner, mesh=mesh,
+                in_specs=(tspec, tspec, tspec, tspec, tspec,
+                          espec3, espec3, espec3, espec3),
+                out_specs=tspec, axis_names={axis})(
+                flat, wgtf, eidf, posf, keepf, wi, bi, wo, bo)
+            return out.reshape(orig_shape)
+
+        return _dispatch(
+            "moe_layer_scatter_ep", impl,
             (x, w, eid, pos, keep, self.w_in, self.b_in, self.w_out,
              self.b_out),
             nondiff_mask=[False, False, True, True, True,
