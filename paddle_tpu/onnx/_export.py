@@ -90,7 +90,7 @@ _ELEMENTWISE = {
     "sqrt": "Sqrt", "abs": "Abs", "erf": "Erf", "floor": "Floor",
     "sign": "Sign", "gt": "Greater", "lt": "Less", "ge": "GreaterOrEqual",
     "le": "LessOrEqual", "eq": "Equal", "pow": "Pow", "and": "And",
-    "or": "Or", "not": "Not",
+    "or": "Or", "not": "Not", "sin": "Sin", "cos": "Cos",
 }
 
 
@@ -104,9 +104,12 @@ def _emit_eqn(g: _Graph, eqn, names):
 
     if prim in _ELEMENTWISE:
         out1(g.add(_ELEMENTWISE[prim], ins))
+    elif prim == "name":
+        # checkpoint_name remat annotation — identity at inference
+        out1(g.add("Identity", ins))
     elif prim in ("jit", "pjit", "custom_jvp_call", "custom_vjp_call",
                   "custom_jvp_call_jaxpr", "closed_call", "remat",
-                  "checkpoint", "name"):
+                  "checkpoint"):
         sub = (eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
                or eqn.params.get("fun_jaxpr"))
         if sub is None:
@@ -127,11 +130,41 @@ def _emit_eqn(g: _Graph, eqn, names):
     elif prim == "dot_general":
         (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
         lhs, rhs = eqn.invars[:2]
-        if lb or rb or lc != (lhs.aval.ndim - 1,) or rc != (0,):
-            raise NotImplementedError(
-                "onnx export: only plain matmul dot_general supported "
-                f"(got dims {eqn.params['dimension_numbers']})")
-        out1(g.add("MatMul", ins))
+        ls, rs = lhs.aval.shape, rhs.aval.shape
+        if not lb and not rb and lc == (lhs.aval.ndim - 1,) and rc == (0,):
+            # plain [.., M, K] @ [K, N] — numpy-matmul semantics directly
+            out1(g.add("MatMul", ins))
+        else:
+            # general case (batched attention matmuls): canonicalize to
+            # [B.., prod(lfree), prod(contract)] @ [B.., prod(contract),
+            # prod(rfree)] — ONNX MatMul is numpy matmul, so stacked
+            # batch dims multiply pairwise; output reshapes to the
+            # jax dot_general layout (batch, lhs free, rhs free)
+            lfree = [d for d in range(len(ls))
+                     if d not in lc and d not in lb]
+            rfree = [d for d in range(len(rs))
+                     if d not in rc and d not in rb]
+            bshape = [ls[d] for d in lb]
+
+            def prod(dims, shape):
+                n = 1
+                for d in dims:
+                    n *= shape[d]
+                return n
+
+            lt = g.add("Transpose", [ins[0]],
+                       [_attr_ints("perm", list(lb) + lfree + list(lc))])
+            lr = g.add("Reshape", [lt, g.const(np.asarray(
+                bshape + [prod(lfree, ls), prod(lc, ls)], np.int64),
+                "shape")])
+            rt = g.add("Transpose", [ins[1]],
+                       [_attr_ints("perm", list(rb) + list(rc) + rfree)])
+            rr = g.add("Reshape", [rt, g.const(np.asarray(
+                bshape + [prod(rc, rs), prod(rfree, rs)], np.int64),
+                "shape")])
+            mm = g.add("MatMul", [lr, rr])
+            out1(g.add("Reshape", [mm, g.const(np.asarray(
+                eqn.outvars[0].aval.shape, np.int64), "shape")]))
     elif prim == "conv_general_dilated":
         dn = eqn.params["dimension_numbers"]
         if tuple(dn.lhs_spec[:2]) != (0, 1) or \
@@ -229,6 +262,101 @@ def _emit_eqn(g: _Graph, eqn, names):
     elif prim == "concatenate":
         out1(g.add("Concat", ins,
                    [_attr_int("axis", eqn.params["dimension"])]))
+    elif prim == "iota":
+        # static shapes make iota a compile-time constant; store only
+        # the 1-D arange and Expand at runtime (a [1,S,S] mask iota
+        # would otherwise serialize S^2 dense values)
+        shape = tuple(eqn.params["shape"])
+        dim = eqn.params["dimension"]
+        ar = np.arange(shape[dim], dtype=np.dtype(eqn.params["dtype"]))
+        view = [1] * len(shape)
+        view[dim] = shape[dim]
+        base = g.const(ar.reshape(view), "iota")
+        if tuple(view) == shape:
+            out1(base)
+        else:
+            out1(g.add("Expand", [
+                base, g.const(np.asarray(shape, np.int64), "shape")]))
+    elif prim == "slice":
+        starts = list(eqn.params["start_indices"])
+        ends = list(eqn.params["limit_indices"])
+        steps = list(eqn.params["strides"] or [1] * len(starts))
+        axes = list(range(len(starts)))
+        out1(g.add("Slice", [
+            ins[0],
+            g.const(np.asarray(starts, np.int64), "starts"),
+            g.const(np.asarray(ends, np.int64), "ends"),
+            g.const(np.asarray(axes, np.int64), "axes"),
+            g.const(np.asarray(steps, np.int64), "steps")]))
+    elif prim == "split":
+        sizes = list(eqn.params["sizes"])
+        axis = eqn.params["axis"]
+        outs = g.add("Split",
+                     [ins[0], g.const(np.asarray(sizes, np.int64),
+                                      "split")],
+                     [_attr_int("axis", axis)], n_out=len(sizes))
+        outs = outs if isinstance(outs, list) else [outs]
+        for ov, nm in zip(eqn.outvars, outs):
+            names[ov] = nm
+    elif prim == "gather":
+        dn = eqn.params["dimension_numbers"]
+        operand, indices = eqn.invars[0], eqn.invars[1]
+        oshape = operand.aval.shape
+        ishape = indices.aval.shape
+        slice_sizes = tuple(eqn.params["slice_sizes"])
+        smap = tuple(dn.start_index_map)
+        collapsed = tuple(dn.collapsed_slice_dims)
+        # the take(x, idx, axis=a) pattern: one indexed dim, collapsed,
+        # every other dim sliced whole — ONNX Gather(axis=a)
+        take_like = (
+            len(smap) == 1 and collapsed == smap
+            and getattr(dn, "operand_batching_dims", ()) == ()
+            and all(slice_sizes[d] == oshape[d]
+                    for d in range(len(oshape)) if d != smap[0])
+            and slice_sizes[smap[0]] == 1)
+        if not take_like:
+            raise NotImplementedError(
+                "onnx export: general gather unsupported (only the "
+                "take-along-axis pattern maps to ONNX Gather); got "
+                f"dimension_numbers {dn}")
+        axis = smap[0]
+        idx_name = ins[1]
+        # lax.gather: the LAST dim of start_indices is the index vector
+        # (length == len(start_index_map) == 1 here) — drop it
+        if not ishape or ishape[-1] != 1:
+            raise NotImplementedError(
+                "onnx export: gather index-vector dim must be trailing "
+                f"size-1, got indices shape {ishape}")
+        idx_shape = ishape[:-1]
+        idx_name = g.add("Reshape", [
+            idx_name, g.const(np.asarray(idx_shape, np.int64),
+                              "shape")])
+        want = (tuple(oshape[:axis]) + tuple(idx_shape)
+                + tuple(oshape[axis + 1:]))
+        if want != tuple(eqn.outvars[0].aval.shape):
+            raise NotImplementedError(
+                "onnx export: gather output layout differs from ONNX "
+                f"Gather semantics ({want} vs "
+                f"{tuple(eqn.outvars[0].aval.shape)})")
+        out1(g.add("Gather", [ins[0], idx_name],
+                   [_attr_int("axis", axis)]))
+    elif prim == "argmax":
+        axes = eqn.params["axes"]
+        am = g.add("ArgMax", ins[:1],
+                   [_attr_int("axis", axes[0]), _attr_int("keepdims", 0)])
+        # ONNX ArgMax always yields int64; cast to the jaxpr's dtype
+        idx_dt = np.dtype(eqn.params.get("index_dtype", np.int64))
+        if idx_dt != np.int64:
+            am = g.add("Cast", [am],
+                       [_attr_int("to", _np_dtype_code(idx_dt))])
+        out1(am)
+    elif prim == "cumsum":
+        attrs = []
+        if eqn.params.get("reverse"):
+            attrs.append(_attr_int("reverse", 1))
+        out1(g.add("CumSum", [
+            ins[0], g.const(np.asarray(eqn.params["axis"], np.int64))],
+            attrs))
     else:
         raise NotImplementedError(
             f"onnx export: primitive {prim!r} has no ONNX mapping (the "
@@ -331,6 +459,11 @@ def export(layer, path, input_spec=None, opset_version=_OPSET, **configs):
     out_path = path if path.endswith(".onnx") else path + ".onnx"
     import os
     os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    # every export self-checks against the vendored onnx.proto schema
+    # (generic wire decoder, independent of this emitter — _schema.py)
+    # BEFORE writing, so a failed export leaves no corrupt file behind
+    from ._schema import validate
+    validate(model)
     with open(out_path, "wb") as f:
         f.write(model)
     return out_path

@@ -123,6 +123,10 @@ def run_model(path: str, *inputs) -> list:
         "Pow": np.power, "Identity": lambda x: x,
         "Sigmoid": lambda x: 1.0 / (1.0 + np.exp(-x)),
         "Floor": np.floor, "Sign": np.sign,
+        "Sin": np.sin, "Cos": np.cos,
+        "GreaterOrEqual": np.greater_equal, "LessOrEqual": np.less_equal,
+        "And": np.logical_and, "Or": np.logical_or,
+        "Not": np.logical_not,
     }
     try:
         from math import erf as _erf
@@ -162,6 +166,32 @@ def run_model(path: str, *inputs) -> list:
             r = np.concatenate(a, axis=attrs["axis"])
         elif op == "Squeeze":
             r = np.squeeze(a[0], axis=tuple(int(d) for d in a[1]))
+        elif op == "Gather":
+            r = np.take(a[0], a[1].astype(np.int64),
+                        axis=attrs.get("axis", 0))
+        elif op == "Slice":
+            starts, ends, axes, steps = (
+                [int(v) for v in a[1]], [int(v) for v in a[2]],
+                [int(v) for v in a[3]] if len(a) > 3
+                else list(range(len(a[1]))),
+                [int(v) for v in a[4]] if len(a) > 4 else [1] * len(a[1]))
+            sl = [slice(None)] * a[0].ndim
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                sl[ax] = slice(s, e, st)
+            r = a[0][tuple(sl)]
+        elif op == "Split":
+            sizes = [int(v) for v in a[1]]
+            pieces = np.split(a[0], np.cumsum(sizes)[:-1],
+                              axis=attrs["axis"])
+            for o, piece in zip(outs, pieces):
+                env[o] = piece
+            continue
+        elif op == "ArgMax":
+            r = np.argmax(a[0], axis=attrs["axis"]).astype(np.int64)
+            if attrs.get("keepdims", 1):
+                r = np.expand_dims(r, attrs["axis"])
+        elif op == "CumSum":
+            r = np.cumsum(a[0], axis=int(a[1]))
         else:
             raise NotImplementedError(f"onnx runtime: op {op}")
         env[outs[0]] = r

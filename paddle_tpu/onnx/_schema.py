@@ -1,0 +1,351 @@
+"""Structural validation of emitted ONNX bytes against the REAL
+onnx.proto schema — independently of the emitter and of _runtime.py.
+
+The checker has two parts:
+
+1. a GENERIC protobuf wire-format reader (``_walk``): nothing in it
+   knows about ONNX — it decodes tag varints, wire types, and
+   length-delimited payloads exactly as the protobuf spec defines them,
+   so a malformed varint, a wrong wire type, or a truncated
+   length-delimited field fails here regardless of what the emitter
+   thought it was writing;
+2. a schema table (``_SCHEMA``) vendored from the official
+   ``onnx/onnx.proto`` (field numbers, types, and labels of ModelProto,
+   GraphProto, NodeProto, AttributeProto, TensorProto, ValueInfoProto,
+   TypeProto, OperatorSetIdProto — onnx rev: opset-13-era IR v8).
+   Every decoded field must appear in the table with the right wire
+   type; message-typed fields recurse.
+
+Because the table is transcribed from the upstream .proto (not from
+_export.py), an emitter bug like "attribute ints written under the
+wrong field number" or "missing AttributeProto.type discriminator"
+fails validation even though the in-repo evaluator (written by the same
+author) might happily accept it.  Semantic checks on top: graph
+connectivity (every node input resolves), attribute payload matches its
+declared type, initializer raw_data length == prod(dims) * dtype size.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+
+# field kinds in the schema table
+V = "varint"          # int32/int64/uint64/enum/bool
+F = "fixed"           # float/double (we only emit varint+len, but the
+                      # schema needs float fields for completeness)
+S = "bytes"           # string/bytes
+M = "msg"             # embedded message (recurse with the named schema)
+
+# Vendored from the official onnx.proto (IR version 8 / opset 13 era).
+_SCHEMA = {
+    "ModelProto": {
+        1: ("ir_version", V, None),
+        8: ("opset_import", M, "OperatorSetIdProto"),
+        2: ("producer_name", S, None),
+        3: ("producer_version", S, None),
+        4: ("domain", S, None),
+        5: ("model_version", V, None),
+        6: ("doc_string", S, None),
+        7: ("graph", M, "GraphProto"),
+        14: ("metadata_props", M, "StringStringEntryProto"),
+        20: ("training_info", M, None),
+        25: ("functions", M, None),
+    },
+    "OperatorSetIdProto": {
+        1: ("domain", S, None),
+        2: ("version", V, None),
+    },
+    "GraphProto": {
+        1: ("node", M, "NodeProto"),
+        2: ("name", S, None),
+        5: ("initializer", M, "TensorProto"),
+        15: ("sparse_initializer", M, None),
+        10: ("doc_string", S, None),
+        11: ("input", M, "ValueInfoProto"),
+        12: ("output", M, "ValueInfoProto"),
+        13: ("value_info", M, "ValueInfoProto"),
+        14: ("quantization_annotation", M, None),
+    },
+    "NodeProto": {
+        1: ("input", S, None),
+        2: ("output", S, None),
+        3: ("name", S, None),
+        4: ("op_type", S, None),
+        7: ("domain", S, None),
+        5: ("attribute", M, "AttributeProto"),
+        6: ("doc_string", S, None),
+    },
+    "AttributeProto": {
+        1: ("name", S, None),
+        21: ("ref_attr_name", S, None),
+        13: ("doc_string", S, None),
+        20: ("type", V, None),
+        2: ("f", F, None),
+        3: ("i", V, None),
+        4: ("s", S, None),
+        5: ("t", M, "TensorProto"),
+        6: ("g", M, "GraphProto"),
+        7: ("floats", F, None),
+        8: ("ints", V, None),
+        9: ("strings", S, None),
+        10: ("tensors", M, "TensorProto"),
+        11: ("graphs", M, "GraphProto"),
+    },
+    "TensorProto": {
+        1: ("dims", V, None),
+        2: ("data_type", V, None),
+        3: ("segment", M, None),
+        4: ("float_data", F, None),
+        5: ("int32_data", V, None),
+        6: ("string_data", S, None),
+        7: ("int64_data", V, None),
+        8: ("name", S, None),
+        12: ("doc_string", S, None),
+        9: ("raw_data", S, None),
+        13: ("external_data", M, "StringStringEntryProto"),
+        14: ("data_location", V, None),
+        10: ("double_data", F, None),
+        11: ("uint64_data", V, None),
+    },
+    "StringStringEntryProto": {
+        1: ("key", S, None),
+        2: ("value", S, None),
+    },
+    "ValueInfoProto": {
+        1: ("name", S, None),
+        2: ("type", M, "TypeProto"),
+        3: ("doc_string", S, None),
+    },
+    "TypeProto": {
+        1: ("tensor_type", M, "TypeProto.Tensor"),
+        4: ("sequence_type", M, None),
+        5: ("map_type", M, None),
+        9: ("optional_type", M, None),
+        8: ("sparse_tensor_type", M, None),
+        6: ("denotation", S, None),
+    },
+    "TypeProto.Tensor": {
+        1: ("elem_type", V, None),
+        2: ("shape", M, "TensorShapeProto"),
+    },
+    "TensorShapeProto": {
+        1: ("dim", M, "TensorShapeProto.Dimension"),
+    },
+    "TensorShapeProto.Dimension": {
+        1: ("dim_value", V, None),
+        2: ("dim_param", S, None),
+        3: ("denotation", S, None),
+    },
+}
+
+# AttributeProto.AttributeType enum (onnx.proto):
+#   UNDEFINED=0 FLOAT=1 INT=2 STRING=3 TENSOR=4 GRAPH=5
+#   FLOATS=6 INTS=7 STRINGS=8 TENSORS=9 GRAPHS=10
+#   SPARSE_TENSOR=11 SPARSE_TENSORS=12 TYPE_PROTO=13 TYPE_PROTOS=14
+_ATTR_TYPES = {
+    1: ("FLOAT", "f"), 2: ("INT", "i"), 3: ("STRING", "s"),
+    4: ("TENSOR", "t"), 5: ("GRAPH", "g"), 6: ("FLOATS", "floats"),
+    7: ("INTS", "ints"), 8: ("STRINGS", "strings"),
+    9: ("TENSORS", "tensors"), 10: ("GRAPHS", "graphs"),
+    11: ("SPARSE_TENSOR", None), 13: ("TYPE_PROTO", None),
+}
+
+# TensorProto.DataType -> numpy itemsize (for raw_data length checks)
+_DTYPE_SIZE = {1: 4, 2: 1, 3: 1, 4: 2, 5: 2, 6: 4, 7: 8, 9: 1, 10: 2,
+               11: 8, 12: 4, 13: 8, 14: 8, 15: 16, 16: 2}
+
+
+class OnnxSchemaError(ValueError):
+    pass
+
+
+def _read_varint(buf: bytes, pos: int):
+    out = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise OnnxSchemaError("truncated varint")
+        b = buf[pos]
+        out |= (b & 0x7F) << shift
+        pos += 1
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+        if shift > 70:
+            raise OnnxSchemaError("varint too long")
+
+
+def _walk(buf: bytes, schema_name: str, path: str = "$"):
+    """Generic wire-format walk: decode every field, check it against
+    the vendored schema, recurse into messages.  Returns
+    {field_name: [decoded values]} — varints as int, bytes as bytes,
+    messages as nested dicts."""
+    schema = _SCHEMA[schema_name]
+    out: dict = {}
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if field == 0:
+            raise OnnxSchemaError(f"{path}: field number 0 is invalid")
+        if field not in schema:
+            raise OnnxSchemaError(
+                f"{path} ({schema_name}): unknown field number {field}")
+        name, kind, sub = schema[field]
+        if wire == 0:
+            if kind not in (V,):
+                raise OnnxSchemaError(
+                    f"{path}.{name}: varint wire type for a {kind} field")
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            if kind not in (S, M, V, F):
+                raise OnnxSchemaError(
+                    f"{path}.{name}: length-delimited for {kind}")
+            ln, pos = _read_varint(buf, pos)
+            if pos + ln > len(buf):
+                raise OnnxSchemaError(
+                    f"{path}.{name}: length {ln} overruns buffer")
+            payload = buf[pos:pos + ln]
+            pos += ln
+            if kind == M:
+                if sub is None:
+                    val = payload  # schema'd as opaque (unused by emitter)
+                else:
+                    val = _walk(payload, sub, f"{path}.{name}")
+            elif kind == V:
+                # packed repeated varints: decode each element
+                vals, p2 = [], 0
+                while p2 < len(payload):
+                    v, p2 = _read_varint(payload, p2)
+                    vals.append(v)
+                out.setdefault(name, []).extend(vals)
+                continue
+            else:
+                val = payload
+        elif wire == 5:
+            if kind != F:
+                raise OnnxSchemaError(
+                    f"{path}.{name}: fixed32 wire for a {kind} field")
+            if pos + 4 > len(buf):
+                raise OnnxSchemaError(f"{path}.{name}: truncated fixed32")
+            val = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            if kind != F:
+                raise OnnxSchemaError(
+                    f"{path}.{name}: fixed64 wire for a {kind} field")
+            if pos + 8 > len(buf):
+                raise OnnxSchemaError(f"{path}.{name}: truncated fixed64")
+            val = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise OnnxSchemaError(
+                f"{path}.{name}: unsupported wire type {wire}")
+        out.setdefault(name, []).append(val)
+    return out
+
+
+def validate(model_bytes: bytes) -> dict:
+    """Full structural validation; returns a summary dict
+    {nodes, initializers, inputs, outputs, opset} on success, raises
+    OnnxSchemaError otherwise."""
+    m = _walk(model_bytes, "ModelProto")
+    if "ir_version" not in m:
+        raise OnnxSchemaError("ModelProto.ir_version missing")
+    if "graph" not in m:
+        raise OnnxSchemaError("ModelProto.graph missing")
+    if "opset_import" not in m:
+        raise OnnxSchemaError("ModelProto.opset_import missing")
+    opset = m["opset_import"][0]
+    if "version" not in opset:
+        raise OnnxSchemaError("OperatorSetIdProto.version missing")
+    g = m["graph"][0]
+
+    known = set()
+    for t in g.get("initializer", []):
+        if "name" not in t:
+            raise OnnxSchemaError("initializer without name")
+        if "data_type" not in t:
+            raise OnnxSchemaError("initializer without data_type")
+        dt = t["data_type"][0]
+        if dt not in _DTYPE_SIZE:
+            raise OnnxSchemaError(f"initializer dtype {dt} unknown")
+        dims = [d for d in t.get("dims", [])]
+        n = int(np.prod(dims)) if dims else 1
+        raw = t.get("raw_data", [b""])[0]
+        if len(raw) != n * _DTYPE_SIZE[dt]:
+            raise OnnxSchemaError(
+                f"initializer {t['name'][0]!r}: raw_data has {len(raw)} "
+                f"bytes, dims {dims} x dtype {dt} needs "
+                f"{n * _DTYPE_SIZE[dt]}")
+        known.add(t["name"][0].decode())
+
+    for vi in g.get("input", []):
+        if "name" not in vi or "type" not in vi:
+            raise OnnxSchemaError("graph input missing name/type")
+        tt = vi["type"][0].get("tensor_type")
+        if not tt or "elem_type" not in tt[0]:
+            raise OnnxSchemaError(
+                f"graph input {vi['name'][0]!r}: no tensor elem_type")
+        known.add(vi["name"][0].decode())
+
+    n_nodes = 0
+    for node in g.get("node", []):
+        n_nodes += 1
+        if "op_type" not in node:
+            raise OnnxSchemaError("node without op_type")
+        op = node["op_type"][0].decode()
+        for i in node.get("input", []):
+            nm = i.decode()
+            if nm and nm not in known:
+                raise OnnxSchemaError(
+                    f"node {op}: input {nm!r} is not a graph input, "
+                    "initializer, or earlier node output (graph not "
+                    "topologically valid)")
+        if not node.get("output"):
+            raise OnnxSchemaError(f"node {op}: no outputs")
+        for o in node.get("output", []):
+            known.add(o.decode())
+        for a in node.get("attribute", []):
+            if "name" not in a:
+                raise OnnxSchemaError(f"node {op}: attribute without name")
+            if "type" not in a:
+                raise OnnxSchemaError(
+                    f"node {op}: attribute {a['name'][0]!r} lacks the "
+                    "type discriminator (required since IR v3)")
+            at = a["type"][0]
+            if at not in _ATTR_TYPES:
+                raise OnnxSchemaError(
+                    f"node {op}: attribute type {at} unknown")
+            payload_field = _ATTR_TYPES[at][1]
+            if payload_field and payload_field not in a:
+                raise OnnxSchemaError(
+                    f"node {op}: attribute {a['name'][0]!r} declares "
+                    f"type {_ATTR_TYPES[at][0]} but field "
+                    f"'{payload_field}' is absent")
+
+    outs = g.get("output", [])
+    if not outs:
+        raise OnnxSchemaError("graph has no outputs")
+    for vo in outs:
+        nm = vo["name"][0].decode()
+        if nm not in known:
+            raise OnnxSchemaError(
+                f"graph output {nm!r} is never produced")
+
+    return {
+        "nodes": n_nodes,
+        "initializers": len(g.get("initializer", [])),
+        "inputs": len(g.get("input", [])),
+        "outputs": len(outs),
+        "opset": opset["version"][0],
+        "ir_version": m["ir_version"][0],
+    }
+
+
+def validate_file(path: str) -> dict:
+    with open(path, "rb") as f:
+        return validate(f.read())
