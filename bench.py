@@ -504,11 +504,26 @@ def _bench_decode(on_tpu):
                     run(n_large)
                     t_l = time.perf_counter() - t0
                     step_s = (t_l - t_s) / (n_large - n_small)
-                    swept = weight_bytes + b * cache_len * kv_slot_bytes
+                    # the flash-decode kernel streams ONLY the valid
+                    # prefix (round 5) — when it routes, the per-step
+                    # KV sweep is the average valid length over the
+                    # differential window; the XLA fallback still
+                    # sweeps the full static cache
+                    from paddle_tpu.core.flags import flag as _flag
+                    from paddle_tpu.ops.pallas.decode_attention import \
+                        packed_ok
+                    prefix_aware = (_flag("use_decode_attention_kernel")
+                                    and on_tpu
+                                    and packed_ok(cfg.num_key_value_heads,
+                                                  cfg.head_dim))
+                    avg_valid = prompt + (n_small + n_large) // 2
+                    swept_len = avg_valid if prefix_aware else cache_len
+                    swept = weight_bytes + b * swept_len * kv_slot_bytes
                     last = {
                         "decode_tokens_per_s": round(b / step_s, 1),
                         "step_ms": round(step_s * 1e3, 3),
                         "cache_len": cache_len,
+                        "kv_swept_len": swept_len,
                         "achieved_GBps": round(swept / step_s / 1e9, 1),
                     }
                     break

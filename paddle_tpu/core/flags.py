@@ -85,6 +85,11 @@ define_flag("use_fused_adamw_kernel", False,
             "XLA's update fusions at 0.62B params on v5e, while costing "
             "~520 MB of HBM headroom (layout-conversion copies around "
             "the custom call)")
+define_flag("use_decode_attention_kernel", True,
+            "fused flash-decode attention kernel for cached decode "
+            "(one pass over the cache, prefix-aware streaming — slots "
+            "beyond the valid length are never read); disable to fall "
+            "back to the XLA einsum attention")
 define_flag("use_int8_matmul_kernel", False,
             "route int8-weight linears through the Pallas quantized matmul "
             "(measured at parity with the XLA dequant+matmul on v5; opt-in)")

@@ -375,8 +375,10 @@ class LLMPredictor:
         cache_dtype = jnp.dtype(self.cfg.cache_dtype
                                 or self.cfg.compute_dtype)
         cache_rows = b * k
+        from ..ops.pallas.decode_attention import cache_shape
         kv_s = [jax.ShapeDtypeStruct(
-            (cache_rows, self.max_cache_len, hkv, d), cache_dtype)
+            cache_shape(cache_rows, hkv, self.max_cache_len, d),
+            cache_dtype)
             for _ in range(2 * n_layers)]
 
         def _export(fn, *shapes):

@@ -57,26 +57,29 @@ def _stochastic_round_bf16(x, key):
     Noise economics at 1.1B-param scale: threefry (jax.random.randint)
     costs ~40 ms/step of generation, and a full-size rng_bit_generator
     buffer is a 4.4 GB HBM transient (measured OOM).  Instead ONE small
-    hardware-RBG tile per store is broadcast across rows: every element
-    still sees uniform noise that is fresh each step (per-element
-    unbiasedness needs independence across STEPS, which the per-step
-    key provides; correlation across positions within one step does not
-    bias the EMA means)."""
-    flat = x.reshape(-1)
-    n = flat.shape[0]
-    bits = jax.lax.bitcast_convert_type(flat, jnp.uint32)
+    hardware-RBG tile per store is broadcast across leading dims: every
+    element still sees uniform noise that is fresh each step
+    (per-element unbiasedness needs independence across STEPS, which
+    the per-step key provides; correlation across positions within one
+    step does not bias the EMA means).
+
+    SHAPE-PRESERVING (round 5): the round-4 form flattened x to
+    [-1, 64Ki] around the noise add — on TPU that reshape physically
+    relayouts the tiled array TWICE per moment store, which at 1.1B
+    params was most of the optimizer sweep's 70-109 ms.  The noise tile
+    is now one trailing-shape row broadcast across leading dims — pure
+    elementwise traffic."""
     kd = jax.random.key_data(key).astype(jnp.uint32).reshape(-1)
     seed = jnp.tile(kd, 2)[:4] if kd.size < 4 else kd[:4]
-    _, tile = jax.lax.rng_bit_generator(seed, (_SR_TILE,),
+    x1 = x.reshape(1) if x.ndim == 0 else x
+    bits = jax.lax.bitcast_convert_type(x1, jnp.uint32)  # x's own shape
+    # one trailing row of noise, broadcast (for free, inside the update
+    # fusion) across every leading dim
+    _, tile = jax.lax.rng_bit_generator(seed, x1.shape[-1:],
                                         dtype=jnp.uint32)
-    pad = (-n) % _SR_TILE
-    if pad:
-        bits = jnp.pad(bits, (0, pad))
-    noise2 = (bits.reshape(-1, _SR_TILE) + (tile & jnp.uint32(0xFFFF))
-              [None, :]) & jnp.uint32(0xFFFF0000)
-    out = jax.lax.bitcast_convert_type(noise2.reshape(-1)[:n],
-                                       jnp.float32).astype(jnp.bfloat16)
-    return out.reshape(x.shape)
+    noise = (bits + (tile & jnp.uint32(0xFFFF))) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(noise, jnp.float32) \
+        .astype(jnp.bfloat16).reshape(x.shape)
 
 
 def _store_moment(val_f32, like, key):
@@ -121,28 +124,55 @@ def _functional_adam(p, g, state, lr, hp, key=None):
 
 def _fused_adam_ok(update_fn, hypers, mesh):
     """Route the update sweep through the Pallas fused AdamW kernel:
-    XLA's per-param update fusions measured ~230 GB/s effective on v5e
-    (the AdamW-minus-SGD step delta: ~60 ms at 0.62B params) while the
-    fused kernel streams ~500 GB/s — the sweep is pure HBM traffic, so
-    this halves it.  Single-chip only (a sharded param would need the
-    kernel under shard_map) and decoupled-wd AdamW only (Adam folds wd
-    into the grad, which the kernel does not model)."""
+    XLA's per-param update fusions measured ~170-230 GB/s effective on
+    v5e while the native-shape fused kernel streams near the HBM
+    roofline — the sweep is pure HBM traffic, so this nearly halves it.
+    Round 4's flat-view kernel relayouted every tiled param (~520 MB of
+    copies at 60M params, 89 GB/s effective — worse than XLA); the
+    round-5 kernel grids over the param's OWN 2-D layout, so only
+    natively tileable params route here (``native_tileable``).
+    Single-chip only (a sharded param would need the kernel under
+    shard_map) and decoupled-wd AdamW only (Adam folds wd into the
+    grad, which the kernel does not model).  bf16 moments store via the
+    hardware-PRNG stochastic rounding inside the kernel."""
     from ..core.flags import flag
     from ..ops.pallas._common import on_tpu
-    # per-PARAM moment dtype is checked at the apply site (the kernel
-    # wants fp32 m/v, which every param except bf16-under-
-    # multi_precision=False has)
+    # adamw_rsqrt_update changes the epsilon semantics of the XLA path;
+    # the kernel implements only the reference sqrt form — mixing both
+    # within one model would silently apply two different updates
     return (update_fn is _functional_adam and hypers.get("decoupled")
             and mesh is None and on_tpu()
+            and not flag("adamw_rsqrt_update")
             and bool(flag("use_fused_adamw_kernel")))
 
 
-def _fused_adam_update(p, g, state, lr, hp):
+def _fused_adam_eligible(p, s):
+    """Per-param gate: native 2-D tileable shape, float param, moments in
+    fp32 or bf16 (the kernel's SR path)."""
+    from ..ops.pallas.fused_optimizer import native_tileable
+    if not jnp.issubdtype(p.dtype, jnp.floating):
+        return False
+    if not isinstance(s, dict) or s.get("m") is None:
+        return False
+    if s["m"].dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    return native_tileable(p.shape, p.dtype, s["m"].dtype)
+
+
+def _fused_adam_update(p, g, state, lr, hp, key=None):
     from ..ops.pallas.fused_optimizer import fused_adamw_update
     t = state["t"] + 1
+    seed = None
+    if key is not None and state["m"].dtype == jnp.bfloat16:
+        # i32 scalar seed for the kernel's hardware PRNG (fresh per step
+        # via the step rng key; per-block offsets come from program ids)
+        seed = jax.lax.bitcast_convert_type(
+            jax.random.key_data(key).reshape(-1)[-1].astype(jnp.uint32),
+            jnp.int32)
     p_new, m_new, v_new = fused_adamw_update(
         p, g, state["m"], state["v"], lr, t, beta1=hp["beta1"],
-        beta2=hp["beta2"], epsilon=hp["epsilon"], weight_decay=hp["wd"])
+        beta2=hp["beta2"], epsilon=hp["epsilon"], weight_decay=hp["wd"],
+        seed=seed)
     return p_new, {"m": m_new, "v": v_new, "t": t}
 
 
@@ -367,14 +397,11 @@ class TrainStep:
                     gs = [g * scale.astype(g.dtype) for g in gs]
                 new_p, new_s = [], []
                 for i, (p, g, s) in enumerate(zip(p_vals, gs, opt_in)):
-                    moments_f32 = not (isinstance(s, dict)
-                                       and s.get("m") is not None
-                                       and s["m"].dtype != jnp.float32)
                     fn_i = (_fused_adam_update
-                            if fused_adam and moments_f32
-                            and jnp.issubdtype(p.dtype, jnp.floating)
+                            if fused_adam and _fused_adam_eligible(p, s)
                             else update_fn)
-                    if fn_i is _functional_adam and isinstance(s, dict) \
+                    if fn_i in (_functional_adam, _fused_adam_update) \
+                            and isinstance(s, dict) \
                             and s.get("m") is not None \
                             and s["m"].dtype == jnp.bfloat16:
                         # bf16 moments store via stochastic rounding —

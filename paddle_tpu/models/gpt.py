@@ -258,17 +258,15 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
         import jax
         import jax.numpy as jnp
         from ..core.tensor import Tensor
+        from .generation import cache_prefill_write
         b, s = ids.shape
         pos = unsqueeze(arange(0, s, dtype="int64"), 0)
         x = self.gpt.drop(self.gpt.wte(Tensor(ids)) + self.gpt.wpe(pos))
         out_kvs = []
         for block, (kc, vc) in zip(self.gpt.h, kvs):
             x, (k, v) = block.prefill(x)
-            kc = jax.lax.dynamic_update_slice(
-                kc, k.astype(kc.dtype), (0, 0, 0, 0))
-            vc = jax.lax.dynamic_update_slice(
-                vc, v.astype(vc.dtype), (0, 0, 0, 0))
-            out_kvs.append((kc, vc))
+            out_kvs.append((cache_prefill_write(kc, k),
+                            cache_prefill_write(vc, v)))
         h = self.gpt.ln_f(x)._value
         last = h[jnp.arange(b), lens - 1]
         logits = self.lm_head(Tensor(last[:, None, :]))._value[:, 0]

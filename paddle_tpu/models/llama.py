@@ -164,7 +164,7 @@ class LlamaAttention(nn.Layer):
     def decode_step(self, x, kv, lens):
         """One cached decode step (the masked_multihead_attention role,
         GQA-aware).  x: [B, 1, hidden]; kv: (k_cache, v_cache) static
-        [B, S_max, H_kv, D] buffers; lens: [B] write slot / last valid
+        [B, S_max, H_kv*D] buffers; lens: [B] write slot / last valid
         index.  Returns (out [B, 1, hidden], updated kv)."""
         from .generation import cache_scatter, cached_decode_attention
         k_cache, v_cache = kv
@@ -318,15 +318,11 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
         import jax
         import jax.numpy as jnp
         from ..core.tensor import Tensor
+        from .generation import cache_prefill_write
         b, s = ids.shape
         hidden, new_kvs = self._prefill_hidden(Tensor(ids))
-        out_kvs = []
-        for (kc, vc), (k, v) in zip(kvs, new_kvs):
-            kc = jax.lax.dynamic_update_slice(
-                kc, k.astype(kc.dtype), (0, 0, 0, 0))
-            vc = jax.lax.dynamic_update_slice(
-                vc, v.astype(vc.dtype), (0, 0, 0, 0))
-            out_kvs.append((kc, vc))
+        out_kvs = [(cache_prefill_write(kc, k), cache_prefill_write(vc, v))
+                   for (kc, vc), (k, v) in zip(kvs, new_kvs)]
         h = hidden._value
         last = h[jnp.arange(b), lens - 1]                     # [B, hidden]
         logits = self.lm_head(Tensor(last[:, None, :]))._value[:, 0]

@@ -9,3 +9,4 @@ from . import rope  # noqa: F401
 from . import fused_optimizer  # noqa: F401
 from . import autotune  # noqa: F401
 from . import quantized_matmul  # noqa: F401
+from . import decode_attention  # noqa: F401

@@ -4,47 +4,96 @@ TPU analogue of the reference fused optimizer kernels
 (``paddle/phi/kernels/gpu/adamw_kernel.cu`` — one kernel updates p/m/v in
 place).  A single elementwise pass reads grad + states once from HBM and
 writes the three outputs, with ``input_output_aliases`` donating the
-buffers (no extra HBM traffic for the copies XLA would otherwise emit).
-Inside jit/TrainStep XLA's fusion already produces an equivalent fused
-loop, so the compiled training path does not route through this kernel;
-it is exposed as a standalone building block (and autotune-harness
-reference) for schedules that update parameters outside a compiled step.
+buffers.
+
+Two call forms:
+
+- **Native-shape (the training path)**: the kernel grids over 2-D blocks
+  of the param's OWN [M, N] shape.  This is the round-5 fix for the
+  round-4 finding that the fused kernel collapsed to 89 GB/s at 60M
+  params: the old flat form ``p.reshape(-1).reshape(-1, 512)`` forces a
+  physical relayout of every tiled TPU array on the way in AND out
+  (~520 MB of copies at 60M params).  Operating on the native shape
+  keeps the custom call layout-identical to the surrounding program, so
+  the only HBM traffic is the update sweep itself.
+- **Flat (legacy/odd shapes)**: 1-D view in [rows, 512] blocks; kept for
+  params whose shape cannot tile (odd dims, tiny vectors).
+
+bf16 moments (the reference ``multi_precision=False`` contract) store
+via the hardware PRNG: ``pltpu.stochastic_round`` with fresh
+``prng_random_bits`` per element — stronger than the broadcast-RBG-tile
+scheme the XLA path uses (jit/train_step.py), at zero HBM cost.
+Interpret mode (CPU CI) has no PRNG lowering and falls back to
+round-to-nearest-even there; parity tests compare against the f32
+reference with bf16-ULP tolerance.
 """
 
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from ._common import on_tpu
 
 
-def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, t_ref,
-                  p_out, m_out, v_out, *, beta1, beta2, epsilon, wd):
-    p = p_ref[:].astype(jnp.float32)
-    g = g_ref[:].astype(jnp.float32)
-    m = m_ref[:]
-    v = v_ref[:]
-    lr = lr_ref[0, 0]  # (1,1) scalar ref: Mosaic rejects 1-D scalar blocks
-    t = t_ref[0, 0]
+def _adamw_math(p, g, m, v, lr, t, *, beta1, beta2, epsilon, wd):
+    """Shared fp32 update math (must mirror jit/train_step.py
+    ``_functional_adam`` decoupled branch exactly)."""
     p = p * (1.0 - lr * wd)
     m_new = beta1 * m + (1.0 - beta1) * g
     v_new = beta2 * v + (1.0 - beta2) * g * g
     # beta ** t via exp/log: Mosaic has no dynamic-exponent pow lowering.
     # beta==0 is legal (0**t == 0 for t>=1, so the bias-correction
     # denominator is exactly 1.0) but log(0) raises at trace time.
-    import math
     b1t = jnp.exp(t * math.log(beta1)) if beta1 > 0 else jnp.float32(0.0)
     b2t = jnp.exp(t * math.log(beta2)) if beta2 > 0 else jnp.float32(0.0)
     m_hat = m_new / (1.0 - b1t)
     v_hat = v_new / (1.0 - b2t)
-    p_out[:] = (p - lr * m_hat / (jnp.sqrt(v_hat) + epsilon)) \
-        .astype(p_out.dtype)
-    m_out[:] = m_new
-    v_out[:] = v_new
+    p_new = p - lr * m_hat / (jnp.sqrt(v_hat) + epsilon)
+    return p_new, m_new, v_new
+
+
+def _store(ref, val_f32, sr: bool):
+    if ref.dtype == jnp.bfloat16 and sr:
+        bits = pltpu.bitcast(pltpu.prng_random_bits(val_f32.shape),
+                             jnp.uint32)
+        ref[:] = pltpu.stochastic_round(val_f32, bits,
+                                        target_dtype=jnp.bfloat16)
+    else:
+        ref[:] = val_f32.astype(ref.dtype)
+
+
+def _adamw_kernel(seed_ref, p_ref, g_ref, m_ref, v_ref, lr_ref, t_ref,
+                  p_out, m_out, v_out, *, beta1, beta2, epsilon, wd, sr,
+                  grid_ndim=2):
+    if sr:
+        # fresh stream per block; per-step freshness comes from the seed
+        # (derived from the TrainStep rng key).  Mosaic takes at most two
+        # seed words — fold the grid position into one
+        if grid_ndim == 2:
+            bid = (pl.program_id(0) * pl.num_programs(1)
+                   + pl.program_id(1))
+        elif grid_ndim == 1:
+            bid = pl.program_id(0)
+        else:
+            bid = 0
+        pltpu.prng_seed(seed_ref[0, 0], bid)
+    p = p_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    m = m_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    lr = lr_ref[0, 0]  # (1,1) scalar ref: Mosaic rejects 1-D scalar blocks
+    t = t_ref[0, 0]
+    p_new, m_new, v_new = _adamw_math(p, g, m, v, lr, t, beta1=beta1,
+                                      beta2=beta2, epsilon=epsilon, wd=wd)
+    p_out[:] = p_new.astype(p_out.dtype)
+    _store(m_out, m_new, sr)
+    _store(v_out, v_new, sr)
 
 
 def adamw_sig(numel, dtype):
@@ -52,16 +101,86 @@ def adamw_sig(numel, dtype):
     return f"{numel}/{np.dtype(dtype)}"
 
 
+def adamw2d_sig(shape, p_dtype, m_dtype):
+    import numpy as np
+    return (f"{shape[0]}x{shape[1]}/{np.dtype(p_dtype)}/"
+            f"{np.dtype(m_dtype)}")
+
+
 _LANES = 512  # row width of the internal 2-D view (Mosaic-friendly)
+_BLOCK_ELEMS = 1 << 17  # default elems per grid block (~VMEM-bounded)
+
+
+def _sublane(dtype):
+    return {2: 16, 4: 8, 1: 32}[jnp.dtype(dtype).itemsize]
+
+
+def native_tileable(shape, p_dtype, m_dtype) -> bool:
+    """Can the param update run on its native [M, N] layout?  Needs a
+    2-D shape whose dims admit aligned blocks (N a multiple of 128, M a
+    multiple of the widest sublane count among the dtypes involved)."""
+    if len(shape) != 2:
+        return False
+    m_dim, n = shape
+    sub = max(_sublane(p_dtype), _sublane(m_dtype))
+    return n % 128 == 0 and m_dim % sub == 0 and m_dim >= sub
+
+
+def _pick_blocks(m_dim, n, p_dtype, m_dtype, target=_BLOCK_ELEMS):
+    """(bm, bn) dividing (M, N) with bm sublane-aligned and bm*bn near
+    the VMEM-bounded target."""
+    sub = max(_sublane(p_dtype), _sublane(m_dtype))
+    bn = n
+    for cand in (512, 256, 128):
+        if n % cand == 0 and n > cand:
+            bn = cand
+            break
+    if n <= 512:
+        bn = n
+    bm = sub
+    while bm * 2 <= m_dim and m_dim % (bm * 2) == 0 and \
+            (bm * 2) * bn <= target:
+        bm *= 2
+    return bm, bn
+
+
+def _adamw_call_2d(p, g, m, v, lr_arr, t_arr, seed_arr, *, beta1, beta2,
+                   epsilon, wd, sr, blocks=None):
+    """Native-shape update: grid over (M//bm, N//bn) blocks of the
+    param's own 2-D layout — zero relayout copies."""
+    m_dim, n = p.shape
+    if blocks is None:
+        from .schedule_search import get_schedule
+        hit = get_schedule("fused_adamw2d",
+                           adamw2d_sig(p.shape, p.dtype, m.dtype))
+        blocks = (int(hit[0]), int(hit[1])) if hit else None
+    bm, bn = blocks if blocks else _pick_blocks(m_dim, n, p.dtype, m.dtype)
+    kernel = functools.partial(_adamw_kernel, beta1=beta1, beta2=beta2,
+                               epsilon=epsilon, wd=wd,
+                               sr=sr and on_tpu())
+    spec = pl.BlockSpec((bm, bn), lambda i, j: (i, j))
+    scalar = pl.BlockSpec((1, 1), lambda i, j: (0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(m_dim // bm, n // bn),
+        in_specs=[scalar, spec, spec, spec, spec, scalar, scalar],
+        out_specs=[spec, spec, spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(p.shape, p.dtype),
+            jax.ShapeDtypeStruct(m.shape, m.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        input_output_aliases={1: 0, 3: 1, 4: 2},
+        interpret=not on_tpu(),
+    )(seed_arr, p, g, m, v, lr_arr, t_arr)
 
 
 def _adamw_call(flat_p, flat_g, flat_m, flat_v, lr_arr, t_arr,
                 beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.01,
-                chunk=None):
-    """chunk=0/None-with-no-winner: whole-array kernel; chunk>0: grid over
-    row blocks of ``chunk`` elements (bounded VMEM per program — the
-    searchable schedule).  Internally the flat arrays are viewed as
-    [rows, 512]: Mosaic wants >=2-D lane-tiled refs on TPU."""
+                chunk=None, seed_arr=None, sr=False):
+    """Flat legacy form: the 1-D arrays are viewed as [rows, 512] (this
+    RELAYOUTS tiled inputs — use the native 2-D path for hot params).
+    chunk=0: whole-array kernel; chunk>0: grid over row blocks."""
     numel = flat_p.shape[0]
     if chunk is None:
         from .schedule_search import get_schedule
@@ -70,19 +189,20 @@ def _adamw_call(flat_p, flat_g, flat_m, flat_v, lr_arr, t_arr,
             chunk = int(hit)
         else:
             # untuned default: bounded chunk — the whole-array form is
-            # VMEM-infeasible beyond ~1M params (measured; BASELINE.md).
-            # Per 512-lane row the kernel stages p+g+m+v in, p+m+v out,
-            # double-buffered: ~22.5 KB/row at bf16 params — 256-row
-            # blocks (128Ki elements) stay under ~6 MB of the 16 MB
-            # scoped VMEM (a 1024-row block OOMed at 22 MB on v5e)
+            # VMEM-infeasible beyond ~1M params (measured; BASELINE.md)
             chunk = 0 if numel <= (1 << 18) else (1 << 17)
-    kernel = functools.partial(_adamw_kernel, beta1=beta1, beta2=beta2,
-                               epsilon=epsilon, wd=wd)
+    if seed_arr is None:
+        seed_arr = jnp.zeros((1, 1), jnp.int32)
+
+    def kern(ndim):
+        return functools.partial(_adamw_kernel, beta1=beta1, beta2=beta2,
+                                 epsilon=epsilon, wd=wd,
+                                 sr=sr and on_tpu(), grid_ndim=ndim)
 
     # pad up to a whole number of row BLOCKS (not merely lanes): odd
     # param sizes would otherwise force tiny non-tileable row blocks
-    # (Mosaic needs the sublane dim divisible by the dtype tile: 16 for
-    # bf16) — the padded tail computes garbage that is sliced away
+    # (Mosaic needs the sublane dim divisible by the dtype tile) — the
+    # padded tail computes garbage that is sliced away
     row_blk = max(16, min(1 << 14, chunk // _LANES)) if chunk else 0
     blk_elems = (row_blk or 1) * _LANES
     pad = (-numel) % blk_elems
@@ -96,42 +216,53 @@ def _adamw_call(flat_p, flat_g, flat_m, flat_v, lr_arr, t_arr,
     rows = p2.shape[0]
     out_shapes = [
         jax.ShapeDtypeStruct(p2.shape, p2.dtype),
-        jax.ShapeDtypeStruct(p2.shape, jnp.float32),
-        jax.ShapeDtypeStruct(p2.shape, jnp.float32),
+        jax.ShapeDtypeStruct(p2.shape, m2.dtype),
+        jax.ShapeDtypeStruct(p2.shape, v2.dtype),
     ]
     if not row_blk or row_blk >= rows:
         outs = pl.pallas_call(
-            kernel,
+            kern(0),
             out_shape=out_shapes,
-            input_output_aliases={0: 0, 2: 1, 3: 2},
+            input_output_aliases={1: 0, 3: 1, 4: 2},
             interpret=not on_tpu(),
-        )(p2, g2, m2, v2, lr_arr, t_arr)
+        )(seed_arr, p2, g2, m2, v2, lr_arr, t_arr)
     else:
         spec = pl.BlockSpec((row_blk, _LANES), lambda i: (i, 0))
         scalar = pl.BlockSpec((1, 1), lambda i: (0, 0))
         outs = pl.pallas_call(
-            kernel,
+            kern(1),
             grid=(rows // row_blk,),
-            in_specs=[spec, spec, spec, spec, scalar, scalar],
+            in_specs=[scalar, spec, spec, spec, spec, scalar, scalar],
             out_specs=[spec, spec, spec],
             out_shape=out_shapes,
-            input_output_aliases={0: 0, 2: 1, 3: 2},
+            input_output_aliases={1: 0, 3: 1, 4: 2},
             interpret=not on_tpu(),
-        )(p2, g2, m2, v2, lr_arr, t_arr)
+        )(seed_arr, p2, g2, m2, v2, lr_arr, t_arr)
     return tuple(o.reshape(-1)[:numel] for o in outs)
 
 
 def fused_adamw_update(p, g, m, v, lr, step, beta1=0.9, beta2=0.999,
-                       epsilon=1e-8, weight_decay=0.01, chunk=None):
-    """One fused AdamW step.  p/g: param dtype; m/v: fp32 moments;
-    lr: scalar; step: 1-based int step count.  Returns (p', m', v')."""
-    flat_p = p.reshape(-1)
-    flat_g = g.reshape(-1)
-    flat_m = m.reshape(-1)
-    flat_v = v.reshape(-1)
-    lr_arr = jnp.asarray([[lr]], jnp.float32)
-    t_arr = jnp.asarray([[step]], jnp.float32)
-    p2, m2, v2 = _adamw_call(flat_p, flat_g, flat_m, flat_v, lr_arr, t_arr,
-                             beta1=beta1, beta2=beta2, epsilon=epsilon,
-                             wd=weight_decay, chunk=chunk)
+                       epsilon=1e-8, weight_decay=0.01, chunk=None,
+                       seed=None):
+    """One fused AdamW step.  p/g: param dtype; m/v: fp32 or bf16
+    moments (bf16 stores via hardware stochastic rounding when ``seed``
+    is given); lr: scalar; step: 1-based int step count.  Returns
+    (p', m', v') with the INPUT shapes and dtypes.
+
+    2-D params with tileable dims run on their native layout (no
+    relayout); everything else takes the flat path.
+    """
+    lr_arr = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+    t_arr = jnp.asarray(step, jnp.float32).reshape(1, 1)
+    sr = seed is not None and (m.dtype == jnp.bfloat16 or
+                               v.dtype == jnp.bfloat16)
+    seed_arr = (jnp.asarray(seed, jnp.int32).reshape(1, 1) if seed is not None
+                else jnp.zeros((1, 1), jnp.int32))
+    kw = dict(beta1=beta1, beta2=beta2, epsilon=epsilon, wd=weight_decay)
+    if native_tileable(p.shape, p.dtype, m.dtype) and chunk is None:
+        return tuple(_adamw_call_2d(p, g, m, v, lr_arr, t_arr, seed_arr,
+                                    sr=sr, **kw))
+    p2, m2, v2 = _adamw_call(p.reshape(-1), g.reshape(-1), m.reshape(-1),
+                             v.reshape(-1), lr_arr, t_arr, chunk=chunk,
+                             seed_arr=seed_arr, sr=sr, **kw)
     return p2.reshape(p.shape), m2.reshape(m.shape), v2.reshape(v.shape)

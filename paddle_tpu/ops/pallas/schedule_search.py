@@ -25,7 +25,8 @@ from .autotune import _sync, _time_once, persistent_get, persistent_put
 
 __all__ = ["chip_kind", "get_schedule", "put_schedule", "tune_kernel",
            "tune_rms_norm", "tune_rope", "tune_quantized_matmul",
-           "tune_fused_adamw", "tune_bench_shapes"]
+           "tune_fused_adamw", "tune_decode_attention",
+           "tune_bench_shapes"]
 
 
 def chip_kind() -> str:
@@ -243,6 +244,29 @@ def tune_fused_adamw(numel: int, dtype="bfloat16", iters: int = 3):
         cands, (p, g, m, v, lr, t), iters=iters, default=default)
 
 
+def tune_decode_attention(b=32, hkv=8, g=4, s=2048, d=64,
+                          dtype="bfloat16", iters: int = 3):
+    """Search the DMA chunk size (cache slots) of the flash-decode
+    attention kernel at a serving shape (full-prefix worst case)."""
+    import jax.numpy as jnp
+
+    from .decode_attention import (_decode_attention_pallas,
+                                   decode_attn_sig, DEFAULT_CHUNK)
+    rng = np.random.default_rng(0)
+    w = hkv * d
+    q4 = jnp.asarray(rng.standard_normal((b, hkv, g, d)), dtype)
+    kc = jnp.asarray(rng.standard_normal((b, s, w)), dtype)
+    vc = jnp.asarray(rng.standard_normal((b, s, w)), dtype)
+    lens = jnp.full((b,), s - 8, jnp.int32)
+    cands = [c for c in (128, 256, 512, 1024) if s % c == 0]
+    default = DEFAULT_CHUNK if s % DEFAULT_CHUNK == 0 else cands[0]
+    return tune_kernel(
+        "decode_attention", decode_attn_sig(b, hkv, g, s, d, q4.dtype),
+        lambda chunk: functools.partial(_decode_attention_pallas,
+                                        chunk=chunk),
+        cands, (q4, kc, vc, lens), iters=iters, default=default)
+
+
 def tune_bench_shapes(iters: int = 3) -> Dict[str, Tuple]:
     """Search every kernel at its bench.py / flagship-model shapes.
     Returns {kernel/sig: (best, table)} for reporting."""
@@ -253,4 +277,6 @@ def tune_bench_shapes(iters: int = 3) -> Dict[str, Tuple]:
     out["quantized_matmul/2048x2048x8192"] = tune_quantized_matmul(
         2048, 2048, 8192, iters=iters)
     out["fused_adamw/4194304"] = tune_fused_adamw(1 << 22, iters=iters)
+    out["decode_attention/32x8x4x2048x64"] = tune_decode_attention(
+        iters=iters)
     return out

@@ -614,3 +614,171 @@ def test_tune_kernel_picks_fastest(tmp_path, monkeypatch):
     assert best == 16
     assert ss.get_schedule("fake", "sig") == 16
     assert len(table) == 3
+
+
+# ---------------------------------------------------------------------------
+# round 5: native-shape fused AdamW + flash-decode attention
+# ---------------------------------------------------------------------------
+
+def _ref_adamw(p, g, m, v, lr, t, b1, b2, eps, wd):
+    pf = p.astype(jnp.float32) * (1 - lr * wd)
+    mr = b1 * m.astype(jnp.float32) + (1 - b1) * g.astype(jnp.float32)
+    vr = b2 * v.astype(jnp.float32) + (1 - b2) * \
+        g.astype(jnp.float32) ** 2
+    mh = mr / (1 - b1 ** t)
+    vh = vr / (1 - b2 ** t)
+    return pf - lr * mh / (jnp.sqrt(vh) + eps), mr, vr
+
+
+@pytest.mark.parametrize("pdt,mdt", [("float32", "float32"),
+                                     ("bfloat16", "float32"),
+                                     ("bfloat16", "bfloat16")])
+def test_fused_adamw_native_2d(pdt, mdt):
+    """The round-5 native-shape path: 2-D params update on their own
+    layout (no flatten/relayout); bf16 moments store via SR on TPU and
+    RNE in interpret mode — compared at bf16-ULP tolerance."""
+    rng = np.random.default_rng(8)
+    shape = (64, 256)
+    p = jnp.asarray(rng.standard_normal(shape), pdt)
+    g = jnp.asarray(rng.standard_normal(shape), pdt) * 0.1
+    m = jnp.asarray(rng.standard_normal(shape), mdt) * 0.01
+    v = jnp.abs(jnp.asarray(rng.standard_normal(shape), mdt)) * 0.01
+    lr, b1, b2, eps, wd = 1e-3, 0.9, 0.999, 1e-8, 0.01
+    assert fo.native_tileable(shape, jnp.dtype(pdt), jnp.dtype(mdt))
+    p2, m2, v2 = fo.fused_adamw_update(p, g, m, v, lr, 4, b1, b2, eps,
+                                       wd, seed=11)
+    assert p2.shape == shape and p2.dtype == jnp.dtype(pdt)
+    assert m2.dtype == jnp.dtype(mdt)
+    pr, mr, vr = _ref_adamw(p, g, m, v, lr, 4, b1, b2, eps, wd)
+    tol = 1e-6 if pdt == "float32" and mdt == "float32" else 1.5e-2
+    np.testing.assert_allclose(np.asarray(p2, np.float32),
+                               np.asarray(pr, np.float32), atol=tol)
+    np.testing.assert_allclose(np.asarray(m2, np.float32),
+                               np.asarray(mr, np.float32), atol=tol)
+    np.testing.assert_allclose(np.asarray(v2, np.float32),
+                               np.asarray(vr, np.float32), atol=tol)
+
+
+def test_fused_adamw_native_vs_flat_same_values():
+    """The native 2-D grid and the legacy flat view are the same math."""
+    rng = np.random.default_rng(9)
+    shape = (32, 512)
+    p = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    g = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    m = jnp.zeros(shape, jnp.float32)
+    v = jnp.zeros(shape, jnp.float32)
+    nat = fo.fused_adamw_update(p, g, m, v, 1e-3, 2)
+    flat = fo.fused_adamw_update(p.reshape(-1), g.reshape(-1),
+                                 m.reshape(-1), v.reshape(-1), 1e-3, 2)
+    for a, b in zip(nat, flat):
+        np.testing.assert_allclose(np.asarray(a).reshape(-1),
+                                   np.asarray(b), rtol=1e-6)
+
+
+def test_native_tileable_gate():
+    bf, f32 = jnp.bfloat16, jnp.float32
+    assert fo.native_tileable((32000, 2048), bf, bf)
+    assert fo.native_tileable((2048, 8192), bf, f32)
+    assert not fo.native_tileable((2048,), bf, bf)        # 1-D
+    assert not fo.native_tileable((100, 7), f32, f32)     # N % 128
+    assert not fo.native_tileable((30, 256), bf, bf)      # M % 16
+    assert not fo.native_tileable((8, 128, 2), f32, f32)  # 3-D
+
+
+def _ref_decode_attention(q4, kc, vc, lens):
+    from paddle_tpu.ops.pallas.decode_attention import \
+        _decode_attention_xla
+    return _decode_attention_xla(q4, kc, vc, lens)
+
+
+@pytest.mark.parametrize("b,hkv,g,s,d", [
+    (2, 2, 4, 256, 64),    # GQA
+    (3, 2, 1, 128, 64),    # MHA (group 1)
+    (1, 4, 2, 512, 32),    # b1 serving, 4 heads per lane group
+])
+def test_decode_attention_kernel_parity(b, hkv, g, s, d):
+    """Flash-decode kernel (interpret mode) vs the XLA einsum reference
+    over ragged valid lengths — including the prefix-aware chunk loop
+    (slots past lens must not affect the result)."""
+    from paddle_tpu.ops.pallas.decode_attention import \
+        _decode_attention_pallas
+    rng = np.random.default_rng(10)
+    w = hkv * d
+    q4 = jnp.asarray(rng.standard_normal((b, hkv, g, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((b, s, w)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, s, w)), jnp.float32)
+    lens = jnp.asarray(rng.integers(0, s, (b,)), jnp.int32)
+    out = _decode_attention_pallas(q4, kc, vc, lens, chunk=64)
+    ref = _ref_decode_attention(q4, kc, vc, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_decode_attention_ignores_stale_tail():
+    """Garbage beyond the valid prefix must not leak into the output —
+    the masking contract the prefix-aware streaming relies on."""
+    from paddle_tpu.ops.pallas.decode_attention import \
+        _decode_attention_pallas
+    rng = np.random.default_rng(11)
+    b, hkv, g, s, d = 2, 2, 2, 256, 64
+    w = hkv * d
+    q4 = jnp.asarray(rng.standard_normal((b, hkv, g, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((b, s, w)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, s, w)), jnp.float32)
+    lens = jnp.asarray([100, 17], jnp.int32)
+    out1 = _decode_attention_pallas(q4, kc, vc, lens, chunk=64)
+    big = 1e6
+    kc2 = kc.at[:, 120:].set(big)
+    vc2 = vc.at[:, 120:].set(-big)
+    out2 = _decode_attention_pallas(q4, kc2, vc2, lens, chunk=64)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               atol=1e-5)
+
+
+def test_decode_attention_public_layout():
+    """decode_attention takes q [B, Hq, D] and returns [B, Hq*D] in
+    q.dtype, matching models/generation.cached_decode_attention; both
+    packed [B, S, W] and fallback [B, S, H, D] caches are accepted."""
+    from paddle_tpu.ops.pallas.decode_attention import (cache_shape,
+                                                        decode_attention)
+    rng = np.random.default_rng(12)
+    b, hq, hkv, s, d = 2, 4, 2, 128, 64
+    q = jnp.asarray(rng.standard_normal((b, hq, d)), jnp.float32)
+    shape = cache_shape(b, hkv, s, d)
+    assert shape == (b, s, hkv * d)           # geometry packs
+    kc = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    lens = jnp.asarray([5, 100], jnp.int32)
+    out = decode_attention(q, kc, vc, lens)
+    assert out.shape == (b, hq * d)
+    q4 = q.reshape(b, hkv, hq // hkv, d)
+    ref = _ref_decode_attention(q4, kc, vc, lens).reshape(b, hq * d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    # odd geometry falls back to the unpacked cache + XLA path
+    assert cache_shape(2, 3, 128, 24) == (2, 128, 3, 24)
+
+
+def test_decode_attention_wide_gqa_falls_back():
+    """GQA group > 8 (more q heads per KV head than a q_cat block) must
+    fall back to XLA instead of crashing in _build_qcat."""
+    from paddle_tpu.ops.pallas.decode_attention import (decode_attention,
+                                                        should_use_pallas)
+    rng = np.random.default_rng(13)
+    b, hq, hkv, s, d = 2, 32, 2, 128, 64     # g = 16
+    q = jnp.asarray(rng.standard_normal((b, hq, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((b, s, hkv * d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, s, hkv * d)), jnp.float32)
+    q4 = q.reshape(b, hkv, hq // hkv, d)
+    assert not should_use_pallas(q4, kc)
+    out = decode_attention(q, kc, vc, jnp.asarray([3, 100], jnp.int32))
+    assert out.shape == (b, hq * d)
+
+
+def test_stochastic_round_preserves_shape():
+    from paddle_tpu.jit.train_step import _stochastic_round_bf16
+    key = jax.random.PRNGKey(0)
+    for shape in [(), (7,), (16, 128), (3, 5, 64)]:
+        x = jnp.ones(shape, jnp.float32) * 1.2345
+        out = _stochastic_round_bf16(x, key)
+        assert out.shape == shape and out.dtype == jnp.bfloat16

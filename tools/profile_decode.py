@@ -1,0 +1,63 @@
+"""Per-op device-time table for the b32 cached decode step (round-5
+target: lift b32 decode from ~440 GB/s aggregate toward the roofline).
+
+Profiles one generate() call (prefill + 64-step scan) and prints the
+per-op table; rows inside the decode ``while``/scan body dominate, so
+dividing by the step count gives per-token cost attribution.
+"""
+import sys
+
+sys.path.insert(0, "/root/repo")
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+STEPS = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+CACHE = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
+INT8 = len(sys.argv) > 4 and sys.argv[4] == "int8"
+
+cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                  intermediate_size=8192, num_hidden_layers=16,
+                  num_attention_heads=32, num_key_value_heads=8,
+                  max_position_embeddings=4096)
+paddle.seed(0)
+model = LlamaForCausalLM(cfg)
+model.eval()
+if INT8:
+    from paddle_tpu.quantization import weight_only_quantize
+    weight_only_quantize(model, skip=lambda name, l: name == "lm_head")
+    paddle.set_flags({"FLAGS_use_int8_matmul_kernel": True})
+rng = np.random.default_rng(0)
+ids = paddle.to_tensor(
+    rng.integers(0, cfg.vocab_size, (B, 128)).astype(np.int32))
+
+def run():
+    toks = model.generate(ids, max_new_tokens=STEPS, max_cache_len=CACHE,
+                          compute_dtype="bfloat16")
+    np.asarray(toks._value)
+
+run()  # compile + warm
+
+import tempfile
+
+import jax
+
+tdir = tempfile.mkdtemp(prefix="prof_decode_")
+jax.profiler.start_trace(tdir)
+run()
+jax.profiler.stop_trace()
+
+from paddle_tpu import profiler
+
+rows = profiler.DeviceSummaryView(tdir).rows()
+rows = [r for r in rows
+        if not (r["name"].startswith("jit_") or r["name"].isdigit())]
+total = sum(r["total_ms"] for r in rows)
+print(f"b={B} steps={STEPS} cache={CACHE} int8={INT8}; "
+      f"total device ms: {total:.2f} (/{STEPS} steps = "
+      f"{total/STEPS:.3f} ms/step incl prefill)")
+for r in sorted(rows, key=lambda r: -r["total_ms"])[:45]:
+    print(f'{r["total_ms"]:9.3f} ms  {100*r["total_ms"]/total:5.1f}%  '
+          f'x{r["calls"]:<5} {r["name"][:90]}')
