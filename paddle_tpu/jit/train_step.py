@@ -45,9 +45,6 @@ def _functional_momentum(p, g, state, lr, hp):
     return p_new, {"velocity": v_new}
 
 
-_SR_TILE = 1 << 16  # 64Ki u32 = 256 KB of noise per draw
-
-
 def _stochastic_round_bf16(x, key):
     """Unbiased f32 -> bf16: add uniform 16-bit noise below the bf16
     mantissa boundary, then truncate (E[result] == x; plain

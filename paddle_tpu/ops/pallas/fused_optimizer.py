@@ -112,7 +112,7 @@ _BLOCK_ELEMS = 1 << 17  # default elems per grid block (~VMEM-bounded)
 
 
 def _sublane(dtype):
-    return {2: 16, 4: 8, 1: 32}[jnp.dtype(dtype).itemsize]
+    return {2: 16, 4: 8, 1: 32}.get(jnp.dtype(dtype).itemsize)
 
 
 def native_tileable(shape, p_dtype, m_dtype) -> bool:
@@ -121,8 +121,11 @@ def native_tileable(shape, p_dtype, m_dtype) -> bool:
     multiple of the widest sublane count among the dtypes involved)."""
     if len(shape) != 2:
         return False
+    subs = (_sublane(p_dtype), _sublane(m_dtype))
+    if None in subs:      # e.g. f64 under x64 — no tiling rule, fall back
+        return False
     m_dim, n = shape
-    sub = max(_sublane(p_dtype), _sublane(m_dtype))
+    sub = max(subs)
     return n % 128 == 0 and m_dim % sub == 0 and m_dim >= sub
 
 

@@ -25,8 +25,8 @@ from .autotune import _sync, _time_once, persistent_get, persistent_put
 
 __all__ = ["chip_kind", "get_schedule", "put_schedule", "tune_kernel",
            "tune_rms_norm", "tune_rope", "tune_quantized_matmul",
-           "tune_fused_adamw", "tune_decode_attention",
-           "tune_bench_shapes"]
+           "tune_fused_adamw", "tune_fused_adamw2d",
+           "tune_decode_attention", "tune_bench_shapes"]
 
 
 def chip_kind() -> str:
@@ -244,6 +244,38 @@ def tune_fused_adamw(numel: int, dtype="bfloat16", iters: int = 3):
         cands, (p, g, m, v, lr, t), iters=iters, default=default)
 
 
+def tune_fused_adamw2d(shape=(7296, 8192), p_dtype="bfloat16",
+                       m_dtype="bfloat16", iters: int = 3):
+    """Search the (bm, bn) grid blocks of the native-shape fused AdamW
+    update at a large-param shape."""
+    import jax.numpy as jnp
+
+    from .fused_optimizer import (_adamw_call_2d, _pick_blocks,
+                                  adamw2d_sig)
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(rng.standard_normal(shape), p_dtype)
+    g = jnp.asarray(rng.standard_normal(shape), p_dtype)
+    m = jnp.zeros(shape, m_dtype)
+    v = jnp.zeros(shape, m_dtype)
+    lr = jnp.asarray([[1e-3]], jnp.float32)
+    t = jnp.asarray([[1.0]], jnp.float32)
+    seed = jnp.asarray([[7]], jnp.int32)
+    m_dim, n = shape
+    bm_c = [bm for bm in (64, 128, 256, 512) if m_dim % bm == 0]
+    bn_c = [bn for bn in (128, 256, 512) if n % bn == 0]
+    cands = [(bm, bn) for bm in bm_c for bn in bn_c]
+    default = _pick_blocks(m_dim, n, jnp.dtype(p_dtype),
+                           jnp.dtype(m_dtype))
+    if default not in cands:
+        cands.append(default)
+    kw = dict(beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.01, sr=True)
+    return tune_kernel(
+        "fused_adamw2d", adamw2d_sig(shape, p.dtype, m.dtype),
+        lambda bm, bn: functools.partial(_adamw_call_2d,
+                                         blocks=(bm, bn), **kw),
+        cands, (p, g, m, v, lr, t, seed), iters=iters, default=default)
+
+
 def tune_decode_attention(b=32, hkv=8, g=4, s=2048, d=64,
                           dtype="bfloat16", iters: int = 3):
     """Search the DMA chunk size (cache slots) of the flash-decode
@@ -277,6 +309,7 @@ def tune_bench_shapes(iters: int = 3) -> Dict[str, Tuple]:
     out["quantized_matmul/2048x2048x8192"] = tune_quantized_matmul(
         2048, 2048, 8192, iters=iters)
     out["fused_adamw/4194304"] = tune_fused_adamw(1 << 22, iters=iters)
+    out["fused_adamw2d/7296x8192"] = tune_fused_adamw2d(iters=iters)
     out["decode_attention/32x8x4x2048x64"] = tune_decode_attention(
         iters=iters)
     return out

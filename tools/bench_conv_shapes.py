@@ -1,0 +1,119 @@
+"""Per-shape conv benchmark for the ResNet-50 b128 training mix.
+
+Round-5 evidence gathering for VERDICT r4 weak #1: conv fusions run at
+89 TF/s ~= 45% of nominal across the fwd/dgrad/wgrad mix while square
+microbenchmarks reach 130-137.  This tool times each distinct conv
+shape class of ResNet-50 in all three roles so the slow class can be
+attacked specifically (Pallas kernel or algebraic decomposition)
+instead of guessing.
+
+fwd:   y = conv(x, w)                      [N,Cin,H,W] x [Cout,Cin,k,k]
+dgrad: dx = conv_transpose-like            (lhs_dilation=stride)
+wgrad: dw = conv(x, dy) contraction over batch+spatial
+
+Each is timed as the ACTUAL XLA HLO the training step produces (via
+jax.vjp on conv_general_dilated), device-amortized in one jitted chain,
+differential between two chain lengths.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# (name, Cin, Cout, k, stride, H_in)  — b128 224^2 ResNet-50 classes
+SHAPES = [
+    ("stem7x7s2", 3, 64, 7, 2, 224),
+    ("s1_1x1a", 64, 64, 1, 1, 56),
+    ("s1_3x3", 64, 64, 3, 1, 56),
+    ("s1_1x1b", 64, 256, 1, 1, 56),
+    ("s2_1x1a", 256, 128, 1, 1, 56),
+    ("s2_3x3s2", 128, 128, 3, 2, 56),
+    ("s2_1x1b", 128, 512, 1, 1, 28),
+    ("s2_down", 256, 512, 1, 2, 56),
+    ("s3_3x3", 256, 256, 3, 1, 14),
+    ("s3_3x3s2", 256, 256, 3, 2, 28),
+    ("s4_3x3", 512, 512, 3, 1, 7),
+    ("s4_1x1b", 512, 2048, 1, 1, 7),
+]
+
+
+def conv_fn(w_shape, stride, pad):
+    import jax
+
+    def f(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=(stride, stride), padding=pad,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return f
+
+
+def time_chain(fn, args, ks=(4, 16)):
+    import jax
+    import jax.numpy as jnp
+
+    def make(n):
+        def run(*a):
+            def body(c, _):
+                out = fn(a[0] + c.astype(a[0].dtype), *a[1:])
+                s = out[0].ravel()[0] if isinstance(out, tuple) \
+                    else out.ravel()[0]
+                return s.astype(jnp.float32) * 1e-9, ()
+            return jax.lax.scan(body, jnp.float32(0), None, length=n)[0]
+        return jax.jit(run)
+    f1, f2 = make(ks[0]), make(ks[1])
+    np.asarray(f1(*args)); np.asarray(f2(*args))
+    t0 = time.perf_counter(); np.asarray(f1(*args))
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter(); np.asarray(f2(*args))
+    t2 = time.perf_counter() - t0
+    return (t2 - t1) / (ks[1] - ks[0])
+
+
+def main(batch=128, dtype="bfloat16"):
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    total = {"fwd": 0.0, "dgrad": 0.0, "wgrad": 0.0}
+    count = {1: 0, 3: 0, 7: 0}
+    print(f"b={batch} {dtype}  (ms / TFLOP/s per op)")
+    for name, cin, cout, k, s, h in SHAPES:
+        pad = [(k // 2, k // 2)] * 2
+        h_out = h // s
+        x = jnp.asarray(rng.standard_normal((batch, cin, h, h)), dtype)
+        w = jnp.asarray(
+            rng.standard_normal((cout, cin, k, k)) * 0.05, dtype)
+        f = conv_fn(w.shape, s, pad)
+        y, vjp = jax.vjp(f, x, w)
+        dy = jnp.asarray(rng.standard_normal(y.shape), dtype)
+
+        flops = 2 * batch * cout * cin * k * k * h_out * h_out
+        t_f = time_chain(f, (x, w))
+
+        def dgrad(dyv, wv):
+            return jax.vjp(lambda xx: f(xx, wv), x)[1](dyv)[0]
+
+        def wgrad(xv, dyv):
+            return jax.vjp(lambda wv: f(xv, wv), w)[1](dyv)[0]
+
+        t_d = time_chain(dgrad, (dy, w))
+        t_w = time_chain(wgrad, (x, dy))
+        total["fwd"] += t_f
+        total["dgrad"] += t_d
+        total["wgrad"] += t_w
+        print(f"{name:10s} cin{cin:4d} cout{cout:4d} k{k} s{s} h{h:3d}: "
+              f"fwd {t_f*1e3:7.3f} {flops/t_f/1e12:5.1f} | "
+              f"dgrad {t_d*1e3:7.3f} {flops/t_d/1e12:5.1f} | "
+              f"wgrad {t_w*1e3:7.3f} {flops/t_w/1e12:5.1f}", flush=True)
+    print(f"totals (one instance each): fwd {total['fwd']*1e3:.2f} ms, "
+          f"dgrad {total['dgrad']*1e3:.2f} ms, "
+          f"wgrad {total['wgrad']*1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

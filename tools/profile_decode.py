@@ -5,9 +5,11 @@ Profiles one generate() call (prefill + 64-step scan) and prints the
 per-op table; rows inside the decode ``while``/scan body dominate, so
 dividing by the step count gives per-token cost attribution.
 """
+import os
 import sys
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 import numpy as np
 
 import paddle_tpu as paddle
