@@ -52,26 +52,44 @@ def conv_fn(w_shape, stride, pad):
     return f
 
 
-def time_chain(fn, args, ks=(4, 16)):
+def time_chain(fn, args, ks=(8, 392)):
+    """Serial chain with hoist-proof inputs: conv is LINEAR, so any
+    affine carry perturbation (x + c) lets XLA decompose
+    conv(x + c*1) = conv(x) [hoisted out of the loop] + c * conv(1);
+    plain sums of the output fold through the conv algebraically, and
+    element slices DCE it.  The input is instead spatially ROLLED by
+    the loop index (a roll along H does not commute with a padded
+    conv) and the output consumed through a square; the roll's own
+    cost is measured by an identical roll-only chain and subtracted."""
     import jax
     import jax.numpy as jnp
 
-    def make(n):
+    def make(n, with_fn):
         def run(*a):
-            def body(c, _):
-                out = fn(a[0] + c.astype(a[0].dtype), *a[1:])
-                s = out[0].ravel()[0] if isinstance(out, tuple) \
-                    else out.ravel()[0]
+            def body(_, i):
+                x_i = jnp.roll(a[0], i, axis=2)
+                if with_fn:
+                    out = fn(x_i, *a[1:])
+                    if isinstance(out, tuple):
+                        out = out[0]
+                else:
+                    out = x_i
+                s = jnp.mean(out.astype(jnp.float32) ** 2) * 1e-6
                 return s.astype(jnp.float32) * 1e-9, ()
-            return jax.lax.scan(body, jnp.float32(0), None, length=n)[0]
+            return jax.lax.scan(body, jnp.float32(0),
+                                jnp.arange(n) % a[0].shape[2])[0]
         return jax.jit(run)
-    f1, f2 = make(ks[0]), make(ks[1])
-    np.asarray(f1(*args)); np.asarray(f2(*args))
-    t0 = time.perf_counter(); np.asarray(f1(*args))
-    t1 = time.perf_counter() - t0
-    t0 = time.perf_counter(); np.asarray(f2(*args))
-    t2 = time.perf_counter() - t0
-    return (t2 - t1) / (ks[1] - ks[0])
+
+    def diff(with_fn):
+        f1, f2 = make(ks[0], with_fn), make(ks[1], with_fn)
+        np.asarray(f1(*args)); np.asarray(f2(*args))
+        t0 = time.perf_counter(); np.asarray(f1(*args))
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter(); np.asarray(f2(*args))
+        t2 = time.perf_counter() - t0
+        return (t2 - t1) / (ks[1] - ks[0])
+
+    return diff(True) - diff(False)
 
 
 def main(batch=128, dtype="bfloat16"):

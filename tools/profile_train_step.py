@@ -12,10 +12,17 @@ from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
 
 L = int(sys.argv[1]) if len(sys.argv) > 1 else 8
 B = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+HEADLINE = len(sys.argv) > 3 and sys.argv[3] == "headline"
 cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                   intermediate_size=8192, num_hidden_layers=L,
                   num_attention_heads=32, num_key_value_heads=8,
-                  max_position_embeddings=2048, recompute=True)
+                  max_position_embeddings=2048, recompute=True,
+                  # "headline" = the bench.py configuration: remat dial
+                  # + chunked fused lm_head+CE + bf16 moments
+                  recompute_policy="save_attn_mlp" if HEADLINE else None,
+                  recompute_policy_alt="save_attn" if HEADLINE else None,
+                  recompute_policy_stride=2 if HEADLINE else 1,
+                  fused_linear_loss=HEADLINE)
 paddle.seed(0)
 model = LlamaForCausalLM(cfg)
 model.train()
@@ -23,10 +30,14 @@ model.to(dtype="bfloat16")
 criterion = LlamaPretrainingCriterion(cfg)
 opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                              parameters=model.parameters(),
-                             multi_precision=True)
+                             multi_precision=not HEADLINE)
 
-def loss_fn(net, tokens, labels):
-    return criterion(net(tokens), labels)
+if HEADLINE:
+    def loss_fn(net, tokens, labels):
+        return net(tokens, labels=labels)[0]
+else:
+    def loss_fn(net, tokens, labels):
+        return criterion(net(tokens), labels)
 
 step = TrainStep(model, loss_fn, opt)
 rng = np.random.default_rng(0)
