@@ -100,23 +100,24 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                  ceil_mode, name="max_pool3d")
 
 
-def _adaptive(x, output_size, n_spatial, kind, name):
+def _adaptive(x, output_size, n_spatial, kind, name, spatial_start=2):
     def impl(a):
-        spatial = a.shape[2:]
+        ss = spatial_start
+        spatial = a.shape[ss:ss + n_spatial]
         os = _tup(output_size, n_spatial)
         os = tuple(o if o is not None else s for o, s in zip(os, spatial))
         out = a
         # pool each spatial dim independently with computed windows
         for d in range(n_spatial):
-            in_s, out_s = out.shape[2 + d], os[d]
+            in_s, out_s = out.shape[ss + d], os[d]
             if in_s == out_s:
                 continue
             if in_s % out_s == 0:
                 k = in_s // out_s
                 window = [1] * out.ndim
                 strides = [1] * out.ndim
-                window[2 + d] = k
-                strides[2 + d] = k
+                window[ss + d] = k
+                strides[ss + d] = k
                 if kind == "max":
                     out = jax.lax.reduce_window(
                         out, -jnp.inf, jax.lax.max, tuple(window),
@@ -130,12 +131,12 @@ def _adaptive(x, output_size, n_spatial, kind, name):
                 starts = (np.arange(out_s) * in_s // out_s)
                 ends = ((np.arange(out_s) + 1) * in_s + out_s - 1) // out_s
                 slices = []
-                moved = jnp.moveaxis(out, 2 + d, 0)
+                moved = jnp.moveaxis(out, ss + d, 0)
                 for s, e in zip(starts, ends):
                     seg = moved[s:e]
                     red = jnp.max(seg, axis=0) if kind == "max" else jnp.mean(seg, axis=0)
                     slices.append(red)
-                out = jnp.moveaxis(jnp.stack(slices, axis=0), 0, 2 + d)
+                out = jnp.moveaxis(jnp.stack(slices, axis=0), 0, ss + d)
         return out
 
     return dispatch(name, impl, (x,))
@@ -146,11 +147,13 @@ def adaptive_avg_pool1d(x, output_size, name=None):
 
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
-    return _adaptive(x, output_size, 2, "avg", "adaptive_avg_pool2d")
+    return _adaptive(x, output_size, 2, "avg", "adaptive_avg_pool2d",
+                     spatial_start=2 if data_format.startswith("NC") else 1)
 
 
 def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
-    return _adaptive(x, output_size, 3, "avg", "adaptive_avg_pool3d")
+    return _adaptive(x, output_size, 3, "avg", "adaptive_avg_pool3d",
+                     spatial_start=2 if data_format.startswith("NC") else 1)
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):

@@ -56,9 +56,10 @@ class _AdaptivePool(Layer):
     def __init__(self, output_size, **kwargs):
         super().__init__()
         self.output_size = output_size
+        self.kwargs = {k: v for k, v in kwargs.items() if k != "name"}
 
     def forward(self, x):
-        return getattr(F, self._fn)(x, self.output_size)
+        return getattr(F, self._fn)(x, self.output_size, **self.kwargs)
 
 
 class AdaptiveAvgPool1D(_AdaptivePool):

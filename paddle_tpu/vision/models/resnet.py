@@ -1,7 +1,14 @@
 """ResNet (analogue of python/paddle/vision/models/resnet.py).
 
-NCHW layout matches the reference API; XLA lays out conv internals for the
-MXU regardless of the logical layout.
+NCHW is the default (reference API parity).  ``data_format="NHWC"``
+runs the whole tower channels-last.  Measured on v5e (BASELINE.md
+round-5 conv attribution): the two layouts are THROUGHPUT-NEUTRAL for
+the b128 train step (52.20 vs 51.30 ms) — XLA's internal layout
+assignment is already channels-minor either way, and the slow
+56x56-stage 1x1 fusions are activation-HBM-bound, not layout-bound.
+NHWC is kept because it is the natural layout for TPU-side data
+pipelines (and other accelerators' channels-last checkpoints), not as
+a performance fix.
 """
 
 from __future__ import annotations
@@ -16,15 +23,18 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
+        df = dict(data_format=data_format)
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False)
-        self.bn1 = norm_layer(planes)
+                               bias_attr=False, **df)
+        self.bn1 = norm_layer(planes, **df)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                               **df)
+        self.bn2 = norm_layer(planes, **df)
         self.downsample = downsample
         self.stride = stride
 
@@ -41,19 +51,21 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
+        df = dict(data_format=data_format)
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = norm_layer(width)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False, **df)
+        self.bn1 = norm_layer(width, **df)
         self.conv2 = nn.Conv2D(width, width, 3, padding=dilation,
                                stride=stride, groups=groups,
-                               dilation=dilation, bias_attr=False)
-        self.bn2 = norm_layer(width)
+                               dilation=dilation, bias_attr=False, **df)
+        self.bn2 = norm_layer(width, **df)
         self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
-                               bias_attr=False)
-        self.bn3 = norm_layer(planes * self.expansion)
+                               bias_attr=False, **df)
+        self.bn3 = norm_layer(planes * self.expansion, **df)
         self.relu = nn.ReLU()
         self.downsample = downsample
 
@@ -69,7 +81,8 @@ class BottleneckBlock(nn.Layer):
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+                 with_pool=True, groups=1, data_format="NCHW",
+                 input_format="NCHW"):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -79,40 +92,53 @@ class ResNet(nn.Layer):
         self.num_classes = num_classes
         self.with_pool = with_pool
         self._norm_layer = nn.BatchNorm2D
+        self._data_format = data_format
+        self._input_format = input_format
+        df = dict(data_format=data_format)
         self.inplanes = 64
         self.dilation = 1
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
-                               bias_attr=False)
-        self.bn1 = self._norm_layer(self.inplanes)
+                               bias_attr=False, **df)
+        self.bn1 = self._norm_layer(self.inplanes, **df)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1, **df)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1), **df)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1, dilate=False):
         norm_layer = self._norm_layer
+        df = dict(data_format=self._data_format)
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
-                norm_layer(planes * block.expansion))
+                          stride=stride, bias_attr=False, **df),
+                norm_layer(planes * block.expansion, **df))
         layers = [block(self.inplanes, planes, stride, downsample, self.groups,
-                        self.base_width, self.dilation, norm_layer)]
+                        self.base_width, self.dilation, norm_layer,
+                        data_format=self._data_format)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
                                 base_width=self.base_width,
-                                norm_layer=norm_layer))
+                                norm_layer=norm_layer,
+                                data_format=self._data_format))
         return nn.Sequential(*layers)
 
     def forward(self, x):
+        if self._data_format != self._input_format:
+            # the input layout is DECLARED (input_format), never guessed
+            # from shapes — a [N, 3, H, 3] batch would be ambiguous.
+            # One entry transpose of the 3-channel input is tiny.
+            x = (x.transpose([0, 2, 3, 1])
+                 if self._data_format == "NHWC"
+                 else x.transpose([0, 3, 1, 2]))
         x = self.relu(self.bn1(self.conv1(x)))
         x = self.maxpool(x)
         x = self.layer1(x)

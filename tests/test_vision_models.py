@@ -42,3 +42,44 @@ def test_no_head_variant():
 
 def test_vgg_batch_norm():
     _check(models.vgg11(batch_norm=True, num_classes=8), size=64)
+
+
+def test_resnet_nhwc_matches_nchw():
+    """data_format="NHWC" (the channels-last tower; see
+    vision/models/resnet.py) must be numerically identical to NCHW —
+    same params, same NCHW input batches (entry transpose)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(0)
+    a = resnet18(num_classes=7)
+    paddle.seed(0)
+    b = resnet18(num_classes=7, data_format="NHWC")
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (2, 3, 32, 32)).astype(np.float32))
+    a.eval(); b.eval()
+    np.testing.assert_allclose(np.asarray(a(x)._value),
+                               np.asarray(b(x)._value),
+                               atol=1e-4, rtol=1e-4)
+    a.train(); b.train()
+    # train mode: BN batch-stat reduction order differs between the
+    # layouts; float accumulation drift over 18 layers stays ~1e-3
+    np.testing.assert_allclose(np.asarray(a(x)._value),
+                               np.asarray(b(x)._value),
+                               atol=5e-3, rtol=5e-3)
+
+
+def test_adaptive_avg_pool2d_nhwc():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+        (2, 8, 6, 4)).astype(np.float32))  # as NHWC: N=2 H=8 W=6 C=4
+    out = F.adaptive_avg_pool2d(x, (2, 3), data_format="NHWC")
+    assert tuple(out.shape) == (2, 2, 3, 4)
+    ref = F.adaptive_avg_pool2d(x.transpose([0, 3, 1, 2]), (2, 3))
+    np.testing.assert_allclose(
+        np.asarray(out._value),
+        np.asarray(ref.transpose([0, 2, 3, 1])._value), atol=1e-6)

@@ -1,19 +1,20 @@
-"""Per-shape conv benchmark for the ResNet-50 b128 training mix.
+"""DEPRECATED wall-clock conv benchmark — kept as a record of why the
+approach fails; use tools/profile_resnet_convs.py (in-model xplane
+attribution) and tools/profile_conv_op.py (per-op xplane rows) instead.
 
-Round-5 evidence gathering for VERDICT r4 weak #1: conv fusions run at
-89 TF/s ~= 45% of nominal across the fwd/dgrad/wgrad mix while square
-microbenchmarks reach 130-137.  This tool times each distinct conv
-shape class of ResNet-50 in all three roles so the slow class can be
-attacked specifically (Pallas kernel or algebraic decomposition)
-instead of guessing.
+Every wall-clock formulation tried here was defeated in a measured way:
 
-fwd:   y = conv(x, w)                      [N,Cin,H,W] x [Cout,Cin,k,k]
-dgrad: dx = conv_transpose-like            (lhs_dilation=stride)
-wgrad: dw = conv(x, dy) contraction over batch+spatial
-
-Each is timed as the ACTUAL XLA HLO the training step produces (via
-jax.vjp on conv_general_dilated), device-amortized in one jitted chain,
-differential between two chain lengths.
+1. Affine carry perturbation (x + c): conv is linear, XLA decomposes
+   conv(x + c*1) = conv(x) [hoisted out of the scan] + c*conv(1).
+2. Plain mean/sum consumption: folds through the conv algebraically
+   (reduce(conv(x, w)) = dot of windowed sums).
+3. Single-element consumption: DCEs all but a sliver of the conv.
+4. Spatial roll inputs: commute with every PAD-FREE conv (all the 1x1
+   shapes this tool exists to measure), so those rows still hoist; the
+   roll-only calibration chain is also not cost-matched (it reduces
+   over the input, the conv chain over the output).
+5. And beneath all of it, the axon tunnel's tens-of-ms wall-clock
+   jitter swamps sub-ms ops even at 400-step differentials.
 """
 
 import os

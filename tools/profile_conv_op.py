@@ -1,0 +1,120 @@
+"""Device-time microbenchmark of single conv ops via the xplane
+profiler (the only jitter-proof way through the axon tunnel: wall-clock
+differentials need 100s of ms of delta, and XLA hoists/folds linear ops
+out of naive chain harnesses — see bench_conv_shapes.py).  Inputs are
+spatially rolled by the loop index (padding breaks conv/roll
+commutation) and the roll shows up as its own xplane row, so the conv
+row's device time is clean.
+
+Compares the bare dgrad/fwd/wgrad conv against the in-model fusion
+times from profile_resnet_convs.py to separate "conv algorithm" cost
+from "fused BN-epilogue traffic" cost.
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def profile_case(name, make_fn, args, steps=16):
+    import jax
+
+    from paddle_tpu import profiler
+
+    fn = jax.jit(make_fn(steps))
+    np.asarray(fn(*args))  # compile+warm
+    tdir = tempfile.mkdtemp(prefix="prof_op_")
+    jax.profiler.start_trace(tdir)
+    np.asarray(fn(*args))
+    jax.profiler.stop_trace()
+    rows = profiler.DeviceSummaryView(tdir).rows()
+    rows = [r for r in rows if not (r["name"].startswith("jit_")
+                                    or r["name"].isdigit()
+                                    or r["name"].startswith("while"))]
+    rows.sort(key=lambda r: -r["total_ms"])
+    print(f"--- {name} (top rows /{steps} steps)")
+    for r in rows[:6]:
+        print(f'  {r["total_ms"]/steps:8.4f} ms/step x{r["calls"]:<4} '
+              f'{r["name"][:70]}')
+    return rows
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    b, cin, cout, h = 128, 256, 64, 56
+    # the slowest in-model class: dgrad of the stage-1 1x1 conv
+    # (dx [128,256,56,56] from dy [128,64,56,56]) — in-model fusion
+    # measured 1.44 ms/step at b128.  Inputs come from an ITERATION-
+    # INDEXED dynamic slice of an oversized buffer: a 1x1 conv has no
+    # padding, so rolled inputs commute with the conv and XLA hoists it
+    # out of the loop (measured: the conv row vanished from the trace)
+    dy_big = jnp.asarray(rng.standard_normal((b, cout, h + 16, h)),
+                         jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((cout, cin, 1, 1)) * 0.05,
+                    jnp.bfloat16)
+    x_big = jnp.asarray(rng.standard_normal((b, cin, h + 16, h)),
+                        jnp.bfloat16)
+    dy = jax.lax.dynamic_slice(dy_big, (0, 0, 0, 0), (b, cout, h, h))
+    x = jax.lax.dynamic_slice(x_big, (0, 0, 0, 0), (b, cin, h, h))
+
+    def f(xx, ww):
+        return jax.lax.conv_general_dilated(
+            xx, ww, (1, 1), [(0, 0), (0, 0)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    def make_dgrad(steps):
+        def run(dy_, w_, x_):
+            def body(_, i):
+                dyr = jax.lax.dynamic_slice(
+                    dy_big, (0, 0, i, 0), (b, cout, h, h))
+                dx = jax.vjp(lambda xx: f(xx, w_), x_)[1](dyr)[0]
+                return jnp.float32(0), jnp.mean(
+                    dx.astype(jnp.float32) ** 2)
+            _, outs = jax.lax.scan(body, jnp.float32(0),
+                                   jnp.arange(steps) % 16)
+            return outs.sum()
+        return run
+
+    def make_fwd(steps):
+        def run(dy_, w_, x_):
+            def body(_, i):
+                xr = jax.lax.dynamic_slice(
+                    x_big, (0, 0, i, 0), (b, cin, h, h))
+                y = f(xr, w_)
+                return jnp.float32(0), jnp.mean(
+                    y.astype(jnp.float32) ** 2)
+            _, outs = jax.lax.scan(body, jnp.float32(0),
+                                   jnp.arange(steps) % 16)
+            return outs.sum()
+        return run
+
+    def make_wgrad(steps):
+        def run(dy_, w_, x_):
+            def body(_, i):
+                dyr = jax.lax.dynamic_slice(
+                    dy_big, (0, 0, i, 0), (b, cout, h, h))
+                dw = jax.vjp(lambda ww: f(x_, ww), w)[1](dyr)[0]
+                return jnp.float32(0), jnp.mean(
+                    dw.astype(jnp.float32) ** 2)
+            _, outs = jax.lax.scan(body, jnp.float32(0),
+                                   jnp.arange(steps) % 16)
+            return outs.sum()
+        return run
+
+    profile_case("dgrad 1x1 256<-64 @56^2 b128 (in-model 1.44 ms)",
+                 make_dgrad, (dy, w, x))
+    profile_case("fwd 1x1 256->64 @56^2 b128", make_fwd, (dy, w, x))
+    profile_case("wgrad 1x1 256->64 @56^2 b128 (in-model ~0.55 ms)",
+                 make_wgrad, (dy, w, x))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,127 @@
+"""Per-conv-shape device-time attribution for the ResNet-50 train step.
+
+Wall-clock microbenchmarks of single convs through the axon tunnel are
+unusable: the tunnel's tens-of-ms jitter swamps sub-ms ops, and XLA
+defeats every chain harness (conv is linear, so carry-perturbed inputs
+hoist; sums fold through the conv; slices DCE it — see
+bench_conv_shapes.py).  The defensible method is the round-4 one:
+profile the REAL training step and attribute each fusion's device time
+to the convolution instruction(s) it contains, using the optimized HLO
+to map fusion names to conv shapes.
+
+Prints conv fusions sorted by device time with their HLO convolution
+signatures — the shape classes worth attacking show up at the top.
+"""
+
+import os
+import re
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+from paddle_tpu.jit.train_step import TrainStep  # noqa: E402
+from paddle_tpu.vision.models import resnet50  # noqa: E402
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+SIZE = 224
+
+paddle.seed(0)
+net = resnet50(num_classes=1000)
+net.train()
+net.to(dtype="bfloat16")
+opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                parameters=net.parameters())
+
+
+def loss_fn(net, x, y):
+    return F.cross_entropy(net(x), y).mean()
+
+
+step = TrainStep(net, loss_fn, opt)
+rng = np.random.default_rng(0)
+x = paddle.to_tensor(rng.standard_normal(
+    (BATCH, 3, SIZE, SIZE)).astype(np.float32)).astype("bfloat16")
+y = paddle.to_tensor(rng.integers(0, 1000, (BATCH,)).astype(np.int64))
+
+float(step.run_steps(x, y, steps=3))  # compile + warm
+
+# --- optimized HLO: map fusion name -> contained convolution signatures
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+lowered = step._multi_cache[3].lower(
+    [p._value for p in step._params], step._state, step._gm_state,
+    jax.random.PRNGKey(0), jnp.float32(0.1),
+    [b._value for b in step._buffers], x._value, y._value)
+hlo = lowered.compile().as_text()
+with open("/tmp/rn50_hlo.txt", "w") as f:
+    f.write(hlo)
+
+# computation bodies (ANY named computation, not just fused_computation:
+# XLA wraps convs in kCustom fusions calling computations with other
+# names): name -> list of convolution signature lines
+comp_convs = {}
+cur = None
+for line in hlo.splitlines():
+    defm = re.match(r"(%?[\w.\-]+)\s*\(.*\)\s*->\s*.*\{", line)
+    if defm and not line.startswith(" "):
+        cur = defm.group(1).lstrip("%")
+        comp_convs.setdefault(cur, [])
+    elif line.startswith("}"):
+        cur = None
+    elif cur and " convolution(" in line:
+        sig = re.search(
+            r"(\S+) convolution\(.*?window={([^}]*)}.*?dim_labels=(\S+?),",
+            line)
+        if sig:
+            comp_convs[cur].append(
+                f"{sig.group(1)} win[{sig.group(2)}] {sig.group(3)}")
+        else:
+            comp_convs[cur].append(line.strip()[:120])
+
+# fusion instructions: name -> called computation
+fusion_comp = {}
+for m in re.finditer(
+        r"%?([\w.\-]+) = .*? fusion\(.*?calls=%?([\w.\-]+)", hlo):
+    fusion_comp[m.group(1)] = m.group(2)
+
+# --- profile
+tdir = tempfile.mkdtemp(prefix="prof_rn50_")
+jax.profiler.start_trace(tdir)
+float(step.run_steps(x, y, steps=3))
+jax.profiler.stop_trace()
+
+from paddle_tpu import profiler  # noqa: E402
+
+rows = profiler.DeviceSummaryView(tdir).rows()
+rows = [r for r in rows
+        if not (r["name"].startswith("jit_") or r["name"].isdigit())]
+total = sum(r["total_ms"] for r in rows)
+conv_ms = 0.0
+conv_rows = []
+for r in rows:
+    comp = fusion_comp.get(r["name"])
+    convs = comp_convs.get(comp, []) if comp else []
+    if convs:
+        conv_ms += r["total_ms"]
+        conv_rows.append((r, convs))
+print(f"b={BATCH}; device total {total:.1f} ms /3 steps = "
+      f"{total/3:.2f} ms/step; conv fusions {conv_ms:.1f} ms "
+      f"({100*conv_ms/total:.0f}%)")
+for r, convs in sorted(conv_rows, key=lambda t: -t[0]["total_ms"])[:40]:
+    per_step = r["total_ms"] / 3
+    print(f'{per_step:8.3f} ms/step x{r["calls"]:<3} {r["name"][:24]:24s} '
+          f'{" | ".join(convs[:2])[:110]}')
+
+print("\n--- top rows NOT attributed to a conv ---")
+nonconv = [r for r in rows
+           if not (fusion_comp.get(r["name"]) and
+                   comp_convs.get(fusion_comp.get(r["name"])))]
+for r in sorted(nonconv, key=lambda r: -r["total_ms"])[:25]:
+    print(f'{r["total_ms"]/3:8.3f} ms/step x{r["calls"]:<3} {r["name"][:60]}')
