@@ -279,7 +279,11 @@ def tune_fused_adamw2d(shape=(7296, 8192), p_dtype="bfloat16",
 def tune_decode_attention(b=32, hkv=8, g=4, s=2048, d=64,
                           dtype="bfloat16", iters: int = 3):
     """Search the DMA chunk size (cache slots) of the flash-decode
-    attention kernel at a serving shape (full-prefix worst case)."""
+    attention kernel.  The candidate must win at SERVING-representative
+    fill levels, not only the full-prefix worst case: a big chunk looks
+    best when every slot is valid but over-streams short prefixes (a
+    1024-slot chunk reads 4x the bytes of a 130-slot prefix), so the
+    per-candidate metric sums a short-, mid- and full-prefix run."""
     import jax.numpy as jnp
 
     from .decode_attention import (_decode_attention_pallas,
@@ -289,14 +293,23 @@ def tune_decode_attention(b=32, hkv=8, g=4, s=2048, d=64,
     q4 = jnp.asarray(rng.standard_normal((b, hkv, g, d)), dtype)
     kc = jnp.asarray(rng.standard_normal((b, s, w)), dtype)
     vc = jnp.asarray(rng.standard_normal((b, s, w)), dtype)
-    lens = jnp.full((b,), s - 8, jnp.int32)
+    fills = [jnp.full((b,), max(8, s // 8), jnp.int32),
+             jnp.full((b,), s // 2, jnp.int32),
+             jnp.full((b,), s - 8, jnp.int32)]
     cands = [c for c in (128, 256, 512, 1024) if s % c == 0]
     default = DEFAULT_CHUNK if s % DEFAULT_CHUNK == 0 else cands[0]
+
+    def make(chunk):
+        def run(q4a, kca, vca):
+            outs = [_decode_attention_pallas(q4a, kca, vca, lens,
+                                             chunk=chunk)
+                    for lens in fills]
+            return sum(o.astype(jnp.float32).sum() for o in outs)
+        return run
+
     return tune_kernel(
         "decode_attention", decode_attn_sig(b, hkv, g, s, d, q4.dtype),
-        lambda chunk: functools.partial(_decode_attention_pallas,
-                                        chunk=chunk),
-        cands, (q4, kc, vc, lens), iters=iters, default=default)
+        make, cands, (q4, kc, vc), iters=iters, default=default)
 
 
 def tune_bench_shapes(iters: int = 3) -> Dict[str, Tuple]:
