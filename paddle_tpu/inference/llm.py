@@ -52,6 +52,96 @@ def _unflatten_kvs(flat):
     return [(flat[i], flat[i + 1]) for i in range(0, len(flat), 2)]
 
 
+def _param_swapper(model, cfg: GenerationConfig):
+    """The closure every serving program shares: positional
+    params+buffers values in, the model's weights swapped for the traced
+    arrays for the duration of the call (floats cast ONCE to the serving
+    compute dtype — the hoisted fast-layout copy)."""
+    params, buffers = model_arrays(model)
+
+    def _with_params(pb_values, fn):
+        p_values = pb_values[:len(params)]
+        b_values = pb_values[len(params):]
+        return swap_call(params, buffers, p_values, b_values,
+                         cfg.compute_dtype, fn)
+
+    return _with_params
+
+
+def _build_decode_block(model, cfg: GenerationConfig, steps_per_call):
+    """Pure greedy/sampled decode block: ``lax.scan`` of
+    ``steps_per_call`` steps of the shared ``decode_scan_body``.
+
+    Slot-granular serving contract (ServingEngine): every op in the
+    body is row-independent — per-row cache scatter, per-row prefix
+    attention, per-row EOS/length masking — so a batch row decodes
+    identically whatever mix of fill levels the other slots hold.
+    Occupancy is pure DATA (``lens``/``done`` vectors), never shape:
+    one compiled block serves every occupancy mix, and rows with
+    ``done=True`` freeze (lens stops advancing, emits are pad), which
+    is how both finished and vacant slots ride along for free.
+    """
+    _with_params = _param_swapper(model, cfg)
+
+    def block_pure(p_values, tok, lens, done, key, *flat_kvs):
+        def run():
+            kvs = _unflatten_kvs(list(flat_kvs))
+            (tok_f, lens_f, kvs_f, key_f, done_f), toks = jax.lax.scan(
+                decode_scan_body(model, cfg), (tok, lens, kvs, key, done),
+                None, length=steps_per_call)
+            return ((toks.T.astype(jnp.int32), tok_f, lens_f, done_f,
+                     key_f) + tuple(_flatten_kvs(kvs_f)))
+        return _with_params(p_values, run)
+
+    return block_pure
+
+
+def build_slot_prefill(model, max_cache_len, cfg: GenerationConfig):
+    """Slot-granular prefill for continuous batching (ServingEngine):
+    prefill ONE sequence (a batch-1 compiled prompt pass) and write its
+    K/V into row ``slot`` of a shared B-slot cache pool.
+
+    The whole ``max_cache_len`` cache row is written — prompt K/V
+    followed by the zeros of the batch-1 scratch cache — so admission
+    unconditionally scrubs the previous occupant's stale K/V (defense
+    in depth on top of the ``lens`` masking that already hides slots
+    past the valid prefix).  ``slot`` is a TRACED scalar: one compiled
+    program admits into any slot.  Signature:
+    ``(p_values, slot, ids [1, P], lens [1], key, *flat_kvs) ->
+    (tok0 [1], key', *flat_kvs)``.
+    """
+    if cfg.num_beams > 1:
+        raise ValueError(
+            "slot-granular prefill is greedy/sampled only — beam search "
+            "expands to K cache rows per request, which does not fit a "
+            "one-slot-per-request pool")
+    n_layers, hkv, d = model.kv_cache_spec()
+    cache_dtype = jnp.dtype(cfg.cache_dtype or cfg.compute_dtype)
+    _with_params = _param_swapper(model, cfg)
+
+    def slot_prefill_pure(p_values, slot, ids, lens, key, *flat_kvs):
+        def run():
+            small = init_kv_cache(n_layers, 1, max_cache_len, hkv, d,
+                                  cache_dtype)
+            logits, small = model.prefill(ids, lens, small)
+            if cfg.do_sample:
+                key0, keyr = jax.random.split(key)
+            else:
+                key0 = keyr = key
+            tok0 = sample_token(logits, key0, cfg)
+            big = _unflatten_kvs(list(flat_kvs))
+            out = []
+            for (bk, bv), (sk, sv) in zip(big, small):
+                zero = (0,) * (bk.ndim - 1)
+                out.append((
+                    jax.lax.dynamic_update_slice(bk, sk, (slot,) + zero),
+                    jax.lax.dynamic_update_slice(bv, sv, (slot,) + zero)))
+            return (tok0, keyr) + tuple(_flatten_kvs(out))
+        return _with_params(p_values, run)
+
+    return slot_prefill_pure
+
+
 def _build_serving_fns(model, batch, max_cache_len,
                        cfg: GenerationConfig, steps_per_call):
     """Pure (params, ...) -> (...) functions for prefill and one decode
@@ -70,16 +160,10 @@ def _build_serving_fns(model, batch, max_cache_len,
       backtraces once at the end (beam results are only final after the
       last step, so the block protocol ships the tree, not sequences).
     """
-    params, buffers = model_arrays(model)
     n_layers, hkv, d = model.kv_cache_spec()
     cache_dtype = jnp.dtype(cfg.cache_dtype or cfg.compute_dtype)
     k = cfg.num_beams
-
-    def _with_params(pb_values, fn):
-        p_values = pb_values[:len(params)]
-        b_values = pb_values[len(params):]
-        return swap_call(params, buffers, p_values, b_values,
-                         cfg.compute_dtype, fn)
+    _with_params = _param_swapper(model, cfg)
 
     if k > 1:
         def prefill_pure(p_values, ids, lens):
@@ -135,17 +219,7 @@ def _build_serving_fns(model, batch, max_cache_len,
             return (tok0, lens, done0, keyr) + tuple(_flatten_kvs(kvs))
         return _with_params(p_values, run)
 
-    def block_pure(p_values, tok, lens, done, key, *flat_kvs):
-        def run():
-            kvs = _unflatten_kvs(list(flat_kvs))
-            (tok_f, lens_f, kvs_f, key_f, done_f), toks = jax.lax.scan(
-                decode_scan_body(model, cfg), (tok, lens, kvs, key, done),
-                None, length=steps_per_call)
-            return ((toks.T.astype(jnp.int32), tok_f, lens_f, done_f,
-                     key_f) + tuple(_flatten_kvs(kvs_f)))
-        return _with_params(p_values, run)
-
-    return prefill_pure, block_pure
+    return prefill_pure, _build_decode_block(model, cfg, steps_per_call)
 
 
 class LLMPredictor:

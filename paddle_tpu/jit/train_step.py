@@ -54,11 +54,21 @@ def _stochastic_round_bf16(x, key):
     Noise economics at 1.1B-param scale: threefry (jax.random.randint)
     costs ~40 ms/step of generation, and a full-size rng_bit_generator
     buffer is a 4.4 GB HBM transient (measured OOM).  Instead ONE small
-    hardware-RBG tile per store is broadcast across leading dims: every
-    element still sees uniform noise that is fresh each step
-    (per-element unbiasedness needs independence across STEPS, which
-    the per-step key provides; correlation across positions within one
-    step does not bias the EMA means).
+    hardware-RBG tile per store is broadcast across leading dims.
+
+    Within-step COLUMN CORRELATION (a property, not a bug): because the
+    noise tile has only the trailing shape, every element sharing a
+    trailing index (same "column", different leading rows) adds the
+    SAME 16-bit noise value in a given step — their rounding errors are
+    perfectly correlated within that step.  This sits next to the
+    EMA-unbiasedness argument deliberately: unbiasedness needs
+    per-element noise that is uniform and independent across STEPS
+    (the fresh per-step key provides that), so E[m_t] per element is
+    exact regardless of within-step correlation.  What the correlation
+    DOES structure is same-step cross-element error: any consumer of a
+    same-step spatial statistic over the stored moments (e.g. the
+    variance of a column mean) sees column-correlated rounding noise,
+    not i.i.d. noise.  The optimizer never computes such a statistic.
 
     SHAPE-PRESERVING (round 5): the round-4 form flattened x to
     [-1, 64Ki] around the noise add — on TPU that reshape physically

@@ -80,6 +80,12 @@ def should_use_pallas(q4, cache) -> bool:
         return False
     if cache.ndim != 3:
         return False
+    if jnp.dtype(q4.dtype) != jnp.dtype(cache.dtype):
+        # mixed-precision serving configs (bf16 compute x f32/int8
+        # cache) would route an untested mixed-dtype dot into the
+        # Mosaic kernel; keep them on the XLA fallback, which casts
+        # explicitly (fp32 logits, V cast at the PV dot)
+        return False
     b, hkv, g, d = q4.shape
     s, w = cache.shape[1], cache.shape[2]
     if not packed_ok(hkv, d) or w != hkv * d:
@@ -104,7 +110,21 @@ def _kernel(lens_ref, qcat_ref, k_hbm, v_hbm, o_ref,
     chains).  Phase 0: guarded chunk DMAs for the valid prefix only.
     Phase 1: one block-diagonal dot per 128-lane head group.  Phase 2:
     one masked softmax over the whole logits scratch.  Phase 3: one PV
-    dot per group, outputs sliced from the small [hp*8, gw] result."""
+    dot per group, outputs sliced from the small [hp*8, gw] result.
+
+    Scratch-reuse invariant: VMEM scratch is SHARED across the grid and
+    the prefix-aware DMAs refresh only rows ``<= length`` — ``vbuf`` is
+    zeroed at program 0 ONLY, ``kbuf`` is NEVER zeroed, so past this
+    row's prefix both buffers hold the PREVIOUS program's chunks (or,
+    at program 0, zeros/undefined).  Correctness rests on exactly two
+    properties: (a) every logit at row > length is masked to -1e30
+    before exp, so stale K contributes weight exp(-inf) = 0; (b) vbuf
+    was zeroed once at program 0, so a zero weight can never meet an
+    undefined NaN bit pattern in V (0 * NaN = NaN — stale-but-real V
+    from earlier programs is finite and safe under (a)).  Both depend
+    on the grid executing SEQUENTIALLY (Pallas-TPU 'arbitrary' grid
+    order); declaring the batch dimension 'parallel' would race
+    programs on the shared scratch and break the invariant."""
     bi = pl.program_id(0)
     length = lens_ref[bi]                     # last valid slot index
     n_chunks = length // chunk + 1

@@ -283,7 +283,8 @@ def tune_decode_attention(b=32, hkv=8, g=4, s=2048, d=64,
     fill levels, not only the full-prefix worst case: a big chunk looks
     best when every slot is valid but over-streams short prefixes (a
     1024-slot chunk reads 4x the bytes of a 130-slot prefix), so the
-    per-candidate metric sums a short-, mid- and full-prefix run."""
+    per-candidate metric sums a short-, mid-, full-prefix AND a ragged
+    mixed-fill run (the continuous-batching slot-pool shape)."""
     import jax.numpy as jnp
 
     from .decode_attention import (_decode_attention_pallas,
@@ -295,7 +296,13 @@ def tune_decode_attention(b=32, hkv=8, g=4, s=2048, d=64,
     vc = jnp.asarray(rng.standard_normal((b, s, w)), dtype)
     fills = [jnp.full((b,), max(8, s // 8), jnp.int32),
              jnp.full((b,), s // 2, jnp.int32),
-             jnp.full((b,), s - 8, jnp.int32)]
+             jnp.full((b,), s - 8, jnp.int32),
+             # continuous-batching serving (inference/serving.py) holds
+             # a MIX of fill levels in one batch — per-row n_chunks
+             # raggedness, where a too-big chunk over-streams the short
+             # rows even when the batch also has full rows
+             jnp.asarray([max(8, ((i % 4) + 1) * (s // 4) - 8)
+                          for i in range(b)], jnp.int32)]
     cands = [c for c in (128, 256, 512, 1024) if s % c == 0]
     default = DEFAULT_CHUNK if s % DEFAULT_CHUNK == 0 else cands[0]
 

@@ -36,6 +36,13 @@ assert jax.device_count() == 8, "expected 8 virtual CPU devices"
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: slow marks long paths (bench-driving
+    # tests, full serving traces) that only run on demand / on chip
+    config.addinivalue_line(
+        "markers", "slow: long-running paths excluded from tier-1")
+
+
 @pytest.fixture(autouse=True)
 def _reseed():
     import paddle_tpu as paddle

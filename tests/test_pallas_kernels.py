@@ -775,6 +775,43 @@ def test_decode_attention_wide_gqa_falls_back():
     assert out.shape == (b, hq * d)
 
 
+def test_decode_attention_rejects_mixed_dtype(monkeypatch):
+    """bf16 compute x f32/int8 cache must NOT route into the Mosaic
+    kernel (the dot would be an untested mixed-precision path): the
+    gate requires q.dtype == cache.dtype."""
+    from paddle_tpu.ops.pallas import decode_attention as da
+    monkeypatch.setattr(da, "pallas_enabled", lambda: True)
+    b, hkv, g, s, d = 2, 2, 4, 256, 64
+    q_bf = jax.ShapeDtypeStruct((b, hkv, g, d), jnp.bfloat16)
+    c_bf = jax.ShapeDtypeStruct((b, s, hkv * d), jnp.bfloat16)
+    c_f32 = jax.ShapeDtypeStruct((b, s, hkv * d), jnp.float32)
+    c_i8 = jax.ShapeDtypeStruct((b, s, hkv * d), jnp.int8)
+    assert da.should_use_pallas(q_bf, c_bf)           # matched routes
+    assert not da.should_use_pallas(q_bf, c_f32)      # mixed does not
+    assert not da.should_use_pallas(q_bf, c_i8)
+
+
+def test_decode_attention_mixed_dtype_parity():
+    """Mixed-dtype serving configs (bf16 q x f32 cache) fall back to
+    the XLA path and still match the all-f32 reference within bf16
+    tolerance — the routed result is correct, not just 'not crashed'."""
+    from paddle_tpu.ops.pallas.decode_attention import decode_attention
+    rng = np.random.default_rng(14)
+    b, hq, hkv, s, d = 2, 4, 2, 128, 64
+    q = rng.standard_normal((b, hq, d)).astype(np.float32)
+    kc = rng.standard_normal((b, s, hkv * d)).astype(np.float32)
+    vc = rng.standard_normal((b, s, hkv * d)).astype(np.float32)
+    lens = jnp.asarray([7, 100], jnp.int32)
+    out = decode_attention(jnp.asarray(q, jnp.bfloat16),
+                           jnp.asarray(kc), jnp.asarray(vc), lens)
+    assert out.dtype == jnp.bfloat16
+    q4 = jnp.asarray(q).reshape(b, hkv, hq // hkv, d)
+    ref = _ref_decode_attention(q4, jnp.asarray(kc), jnp.asarray(vc),
+                                lens).reshape(b, hq * d)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), atol=3e-2, rtol=3e-2)
+
+
 def test_stochastic_round_preserves_shape():
     from paddle_tpu.jit.train_step import _stochastic_round_bf16
     key = jax.random.PRNGKey(0)
