@@ -78,6 +78,25 @@ def _diff_time(run, k_small, k_large):
     return (t_l - t_s) / (k_large - k_small)
 
 
+def _run_section(name, fn, metrics_out):
+    """Run one bench section with an observability-registry snapshot
+    taken around it; the per-section delta (compile counts, Pallas
+    route/fallback decisions, serving scheduler counters, latency
+    quantiles) lands in the JSON's ``metrics`` sub-object so the BENCH
+    trajectory records fallback rates and compile counts alongside
+    throughput."""
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    reg = obs_metrics.get_registry()
+    before = reg.snapshot()
+    try:
+        return fn()
+    finally:
+        delta = obs_metrics.diff_snapshots(before, reg.snapshot())
+        if delta:
+            metrics_out[name] = delta
+
+
 def main():
     import jax
 
@@ -85,34 +104,26 @@ def main():
     on_tpu = dev.platform in ("tpu", "axon")
     peak_flops = 197e12 if on_tpu else 1e11  # v5e nominal bf16
 
-    result = _bench_llama(on_tpu, peak_flops)
+    metrics = {}
+    result = _run_section(
+        "llama_pretrain", lambda: _bench_llama(on_tpu, peak_flops), metrics)
     gc.collect()
     secondary = {}
-    try:
-        secondary["resnet50_train"] = _bench_resnet(on_tpu, peak_flops)
-    except Exception as e:
-        secondary["resnet50_train"] = {"error": str(e)[:300]}
-    gc.collect()
-    try:
-        secondary["ocr_rec_infer"] = _bench_ocr(on_tpu, peak_flops)
-    except Exception as e:
-        secondary["ocr_rec_infer"] = {"error": str(e)[:300]}
-    gc.collect()
-    try:
-        secondary["llm_decode"] = _bench_decode(on_tpu)
-    except Exception as e:
-        secondary["llm_decode"] = {"error": str(e)[:300]}
-    gc.collect()
-    try:
-        secondary["moe_block"] = _bench_moe(on_tpu)
-    except Exception as e:
-        secondary["moe_block"] = {"error": str(e)[:300]}
-    gc.collect()
-    try:
-        secondary["llm_serving"] = _bench_serving(on_tpu)
-    except Exception as e:
-        secondary["llm_serving"] = {"error": str(e)[:300]}
+    sections = [
+        ("resnet50_train", lambda: _bench_resnet(on_tpu, peak_flops)),
+        ("ocr_rec_infer", lambda: _bench_ocr(on_tpu, peak_flops)),
+        ("llm_decode", lambda: _bench_decode(on_tpu)),
+        ("moe_block", lambda: _bench_moe(on_tpu)),
+        ("llm_serving", lambda: _bench_serving(on_tpu)),
+    ]
+    for name, fn in sections:
+        try:
+            secondary[name] = _run_section(name, fn, metrics)
+        except Exception as e:
+            secondary[name] = {"error": str(e)[:300]}
+        gc.collect()
     result["secondary"] = secondary
+    result["metrics"] = metrics
     print(json.dumps(result))
 
 

@@ -33,6 +33,7 @@ from . import tensor  # noqa: F401
 
 # ---- subsystems ----
 from . import runtime  # noqa: F401
+from . import observability  # noqa: F401
 from . import profiler  # noqa: F401
 from . import autograd  # noqa: F401
 from . import nn  # noqa: F401

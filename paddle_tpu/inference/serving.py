@@ -59,7 +59,75 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.generation import GenerationConfig, model_arrays
+from ..observability import metrics as obs_metrics
+from ..observability.spans import instant as _span_instant
+from ..observability.spans import span as _span
 from .llm import _build_decode_block, build_slot_prefill
+
+
+class _ServingInstruments:
+    """The engine's registry handles plus per-engine baselines.
+
+    Instruments live in a (usually process-wide) ``MetricsRegistry`` —
+    a second engine in the same process shares them — so each engine
+    snapshots its counters at construction and ``stats()`` reports the
+    delta while the registry keeps the process-wide totals an exporter
+    scrapes.  Two sharing caveats: (1) the delta is exact for engines
+    used SEQUENTIALLY on one registry; engines running interleaved on
+    the same registry see each other's increments — pass each a
+    private ``registry=`` for exact isolation; (2) disabling the
+    registry freezes the counters, so ``stats()`` stops advancing too
+    (the price of stats() being registry-derived); (3) the Pallas
+    route counter (``pallas.decode_attention.route``) always lives in
+    the process-default registry — the dispatch gate has no engine
+    context — so a private registry's export carries no route series."""
+
+    def __init__(self, registry):
+        self.registry = registry
+        r = registry
+        self.prefills = r.counter(
+            "serving.prefills", "slot-granular prompt prefills run")
+        self.decode_steps = r.counter(
+            "serving.decode_steps", "decode steps executed (block size "
+            "x dispatches)")
+        self.busy_slot_steps = r.counter(
+            "serving.busy_slot_steps",
+            "decode step x slot cells holding a live request")
+        self.block_dispatches = r.counter(
+            "serving.block_dispatches", "compiled decode block calls")
+        self.tokens_emitted = r.counter(
+            "serving.tokens_emitted", "tokens emitted to requests "
+            "(prefill first-tokens + decode-block harvest; "
+            "block-granular, so a request hitting EOS mid-block counts "
+            "its pad tail — exact only at steps_per_call=1)")
+        self.requests_submitted = r.counter(
+            "serving.requests_submitted", "requests accepted into the queue")
+        self.requests_finished = r.counter(
+            "serving.requests_finished", "requests retired (EOS or budget)")
+        self.evictions = r.counter(
+            "serving.slot_evictions", "slot frees at request retirement "
+            "(first-token finishes never occupied a slot)")
+        self.queue_depth = r.gauge(
+            "serving.queue_depth", "requests waiting for a slot")
+        self.slot_occupancy = r.gauge(
+            "serving.slot_occupancy", "slots holding a live request")
+        self.slots_total = r.gauge(
+            "serving.slots_total", "KV-cache slot pool size")
+        self.latency = r.histogram(
+            "serving.request_latency_seconds",
+            "request latency, arrival -> last token")
+        self.ttft = r.histogram(
+            "serving.ttft_seconds",
+            "time to first token, arrival -> prefill emit")
+        self._base = {}
+        for c in (self.prefills, self.decode_steps, self.busy_slot_steps,
+                  self.block_dispatches, self.requests_finished):
+            self._base[c.name] = c.value()
+
+    def since_init(self, counter) -> float:
+        """Counter delta attributable to THIS engine."""
+        return counter.value() - self._base.get(counter.name, 0)
+
 
 def _call_quiet(fn, *args):
     """Invoke a compiled serving program with the donation warning
@@ -131,7 +199,8 @@ class ServingEngine:
                  eos_token_id=None, pad_token_id=0,
                  do_sample=False, temperature=1.0, top_k=0,
                  compute_dtype="bfloat16", cache_dtype=None,
-                 seed=0, static_batching=False, clock=time.perf_counter):
+                 seed=0, static_batching=False, clock=time.perf_counter,
+                 registry=None):
         self.num_slots = int(num_slots)
         self.prompt_len = int(prompt_len)
         self.max_cache_len = int(max_cache_len or (prompt_len + 256))
@@ -188,11 +257,15 @@ class ServingEngine:
         self._finished: List[Request] = []
         self._clock = clock
         self._next_id = 0
-        # scheduler accounting (stats())
-        self._decode_steps = 0
-        self._busy_slot_steps = 0
-        self._prefill_count = 0
-        self._block_dispatches = 0
+        # scheduler accounting lives in the observability registry
+        # (stats() reads per-engine counter deltas back out of it);
+        # peak_queue additionally mirrors the queue-depth gauge's
+        # high-water mark as a plain int so stats() stays exact even if
+        # the registry is disabled mid-run
+        self._m = _ServingInstruments(
+            registry if registry is not None else obs_metrics.get_registry())
+        self._m.slots_total.set(self.num_slots)
+        self._m.slot_occupancy.set(0)
         self._peak_queue = 0
 
     # -- request intake --
@@ -231,12 +304,23 @@ class ServingEngine:
         self._next_id += 1
         self._queue.append(req)
         self._peak_queue = max(self._peak_queue, len(self._queue))
+        self._m.requests_submitted.inc()
+        self._m.queue_depth.set(len(self._queue))
+        _span_instant("serving.request.queued", request=req.request_id,
+                      seq_len=n, max_new=m)
         return req
 
     # -- scheduler --
     def _finish(self, req: Request, t: float, out: List[Request]):
         req.finish_time = t
+        if req.slot is not None:
+            self._m.evictions.inc()
         req.slot = None
+        self._m.requests_finished.inc()
+        if req.latency is not None:
+            self._m.latency.observe(req.latency)
+        _span_instant("serving.request.finish", request=req.request_id,
+                      tokens=len(req.tokens))
         # pad the stream out to max_new_tokens (the static generate()
         # convention: pad after EOS) so output shapes are uniform
         req.tokens.extend(
@@ -256,19 +340,25 @@ class ServingEngine:
             slot = next((i for i, r in enumerate(self._slots)
                          if r is None), None)
             if slot is None:
-                return
+                break
             req = self._queue.popleft()
+            self._m.queue_depth.set(len(self._queue))
             self._key, sub = jax.random.split(self._key)
-            outp = _call_quiet(
-                self._prefill, self._pb, jnp.asarray(slot, jnp.int32),
-                jnp.asarray(req.prompt[None, :]),
-                jnp.asarray([req.seq_len], jnp.int32), sub,
-                *self._flat_kvs)
-            self._flat_kvs = list(outp[2:])
-            tok0 = int(np.asarray(outp[0])[0])
-            self._prefill_count += 1
+            with _span("serving.prefill", request=req.request_id,
+                       slot=slot, seq_len=req.seq_len):
+                outp = _call_quiet(
+                    self._prefill, self._pb, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(req.prompt[None, :]),
+                    jnp.asarray([req.seq_len], jnp.int32), sub,
+                    *self._flat_kvs)
+                self._flat_kvs = list(outp[2:])
+                tok0 = int(np.asarray(outp[0])[0])
+            self._m.prefills.inc()
+            self._m.tokens_emitted.inc()
             t = self._clock()
             req.first_token_time = t
+            if req.ttft is not None:
+                self._m.ttft.observe(req.ttft)
             req.tokens.append(tok0)
             req.remaining = req.max_new_tokens - 1
             if (self.cfg.eos_token_id is not None and
@@ -283,6 +373,8 @@ class ServingEngine:
             self._tok[slot] = tok0
             self._lens[slot] = req.seq_len
             self._done[slot] = False
+        self._m.slot_occupancy.set(
+            sum(r is not None for r in self._slots))
 
     def _block_fn(self, steps: int):
         fn = self._blocks.get(steps)
@@ -308,19 +400,21 @@ class ServingEngine:
         min_budget = min(self._slots[i].remaining for i in active)
         n = self.steps_per_call if min_budget >= self.steps_per_call \
             else 1
-        out = _call_quiet(
-            self._block_fn(n),
-            self._pb, jnp.asarray(self._tok), jnp.asarray(self._lens),
-            jnp.asarray(self._done), self._key, *self._flat_kvs)
-        toks = np.asarray(out[0])                       # [B, n]
+        with _span("serving.decode_block", steps=n, active=len(active)):
+            out = _call_quiet(
+                self._block_fn(n),
+                self._pb, jnp.asarray(self._tok), jnp.asarray(self._lens),
+                jnp.asarray(self._done), self._key, *self._flat_kvs)
+            toks = np.asarray(out[0])                   # [B, n]
         self._tok = np.array(out[1])    # np.array: writable host copies
         self._lens = np.array(out[2])
         done = np.array(out[3])
         self._key = out[4]
         self._flat_kvs = list(out[5:])
-        self._decode_steps += n
-        self._busy_slot_steps += n * len(active)
-        self._block_dispatches += 1
+        self._m.decode_steps.inc(n)
+        self._m.busy_slot_steps.inc(n * len(active))
+        self._m.block_dispatches.inc()
+        self._m.tokens_emitted.inc(n * len(active))
         t = self._clock()
         for i in active:
             req = self._slots[i]
@@ -331,6 +425,8 @@ class ServingEngine:
                 done[i] = True         # freeze the row until re-use
                 self._finish(req, t, finished)
         self._done = done
+        self._m.slot_occupancy.set(
+            sum(r is not None for r in self._slots))
         return finished
 
     def run(self, max_iters: Optional[int] = None) -> List[Request]:
@@ -357,19 +453,31 @@ class ServingEngine:
         return sorted(finished, key=lambda r: r.request_id)
 
     def stats(self) -> dict:
-        """Scheduler counters.  ``mean_slot_occupancy`` is the fraction
-        of (decode step x slot) cells that held a live request — the
-        utilization static batching forfeits on mixed-length traces."""
-        occ = (self._busy_slot_steps /
-               (self._decode_steps * self.num_slots)
-               if self._decode_steps else 0.0)
+        """Scheduler counters, read back out of the observability
+        registry as per-engine deltas (``_ServingInstruments`` — see
+        its docstring for the shared-registry and disabled-registry
+        caveats).  ``mean_slot_occupancy`` is the fraction of (decode
+        step x slot) cells that held a live request — the utilization
+        static batching forfeits on mixed-length traces."""
+        decode_steps = self._m.since_init(self._m.decode_steps)
+        busy = self._m.since_init(self._m.busy_slot_steps)
+        occ = (busy / (decode_steps * self.num_slots)
+               if decode_steps else 0.0)
         return {
             "num_slots": self.num_slots,
-            "decode_steps": self._decode_steps,
-            "busy_slot_steps": self._busy_slot_steps,
-            "block_dispatches": self._block_dispatches,
-            "prefills": self._prefill_count,
+            "decode_steps": int(decode_steps),
+            "busy_slot_steps": int(busy),
+            "block_dispatches": int(
+                self._m.since_init(self._m.block_dispatches)),
+            "prefills": int(self._m.since_init(self._m.prefills)),
             "mean_slot_occupancy": occ,
             "peak_queue": self._peak_queue,
-            "finished": len(self._finished),
+            "finished": int(
+                self._m.since_init(self._m.requests_finished)),
         }
+
+    @property
+    def metrics_registry(self):
+        """The MetricsRegistry this engine records into (the process
+        default unless one was passed at construction)."""
+        return self._m.registry

@@ -14,6 +14,7 @@ BASELINE.md).  Other optimizers fall back to `step_eager`.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, Optional
 
 import jax
@@ -22,11 +23,61 @@ import jax.numpy as jnp
 from ..core import generator as _generator
 from ..core import tape as _tape
 from ..core.tensor import Tensor
+from ..observability import metrics as _obs
+from ..observability.spans import span as _span
 from ..optimizer import SGD, Adam, AdamW, Momentum
 from ..optimizer.optimizer import Optimizer
 
 
 _UNSET = object()
+
+
+class _TrainStepInstruments:
+    """Registry handles for the train-step hot path (shared across
+    TrainStep instances; created once on first use).  A "compile" is
+    the first dispatch of a (TrainStep, block size) pair — jax traces
+    and XLA-compiles inside that call, so its wall time IS the compile
+    duration (plus one step of execution, which is noise next to
+    multi-second XLA compiles at real scale)."""
+
+    _inst = None
+
+    def __init__(self):
+        r = _obs.get_registry()
+        self.compiles = r.counter(
+            "train_step.compiles", "XLA (re)compilations of the fused "
+            "train step (first dispatch per executable)")
+        self.compile_seconds = r.histogram(
+            "train_step.compile_seconds",
+            "trace + compile + first-step wall time",
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                     120.0, 300.0))
+        self.cache_hits = r.counter(
+            "train_step.cache_hits", "dispatches served by an existing "
+            "compiled executable")
+        self.cache_misses = r.counter(
+            "train_step.cache_misses", "dispatches that had to build an "
+            "executable")
+        self.step_seconds = r.histogram(
+            "train_step.step_seconds", "per-call wall time of the "
+            "compiled step (async dispatch; excludes compile calls)")
+
+    @classmethod
+    def get(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+    def record_dispatch(self, was_compile: bool, dt: float):
+        """Account one dispatch: compile calls land in the compile
+        histogram, steady-state calls in the step histogram."""
+        if was_compile:
+            self.compiles.inc()
+            self.cache_misses.inc()
+            self.compile_seconds.observe(dt)
+        else:
+            self.cache_hits.inc()
+            self.step_seconds.observe(dt)
 
 
 def _functional_sgd(p, g, state, lr, hp):
@@ -235,6 +286,28 @@ class TrainStep:
         if isinstance(opt, SGD):
             return _functional_sgd, {}
         return None, None
+
+    def _compile_probe(self, fn, flag_attr: str):
+        """Closure that, called AFTER a dispatch of ``fn``, reports
+        whether that dispatch traced+compiled: jit-cache growth when
+        jax's private ``_cache_size`` probe exists (catches shape-change
+        retraces too), else a first-dispatch flag on ``self``."""
+        csize = getattr(fn, "_cache_size", None)
+        if csize is not None:
+            try:
+                n0 = csize()
+                return lambda: csize() > n0
+            except Exception:
+                pass
+        first = not getattr(self, flag_attr, False)
+
+        def probe():
+            # flag set only here, AFTER a successful dispatch: if the
+            # first dispatch raised, the retry still counts as compile
+            setattr(self, flag_attr, True)
+            return first
+
+        return probe
 
     def _mesh(self):
         """Resolve mesh= (accepts jax Mesh, ProcessMesh, or None→global)."""
@@ -509,10 +582,12 @@ class TrainStep:
         per iteration, constant lr).  Amortizes per-dispatch host latency —
         benchmarking/microbenchmark use; real epochs feed fresh batches
         through ``__call__``.  Returns the last step's loss."""
+        m = _TrainStepInstruments.get()
         if self._state is None:
             self._state = self._init_state()
             self._gm_state = self._init_gm_state()
-            self._build()
+            with _span("train_step.build"):
+                self._build()
         if not hasattr(self, "_multi_cache"):
             self._multi_cache = {}
         fn = self._multi_cache.get(steps)
@@ -542,9 +617,13 @@ class TrainStep:
         lr = jnp.float32(self.optimizer.get_lr())
         p_values = [p._value for p in self._params]
         b_values = [b._value for b in self._buffers]
-        new_p, self._state, self._gm_state, loss, new_b = fn(
-            p_values, self._state, self._gm_state, key, lr, b_values,
-            *arrays)
+        probe = self._compile_probe(fn, f"_dispatched_multi_{steps}")
+        t0 = time.perf_counter()
+        with _span("train_step.run_steps", steps=steps):
+            new_p, self._state, self._gm_state, loss, new_b = fn(
+                p_values, self._state, self._gm_state, key, lr, b_values,
+                *arrays)
+        m.record_dispatch(probe(), time.perf_counter() - t0)
         for p, v in zip(self._params, new_p):
             p._value = v
         for b, v in zip(self._buffers, new_b):
@@ -552,18 +631,30 @@ class TrainStep:
         return Tensor(loss)
 
     def __call__(self, *inputs):
+        m = _TrainStepInstruments.get()
         if self._state is None:
             self._state = self._init_state()
             self._gm_state = self._init_gm_state()
-            self._build()
+            with _span("train_step.build"):
+                self._build()
+        # a dispatch that grows the jit executable cache is a compile —
+        # catches the first call AND input-shape-change retraces (which
+        # would otherwise pollute the step-time histogram with
+        # multi-second outliers); falls back to a first-dispatch flag
+        # where the private _cache_size probe is unavailable
+        probe = self._compile_probe(self._compiled, "_dispatched")
         arrays = [self._shard_batch(i) for i in inputs]
         key = _generator.default_generator().next_key()
         lr = jnp.float32(self.optimizer.get_lr())
         p_values = [p._value for p in self._params]
         b_values = [b._value for b in self._buffers]
-        new_p, self._state, self._gm_state, loss, aux, new_b = self._compiled(
-            p_values, self._state, self._gm_state, key, lr, b_values,
-            *arrays)
+        t0 = time.perf_counter()
+        with _span("train_step.call"):
+            new_p, self._state, self._gm_state, loss, aux, new_b = \
+                self._compiled(
+                    p_values, self._state, self._gm_state, key, lr,
+                    b_values, *arrays)
+        m.record_dispatch(probe(), time.perf_counter() - t0)
         for p, v in zip(self._params, new_p):
             p._value = v
         for b, v in zip(self._buffers, new_b):

@@ -1,0 +1,35 @@
+"""paddle_tpu.observability — always-on runtime metrics + structured
+tracing.
+
+Two cooperating layers (see the module docstrings for design notes):
+
+- :mod:`~paddle_tpu.observability.metrics` — a process-wide
+  ``MetricsRegistry`` of named Counter/Gauge/Histogram instruments with
+  Prometheus-text and JSON exporters and a ``snapshot()``/
+  ``diff_snapshots()`` API for benches.  The serving engine, TrainStep,
+  the Pallas decode-attention routing gate and the kernel tuner record
+  into the default registry.
+- :mod:`~paddle_tpu.observability.spans` — ``span(name, **attrs)``
+  ranges over ``runtime.HostTracer`` and ``merge_chrome_traces`` to
+  stitch the host trace with the ``jax.profiler`` device dump into one
+  Perfetto-loadable file.
+
+The reference analogue is ``paddle/fluid/platform/profiler`` plus its
+benchmark/stat utilities; here the metrics side is pull-model (scrape
+or snapshot) so hot paths never block on an exporter.
+"""
+
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, DEFAULT_BUCKETS, NAME_RE,
+    diff_snapshots, get_registry,
+)
+from .spans import (  # noqa: F401
+    format_span_name, instant, merge_chrome_traces, parse_span_name, span,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
+    "NAME_RE", "diff_snapshots", "get_registry",
+    "span", "instant", "format_span_name", "parse_span_name",
+    "merge_chrome_traces",
+]

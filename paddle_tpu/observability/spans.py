@@ -1,0 +1,205 @@
+"""Structured spans over the host tracer + host/device trace merging.
+
+``span(name, **attrs)`` is the structured face of
+``runtime.HostTracer``: a range on the calling thread's lane whose
+attributes are encoded into the event name (the tracer's native event
+tuple has no args field — native C++ and Python fallback share the
+``(kind, t0, t1, tid, value, name)`` schema), using ``;k=v`` suffixes
+that ``parse_span_name`` and the chrome-trace merger decode back into
+Perfetto ``args``.  When the tracer is disabled ``__enter__`` is one
+attribute load + bool test — attrs are never formatted — so
+instrumented hot loops (the serving scheduler) pay nothing outside a
+profiling window.
+
+``merge_chrome_traces`` stitches the host chrome trace and the
+``jax.profiler`` device dump (the ``*.trace.json.gz`` files
+``DeviceSummaryView._load`` reads) into ONE Perfetto-loadable JSON:
+host lanes keep pid 0, device processes are re-numbered into a disjoint
+pid range, and metadata (process/thread names) is preserved.  The two
+clock domains are not re-aligned — Perfetto shows them as separate
+process groups, which is what correlating "queue stall here, device
+idle there" needs in practice.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from typing import Optional
+
+from .. import runtime as rt
+
+_ATTR_SEP = ";"
+
+# tracing-window generation: bumped by Profiler at every record-window
+# start (after HostTracer.clear()).  Ranges opened in an earlier window
+# no longer exist on the tracer, so a close crossing a window boundary
+# must become a no-op instead of popping an unrelated range.
+_trace_gen = 0
+
+
+def current_trace_generation() -> int:
+    return _trace_gen
+
+
+def bump_trace_generation() -> int:
+    global _trace_gen
+    _trace_gen += 1
+    return _trace_gen
+
+
+def _esc_attr(v) -> str:
+    """Escape ``;``/``=`` in attr values so a value cannot fabricate
+    extra attrs on re-parse (same contract as the metrics label-key
+    escaping; inverse is ``_unesc_attr``)."""
+    return (str(v).replace("%", "%25").replace(";", "%3B")
+            .replace("=", "%3D"))
+
+
+def _unesc_attr(v: str) -> str:
+    return v.replace("%3D", "=").replace("%3B", ";").replace("%25", "%")
+
+
+def format_span_name(name: str, attrs: dict) -> str:
+    if not attrs:
+        return name
+    return name + _ATTR_SEP + _ATTR_SEP.join(
+        f"{k}={_esc_attr(v)}" for k, v in attrs.items())
+
+
+def parse_span_name(encoded: str):
+    """Inverse of ``format_span_name``: ``(name, attrs_dict)``."""
+    if _ATTR_SEP not in encoded:
+        return encoded, {}
+    name, *parts = encoded.split(_ATTR_SEP)
+    attrs = {}
+    for p in parts:
+        k, _, v = p.partition("=")
+        if k:
+            attrs[k] = _unesc_attr(v)
+    return name, attrs
+
+
+class span:
+    """Context manager recording a named host range with attributes.
+
+    with span("serving.decode_block", steps=4, active=7):
+        run_block()
+
+    Re-entrant per instance is NOT supported (one range per ``with``);
+    nesting distinct spans is (the tracer keeps a per-thread stack).
+    """
+
+    __slots__ = ("_name", "_attrs", "_active", "_gen")
+
+    def __init__(self, name: str, **attrs):
+        self._name = name
+        self._attrs = attrs
+        self._active = False
+        self._gen = 0
+
+    def __enter__(self):
+        if rt.HostTracer.enabled:
+            self._active = True
+            self._gen = _trace_gen
+            rt.HostTracer.begin(format_span_name(self._name, self._attrs))
+        return self
+
+    def __exit__(self, *exc):
+        if self._active:
+            self._active = False
+            # a window boundary between enter and exit invalidated the
+            # opened range — closing now would pop someone else's
+            if self._gen == _trace_gen:
+                rt.HostTracer.end()
+        return False
+
+
+def instant(name: str, **attrs):
+    """Zero-duration marker (request queued / finished) with attrs."""
+    if rt.HostTracer.enabled:
+        rt.HostTracer.instant(format_span_name(name, attrs))
+
+
+def _host_events_as_chrome(events) -> list:
+    """HostTracer event tuples -> chrome trace events, span-attr names
+    decoded into ``args``."""
+    out = []
+    for kind, t0, t1, tid, value, raw in events:
+        name, attrs = parse_span_name(raw)
+        e = {"name": name, "pid": 0, "tid": tid, "ts": t0 / 1e3}
+        if attrs:
+            e["args"] = attrs
+        if kind == 0:
+            e.update(ph="X", dur=(t1 - t0) / 1e3)
+        elif kind == 1:
+            e.update(ph="i", s="t")
+        else:
+            e.update(ph="C", args={"value": value, **attrs})
+        out.append(e)
+    return out
+
+
+def merge_chrome_traces(out_path: str, host=None,
+                        device_trace_dir: Optional[str] = None) -> dict:
+    """Write one chrome/Perfetto JSON combining host spans and the
+    jax.profiler device capture.
+
+    ``host``: path to an exported host chrome trace, a list of
+    HostTracer event tuples, or None (= the live tracer buffer).
+    ``device_trace_dir``: the ``Profiler.device_trace_dir`` /
+    ``jax.profiler.start_trace`` directory; None or a dir without
+    captures yields a host-only trace (still valid JSON).
+
+    Returns summary counts: ``{"host_events", "device_events",
+    "device_processes", "path"}``.
+    """
+    events = [{"ph": "M", "pid": 0, "name": "process_name",
+               "args": {"name": "host (paddle_tpu.runtime.HostTracer)"}}]
+    if host is None:
+        host_events = _host_events_as_chrome(rt.HostTracer.events())
+    elif isinstance(host, str):
+        with open(host) as f:
+            host_events = json.load(f).get("traceEvents", [])
+        # an exported host trace carries raw encoded names — decode the
+        # span-attr suffixes here too, so all three input forms honor
+        # the "attrs land as Perfetto args" contract
+        for e in host_events:
+            raw = e.get("name", "")
+            if _ATTR_SEP in raw:
+                e["name"], attrs = parse_span_name(raw)
+                if attrs:
+                    e["args"] = {**attrs, **e.get("args", {})}
+    else:
+        host_events = _host_events_as_chrome(host)
+    events.extend(host_events)
+
+    n_dev = 0
+    pid_map = {}
+    if device_trace_dir:
+        # device pids are renumbered from 1000 upward per (file, pid) so
+        # multiple capture files cannot collide with each other or host
+        for path in sorted(glob.glob(os.path.join(
+                device_trace_dir, "**", "*.trace.json.gz"),
+                recursive=True)):
+            with gzip.open(path, "rt") as f:
+                raw = json.load(f).get("traceEvents", [])
+            for e in raw:
+                pid = e.get("pid")
+                if pid is None:
+                    continue
+                key = (path, pid)
+                if key not in pid_map:
+                    pid_map[key] = 1000 + len(pid_map)
+                e = dict(e)
+                e["pid"] = pid_map[key]
+                events.append(e)
+                if e.get("ph") != "M":
+                    n_dev += 1
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
+    return {"host_events": len(host_events), "device_events": n_dev,
+            "device_processes": len(pid_map), "path": out_path}

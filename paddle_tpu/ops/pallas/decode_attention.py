@@ -74,32 +74,68 @@ def decode_attn_sig(b, hkv, g, s, d, dtype):
     return f"{b}x{hkv}x{g}x{s}x{d}/{np.dtype(dtype)}"
 
 
-def should_use_pallas(q4, cache) -> bool:
+def _route_decision(q4, cache):
+    """(use_pallas, reason) for the decode-attention dispatch gate —
+    the reason string feeds the ``pallas.decode_attention.route``
+    fallback-rate counter."""
     from ...core.flags import flag
-    if not flag("use_decode_attention_kernel") or not pallas_enabled():
-        return False
+    if not flag("use_decode_attention_kernel"):
+        return False, "flag_disabled"
+    if not pallas_enabled():
+        return False, "pallas_unavailable"
     if cache.ndim != 3:
-        return False
+        return False, "unpacked_cache"
     if jnp.dtype(q4.dtype) != jnp.dtype(cache.dtype):
         # mixed-precision serving configs (bf16 compute x f32/int8
         # cache) would route an untested mixed-dtype dot into the
         # Mosaic kernel; keep them on the XLA fallback, which casts
         # explicitly (fp32 logits, V cast at the PV dot)
-        return False
+        return False, "dtype_mismatch"
     b, hkv, g, d = q4.shape
     s, w = cache.shape[1], cache.shape[2]
     if not packed_ok(hkv, d) or w != hkv * d:
-        return False
+        return False, "geometry"
     if g > _GPAD:        # q_cat blocks hold at most 8 query heads/KV head
-        return False
+        return False, "group_too_wide"
     if s % 8:
-        return False
+        return False, "seq_align"
     itemsize = jnp.dtype(cache.dtype).itemsize
     gw = max(_LANES, d)
     lg_bytes = (w // gw) * (gw // d) * _GPAD * s * 4
     if 2 * s * w * itemsize + lg_bytes > _VMEM_BUDGET:
-        return False
-    return True
+        return False, "vmem_budget"
+    return True, "ok"
+
+
+_route_counter_inst = None
+
+
+def _route_counter():
+    # resolved once: the gate runs per trace AND per eager/interpret
+    # decode step, so the registry lookup must not be on that path.
+    # Always the PROCESS-DEFAULT registry: the gate is a free function
+    # with no engine context, so route decisions are process-global —
+    # engines holding a private registry= still contribute here, and a
+    # private registry's export carries no route series
+    global _route_counter_inst
+    if _route_counter_inst is None:
+        from ...observability import metrics as _obs
+        _route_counter_inst = _obs.get_registry().counter(
+            "pallas.decode_attention.route",
+            "decode-attention dispatch decisions (pallas kernel vs XLA "
+            "fallback, with the gating reason)",
+            labels=("decision", "reason"))
+    return _route_counter_inst
+
+
+def should_use_pallas(q4, cache) -> bool:
+    use, reason = _route_decision(q4, cache)
+    # counted at trace/gate time (once per compiled program or direct
+    # query, not per device step): the always-on Pallas-fallback-rate
+    # signal the bench JSON and Prometheus scrape expose
+    _route_counter().inc(decision="pallas" if use else "xla",
+                         reason=reason)
+    return use
 
 
 def _kernel(lens_ref, qcat_ref, k_hbm, v_hbm, o_ref,

@@ -65,18 +65,37 @@ def tune_kernel(kernel: str, sig: str, make_fn: Callable,
     can hurt in-model where the standalone timing context differs).
     Returns ``(best_config, table)``; table entries are
     ``(config, seconds | None)`` (None = failed to compile/run)."""
+    import time as _time
+
+    from ...observability import metrics as _obs
+    from ...observability.spans import span as _span
+    reg = _obs.get_registry()
+    trial_count = reg.counter(
+        "tuner.trials", "schedule-search candidate trials",
+        labels=("kernel", "outcome"))
+    trial_seconds = reg.histogram(
+        "tuner.trial_seconds",
+        "wall time per candidate trial (compile + timed iters)",
+        labels=("kernel",))
     table: List = []
     errors: List = []
     best, best_t = None, float("inf")
     default_t = None
     for cand in candidates:
         cand_t = cand if isinstance(cand, tuple) else (cand,)
+        w0 = _time.perf_counter()
         try:
-            t = _time_candidate(make_fn(*cand_t), args, iters=iters)
+            with _span("tuner.trial", kernel=kernel, sig=sig,
+                       candidate=cand):
+                t = _time_candidate(make_fn(*cand_t), args, iters=iters)
         except Exception as e:
+            trial_count.inc(kernel=kernel, outcome="error")
+            trial_seconds.observe(_time.perf_counter() - w0, kernel=kernel)
             table.append((cand, None))
             errors.append((cand, str(e)[:200]))
             continue
+        trial_count.inc(kernel=kernel, outcome="ok")
+        trial_seconds.observe(_time.perf_counter() - w0, kernel=kernel)
         table.append((cand, t))
         if cand == default:
             default_t = t

@@ -80,52 +80,141 @@ def export_chrome_tracing(dir_name: str,
     return handler
 
 
+def _mismatch_counter():
+    from ..observability import metrics as _obs
+    return _obs.get_registry().counter(
+        "profiler.record_event_mismatches",
+        "RecordEvent.end() calls without a matching begin() "
+        "(made no-ops instead of corrupting the tracer stack)")
+
+
 class RecordEvent:
-    """User-scoped host range (≙ python/paddle/profiler/utils.py:38)."""
+    """User-scoped host range (≙ python/paddle/profiler/utils.py:38).
+
+    Begin/end are depth-guarded: ``end()`` without a matching
+    ``begin()`` (including a double-``end()`` from explicit use plus
+    ``__exit__``) is a no-op that warns and bumps the
+    ``profiler.record_event_mismatches`` counter — an unmatched
+    ``HostTracer.end()`` would otherwise pop someone ELSE's range off
+    the per-thread tracer stack and silently corrupt the trace."""
 
     def __init__(self, name: str, event_type: str = "UserDefined"):
         self.name = name
         self.event_type = event_type
+        # one entry per OPEN range: the trace generation it was opened
+        # in (a plain depth int + single gen would let a re-begin()
+        # inside a new window launder a stale open across the boundary)
+        self._opens: list = []
 
     def __enter__(self):
         self.begin()
         return self
 
     def __exit__(self, *exc):
-        self.end()
+        # exiting a with-block whose range was already closed by an
+        # explicit end() is the documented early-stop idiom — close
+        # only if this instance still owns an open range, never warn
+        if self._opens:
+            self._pop_if_same_window()
         return False
 
+    def _pop_if_same_window(self):
+        """Pop the tracer range unless a record-window boundary since
+        its begin() invalidated it (popping then would close an
+        unrelated range from the NEW window)."""
+        from ..observability import spans as _spans
+        if self._opens.pop() == _spans.current_trace_generation():
+            rt.HostTracer.end()
+        else:
+            _mismatch_counter().inc()
+
     def begin(self):
-        rt.HostTracer.begin(self.name)
+        # only ranges the tracer actually opened are tracked: a
+        # begin() outside a profiling window pushes nothing, so a later
+        # end() INSIDE a window must not pop an unrelated range
+        if rt.HostTracer.enabled:
+            from ..observability import spans as _spans
+            self._opens.append(_spans.current_trace_generation())
+            rt.HostTracer.begin(self.name)
 
     def end(self):
-        rt.HostTracer.end()
+        if self._opens:
+            self._pop_if_same_window()
+            return
+        # depth 0 with tracing OFF is the normal un-profiled path (the
+        # paired begin() counted nothing) — only an in-window unmatched
+        # end() is a caller bug worth warning about
+        if rt.HostTracer.enabled:
+            import warnings
+            _mismatch_counter().inc()
+            warnings.warn(
+                f"RecordEvent({self.name!r}).end() without a matching "
+                f"begin(); ignored", RuntimeWarning, stacklevel=2)
 
 
 class _EventStat:
-    __slots__ = ("count", "total_ns", "max_ns", "min_ns")
+    __slots__ = ("count", "total_ns", "max_ns", "min_ns", "self_ns",
+                 "instants")
 
     def __init__(self):
         self.count = 0
         self.total_ns = 0
         self.max_ns = 0
         self.min_ns = None
+        self.self_ns = 0
+        self.instants = 0
 
-    def add(self, dur: int):
+    def add(self, dur: int, self_ns: int):
         self.count += 1
         self.total_ns += dur
+        self.self_ns += self_ns
         self.max_ns = max(self.max_ns, dur)
         self.min_ns = dur if self.min_ns is None else min(self.min_ns, dur)
 
 
 class SummaryView:
-    """Aggregated per-name host event table (≙ profiler_statistic.py)."""
+    """Aggregated per-name host event table (≙ profiler_statistic.py).
+
+    ``total`` for a name sums its ranges INCLUSIVE of children (so a
+    parent scope double-counts its nested ranges there — that is the
+    chrome-trace convention); ``self`` subtracts each range's DIRECT
+    children, so the self column partitions wall time without double
+    counting.  Instant events are tallied per name as zero-duration
+    occurrences instead of being dropped.  Span attr suffixes
+    (``name;k=v`` from ``observability.spans``) are stripped before
+    aggregation, so 100 ``serving.prefill`` spans with distinct request
+    ids land in ONE row, not 100."""
 
     def __init__(self, events):
+        from ..observability.spans import parse_span_name
         self.stats = defaultdict(_EventStat)
+        per_tid = defaultdict(list)
         for kind, t0, t1, tid, value, name in events:
+            name = parse_span_name(name)[0]
             if kind == 0:  # range
-                self.stats[name].add(t1 - t0)
+                per_tid[tid].append((t0, t1, name))
+            elif kind == 1:  # instant
+                self.stats[name].instants += 1
+        for ranges in per_tid.values():
+            # sweep in start order (ties: widest first = parent first);
+            # a stack entry is [t1, child_ns, t0, name] and child time
+            # is charged to the DIRECT parent only
+            stack = []
+
+            def close(entry):
+                t1, child_ns, t0, name = entry
+                dur = t1 - t0
+                self.stats[name].add(dur, max(dur - child_ns, 0))
+
+            for t0, t1, name in sorted(ranges,
+                                       key=lambda r: (r[0], -r[1])):
+                while stack and stack[-1][0] <= t0:
+                    close(stack.pop())
+                if stack:
+                    stack[-1][1] += t1 - t0
+                stack.append([t1, 0, t0, name])
+            while stack:
+                close(stack.pop())
 
     def rows(self):
         out = []
@@ -134,20 +223,24 @@ class SummaryView:
             out.append({
                 "name": name, "calls": s.count,
                 "total_ms": s.total_ns / 1e6,
-                "avg_ms": s.total_ns / s.count / 1e6,
+                "self_ms": s.self_ns / 1e6,
+                "avg_ms": (s.total_ns / s.count / 1e6) if s.count else 0.0,
                 "max_ms": s.max_ns / 1e6,
                 "min_ms": (s.min_ns or 0) / 1e6,
+                "instants": s.instants,
             })
         return out
 
     def table(self) -> str:
         rows = self.rows()
-        header = f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}" \
+        header = f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}" \
+                 f"{'Self(ms)':>12}{'Avg(ms)':>12}" \
                  f"{'Max(ms)':>12}{'Min(ms)':>12}"
         lines = [header, "-" * len(header)]
         for r in rows:
             lines.append(
                 f"{r['name'][:39]:<40}{r['calls']:>8}{r['total_ms']:>12.3f}"
+                f"{r['self_ms']:>12.3f}"
                 f"{r['avg_ms']:>12.3f}{r['max_ms']:>12.3f}{r['min_ms']:>12.3f}")
         return "\n".join(lines)
 
@@ -295,7 +388,11 @@ class Profiler:
         self.current_state = new
 
     def _start_record(self):
+        from ..observability import spans as _spans
         rt.HostTracer.clear()
+        # invalidate ranges opened in any previous window: their tracer
+        # stack entries did not survive the clear/disable boundary
+        _spans.bump_trace_generation()
         rt.HostTracer.enable()
         if not self.timer_only and any(
                 t in (ProfilerTarget.TPU, ProfilerTarget.GPU,
@@ -334,6 +431,22 @@ class Profiler:
 
     def summary(self) -> SummaryView:
         return SummaryView(self.events())
+
+    def metrics(self) -> dict:
+        """Snapshot of the process-wide observability registry
+        (serving/train-step/kernel-dispatch instruments) — the
+        always-on counters that complement the windowed event trace."""
+        from ..observability import metrics as _obs
+        return _obs.get_registry().snapshot()
+
+    def export_merged_trace(self, path: str) -> dict:
+        """Stitch the recorded host events and the device capture (when
+        a device target completed a record window) into ONE
+        Perfetto-loadable chrome trace at ``path``."""
+        from ..observability.spans import merge_chrome_traces
+        return merge_chrome_traces(
+            path, host=self.events(),
+            device_trace_dir=self._device_trace_dir)
 
     def export_chrome_trace(self, path: str):
         rt.HostTracer.export_chrome_trace(path)

@@ -233,6 +233,9 @@ def test_train_step_compiled_matches_eager():
     def loss_fn(net, xb, yb):
         return ((net(xb) - yb) ** 2).mean()
 
+    from paddle_tpu.observability import diff_snapshots, get_registry
+
+    obs_before = get_registry().snapshot()
     step = TrainStep(net2, loss_fn, opt2)
     for i in range(5):
         xb, yb = paddle.to_tensor(x), paddle.to_tensor(y)
@@ -246,6 +249,14 @@ def test_train_step_compiled_matches_eager():
         np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-4)
     np.testing.assert_allclose(net1.weight.numpy(), net2.weight.numpy(),
                                rtol=1e-4, atol=1e-5)
+    # observability: 5 dispatches = 1 compile (first call) + 4 cache hits,
+    # compile/step wall-time histograms populated
+    d = diff_snapshots(obs_before, get_registry().snapshot())
+    assert d["train_step.compiles"]["values"][""] == 1
+    assert d["train_step.cache_misses"]["values"][""] == 1
+    assert d["train_step.cache_hits"]["values"][""] == 4
+    assert d["train_step.compile_seconds"]["values"][""]["count"] == 1
+    assert d["train_step.step_seconds"]["values"][""]["count"] == 4
 
 
 def test_model_train_metrics_and_progress(capsys):

@@ -1,0 +1,445 @@
+"""Observability layer (paddle_tpu/observability/): metrics registry
+semantics, exporters, snapshot/diff, span encoding, host+device chrome
+trace merging, ServingEngine instrumentation (stats() == registry), the
+disabled-mode overhead contract, and the instrument-name lint.
+
+Tier-1 budget discipline: ONE module-scoped engine run covers the
+serving acceptance criteria (Prometheus export, merged trace, stats
+equality, decode-block timing) — tiny llama shapes, no Pallas compile;
+registry-only tests are pure Python."""
+
+import gzip
+import importlib.util
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.observability import (
+    MetricsRegistry, diff_snapshots, format_span_name, get_registry,
+    merge_chrome_traces, parse_span_name, span,
+)
+from paddle_tpu.profiler import Profiler, ProfilerTarget
+
+
+# ---------------------------------------------------------------------------
+# registry semantics (pure python)
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("t.requests", "help text")
+    c.inc()
+    c.inc(4)
+    assert c.value() == 5
+    with pytest.raises(ValueError, match=">= 0"):
+        c.inc(-1)
+
+    g = reg.gauge("t.depth")
+    g.set(3)
+    g.set(1)
+    g.add(2)
+    assert g.value() == 3
+    assert g.hwm() == 3
+
+    h = reg.histogram("t.lat", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5):
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 4
+    assert abs(s["sum"] - 0.605) < 1e-9
+    assert 0.01 <= s["p50"] <= 0.1          # 2nd/3rd obs in (0.01, 0.1]
+    assert 0.1 <= s["p99"] <= 1.0
+
+
+def test_labels_and_registration_rules():
+    reg = MetricsRegistry()
+    c = reg.counter("t.route", labels=("decision", "reason"))
+    c.inc(decision="pallas", reason="ok")
+    c.inc(2, decision="xla", reason="vmem")
+    assert c.value(decision="pallas", reason="ok") == 1
+    assert c.value(decision="xla", reason="vmem") == 2
+    assert c.value(decision="xla", reason="other") == 0
+    with pytest.raises(ValueError, match="label"):
+        c.inc(decision="pallas")            # missing label
+    # re-registration: same type+labels returns the SAME instrument
+    assert reg.counter("t.route", labels=("decision", "reason")) is c
+    with pytest.raises(ValueError, match="labels"):
+        reg.counter("t.route", labels=("decision",))
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("t.route")
+    with pytest.raises(ValueError, match="invalid instrument name"):
+        reg.counter("Bad-Name")
+    with pytest.raises(ValueError, match="invalid instrument name"):
+        reg.counter("9starts.with.digit")
+    # histogram bucket conflicts must raise, not silently keep old bounds
+    h = reg.histogram("t.lat2", buckets=(0.1, 1.0))
+    assert reg.histogram("t.lat2", buckets=(1.0, 0.1)) is h  # same sorted
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("t.lat2", buckets=(0.5, 5.0))
+
+
+def test_disabled_registry_is_noop():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("t.c")
+    g = reg.gauge("t.g")
+    h = reg.histogram("t.h")
+    c.inc(5)
+    g.set(9)
+    h.observe(1.0)
+    assert c.value() == 0 and g.value() == 0
+    assert h.summary()["count"] == 0
+    reg.enable()
+    c.inc()
+    assert c.value() == 1
+
+
+def test_snapshot_diff_and_json():
+    reg = MetricsRegistry()
+    c = reg.counter("t.c")
+    g = reg.gauge("t.g")
+    h = reg.histogram("t.h", buckets=(0.1, 1.0))
+    c.inc(3)
+    h.observe(0.05)
+    before = reg.snapshot()
+    c.inc(2)
+    g.set(7)
+    h.observe(0.5)
+    h.observe(0.5)
+    after = reg.snapshot()
+    json.dumps(after)                        # snapshot is serializable
+    d = diff_snapshots(before, after)
+    assert d["t.c"]["values"][""] == 2
+    assert d["t.g"]["values"][""] == 7
+    cell = d["t.h"]["values"][""]
+    assert cell["count"] == 2                # the pre-existing obs diffed out
+    assert abs(cell["sum"] - 1.0) < 1e-9
+    assert 0.1 <= cell["p50"] <= 1.0
+    # instruments that did not move during the window drop out —
+    # including gauges (a stale level must not be re-attributed)
+    assert diff_snapshots(after, after) == {}
+    # ...and so do individual zero-delta label cells of a counter
+    regl = MetricsRegistry()
+    cl = regl.counter("t.route", labels=("reason",))
+    cl.inc(reason="a")
+    b0 = regl.snapshot()
+    cl.inc(reason="b")
+    dl = diff_snapshots(b0, regl.snapshot())
+    assert dl["t.route"]["values"] == {"reason=b": 1}
+
+
+def test_prometheus_text_format():
+    reg = MetricsRegistry()
+    reg.counter("t.tokens", "tokens").inc(12)
+    reg.gauge("t.depth").set(4)
+    h = reg.histogram("t.lat", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    text = reg.to_prometheus_text()
+    assert "# TYPE t_tokens counter" in text
+    assert "t_tokens 12" in text
+    assert "t_depth 4" in text
+    assert 't_lat_bucket{le="0.1"} 1' in text
+    assert 't_lat_bucket{le="+Inf"} 2' in text
+    assert "t_lat_count 2" in text
+    assert '# TYPE t_lat_quantile gauge' in text
+    assert 't_lat_quantile{quantile="0.99"}' in text
+
+
+def test_prometheus_text_quotes_label_values():
+    reg = MetricsRegistry()
+    c = reg.counter("t.route", labels=("decision", "reason"))
+    c.inc(3, decision="xla", reason="vmem_budget")
+    h = reg.histogram("t.trial", labels=("kernel",), buckets=(0.1, 1.0))
+    h.observe(0.5, kernel="rms_norm")
+    text = reg.to_prometheus_text()
+    # exposition grammar: label VALUES must be double-quoted
+    assert 't_route{decision="xla",reason="vmem_budget"} 3' in text
+    assert 't_trial_bucket{kernel="rms_norm",le="1.0"} 1' in text
+    assert 't_trial_count{kernel="rms_norm"} 1' in text
+    assert 't_trial_quantile{kernel="rms_norm",quantile="0.99"}' in text
+    import re as _re
+    assert not _re.search(r"\{[^}\"]*=[^\"][^}]*\}", text), \
+        "unquoted label value leaked into exposition output"
+    # hostile label values cannot fabricate extra labels: ','/'=' are
+    # escaped in the snapshot key and restored verbatim on export
+    e = reg.counter("t.err", labels=("kind",))
+    e.inc(kind="a,b=c")
+    assert e.value(kind="a,b=c") == 1
+    text2 = reg.to_prometheus_text()
+    assert 't_err{kind="a,b=c"} 1' in text2
+    assert 'b="c"' not in text2
+
+
+def test_span_name_roundtrip():
+    enc = format_span_name("serving.prefill", {"request": 3, "slot": 1})
+    assert enc == "serving.prefill;request=3;slot=1"
+    name, attrs = parse_span_name(enc)
+    assert name == "serving.prefill"
+    assert attrs == {"request": "3", "slot": "1"}
+    assert parse_span_name("plain") == ("plain", {})
+    # hostile attr values cannot fabricate extra attrs on re-parse
+    name2, attrs2 = parse_span_name(
+        format_span_name("myapp.handle", {"url": "a=1;b=2"}))
+    assert name2 == "myapp.handle" and attrs2 == {"url": "a=1;b=2"}
+
+
+# ---------------------------------------------------------------------------
+# pallas routing counter
+# ---------------------------------------------------------------------------
+
+def test_decode_attention_route_counter(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import decode_attention as da
+
+    monkeypatch.setattr(da, "pallas_enabled", lambda: True)
+    c = get_registry().counter("pallas.decode_attention.route",
+                               labels=("decision", "reason"))
+    base_mix = c.value(decision="xla", reason="dtype_mismatch")
+    base_ok = c.value(decision="pallas", reason="ok")
+    q4 = jax.ShapeDtypeStruct((2, 2, 2, 64), jnp.float32)
+    kc_bf16 = jax.ShapeDtypeStruct((2, 16, 128), jnp.bfloat16)
+    assert not da.should_use_pallas(q4, kc_bf16)
+    assert c.value(decision="xla",
+                   reason="dtype_mismatch") == base_mix + 1
+    kc_f32 = jax.ShapeDtypeStruct((2, 16, 128), jnp.float32)
+    assert da.should_use_pallas(q4, kc_f32)
+    assert c.value(decision="pallas", reason="ok") == base_ok + 1
+
+
+# train-step compile/step instrument coverage piggybacks on the existing
+# TrainStep parity test (tests/test_amp_io_jit.py::
+# test_train_step_compiled_matches_eager) — no extra XLA compile here.
+
+# ---------------------------------------------------------------------------
+# serving engine instrumentation — ONE module-scoped trace covers the
+# acceptance criteria (export, merged trace, stats equality, overhead)
+# ---------------------------------------------------------------------------
+
+P, C = 6, 32
+SPECS = [(4, 4), (3, 3), (5, 2)]           # (seq_len, max_new)
+
+
+@pytest.fixture(scope="module")
+def served():
+    paddle.seed(2024)
+    # 1-layer tiny config + steps_per_call=1 (ONE decode-block compile):
+    # tier-1 is truncation-scored, so this module keeps XLA work minimal
+    cfg = models.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64)
+    net = models.LlamaForCausalLM(cfg)
+    net.eval()
+    reg = MetricsRegistry()
+    eng = ServingEngine(net, num_slots=2, prompt_len=P, max_cache_len=C,
+                        steps_per_call=1, compute_dtype="float32",
+                        registry=reg)
+    rng = np.random.default_rng(7)
+    with Profiler(targets=[ProfilerTarget.CPU]) as prof:
+        reqs = [eng.submit(
+            rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32),
+            max_new_tokens=m) for n, m in SPECS]
+        done = eng.run()
+    stats = eng.stats()
+    host_events = prof.events()
+
+    # disabled-mode decode-block timing: the registry is off, so every
+    # instrument touch in step() is the one-bool-check fast path; the
+    # tracer is off too (outside the profiler window)
+    reg.disable()
+    eng.submit(rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32),
+               max_new_tokens=16)
+    step_times = []
+    while eng._queue or any(s is not None for s in eng._slots):
+        t0 = time.perf_counter()
+        eng.step()
+        step_times.append(time.perf_counter() - t0)
+    reg.enable()
+    return SimpleNamespace(reg=reg, eng=eng, reqs=reqs, done=done,
+                           stats=stats, host_events=host_events,
+                           step_times=step_times)
+
+
+def test_serving_prometheus_export(served):
+    text = served.reg.to_prometheus_text()
+    assert "# TYPE serving_queue_depth gauge" in text
+    assert "serving_slot_occupancy" in text
+    assert "serving_slots_total 2" in text
+    assert f"serving_prefills {len(SPECS)}" in text
+    assert "serving_tokens_emitted" in text
+    assert "serving_request_latency_seconds_bucket" in text
+    assert 'serving_request_latency_seconds_quantile{quantile="0.99"}' \
+        in text
+    assert 'serving_ttft_seconds_quantile{quantile="0.50"}' in text
+
+
+def test_serving_stats_equal_registry(served):
+    """Acceptance (c): stats() is derived FROM the registry; with a
+    fresh per-engine registry the per-engine deltas equal the raw
+    instrument values."""
+    s, reg = served.stats, served.reg
+    assert s["decode_steps"] == reg.get("serving.decode_steps").value()
+    assert s["busy_slot_steps"] == \
+        reg.get("serving.busy_slot_steps").value()
+    assert s["block_dispatches"] == \
+        reg.get("serving.block_dispatches").value()
+    assert s["prefills"] == reg.get("serving.prefills").value() \
+        == len(SPECS)
+    assert s["finished"] == \
+        reg.get("serving.requests_finished").value() == len(SPECS)
+    assert s["peak_queue"] == reg.get("serving.queue_depth").hwm()
+    assert s["mean_slot_occupancy"] == pytest.approx(
+        s["busy_slot_steps"] / (s["decode_steps"] * s["num_slots"]))
+    # lifecycle accounting: every request fully emitted + measured
+    assert reg.get("serving.tokens_emitted").value() >= \
+        sum(m for _, m in SPECS)
+    assert reg.get("serving.request_latency_seconds") \
+        .summary()["count"] == len(SPECS)
+    assert reg.get("serving.ttft_seconds").summary()["count"] == len(SPECS)
+    assert reg.get("serving.queue_depth").value() == 0   # drained
+    assert reg.get("serving.slot_occupancy").value() == 0
+
+
+def test_serving_lifecycle_spans_recorded(served):
+    from paddle_tpu.observability.spans import parse_span_name as parse
+    names = [parse(e[5])[0] for e in served.host_events]
+    for expected in ("serving.request.queued", "serving.prefill",
+                     "serving.decode_block", "serving.request.finish"):
+        assert expected in names, expected
+    # span attrs survive the tracer round trip
+    attrs = [parse(e[5])[1] for e in served.host_events
+             if parse(e[5])[0] == "serving.decode_block"]
+    assert attrs and all("steps" in a and "active" in a for a in attrs)
+    # SummaryView strips attr suffixes: one aggregated row per span
+    # name, not one per request/dispatch
+    from paddle_tpu.profiler import SummaryView
+    rows = {r["name"]: r for r in SummaryView(served.host_events).rows()}
+    assert rows["serving.prefill"]["calls"] == len(SPECS)
+    assert not any(";" in n for n in rows)
+
+
+def test_merged_chrome_trace(served, tmp_path):
+    # synthetic jax.profiler-style device capture (the *.trace.json.gz
+    # layout DeviceSummaryView._load reads)
+    dev = tmp_path / "plugins" / "profile" / "run1"
+    dev.mkdir(parents=True)
+    with gzip.open(dev / "m.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": [
+            {"ph": "M", "pid": 2, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 2, "tid": 1, "name": "fusion.1",
+             "ts": 10, "dur": 50.0},
+        ]}, f)
+    out = str(tmp_path / "merged.json")
+    info = merge_chrome_traces(out, host=served.host_events,
+                               device_trace_dir=str(tmp_path))
+    assert info["device_events"] == 1 and info["device_processes"] == 1
+    with open(out) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    host_names = {e["name"] for e in evs if e.get("pid") == 0}
+    assert "serving.decode_block" in host_names        # attrs decoded
+    blocks = [e for e in evs if e["name"] == "serving.decode_block"]
+    assert all("steps" in e["args"] for e in blocks)
+    dev_evs = [e for e in evs if e.get("pid", 0) >= 1000
+               and e.get("ph") == "X"]
+    assert len(dev_evs) == 1 and dev_evs[0]["name"] == "fusion.1"
+    # host-only merge is still valid
+    info2 = merge_chrome_traces(str(tmp_path / "host_only.json"),
+                                host=served.host_events)
+    assert info2["device_events"] == 0
+    # file-path host input decodes span attrs too (same contract as
+    # the event-tuple and live-tracer forms)
+    hostf = tmp_path / "host.json"
+    hostf.write_text(json.dumps({"traceEvents": [
+        {"name": "serving.prefill;request=9;slot=1", "ph": "X",
+         "pid": 0, "tid": 1, "ts": 0, "dur": 5}]}))
+    merge_chrome_traces(str(tmp_path / "m3.json"), host=str(hostf))
+    with open(tmp_path / "m3.json") as f:
+        t3 = json.load(f)
+    ev3 = [e for e in t3["traceEvents"]
+           if e["name"] == "serving.prefill"][0]
+    assert ev3["args"] == {"request": "9", "slot": "1"}
+
+
+def test_disabled_overhead_under_2pct(served):
+    """Acceptance: disabled-mode instrument overhead on the decode
+    block loop < 2%.  ``step_times`` were measured in the fixture with
+    the registry disabled; here the exact per-iteration instrument
+    touch sequence (a superset of step()'s) is timed on a disabled
+    registry and compared against the measured block time."""
+    t_block = float(np.median(served.step_times))
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("o.c")
+    g = reg.gauge("o.g")
+    h = reg.histogram("o.h")
+
+    def touches():                  # >= the per-step() instrument work
+        c.inc()
+        c.inc(2)
+        c.inc(2)
+        c.inc()
+        c.inc()
+        g.set(3)
+        g.set(2)
+        h.observe(0.01)
+        h.observe(0.02)
+        with span("serving.decode_block", steps=2, active=1):
+            pass
+
+    n = 3000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        touches()
+    t_inst = (time.perf_counter() - t0) / n
+    # prototype: ~3 us of disabled-path calls vs ~1.4 ms block -> 0.2%
+    assert t_inst < 0.02 * t_block, (t_inst, t_block)
+
+
+# ---------------------------------------------------------------------------
+# lint: instrument names across the tree
+# ---------------------------------------------------------------------------
+
+def _load_lint():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "check_metrics_names.py")
+    spec = importlib.util.spec_from_file_location("check_metrics_names",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metrics_name_lint_clean():
+    lint = _load_lint()
+    errors, regs = lint.check()         # ONE walk (main() would re-walk)
+    assert errors == []
+    # the lint actually sees the built-in instruments
+    names = {r[3] for r in regs}
+    assert "serving.queue_depth" in names
+    assert "train_step.compiles" in names
+    assert "pallas.decode_attention.route" in names
+
+
+def test_metrics_name_lint_catches_violations(tmp_path):
+    lint = _load_lint()
+    pkg = tmp_path / "paddle_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        'r.counter("Bad.Name")\n'
+        'r.counter("dup.name")\n'
+        'r.gauge("dup.name")\n'
+        'HostTracer.counter("Free Form OK", 1)\n')
+    errors, regs = lint.check(str(tmp_path))
+    assert len(errors) == 2
+    assert any("Bad.Name" in e for e in errors)
+    assert any("dup.name" in e and "conflict" not in e for e in errors)
+    assert all("Free Form OK" not in e for e in errors)

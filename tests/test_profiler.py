@@ -33,6 +33,33 @@ def test_make_scheduler_validation():
         make_scheduler(closed=0, ready=0, record=0)
 
 
+def test_make_scheduler_skip_first_repeat_interaction():
+    # repeat counting starts AFTER skip_first: the skipped steps must
+    # not consume any part of the first cycle
+    sched = make_scheduler(closed=1, ready=1, record=1, repeat=2,
+                           skip_first=3)
+    states = [sched(i) for i in range(12)]
+    assert states[:3] == [ProfilerState.CLOSED] * 3        # skip_first
+    assert states[3] == ProfilerState.CLOSED               # cycle 1
+    assert states[4] == ProfilerState.READY
+    assert states[5] == ProfilerState.RECORD_AND_RETURN
+    assert states[8] == ProfilerState.RECORD_AND_RETURN    # cycle 2
+    assert states[9:] == [ProfilerState.CLOSED] * 3        # exhausted
+
+
+def test_make_scheduler_ready_zero():
+    # ready=0 jumps straight from CLOSED to RECORD
+    sched = make_scheduler(closed=1, ready=0, record=2, repeat=1)
+    assert [sched(i) for i in range(4)] == [
+        ProfilerState.CLOSED, ProfilerState.RECORD,
+        ProfilerState.RECORD_AND_RETURN, ProfilerState.CLOSED]
+    # closed=0, ready=0: records forever (repeat=0), every cycle ends
+    # with a RECORD_AND_RETURN step
+    sched2 = make_scheduler(closed=0, ready=0, record=2)
+    assert [sched2(i) for i in range(4)] == [
+        ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN] * 2
+
+
 def test_profiler_records_user_and_op_events():
     with Profiler(targets=[ProfilerTarget.CPU]) as prof:
         with RecordEvent("my_scope"):
@@ -74,6 +101,117 @@ def test_chrome_export_and_reload(tmp_path):
     events = load_profiler_result(os.path.join(out_dir, files[0]))
     assert any(e["name"] == "exported_scope" for e in events)
     json.dumps(events)  # valid json structure
+
+
+def test_record_event_unmatched_end_is_noop():
+    from paddle_tpu.observability import get_registry
+    c = get_registry().counter("profiler.record_event_mismatches")
+    base = c.value()
+    with Profiler():                          # tracer ON: a real bug
+        ev = RecordEvent("lonely")
+        with pytest.warns(RuntimeWarning, match="without a matching begin"):
+            ev.end()
+    assert c.value() == base + 1
+    # OUTSIDE a window, a paired begin()/end() is the normal un-profiled
+    # path: begin() records nothing and end() must stay silent
+    ev2 = RecordEvent("quiet")
+    ev2.begin()
+    ev2.end()
+    assert c.value() == base + 1
+
+
+def test_record_event_across_windows_does_not_pop_new_range():
+    """A range opened in window A did not survive A's close; its end()
+    in window B must not pop a window-B range (generation guard)."""
+    from paddle_tpu.observability.spans import span
+    prof_a = Profiler()
+    prof_a.start()
+    ev = RecordEvent("window_a")
+    ev.begin()
+    ctx = RecordEvent("ctx_a")
+    ctx.__enter__()
+    sp = span("span_a").__enter__()
+    prof_a.stop()
+    with Profiler() as prof_b:
+        outer = RecordEvent("outer_b")
+        outer.begin()
+        ev.end()                             # stale: no-op, counted
+        ctx.__exit__(None, None, None)       # stale __exit__: no-op too
+        sp.__exit__(None, None, None)        # stale span: no-op
+        outer.end()
+    rows = {r["name"]: r for r in prof_b.summary().rows()}
+    assert rows["outer_b"]["calls"] == 1
+    assert all(n not in rows for n in ("window_a", "ctx_a", "span_a"))
+
+
+def test_record_event_begin_outside_window_end_inside():
+    """A begin() outside the window pushes no tracer range; the later
+    end() inside a window must NOT pop an unrelated open range."""
+    from paddle_tpu.observability import get_registry
+    c = get_registry().counter("profiler.record_event_mismatches")
+    base = c.value()
+    stale = RecordEvent("pre_window")
+    stale.begin()                             # tracer off: no-op
+    with Profiler() as prof:
+        outer = RecordEvent("outer")
+        outer.begin()
+        with pytest.warns(RuntimeWarning):
+            stale.end()                       # must not close "outer"
+        outer.end()
+    rows = {r["name"]: r for r in prof.summary().rows()}
+    assert rows["outer"]["calls"] == 1        # outer survived intact
+    assert "pre_window" not in rows
+    assert c.value() == base + 1
+
+
+def test_record_event_double_end_does_not_corrupt_tracer():
+    """Explicit end() inside a with-block (the early-stop idiom) must
+    not let __exit__ pop the ENCLOSING range off the tracer stack; a
+    further stray end() is a warned no-op."""
+    with Profiler() as prof:
+        outer = RecordEvent("outer")
+        outer.begin()
+        inner = RecordEvent("inner")
+        with inner:
+            inner.end()                      # closes inner early
+        # __exit__ above must NOT have closed "outer"
+        with pytest.warns(RuntimeWarning):
+            inner.end()                      # stray double-end: no-op
+        outer.end()
+    stats = {r["name"]: r for r in prof.summary().rows()}
+    assert stats["inner"]["calls"] == 1
+    assert stats["outer"]["calls"] == 1
+    # inner nests inside outer: outer's total must cover inner's
+    assert stats["outer"]["total_ms"] >= stats["inner"]["total_ms"]
+
+
+def test_summary_self_time_and_instants():
+    # synthetic event tuples (kind, t0, t1, tid, value, name):
+    # parent 0-10ms wrapping child 2-5ms, plus an instant marker
+    ms = 1_000_000
+    events = [
+        (0, 0 * ms, 10 * ms, 1, 0, "parent"),
+        (0, 2 * ms, 5 * ms, 1, 0, "child"),
+        (1, 3 * ms, 3 * ms, 1, 0, "mark"),
+    ]
+    from paddle_tpu.profiler import SummaryView
+    rows = {r["name"]: r for r in SummaryView(events).rows()}
+    assert rows["parent"]["total_ms"] == pytest.approx(10.0)
+    assert rows["parent"]["self_ms"] == pytest.approx(7.0)   # minus child
+    assert rows["child"]["self_ms"] == pytest.approx(3.0)
+    assert rows["mark"]["instants"] == 1 and rows["mark"]["calls"] == 0
+    # self time partitions the wall clock (no double counting)
+    assert rows["parent"]["self_ms"] + rows["child"]["self_ms"] == \
+        pytest.approx(rows["parent"]["total_ms"])
+    assert "Self(ms)" in SummaryView(events).table()
+
+
+def test_profiler_metrics_accessor():
+    from paddle_tpu.observability import get_registry
+    get_registry().counter("profiler.record_event_mismatches")
+    snap = Profiler().metrics()
+    assert isinstance(snap, dict)
+    assert "profiler.record_event_mismatches" in snap
 
 
 def test_record_function_decorator():

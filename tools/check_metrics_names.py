@@ -1,0 +1,106 @@
+"""Lint observability instrument names across the tree.
+
+Walks ``paddle_tpu/`` (and ``tools/``/``bench.py``) source, extracts
+every static registry registration — ``<receiver>.counter("name", ...)``
+/ ``.gauge(...)`` / ``.histogram(...)`` — and fails when:
+
+1. a name does not match ``^[a-z][a-z0-9_.]*$``
+   (``observability.metrics.NAME_RE``, the registry's own runtime
+   check; dots namespace subsystems and map to underscores in the
+   Prometheus exporter), or
+2. the same name is registered with CONFLICTING instrument types in
+   different call sites (the registry raises at runtime only when both
+   sites actually execute in one process — the lint catches the
+   conflict statically).
+
+``HostTracer.counter(...)`` calls (the chrome-trace counter lane, a
+different API with free-form names) are excluded by receiver name.
+
+Run directly (``python tools/check_metrics_names.py``) or via the
+tier-1 test in ``tests/test_observability.py``.  Exit code 0 = clean.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# receiver.method(<quoted literal name> — receiver captured so tracer
+# counter lanes (HostTracer.counter) can be skipped; a no-arg call
+# chain like get_registry().counter(<name>) also counts
+_REG_CALL = re.compile(
+    r"(?P<recv>[A-Za-z_][A-Za-z0-9_]*)\s*(?:\(\s*\))?\s*\.\s*"
+    r"(?P<kind>counter|gauge|histogram)\s*\(\s*"
+    r"(?P<quote>['\"])(?P<name>[^'\"]*)(?P=quote)")
+
+_SKIP_RECEIVERS = {"HostTracer"}
+
+NAME_RE = re.compile(r"^[a-z][a-z0-9_.]*$")
+
+
+def iter_registrations(root: str = REPO_ROOT):
+    """Yield (path, lineno, kind, name) for every static registration."""
+    scan_dirs = [os.path.join(root, "paddle_tpu"),
+                 os.path.join(root, "tools")]
+    scan_files = [os.path.join(root, "bench.py")]
+    paths = list(scan_files)
+    for d in scan_dirs:
+        for dirpath, _dirnames, filenames in os.walk(d):
+            if "__pycache__" in dirpath:
+                continue
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    paths.append(os.path.join(dirpath, fn))
+    for path in paths:
+        if not os.path.exists(path):
+            continue
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        for m in _REG_CALL.finditer(src):
+            if m.group("recv") in _SKIP_RECEIVERS:
+                continue
+            lineno = src.count("\n", 0, m.start()) + 1
+            yield (os.path.relpath(path, root), lineno,
+                   m.group("kind"), m.group("name"))
+
+
+def check(root: str = REPO_ROOT):
+    """Returns (errors, registrations) — errors is a list of strings."""
+    errors = []
+    seen = {}  # name -> (kind, first site)
+    regs = list(iter_registrations(root))
+    for path, lineno, kind, name in regs:
+        site = f"{path}:{lineno}"
+        if not NAME_RE.match(name):
+            errors.append(
+                f"{site}: instrument name {name!r} does not match "
+                f"{NAME_RE.pattern}")
+            continue
+        prev = seen.get(name)
+        if prev is None:
+            seen[name] = (kind, site)
+        elif prev[0] != kind:
+            errors.append(
+                f"{site}: {name!r} registered as {kind} but "
+                f"{prev[1]} registers it as {prev[0]}")
+    return errors, regs
+
+
+def main(argv=None) -> int:
+    errors, regs = check()
+    if errors:
+        print(f"check_metrics_names: {len(errors)} error(s) over "
+              f"{len(regs)} registration(s)")
+        for e in errors:
+            print(f"  {e}")
+        return 1
+    print(f"check_metrics_names: OK ({len(regs)} registrations, "
+          f"{len({r[3] for r in regs})} distinct names)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
