@@ -142,6 +142,74 @@ def build_slot_prefill(model, max_cache_len, cfg: GenerationConfig):
     return slot_prefill_pure
 
 
+def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call):
+    """Paged twin of ``_build_decode_block``: the cache is the shared
+    block arena plus per-slot block tables instead of per-slot
+    contiguous rows.  The tables ride into the scan closure as a
+    loop-invariant traced value (a request's table never changes during
+    its decode life — all its blocks are mapped at admission), so the
+    per-step transfer is ONLY the small [B, max_blocks] int32 table
+    push; the arenas stay donated device buffers.  Signature:
+    ``(p_values, tok, lens, done, key, tables, *flat_arenas) ->
+    (toks [B, n], tok', lens', done', key', *flat_arenas)``."""
+    _with_params = _param_swapper(model, cfg)
+
+    def block_pure(p_values, tok, lens, done, key, tables, *flat_arenas):
+        def run():
+            kvs = [(flat_arenas[i], flat_arenas[i + 1], tables)
+                   for i in range(0, len(flat_arenas), 2)]
+            (tok_f, lens_f, kvs_f, key_f, done_f), toks = jax.lax.scan(
+                decode_scan_body(model, cfg), (tok, lens, kvs, key, done),
+                None, length=steps_per_call)
+            flat_out = []
+            for ka, va, _t in kvs_f:
+                flat_out += [ka, va]
+            return ((toks.T.astype(jnp.int32), tok_f, lens_f, done_f,
+                     key_f) + tuple(flat_out))
+        return _with_params(p_values, run)
+
+    return block_pure
+
+
+def build_chunk_prefill(model, cfg: GenerationConfig):
+    """Chunked-prefill program for the paged ServingEngine: ONE prompt
+    chunk of ONE sequence (batch-1; the static chunk length is the ids
+    shape) computed at global positions ``start .. start+C-1``, K/V
+    written through the slot's block table (``models.*.prefill_chunk``).
+    A token is sampled from the logits at prompt position
+    ``n_valid - 1`` every call; it is only meaningful on the chunk that
+    covers that position — the engine ignores earlier chunks' sample
+    and never advances decode state from them.  Signature:
+    ``(p_values, ids [1, C], start [], n_valid [], tables
+    [1, max_blocks], key, *flat_arenas) -> (tok [1], key',
+    *flat_arenas)``."""
+    if cfg.num_beams > 1:
+        raise ValueError(
+            "chunked prefill is greedy/sampled only — beam search "
+            "expands to K cache rows per request, which does not fit a "
+            "one-slot-per-request block table")
+    _with_params = _param_swapper(model, cfg)
+
+    def chunk_pure(p_values, ids, start, n_valid, tables, key,
+                   *flat_arenas):
+        def run():
+            kvs = [(flat_arenas[i], flat_arenas[i + 1], tables)
+                   for i in range(0, len(flat_arenas), 2)]
+            logits, kvs_f = model.prefill_chunk(ids, start, n_valid, kvs)
+            if cfg.do_sample:
+                key0, keyr = jax.random.split(key)
+            else:
+                key0 = keyr = key
+            tok = sample_token(logits, key0, cfg)
+            flat_out = []
+            for ka, va, _t in kvs_f:
+                flat_out += [ka, va]
+            return (tok, keyr) + tuple(flat_out)
+        return _with_params(p_values, run)
+
+    return chunk_pure
+
+
 def _build_serving_fns(model, batch, max_cache_len,
                        cfg: GenerationConfig, steps_per_call):
     """Pure (params, ...) -> (...) functions for prefill and one decode

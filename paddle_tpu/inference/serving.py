@@ -1,56 +1,73 @@
-"""Continuous-batching LLM serving engine (slot-based KV cache pool +
-iteration-level mixed prefill/decode scheduler).
+"""Continuous-batching LLM serving engine over a PAGED KV-cache block
+pool (iteration-level scheduler + block-granular memory manager +
+shared-prefix caching + chunked prefill).
 
-The static-batch ``LLMPredictor`` admits all requests together and
-decodes until the LAST sequence finishes: a batch-32 server runs at the
-throughput of its slowest request and idles every finished slot.  This
-module is the scheduling layer above the compiled serving blocks — the
-continuous-batching design of Orca (iteration-level scheduling) and
-vLLM (slot/paged KV management), restricted to what XLA's static shapes
-allow:
+The first engine generation reserved a contiguous ``num_slots x
+max_cache_len`` KV region per slot and prefilled every prompt whole in
+a batch-1 pass: short requests stranded HBM at worst-case capacity,
+shared system prompts were recomputed on every admission, and one long
+prefill stalled every decoding slot for the full prompt pass.  This
+module keeps that engine's scheduler contract (iteration-level
+admission, mixed-fill decode blocks, donated caches, greedy parity
+with per-request ``generate()``) and rebuilds the memory system along
+the PagedAttention (Kwon et al., vLLM) + Sarathi-Serve (chunked
+prefill) design, restricted to what XLA's static shapes allow:
 
-- **Slot pool**: the engine owns a fixed pool of ``num_slots`` KV-cache
-  rows per layer (the same packed ``[B, S, H_kv*D]`` buffers the
-  flash-decode kernel streams).  A request occupies exactly one row for
-  its lifetime; eviction is iteration-granular.
-- **Slot-granular prefill**: admission runs a batch-1 compiled prompt
-  pass (``inference.llm.build_slot_prefill``) that writes the prompt
-  K/V — and scrubbing zeros for the rest of the row — into the vacant
-  slot of the SHARED pool.  ``slot`` is a traced scalar, so one
-  compiled program admits into any slot.
-- **Mixed-fill decode**: one compiled decode block
-  (``inference.llm._build_decode_block``) steps every slot at once.
-  All shapes stay static for XLA — occupancy is expressed purely
-  through the ``sequence_lengths``/``done`` vectors, so the
-  flash-decode kernel naturally streams only each row's valid prefix
-  and vacant/finished rows ride along frozen (lens pinned, emits pad).
-- **Iteration-level scheduling**: after every block the host harvests
-  tokens, retires finished requests (EOS or budget), frees their slots
-  and admits from the queue the moment a slot is vacant.  With
-  ``steps_per_call=1`` this is exact per-token (Orca-style) scheduling;
-  larger blocks amortize the per-dispatch tunnel cost and fall back to
-  single steps automatically when any active request is within a block
-  of finishing (so a block can never overshoot a request's budget or
-  its cache row).
-- **Donated caches**: the cache buffers are donated into both compiled
-  programs, so steady-state serving allocates no per-step HBM.
+- **Block pool**: each layer's K/V live in ONE ``[num_blocks + 1,
+  block_len, H_kv*D]`` arena (the ``+1`` row is the trash block —
+  statically-shaped writes from vacant/frozen slots and prompt pad
+  tails are redirected there instead of being shape-masked).  A
+  host-side free-list (``BlockPool``) maps logical blocks to arena
+  rows; per-slot block tables ``[num_slots, max_blocks_per_slot]``
+  int32 are the only NEW per-step host->device transfer.  Effective
+  concurrency is bounded by blocks actually USED
+  (``ceil((prompt + new - 1) / block_len)`` per request), not by
+  ``num_slots x max_cache_len``.
+- **Block-aligned prefix caching**: full prompt blocks are identified
+  by a chained blake2b digest over their token ids (chaining makes a
+  block's identity include its whole prefix, so equal digests imply
+  equal attention context).  Computed blocks are published to a
+  refcounted ``digest -> block`` map; admission maps shared leading
+  blocks straight into the new slot's table and prefill starts at the
+  first unmatched position.  Only FULL blocks are shared, and at least
+  the block holding the prompt's last token is always recomputed (its
+  hidden state is needed to sample the first token), so shared blocks
+  are immutable by construction and no copy-on-write is ever needed.
+  Unpinned cached blocks park in an LRU and are reclaimed when the
+  free list runs dry.
+- **Chunked prefill**: prompts are computed ``chunk_len`` tokens at a
+  time, at most ONE chunk per ``step()`` alongside the shared decode
+  block — a long prompt no longer stalls in-flight decoding for its
+  full prompt pass, and TTFT of queued requests overlaps decode
+  instead of serializing behind it.
+- **Paged reads**: decode attention goes through the block table — the
+  Pallas flash-decode kernel gained a block-table DMA variant
+  (``decode_attention_paged``; gate reasons ``paged_ok`` /
+  ``paged_block_len``) with a gather-based XLA path as the universal
+  fallback.  Chunk prefill always uses the gather-based XLA path.
+- **Donated arenas**: the arenas are donated into both compiled
+  programs (chunk prefill and decode block), so steady-state serving
+  still allocates no per-step HBM and never materializes a second
+  copy of the pool.
 
-Why it wins: with mixed request lengths, static batching wastes
-``(max_len - mean_len) / max_len`` of its decode steps on finished
-rows.  Continuous batching refills those rows instead; the decode
-kernel's per-row raggedness support turns directly into tokens/s.
+Greedy output stays token-for-token identical to per-request
+``generate()`` across block reuse, prefix hits and chunked prefill:
+every position of a sequence's dense view is either masked (past
+``lens``) or was written by exactly the math the dense engine ran at
+that position, and row-independence of the decode body is unchanged.
 
-``static_batching=True`` degrades the SAME engine to gang scheduling —
-admit only when the whole pool is empty — which is the A/B baseline
-``bench.py``'s ``llm_serving`` section measures against: both arms run
-identical compiled programs, so the delta is purely the scheduler.
+``static_batching=True`` still degrades the SAME engine to gang
+scheduling (admit only into an empty pool) — the A/B baseline of
+``bench.py``'s ``llm_serving`` section; ``enable_prefix_cache=False``
+is the A/B arm for the shared-prefix trace.
 """
 
 from __future__ import annotations
 
+import hashlib
 import time
 import warnings
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import List, Optional
 
@@ -58,11 +75,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.generation import GenerationConfig, model_arrays
+from ..models.generation import (GenerationConfig, init_paged_kv_arena,
+                                 model_arrays)
 from ..observability import metrics as obs_metrics
 from ..observability.spans import instant as _span_instant
 from ..observability.spans import span as _span
-from .llm import _build_decode_block, build_slot_prefill
+from .llm import _build_paged_decode_block, build_chunk_prefill
 
 
 class _ServingInstruments:
@@ -86,7 +104,12 @@ class _ServingInstruments:
         self.registry = registry
         r = registry
         self.prefills = r.counter(
-            "serving.prefills", "slot-granular prompt prefills run")
+            "serving.prefills", "prompt prefills completed (requests "
+            "that reached their first token)")
+        self.prefill_chunks = r.counter(
+            "serving.prefill_chunks", "prompt chunks computed (chunked-"
+            "prefill dispatches; prefix-cached blocks never become "
+            "chunks)")
         self.decode_steps = r.counter(
             "serving.decode_steps", "decode steps executed (block size "
             "x dispatches)")
@@ -104,24 +127,43 @@ class _ServingInstruments:
             "serving.requests_submitted", "requests accepted into the queue")
         self.requests_finished = r.counter(
             "serving.requests_finished", "requests retired (EOS or budget)")
+        self.requests_cancelled = r.counter(
+            "serving.requests_cancelled",
+            "still-queued requests dropped by cancel()")
         self.evictions = r.counter(
-            "serving.slot_evictions", "slot frees at request retirement "
-            "(first-token finishes never occupied a slot)")
+            "serving.slot_evictions", "slot frees at request retirement")
+        self.prefix_hits = r.counter(
+            "serving.prefix_hits", "prompt blocks mapped from the prefix "
+            "cache at admission instead of being recomputed")
+        self.prefix_misses = r.counter(
+            "serving.prefix_misses", "matchable prompt blocks that had "
+            "to be computed (no cached twin at admission)")
         self.queue_depth = r.gauge(
             "serving.queue_depth", "requests waiting for a slot")
         self.slot_occupancy = r.gauge(
             "serving.slot_occupancy", "slots holding a live request")
         self.slots_total = r.gauge(
             "serving.slots_total", "KV-cache slot pool size")
+        self.blocks_free = r.gauge(
+            "serving.blocks_free", "KV block-pool blocks with refcount 0 "
+            "(free list + reclaimable prefix-cached)")
+        self.blocks_in_use = r.gauge(
+            "serving.blocks_in_use", "KV block-pool blocks pinned by "
+            "live or queued requests (hwm = high-water mark)")
         self.latency = r.histogram(
             "serving.request_latency_seconds",
             "request latency, arrival -> last token")
         self.ttft = r.histogram(
             "serving.ttft_seconds",
-            "time to first token, arrival -> prefill emit")
+            "time to first token, arrival -> last prefill chunk")
+        self.chunk_latency = r.histogram(
+            "serving.prefill_chunk_seconds",
+            "wall time of one chunked-prefill dispatch")
         self._base = {}
-        for c in (self.prefills, self.decode_steps, self.busy_slot_steps,
-                  self.block_dispatches, self.requests_finished):
+        for c in (self.prefills, self.prefill_chunks, self.decode_steps,
+                  self.busy_slot_steps, self.block_dispatches,
+                  self.requests_finished, self.requests_cancelled,
+                  self.prefix_hits, self.prefix_misses):
             self._base[c.name] = c.value()
 
     def since_init(self, counter) -> float:
@@ -142,6 +184,114 @@ def _call_quiet(fn, *args):
         return fn(*args)
 
 
+def _block_digests(ids: np.ndarray, n: int, block_len: int) -> List[bytes]:
+    """Chained blake2b digests of the prompt's FULL blocks: block i's
+    digest covers tokens [0, (i+1)*block_len) through the chain, so two
+    blocks share a digest only when their whole attention context is
+    identical — the property that makes mapping a cached block into a
+    new sequence exact, not just likely."""
+    out: List[bytes] = []
+    h = b"ptpu-paged-kv"
+    for i in range(n // block_len):
+        h = hashlib.blake2b(
+            h + ids[i * block_len:(i + 1) * block_len].tobytes(),
+            digest_size=16).digest()
+        out.append(h)
+    return out
+
+
+class BlockPool:
+    """Host-side allocator for the device block arena: a free list over
+    ``num_blocks`` logical blocks plus a refcounted prefix cache.
+
+    Lifecycle of a block: ``alloc`` hands it out with refcount 1;
+    ``pin``/``unpin`` move the refcount as prefix sharers map it in and
+    requests retire; a block whose refcount drops to 0 returns to the
+    free list UNLESS it is published in the prefix map — then it parks
+    in an LRU, still mapped, and is reclaimed (unmapped) only when the
+    free list runs dry.  The extra arena row ``trash`` is not managed
+    here: it is the fixed write-masking target and never allocated.
+
+    Purely host state — the device never sees refcounts or digests,
+    only the int32 block tables (the "no per-step sync of the arena"
+    contract)."""
+
+    def __init__(self, num_blocks: int, block_len: int):
+        self.num_blocks = int(num_blocks)
+        self.block_len = int(block_len)
+        self.trash = self.num_blocks           # extra arena row index
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._ref = [0] * self.num_blocks
+        self._digest_of: List[Optional[bytes]] = [None] * self.num_blocks
+        self._by_digest = {}                   # digest -> block id
+        self._lru: OrderedDict = OrderedDict()  # digest -> block, ref==0
+
+    def available(self) -> int:
+        """Blocks allocatable right now (free + reclaimable cached)."""
+        return len(self._free) + len(self._lru)
+
+    def in_use(self) -> int:
+        """Blocks pinned by live or queued requests (refcount > 0)."""
+        return self.num_blocks - self.available()
+
+    def cached(self) -> int:
+        """Unpinned blocks kept mapped for future prefix hits."""
+        return len(self._lru)
+
+    def lookup(self, digest: bytes) -> Optional[int]:
+        return self._by_digest.get(digest)
+
+    def pin(self, block: int):
+        if self._ref[block] == 0:
+            dg = self._digest_of[block]
+            if dg is not None:
+                self._lru.pop(dg, None)
+        self._ref[block] += 1
+
+    def unpin(self, block: int):
+        if self._ref[block] <= 0:
+            raise RuntimeError(
+                f"block {block} unpinned below refcount 0 — double free")
+        self._ref[block] -= 1
+        if self._ref[block] == 0:
+            # a block's digest is set/cleared atomically with its
+            # _by_digest entry (register never overwrites, alloc clears
+            # both), so digest-set means published-and-mapped
+            dg = self._digest_of[block]
+            if dg is not None:
+                self._lru[dg] = block          # reclaimable, still mapped
+            else:
+                self._free.append(block)
+
+    def register(self, block: int, digest: bytes):
+        """Publish a fully-written prompt block for future prefix hits.
+        First writer wins: a concurrent duplicate computation keeps its
+        private copy unpublished (it returns to the plain free list on
+        unpin)."""
+        if digest in self._by_digest:
+            return
+        self._by_digest[digest] = block
+        self._digest_of[block] = digest
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` blocks with refcount 1 each, reclaiming the oldest
+        refcount-0 cached blocks (unmapping their digests) when the
+        free list runs dry; None when the pool cannot serve ``n``."""
+        if n > self.available():
+            return None
+        out = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.pop()
+            else:
+                dg, b = self._lru.popitem(last=False)
+                del self._by_digest[dg]
+                self._digest_of[b] = None
+            self._ref[b] = 1
+            out.append(b)
+        return out
+
+
 @dataclass
 class Request:
     """One serving request and its lifecycle accounting.
@@ -151,6 +301,8 @@ class Request:
     ``generate()``), and ``output`` is always exactly
     ``max_new_tokens`` long — token-for-token what a static-batch
     greedy ``generate()`` of this request alone would return.
+    ``state`` walks queued -> prefill -> decode -> finished (or
+    cancelled from queued).
     """
     request_id: int
     prompt: np.ndarray                 # [prompt_len] padded
@@ -164,6 +316,13 @@ class Request:
     submit_time: float = 0.0
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
+    state: str = "queued"
+    pf_pos: int = 0                    # next prompt position to compute
+    matched: List[int] = field(default_factory=list)   # prefix-hit blocks
+    blocks: List[int] = field(default_factory=list)    # full block map
+    digests: List[bytes] = field(default_factory=list)
+    registered: int = 0                # blocks published so far
+    chunk_ids: Optional[np.ndarray] = None  # prompt padded to chunk grid
 
     @property
     def output(self) -> np.ndarray:
@@ -177,25 +336,29 @@ class Request:
 
     @property
     def ttft(self) -> Optional[float]:
-        """Time to first token (arrival -> prefill emit)."""
+        """Time to first token (arrival -> last prefill chunk)."""
         if self.first_token_time is None:
             return None
         return self.first_token_time - self.arrival_time
 
 
 class ServingEngine:
-    """Continuous-batching serving session over a fixed slot pool.
+    """Continuous-batching serving session over a paged KV block pool.
 
     ``submit()`` enqueues requests (optionally with a future
-    ``arrival_time`` for trace replay); ``step()`` runs one scheduler
-    iteration (admit + one decode block); ``run()`` drains everything
-    and returns the finished requests.  Greedy output is token-for-token
-    identical to per-request static ``generate()`` — see
-    ``_build_decode_block``'s row-independence contract.
+    ``arrival_time`` for trace replay); ``cancel()`` drops a
+    still-queued one; ``step()`` runs one scheduler iteration (admit +
+    at most one prefill chunk + one decode block); ``run()`` drains
+    everything and returns the finished requests.  Greedy output is
+    token-for-token identical to per-request static ``generate()`` —
+    see ``_build_decode_block``'s row-independence contract and the
+    module docstring's paged-exactness argument.
     """
 
     def __init__(self, model, *, num_slots, prompt_len,
                  max_cache_len=None, steps_per_call=1,
+                 block_len=16, num_blocks=None, chunk_len=None,
+                 enable_prefix_cache=True,
                  eos_token_id=None, pad_token_id=0,
                  do_sample=False, temperature=1.0, top_k=0,
                  compute_dtype="bfloat16", cache_dtype=None,
@@ -205,16 +368,32 @@ class ServingEngine:
         self.prompt_len = int(prompt_len)
         self.max_cache_len = int(max_cache_len or (prompt_len + 256))
         self.steps_per_call = int(steps_per_call)
+        self.block_len = int(block_len)
         self.static_batching = bool(static_batching)
+        self.enable_prefix_cache = bool(enable_prefix_cache)
         if self.num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if self.steps_per_call < 1:
             raise ValueError(
                 f"steps_per_call must be >= 1, got {steps_per_call}")
+        if self.block_len < 1:
+            raise ValueError(f"block_len must be >= 1, got {block_len}")
         if self.max_cache_len < self.prompt_len + 1:
             raise ValueError(
                 f"max_cache_len ({self.max_cache_len}) must be >= "
                 f"prompt_len + 1 ({self.prompt_len + 1})")
+        # per-slot table width; a slot's dense view spans max_blocks *
+        # block_len >= max_cache_len slots (the tail rounds up)
+        self.max_blocks = -(-self.max_cache_len // self.block_len)
+        self.num_blocks = (int(num_blocks) if num_blocks is not None
+                           else self.num_slots * self.max_blocks)
+        if self.num_blocks < 1:
+            raise ValueError(
+                f"num_blocks must be >= 1, got {self.num_blocks}")
+        self.chunk_len = (int(chunk_len) if chunk_len is not None
+                          else self.prompt_len)
+        if self.chunk_len < 1:
+            raise ValueError(f"chunk_len must be >= 1, got {chunk_len}")
         self.cfg = GenerationConfig(
             do_sample=bool(do_sample), temperature=float(temperature),
             top_k=int(top_k), eos_token_id=eos_token_id,
@@ -228,24 +407,30 @@ class ServingEngine:
             [bf._value for bf in buffers]
 
         n_layers, hkv, d = model.kv_cache_spec()
-        from ..ops.pallas.decode_attention import cache_shape
-        shape = cache_shape(self.num_slots, hkv, self.max_cache_len, d)
         cdt = jnp.dtype(self.cfg.cache_dtype or self.cfg.compute_dtype)
-        self._flat_kvs = [jnp.zeros(shape, cdt)
-                          for _ in range(2 * n_layers)]
-        # args: (p_values, slot, ids, lens, key, *flat_kvs) /
-        #       (p_values, tok, lens, done, key, *flat_kvs) — the cache
-        # pool is donated in both so steady-state serving does not churn
-        # a second copy of the pool through HBM every step
-        donate = tuple(range(5, 5 + 2 * n_layers))
-        self._prefill = jax.jit(
-            build_slot_prefill(model, self.max_cache_len, self.cfg),
-            donate_argnums=donate)
+        arenas = init_paged_kv_arena(n_layers, self.num_blocks,
+                                     self.block_len, hkv, d, cdt)
+        self._arenas: List = []
+        for ka, va in arenas:
+            self._arenas += [ka, va]
+        self._pool = BlockPool(self.num_blocks, self.block_len)
+        # host-side block tables; pushed (small int32) per dispatch —
+        # the ONLY new per-step transfer; the arenas never leave the
+        # device and are donated into both compiled programs so
+        # steady-state serving does not churn a second copy of the
+        # pool through HBM every step.
+        # args: (pb, ids, start, n_valid, tables, key, *arenas) /
+        #       (pb, tok, lens, done, key, tables, *arenas)
+        self._tables = np.full((self.num_slots, self.max_blocks),
+                               self._pool.trash, np.int32)
+        donate = tuple(range(6, 6 + 2 * n_layers))
+        self._chunk_fn = jax.jit(
+            build_chunk_prefill(model, self.cfg), donate_argnums=donate)
         self._donate = donate
         self._blocks = {}              # static block size -> jitted fn
 
         # device-carried occupancy state, mirrored host-side ([B] ints
-        # are cheap to push; the cache pool never leaves the device)
+        # are cheap to push; the arenas never leave the device)
         self._tok = np.zeros((self.num_slots,), np.int32)
         self._lens = np.zeros((self.num_slots,), np.int32)
         self._done = np.ones((self.num_slots,), bool)
@@ -254,19 +439,45 @@ class ServingEngine:
 
         self._slots: List[Optional[Request]] = [None] * self.num_slots
         self._queue: deque = deque()
+        self._prefilling: deque = deque()
         self._finished: List[Request] = []
         self._clock = clock
         self._next_id = 0
         # scheduler accounting lives in the observability registry
         # (stats() reads per-engine counter deltas back out of it);
-        # peak_queue additionally mirrors the queue-depth gauge's
-        # high-water mark as a plain int so stats() stays exact even if
-        # the registry is disabled mid-run
+        # peak_queue/peak_blocks mirror the gauges' high-water marks as
+        # plain ints so stats() stays exact even if the registry is
+        # disabled mid-run
         self._m = _ServingInstruments(
             registry if registry is not None else obs_metrics.get_registry())
         self._m.slots_total.set(self.num_slots)
         self._m.slot_occupancy.set(0)
+        self._m.blocks_free.set(self.num_blocks)
+        self._m.blocks_in_use.set(0)
         self._peak_queue = 0
+        self._peak_blocks = 0
+
+    # -- block accounting --
+    def _blocks_needed(self, n: int, m: int) -> int:
+        """Blocks a request writes: prompt + generated K/V is n + m - 1
+        slots (the last sampled token is emitted, never fed back)."""
+        return -(-(n + m - 1) // self.block_len)
+
+    def _update_block_gauges(self):
+        free = self._pool.available()
+        in_use = self._pool.in_use()
+        self._m.blocks_free.set(free)
+        self._m.blocks_in_use.set(in_use)
+        self._peak_blocks = max(self._peak_blocks, in_use)
+
+    def _release_blocks(self, req: Request):
+        for b in req.blocks:
+            self._pool.unpin(b)
+        req.blocks = []
+        req.matched = []
+        if req.slot is not None:
+            self._tables[req.slot] = self._pool.trash
+        self._update_block_gauges()
 
     # -- request intake --
     def submit(self, prompt_ids, seq_len=None, max_new_tokens=32,
@@ -275,7 +486,9 @@ class ServingEngine:
         most ``prompt_len`` tokens (right-padded internally);
         ``arrival_time`` (in ``clock()`` units) lets a trace replay
         future arrivals — the scheduler will not admit a request before
-        it has "arrived"."""
+        it has "arrived".  With prefix caching on, the prompt's full
+        blocks are probed against the cache here and any hits are
+        PINNED so they cannot be reclaimed while the request waits."""
         ids = np.asarray(getattr(prompt_ids, "_value", prompt_ids))
         ids = np.asarray(ids).reshape(-1).astype(np.int32)
         if ids.size < 1 or ids.size > self.prompt_len:
@@ -291,8 +504,17 @@ class ServingEngine:
             raise ValueError(f"max_new_tokens must be >= 1, got {m}")
         if n + m - 1 > self.max_cache_len:
             raise ValueError(
-                f"prompt ({n}) + max_new_tokens ({m}) - 1 exceeds "
-                f"max_cache_len ({self.max_cache_len})")
+                f"prompt ({n}) + max_new_tokens ({m}) - 1 = {n + m - 1} "
+                f"tokens ({self._blocks_needed(n, m)} blocks of "
+                f"{self.block_len}) exceeds max_cache_len "
+                f"({self.max_cache_len} tokens = {self.max_blocks} "
+                f"blocks per slot)")
+        if self._blocks_needed(n, m) > self.num_blocks:
+            raise ValueError(
+                f"request needs {self._blocks_needed(n, m)} blocks of "
+                f"{self.block_len} ({n + m - 1} tokens) but the pool "
+                f"only has num_blocks={self.num_blocks} — it could "
+                f"never be admitted")
         padded = np.full((self.prompt_len,), self.cfg.pad_token_id,
                          np.int32)
         padded[:ids.size] = ids
@@ -301,6 +523,25 @@ class ServingEngine:
                       now if arrival_time is None else float(arrival_time),
                       pad_token_id=self.cfg.pad_token_id)
         req.submit_time = now
+        # chunk grid: any slice [start, start + chunk_len) with
+        # start < seq_len must be in range
+        req.chunk_ids = np.full((self.prompt_len + self.chunk_len,),
+                                self.cfg.pad_token_id, np.int32)
+        req.chunk_ids[:self.prompt_len] = padded
+        if self.enable_prefix_cache:
+            req.digests = _block_digests(padded, n, self.block_len)
+            # match at most (n-1)//block_len blocks: the block holding
+            # the prompt's LAST token is always recomputed — sampling
+            # the first output token needs its hidden state, which the
+            # cache does not carry
+            for dg in req.digests[:(n - 1) // self.block_len]:
+                b = self._pool.lookup(dg)
+                if b is None:
+                    break
+                self._pool.pin(b)
+                req.matched.append(b)
+            if req.matched:
+                self._update_block_gauges()
         self._next_id += 1
         self._queue.append(req)
         self._peak_queue = max(self._peak_queue, len(self._queue))
@@ -310,9 +551,31 @@ class ServingEngine:
                       seq_len=n, max_new=m)
         return req
 
+    def cancel(self, request_id: int) -> bool:
+        """Drop a STILL-QUEUED request: removes it from the queue and
+        releases any prefix-cache pins its submit-time match took.
+        Returns False when the request is unknown or already admitted —
+        in-flight work is not preempted (its blocks free at
+        retirement)."""
+        for req in self._queue:
+            if req.request_id == request_id:
+                self._queue.remove(req)
+                for b in req.matched:
+                    self._pool.unpin(b)
+                req.matched = []
+                req.state = "cancelled"
+                self._m.requests_cancelled.inc()
+                self._m.queue_depth.set(len(self._queue))
+                self._update_block_gauges()
+                _span_instant("serving.request.cancel",
+                              request=req.request_id)
+                return True
+        return False
+
     # -- scheduler --
     def _finish(self, req: Request, t: float, out: List[Request]):
         req.finish_time = t
+        req.state = "finished"
         if req.slot is not None:
             self._m.evictions.inc()
         req.slot = None
@@ -329,10 +592,13 @@ class ServingEngine:
         self._finished.append(req)
         out.append(req)
 
-    def _admit(self, now: float, out: List[Request]):
-        """Fill vacant slots from the queue head (FIFO over arrivals).
-        Gang mode (``static_batching``) only admits into an EMPTY pool —
-        the static-batch baseline scheduler."""
+    def _admit(self, now: float):
+        """Map queue-head requests (FIFO over arrivals) into vacant
+        slots: extend the prefix match against blocks published since
+        submit, allocate the remaining blocks, and hand the request to
+        the chunked-prefill queue.  Gang mode (``static_batching``)
+        only admits into an EMPTY pool — the static-batch baseline
+        scheduler."""
         if self.static_batching and \
                 any(r is not None for r in self._slots):
             return
@@ -341,61 +607,150 @@ class ServingEngine:
                          if r is None), None)
             if slot is None:
                 break
-            req = self._queue.popleft()
-            self._m.queue_depth.set(len(self._queue))
-            self._key, sub = jax.random.split(self._key)
-            with _span("serving.prefill", request=req.request_id,
-                       slot=slot, seq_len=req.seq_len):
-                outp = _call_quiet(
-                    self._prefill, self._pb, jnp.asarray(slot, jnp.int32),
-                    jnp.asarray(req.prompt[None, :]),
-                    jnp.asarray([req.seq_len], jnp.int32), sub,
-                    *self._flat_kvs)
-                self._flat_kvs = list(outp[2:])
-                tok0 = int(np.asarray(outp[0])[0])
-            self._m.prefills.inc()
-            self._m.tokens_emitted.inc()
-            t = self._clock()
-            req.first_token_time = t
-            if req.ttft is not None:
-                self._m.ttft.observe(req.ttft)
-            req.tokens.append(tok0)
-            req.remaining = req.max_new_tokens - 1
-            if (self.cfg.eos_token_id is not None and
-                    tok0 == self.cfg.eos_token_id) or req.remaining == 0:
-                # finished at the first token: the slot was written but
-                # never occupied (the next occupant scrubs the row)
-                self._done[slot] = True
-                self._finish(req, t, out)
-                continue
+            req = self._queue[0]
+            if self.enable_prefix_cache:
+                # blocks computed between submit and now may extend the
+                # match (e.g. the prefix holder finished its prefill
+                # while this request queued)
+                for dg in req.digests[len(req.matched):
+                                      (req.seq_len - 1) // self.block_len]:
+                    b = self._pool.lookup(dg)
+                    if b is None:
+                        break
+                    self._pool.pin(b)
+                    req.matched.append(b)
+            total = self._blocks_needed(req.seq_len, req.max_new_tokens)
+            fresh = self._pool.alloc(total - len(req.matched))
+            if fresh is None and \
+                    not any(r is not None for r in self._slots):
+                # head-of-line valve: nothing is running, so the only
+                # refcounts are queued requests' submit-time pins —
+                # release them all (the cached blocks stay mapped, just
+                # reclaimable again) and retry; the submit() capacity
+                # guard makes this retry infallible
+                for r in self._queue:
+                    for b in r.matched:
+                        self._pool.unpin(b)
+                    r.matched = []
+                fresh = self._pool.alloc(total)
+            if fresh is None:
+                break                     # pool drains as requests retire
+            self._queue.popleft()
+            matchable = ((req.seq_len - 1) // self.block_len
+                         if self.enable_prefix_cache else 0)
+            self._m.prefix_hits.inc(len(req.matched))
+            self._m.prefix_misses.inc(matchable - len(req.matched))
+            req.blocks = req.matched + fresh
+            row = np.full((self.max_blocks,), self._pool.trash, np.int32)
+            row[:len(req.blocks)] = req.blocks
+            self._tables[slot] = row
             req.slot = slot
+            req.state = "prefill"
+            req.pf_pos = len(req.matched) * self.block_len
             self._slots[slot] = req
-            self._tok[slot] = tok0
-            self._lens[slot] = req.seq_len
-            self._done[slot] = False
+            self._done[slot] = True       # not decoding yet
+            self._lens[slot] = 0
+            self._prefilling.append(req)
+            self._m.queue_depth.set(len(self._queue))
+            self._update_block_gauges()
+            _span_instant("serving.request.admit", request=req.request_id,
+                          slot=slot, matched_blocks=len(req.matched))
         self._m.slot_occupancy.set(
             sum(r is not None for r in self._slots))
+
+    def _prefill_chunk(self, out: List[Request]):
+        """Run at most ONE prompt chunk (FIFO over admissions).  The
+        final chunk of a prompt samples the request's first token and
+        flips it into the decode mix; completed full blocks are
+        published to the prefix cache as soon as they are written."""
+        if not self._prefilling:
+            return
+        req = self._prefilling[0]
+        start, c = req.pf_pos, self.chunk_len
+        self._key, sub = jax.random.split(self._key)
+        t0 = self._clock()
+        with _span("serving.prefill", request=req.request_id,
+                   slot=req.slot, start=start):
+            outp = _call_quiet(
+                self._chunk_fn, self._pb,
+                jnp.asarray(req.chunk_ids[None, start:start + c]),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(req.seq_len, jnp.int32),
+                jnp.asarray(self._tables[req.slot][None, :]), sub,
+                *self._arenas)
+            self._arenas = list(outp[2:])
+            tok0 = int(np.asarray(outp[0])[0])
+        self._m.prefill_chunks.inc()
+        self._m.chunk_latency.observe(self._clock() - t0)
+        req.pf_pos = start + c
+        if self.enable_prefix_cache:
+            full = min(req.pf_pos, req.seq_len) // self.block_len
+            while req.registered < min(full, len(req.digests)):
+                i = req.registered
+                self._pool.register(req.blocks[i], req.digests[i])
+                req.registered = i + 1
+        if req.pf_pos < req.seq_len:
+            return                        # more chunks to go
+        # final chunk: tok0 is the request's first generated token
+        self._prefilling.popleft()
+        self._m.prefills.inc()
+        self._m.tokens_emitted.inc()
+        t = self._clock()
+        req.first_token_time = t
+        if req.ttft is not None:
+            self._m.ttft.observe(req.ttft)
+        req.tokens.append(tok0)
+        req.remaining = req.max_new_tokens - 1
+        slot = req.slot
+        if (self.cfg.eos_token_id is not None and
+                tok0 == self.cfg.eos_token_id) or req.remaining == 0:
+            # finished at the first token: never enters the decode mix
+            self._slots[slot] = None
+            self._done[slot] = True
+            self._release_blocks(req)
+            self._finish(req, t, out)
+            return
+        req.state = "decode"
+        self._tok[slot] = tok0
+        self._lens[slot] = req.seq_len
+        self._done[slot] = False
 
     def _block_fn(self, steps: int):
         fn = self._blocks.get(steps)
         if fn is None:
             fn = jax.jit(
-                _build_decode_block(self._model, self.cfg, steps),
+                _build_paged_decode_block(self._model, self.cfg, steps),
                 donate_argnums=self._donate)
             self._blocks[steps] = fn
         return fn
 
+    def _decode_tables(self) -> np.ndarray:
+        """The decode block's table view: real rows for decoding slots,
+        all-trash rows for vacant/prefilling slots — a frozen row's
+        statically-shaped write at its pinned ``lens`` must never land
+        in a block another sequence now owns."""
+        tbl = np.full_like(self._tables, self._pool.trash)
+        for i, r in enumerate(self._slots):
+            if r is not None and r.state == "decode":
+                tbl[i] = self._tables[i]
+        return tbl
+
     def step(self, now: Optional[float] = None) -> List[Request]:
         """One scheduler iteration: admit arrivals into vacant slots,
-        then run one decode block over the current occupancy mix.
-        Returns the requests that finished this iteration."""
+        run at most one prefill chunk, then one decode block over the
+        current occupancy mix.  Returns the requests that finished this
+        iteration."""
         finished: List[Request] = []
-        self._admit(self._clock() if now is None else now, finished)
-        active = [i for i, r in enumerate(self._slots) if r is not None]
+        self._admit(self._clock() if now is None else now)
+        self._prefill_chunk(finished)
+        active = [i for i, r in enumerate(self._slots)
+                  if r is not None and r.state == "decode"]
         if not active:
+            self._m.slot_occupancy.set(
+                sum(r is not None for r in self._slots))
             return finished
         # a full block only when no active request can finish inside it
-        # (a block never overshoots a budget or a cache row); otherwise
+        # (a block never overshoots a budget or a block table); otherwise
         # drop to exact iteration-level single steps
         min_budget = min(self._slots[i].remaining for i in active)
         n = self.steps_per_call if min_budget >= self.steps_per_call \
@@ -404,13 +759,14 @@ class ServingEngine:
             out = _call_quiet(
                 self._block_fn(n),
                 self._pb, jnp.asarray(self._tok), jnp.asarray(self._lens),
-                jnp.asarray(self._done), self._key, *self._flat_kvs)
+                jnp.asarray(self._done), self._key,
+                jnp.asarray(self._decode_tables()), *self._arenas)
             toks = np.asarray(out[0])                   # [B, n]
         self._tok = np.array(out[1])    # np.array: writable host copies
         self._lens = np.array(out[2])
         done = np.array(out[3])
         self._key = out[4]
-        self._flat_kvs = list(out[5:])
+        self._arenas = list(out[5:])
         self._m.decode_steps.inc(n)
         self._m.busy_slot_steps.inc(n * len(active))
         self._m.block_dispatches.inc()
@@ -423,6 +779,7 @@ class ServingEngine:
             if done[i] or req.remaining == 0:
                 self._slots[i] = None
                 done[i] = True         # freeze the row until re-use
+                self._release_blocks(req)
                 self._finish(req, t, finished)
         self._done = done
         self._m.slot_occupancy.set(
@@ -430,9 +787,10 @@ class ServingEngine:
         return finished
 
     def run(self, max_iters: Optional[int] = None) -> List[Request]:
-        """Drain the queue: admit/decode until every submitted request
-        has finished.  Sleeps only when idle ahead of a future arrival.
-        Returns this call's finished requests in submission order."""
+        """Drain the queue: admit/prefill/decode until every submitted
+        request has finished.  Sleeps only when idle ahead of a future
+        arrival.  Returns this call's finished requests in submission
+        order."""
         finished: List[Request] = []
         iters = 0
         while self._queue or any(r is not None for r in self._slots):
@@ -458,11 +816,16 @@ class ServingEngine:
         its docstring for the shared-registry and disabled-registry
         caveats).  ``mean_slot_occupancy`` is the fraction of (decode
         step x slot) cells that held a live request — the utilization
-        static batching forfeits on mixed-length traces."""
+        static batching forfeits on mixed-length traces.
+        ``prefix_hit_rate`` is block-granular over matchable prompt
+        blocks; ``peak_blocks_in_use`` is the pool's refcount>0
+        high-water mark (host-mirrored, registry-independent)."""
         decode_steps = self._m.since_init(self._m.decode_steps)
         busy = self._m.since_init(self._m.busy_slot_steps)
         occ = (busy / (decode_steps * self.num_slots)
                if decode_steps else 0.0)
+        hits = self._m.since_init(self._m.prefix_hits)
+        misses = self._m.since_init(self._m.prefix_misses)
         return {
             "num_slots": self.num_slots,
             "decode_steps": int(decode_steps),
@@ -470,10 +833,23 @@ class ServingEngine:
             "block_dispatches": int(
                 self._m.since_init(self._m.block_dispatches)),
             "prefills": int(self._m.since_init(self._m.prefills)),
+            "prefill_chunks": int(
+                self._m.since_init(self._m.prefill_chunks)),
             "mean_slot_occupancy": occ,
             "peak_queue": self._peak_queue,
             "finished": int(
                 self._m.since_init(self._m.requests_finished)),
+            "cancelled": int(
+                self._m.since_init(self._m.requests_cancelled)),
+            "block_len": self.block_len,
+            "num_blocks": self.num_blocks,
+            "blocks_in_use": self._pool.in_use(),
+            "peak_blocks_in_use": self._peak_blocks,
+            "prefix_cached_blocks": self._pool.cached(),
+            "prefix_hits": int(hits),
+            "prefix_misses": int(misses),
+            "prefix_hit_rate": (hits / (hits + misses)
+                                if hits + misses else 0.0),
         }
 
     @property

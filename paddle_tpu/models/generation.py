@@ -109,6 +109,63 @@ def cache_scatter(cache, lens, new_kv):
     return cache.at[jnp.arange(b), lens].set(new_kv.astype(cache.dtype))
 
 
+def init_paged_kv_arena(num_layers, num_blocks, block_len, num_kv_heads,
+                        head_dim, dtype):
+    """Per-layer (k, v) PAGED block arenas for the serving engine: one
+    ``[num_blocks + 1, block_len, ...]`` pool per layer
+    (ops/pallas/decode_attention.paged_arena_shape), shared by every
+    slot through per-slot block tables.  The extra trailing row is the
+    TRASH block: statically-shaped scatters from vacant/frozen slots
+    and from pad positions of a prefill chunk are redirected there, so
+    a masked write can never touch another sequence's blocks.  Zero
+    init matters only for the trash/never-written rows: reads past a
+    row's ``lens`` are masked to weight 0, which is exact only against
+    finite stale data (0 * NaN = NaN)."""
+    from ..ops.pallas.decode_attention import paged_arena_shape
+    shape = paged_arena_shape(num_blocks + 1, num_kv_heads, block_len,
+                              head_dim)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(num_layers)]
+
+
+def paged_cache_scatter(arena, tables, lens, new_kv):
+    """Write one new [B, H_kv, D] decode entry at each sequence's slot
+    ``lens[b]``, routed through its block table: arena row
+    ``tables[b, lens[b] // L]``, offset ``lens[b] % L``.  Vacant and
+    frozen rows carry all-trash tables, so their (repeated) writes land
+    in the trash block instead of a block another sequence may now own
+    — the paged replacement for the dense engine's "done rows overwrite
+    their own dead row" contract.  Same O(B*H_kv*D) batched-scatter
+    cost as ``cache_scatter``."""
+    b = tables.shape[0]
+    block_len = arena.shape[1]
+    blk = tables[jnp.arange(b), lens // block_len]
+    off = lens % block_len
+    if arena.ndim == 3:
+        new_kv = new_kv.reshape(b, -1)
+    return arena.at[blk, off].set(new_kv.astype(arena.dtype))
+
+
+def paged_chunk_scatter(arena, tables, start, n_valid, new_kv):
+    """Write a batch-1 prefill chunk's K/V planes ([C, H_kv, D]) at
+    global positions ``start .. start+C-1`` through the slot's block
+    table (``tables`` is [1, max_blocks]).  Positions ``>= n_valid``
+    (the pad tail of the prompt's last chunk) write to the trash row:
+    the chunk shape is static, so the scatter always issues C writes
+    and masking is done by redirecting the target, never by shrinking
+    the shape."""
+    c = new_kv.shape[0]
+    block_len = arena.shape[1]
+    trash = arena.shape[0] - 1
+    pos = start + jnp.arange(c, dtype=jnp.int32)
+    idx = jnp.minimum(pos // block_len, tables.shape[1] - 1)
+    blk = jnp.where(pos < n_valid, tables[0, idx], trash)
+    off = pos % block_len
+    if arena.ndim == 3:
+        new_kv = new_kv.reshape(c, -1)
+    return arena.at[blk, off].set(new_kv.astype(arena.dtype))
+
+
 def cache_prefill_write(cache, kv_bshd):
     """Write prompt K/V planes ([B, S, H_kv, D] as produced by the
     prefill attention) into the cache from slot 0."""

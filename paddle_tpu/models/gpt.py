@@ -107,20 +107,56 @@ class GPTAttention(nn.Layer):
 
     def decode_step(self, x, kv, lens):
         """One cached decode step (MHA: kv heads == q heads, so the GQA
-        grouped attention runs with group size 1)."""
-        from .generation import cache_scatter, cached_decode_attention
+        grouped attention runs with group size 1).  kv is the dense
+        (k_cache, v_cache) pair or the paged (k_arena, v_arena, tables)
+        triple."""
         from ..core.tensor import Tensor
         b = x.shape[0]
-        k_cache, v_cache = kv
         qkv = self.qkv_proj(x).reshape([b, 1, 3, self.num_heads,
                                         self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        k_cache = cache_scatter(k_cache, lens, k._value[:, 0])
-        v_cache = cache_scatter(v_cache, lens, v._value[:, 0])
-        out = cached_decode_attention(q._value[:, 0], k_cache, v_cache,
-                                      lens)
+        if len(kv) == 3:
+            from .generation import paged_cache_scatter
+            from ..ops.pallas.decode_attention import decode_attention_paged
+            k_arena, v_arena, tables = kv
+            k_arena = paged_cache_scatter(k_arena, tables, lens,
+                                          k._value[:, 0])
+            v_arena = paged_cache_scatter(v_arena, tables, lens,
+                                          v._value[:, 0])
+            out = decode_attention_paged(q._value[:, 0], k_arena, v_arena,
+                                         tables, lens)
+            kv = (k_arena, v_arena, tables)
+        else:
+            from .generation import cache_scatter, cached_decode_attention
+            k_cache, v_cache = kv
+            k_cache = cache_scatter(k_cache, lens, k._value[:, 0])
+            v_cache = cache_scatter(v_cache, lens, v._value[:, 0])
+            out = cached_decode_attention(q._value[:, 0], k_cache, v_cache,
+                                          lens)
+            kv = (k_cache, v_cache)
         out = self.out_proj(Tensor(out[:, None, :]))
-        return out, (k_cache, v_cache)
+        return out, kv
+
+    def chunk_step(self, x, kv, start, n_valid):
+        """One chunked-prefill step over the paged cache (batch-1 C
+        prompt tokens; see LlamaAttention.chunk_step — position ids
+        are applied at the model level here, GPT has no RoPE)."""
+        from .generation import paged_chunk_scatter
+        from ..ops.pallas.decode_attention import paged_prefix_attention
+        from ..core.tensor import Tensor
+        b, c, _ = x.shape
+        qkv = self.qkv_proj(x).reshape([b, c, 3, self.num_heads,
+                                        self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k_arena, v_arena, tables = kv
+        k_arena = paged_chunk_scatter(k_arena, tables, start, n_valid,
+                                      k._value[0])
+        v_arena = paged_chunk_scatter(v_arena, tables, start, n_valid,
+                                      v._value[0])
+        out = paged_prefix_attention(q._value, k_arena, v_arena, tables,
+                                     start.reshape(1))
+        out = self.out_proj(Tensor(out.reshape(b, c, -1)))
+        return out, (k_arena, v_arena, tables)
 
 
 class GPTMLP(nn.Layer):
@@ -169,6 +205,11 @@ class GPTDecoderLayer(nn.Layer):
 
     def decode_step(self, x, kv, lens):
         a, kv = self.attn.decode_step(self.ln_1(x), kv, lens)
+        x = x + self.dropout(a)
+        return x + self.mlp(self.ln_2(x)), kv
+
+    def chunk_step(self, x, kv, start, n_valid):
+        a, kv = self.attn.chunk_step(self.ln_1(x), kv, start, n_valid)
         x = x + self.dropout(a)
         return x + self.mlp(self.ln_2(x)), kv
 
@@ -283,6 +324,31 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
             new_kvs.append(kv)
         x = self.gpt.ln_f(x)
         logits = self.lm_head(x)._value[:, 0]
+        return logits, new_kvs
+
+    def prefill_chunk(self, ids, start, n_valid, kvs):
+        """One chunked-prefill pass (paged kv triples): ids [1, C] at
+        global positions start..start+C-1; learned positions are
+        clipped at the table edge for the pad tail (those rows' K/V are
+        trash-routed, so the clamp never leaks into a real prefix).
+        Returns the logits at prompt position ``n_valid - 1`` plus the
+        updated kvs."""
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        c = ids.shape[1]
+        limit = self.config.max_position_embeddings
+        pos = jnp.clip(start + jnp.arange(c, dtype=jnp.int32), 0,
+                       limit - 1)
+        x = self.gpt.drop(self.gpt.wte(Tensor(ids))
+                          + self.gpt.wpe(Tensor(pos[None, :])))
+        new_kvs = []
+        for block, kv in zip(self.gpt.h, kvs):
+            x, kv = block.chunk_step(x, kv, start, n_valid)
+            new_kvs.append(kv)
+        h = self.gpt.ln_f(x)._value
+        idx = jnp.clip(n_valid - 1 - start, 0, c - 1)
+        last = h[0, idx]
+        logits = self.lm_head(Tensor(last[None, None, :]))._value[:, 0]
         return logits, new_kvs
 
 

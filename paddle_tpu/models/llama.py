@@ -164,18 +164,57 @@ class LlamaAttention(nn.Layer):
     def decode_step(self, x, kv, lens):
         """One cached decode step (the masked_multihead_attention role,
         GQA-aware).  x: [B, 1, hidden]; kv: (k_cache, v_cache) static
-        [B, S_max, H_kv*D] buffers; lens: [B] write slot / last valid
-        index.  Returns (out [B, 1, hidden], updated kv)."""
-        from .generation import cache_scatter, cached_decode_attention
-        k_cache, v_cache = kv
-        q, k, v = self._qkv_rope(x, lens[:, None])
-        k_cache = cache_scatter(k_cache, lens, k._value[:, 0])
-        v_cache = cache_scatter(v_cache, lens, v._value[:, 0])
-        out = cached_decode_attention(q._value[:, 0], k_cache, v_cache,
-                                      lens)
+        [B, S_max, H_kv*D] buffers, or the PAGED 3-tuple
+        (k_arena, v_arena, block_tables) used by the serving engine;
+        lens: [B] write slot / last valid index.  Returns
+        (out [B, 1, hidden], updated kv — same arity as given)."""
         from ..core.tensor import Tensor
+        q, k, v = self._qkv_rope(x, lens[:, None])
+        if len(kv) == 3:
+            from .generation import paged_cache_scatter
+            from ..ops.pallas.decode_attention import decode_attention_paged
+            k_arena, v_arena, tables = kv
+            k_arena = paged_cache_scatter(k_arena, tables, lens,
+                                          k._value[:, 0])
+            v_arena = paged_cache_scatter(v_arena, tables, lens,
+                                          v._value[:, 0])
+            out = decode_attention_paged(q._value[:, 0], k_arena, v_arena,
+                                         tables, lens)
+            kv = (k_arena, v_arena, tables)
+        else:
+            from .generation import cache_scatter, cached_decode_attention
+            k_cache, v_cache = kv
+            k_cache = cache_scatter(k_cache, lens, k._value[:, 0])
+            v_cache = cache_scatter(v_cache, lens, v._value[:, 0])
+            out = cached_decode_attention(q._value[:, 0], k_cache, v_cache,
+                                          lens)
+            kv = (k_cache, v_cache)
         out = self.o_proj(Tensor(out[:, None, :]))
-        return out, (k_cache, v_cache)
+        return out, kv
+
+    def chunk_step(self, x, kv, start, n_valid):
+        """One chunked-prefill step over the PAGED cache: x holds C
+        prompt tokens of ONE sequence ([1, C, hidden]) at global
+        positions ``start .. start+C-1``; K/V are scattered through the
+        slot's block table (pad positions ``>= n_valid`` trash-routed)
+        and attention runs causally over the full written prefix —
+        prefix-cached blocks included, which is how a prefix hit skips
+        recomputing the shared leading blocks."""
+        from .generation import paged_chunk_scatter
+        from ..ops.pallas.decode_attention import paged_prefix_attention
+        b, c, _ = x.shape
+        pos = start + jnp.arange(c, dtype=jnp.int32)
+        q, k, v = self._qkv_rope(x, pos[None, :])
+        k_arena, v_arena, tables = kv
+        k_arena = paged_chunk_scatter(k_arena, tables, start, n_valid,
+                                      k._value[0])
+        v_arena = paged_chunk_scatter(v_arena, tables, start, n_valid,
+                                      v._value[0])
+        out = paged_prefix_attention(q._value, k_arena, v_arena, tables,
+                                     start.reshape(1))
+        from ..core.tensor import Tensor
+        out = self.o_proj(Tensor(out.reshape(b, c, -1)))
+        return out, (k_arena, v_arena, tables)
 
 
 class LlamaMLP(nn.Layer):
@@ -243,6 +282,12 @@ class LlamaDecoderLayer(nn.Layer):
     def decode_step(self, x, kv, lens):
         attn_out, kv = self.self_attn.decode_step(self.input_layernorm(x),
                                                   kv, lens)
+        h = x + attn_out
+        return h + self.mlp(self.post_attention_layernorm(h)), kv
+
+    def chunk_step(self, x, kv, start, n_valid):
+        attn_out, kv = self.self_attn.chunk_step(self.input_layernorm(x),
+                                                 kv, start, n_valid)
         h = x + attn_out
         return h + self.mlp(self.post_attention_layernorm(h)), kv
 
@@ -337,7 +382,9 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
         return self.llama.norm(x), kvs
 
     def decode_step(self, tokens, lens, kvs):
-        """One cached decode step over all layers. tokens: [B] int32."""
+        """One cached decode step over all layers. tokens: [B] int32.
+        Each kv entry may be the dense (k, v) pair or the paged
+        (k_arena, v_arena, tables) triple — the layers dispatch."""
         from ..core.tensor import Tensor
         x = self.llama.embed_tokens(Tensor(tokens[:, None]))
         new_kvs = []
@@ -346,6 +393,27 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
             new_kvs.append(kv)
         x = self.llama.norm(x)
         logits = self.lm_head(x)._value[:, 0]
+        return logits, new_kvs
+
+    def prefill_chunk(self, ids, start, n_valid, kvs):
+        """One chunked-prefill pass over all layers (paged kv triples):
+        ids [1, C] prompt tokens at global positions start..start+C-1;
+        ``n_valid`` is the prompt's true length.  Returns the logits at
+        prompt position ``n_valid - 1`` — meaningful only on the chunk
+        that covers it (the serving engine ignores earlier chunks'
+        return) — plus the updated kvs."""
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        c = ids.shape[1]
+        x = self.llama.embed_tokens(Tensor(ids))
+        new_kvs = []
+        for layer, kv in zip(self.llama.layers, kvs):
+            x, kv = layer.chunk_step(x, kv, start, n_valid)
+            new_kvs.append(kv)
+        h = self.llama.norm(x)._value
+        idx = jnp.clip(n_valid - 1 - start, 0, c - 1)
+        last = h[0, idx]                                   # [hidden]
+        logits = self.lm_head(Tensor(last[None, None, :]))._value[:, 0]
         return logits, new_kvs
 
 

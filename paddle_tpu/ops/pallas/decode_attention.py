@@ -69,15 +69,42 @@ def cache_shape(batch, num_kv_heads, max_cache_len, head_dim):
     return (batch, max_cache_len, num_kv_heads, head_dim)
 
 
+def paged_arena_shape(num_blocks, num_kv_heads, block_len, head_dim):
+    """At-rest PAGED KV arena shape: one pool of ``num_blocks`` blocks
+    of ``block_len`` slots shared by every sequence (vLLM's
+    PagedAttention layout), packed [NB, L, H*D] when the head geometry
+    allows (each block row keeps the heads-in-lanes tiling of
+    ``cache_shape``), else [NB, L, H, D]."""
+    if packed_ok(num_kv_heads, head_dim):
+        return (num_blocks, block_len, num_kv_heads * head_dim)
+    return (num_blocks, block_len, num_kv_heads, head_dim)
+
+
+def paged_gather_view(arena, tables):
+    """Dense per-sequence view of a paged arena: gather each row's
+    blocks through its table and fold the block axis into a
+    [B, max_blocks * L, ...] cache the existing attention math reads.
+    Table entries past a sequence's allocation point at the trash block
+    (last arena row); its contents are finite garbage hidden by the
+    same ``lens`` masking that hides unwritten slots of a dense
+    cache."""
+    g = arena[tables]                  # [B, max_blocks, L, ...]
+    b, nb, blk_len = g.shape[:3]
+    return g.reshape((b, nb * blk_len) + g.shape[3:])
+
+
 def decode_attn_sig(b, hkv, g, s, d, dtype):
     import numpy as np
     return f"{b}x{hkv}x{g}x{s}x{d}/{np.dtype(dtype)}"
 
 
-def _route_decision(q4, cache):
-    """(use_pallas, reason) for the decode-attention dispatch gate —
-    the reason string feeds the ``pallas.decode_attention.route``
-    fallback-rate counter."""
+def _gate_shared(q4, cache, s, align_ok, align_reason):
+    """The gate checks common to the dense and paged dispatchers —
+    ONE implementation so the two routes cannot silently diverge.
+    ``s`` is the staged dense-row count; ``align_ok``/``align_reason``
+    inject the path-specific sublane-tiling rule at its position in
+    the check order.  Returns (use_pallas, reason-or-None); the caller
+    maps None to its accept reason."""
     from ...core.flags import flag
     if not flag("use_decode_attention_kernel"):
         return False, "flag_disabled"
@@ -92,19 +119,28 @@ def _route_decision(q4, cache):
         # explicitly (fp32 logits, V cast at the PV dot)
         return False, "dtype_mismatch"
     b, hkv, g, d = q4.shape
-    s, w = cache.shape[1], cache.shape[2]
+    w = cache.shape[2]
     if not packed_ok(hkv, d) or w != hkv * d:
         return False, "geometry"
     if g > _GPAD:        # q_cat blocks hold at most 8 query heads/KV head
         return False, "group_too_wide"
-    if s % 8:
-        return False, "seq_align"
+    if not align_ok:
+        return False, align_reason
     itemsize = jnp.dtype(cache.dtype).itemsize
     gw = max(_LANES, d)
     lg_bytes = (w // gw) * (gw // d) * _GPAD * s * 4
     if 2 * s * w * itemsize + lg_bytes > _VMEM_BUDGET:
         return False, "vmem_budget"
-    return True, "ok"
+    return True, None
+
+
+def _route_decision(q4, cache):
+    """(use_pallas, reason) for the decode-attention dispatch gate —
+    the reason string feeds the ``pallas.decode_attention.route``
+    fallback-rate counter."""
+    s = cache.shape[1]
+    use, reason = _gate_shared(q4, cache, s, s % 8 == 0, "seq_align")
+    return use, reason or "ok"
 
 
 _route_counter_inst = None
@@ -133,6 +169,28 @@ def should_use_pallas(q4, cache) -> bool:
     # counted at trace/gate time (once per compiled program or direct
     # query, not per device step): the always-on Pallas-fallback-rate
     # signal the bench JSON and Prometheus scrape expose
+    _route_counter().inc(decision="pallas" if use else "xla",
+                         reason=reason)
+    return use
+
+
+def _route_decision_paged(q4, arena, tables):
+    """(use_pallas, reason) for the PAGED decode-attention gate: the
+    shared gate (``_gate_shared``) evaluated on the arena geometry,
+    with the paged-only sublane rule in place of ``seq_align`` — the
+    staged chunk unit is a whole block, so ``block_len`` must sit on
+    the (8, 128) sublane tile (``paged_block_len``).  Accepts route as
+    ``paged_ok`` so the route counter separates paged-kernel traffic
+    from dense ``ok``."""
+    blk_len = arena.shape[1]
+    s = tables.shape[1] * blk_len      # staged dense rows
+    use, reason = _gate_shared(q4, arena, s, blk_len % 8 == 0,
+                               "paged_block_len")
+    return use, reason or "paged_ok"
+
+
+def should_use_pallas_paged(q4, arena, tables) -> bool:
+    use, reason = _route_decision_paged(q4, arena, tables)
     _route_counter().inc(decision="pallas" if use else "xla",
                          reason=reason)
     return use
@@ -232,6 +290,84 @@ def _kernel(lens_ref, qcat_ref, k_hbm, v_hbm, o_ref,
                            ).astype(out_dtype)
 
 
+def _paged_kernel(lens_ref, tbl_ref, qcat_ref, k_hbm, v_hbm, o_ref,
+                  kbuf, vbuf, lg_ref, ksem, vsem,
+                  *, block_len, n_blocks_max, scale, out_dtype, hkv, g, d,
+                  gw, hp, ng):
+    """Block-table variant of ``_kernel``: the c-th staged chunk DMAs
+    arena block ``tbl_ref[bi, c]`` (a [block_len, W] row of the shared
+    pool) instead of a slice of a per-sequence contiguous cache row —
+    the indirection is resolved at DMA-issue time from the scalar-
+    prefetched table, so traffic is still O(valid prefix) and the
+    compute phases see the same contiguous [rows, W] staging buffer.
+    The scratch-reuse invariant of ``_kernel`` (vbuf zeroed at program
+    0 only, stale K masked to -inf before exp, sequential grid) carries
+    over unchanged."""
+    bi = pl.program_id(0)
+    length = lens_ref[bi]                     # last valid slot index
+    n_blk = length // block_len + 1
+    rows = n_blocks_max * block_len
+
+    @pl.when(bi == 0)
+    def _():
+        vbuf[...] = jnp.zeros_like(vbuf)
+
+    for c in range(n_blocks_max):             # static unroll, guarded
+        @pl.when(c < n_blk)
+        def _(c=c):
+            pltpu.make_async_copy(
+                k_hbm.at[tbl_ref[bi, c]],
+                kbuf.at[pl.ds(c * block_len, block_len), :],
+                ksem.at[c]).start()
+            pltpu.make_async_copy(
+                v_hbm.at[tbl_ref[bi, c]],
+                vbuf.at[pl.ds(c * block_len, block_len), :],
+                vsem.at[c]).start()
+
+    for c in range(n_blocks_max):
+        @pl.when(c < n_blk)
+        def _(c=c):
+            pltpu.make_async_copy(
+                k_hbm.at[tbl_ref[bi, c]],
+                kbuf.at[pl.ds(c * block_len, block_len), :],
+                ksem.at[c]).wait()
+
+    for p in range(ng):
+        lg_ref[p] = jax.lax.dot_general(
+            qcat_ref[0, p], kbuf[:, p * gw:(p + 1) * gw],
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [hp*8, rows]
+
+    sub = jax.lax.broadcasted_iota(jnp.int32, (ng, hp * _GPAD, rows), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (ng, hp * _GPAD, rows), 2)
+    keep = (row <= length) & (jax.lax.rem(sub, _GPAD) < g)
+    lg = jnp.where(keep, lg_ref[...], _NEG_INF)
+    m = jnp.max(lg, axis=-1, keepdims=True)
+    p_ = jnp.exp(lg - m)
+    l = jnp.sum(p_, axis=-1, keepdims=True)    # [ng, hp*8, 1]
+    lg_ref[...] = p_
+
+    for c in range(n_blocks_max):
+        @pl.when(c < n_blk)
+        def _(c=c):
+            pltpu.make_async_copy(
+                v_hbm.at[tbl_ref[bi, c]],
+                vbuf.at[pl.ds(c * block_len, block_len), :],
+                vsem.at[c]).wait()
+
+    for p in range(ng):
+        pv_w = jax.lax.dot_general(
+            lg_ref[p].astype(vbuf.dtype), vbuf[:, p * gw:(p + 1) * gw],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [hp*8, gw]
+        for j in range(hp):
+            h = p * hp + j
+            o_ref[0, h] = (pv_w[j * _GPAD:j * _GPAD + g,
+                                j * d:(j + 1) * d]
+                           / l[p, j * _GPAD:j * _GPAD + g]
+                           ).astype(out_dtype)
+
+
 def _build_qcat(q4, hp, ng, gw):
     """Block-diagonal q: [B, H_kv, G, D] -> [B, ng, hp*8, gw] where
     group p, block j holds head p*hp+j's q in lane range [j*D, (j+1)*D)
@@ -292,6 +428,50 @@ def _decode_attention_pallas(q4, k_cache, v_cache, lens, chunk=None):
     )(lens.astype(jnp.int32), qcat, k_cache, v_cache)
 
 
+def _decode_attention_pallas_paged(q4, k_arena, v_arena, tables, lens):
+    """q4: [B, H_kv, G, D]; arenas packed [NB+1, L, H_kv*D] (last row =
+    trash block); tables: [B, max_blocks] int32 arena row indices."""
+    b, hkv, g, d = q4.shape
+    blk_len = k_arena.shape[1]
+    w = k_arena.shape[2]
+    n_blocks_max = tables.shape[1]
+    s = n_blocks_max * blk_len
+    gw = max(_LANES, d)
+    hp = gw // d
+    ng = w // gw
+    kernel = functools.partial(
+        _paged_kernel, block_len=blk_len, n_blocks_max=n_blocks_max,
+        scale=1.0 / (d ** 0.5), out_dtype=q4.dtype, hkv=hkv, g=g, d=d,
+        gw=gw, hp=hp, ng=ng)
+    qcat = _build_qcat(q4, hp, ng, gw)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, ng, hp * _GPAD, gw),
+                         lambda bi, lens_p, tbl_p: (bi, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, hkv, g, d),
+                               lambda bi, lens_p, tbl_p: (bi, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((s, w), k_arena.dtype),
+            pltpu.VMEM((s, w), v_arena.dtype),
+            pltpu.VMEM((ng, hp * _GPAD, s), jnp.float32),
+            pltpu.SemaphoreType.DMA((n_blocks_max,)),
+            pltpu.SemaphoreType.DMA((n_blocks_max,)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q4.dtype),
+        interpret=not on_tpu(),
+    )(lens.astype(jnp.int32), tables.astype(jnp.int32), qcat,
+      k_arena, v_arena)
+
+
 def _decode_attention_xla(q4, k_cache, v_cache, lens):
     """Reference math on the logical [B, S, H_kv, D] view (fp32
     softmax): the non-TPU / odd-shape fallback.  Accepts packed
@@ -329,3 +509,71 @@ def decode_attention(q, k_cache, v_cache, lens):
     else:
         out = _decode_attention_xla(q4, k_cache, v_cache, lens)
     return out.reshape(b, hq * d)
+
+
+def decode_attention_paged(q, k_arena, v_arena, tables, lens):
+    """One-token GQA attention over a PAGED cache prefix.
+
+    q: [B, H_q, D]; arenas: ``paged_arena_shape`` pools (packed
+    [NB+1, L, H_kv*D] or unpacked [NB+1, L, H_kv, D], last row = trash
+    block); tables: [B, max_blocks] int32 arena row per logical block;
+    lens: [B] = index of the LAST valid slot.  On TPU (and when the
+    block geometry passes ``_route_decision_paged``) this runs the
+    block-table Pallas kernel — DMA indirection through the
+    scalar-prefetched table, no dense copy of the pool; otherwise the
+    gather-based XLA path materializes each row's dense view
+    (``paged_gather_view``) and reuses the reference math.  Returns
+    [B, H_q * D] in q.dtype.
+    """
+    b, hq, d = q.shape
+    hkv = (k_arena.shape[2] // d if k_arena.ndim == 3
+           else k_arena.shape[2])
+    g = hq // hkv
+    q4 = q.reshape(b, hkv, g, d)
+    if should_use_pallas_paged(q4, k_arena, tables):
+        out = _decode_attention_pallas_paged(q4, k_arena, v_arena,
+                                             tables, lens)
+    else:
+        out = _decode_attention_xla(q4, paged_gather_view(k_arena, tables),
+                                    paged_gather_view(v_arena, tables),
+                                    lens)
+    return out.reshape(b, hq * d)
+
+
+def paged_prefix_attention(q, k_arena, v_arena, tables, start):
+    """Chunked-prefill attention over the paged cache: C chunk queries
+    at global positions ``start + row`` attend causally over everything
+    already written through the block table (prefix-cached blocks,
+    earlier chunks, and this chunk's own K/V — the scatter happens
+    before this read).
+
+    q: [B, C, H_q, D]; arenas/tables as ``decode_attention_paged``;
+    start: [B] first global position of the chunk.  Always the
+    gather-based XLA path with fp32 softmax — prefill is
+    compute-bound over the chunk, not cache-sweep-bound, so the paged
+    kernel's DMA indirection buys nothing here.  Returns
+    [B, C, H_q, D] in q.dtype; rows past the prompt's true length
+    compute garbage that the caller masks (their K/V writes were
+    trash-routed, so the garbage never enters any other row's
+    prefix)."""
+    b, cc, hq, d = q.shape
+    kd = paged_gather_view(k_arena, tables)
+    vd = paged_gather_view(v_arena, tables)
+    if kd.ndim == 3:
+        s = kd.shape[1]
+        hkv = kd.shape[2] // d
+        kd = kd.reshape(b, s, hkv, d)
+        vd = vd.reshape(b, s, hkv, d)
+    else:
+        s, hkv = kd.shape[1], kd.shape[2]
+    g = hq // hkv
+    q5 = q.reshape(b, cc, hkv, g, d)
+    logits = jnp.einsum("bckgd,bskd->bckgs", q5, kd,
+                        preferred_element_type=jnp.float32)
+    logits = logits / jnp.sqrt(jnp.float32(d))
+    pos = start.reshape(b, 1) + jnp.arange(cc)[None, :]        # [B, C]
+    keep = jnp.arange(s)[None, None, :] <= pos[:, :, None]     # [B, C, S]
+    logits = jnp.where(keep[:, :, None, None, :], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bckgs,bskd->bckgd", probs, vd.astype(q.dtype))
+    return out.reshape(b, cc, hq, d)

@@ -427,6 +427,17 @@ def test_metrics_name_lint_clean():
     assert "serving.queue_depth" in names
     assert "train_step.compiles" in names
     assert "pallas.decode_attention.route" in names
+    # the paged serving instruments are covered too
+    for n in ("serving.blocks_free", "serving.blocks_in_use",
+              "serving.prefix_hits", "serving.prefix_misses",
+              "serving.prefill_chunks", "serving.requests_cancelled",
+              "serving.prefill_chunk_seconds"):
+        assert n in names, n
+    # the AST walker resolves labels: the route counter's label tuple
+    # is visible to the conflict rule
+    by_name = {r[3]: r[4] for r in regs}
+    assert by_name["pallas.decode_attention.route"] == \
+        ("decision", "reason")
 
 
 def test_metrics_name_lint_catches_violations(tmp_path):
@@ -437,9 +448,20 @@ def test_metrics_name_lint_catches_violations(tmp_path):
         'r.counter("Bad.Name")\n'
         'r.counter("dup.name")\n'
         'r.gauge("dup.name")\n'
+        'r.counter("lbl.name", "help", labels=("a", "b"))\n'
+        'r.counter("lbl.name", "help", labels=("a",))\n'
+        'r.counter("lbl.bare", "help", labels=("a",))\n'
+        'r.counter("lbl.bare")\n'
+        'r.counter("lbl.dyn", "help", labels=("a",))\n'
+        'r.counter("lbl.dyn", "help", labels=make_labels())\n'
         'HostTracer.counter("Free Form OK", 1)\n')
     errors, regs = lint.check(str(tmp_path))
-    assert len(errors) == 2
+    assert len(errors) == 4
     assert any("Bad.Name" in e for e in errors)
     assert any("dup.name" in e and "conflict" not in e for e in errors)
+    # conflicting literal label tuples caught — including a bare
+    # (unlabeled) site vs a labeled one; dynamic labels opt out
+    assert any("lbl.name" in e for e in errors)
+    assert any("lbl.bare" in e for e in errors)
+    assert all("lbl.dyn" not in e for e in errors)
     assert all("Free Form OK" not in e for e in errors)

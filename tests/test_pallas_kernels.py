@@ -735,6 +735,73 @@ def test_decode_attention_ignores_stale_tail():
                                atol=1e-5)
 
 
+def test_decode_attention_paged_kernel_parity():
+    """Block-table Pallas kernel (interpret mode) vs the gather-based
+    XLA paged path: scattered arena blocks + per-row tables must equal
+    attention over each row's gathered dense view, across ragged
+    lens (partial blocks included)."""
+    from paddle_tpu.ops.pallas.decode_attention import (
+        _decode_attention_pallas_paged, paged_gather_view,
+        _route_decision_paged)
+    rng = np.random.default_rng(13)
+    b, hkv, g, blk_len, nb, mb, d = 3, 2, 2, 8, 12, 4, 64
+    w = hkv * d
+    q4 = jnp.asarray(rng.standard_normal((b, hkv, g, d)), jnp.float32)
+    ka = jnp.asarray(rng.standard_normal((nb + 1, blk_len, w)),
+                     jnp.float32)
+    va = jnp.asarray(rng.standard_normal((nb + 1, blk_len, w)),
+                     jnp.float32)
+    tables = jnp.asarray(rng.permutation(nb)[:b * mb].reshape(b, mb),
+                         jnp.int32)
+    lens = jnp.asarray([5, 17, 30], jnp.int32)   # mid-block frontiers
+    use, reason = _route_decision_paged(q4, ka, tables)
+    assert reason in ("paged_ok", "pallas_unavailable")
+    out = _decode_attention_pallas_paged(q4, ka, va, tables, lens)
+    ref = _ref_decode_attention(q4, paged_gather_view(ka, tables),
+                                paged_gather_view(va, tables), lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)
+    # the new gate reason: off-sublane block lengths reject cleanly
+    ka_bad = jnp.zeros((nb + 1, 6, w), jnp.float32)
+    use2, reason2 = _route_decision_paged(q4, ka_bad, tables)
+    assert not use2 and reason2 in ("paged_block_len",
+                                    "pallas_unavailable")
+
+
+def test_decode_attention_paged_equals_dense_layout():
+    """A paged arena holding the same logical content as a dense cache
+    must produce the same decode-attention output through the XLA
+    paths — the exactness contract the serving engine's generate()
+    parity rests on (extra masked columns contribute exact zeros)."""
+    from paddle_tpu.ops.pallas.decode_attention import (
+        decode_attention, decode_attention_paged)
+    rng = np.random.default_rng(14)
+    b, hq, hkv, d, blk_len, mb = 2, 4, 2, 64, 8, 3
+    s = blk_len * mb
+    w = hkv * d
+    q = jnp.asarray(rng.standard_normal((b, hq, d)), jnp.float32)
+    dense = jnp.asarray(rng.standard_normal((b, s, w)), jnp.float32)
+    dense_v = jnp.asarray(rng.standard_normal((b, s, w)), jnp.float32)
+    # scatter the dense rows into a shuffled arena
+    perm = rng.permutation(2 * b * mb)[:b * mb]
+    nb = 2 * b * mb
+    ka = jnp.zeros((nb + 1, blk_len, w), jnp.float32)
+    va = jnp.zeros((nb + 1, blk_len, w), jnp.float32)
+    tables = np.zeros((b, mb), np.int32)
+    for r in range(b):
+        for j in range(mb):
+            blk = int(perm[r * mb + j])
+            tables[r, j] = blk
+            ka = ka.at[blk].set(dense[r, j * blk_len:(j + 1) * blk_len])
+            va = va.at[blk].set(dense_v[r, j * blk_len:(j + 1) * blk_len])
+    lens = jnp.asarray([s - 1, 11], jnp.int32)
+    out_paged = decode_attention_paged(q, ka, va,
+                                       jnp.asarray(tables), lens)
+    out_dense = decode_attention(q, dense, dense_v, lens)
+    np.testing.assert_allclose(np.asarray(out_paged),
+                               np.asarray(out_dense), atol=1e-6)
+
+
 def test_decode_attention_public_layout():
     """decode_attention takes q [B, Hq, D] and returns [B, Hq*D] in
     q.dtype, matching models/generation.cached_decode_attention; both

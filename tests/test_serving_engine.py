@@ -82,9 +82,11 @@ def test_mixed_trace_parity_slot_reuse_admission(netm):
 def test_engine_loop_smoke_pallas_interpret(monkeypatch):
     """Fast tier-1 smoke: the scheduler loop drives the REAL flash-
     decode Pallas kernel (interpret mode on CPU) end to end — geometry
-    chosen so ``should_use_pallas`` routes (packed cache, g <= 8,
-    s % 8 == 0) — admissions, mixed-fill decode blocks, evictions and
-    slot reuse all run over the kernel path on every PR."""
+    chosen so the paged gate routes (packed arena, g <= 8,
+    block_len % 8 == 0) — admissions, chunked prefill, mixed-fill
+    decode blocks over the BLOCK-TABLE kernel, evictions and block
+    reuse all run over the kernel path on every PR."""
+    from paddle_tpu.observability.metrics import get_registry
     from paddle_tpu.ops.pallas import decode_attention as da
     monkeypatch.setattr(da, "pallas_enabled", lambda: True)
     cfg = models.LlamaConfig(
@@ -96,10 +98,14 @@ def test_engine_loop_smoke_pallas_interpret(monkeypatch):
     assert cfg.head_dim == 64 and da.packed_ok(2, 64)
     q4 = np.zeros((2, 2, 2, 64), np.float32)
     kc = np.zeros((2, 16, 128), np.float32)
-    assert da.should_use_pallas(q4, kc)     # the kernel really routes
+    assert da.should_use_pallas(q4, kc)     # the dense gate still routes
+    route = get_registry().counter("pallas.decode_attention.route",
+                                   labels=("decision", "reason"))
+    base_paged = route.value(decision="pallas", reason="paged_ok")
     rng = np.random.default_rng(5)
     eng = ServingEngine(net, num_slots=2, prompt_len=4, max_cache_len=16,
-                        steps_per_call=2, compute_dtype="float32")
+                        steps_per_call=2, block_len=8,
+                        compute_dtype="float32")
     reqs = [eng.submit(rng.integers(0, cfg.vocab_size, (n,))
                        .astype(np.int32), max_new_tokens=m)
             for n, m in ((4, 5), (3, 3), (4, 4))]
@@ -109,6 +115,9 @@ def test_engine_loop_smoke_pallas_interpret(monkeypatch):
         assert r.output.shape == (r.max_new_tokens,)
         assert (r.output >= 0).all() and (r.output < cfg.vocab_size).all()
     assert 0.0 < eng.stats()["mean_slot_occupancy"] <= 1.0
+    # the decode blocks really dispatched the paged kernel variant
+    assert route.value(decision="pallas",
+                       reason="paged_ok") > base_paged
 
 
 def test_submit_guards(netm):
@@ -121,14 +130,136 @@ def test_submit_guards(netm):
         eng.submit(np.zeros((4,), np.int32), max_new_tokens=0)
     with pytest.raises(ValueError, match="max_cache_len"):
         eng.submit(np.zeros((4,), np.int32), max_new_tokens=100)
+    # the capacity error is block-aware: tokens AND blocks reported
+    with pytest.raises(ValueError, match=r"blocks"):
+        eng.submit(np.zeros((4,), np.int32), max_new_tokens=100)
     with pytest.raises(ValueError, match="seq_len"):
         eng.submit(np.zeros((4,), np.int32), seq_len=9)
     with pytest.raises(ValueError, match="num_slots"):
         ServingEngine(net, num_slots=0, prompt_len=4, max_cache_len=8)
+    with pytest.raises(ValueError, match="block_len"):
+        ServingEngine(net, num_slots=1, prompt_len=4, max_cache_len=8,
+                      block_len=0)
+    # a request that fits max_cache_len but not the (shrunk) pool
+    small = ServingEngine(net, num_slots=1, prompt_len=4,
+                          max_cache_len=8, block_len=2, num_blocks=2,
+                          compute_dtype="float32")
+    with pytest.raises(ValueError, match="num_blocks"):
+        small.submit(np.zeros((4,), np.int32), max_new_tokens=4)
     with pytest.raises(ValueError, match="beam|slot-granular"):
         from paddle_tpu.models.generation import GenerationConfig
         from paddle_tpu.inference.llm import build_slot_prefill
         build_slot_prefill(net, 8, GenerationConfig(num_beams=2))
+    with pytest.raises(ValueError, match="beam|chunked"):
+        from paddle_tpu.models.generation import GenerationConfig
+        from paddle_tpu.inference.llm import build_chunk_prefill
+        build_chunk_prefill(net, GenerationConfig(num_beams=2))
+
+
+def test_cancel_queued_request(netm):
+    """cancel() drops a still-queued request (no device work involved:
+    nothing here compiles) and refuses in-flight/unknown ids."""
+    cfg, net = netm
+    eng = ServingEngine(net, num_slots=1, prompt_len=4, max_cache_len=8,
+                        compute_dtype="float32")
+    a = eng.submit(np.zeros((4,), np.int32), max_new_tokens=2)
+    b = eng.submit(np.ones((4,), np.int32), max_new_tokens=2)
+    assert eng.cancel(a.request_id) is True
+    assert a.state == "cancelled"
+    assert eng.cancel(a.request_id) is False        # already gone
+    assert eng.cancel(10_000) is False              # unknown
+    s = eng.stats()
+    assert s["cancelled"] == 1
+    assert len(eng._queue) == 1 and eng._queue[0] is b
+    assert eng.metrics_registry.get("serving.requests_cancelled") \
+        .value() >= 1
+
+
+def test_block_pool_unit():
+    """Host-side BlockPool semantics: alloc/refcount/publish/LRU
+    reclaim — no device work."""
+    from paddle_tpu.inference.serving import BlockPool
+    pool = BlockPool(4, block_len=2)
+    assert pool.available() == 4 and pool.trash == 4
+    blocks = pool.alloc(3)
+    assert sorted(blocks) == [0, 1, 2] and pool.in_use() == 3
+    assert pool.alloc(2) is None                  # only 1 left
+    pool.register(blocks[0], b"dg0")
+    pool.register(blocks[1], b"dg1")
+    pool.register(blocks[2], b"dg1")      # duplicate content: first wins
+    assert pool.lookup(b"dg1") == blocks[1]
+    for blk in blocks:
+        pool.unpin(blk)
+    # published blocks park in the LRU (still mapped), others free
+    assert pool.available() == 4 and pool.cached() == 2
+    assert pool.lookup(b"dg0") == blocks[0]
+    hit = pool.lookup(b"dg1")
+    pool.pin(hit)                                 # prefix hit re-pins
+    assert pool.cached() == 1 and pool.in_use() == 1
+    # exhausting the free list reclaims the LRU (dg0 unmaps)
+    got = pool.alloc(3)
+    assert len(got) == 3 and pool.lookup(b"dg0") is None
+    assert pool.alloc(1) is None                  # truly empty now
+    pool.unpin(hit)
+    assert pool.lookup(b"dg1") == hit             # still cached
+    with pytest.raises(RuntimeError, match="double free"):
+        pool.unpin(hit)
+
+
+def test_paged_prefix_parity_chunked_prefill(netm):
+    """The paged acceptance contract in one trace: 5 requests / 2 slots
+    over a 12-block pool (block_len 2 — every request spans multiple
+    blocks and the pool is smaller than the trace's total footprint, so
+    freed blocks are reused), three requests sharing a 4-token (2 full
+    block) prefix, chunk_len 4 (the 6-token prompts prefill in 2
+    chunks) — and every output token-for-token identical to per-request
+    static greedy generation across block reuse, prefix hits and
+    chunked prefill.  Oracle max_new values reuse the module's
+    generate() executable cache (tier-1 compile budget)."""
+    cfg, net = netm
+    rng = np.random.default_rng(6)
+    shared = rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
+    eng = ServingEngine(net, num_slots=2, prompt_len=P, max_cache_len=C,
+                        steps_per_call=3, block_len=2, chunk_len=4,
+                        num_blocks=12, compute_dtype="float32")
+    specs = [(6, 7, True), (5, 2, False), (6, 7, True), (4, 2, False),
+             (5, 7, True)]
+    reqs = []
+    for seq_len, max_new, share in specs:
+        ids = rng.integers(0, cfg.vocab_size, (seq_len,)).astype(np.int32)
+        if share:
+            ids[:4] = shared
+        reqs.append((ids, seq_len, max_new,
+                     eng.submit(ids, max_new_tokens=max_new)))
+    done = eng.run(max_iters=500)
+    assert len(done) == len(specs)
+    for ids, seq_len, max_new, req in reqs:
+        want = _oracle(net, _pad(ids), seq_len, max_new)
+        np.testing.assert_array_equal(req.output, want)
+    s = eng.stats()
+    # requests 2 and 4 admit after request 0's prefill published the
+    # shared blocks: 2 block hits each (the submit-time probe missed —
+    # nothing was published yet — so the admission-time re-probe did it)
+    assert s["prefix_hits"] == 4
+    assert 0.0 < s["prefix_hit_rate"] < 1.0
+    # 2 chunks per 6/5-token miss, 1 chunk per 4-token miss, 1 chunk
+    # for each sharer's unmatched tail: the hits really skipped compute
+    assert s["prefill_chunks"] == 7
+    assert s["prefills"] == len(specs)
+    assert s["blocks_in_use"] == 0                 # pool fully drained
+    assert 0 < s["peak_blocks_in_use"] <= 12
+    # post-run: a queued sharer pins cached prefix blocks; cancel()
+    # releases the pins (the cancel-of-prefix-pinned contract)
+    in_use0 = eng.stats()["blocks_in_use"]
+    ids2 = np.concatenate([shared,
+                           rng.integers(0, cfg.vocab_size, (2,))
+                           .astype(np.int32)])
+    late = eng.submit(ids2, max_new_tokens=7)
+    assert len(late.matched) == 2                  # submit-time hit
+    assert eng.stats()["blocks_in_use"] == in_use0 + 2
+    assert eng.cancel(late.request_id) is True
+    assert eng.stats()["blocks_in_use"] == in_use0
+    assert eng.stats()["cancelled"] == 1
 
 
 # ---------------------------------------------------------------------------
@@ -235,10 +366,117 @@ def test_static_batching_mode_gang_schedules(netm):
 
 
 @pytest.mark.slow
+def test_paged_fragmentation_stress(netm):
+    """Fragmentation + cancel-mid-run over a tight pool: 8 mixed
+    requests (some sharing a prefix) through 3 slots and only 14
+    blocks, one queued request cancelled between scheduler iterations.
+    Every surviving output must still match the oracle and the pool
+    must drain to zero pinned blocks with clean refcounts."""
+    cfg, net = netm
+    rng = np.random.default_rng(8)
+    shared = rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
+    eng = ServingEngine(net, num_slots=3, prompt_len=P, max_cache_len=C,
+                        steps_per_call=3, block_len=2, chunk_len=4,
+                        num_blocks=14, compute_dtype="float32")
+    specs = [(6, 7, True), (4, 2, False), (5, 7, True), (6, 2, False),
+             (3, 7, False), (6, 7, True), (5, 2, True), (4, 7, False)]
+    reqs = []
+    for seq_len, max_new, share in specs:
+        ids = rng.integers(0, cfg.vocab_size, (seq_len,)).astype(np.int32)
+        if share:
+            ids[:4] = shared
+        reqs.append((ids, seq_len, max_new,
+                     eng.submit(ids, max_new_tokens=max_new)))
+    victim = reqs[5][3]                      # deep enough to stay queued
+    for _ in range(2):
+        eng.step()
+    assert eng.cancel(victim.request_id) is True
+    done = eng.run(max_iters=2000)
+    finished_ids = {r.request_id for r in eng._finished}
+    assert victim.request_id not in finished_ids
+    for ids, seq_len, max_new, req in reqs:
+        if req is victim:
+            continue
+        np.testing.assert_array_equal(
+            req.output, _oracle(net, _pad(ids), seq_len, max_new))
+    s = eng.stats()
+    assert s["finished"] == len(specs) - 1 and s["cancelled"] == 1
+    assert s["blocks_in_use"] == 0
+    assert all(r == 0 for r in eng._pool._ref)
+
+
+@pytest.mark.slow
+def test_prefix_reclaim_and_admission_valve(netm):
+    """Refcount-exhaustion corners on a 4-block pool: (a) a retired
+    request's published blocks stay mapped (LRU) and serve a later
+    submit-time pin; (b) a queue head that cannot allocate while a
+    LATER request's submit-time pin holds a block and NOTHING is
+    active triggers the release valve — without it the scheduler would
+    spin forever and run() would blow max_iters; (c) the head's
+    allocation then reclaims the whole LRU, so the shared prefix
+    re-misses at the sharer's admission — and outputs still match the
+    oracle throughout."""
+    cfg, net = netm
+    rng = np.random.default_rng(9)
+    shared = rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
+    eng = ServingEngine(net, num_slots=2, prompt_len=P, max_cache_len=8,
+                        steps_per_call=2, block_len=2, chunk_len=4,
+                        num_blocks=4, compute_dtype="float32")
+    req_a = eng.submit(shared, max_new_tokens=1)     # 2 blocks, publishes 2
+    eng.run(max_iters=100)
+    assert eng.stats()["prefix_cached_blocks"] == 2  # parked, mapped
+    # head X needs all 4 blocks; Y (submitted after) pins a cached one
+    req_x = eng.submit(
+        rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32),
+        max_new_tokens=3)                            # 4 blocks, no match
+    req_y = eng.submit(shared, max_new_tokens=1)
+    assert len(req_y.matched) == 1                   # (a) submit-time hit
+    done = eng.run(max_iters=300)                    # (b) valve or hang
+    assert {r.request_id for r in done} == {req_x.request_id,
+                                            req_y.request_id}
+    s = eng.stats()
+    # (c) the valve released Y's pin and X's alloc unmapped the LRU:
+    # nobody scored an admission-time hit in this engine's lifetime
+    assert s["prefix_hits"] == 0 and s["prefix_misses"] == 4
+    assert s["blocks_in_use"] == 0
+    for req, n, m in ((req_a, 4, 1), (req_x, 6, 3), (req_y, 4, 1)):
+        np.testing.assert_array_equal(
+            req.output, _oracle(net, _pad(req.prompt[:n]), n, m))
+
+
+@pytest.mark.slow
+def test_gpt_paged_serving_parity():
+    """The GPT chunk/paged path (learned positions, MHA): engine output
+    equals per-request greedy generate() with chunked prefill and
+    multi-block prompts."""
+    paddle.seed(11)
+    cfg = models.tiny_gpt_config()
+    net = models.GPTForCausalLM(cfg)
+    net.eval()
+    rng = np.random.default_rng(12)
+    eng = ServingEngine(net, num_slots=2, prompt_len=P, max_cache_len=C,
+                        steps_per_call=2, block_len=4, chunk_len=4,
+                        compute_dtype="float32")
+    reqs = []
+    for seq_len, max_new in ((6, 5), (4, 3), (5, 5)):
+        ids = rng.integers(0, cfg.vocab_size, (seq_len,)).astype(np.int32)
+        reqs.append((ids, seq_len, max_new,
+                     eng.submit(ids, max_new_tokens=max_new)))
+    assert len(eng.run(max_iters=500)) == 3
+    for ids, seq_len, max_new, req in reqs:
+        want = np.asarray(net.generate(
+            paddle.to_tensor(_pad(ids)[None, :]),
+            seq_lens=np.array([seq_len]), max_new_tokens=max_new,
+            max_cache_len=C, compute_dtype="float32")._value)[0]
+        np.testing.assert_array_equal(req.output, want)
+
+
+@pytest.mark.slow
 def test_bench_llm_serving_section():
     """The bench.py llm_serving section end to end on CPU (slow: full
     trace through both arms): emits tokens/s, p50/p99 latency and
-    occupancy for continuous AND static arms."""
+    occupancy for continuous AND static arms, plus the shared-prefix
+    A/B (prefix cache on/off)."""
     import importlib.util
     import os
     spec = importlib.util.spec_from_file_location(
@@ -250,8 +488,17 @@ def test_bench_llm_serving_section():
     for k in ("tokens_per_s", "static_tokens_per_s", "p50_latency_ms",
               "p99_latency_ms", "static_p50_latency_ms",
               "static_p99_latency_ms", "mean_slot_occupancy",
-              "vs_static"):
+              "vs_static", "prefix"):
         assert k in out, k
     assert out["tokens_per_s"] > 0
     assert 0.0 < out["mean_slot_occupancy"] <= 1.0
     assert out["mean_slot_occupancy"] >= out["static_slot_occupancy"]
+    pfx = out["prefix"]
+    for k in ("tokens_per_s", "no_cache_tokens_per_s", "vs_no_cache",
+              "mean_ttft_ms", "no_cache_mean_ttft_ms",
+              "prefix_hit_rate", "peak_blocks_in_use", "prefill_chunks",
+              "no_cache_prefill_chunks"):
+        assert k in pfx, k
+    assert 0.0 < pfx["prefix_hit_rate"] <= 1.0
+    # hits skip chunks; the cached arm must compute strictly fewer
+    assert pfx["prefill_chunks"] < pfx["no_cache_prefill_chunks"]

@@ -11,8 +11,13 @@ every static registry registration — ``<receiver>.counter("name", ...)``
 2. the same name is registered with CONFLICTING instrument types in
    different call sites (the registry raises at runtime only when both
    sites actually execute in one process — the lint catches the
-   conflict statically).
+   conflict statically), or
+3. the same name is registered with CONFLICTING label-name tuples —
+   the registry's other re-registration error; a site with a
+   non-literal ``labels=`` argument is skipped for this rule.
 
+Registrations are parsed from the AST (not a regex), so multi-line
+calls and keyword/positional ``labels`` both resolve.
 ``HostTracer.counter(...)`` calls (the chrome-trace counter lane, a
 different API with free-form names) are excluded by receiver name.
 
@@ -22,27 +27,59 @@ tier-1 test in ``tests/test_observability.py``.  Exit code 0 = clean.
 
 from __future__ import annotations
 
+import ast
 import os
 import re
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# receiver.method(<quoted literal name> — receiver captured so tracer
-# counter lanes (HostTracer.counter) can be skipped; a no-arg call
-# chain like get_registry().counter(<name>) also counts
-_REG_CALL = re.compile(
-    r"(?P<recv>[A-Za-z_][A-Za-z0-9_]*)\s*(?:\(\s*\))?\s*\.\s*"
-    r"(?P<kind>counter|gauge|histogram)\s*\(\s*"
-    r"(?P<quote>['\"])(?P<name>[^'\"]*)(?P=quote)")
-
+_KINDS = {"counter", "gauge", "histogram"}
 _SKIP_RECEIVERS = {"HostTracer"}
 
 NAME_RE = re.compile(r"^[a-z][a-z0-9_.]*$")
 
 
+def _receiver_name(func: ast.Attribute) -> str:
+    """Leftmost identifier of the attribute's value: ``r.counter`` ->
+    ``r``; ``get_registry().counter`` -> ``get_registry``;
+    ``HostTracer.counter`` -> ``HostTracer``."""
+    v = func.value
+    while isinstance(v, ast.Call):
+        v = v.func
+    if isinstance(v, ast.Attribute):
+        return v.attr
+    if isinstance(v, ast.Name):
+        return v.id
+    return ""
+
+
+def _literal_labels(call: ast.Call):
+    """The ``labels=`` argument as a tuple of strings: ``()`` when the
+    argument is absent (the registry's unlabeled default — an unlabeled
+    site genuinely conflicts with a labeled one), a tuple of names when
+    it is a literal tuple/list of string constants, and None only when
+    it is present but DYNAMIC (dynamic labels opt out of the conflict
+    rule — the lint cannot know their value)."""
+    node = None
+    for kw in call.keywords:
+        if kw.arg == "labels":
+            node = kw.value
+    if node is None and len(call.args) >= 3:   # counter(name, help, labels)
+        node = call.args[2]
+    if node is None:
+        return ()
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in node.elts):
+        return tuple(e.value for e in node.elts)
+    return None
+
+
 def iter_registrations(root: str = REPO_ROOT):
-    """Yield (path, lineno, kind, name) for every static registration."""
+    """Yield (path, lineno, kind, name, labels) for every static
+    registration with a literal name; ``labels`` is a tuple of label
+    names or None when unlabeled/dynamic."""
     scan_dirs = [os.path.join(root, "paddle_tpu"),
                  os.path.join(root, "tools")]
     scan_files = [os.path.join(root, "bench.py")]
@@ -59,20 +96,31 @@ def iter_registrations(root: str = REPO_ROOT):
             continue
         with open(path, encoding="utf-8") as f:
             src = f.read()
-        for m in _REG_CALL.finditer(src):
-            if m.group("recv") in _SKIP_RECEIVERS:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _KINDS):
                 continue
-            lineno = src.count("\n", 0, m.start()) + 1
-            yield (os.path.relpath(path, root), lineno,
-                   m.group("kind"), m.group("name"))
+            if _receiver_name(node.func) in _SKIP_RECEIVERS:
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            yield (os.path.relpath(path, root), node.lineno,
+                   node.func.attr, node.args[0].value,
+                   _literal_labels(node))
 
 
 def check(root: str = REPO_ROOT):
     """Returns (errors, registrations) — errors is a list of strings."""
     errors = []
-    seen = {}  # name -> (kind, first site)
+    seen = {}  # name -> (kind, first site, labels)
     regs = list(iter_registrations(root))
-    for path, lineno, kind, name in regs:
+    for path, lineno, kind, name, labels in regs:
         site = f"{path}:{lineno}"
         if not NAME_RE.match(name):
             errors.append(
@@ -81,11 +129,18 @@ def check(root: str = REPO_ROOT):
             continue
         prev = seen.get(name)
         if prev is None:
-            seen[name] = (kind, site)
-        elif prev[0] != kind:
+            seen[name] = (kind, site, labels)
+            continue
+        if prev[0] != kind:
             errors.append(
                 f"{site}: {name!r} registered as {kind} but "
                 f"{prev[1]} registers it as {prev[0]}")
+        elif (labels is not None and prev[2] is not None
+                and labels != prev[2]):
+            errors.append(
+                f"{site}: {name!r} registered with labels "
+                f"{list(labels)} but {prev[1]} registers it with "
+                f"{list(prev[2])}")
     return errors, regs
 
 
