@@ -60,6 +60,15 @@ that position, and row-independence of the decode body is unchanged.
 scheduling (admit only into an empty pool) — the A/B baseline of
 ``bench.py``'s ``llm_serving`` section; ``enable_prefix_cache=False``
 is the A/B arm for the shared-prefix trace.
+
+**Speculative decoding** is a per-request mode on top
+(``submit(spec_decode=K)``, greedy engines only): each scheduler
+iteration runs at most one batched K+1-position verify forward over
+the spec-mode slots (drafter proposals + the paged verify machinery of
+``inference/speculative.py``) alongside the prefill chunk and the
+plain decode block, emitting the accepted draft prefix plus one
+correction token per slot — token-for-token the sequential greedy
+stream, at a fraction of the target forwards when drafts verify.
 """
 
 from __future__ import annotations
@@ -81,6 +90,7 @@ from ..observability import metrics as obs_metrics
 from ..observability.spans import instant as _span_instant
 from ..observability.spans import span as _span
 from .llm import _build_paged_decode_block, build_chunk_prefill
+from .speculative import NGramDrafter, accept_drafts, build_spec_verify
 
 
 class _ServingInstruments:
@@ -115,7 +125,9 @@ class _ServingInstruments:
             "x dispatches)")
         self.busy_slot_steps = r.counter(
             "serving.busy_slot_steps",
-            "decode step x slot cells holding a live request")
+            "decode step x slot cells holding a live PLAIN-decode "
+            "request (spec-mode slots progress via verify forwards, "
+            "not decode steps, and are excluded — see serving.spec.*)")
         self.block_dispatches = r.counter(
             "serving.block_dispatches", "compiled decode block calls")
         self.tokens_emitted = r.counter(
@@ -159,11 +171,40 @@ class _ServingInstruments:
         self.chunk_latency = r.histogram(
             "serving.prefill_chunk_seconds",
             "wall time of one chunked-prefill dispatch")
+        self.spec_verifies = r.counter(
+            "serving.spec.verify_steps", "speculative verify forwards "
+            "dispatched (one K+1-position target forward per scheduler "
+            "iteration with >= 1 spec-mode slot) — against "
+            "serving.block_dispatches this is the plain-vs-speculative "
+            "decode route split")
+        self.spec_draft_hits = r.counter(
+            "serving.spec.draft_hits",
+            "drafter proposals that produced >= 1 candidate token")
+        self.spec_draft_misses = r.counter(
+            "serving.spec.draft_misses", "drafter proposals that came "
+            "back empty (the verify degrades to a plain 1-token step "
+            "for that slot)")
+        self.spec_draft_tokens = r.counter(
+            "serving.spec.draft_tokens",
+            "candidate tokens proposed by the drafter")
+        self.spec_accepted_tokens = r.counter(
+            "serving.spec.accepted_tokens", "draft tokens accepted by "
+            "the verifier (each saved one target forward)")
+        self.spec_accepted_len = r.histogram(
+            "serving.spec.accepted_length",
+            "accepted draft-prefix length per spec slot per verify "
+            "forward (tokens; the +1 correction/bonus emit is not "
+            "counted)",
+            buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0,
+                     24.0, 32.0))
         self._base = {}
         for c in (self.prefills, self.prefill_chunks, self.decode_steps,
                   self.busy_slot_steps, self.block_dispatches,
                   self.requests_finished, self.requests_cancelled,
-                  self.prefix_hits, self.prefix_misses):
+                  self.prefix_hits, self.prefix_misses,
+                  self.spec_verifies, self.spec_draft_hits,
+                  self.spec_draft_misses, self.spec_draft_tokens,
+                  self.spec_accepted_tokens):
             self._base[c.name] = c.value()
 
     def since_init(self, counter) -> float:
@@ -317,6 +358,7 @@ class Request:
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
     state: str = "queued"
+    spec_k: Optional[int] = None       # speculative mode: drafts/verify
     pf_pos: int = 0                    # next prompt position to compute
     matched: List[int] = field(default_factory=list)   # prefix-hit blocks
     blocks: List[int] = field(default_factory=list)    # full block map
@@ -358,7 +400,7 @@ class ServingEngine:
     def __init__(self, model, *, num_slots, prompt_len,
                  max_cache_len=None, steps_per_call=1,
                  block_len=16, num_blocks=None, chunk_len=None,
-                 enable_prefix_cache=True,
+                 enable_prefix_cache=True, drafter=None,
                  eos_token_id=None, pad_token_id=0,
                  do_sample=False, temperature=1.0, top_k=0,
                  compute_dtype="bfloat16", cache_dtype=None,
@@ -428,6 +470,15 @@ class ServingEngine:
             build_chunk_prefill(model, self.cfg), donate_argnums=donate)
         self._donate = donate
         self._blocks = {}              # static block size -> jitted fn
+        # speculative decoding: per-request mode (submit(spec_decode=K));
+        # the drafter is engine-level (host-side, shared by every spec
+        # request) and defaults to prompt-lookup self-drafting the
+        # first time a spec request arrives
+        self._drafter = drafter
+        self._verify_fns = {}          # static verify width -> jitted fn
+        self._spec_k_max = 0           # engine-lifetime max spec_decode
+        self._spec_fallback = set()    # per-iteration: spec slots that
+        #                                ride the plain block instead
 
         # device-carried occupancy state, mirrored host-side ([B] ints
         # are cheap to push; the arenas never leave the device)
@@ -481,14 +532,19 @@ class ServingEngine:
 
     # -- request intake --
     def submit(self, prompt_ids, seq_len=None, max_new_tokens=32,
-               arrival_time=None) -> Request:
+               arrival_time=None, spec_decode=None) -> Request:
         """Enqueue one request.  ``prompt_ids`` is a 1-D id array of at
         most ``prompt_len`` tokens (right-padded internally);
         ``arrival_time`` (in ``clock()`` units) lets a trace replay
         future arrivals — the scheduler will not admit a request before
-        it has "arrived".  With prefix caching on, the prompt's full
-        blocks are probed against the cache here and any hits are
-        PINNED so they cannot be reclaimed while the request waits."""
+        it has "arrived".  ``spec_decode=K`` puts THIS request in
+        speculative-decoding mode: its decode phase runs drafter
+        proposals of up to K tokens through the K+1-position verify
+        forward instead of riding the plain decode block (greedy
+        engines only; output is unchanged, token-for-token).  With
+        prefix caching on, the prompt's full blocks are probed against
+        the cache here and any hits are PINNED so they cannot be
+        reclaimed while the request waits."""
         ids = np.asarray(getattr(prompt_ids, "_value", prompt_ids))
         ids = np.asarray(ids).reshape(-1).astype(np.int32)
         if ids.size < 1 or ids.size > self.prompt_len:
@@ -502,6 +558,18 @@ class ServingEngine:
         m = int(max_new_tokens)
         if m < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {m}")
+        spec_k = None
+        if spec_decode is not None:
+            spec_k = int(spec_decode)
+            if spec_k < 1:
+                raise ValueError(
+                    f"spec_decode must be >= 1 draft tokens, got "
+                    f"{spec_decode}")
+            if self.cfg.do_sample:
+                raise ValueError(
+                    "spec_decode requires a greedy engine "
+                    "(do_sample=False): acceptance compares drafts "
+                    "against the target argmax")
         if n + m - 1 > self.max_cache_len:
             raise ValueError(
                 f"prompt ({n}) + max_new_tokens ({m}) - 1 = {n + m - 1} "
@@ -523,32 +591,59 @@ class ServingEngine:
                       now if arrival_time is None else float(arrival_time),
                       pad_token_id=self.cfg.pad_token_id)
         req.submit_time = now
+        req.spec_k = spec_k
+        if spec_k is not None:
+            # only AFTER every validation above: a rejected submit must
+            # not widen the engine-lifetime verify width (or install
+            # the default drafter) for requests that never ran
+            if self._drafter is None:
+                self._drafter = NGramDrafter()
+            self._spec_k_max = max(self._spec_k_max, spec_k)
         # chunk grid: any slice [start, start + chunk_len) with
         # start < seq_len must be in range
         req.chunk_ids = np.full((self.prompt_len + self.chunk_len,),
                                 self.cfg.pad_token_id, np.int32)
         req.chunk_ids[:self.prompt_len] = padded
-        if self.enable_prefix_cache:
-            req.digests = _block_digests(padded, n, self.block_len)
-            # match at most (n-1)//block_len blocks: the block holding
-            # the prompt's LAST token is always recomputed — sampling
-            # the first output token needs its hidden state, which the
-            # cache does not carry
-            for dg in req.digests[:(n - 1) // self.block_len]:
-                b = self._pool.lookup(dg)
-                if b is None:
-                    break
-                self._pool.pin(b)
-                req.matched.append(b)
-            if req.matched:
-                self._update_block_gauges()
-        self._next_id += 1
-        self._queue.append(req)
-        self._peak_queue = max(self._peak_queue, len(self._queue))
-        self._m.requests_submitted.inc()
-        self._m.queue_depth.set(len(self._queue))
-        _span_instant("serving.request.queued", request=req.request_id,
-                      seq_len=n, max_new=m)
+        # everything past this point runs with prefix-probe pins
+        # potentially held: any failure (a raising instrument/span hook,
+        # a future validation added below the probe) must UNPIN the
+        # probed blocks and drop the request, or each failed submit
+        # would leak refcounts until the pool wedges
+        try:
+            if self.enable_prefix_cache:
+                req.digests = _block_digests(padded, n, self.block_len)
+                # match at most (n-1)//block_len blocks: the block
+                # holding the prompt's LAST token is always recomputed —
+                # sampling the first output token needs its hidden
+                # state, which the cache does not carry
+                for dg in req.digests[:(n - 1) // self.block_len]:
+                    b = self._pool.lookup(dg)
+                    if b is None:
+                        break
+                    self._pool.pin(b)
+                    req.matched.append(b)
+                if req.matched:
+                    self._update_block_gauges()
+            self._next_id += 1
+            self._queue.append(req)
+            self._peak_queue = max(self._peak_queue, len(self._queue))
+            _span_instant("serving.request.queued",
+                          request=req.request_id, seq_len=n, max_new=m)
+            # counters LAST: a failure above (e.g. a raising span hook)
+            # rolls the queue and pins back, but a Counter cannot be
+            # decremented — incrementing only once nothing can raise
+            # keeps submitted == finished + queued + active consistent
+            self._m.requests_submitted.inc()
+            self._m.queue_depth.set(len(self._queue))
+        except BaseException:
+            if self._queue and self._queue[-1] is req:
+                self._queue.pop()
+            for b in req.matched:
+                self._pool.unpin(b)
+            req.matched = []
+            self._update_block_gauges()
+            self._m.queue_depth.set(len(self._queue))
+            raise
         return req
 
     def cancel(self, request_id: int) -> bool:
@@ -713,7 +808,11 @@ class ServingEngine:
         req.state = "decode"
         self._tok[slot] = tok0
         self._lens[slot] = req.seq_len
-        self._done[slot] = False
+        # spec-mode rows never ride the plain decode block: their row
+        # stays done=True there (frozen lens, trash-routed writes, pad
+        # emits) and all progress happens in the verify dispatch, which
+        # reads its own host-side truth (req.tokens / self._lens)
+        self._done[slot] = req.spec_k is not None
 
     def _block_fn(self, steps: int):
         fn = self._blocks.get(steps)
@@ -724,27 +823,161 @@ class ServingEngine:
             self._blocks[steps] = fn
         return fn
 
+    def _block_rides(self, i: int, r: Request) -> bool:
+        """Does slot ``i`` ride THIS iteration's plain decode block?
+        Plain-decode rows always do; a spec-mode row only on an
+        iteration where the whole spec mix drafted nothing
+        (``_spec_fallback``) — a zero-draft verify would pay the
+        K+1-wide forward for one token, so those iterations ride the
+        shared block instead (which may scan up to ``steps_per_call``
+        tokens: drafting opportunities inside that span are forgone,
+        a deliberate trade — the drafter just missed, so the stream is
+        locally unpredictable anyway; tokens stay exactly the
+        sequential greedy stream either way)."""
+        return r.state == "decode" and (r.spec_k is None
+                                        or i in self._spec_fallback)
+
     def _decode_tables(self) -> np.ndarray:
-        """The decode block's table view: real rows for decoding slots,
-        all-trash rows for vacant/prefilling slots — a frozen row's
-        statically-shaped write at its pinned ``lens`` must never land
-        in a block another sequence now owns."""
+        """The decode block's table view: real rows for slots riding
+        this block, all-trash rows for vacant/prefilling/spec-verify
+        slots — a frozen row's statically-shaped write at its pinned
+        ``lens`` must never land in a block another sequence now owns
+        (a verifying spec row's blocks are live: the verify dispatch
+        owns them)."""
         tbl = np.full_like(self._tables, self._pool.trash)
         for i, r in enumerate(self._slots):
-            if r is not None and r.state == "decode":
+            if r is not None and self._block_rides(i, r):
                 tbl[i] = self._tables[i]
         return tbl
 
+    def _verify_fn(self, steps: int):
+        fn = self._verify_fns.get(steps)
+        if fn is None:
+            fn = jax.jit(
+                build_spec_verify(self._model, self.cfg, steps),
+                donate_argnums=tuple(
+                    5 + i for i in range(len(self._arenas))))
+            self._verify_fns[steps] = fn
+        return fn
+
+    def _spec_verify(self, out: List[Request]):
+        """One speculative iteration over every spec-mode decode slot:
+        draft (host), verify (ONE batched K+1-position target forward),
+        accept (host), advance/rewind per-slot lengths.
+
+        The verify width is the ENGINE-LIFETIME ``max(spec_decode) + 1``
+        (not the current mix's max, which would oscillate and
+        jit-compile a fresh program every time the widest request
+        retires): at most one compile per new high-water K, with
+        narrower rows (smaller spec_k, fewer drafts proposed, tail of
+        the token budget) masked by ``n_valid`` rather than
+        recompiled.  Rollback is the length
+        bookkeeping itself: ``self._lens[slot]`` advances by exactly
+        the emitted count, so rejected draft positions stay behind the
+        mask (re-masking the tail of the last block) until the next
+        forward overwrites them."""
+        spec = [i for i, r in enumerate(self._slots)
+                if r is not None and r.state == "decode"
+                and r.spec_k is not None]
+        if not spec:
+            return
+        drafts = {}
+        for i in spec:
+            req = self._slots[i]
+            # budget clamp: a verify emits <= k_eff + 1 tokens and its
+            # last WRITE lands at lens + k_eff <= seq_len + max_new - 2
+            # — never past the request's allocated blocks
+            k_eff = min(req.spec_k, req.remaining - 1)
+            d = self._drafter.propose(
+                np.concatenate([req.prompt[:req.seq_len],
+                                np.asarray(req.tokens, np.int32)]),
+                k_eff) if k_eff > 0 else np.zeros((0,), np.int32)
+            d = np.asarray(d).reshape(-1).astype(np.int32)[:k_eff]
+            if k_eff > 0:
+                # hit/miss score the DRAFTER; budget-clamped tails
+                # (k_eff == 0) never consulted it and count as neither
+                if d.size:
+                    self._m.spec_draft_hits.inc()
+                else:
+                    self._m.spec_draft_misses.inc()
+                self._m.spec_draft_tokens.inc(int(d.size))
+            drafts[i] = d
+        if not any(drafts[i].size for i in spec):
+            # nothing drafted anywhere: a verify would pay the K+1-wide
+            # forward to emit one token per slot — ride the plain block
+            # this iteration instead (same greedy tokens; the block may
+            # scan steps_per_call of them, see _block_rides).  With
+            # >= 1 drafted row the verify's cost is fixed at B x width
+            # anyway, so empty rows then ride it for free.
+            self._spec_fallback = set(spec)
+            return
+        width = self._spec_k_max + 1
+        toks = np.full((self.num_slots, width), self.cfg.pad_token_id,
+                       np.int32)
+        n_valid = np.zeros((self.num_slots,), np.int32)
+        tbl = np.full_like(self._tables, self._pool.trash)
+        for i in spec:
+            req = self._slots[i]
+            d = drafts[i]
+            toks[i, 0] = req.tokens[-1]   # the still-un-fed last token
+            toks[i, 1:1 + d.size] = d
+            n_valid[i] = 1 + d.size
+            tbl[i] = self._tables[i]
+        with _span("serving.spec_verify", width=width, active=len(spec)):
+            outp = _call_quiet(
+                self._verify_fn(width), self._pb, jnp.asarray(toks),
+                jnp.asarray(self._lens), jnp.asarray(n_valid),
+                jnp.asarray(tbl), *self._arenas)
+            greedy = np.asarray(outp[0])                # [B, width]
+        self._arenas = list(outp[1:])
+        self._m.spec_verifies.inc()
+        t = self._clock()
+        for i in spec:
+            req = self._slots[i]
+            emitted, accepted = accept_drafts(
+                greedy[i], drafts[i], self.cfg.eos_token_id)
+            self._m.spec_accepted_len.observe(float(accepted))
+            self._m.spec_accepted_tokens.inc(accepted)
+            self._m.tokens_emitted.inc(len(emitted))
+            req.tokens.extend(emitted)
+            req.remaining -= len(emitted)
+            self._lens[i] += len(emitted)
+            self._tok[i] = emitted[-1]
+            _span_instant("serving.spec.accept", request=req.request_id,
+                          drafted=int(drafts[i].size), accepted=accepted)
+            hit_eos = (self.cfg.eos_token_id is not None
+                       and emitted[-1] == self.cfg.eos_token_id)
+            if hit_eos or req.remaining == 0:
+                self._slots[i] = None
+                self._done[i] = True
+                self._release_blocks(req)
+                self._finish(req, t, out)
+
     def step(self, now: Optional[float] = None) -> List[Request]:
         """One scheduler iteration: admit arrivals into vacant slots,
-        run at most one prefill chunk, then one decode block over the
-        current occupancy mix.  Returns the requests that finished this
+        run at most one prefill chunk, then one speculative verify
+        forward over the spec-mode slots and one decode block over the
+        plain-decode mix — all three phases coexist in the same
+        iteration.  Returns the requests that finished this
         iteration."""
         finished: List[Request] = []
         self._admit(self._clock() if now is None else now)
         self._prefill_chunk(finished)
+        self._spec_fallback = set()
+        self._spec_verify(finished)
+        # re-assert spec rows' block state for THIS iteration: fallback
+        # rows thaw into the shared block, verifying rows stay frozen
+        # — and a thawing row's fed token comes from HOST truth
+        # (req.tokens[-1]), because a frozen row's device carry emits
+        # pad into tok (the previous block's done-row convention)
+        for i, r in enumerate(self._slots):
+            if r is not None and r.state == "decode" \
+                    and r.spec_k is not None:
+                self._done[i] = i not in self._spec_fallback
+                if i in self._spec_fallback:
+                    self._tok[i] = r.tokens[-1]
         active = [i for i, r in enumerate(self._slots)
-                  if r is not None and r.state == "decode"]
+                  if r is not None and self._block_rides(i, r)]
         if not active:
             self._m.slot_occupancy.set(
                 sum(r is not None for r in self._slots))
@@ -815,17 +1048,35 @@ class ServingEngine:
         registry as per-engine deltas (``_ServingInstruments`` — see
         its docstring for the shared-registry and disabled-registry
         caveats).  ``mean_slot_occupancy`` is the fraction of (decode
-        step x slot) cells that held a live request — the utilization
-        static batching forfeits on mixed-length traces.
+        step x slot) cells that held a live PLAIN-decode request — the
+        utilization static batching forfeits on mixed-length traces;
+        spec-mode slots progress via verify forwards, not decode
+        steps, and are excluded from both numerator and step count.
         ``prefix_hit_rate`` is block-granular over matchable prompt
         blocks; ``peak_blocks_in_use`` is the pool's refcount>0
-        high-water mark (host-mirrored, registry-independent)."""
+        high-water mark (host-mirrored, registry-independent).
+        ``mean_latency_s``/``mean_ttft_s`` are means over THIS engine's
+        finished requests and are ``None`` — never a division by zero —
+        while that set is empty.  The ``spec_*`` keys cover the
+        speculative route: ``spec_mean_accepted_len`` is accepted draft
+        tokens per verify forward, AGGREGATED over the spec slots that
+        forward covered — a verify emits accepted + (one correction/
+        bonus per spec slot) tokens, so the per-forward multiplier is
+        n_spec_slots + this value (1 + it only at a single spec slot);
+        ``spec_acceptance_rate`` is token-granular over drafted
+        tokens."""
         decode_steps = self._m.since_init(self._m.decode_steps)
         busy = self._m.since_init(self._m.busy_slot_steps)
         occ = (busy / (decode_steps * self.num_slots)
                if decode_steps else 0.0)
         hits = self._m.since_init(self._m.prefix_hits)
         misses = self._m.since_init(self._m.prefix_misses)
+        lats = [r.latency for r in self._finished
+                if r.latency is not None]
+        ttfts = [r.ttft for r in self._finished if r.ttft is not None]
+        verifies = self._m.since_init(self._m.spec_verifies)
+        drafted = self._m.since_init(self._m.spec_draft_tokens)
+        accepted = self._m.since_init(self._m.spec_accepted_tokens)
         return {
             "num_slots": self.num_slots,
             "decode_steps": int(decode_steps),
@@ -850,6 +1101,19 @@ class ServingEngine:
             "prefix_misses": int(misses),
             "prefix_hit_rate": (hits / (hits + misses)
                                 if hits + misses else 0.0),
+            "mean_latency_s": (sum(lats) / len(lats)) if lats else None,
+            "mean_ttft_s": (sum(ttfts) / len(ttfts)) if ttfts else None,
+            "spec_verify_steps": int(verifies),
+            "spec_draft_hits": int(
+                self._m.since_init(self._m.spec_draft_hits)),
+            "spec_draft_misses": int(
+                self._m.since_init(self._m.spec_draft_misses)),
+            "spec_draft_tokens": int(drafted),
+            "spec_accepted_tokens": int(accepted),
+            "spec_acceptance_rate": (accepted / drafted
+                                     if drafted else 0.0),
+            "spec_mean_accepted_len": (accepted / verifies
+                                       if verifies else 0.0),
         }
 
     @property

@@ -166,6 +166,35 @@ def paged_chunk_scatter(arena, tables, start, n_valid, new_kv):
     return arena.at[blk, off].set(new_kv.astype(arena.dtype))
 
 
+def paged_verify_scatter(arena, tables, lens, n_valid, new_kv):
+    """Write a speculative verify forward's K/V planes ([B, C, H_kv, D])
+    at per-row global positions ``lens[b] .. lens[b]+C-1`` through each
+    row's block table — the batched generalization of
+    ``paged_chunk_scatter``'s multi-position machinery (that one is
+    batch-1 with a shared start; this one is per-row starts over the
+    decode mix).  Columns ``>= n_valid[b]`` (draft-pad tail, rows not
+    in spec mode this step) write to the trash row: the C shape is
+    static, so the scatter always issues B*C writes and masking is done
+    by redirecting the target.  The ``n_valid`` mask is also the
+    rollback guarantee's other half: a draft position can only ever
+    land inside its own row's blocks at a slot ``> lens`` that the row
+    itself overwrites before its ``lens`` advances past it, so a
+    rejected draft's K/V is finite garbage behind the ``lens`` mask,
+    never another sequence's data."""
+    b, c = new_kv.shape[0], new_kv.shape[1]
+    block_len = arena.shape[1]
+    trash = arena.shape[0] - 1
+    pos = lens[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+    idx = jnp.minimum(pos // block_len, tables.shape[1] - 1)
+    blk = jnp.where(jnp.arange(c, dtype=jnp.int32)[None, :]
+                    < n_valid[:, None],
+                    jnp.take_along_axis(tables, idx, axis=1), trash)
+    off = pos % block_len
+    if arena.ndim == 3:
+        new_kv = new_kv.reshape(b, c, -1)
+    return arena.at[blk, off].set(new_kv.astype(arena.dtype))
+
+
 def cache_prefill_write(cache, kv_bshd):
     """Write prompt K/V planes ([B, S, H_kv, D] as produced by the
     prefill attention) into the cache from slot 0."""

@@ -158,6 +158,29 @@ class GPTAttention(nn.Layer):
         out = self.out_proj(Tensor(out.reshape(b, c, -1)))
         return out, (k_arena, v_arena, tables)
 
+    def verify_step(self, x, kv, lens, n_valid):
+        """One speculative-verify step over the paged cache: C = K+1
+        tokens per row at global positions ``lens[b] + c`` (see
+        LlamaAttention.verify_step — positions are applied at the model
+        level here, GPT has no RoPE)."""
+        from .generation import paged_verify_scatter
+        from ..ops.pallas.decode_attention import \
+            decode_attention_paged_multi
+        from ..core.tensor import Tensor
+        b, c, _ = x.shape
+        qkv = self.qkv_proj(x).reshape([b, c, 3, self.num_heads,
+                                        self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k_arena, v_arena, tables = kv
+        k_arena = paged_verify_scatter(k_arena, tables, lens, n_valid,
+                                       k._value)
+        v_arena = paged_verify_scatter(v_arena, tables, lens, n_valid,
+                                       v._value)
+        out = decode_attention_paged_multi(q._value, k_arena, v_arena,
+                                           tables, lens)
+        out = self.out_proj(Tensor(out.reshape(b, c, -1)))
+        return out, (k_arena, v_arena, tables)
+
 
 class GPTMLP(nn.Layer):
     def __init__(self, config: GPTConfig):
@@ -210,6 +233,11 @@ class GPTDecoderLayer(nn.Layer):
 
     def chunk_step(self, x, kv, start, n_valid):
         a, kv = self.attn.chunk_step(self.ln_1(x), kv, start, n_valid)
+        x = x + self.dropout(a)
+        return x + self.mlp(self.ln_2(x)), kv
+
+    def verify_step(self, x, kv, lens, n_valid):
+        a, kv = self.attn.verify_step(self.ln_1(x), kv, lens, n_valid)
         x = x + self.dropout(a)
         return x + self.mlp(self.ln_2(x)), kv
 
@@ -349,6 +377,29 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
         idx = jnp.clip(n_valid - 1 - start, 0, c - 1)
         last = h[0, idx]
         logits = self.lm_head(Tensor(last[None, None, :]))._value[:, 0]
+        return logits, new_kvs
+
+    def verify_step(self, tokens, lens, n_valid, kvs):
+        """One speculative-verify pass (paged kv triples): tokens
+        [B, C] at per-row global positions ``lens[b] + c``; learned
+        positions are clipped at the table edge for the draft-pad tail
+        (those columns' K/V are trash-routed, so the clamp never leaks
+        into a real prefix).  Returns logits at all C positions
+        ([B, C, vocab]) plus the updated kvs."""
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        c = tokens.shape[1]
+        limit = self.config.max_position_embeddings
+        pos = jnp.clip(lens[:, None] + jnp.arange(c, dtype=jnp.int32),
+                       0, limit - 1)
+        x = self.gpt.drop(self.gpt.wte(Tensor(tokens))
+                          + self.gpt.wpe(Tensor(pos)))
+        new_kvs = []
+        for block, kv in zip(self.gpt.h, kvs):
+            x, kv = block.verify_step(x, kv, lens, n_valid)
+            new_kvs.append(kv)
+        x = self.gpt.ln_f(x)
+        logits = self.lm_head(x)._value                    # [B, C, V]
         return logits, new_kvs
 
 

@@ -216,6 +216,32 @@ class LlamaAttention(nn.Layer):
         out = self.o_proj(Tensor(out.reshape(b, c, -1)))
         return out, (k_arena, v_arena, tables)
 
+    def verify_step(self, x, kv, lens, n_valid):
+        """One speculative-verify step over the PAGED cache: x holds
+        C = K+1 tokens PER row ([B, C, hidden]) — the row's last
+        emitted token plus K draft candidates — at per-row global
+        positions ``lens[b] .. lens[b]+C-1``.  K/V scatter through each
+        row's block table with columns ``>= n_valid[b]`` trash-routed
+        (``paged_verify_scatter``), and attention is causal per query
+        offset (``decode_attention_paged_multi``), so position c sees
+        exactly the prefix sequential decode would have given it."""
+        from .generation import paged_verify_scatter
+        from ..ops.pallas.decode_attention import \
+            decode_attention_paged_multi
+        b, c, _ = x.shape
+        pos = lens[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+        q, k, v = self._qkv_rope(x, pos)
+        k_arena, v_arena, tables = kv
+        k_arena = paged_verify_scatter(k_arena, tables, lens, n_valid,
+                                       k._value)
+        v_arena = paged_verify_scatter(v_arena, tables, lens, n_valid,
+                                       v._value)
+        out = decode_attention_paged_multi(q._value, k_arena, v_arena,
+                                           tables, lens)
+        from ..core.tensor import Tensor
+        out = self.o_proj(Tensor(out.reshape(b, c, -1)))
+        return out, (k_arena, v_arena, tables)
+
 
 class LlamaMLP(nn.Layer):
     def __init__(self, config: LlamaConfig):
@@ -288,6 +314,12 @@ class LlamaDecoderLayer(nn.Layer):
     def chunk_step(self, x, kv, start, n_valid):
         attn_out, kv = self.self_attn.chunk_step(self.input_layernorm(x),
                                                  kv, start, n_valid)
+        h = x + attn_out
+        return h + self.mlp(self.post_attention_layernorm(h)), kv
+
+    def verify_step(self, x, kv, lens, n_valid):
+        attn_out, kv = self.self_attn.verify_step(
+            self.input_layernorm(x), kv, lens, n_valid)
         h = x + attn_out
         return h + self.mlp(self.post_attention_layernorm(h)), kv
 
@@ -414,6 +446,26 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
         idx = jnp.clip(n_valid - 1 - start, 0, c - 1)
         last = h[0, idx]                                   # [hidden]
         logits = self.lm_head(Tensor(last[None, None, :]))._value[:, 0]
+        return logits, new_kvs
+
+    def verify_step(self, tokens, lens, n_valid, kvs):
+        """One speculative-verify pass over all layers (paged kv
+        triples): tokens [B, C] — each row's last emitted token plus
+        its K draft candidates — at per-row global positions
+        ``lens[b] + c``.  Returns logits at ALL C positions
+        ([B, C, vocab]; C is small, so materializing them is cheap —
+        the verifier needs every position's argmax for the longest-
+        prefix acceptance rule) plus the updated kvs.  Columns
+        ``>= n_valid[b]`` compute trash-routed garbage the engine
+        ignores."""
+        from ..core.tensor import Tensor
+        x = self.llama.embed_tokens(Tensor(tokens))
+        new_kvs = []
+        for layer, kv in zip(self.llama.layers, kvs):
+            x, kv = layer.verify_step(x, kv, lens, n_valid)
+            new_kvs.append(kv)
+        x = self.llama.norm(x)
+        logits = self.lm_head(x)._value                    # [B, C, V]
         return logits, new_kvs
 
 

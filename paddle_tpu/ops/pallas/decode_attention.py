@@ -98,13 +98,16 @@ def decode_attn_sig(b, hkv, g, s, d, dtype):
     return f"{b}x{hkv}x{g}x{s}x{d}/{np.dtype(dtype)}"
 
 
-def _gate_shared(q4, cache, s, align_ok, align_reason):
+def _gate_shared(q4, cache, s, align_ok, align_reason, q_rows=_GPAD):
     """The gate checks common to the dense and paged dispatchers —
     ONE implementation so the two routes cannot silently diverge.
     ``s`` is the staged dense-row count; ``align_ok``/``align_reason``
     inject the path-specific sublane-tiling rule at its position in
-    the check order.  Returns (use_pallas, reason-or-None); the caller
-    maps None to its accept reason."""
+    the check order; ``q_rows`` is the per-head q-row block the caller
+    stages (``_GPAD`` for the single-token kernels, a multiple of it
+    for the K-wide verify kernel) and scales the logits-scratch VMEM
+    estimate.  Returns (use_pallas, reason-or-None); the caller maps
+    None to its accept reason."""
     from ...core.flags import flag
     if not flag("use_decode_attention_kernel"):
         return False, "flag_disabled"
@@ -128,7 +131,7 @@ def _gate_shared(q4, cache, s, align_ok, align_reason):
         return False, align_reason
     itemsize = jnp.dtype(cache.dtype).itemsize
     gw = max(_LANES, d)
-    lg_bytes = (w // gw) * (gw // d) * _GPAD * s * 4
+    lg_bytes = (w // gw) * (gw // d) * q_rows * s * 4
     if 2 * s * w * itemsize + lg_bytes > _VMEM_BUDGET:
         return False, "vmem_budget"
     return True, None
@@ -191,6 +194,38 @@ def _route_decision_paged(q4, arena, tables):
 
 def should_use_pallas_paged(q4, arena, tables) -> bool:
     use, reason = _route_decision_paged(q4, arena, tables)
+    _route_counter().inc(decision="pallas" if use else "xla",
+                         reason=reason)
+    return use
+
+
+_QROWS_MAX = 4 * _GPAD      # per-head q-row cap of the K-wide kernel
+
+
+def _route_decision_paged_multi(q5, arena, tables):
+    """(use_pallas, reason) for the K-WIDE paged verify gate
+    (``decode_attention_paged_multi``): the shared gate evaluated on
+    the arena geometry with the paged sublane rule, plus the verify
+    kernel's own row budget — the block-diagonal q staging packs
+    ``g * C`` query rows per head (C speculative positions x G grouped
+    query heads), rounded up to the sublane unit; wider than
+    ``_QROWS_MAX`` rows would blow the logits scratch for no win
+    (reason ``query_rows``).  Accepts route as ``paged_multi_ok`` so
+    the route counter separates verify traffic from single-token
+    ``paged_ok``."""
+    b, cq, hkv, g, d = q5.shape
+    qr = -(-(g * cq) // _GPAD) * _GPAD
+    if qr > _QROWS_MAX:
+        return False, "query_rows"
+    blk_len = arena.shape[1]
+    s = tables.shape[1] * blk_len      # staged dense rows
+    use, reason = _gate_shared(q5[:, 0], arena, s, blk_len % 8 == 0,
+                               "paged_block_len", q_rows=qr)
+    return use, reason or "paged_multi_ok"
+
+
+def should_use_pallas_paged_multi(q5, arena, tables) -> bool:
+    use, reason = _route_decision_paged_multi(q5, arena, tables)
     _route_counter().inc(decision="pallas" if use else "xla",
                          reason=reason)
     return use
@@ -368,6 +403,93 @@ def _paged_kernel(lens_ref, tbl_ref, qcat_ref, k_hbm, v_hbm, o_ref,
                            ).astype(out_dtype)
 
 
+def _paged_multi_kernel(lens_ref, tbl_ref, qcat_ref, k_hbm, v_hbm, o_ref,
+                        kbuf, vbuf, lg_ref, ksem, vsem,
+                        *, block_len, n_blocks_max, cq, qr, scale,
+                        out_dtype, g, d, gw, hp, ng):
+    """K-wide query variant of ``_paged_kernel`` — the speculative-
+    decoding verifier's attention.  Each program scores ``cq`` query
+    positions of one batch row (the just-written token plus the K
+    draft candidates) against the SAME staged paged prefix: per head,
+    the q block holds ``qr = roundup(g * cq, 8)`` rows ordered
+    ``c * g + gi`` (query position c, grouped query head gi), and the
+    softmax mask is CAUSAL per row — query c sees cache rows
+    ``<= lens[b] + c``, so each draft position attends exactly the
+    prefix the sequential decode loop would have given it (the greedy-
+    equivalence contract of the verifier).  DMA traffic is still one
+    sweep of the valid prefix (now ``lens + cq - 1`` rows) — the whole
+    point: K+1 positions scored for one cache sweep plus one weight
+    sweep.  The scratch-reuse invariant of ``_kernel`` (vbuf zeroed at
+    program 0 only, stale K masked to -1e30 before exp, sequential
+    grid) carries over unchanged."""
+    bi = pl.program_id(0)
+    length = lens_ref[bi]              # first query's global slot
+    n_blk = jnp.minimum((length + cq - 1) // block_len + 1, n_blocks_max)
+    rows = n_blocks_max * block_len
+
+    @pl.when(bi == 0)
+    def _():
+        vbuf[...] = jnp.zeros_like(vbuf)
+
+    for c in range(n_blocks_max):             # static unroll, guarded
+        @pl.when(c < n_blk)
+        def _(c=c):
+            pltpu.make_async_copy(
+                k_hbm.at[tbl_ref[bi, c]],
+                kbuf.at[pl.ds(c * block_len, block_len), :],
+                ksem.at[c]).start()
+            pltpu.make_async_copy(
+                v_hbm.at[tbl_ref[bi, c]],
+                vbuf.at[pl.ds(c * block_len, block_len), :],
+                vsem.at[c]).start()
+
+    for c in range(n_blocks_max):
+        @pl.when(c < n_blk)
+        def _(c=c):
+            pltpu.make_async_copy(
+                k_hbm.at[tbl_ref[bi, c]],
+                kbuf.at[pl.ds(c * block_len, block_len), :],
+                ksem.at[c]).wait()
+
+    for p in range(ng):
+        lg_ref[p] = jax.lax.dot_general(
+            qcat_ref[0, p], kbuf[:, p * gw:(p + 1) * gw],
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [hp*qr, rows]
+
+    # per-row causal mask: q row r = c*g + gi within its head's qr
+    # block is a real query iff r < g*cq, and sees rows <= length + c
+    sub = jax.lax.broadcasted_iota(jnp.int32, (ng, hp * qr, rows), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (ng, hp * qr, rows), 2)
+    qsub = jax.lax.rem(sub, qr)
+    keep = (row <= length + qsub // g) & (qsub < g * cq)
+    lg = jnp.where(keep, lg_ref[...], _NEG_INF)
+    m = jnp.max(lg, axis=-1, keepdims=True)
+    p_ = jnp.exp(lg - m)
+    l = jnp.sum(p_, axis=-1, keepdims=True)    # [ng, hp*qr, 1]
+    lg_ref[...] = p_
+
+    for c in range(n_blocks_max):
+        @pl.when(c < n_blk)
+        def _(c=c):
+            pltpu.make_async_copy(
+                v_hbm.at[tbl_ref[bi, c]],
+                vbuf.at[pl.ds(c * block_len, block_len), :],
+                vsem.at[c]).wait()
+
+    for p in range(ng):
+        pv_w = jax.lax.dot_general(
+            lg_ref[p].astype(vbuf.dtype), vbuf[:, p * gw:(p + 1) * gw],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [hp*qr, gw]
+        for j in range(hp):
+            h = p * hp + j
+            o_ref[0, h] = (pv_w[j * qr:j * qr + cq * g,
+                                j * d:(j + 1) * d]
+                           / l[p, j * qr:j * qr + cq * g]
+                           ).astype(out_dtype)
+
+
 def _build_qcat(q4, hp, ng, gw):
     """Block-diagonal q: [B, H_kv, G, D] -> [B, ng, hp*8, gw] where
     group p, block j holds head p*hp+j's q in lane range [j*D, (j+1)*D)
@@ -472,6 +594,70 @@ def _decode_attention_pallas_paged(q4, k_arena, v_arena, tables, lens):
       k_arena, v_arena)
 
 
+def _build_qcat_multi(q5, hp, ng, gw, qr):
+    """Block-diagonal K-wide q: [B, C, H_kv, G, D] -> [B, ng, hp*qr, gw]
+    where group p, block j holds head p*hp+j's queries (row-ordered
+    ``c*g + gi``, zero-padded to qr rows) in lane range [j*D, (j+1)*D)
+    and zeros elsewhere."""
+    b, cq, hkv, g, d = q5.shape
+    qh = jnp.transpose(q5, (0, 2, 1, 3, 4)).reshape(b, hkv, cq * g, d)
+    qh = jnp.pad(qh, ((0, 0), (0, 0), (0, qr - cq * g), (0, 0)))
+    qg = qh.reshape(b, ng, hp, qr, d)
+    eye = jnp.eye(hp, dtype=q5.dtype)
+    qcat = jnp.einsum("bnjrd,jk->bnjrkd", qg, eye)
+    return qcat.reshape(b, ng, hp * qr, gw)
+
+
+def _decode_attention_pallas_paged_multi(q5, k_arena, v_arena, tables,
+                                         lens):
+    """q5: [B, C, H_kv, G, D]; arenas packed [NB+1, L, H_kv*D] (last
+    row = trash block); tables: [B, max_blocks] int32; lens: [B] global
+    position of the FIRST query.  Returns [B, C, H_kv, G, D]."""
+    b, cq, hkv, g, d = q5.shape
+    blk_len = k_arena.shape[1]
+    w = k_arena.shape[2]
+    n_blocks_max = tables.shape[1]
+    s = n_blocks_max * blk_len
+    gw = max(_LANES, d)
+    hp = gw // d
+    ng = w // gw
+    qr = -(-(g * cq) // _GPAD) * _GPAD
+    kernel = functools.partial(
+        _paged_multi_kernel, block_len=blk_len,
+        n_blocks_max=n_blocks_max, cq=cq, qr=qr,
+        scale=1.0 / (d ** 0.5), out_dtype=q5.dtype, g=g, d=d,
+        gw=gw, hp=hp, ng=ng)
+    qcat = _build_qcat_multi(q5, hp, ng, gw, qr)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, ng, hp * qr, gw),
+                         lambda bi, lens_p, tbl_p: (bi, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, hkv, cq * g, d),
+                               lambda bi, lens_p, tbl_p: (bi, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((s, w), k_arena.dtype),
+            pltpu.VMEM((s, w), v_arena.dtype),
+            pltpu.VMEM((ng, hp * qr, s), jnp.float32),
+            pltpu.SemaphoreType.DMA((n_blocks_max,)),
+            pltpu.SemaphoreType.DMA((n_blocks_max,)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, cq * g, d), q5.dtype),
+        interpret=not on_tpu(),
+    )(lens.astype(jnp.int32), tables.astype(jnp.int32), qcat,
+      k_arena, v_arena)
+    # head-major rows c*g+gi back to [B, C, H_kv, G, D]
+    return jnp.transpose(out.reshape(b, hkv, cq, g, d), (0, 2, 1, 3, 4))
+
+
 def _decode_attention_xla(q4, k_cache, v_cache, lens):
     """Reference math on the logical [B, S, H_kv, D] view (fp32
     softmax): the non-TPU / odd-shape fallback.  Accepts packed
@@ -551,11 +737,52 @@ def paged_prefix_attention(q, k_arena, v_arena, tables, start):
     start: [B] first global position of the chunk.  Always the
     gather-based XLA path with fp32 softmax — prefill is
     compute-bound over the chunk, not cache-sweep-bound, so the paged
-    kernel's DMA indirection buys nothing here.  Returns
+    kernel's DMA indirection buys nothing here (the verifier's
+    cache-sweep-bound twin, ``decode_attention_paged_multi``, is the
+    one that gates into the K-wide Pallas kernel).  Returns
     [B, C, H_q, D] in q.dtype; rows past the prompt's true length
     compute garbage that the caller masks (their K/V writes were
     trash-routed, so the garbage never enters any other row's
     prefix)."""
+    return _paged_multi_xla(q, k_arena, v_arena, tables, start)
+
+
+def decode_attention_paged_multi(q, k_arena, v_arena, tables, lens):
+    """K-wide GQA attention over a PAGED cache prefix — the speculative
+    -decoding verify forward's attention (one target forward scores the
+    just-written token plus K draft candidates).
+
+    q: [B, C, H_q, D] — C = K+1 query positions per row, position c at
+    global slot ``lens[b] + c`` (their K/V were scattered through the
+    table before this read, exactly the chunk-prefill discipline);
+    arenas/tables as ``decode_attention_paged``; lens: [B] global slot
+    of the FIRST query.  Query c attends causally over slots
+    ``<= lens[b] + c`` — token-for-token the prefix the sequential
+    decode loop would have offered it, which is what makes longest-
+    prefix acceptance exactly greedy-equivalent.  Unlike chunk prefill
+    this path IS cache-sweep-bound (C is small, the prefix is long), so
+    it gates into the K-wide paged Pallas kernel
+    (``_route_decision_paged_multi``; accept reason ``paged_multi_ok``)
+    with the gather-based XLA path as the universal fallback.  Returns
+    [B, C, H_q, D] in q.dtype."""
+    b, cc, hq, d = q.shape
+    hkv = (k_arena.shape[2] // d if k_arena.ndim == 3
+           else k_arena.shape[2])
+    g = hq // hkv
+    q5 = q.reshape(b, cc, hkv, g, d)
+    if should_use_pallas_paged_multi(q5, k_arena, tables):
+        out = _decode_attention_pallas_paged_multi(q5, k_arena, v_arena,
+                                                   tables, lens)
+        return out.reshape(b, cc, hq, d)
+    return _paged_multi_xla(q, k_arena, v_arena, tables, lens)
+
+
+def _paged_multi_xla(q, k_arena, v_arena, tables, start):
+    """Gather-based multi-position paged attention (fp32 softmax): the
+    shared XLA body of ``paged_prefix_attention`` and
+    ``decode_attention_paged_multi`` — each row's dense view is
+    materialized through its table and query c is masked to rows
+    ``<= start[b] + c``."""
     b, cc, hq, d = q.shape
     kd = paged_gather_view(k_arena, tables)
     vd = paged_gather_view(v_arena, tables)

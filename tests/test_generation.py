@@ -86,6 +86,41 @@ def test_sampling_shapes_and_range():
     np.testing.assert_array_equal(got, again)
 
 
+def test_sample_token_prng_determinism_and_topk1_greedy():
+    """The spec-decode greedy-equivalence assumptions, at the
+    ``sample_token`` functional level: (a) the same PRNG key and config
+    produce identical tokens call-over-call (the serving engine replays
+    keys through compiled programs and relies on this); (b) top_k=1
+    sampling degenerates to greedy argmax at ANY temperature — the
+    boundary where a sampled stream equals the verifier's argmax."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.generation import GenerationConfig, \
+        sample_token
+    rng = np.random.default_rng(5)
+    logits = jnp.asarray(rng.standard_normal((4, 50)), jnp.float32)
+    cfg = GenerationConfig(do_sample=True, temperature=0.7, top_k=5)
+    key = jax.random.PRNGKey(11)
+    t1 = np.asarray(sample_token(logits, key, cfg))
+    t2 = np.asarray(sample_token(logits, key, cfg))
+    np.testing.assert_array_equal(t1, t2)        # same key => same tokens
+    assert t1.shape == (4,) and t1.dtype == np.int32
+    # a different key may (and here does) sample differently — the
+    # determinism above is keyed, not degenerate
+    t3 = np.asarray(sample_token(logits, jax.random.PRNGKey(12), cfg))
+    assert not np.array_equal(t1, t3)
+    greedy = np.asarray(sample_token(
+        logits, key, GenerationConfig(do_sample=False)))
+    np.testing.assert_array_equal(greedy, np.asarray(logits).argmax(-1))
+    for temp in (0.5, 1.0, 2.0):
+        for seed in range(5):
+            k1 = np.asarray(sample_token(
+                logits, jax.random.PRNGKey(seed),
+                GenerationConfig(do_sample=True, temperature=temp,
+                                 top_k=1)))
+            np.testing.assert_array_equal(k1, greedy)
+
+
 def test_cache_len_validation():
     cfg, net = _net()
     ids = np.zeros((1, 4), np.int64)

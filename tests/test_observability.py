@@ -433,6 +433,22 @@ def test_metrics_name_lint_clean():
               "serving.prefill_chunks", "serving.requests_cancelled",
               "serving.prefill_chunk_seconds"):
         assert n in names, n
+    # the speculative-decoding set is both registered AND enforced by
+    # the lint's required-instruments rule (rule 4: deleting a
+    # registration site must fail the lint, not flatline a dashboard)
+    for n, kind in lint.REQUIRED_INSTRUMENTS.items():
+        assert n.startswith("serving.spec."), n
+        assert n in names, n
+    kinds = {r[3]: r[2] for r in regs}
+    assert kinds["serving.spec.accepted_length"] == "histogram"
+    assert kinds["serving.spec.verify_steps"] == "counter"
+    # rule 4 fires on a missing required name
+    import tempfile
+    with tempfile.TemporaryDirectory() as empty_root:
+        os.makedirs(os.path.join(empty_root, "paddle_tpu"))
+        errs, _ = lint.check(empty_root)
+        missing = [e for e in errs if "required instrument" in e]
+        assert len(missing) == len(lint.REQUIRED_INSTRUMENTS)
     # the AST walker resolves labels: the route counter's label tuple
     # is visible to the conflict rule
     by_name = {r[3]: r[4] for r in regs}
@@ -455,7 +471,12 @@ def test_metrics_name_lint_catches_violations(tmp_path):
         'r.counter("lbl.dyn", "help", labels=("a",))\n'
         'r.counter("lbl.dyn", "help", labels=make_labels())\n'
         'HostTracer.counter("Free Form OK", 1)\n')
-    errors, regs = lint.check(str(tmp_path))
+    all_errors, regs = lint.check(str(tmp_path))
+    # the synthetic tree registers none of the required instruments, so
+    # rule 4 fires once per required name on top of the 4 violations
+    required = [e for e in all_errors if "required instrument" in e]
+    assert len(required) == len(lint.REQUIRED_INSTRUMENTS)
+    errors = [e for e in all_errors if "required instrument" not in e]
     assert len(errors) == 4
     assert any("Bad.Name" in e for e in errors)
     assert any("dup.name" in e and "conflict" not in e for e in errors)

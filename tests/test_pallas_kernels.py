@@ -768,6 +768,92 @@ def test_decode_attention_paged_kernel_parity():
                                     "pallas_unavailable")
 
 
+def test_decode_attention_paged_multi_kernel_parity():
+    """K-wide paged verify kernel (interpret mode) vs the gather-based
+    XLA multi-position path: per-offset causal masking (query c sees
+    rows <= lens + c) over scattered arena blocks, across ragged lens
+    and a query width that needs a padded q-row block (g*cq not a
+    sublane multiple)."""
+    from paddle_tpu.ops.pallas.decode_attention import (
+        _decode_attention_pallas_paged_multi, _paged_multi_xla,
+        _route_decision_paged_multi)
+    rng = np.random.default_rng(17)
+    b, hkv, g, blk_len, nb, mb, d, cq = 3, 2, 2, 8, 12, 4, 64, 5
+    w = hkv * d
+    hq = hkv * g
+    q = jnp.asarray(rng.standard_normal((b, cq, hq, d)), jnp.float32)
+    q5 = q.reshape(b, cq, hkv, g, d)
+    ka = jnp.asarray(rng.standard_normal((nb + 1, blk_len, w)),
+                     jnp.float32)
+    va = jnp.asarray(rng.standard_normal((nb + 1, blk_len, w)),
+                     jnp.float32)
+    tables = jnp.asarray(rng.permutation(nb)[:b * mb].reshape(b, mb),
+                         jnp.int32)
+    # mid-block frontiers; last row's queries spill into the next block
+    lens = jnp.asarray([5, 17, 26], jnp.int32)
+    use, reason = _route_decision_paged_multi(q5, ka, tables)
+    assert reason in ("paged_multi_ok", "pallas_unavailable")
+    out = _decode_attention_pallas_paged_multi(q5, ka, va, tables, lens)
+    ref = _paged_multi_xla(q, ka, va, tables, lens).reshape(
+        b, cq, hkv, g, d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)
+    # single-position degenerates to the plain paged kernel's answer
+    from paddle_tpu.ops.pallas.decode_attention import \
+        _decode_attention_pallas_paged
+    out1 = _decode_attention_pallas_paged_multi(q5[:, :1], ka, va,
+                                                tables, lens)
+    ref1 = _decode_attention_pallas_paged(q5[:, 0], ka, va, tables, lens)
+    np.testing.assert_allclose(np.asarray(out1[:, 0]), np.asarray(ref1),
+                               atol=2e-2, rtol=2e-2)
+    # gate: too-wide query blocks reject cleanly; off-sublane blocks too
+    q_wide = jnp.zeros((b, 20, hkv, g, d), jnp.float32)
+    use2, reason2 = _route_decision_paged_multi(q_wide, ka, tables)
+    assert not use2 and reason2 == "query_rows"
+    ka_bad = jnp.zeros((nb + 1, 6, w), jnp.float32)
+    use3, reason3 = _route_decision_paged_multi(q5, ka_bad, tables)
+    assert not use3 and reason3 in ("paged_block_len",
+                                    "pallas_unavailable")
+
+
+@pytest.mark.slow
+def test_decode_attention_paged_multi_ignores_stale_tail():
+    """Rejected-draft rollback contract: K/V past ``lens + c`` (the
+    re-masked tail of the last block) must not leak into any query's
+    output — garbage planted beyond each query's causal frontier
+    leaves the result bit-identical."""
+    from paddle_tpu.ops.pallas.decode_attention import \
+        _decode_attention_pallas_paged_multi
+    rng = np.random.default_rng(18)
+    b, hkv, g, blk_len, mb, d, cq = 2, 2, 2, 8, 3, 64, 3
+    nb = b * mb
+    w = hkv * d
+    q5 = jnp.asarray(rng.standard_normal((b, cq, hkv, g, d)),
+                     jnp.float32)
+    ka = jnp.asarray(rng.standard_normal((nb + 1, blk_len, w)),
+                     jnp.float32)
+    va = jnp.asarray(rng.standard_normal((nb + 1, blk_len, w)),
+                     jnp.float32)
+    tables = jnp.asarray(np.arange(nb).reshape(b, mb), jnp.int32)
+    lens = jnp.asarray([9, 4], jnp.int32)
+    out1 = _decode_attention_pallas_paged_multi(q5, ka, va, tables, lens)
+    big = 1e6
+    # poison every slot beyond each row's LAST query frontier
+    ka2, va2 = np.array(ka), np.array(va)
+    for r in range(b):
+        frontier = int(lens[r]) + cq - 1
+        for j in range(mb):
+            lo = j * blk_len
+            for off in range(blk_len):
+                if lo + off > frontier:
+                    ka2[int(tables[r, j]), off] = big
+                    va2[int(tables[r, j]), off] = -big
+    out2 = _decode_attention_pallas_paged_multi(
+        q5, jnp.asarray(ka2), jnp.asarray(va2), tables, lens)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               atol=1e-5)
+
+
 def test_decode_attention_paged_equals_dense_layout():
     """A paged arena holding the same logical content as a dense cache
     must produce the same decode-attention output through the XLA

@@ -262,6 +262,73 @@ def test_paged_prefix_parity_chunked_prefill(netm):
     assert eng.stats()["cancelled"] == 1
 
 
+def test_stats_before_any_finish_returns_nones(netm):
+    """stats() on a virgin engine (and mid-flight before any request
+    finishes) must not divide by zero: mean latency/TTFT over the empty
+    finished set are None, rates are 0.0."""
+    cfg, net = netm
+    eng = ServingEngine(net, num_slots=1, prompt_len=4, max_cache_len=8,
+                        compute_dtype="float32")
+    s = eng.stats()
+    assert s["mean_latency_s"] is None
+    assert s["mean_ttft_s"] is None
+    assert s["mean_slot_occupancy"] == 0.0
+    assert s["prefix_hit_rate"] == 0.0
+    assert s["spec_acceptance_rate"] == 0.0
+    assert s["spec_mean_accepted_len"] == 0.0
+    assert s["finished"] == 0
+    # still None with work queued but nothing finished
+    eng.submit(np.zeros((4,), np.int32), max_new_tokens=2,
+               arrival_time=1e18)
+    s2 = eng.stats()
+    assert s2["mean_latency_s"] is None and s2["mean_ttft_s"] is None
+
+
+def test_submit_failure_after_prefix_probe_unpins(netm, monkeypatch):
+    """Regression for the probe-pin leak: a submit() that fails AFTER
+    its prefix probe pinned cached blocks must unpin them and drop the
+    request — otherwise every failed submit leaks refcounts until the
+    pool is exhausted.  Fail repeatedly (more times than the pool has
+    blocks), then verify the pool recovered and a real submit+run still
+    works."""
+    cfg, net = netm
+    eng = ServingEngine(net, num_slots=1, prompt_len=4, max_cache_len=8,
+                        block_len=2, num_blocks=4,
+                        compute_dtype="float32")
+    rng = np.random.default_rng(21)
+    shared = rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
+    first = eng.submit(shared, max_new_tokens=1)   # publishes 2 blocks
+    eng.run(max_iters=100)
+    assert eng.stats()["prefix_cached_blocks"] == 2
+    avail0 = eng._pool.available()
+
+    from paddle_tpu.inference import serving as srv
+    real_instant = srv._span_instant
+
+    def exploding_instant(name, **attrs):
+        if name == "serving.request.queued":
+            raise RuntimeError("injected submit failure")
+        return real_instant(name, **attrs)
+
+    monkeypatch.setattr(srv, "_span_instant", exploding_instant)
+    submitted0 = eng.metrics_registry.get(
+        "serving.requests_submitted").value()
+    for _ in range(eng.num_blocks + 2):     # would exhaust if leaking
+        with pytest.raises(RuntimeError, match="injected"):
+            eng.submit(shared, max_new_tokens=1)
+        assert eng._pool.available() == avail0
+        assert len(eng._queue) == 0
+    # a dropped submit must not advance the submitted counter either
+    assert eng.metrics_registry.get(
+        "serving.requests_submitted").value() == submitted0
+    monkeypatch.setattr(srv, "_span_instant", real_instant)
+    req = eng.submit(shared, max_new_tokens=1)
+    assert len(req.matched) == 1                   # probe still hits
+    done = eng.run(max_iters=100)
+    assert [r.request_id for r in done] == [req.request_id]
+    assert eng._pool.available() == avail0
+
+
 # ---------------------------------------------------------------------------
 # slow: the wider scheduler scenario matrix (per-scenario engine configs
 # recompile the serving programs; excluded from the truncation-scored
@@ -502,3 +569,16 @@ def test_bench_llm_serving_section():
     assert 0.0 < pfx["prefix_hit_rate"] <= 1.0
     # hits skip chunks; the cached arm must compute strictly fewer
     assert pfx["prefill_chunks"] < pfx["no_cache_prefill_chunks"]
+    spec = out["spec"]
+    for k in ("k", "tokens_per_s", "no_spec_tokens_per_s", "vs_no_spec",
+              "mean_accepted_len", "acceptance_rate", "drafts_per_token",
+              "draft_hit_rate", "accepted_length_le",
+              "accepted_length_counts"):
+        assert k in spec, k
+    # the repetitive trace really speculates: drafts verify at a mean
+    # accepted length > 1 and the arm beats the non-speculative engine
+    assert spec["mean_accepted_len"] > 1.0
+    assert spec["vs_no_spec"] > 1.0
+    assert 0.0 < spec["acceptance_rate"] <= 1.0
+    # the distribution and the verify counter cover the same window
+    assert sum(spec["accepted_length_counts"]) == spec["verify_steps"]

@@ -14,7 +14,13 @@ every static registry registration — ``<receiver>.counter("name", ...)``
    conflict statically), or
 3. the same name is registered with CONFLICTING label-name tuples —
    the registry's other re-registration error; a site with a
-   non-literal ``labels=`` argument is skipped for this rule.
+   non-literal ``labels=`` argument is skipped for this rule, or
+4. a REQUIRED instrument has no registration site at all — the names
+   in ``REQUIRED_INSTRUMENTS`` are load-bearing for dashboards and the
+   bench JSON (currently the ``serving.spec.*`` speculative-decoding
+   set: the accepted-length histogram, the draft hit/miss counters and
+   the verify-route counter), and a rename/delete that would silently
+   flatline them fails here instead.
 
 Registrations are parsed from the AST (not a regex), so multi-line
 calls and keyword/positional ``labels`` both resolve.
@@ -38,6 +44,21 @@ _KINDS = {"counter", "gauge", "histogram"}
 _SKIP_RECEIVERS = {"HostTracer"}
 
 NAME_RE = re.compile(r"^[a-z][a-z0-9_.]*$")
+
+# instrument names external consumers (bench JSON ``metrics``
+# sub-object, dashboards) key on; the lint fails when any loses its
+# last registration site.  kind is asserted too — a histogram silently
+# re-registered as a counter would also break its consumers.
+REQUIRED_INSTRUMENTS = {
+    # speculative decoding (inference/serving.py _ServingInstruments):
+    # acceptance-length distribution, draft hit/miss, verify route
+    "serving.spec.accepted_length": "histogram",
+    "serving.spec.accepted_tokens": "counter",
+    "serving.spec.draft_hits": "counter",
+    "serving.spec.draft_misses": "counter",
+    "serving.spec.draft_tokens": "counter",
+    "serving.spec.verify_steps": "counter",
+}
 
 
 def _receiver_name(func: ast.Attribute) -> str:
@@ -141,6 +162,18 @@ def check(root: str = REPO_ROOT):
                 f"{site}: {name!r} registered with labels "
                 f"{list(labels)} but {prev[1]} registers it with "
                 f"{list(prev[2])}")
+    for name, kind in sorted(REQUIRED_INSTRUMENTS.items()):
+        got = seen.get(name)
+        if got is None:
+            errors.append(
+                f"required instrument {name!r} ({kind}) has no "
+                f"registration site — dashboards/bench key on it; "
+                f"update REQUIRED_INSTRUMENTS if the rename is "
+                f"deliberate")
+        elif got[0] != kind:
+            errors.append(
+                f"{got[1]}: required instrument {name!r} is registered "
+                f"as {got[0]}, expected {kind}")
     return errors, regs
 
 
