@@ -602,9 +602,16 @@ def _bench_decode(on_tpu):
                         kchunk = int(hit) if hit else DEFAULT_CHUNK
                         while cache_len % kchunk:
                             kchunk //= 2
+                        # EXACTLY the kernel's DMA count: it issues
+                        # lens // chunk + 1 chunks for last-valid-index
+                        # lens = avg_valid - 1, i.e. ceil(avg_valid /
+                        # chunk) whole chunks — the old "// + 1" form
+                        # overshot by one full chunk whenever avg_valid
+                        # landed on a chunk boundary, skewing
+                        # achieved_GBps across chunk tunings
                         swept_len = min(
                             cache_len,
-                            (avg_valid // kchunk + 1) * kchunk)
+                            ((avg_valid - 1) // kchunk + 1) * kchunk)
                     else:
                         swept_len = cache_len
                     swept = weight_bytes + b * swept_len * kv_slot_bytes
@@ -769,6 +776,14 @@ def _bench_serving(on_tpu):
     (accepted-length distribution, acceptance rate, drafts-per-token),
     which also land in the run's ``metrics`` sub-object through the
     ``serving.spec.*`` instruments.
+
+    A fifth A/B isolates the INT8 KV CACHE (``kv_int8`` sub-object):
+    the mixed trace replayed through ``kv_cache_dtype="int8"`` vs the
+    full-precision engine — tokens/s ratio, modeled achieved_GBps per
+    arm (``serving.kv.bytes_swept`` / wall), and the quality gate
+    (teacher-forced greedy token agreement >= 0.98 and |dNLL| <= 1%
+    through the paged cache path, mirroring the weight-int8 gate of
+    ``_bench_decode``).
     """
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -1064,6 +1079,117 @@ def _bench_serving(on_tpu):
     spec_on = run_spec_arm(use_spec=True)
     spec_off = run_spec_arm(use_spec=False)
 
+    # -- int8 KV-cache arm: the SAME drain trace through two engines
+    # that differ ONLY in kv_cache_dtype (int8 codes + f32 absmax
+    # scales vs the full-precision cache).  Reported: tokens/s ratio,
+    # modeled achieved_GBps per arm (serving.kv.bytes_swept / wall —
+    # the arena-sweep roofline basis, which is where the int8 win
+    # lives), plus the QUALITY GATE mirroring the weight-int8 gate of
+    # _bench_decode: teacher-forced greedy token agreement and NLL
+    # delta through the paged cache path (model.verify_step scores a
+    # forced stream causally against each arena dtype — every position
+    # attends through quantized K/V, so the delta isolates KV
+    # quantization error, not weight error) --
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.generation import (init_paged_kv_arena,
+                                              model_arrays, swap_call)
+
+    def _one_kv_trace(kvdt):
+        eng = ServingEngine(
+            model, num_slots=num_slots, prompt_len=prompt,
+            max_cache_len=cache_len, steps_per_call=steps_per_call,
+            block_len=pf_block, compute_dtype=compute_dtype,
+            kv_cache_dtype=kvdt)
+        for _ in range(2):     # warm chunk program + both block sizes
+            eng.submit(prompts[0][:int(plens[0])],
+                       max_new_tokens=steps_per_call + 2)
+        eng.run()
+        warm = eng.stats()
+        t0 = time.perf_counter()
+        for i in range(n_requests):
+            eng.submit(prompts[i][:int(plens[i])],
+                       max_new_tokens=int(news[i]), arrival_time=t0)
+        done = eng.run()
+        wall = max(r.finish_time for r in done) - t0
+        final = eng.stats()
+        swept = final["kv_bytes_swept"] - warm["kv_bytes_swept"]
+        return wall, swept, np.concatenate([r.output for r in done])
+
+    def run_kv_arm(kvdt):
+        # best-of-2 walls; the swept-bytes model and outputs are
+        # deterministic per arm, so runs[0] carries them
+        runs = [_one_kv_trace(kvdt) for _ in range(2)]
+        wall = min(r[0] for r in runs)
+        return wall, runs[0][1], runs[0][2]
+
+    kv_base_wall, kv_base_swept, kv_base_out = run_kv_arm(None)
+    kv_q_wall, kv_q_swept, kv_q_out = run_kv_arm("int8")
+
+    # teacher-forced gate stream: request 0's prompt + the BASELINE
+    # engine's own greedy continuation — the trace's actual token
+    # distribution, scored position-by-position so one near-tie flip
+    # cannot cascade (free-running agreement is reported separately)
+    n0 = int(plens[0])
+    tf_stream = np.concatenate(
+        [prompts[0][:n0], kv_base_out[:int(news[0])]]).astype(np.int32)
+    tf_t = int(tf_stream.size)
+    n_layers, hkv_s, d_s = model.kv_cache_spec()
+    tf_mb = -(-tf_t // pf_block)
+    tf_tables = jnp.arange(tf_mb, dtype=jnp.int32)[None, :]
+    params, buffers = model_arrays(model)
+
+    def _kv_forced(kvdt):
+        adt = jnp.dtype(kvdt if kvdt else compute_dtype)
+
+        def pure(p_values, b_values, toks):
+            def run():
+                arenas = init_paged_kv_arena(
+                    n_layers, tf_mb, pf_block, hkv_s, d_s, adt)
+                kvs = [tuple(e) + (tf_tables,) for e in arenas]
+                logits, _ = model.verify_step(
+                    toks, jnp.zeros((1,), jnp.int32),
+                    jnp.full((1,), tf_t, jnp.int32), kvs)
+                lp = jax.nn.log_softmax(
+                    logits[:, :-1].astype(jnp.float32), -1)
+                nll = -jnp.take_along_axis(
+                    lp, toks[:, 1:][..., None].astype(jnp.int32),
+                    -1).mean()
+                return nll, jnp.argmax(logits, -1).astype(jnp.int32)
+            return swap_call(params, buffers, p_values, b_values,
+                             compute_dtype, run)
+        nll, am = jax.jit(pure)(
+            [p._value for p in params], [bf._value for bf in buffers],
+            jnp.asarray(tf_stream[None, :]))
+        return float(nll), np.asarray(am)
+
+    nll_base, am_base = _kv_forced(None)
+    nll_q, am_q = _kv_forced("int8")
+    tf_agree = float((am_base == am_q).mean())
+    delta_nll_pct = 100.0 * (nll_q - nll_base) / abs(nll_base)
+    # baseline_* keys: the full-precision arm runs in compute_dtype
+    # (bf16 on TPU, f32 on CPU — baseline_dtype says which), so a
+    # dtype-named key would misread across platforms
+    kv_int8 = {
+        "baseline_dtype": compute_dtype,
+        "tokens_per_s": round(float(news.sum()) / kv_q_wall, 1),
+        "baseline_tokens_per_s": round(
+            float(news.sum()) / kv_base_wall, 1),
+        "vs_baseline": round(kv_base_wall / max(kv_q_wall, 1e-9), 3),
+        "achieved_GBps": round(kv_q_swept / kv_q_wall / 1e9, 3),
+        "baseline_achieved_GBps": round(
+            kv_base_swept / kv_base_wall / 1e9, 3),
+        "kv_bytes_swept": int(kv_q_swept),
+        "baseline_kv_bytes_swept": int(kv_base_swept),
+        "token_agreement": round(tf_agree, 4),
+        "engine_token_agreement": round(
+            float((kv_base_out == kv_q_out).mean()), 4),
+        "delta_nll_pct": round(delta_nll_pct, 4),
+        "forced_tokens": tf_t,
+        "gate": {"token_agreement_ok": tf_agree >= 0.98,
+                 "nll_ok": abs(delta_nll_pct) <= 1.0},
+    }
+
     return {
         "tokens_per_s": cont["tokens_per_s"],
         "p50_latency_ms": cont["p50_latency_ms"],
@@ -1092,6 +1218,7 @@ def _bench_serving(on_tpu):
             "no_cache_peak_blocks_in_use":
                 pfx_off["peak_blocks_in_use"],
         },
+        "kv_int8": kv_int8,
         "spec": {
             "k": sp_k, "max_new": sp_new, "n_requests": sp_n,
             "tokens_per_s": spec_on["tokens_per_s"],

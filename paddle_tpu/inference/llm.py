@@ -142,36 +142,55 @@ def build_slot_prefill(model, max_cache_len, cfg: GenerationConfig):
     return slot_prefill_pure
 
 
-def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call):
+def _pack_paged_kvs(flat_arenas, tables, kv_int8):
+    """Per-layer kv entries from the engine's flat arena list: the
+    (k, v, tables) triple of the float cache, or the
+    (k_codes, v_codes, k_scales, v_scales, tables) 5-tuple of the int8
+    cache (4 donated arrays per layer instead of 2)."""
+    stride = 4 if kv_int8 else 2
+    return [tuple(flat_arenas[i:i + stride]) + (tables,)
+            for i in range(0, len(flat_arenas), stride)]
+
+
+def _flatten_paged_kvs(kvs):
+    """Inverse of ``_pack_paged_kvs`` minus the tables: the flat arena
+    list handed back out of a serving program (donation-matched)."""
+    flat = []
+    for entry in kvs:
+        flat += list(entry[:-1])
+    return flat
+
+
+def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call,
+                              kv_int8=False):
     """Paged twin of ``_build_decode_block``: the cache is the shared
     block arena plus per-slot block tables instead of per-slot
     contiguous rows.  The tables ride into the scan closure as a
     loop-invariant traced value (a request's table never changes during
     its decode life — all its blocks are mapped at admission), so the
     per-step transfer is ONLY the small [B, max_blocks] int32 table
-    push; the arenas stay donated device buffers.  Signature:
+    push; the arenas stay donated device buffers.  ``kv_int8`` selects
+    the quantized cache: ``flat_arenas`` then interleaves
+    (k_codes, v_codes, k_scales, v_scales) per layer and the models'
+    decode path quantizes on append / dequantizes on read.  Signature:
     ``(p_values, tok, lens, done, key, tables, *flat_arenas) ->
     (toks [B, n], tok', lens', done', key', *flat_arenas)``."""
     _with_params = _param_swapper(model, cfg)
 
     def block_pure(p_values, tok, lens, done, key, tables, *flat_arenas):
         def run():
-            kvs = [(flat_arenas[i], flat_arenas[i + 1], tables)
-                   for i in range(0, len(flat_arenas), 2)]
+            kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
             (tok_f, lens_f, kvs_f, key_f, done_f), toks = jax.lax.scan(
                 decode_scan_body(model, cfg), (tok, lens, kvs, key, done),
                 None, length=steps_per_call)
-            flat_out = []
-            for ka, va, _t in kvs_f:
-                flat_out += [ka, va]
             return ((toks.T.astype(jnp.int32), tok_f, lens_f, done_f,
-                     key_f) + tuple(flat_out))
+                     key_f) + tuple(_flatten_paged_kvs(kvs_f)))
         return _with_params(p_values, run)
 
     return block_pure
 
 
-def build_chunk_prefill(model, cfg: GenerationConfig):
+def build_chunk_prefill(model, cfg: GenerationConfig, kv_int8=False):
     """Chunked-prefill program for the paged ServingEngine: ONE prompt
     chunk of ONE sequence (batch-1; the static chunk length is the ids
     shape) computed at global positions ``start .. start+C-1``, K/V
@@ -179,7 +198,8 @@ def build_chunk_prefill(model, cfg: GenerationConfig):
     A token is sampled from the logits at prompt position
     ``n_valid - 1`` every call; it is only meaningful on the chunk that
     covers that position — the engine ignores earlier chunks' sample
-    and never advances decode state from them.  Signature:
+    and never advances decode state from them.  ``kv_int8`` selects the
+    quantized cache (see ``_build_paged_decode_block``).  Signature:
     ``(p_values, ids [1, C], start [], n_valid [], tables
     [1, max_blocks], key, *flat_arenas) -> (tok [1], key',
     *flat_arenas)``."""
@@ -193,18 +213,14 @@ def build_chunk_prefill(model, cfg: GenerationConfig):
     def chunk_pure(p_values, ids, start, n_valid, tables, key,
                    *flat_arenas):
         def run():
-            kvs = [(flat_arenas[i], flat_arenas[i + 1], tables)
-                   for i in range(0, len(flat_arenas), 2)]
+            kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
             logits, kvs_f = model.prefill_chunk(ids, start, n_valid, kvs)
             if cfg.do_sample:
                 key0, keyr = jax.random.split(key)
             else:
                 key0 = keyr = key
             tok = sample_token(logits, key0, cfg)
-            flat_out = []
-            for ka, va, _t in kvs_f:
-                flat_out += [ka, va]
-            return (tok, keyr) + tuple(flat_out)
+            return (tok, keyr) + tuple(_flatten_paged_kvs(kvs_f))
         return _with_params(p_values, run)
 
     return chunk_pure

@@ -61,6 +61,23 @@ scheduling (admit only into an empty pool) — the A/B baseline of
 ``bench.py``'s ``llm_serving`` section; ``enable_prefix_cache=False``
 is the A/B arm for the shared-prefix trace.
 
+**Int8 KV cache** (``kv_cache_dtype="int8"``): decode at scale is
+KV-bandwidth-bound — the step streams the arena once per token — so
+the arenas can be stored QUANTIZED: int8 codes plus parallel
+per-entry per-kv-head f32 absmax scale arenas.  Every writer
+(chunked prefill, decode scatter, the speculative verify scatter)
+quantizes on append (``models.generation.*_q``); every reader
+dequantizes on read — the paged Pallas kernels DMA codes + scales
+and dequantize in VMEM right before the dot (route reasons
+``paged_int8_ok`` / ``paged_multi_int8_ok`` / ``int8_geom``), the
+XLA gather fallback reads ``paged_dequant_view`` so CPU tests
+exercise the same math.  HBM swept per token roughly halves
+(1 + 4/D bytes/lane vs 2) and twice the KV blocks fit the same
+arena budget; scheduling is unchanged — block tables, prefix
+digests (salted by cache dtype), trash-block discipline and
+spec-decode rollback all operate on block indices, never on cache
+bytes.
+
 **Speculative decoding** is a per-request mode on top
 (``submit(spec_decode=K)``, greedy engines only): each scheduler
 iteration runs at most one batched K+1-position verify forward over
@@ -197,6 +214,18 @@ class _ServingInstruments:
             "counted)",
             buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0,
                      24.0, 32.0))
+        self.kv_bytes_swept = r.counter(
+            "serving.kv.bytes_swept",
+            "modeled KV-arena bytes read by decode/verify/prefill-chunk "
+            "dispatches, at the paged kernels' block-DMA granularity "
+            "(valid prefix rounded up to whole blocks; codes + scale "
+            "planes for the int8 cache) — the roofline denominator of "
+            "the serving bench's achieved_GBps")
+        self.kv_quant_dtype = r.gauge(
+            "serving.kv.quant_dtype",
+            "1 for each KV-cache at-rest dtype an engine in this "
+            "process serves with (the label carries the dtype name)",
+            labels=("dtype",))
         self._base = {}
         for c in (self.prefills, self.prefill_chunks, self.decode_steps,
                   self.busy_slot_steps, self.block_dispatches,
@@ -204,7 +233,7 @@ class _ServingInstruments:
                   self.prefix_hits, self.prefix_misses,
                   self.spec_verifies, self.spec_draft_hits,
                   self.spec_draft_misses, self.spec_draft_tokens,
-                  self.spec_accepted_tokens):
+                  self.spec_accepted_tokens, self.kv_bytes_swept):
             self._base[c.name] = c.value()
 
     def since_init(self, counter) -> float:
@@ -225,14 +254,18 @@ def _call_quiet(fn, *args):
         return fn(*args)
 
 
-def _block_digests(ids: np.ndarray, n: int, block_len: int) -> List[bytes]:
+def _block_digests(ids: np.ndarray, n: int, block_len: int,
+                   salt: bytes = b"ptpu-paged-kv") -> List[bytes]:
     """Chained blake2b digests of the prompt's FULL blocks: block i's
     digest covers tokens [0, (i+1)*block_len) through the chain, so two
     blocks share a digest only when their whole attention context is
     identical — the property that makes mapping a cached block into a
-    new sequence exact, not just likely."""
+    new sequence exact, not just likely.  ``salt`` seeds the chain; the
+    engine salts with the KV cache dtype so a bf16 block and an int8
+    block of the same tokens can never alias (their arena bytes
+    differ)."""
     out: List[bytes] = []
-    h = b"ptpu-paged-kv"
+    h = salt
     for i in range(n // block_len):
         h = hashlib.blake2b(
             h + ids[i * block_len:(i + 1) * block_len].tobytes(),
@@ -404,6 +437,7 @@ class ServingEngine:
                  eos_token_id=None, pad_token_id=0,
                  do_sample=False, temperature=1.0, top_k=0,
                  compute_dtype="bfloat16", cache_dtype=None,
+                 kv_cache_dtype=None,
                  seed=0, static_batching=False, clock=time.perf_counter,
                  registry=None):
         self.num_slots = int(num_slots)
@@ -449,13 +483,50 @@ class ServingEngine:
             [bf._value for bf in buffers]
 
         n_layers, hkv, d = model.kv_cache_spec()
-        cdt = jnp.dtype(self.cfg.cache_dtype or self.cfg.compute_dtype)
+        # kv_cache_dtype overrides the arena dtype only; "int8" selects
+        # the QUANTIZED cache — int8 code arenas + parallel f32 absmax
+        # scale arenas, quantize-on-append in every writer and
+        # dequantize-on-read in every reader (models.generation
+        # quantize_kv_heads / ops.pallas.decode_attention int8 paths).
+        # The compute dtype (weights, activations, softmax) is
+        # untouched: only the at-rest cache and its HBM sweep shrink.
+        kvdt = (kv_cache_dtype if kv_cache_dtype is not None
+                else (self.cfg.cache_dtype or self.cfg.compute_dtype))
+        try:
+            cdt = jnp.dtype(kvdt)
+        except TypeError as e:
+            raise ValueError(f"unknown kv_cache_dtype {kvdt!r}") from e
+        if cdt != jnp.dtype(jnp.int8) and \
+                not jnp.issubdtype(cdt, jnp.floating):
+            # any float dtype is a valid at-rest cache; "int8" selects
+            # the quantized cache.  Everything else (int4, uint8, ...)
+            # would silently cast K/V into an integer arena with no
+            # scale planes — garbage outputs, so reject loudly
+            raise ValueError(
+                f"kv_cache_dtype must be a float dtype or 'int8' (the "
+                f"quantized cache), got {kvdt!r}")
+        self.kv_cache_dtype = str(jnp.dtype(cdt).name)
+        self._kv_int8 = cdt == jnp.dtype(jnp.int8)
+        self._n_layers = n_layers
         arenas = init_paged_kv_arena(n_layers, self.num_blocks,
                                      self.block_len, hkv, d, cdt)
         self._arenas: List = []
-        for ka, va in arenas:
-            self._arenas += [ka, va]
+        for entry in arenas:
+            self._arenas += list(entry)
+        # modeled per-row KV sweep bytes across all layers, at the
+        # Pallas kernels' block-DMA granularity (serving.kv.bytes_swept)
+        row_bytes = 2 * hkv * d * (1 if self._kv_int8
+                                   else jnp.dtype(cdt).itemsize)
+        if self._kv_int8:
+            row_bytes += 2 * hkv * 4       # f32 scale planes
+        self._kv_row_bytes = row_bytes * n_layers
         self._pool = BlockPool(self.num_blocks, self.block_len)
+        # prefix digests are salted with the cache dtype: a bf16 block
+        # and an int8 block of the same tokens hold different bytes, so
+        # they must never alias in any (present or future) shared
+        # digest namespace
+        self._digest_salt = ("ptpu-paged-kv/"
+                             + self.kv_cache_dtype).encode()
         # host-side block tables; pushed (small int32) per dispatch —
         # the ONLY new per-step transfer; the arenas never leave the
         # device and are donated into both compiled programs so
@@ -465,9 +536,10 @@ class ServingEngine:
         #       (pb, tok, lens, done, key, tables, *arenas)
         self._tables = np.full((self.num_slots, self.max_blocks),
                                self._pool.trash, np.int32)
-        donate = tuple(range(6, 6 + 2 * n_layers))
+        donate = tuple(range(6, 6 + len(self._arenas)))
         self._chunk_fn = jax.jit(
-            build_chunk_prefill(model, self.cfg), donate_argnums=donate)
+            build_chunk_prefill(model, self.cfg, kv_int8=self._kv_int8),
+            donate_argnums=donate)
         self._donate = donate
         self._blocks = {}              # static block size -> jitted fn
         # speculative decoding: per-request mode (submit(spec_decode=K));
@@ -502,6 +574,7 @@ class ServingEngine:
         self._m = _ServingInstruments(
             registry if registry is not None else obs_metrics.get_registry())
         self._m.slots_total.set(self.num_slots)
+        self._m.kv_quant_dtype.set(1, dtype=self.kv_cache_dtype)
         self._m.slot_occupancy.set(0)
         self._m.blocks_free.set(self.num_blocks)
         self._m.blocks_in_use.set(0)
@@ -520,6 +593,25 @@ class ServingEngine:
         self._m.blocks_free.set(free)
         self._m.blocks_in_use.set(in_use)
         self._peak_blocks = max(self._peak_blocks, in_use)
+
+    def _count_kv_sweep(self, last_indices):
+        """Model one dispatch's KV read traffic into
+        ``serving.kv.bytes_swept``: one entry per (row, scanned step)
+        giving that sweep's last valid index; each is rounded up to
+        whole blocks (the paged kernels' ``length // L + 1`` DMA
+        granularity, clamped to the table span — the kernel never
+        streams past ``max_blocks``) and charged the per-row per-layer
+        byte cost (codes + scale planes for int8).  Modeled, not
+        measured, and PARTICIPATING rows only: vacant/frozen rows in
+        the same dispatch do DMA their (trash-routed) frontier, but
+        that waste traffic is excluded so the counter reads as useful
+        KV bytes — the conservative roofline basis the serving bench's
+        achieved_GBps uses (both A/B arms share the model, so ratios
+        are unaffected)."""
+        rows = sum(min(int(ix) // self.block_len + 1, self.max_blocks)
+                   * self.block_len
+                   for ix in last_indices)
+        self._m.kv_bytes_swept.inc(rows * self._kv_row_bytes)
 
     def _release_blocks(self, req: Request):
         for b in req.blocks:
@@ -611,7 +703,8 @@ class ServingEngine:
         # would leak refcounts until the pool wedges
         try:
             if self.enable_prefix_cache:
-                req.digests = _block_digests(padded, n, self.block_len)
+                req.digests = _block_digests(padded, n, self.block_len,
+                                             salt=self._digest_salt)
                 # match at most (n-1)//block_len blocks: the block
                 # holding the prompt's LAST token is always recomputed —
                 # sampling the first output token needs its hidden
@@ -777,6 +870,7 @@ class ServingEngine:
             tok0 = int(np.asarray(outp[0])[0])
         self._m.prefill_chunks.inc()
         self._m.chunk_latency.observe(self._clock() - t0)
+        self._count_kv_sweep([min(start + c, req.seq_len) - 1])
         req.pf_pos = start + c
         if self.enable_prefix_cache:
             full = min(req.pf_pos, req.seq_len) // self.block_len
@@ -818,7 +912,8 @@ class ServingEngine:
         fn = self._blocks.get(steps)
         if fn is None:
             fn = jax.jit(
-                _build_paged_decode_block(self._model, self.cfg, steps),
+                _build_paged_decode_block(self._model, self.cfg, steps,
+                                          kv_int8=self._kv_int8),
                 donate_argnums=self._donate)
             self._blocks[steps] = fn
         return fn
@@ -854,7 +949,8 @@ class ServingEngine:
         fn = self._verify_fns.get(steps)
         if fn is None:
             fn = jax.jit(
-                build_spec_verify(self._model, self.cfg, steps),
+                build_spec_verify(self._model, self.cfg, steps,
+                                  kv_int8=self._kv_int8),
                 donate_argnums=tuple(
                     5 + i for i in range(len(self._arenas))))
             self._verify_fns[steps] = fn
@@ -931,6 +1027,11 @@ class ServingEngine:
             greedy = np.asarray(outp[0])                # [B, width]
         self._arenas = list(outp[1:])
         self._m.spec_verifies.inc()
+        # the K-wide kernel DMAs the STATIC width's frontier
+        # (lens + cq - 1) for every spec row, however few positions
+        # n_valid marks valid — model exactly that
+        self._count_kv_sweep([int(self._lens[i]) + width - 1
+                              for i in spec])
         t = self._clock()
         for i in spec:
             req = self._slots[i]
@@ -988,6 +1089,7 @@ class ServingEngine:
         min_budget = min(self._slots[i].remaining for i in active)
         n = self.steps_per_call if min_budget >= self.steps_per_call \
             else 1
+        pre_lens = self._lens
         with _span("serving.decode_block", steps=n, active=len(active)):
             out = _call_quiet(
                 self._block_fn(n),
@@ -1004,6 +1106,14 @@ class ServingEngine:
         self._m.busy_slot_steps.inc(n * len(active))
         self._m.block_dispatches.inc()
         self._m.tokens_emitted.inc(n * len(active))
+        # per-step frontier, not the block's final lens: scanned step s
+        # scatters at index lens_pre+s and attends up to it — clamped
+        # to the row's final lens, where a mid-block EOS froze it (the
+        # scan keeps sweeping the frozen frontier for the rest of the
+        # block)
+        self._count_kv_sweep(
+            [min(int(pre_lens[i]) + s, int(self._lens[i]))
+             for i in active for s in range(n)])
         t = self._clock()
         for i in active:
             req = self._slots[i]
@@ -1079,6 +1189,9 @@ class ServingEngine:
         accepted = self._m.since_init(self._m.spec_accepted_tokens)
         return {
             "num_slots": self.num_slots,
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "kv_bytes_swept": int(
+                self._m.since_init(self._m.kv_bytes_swept)),
             "decode_steps": int(decode_steps),
             "busy_slot_steps": int(busy),
             "block_dispatches": int(

@@ -188,7 +188,7 @@ def accept_drafts(greedy_row, drafts,
     return emitted, a
 
 
-def build_spec_verify(model, cfg, steps: int):
+def build_spec_verify(model, cfg, steps: int, kv_int8: bool = False):
     """The compiled verifier program: ONE target forward scores
     ``steps`` positions per slot (the last emitted token plus up to
     ``steps - 1`` draft candidates) against the paged KV arena and
@@ -203,7 +203,10 @@ def build_spec_verify(model, cfg, steps: int):
     is an exact-equivalence argument only for deterministic decoding
     (``sample_token`` with ``do_sample=False`` — and with ``top_k=1``
     sampling degenerating to the same argmax; rejection sampling for
-    temperature>0 is future work).  Signature:
+    temperature>0 is future work).  ``kv_int8`` selects the quantized
+    paged cache — the verify forward then reads int8 codes + scales and
+    its K/V writes quantize on append, so drafting/acceptance runs
+    against exactly the arena the decode path maintains.  Signature:
     ``(p_values, toks [B, C], lens [B], n_valid [B],
     tables [B, max_blocks], *flat_arenas) ->
     (greedy [B, C], *flat_arenas)``."""
@@ -219,20 +222,16 @@ def build_spec_verify(model, cfg, steps: int):
             "stream")
     if steps < 1:
         raise ValueError(f"verify steps must be >= 1, got {steps}")
-    from .llm import _param_swapper
+    from .llm import _flatten_paged_kvs, _pack_paged_kvs, _param_swapper
 
     _with_params = _param_swapper(model, cfg)
 
     def verify_pure(p_values, toks, lens, n_valid, tables, *flat_arenas):
         def run():
-            kvs = [(flat_arenas[i], flat_arenas[i + 1], tables)
-                   for i in range(0, len(flat_arenas), 2)]
+            kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
             logits, kvs_f = model.verify_step(toks, lens, n_valid, kvs)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            flat_out = []
-            for ka, va, _t in kvs_f:
-                flat_out += [ka, va]
-            return (greedy,) + tuple(flat_out)
+            return (greedy,) + tuple(_flatten_paged_kvs(kvs_f))
         return _with_params(p_values, run)
 
     return verify_pure

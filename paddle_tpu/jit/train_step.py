@@ -265,7 +265,11 @@ class TrainStep:
         # live in the PARAM dtype (paddle adamw kernel's mp_ branch is
         # the fp32 path).  bf16 moments store via stochastic rounding —
         # plain round-to-nearest would bias the EMAs; with SR the
-        # optimizer-state HBM sweep halves (BASELINE.md round 4)
+        # optimizer-state HBM sweep halves (BASELINE.md round 4).  The
+        # noise tile is shared across leading dims, so same-step
+        # rounding errors are COLUMN-correlated — unbiasedness per
+        # element survives, same-step spatial statistics would not; see
+        # the trade-off note in _stochastic_round_bf16's docstring
         if isinstance(opt, AdamW):
             return _functional_adam, {
                 "beta1": opt._beta1, "beta2": opt._beta2,

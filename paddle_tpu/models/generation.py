@@ -120,30 +120,112 @@ def init_paged_kv_arena(num_layers, num_blocks, block_len, num_kv_heads,
     a masked write can never touch another sequence's blocks.  Zero
     init matters only for the trash/never-written rows: reads past a
     row's ``lens`` are masked to weight 0, which is exact only against
-    finite stale data (0 * NaN = NaN)."""
-    from ..ops.pallas.decode_attention import paged_arena_shape
+    finite stale data (0 * NaN = NaN).
+
+    ``dtype="int8"`` selects the QUANTIZED cache: each layer yields a
+    4-tuple ``(k_codes, v_codes, k_scales, v_scales)`` — int8 code
+    arenas plus parallel ``[num_blocks + 1, block_len, H_kv]`` f32
+    absmax-scale arenas (``quantize_kv_heads``); every other dtype
+    yields the plain (k, v) pair."""
+    from ..ops.pallas.decode_attention import (paged_arena_shape,
+                                               paged_scale_shape)
     shape = paged_arena_shape(num_blocks + 1, num_kv_heads, block_len,
                               head_dim)
+    if jnp.dtype(dtype) == jnp.int8:
+        # quantized arenas carry parallel per-entry per-kv-head absmax
+        # scale planes (quantize_kv_heads); the trash row exists in the
+        # scale arenas too, for the same masked-write reason.  f32
+        # scales: a bf16 scale would stack ~0.4% scale error on top of
+        # the int8 step, and the scale planes are 4/D of the codes'
+        # bytes — not worth the precision trade.
+        sshape = paged_scale_shape(num_blocks + 1, num_kv_heads,
+                                   block_len)
+        return [(jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                 jnp.zeros(sshape, jnp.float32),
+                 jnp.zeros(sshape, jnp.float32))
+                for _ in range(num_layers)]
     return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
             for _ in range(num_layers)]
 
 
-def paged_cache_scatter(arena, tables, lens, new_kv):
-    """Write one new [B, H_kv, D] decode entry at each sequence's slot
-    ``lens[b]``, routed through its block table: arena row
-    ``tables[b, lens[b] // L]``, offset ``lens[b] % L``.  Vacant and
-    frozen rows carry all-trash tables, so their (repeated) writes land
-    in the trash block instead of a block another sequence may now own
-    — the paged replacement for the dense engine's "done rows overwrite
-    their own dead row" contract.  Same O(B*H_kv*D) batched-scatter
-    cost as ``cache_scatter``."""
+def quantize_kv_heads(kv):
+    """Per-entry per-kv-head absmax int8 quantization of K/V planes.
+
+    ``kv`` is any ``[..., H_kv, D]`` stack of head vectors; returns
+    ``(codes int8 [..., H_kv, D], scales f32 [..., H_kv])`` with
+    ``codes * scales[..., None] ~= kv``.  The scale granularity is the
+    quantization design decision of the int8 KV cache (notes.md has the
+    full rationale): one absmax scale per WRITTEN ENTRY per kv head —
+    every append quantizes exactly what it writes and nothing else, so
+    writers stay pure scatters (no read-modify-requantize of
+    neighbouring block rows) and a value's dequantized form never
+    changes after its write (prefix-cached blocks stay bit-identical,
+    spec-decode rewind leaves no requantization residue).  absmax is
+    clamped so an all-zero plane (pad tails, zero-init rows) yields a
+    tiny finite scale, codes 0 and an exact dequant of 0."""
+    f = kv.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(f), axis=-1)
+    scales = jnp.maximum(absmax, 1e-8) / 127.0
+    codes = jnp.clip(jnp.round(f / scales[..., None]), -127, 127)
+    return codes.astype(jnp.int8), scales
+
+
+def _paged_decode_route(arena, tables, lens):
+    """(blk, off) arena coordinates for one [B] decode append at slot
+    ``lens[b]``: arena row ``tables[b, lens[b] // L]``, offset
+    ``lens[b] % L``.  The SINGLE source of the decode trash-routing
+    index math — both the code-arena scatter and its ``_q`` scale-plane
+    twin route through here, so the two planes can never desynchronize
+    (the arena argument only supplies ``shape[1] == L``; code and scale
+    arenas agree on it)."""
     b = tables.shape[0]
     block_len = arena.shape[1]
     blk = tables[jnp.arange(b), lens // block_len]
     off = lens % block_len
+    return blk, off
+
+
+def paged_cache_scatter(arena, tables, lens, new_kv):
+    """Write one new [B, H_kv, D] decode entry at each sequence's slot
+    ``lens[b]``, routed through its block table
+    (``_paged_decode_route``).  Vacant and frozen rows carry all-trash
+    tables, so their (repeated) writes land in the trash block instead
+    of a block another sequence may now own — the paged replacement for
+    the dense engine's "done rows overwrite their own dead row"
+    contract.  Same O(B*H_kv*D) batched-scatter cost as
+    ``cache_scatter``."""
+    blk, off = _paged_decode_route(arena, tables, lens)
     if arena.ndim == 3:
-        new_kv = new_kv.reshape(b, -1)
+        new_kv = new_kv.reshape(tables.shape[0], -1)
     return arena.at[blk, off].set(new_kv.astype(arena.dtype))
+
+
+def paged_cache_scatter_q(arena, scales, tables, lens, new_kv):
+    """Quantize-on-append twin of ``paged_cache_scatter`` for the int8
+    cache: the new [B, H_kv, D] entry is absmax-quantized per kv head
+    (``quantize_kv_heads``) and its codes + scales are scattered through
+    the block table with the SAME trash-routing discipline (vacant/
+    frozen rows carry all-trash tables, so both planes of a masked
+    write land in the trash row).  Returns ``(arena, scales)``."""
+    codes, s = quantize_kv_heads(new_kv)
+    arena = paged_cache_scatter(arena, tables, lens, codes)
+    blk, off = _paged_decode_route(arena, tables, lens)
+    return arena, scales.at[blk, off].set(s)
+
+
+def _paged_chunk_route(arena, tables, start, n_valid, c):
+    """(blk, off) coordinates for a batch-1 chunk of ``c`` consecutive
+    positions ``start .. start+c-1`` through ``tables`` ([1,
+    max_blocks]); positions ``>= n_valid`` route to the trash row.  The
+    SINGLE source of the chunk trash-routing index math, shared by the
+    code-arena scatter and its ``_q`` scale-plane twin."""
+    block_len = arena.shape[1]
+    trash = arena.shape[0] - 1
+    pos = start + jnp.arange(c, dtype=jnp.int32)
+    idx = jnp.minimum(pos // block_len, tables.shape[1] - 1)
+    blk = jnp.where(pos < n_valid, tables[0, idx], trash)
+    off = pos % block_len
+    return blk, off
 
 
 def paged_chunk_scatter(arena, tables, start, n_valid, new_kv):
@@ -155,15 +237,23 @@ def paged_chunk_scatter(arena, tables, start, n_valid, new_kv):
     and masking is done by redirecting the target, never by shrinking
     the shape."""
     c = new_kv.shape[0]
-    block_len = arena.shape[1]
-    trash = arena.shape[0] - 1
-    pos = start + jnp.arange(c, dtype=jnp.int32)
-    idx = jnp.minimum(pos // block_len, tables.shape[1] - 1)
-    blk = jnp.where(pos < n_valid, tables[0, idx], trash)
-    off = pos % block_len
+    blk, off = _paged_chunk_route(arena, tables, start, n_valid, c)
     if arena.ndim == 3:
         new_kv = new_kv.reshape(c, -1)
     return arena.at[blk, off].set(new_kv.astype(arena.dtype))
+
+
+def paged_chunk_scatter_q(arena, scales, tables, start, n_valid, new_kv):
+    """Quantize-on-append twin of ``paged_chunk_scatter``: the chunk's
+    [C, H_kv, D] planes quantize per position per kv head and both
+    codes and scales scatter through the table, pad-tail positions
+    (``>= n_valid``) trash-routed in BOTH arenas.  Returns
+    ``(arena, scales)``."""
+    codes, s = quantize_kv_heads(new_kv)
+    arena = paged_chunk_scatter(arena, tables, start, n_valid, codes)
+    blk, off = _paged_chunk_route(arena, tables, start, n_valid,
+                                  new_kv.shape[0])
+    return arena, scales.at[blk, off].set(s)
 
 
 def paged_verify_scatter(arena, tables, lens, n_valid, new_kv):
@@ -182,6 +272,18 @@ def paged_verify_scatter(arena, tables, lens, n_valid, new_kv):
     rejected draft's K/V is finite garbage behind the ``lens`` mask,
     never another sequence's data."""
     b, c = new_kv.shape[0], new_kv.shape[1]
+    blk, off = _paged_verify_route(arena, tables, lens, n_valid, c)
+    if arena.ndim == 3:
+        new_kv = new_kv.reshape(b, c, -1)
+    return arena.at[blk, off].set(new_kv.astype(arena.dtype))
+
+
+def _paged_verify_route(arena, tables, lens, n_valid, c):
+    """(blk, off) coordinates for a verify forward's per-row spans
+    ``lens[b] .. lens[b]+c-1`` through each row's table; columns
+    ``>= n_valid[b]`` route to the trash row.  The SINGLE source of the
+    verify trash-routing index math, shared by the code-arena scatter
+    and its ``_q`` scale-plane twin."""
     block_len = arena.shape[1]
     trash = arena.shape[0] - 1
     pos = lens[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
@@ -190,9 +292,22 @@ def paged_verify_scatter(arena, tables, lens, n_valid, new_kv):
                     < n_valid[:, None],
                     jnp.take_along_axis(tables, idx, axis=1), trash)
     off = pos % block_len
-    if arena.ndim == 3:
-        new_kv = new_kv.reshape(b, c, -1)
-    return arena.at[blk, off].set(new_kv.astype(arena.dtype))
+    return blk, off
+
+
+def paged_verify_scatter_q(arena, scales, tables, lens, n_valid, new_kv):
+    """Quantize-on-append twin of ``paged_verify_scatter``: the verify
+    forward's [B, C, H_kv, D] planes quantize per position per kv head;
+    codes and scales scatter with the same per-row trash mask (columns
+    ``>= n_valid[b]``), so the rollback guarantee carries over to both
+    planes — a rejected draft's codes AND its scales are finite garbage
+    behind the ``lens`` mask, overwritten before lens reaches them.
+    Returns ``(arena, scales)``."""
+    codes, s = quantize_kv_heads(new_kv)
+    arena = paged_verify_scatter(arena, tables, lens, n_valid, codes)
+    blk, off = _paged_verify_route(arena, tables, lens, n_valid,
+                                   new_kv.shape[1])
+    return arena, scales.at[blk, off].set(s)
 
 
 def cache_prefill_write(cache, kv_bshd):

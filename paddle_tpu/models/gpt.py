@@ -108,14 +108,27 @@ class GPTAttention(nn.Layer):
     def decode_step(self, x, kv, lens):
         """One cached decode step (MHA: kv heads == q heads, so the GQA
         grouped attention runs with group size 1).  kv is the dense
-        (k_cache, v_cache) pair or the paged (k_arena, v_arena, tables)
-        triple."""
+        (k_cache, v_cache) pair, the paged (k_arena, v_arena, tables)
+        triple, or the quantized paged 5-tuple (k_codes, v_codes,
+        k_scales, v_scales, tables) of the int8 KV cache."""
         from ..core.tensor import Tensor
         b = x.shape[0]
         qkv = self.qkv_proj(x).reshape([b, 1, 3, self.num_heads,
                                         self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if len(kv) == 3:
+        if len(kv) == 5:
+            from .generation import paged_cache_scatter_q
+            from ..ops.pallas.decode_attention import decode_attention_paged
+            k_arena, v_arena, k_s, v_s, tables = kv
+            k_arena, k_s = paged_cache_scatter_q(k_arena, k_s, tables,
+                                                 lens, k._value[:, 0])
+            v_arena, v_s = paged_cache_scatter_q(v_arena, v_s, tables,
+                                                 lens, v._value[:, 0])
+            out = decode_attention_paged(q._value[:, 0], k_arena, v_arena,
+                                         tables, lens,
+                                         kv_scales=(k_s, v_s))
+            kv = (k_arena, v_arena, k_s, v_s, tables)
+        elif len(kv) == 3:
             from .generation import paged_cache_scatter
             from ..ops.pallas.decode_attention import decode_attention_paged
             k_arena, v_arena, tables = kv
@@ -141,29 +154,44 @@ class GPTAttention(nn.Layer):
         """One chunked-prefill step over the paged cache (batch-1 C
         prompt tokens; see LlamaAttention.chunk_step — position ids
         are applied at the model level here, GPT has no RoPE)."""
-        from .generation import paged_chunk_scatter
+        from .generation import paged_chunk_scatter, paged_chunk_scatter_q
         from ..ops.pallas.decode_attention import paged_prefix_attention
         from ..core.tensor import Tensor
         b, c, _ = x.shape
         qkv = self.qkv_proj(x).reshape([b, c, 3, self.num_heads,
                                         self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        k_arena, v_arena, tables = kv
-        k_arena = paged_chunk_scatter(k_arena, tables, start, n_valid,
-                                      k._value[0])
-        v_arena = paged_chunk_scatter(v_arena, tables, start, n_valid,
-                                      v._value[0])
-        out = paged_prefix_attention(q._value, k_arena, v_arena, tables,
-                                     start.reshape(1))
+        if len(kv) == 5:
+            k_arena, v_arena, k_s, v_s, tables = kv
+            k_arena, k_s = paged_chunk_scatter_q(k_arena, k_s, tables,
+                                                 start, n_valid,
+                                                 k._value[0])
+            v_arena, v_s = paged_chunk_scatter_q(v_arena, v_s, tables,
+                                                 start, n_valid,
+                                                 v._value[0])
+            out = paged_prefix_attention(q._value, k_arena, v_arena,
+                                         tables, start.reshape(1),
+                                         kv_scales=(k_s, v_s))
+            new_kv = (k_arena, v_arena, k_s, v_s, tables)
+        else:
+            k_arena, v_arena, tables = kv
+            k_arena = paged_chunk_scatter(k_arena, tables, start, n_valid,
+                                          k._value[0])
+            v_arena = paged_chunk_scatter(v_arena, tables, start, n_valid,
+                                          v._value[0])
+            out = paged_prefix_attention(q._value, k_arena, v_arena,
+                                         tables, start.reshape(1))
+            new_kv = (k_arena, v_arena, tables)
         out = self.out_proj(Tensor(out.reshape(b, c, -1)))
-        return out, (k_arena, v_arena, tables)
+        return out, new_kv
 
     def verify_step(self, x, kv, lens, n_valid):
         """One speculative-verify step over the paged cache: C = K+1
         tokens per row at global positions ``lens[b] + c`` (see
         LlamaAttention.verify_step — positions are applied at the model
         level here, GPT has no RoPE)."""
-        from .generation import paged_verify_scatter
+        from .generation import (paged_verify_scatter,
+                                 paged_verify_scatter_q)
         from ..ops.pallas.decode_attention import \
             decode_attention_paged_multi
         from ..core.tensor import Tensor
@@ -171,15 +199,29 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv_proj(x).reshape([b, c, 3, self.num_heads,
                                         self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        k_arena, v_arena, tables = kv
-        k_arena = paged_verify_scatter(k_arena, tables, lens, n_valid,
-                                       k._value)
-        v_arena = paged_verify_scatter(v_arena, tables, lens, n_valid,
-                                       v._value)
-        out = decode_attention_paged_multi(q._value, k_arena, v_arena,
-                                           tables, lens)
+        if len(kv) == 5:
+            k_arena, v_arena, k_s, v_s, tables = kv
+            k_arena, k_s = paged_verify_scatter_q(k_arena, k_s, tables,
+                                                  lens, n_valid,
+                                                  k._value)
+            v_arena, v_s = paged_verify_scatter_q(v_arena, v_s, tables,
+                                                  lens, n_valid,
+                                                  v._value)
+            out = decode_attention_paged_multi(q._value, k_arena, v_arena,
+                                               tables, lens,
+                                               kv_scales=(k_s, v_s))
+            new_kv = (k_arena, v_arena, k_s, v_s, tables)
+        else:
+            k_arena, v_arena, tables = kv
+            k_arena = paged_verify_scatter(k_arena, tables, lens, n_valid,
+                                           k._value)
+            v_arena = paged_verify_scatter(v_arena, tables, lens, n_valid,
+                                           v._value)
+            out = decode_attention_paged_multi(q._value, k_arena, v_arena,
+                                               tables, lens)
+            new_kv = (k_arena, v_arena, tables)
         out = self.out_proj(Tensor(out.reshape(b, c, -1)))
-        return out, (k_arena, v_arena, tables)
+        return out, new_kv
 
 
 class GPTMLP(nn.Layer):

@@ -164,13 +164,28 @@ class LlamaAttention(nn.Layer):
     def decode_step(self, x, kv, lens):
         """One cached decode step (the masked_multihead_attention role,
         GQA-aware).  x: [B, 1, hidden]; kv: (k_cache, v_cache) static
-        [B, S_max, H_kv*D] buffers, or the PAGED 3-tuple
-        (k_arena, v_arena, block_tables) used by the serving engine;
-        lens: [B] write slot / last valid index.  Returns
-        (out [B, 1, hidden], updated kv — same arity as given)."""
+        [B, S_max, H_kv*D] buffers, the PAGED 3-tuple
+        (k_arena, v_arena, block_tables) used by the serving engine, or
+        the quantized PAGED 5-tuple (k_codes, v_codes, k_scales,
+        v_scales, block_tables) of the int8 KV cache (quantize on
+        append, dequantize in the attention read); lens: [B] write slot
+        / last valid index.  Returns (out [B, 1, hidden], updated kv —
+        same arity as given)."""
         from ..core.tensor import Tensor
         q, k, v = self._qkv_rope(x, lens[:, None])
-        if len(kv) == 3:
+        if len(kv) == 5:
+            from .generation import paged_cache_scatter_q
+            from ..ops.pallas.decode_attention import decode_attention_paged
+            k_arena, v_arena, k_s, v_s, tables = kv
+            k_arena, k_s = paged_cache_scatter_q(k_arena, k_s, tables,
+                                                 lens, k._value[:, 0])
+            v_arena, v_s = paged_cache_scatter_q(v_arena, v_s, tables,
+                                                 lens, v._value[:, 0])
+            out = decode_attention_paged(q._value[:, 0], k_arena, v_arena,
+                                         tables, lens,
+                                         kv_scales=(k_s, v_s))
+            kv = (k_arena, v_arena, k_s, v_s, tables)
+        elif len(kv) == 3:
             from .generation import paged_cache_scatter
             from ..ops.pallas.decode_attention import decode_attention_paged
             k_arena, v_arena, tables = kv
@@ -200,21 +215,35 @@ class LlamaAttention(nn.Layer):
         and attention runs causally over the full written prefix —
         prefix-cached blocks included, which is how a prefix hit skips
         recomputing the shared leading blocks."""
-        from .generation import paged_chunk_scatter
+        from .generation import paged_chunk_scatter, paged_chunk_scatter_q
         from ..ops.pallas.decode_attention import paged_prefix_attention
         b, c, _ = x.shape
         pos = start + jnp.arange(c, dtype=jnp.int32)
         q, k, v = self._qkv_rope(x, pos[None, :])
-        k_arena, v_arena, tables = kv
-        k_arena = paged_chunk_scatter(k_arena, tables, start, n_valid,
-                                      k._value[0])
-        v_arena = paged_chunk_scatter(v_arena, tables, start, n_valid,
-                                      v._value[0])
-        out = paged_prefix_attention(q._value, k_arena, v_arena, tables,
-                                     start.reshape(1))
+        if len(kv) == 5:
+            k_arena, v_arena, k_s, v_s, tables = kv
+            k_arena, k_s = paged_chunk_scatter_q(k_arena, k_s, tables,
+                                                 start, n_valid,
+                                                 k._value[0])
+            v_arena, v_s = paged_chunk_scatter_q(v_arena, v_s, tables,
+                                                 start, n_valid,
+                                                 v._value[0])
+            out = paged_prefix_attention(q._value, k_arena, v_arena,
+                                         tables, start.reshape(1),
+                                         kv_scales=(k_s, v_s))
+            new_kv = (k_arena, v_arena, k_s, v_s, tables)
+        else:
+            k_arena, v_arena, tables = kv
+            k_arena = paged_chunk_scatter(k_arena, tables, start, n_valid,
+                                          k._value[0])
+            v_arena = paged_chunk_scatter(v_arena, tables, start, n_valid,
+                                          v._value[0])
+            out = paged_prefix_attention(q._value, k_arena, v_arena,
+                                         tables, start.reshape(1))
+            new_kv = (k_arena, v_arena, tables)
         from ..core.tensor import Tensor
         out = self.o_proj(Tensor(out.reshape(b, c, -1)))
-        return out, (k_arena, v_arena, tables)
+        return out, new_kv
 
     def verify_step(self, x, kv, lens, n_valid):
         """One speculative-verify step over the PAGED cache: x holds
@@ -225,22 +254,37 @@ class LlamaAttention(nn.Layer):
         (``paged_verify_scatter``), and attention is causal per query
         offset (``decode_attention_paged_multi``), so position c sees
         exactly the prefix sequential decode would have given it."""
-        from .generation import paged_verify_scatter
+        from .generation import (paged_verify_scatter,
+                                 paged_verify_scatter_q)
         from ..ops.pallas.decode_attention import \
             decode_attention_paged_multi
         b, c, _ = x.shape
         pos = lens[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
         q, k, v = self._qkv_rope(x, pos)
-        k_arena, v_arena, tables = kv
-        k_arena = paged_verify_scatter(k_arena, tables, lens, n_valid,
-                                       k._value)
-        v_arena = paged_verify_scatter(v_arena, tables, lens, n_valid,
-                                       v._value)
-        out = decode_attention_paged_multi(q._value, k_arena, v_arena,
-                                           tables, lens)
+        if len(kv) == 5:
+            k_arena, v_arena, k_s, v_s, tables = kv
+            k_arena, k_s = paged_verify_scatter_q(k_arena, k_s, tables,
+                                                  lens, n_valid,
+                                                  k._value)
+            v_arena, v_s = paged_verify_scatter_q(v_arena, v_s, tables,
+                                                  lens, n_valid,
+                                                  v._value)
+            out = decode_attention_paged_multi(q._value, k_arena, v_arena,
+                                               tables, lens,
+                                               kv_scales=(k_s, v_s))
+            new_kv = (k_arena, v_arena, k_s, v_s, tables)
+        else:
+            k_arena, v_arena, tables = kv
+            k_arena = paged_verify_scatter(k_arena, tables, lens, n_valid,
+                                           k._value)
+            v_arena = paged_verify_scatter(v_arena, tables, lens, n_valid,
+                                           v._value)
+            out = decode_attention_paged_multi(q._value, k_arena, v_arena,
+                                               tables, lens)
+            new_kv = (k_arena, v_arena, tables)
         from ..core.tensor import Tensor
         out = self.o_proj(Tensor(out.reshape(b, c, -1)))
-        return out, (k_arena, v_arena, tables)
+        return out, new_kv
 
 
 class LlamaMLP(nn.Layer):

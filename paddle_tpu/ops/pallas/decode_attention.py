@@ -80,6 +80,14 @@ def paged_arena_shape(num_blocks, num_kv_heads, block_len, head_dim):
     return (num_blocks, block_len, num_kv_heads, head_dim)
 
 
+def paged_scale_shape(num_blocks, num_kv_heads, block_len):
+    """At-rest shape of an int8 arena's parallel absmax-scale plane:
+    one f32 scale per block slot per kv head
+    (``models.generation.quantize_kv_heads``).  4/D of the code arena's
+    bytes — the price of exact, pure-scatter quantize-on-append."""
+    return (num_blocks, block_len, num_kv_heads)
+
+
 def paged_gather_view(arena, tables):
     """Dense per-sequence view of a paged arena: gather each row's
     blocks through its table and fold the block axis into a
@@ -93,12 +101,53 @@ def paged_gather_view(arena, tables):
     return g.reshape((b, nb * blk_len) + g.shape[3:])
 
 
+def paged_dequant_view(arena, scales, tables, out_dtype):
+    """Dense DEQUANTIZED per-sequence view of an int8 paged arena: the
+    gather of ``paged_gather_view`` with each entry's per-kv-head
+    absmax scale multiplied back in, cast to the compute dtype.  This
+    is the XLA fallback's read path for the quantized cache — one
+    definition of the dequant math shared by the gather fallback of
+    ``decode_attention_paged``, ``decode_attention_paged_multi`` and
+    ``paged_prefix_attention``, so CPU tier-1 tests exercise exactly
+    the arithmetic the in-kernel dequant mirrors."""
+    if jnp.dtype(arena.dtype) != jnp.dtype(jnp.int8):
+        raise TypeError(
+            "paged_dequant_view: kv_scales supplied for a "
+            f"{jnp.dtype(arena.dtype).name} arena — scale planes only "
+            "ride an int8 code arena (a float cache must pass "
+            "kv_scales=None)")
+    g = arena[tables].astype(jnp.float32)   # [B, max_blocks, L, ...]
+    s = scales[tables]                      # [B, max_blocks, L, H_kv]
+    if arena.ndim == 3:
+        d = arena.shape[2] // scales.shape[2]
+        s = jnp.repeat(s, d, axis=-1)       # heads-in-lanes expansion
+    else:
+        s = s[..., None]
+    deq = (g * s).astype(out_dtype)
+    b, nb, blk_len = deq.shape[:3]
+    return deq.reshape((b, nb * blk_len) + deq.shape[3:])
+
+
 def decode_attn_sig(b, hkv, g, s, d, dtype):
     import numpy as np
     return f"{b}x{hkv}x{g}x{s}x{d}/{np.dtype(dtype)}"
 
 
-def _gate_shared(q4, cache, s, align_ok, align_reason, q_rows=_GPAD):
+_MIXED_DTYPE_ALLOWLIST = frozenset({
+    # (q dtype, cache dtype) pairs with a TESTED in-kernel conversion,
+    # beyond exact dtype equality: only the int8 quantized cache read
+    # by a float compute dtype, and only when the caller supplies the
+    # parallel scale arenas (the ``has_scales`` gate argument) — the
+    # kernels dequantize codes * scales to the compute dtype right
+    # before each dot.  Any other mix stays on the XLA fallback, which
+    # casts explicitly (fp32 logits, V cast at the PV dot).
+    (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.int8)),
+    (jnp.dtype(jnp.float32), jnp.dtype(jnp.int8)),
+})
+
+
+def _gate_shared(q4, cache, s, align_ok, align_reason, q_rows=_GPAD,
+                 has_scales=False):
     """The gate checks common to the dense and paged dispatchers —
     ONE implementation so the two routes cannot silently diverge.
     ``s`` is the staged dense-row count; ``align_ok``/``align_reason``
@@ -106,8 +155,14 @@ def _gate_shared(q4, cache, s, align_ok, align_reason, q_rows=_GPAD):
     the check order; ``q_rows`` is the per-head q-row block the caller
     stages (``_GPAD`` for the single-token kernels, a multiple of it
     for the K-wide verify kernel) and scales the logits-scratch VMEM
-    estimate.  Returns (use_pallas, reason-or-None); the caller maps
-    None to its accept reason."""
+    estimate; ``has_scales`` says the caller carries the int8 cache's
+    scale arenas — the requirement for the mixed (float q, int8 cache)
+    pairs of ``_MIXED_DTYPE_ALLOWLIST`` (every other q/cache dtype mix
+    rejects as ``dtype_mismatch``; an int8 pairing that fails the
+    packed-geometry check rejects as ``int8_geom`` so the route
+    counter separates it from bf16 ``geometry``).  Returns
+    (use_pallas, reason-or-None); the caller maps None to its accept
+    reason."""
     from ...core.flags import flag
     if not flag("use_decode_attention_kernel"):
         return False, "flag_disabled"
@@ -115,16 +170,23 @@ def _gate_shared(q4, cache, s, align_ok, align_reason, q_rows=_GPAD):
         return False, "pallas_unavailable"
     if cache.ndim != 3:
         return False, "unpacked_cache"
+    int8_pair = False
     if jnp.dtype(q4.dtype) != jnp.dtype(cache.dtype):
-        # mixed-precision serving configs (bf16 compute x f32/int8
-        # cache) would route an untested mixed-dtype dot into the
-        # Mosaic kernel; keep them on the XLA fallback, which casts
-        # explicitly (fp32 logits, V cast at the PV dot)
-        return False, "dtype_mismatch"
+        pair = (jnp.dtype(q4.dtype), jnp.dtype(cache.dtype))
+        if not (has_scales and pair in _MIXED_DTYPE_ALLOWLIST):
+            return False, "dtype_mismatch"
+        int8_pair = True
+    elif has_scales:
+        # equal q/cache dtypes with scale arenas riding along: the
+        # int8-kernel selection downstream keys on scale presence, so
+        # letting a FLOAT cache through here would dequant-multiply
+        # real K/V in the _q kernels — reject instead of routing a
+        # kernel whose operand contract the caller violates
+        return False, "scales_mismatch"
     b, hkv, g, d = q4.shape
     w = cache.shape[2]
     if not packed_ok(hkv, d) or w != hkv * d:
-        return False, "geometry"
+        return False, "int8_geom" if int8_pair else "geometry"
     if g > _GPAD:        # q_cat blocks hold at most 8 query heads/KV head
         return False, "group_too_wide"
     if not align_ok:
@@ -132,7 +194,10 @@ def _gate_shared(q4, cache, s, align_ok, align_reason, q_rows=_GPAD):
     itemsize = jnp.dtype(cache.dtype).itemsize
     gw = max(_LANES, d)
     lg_bytes = (w // gw) * (gw // d) * q_rows * s * 4
-    if 2 * s * w * itemsize + lg_bytes > _VMEM_BUDGET:
+    vmem = 2 * s * w * itemsize + lg_bytes
+    if int8_pair:
+        vmem += 2 * s * hkv * 4      # staged f32 scale planes
+    if vmem > _VMEM_BUDGET:
         return False, "vmem_budget"
     return True, None
 
@@ -177,23 +242,30 @@ def should_use_pallas(q4, cache) -> bool:
     return use
 
 
-def _route_decision_paged(q4, arena, tables):
+def _route_decision_paged(q4, arena, tables, kv_scales=None):
     """(use_pallas, reason) for the PAGED decode-attention gate: the
     shared gate (``_gate_shared``) evaluated on the arena geometry,
     with the paged-only sublane rule in place of ``seq_align`` — the
     staged chunk unit is a whole block, so ``block_len`` must sit on
     the (8, 128) sublane tile (``paged_block_len``).  Accepts route as
     ``paged_ok`` so the route counter separates paged-kernel traffic
-    from dense ``ok``."""
+    from dense ``ok`` — or as ``paged_int8_ok`` when the caller passes
+    the quantized cache's scale arenas (``kv_scales``), the explicitly
+    allowlisted (float q, int8 cache + scales) pairing that runs the
+    dequant-in-kernel variant."""
     blk_len = arena.shape[1]
     s = tables.shape[1] * blk_len      # staged dense rows
     use, reason = _gate_shared(q4, arena, s, blk_len % 8 == 0,
-                               "paged_block_len")
-    return use, reason or "paged_ok"
+                               "paged_block_len",
+                               has_scales=kv_scales is not None)
+    if reason is not None:
+        return use, reason
+    return use, ("paged_int8_ok" if kv_scales is not None
+                 else "paged_ok")
 
 
-def should_use_pallas_paged(q4, arena, tables) -> bool:
-    use, reason = _route_decision_paged(q4, arena, tables)
+def should_use_pallas_paged(q4, arena, tables, kv_scales=None) -> bool:
+    use, reason = _route_decision_paged(q4, arena, tables, kv_scales)
     _route_counter().inc(decision="pallas" if use else "xla",
                          reason=reason)
     return use
@@ -202,7 +274,7 @@ def should_use_pallas_paged(q4, arena, tables) -> bool:
 _QROWS_MAX = 4 * _GPAD      # per-head q-row cap of the K-wide kernel
 
 
-def _route_decision_paged_multi(q5, arena, tables):
+def _route_decision_paged_multi(q5, arena, tables, kv_scales=None):
     """(use_pallas, reason) for the K-WIDE paged verify gate
     (``decode_attention_paged_multi``): the shared gate evaluated on
     the arena geometry with the paged sublane rule, plus the verify
@@ -212,7 +284,8 @@ def _route_decision_paged_multi(q5, arena, tables):
     ``_QROWS_MAX`` rows would blow the logits scratch for no win
     (reason ``query_rows``).  Accepts route as ``paged_multi_ok`` so
     the route counter separates verify traffic from single-token
-    ``paged_ok``."""
+    ``paged_ok`` — or as ``paged_multi_int8_ok`` for the allowlisted
+    (float q, int8 cache + scales) pairing."""
     b, cq, hkv, g, d = q5.shape
     qr = -(-(g * cq) // _GPAD) * _GPAD
     if qr > _QROWS_MAX:
@@ -220,12 +293,18 @@ def _route_decision_paged_multi(q5, arena, tables):
     blk_len = arena.shape[1]
     s = tables.shape[1] * blk_len      # staged dense rows
     use, reason = _gate_shared(q5[:, 0], arena, s, blk_len % 8 == 0,
-                               "paged_block_len", q_rows=qr)
-    return use, reason or "paged_multi_ok"
+                               "paged_block_len", q_rows=qr,
+                               has_scales=kv_scales is not None)
+    if reason is not None:
+        return use, reason
+    return use, ("paged_multi_int8_ok" if kv_scales is not None
+                 else "paged_multi_ok")
 
 
-def should_use_pallas_paged_multi(q5, arena, tables) -> bool:
-    use, reason = _route_decision_paged_multi(q5, arena, tables)
+def should_use_pallas_paged_multi(q5, arena, tables,
+                                  kv_scales=None) -> bool:
+    use, reason = _route_decision_paged_multi(q5, arena, tables,
+                                              kv_scales)
     _route_counter().inc(decision="pallas" if use else "xla",
                          reason=reason)
     return use
@@ -335,9 +414,22 @@ def _paged_kernel(lens_ref, tbl_ref, qcat_ref, k_hbm, v_hbm, o_ref,
     the indirection is resolved at DMA-issue time from the scalar-
     prefetched table, so traffic is still O(valid prefix) and the
     compute phases see the same contiguous [rows, W] staging buffer.
-    The scratch-reuse invariant of ``_kernel`` (vbuf zeroed at program
-    0 only, stale K masked to -inf before exp, sequential grid) carries
-    over unchanged."""
+
+    Scratch-reuse invariant (same as ``_kernel``, stated in full
+    because it is load-bearing here too): VMEM scratch is SHARED across
+    the grid and the table-indirected DMAs refresh only blocks of the
+    valid prefix — ``vbuf`` is zeroed at program 0 ONLY, ``kbuf`` is
+    NEVER zeroed, so past this row's prefix both buffers hold the
+    previous program's blocks (or, at program 0, zeros/undefined).
+    Correctness rests on (a) the masked-logit flush: every logit at
+    row > length is set to -1e30 before exp, so stale K contributes
+    weight exp(-inf) = 0; (b) vbuf's one-time memset: a zero weight
+    never meets an undefined NaN bit pattern in V (0 * NaN = NaN;
+    stale-but-real V from earlier programs is finite and safe under
+    (a)).  Both depend on the grid executing SEQUENTIALLY (the
+    Pallas-TPU 'arbitrary' grid order) — declaring the batch dimension
+    'parallel' would race programs on the shared scratch and break the
+    invariant."""
     bi = pl.program_id(0)
     length = lens_ref[bi]                     # last valid slot index
     n_blk = length // block_len + 1
@@ -403,6 +495,115 @@ def _paged_kernel(lens_ref, tbl_ref, qcat_ref, k_hbm, v_hbm, o_ref,
                            ).astype(out_dtype)
 
 
+def _paged_kernel_q(lens_ref, tbl_ref, qcat_ref, k_hbm, v_hbm,
+                    ks_hbm, vs_hbm, o_ref,
+                    kbuf, vbuf, ksbuf, vsbuf, lg_ref,
+                    ksem, vsem, kssem, vssem,
+                    *, block_len, n_blocks_max, scale, out_dtype, hkv,
+                    g, d, gw, hp, ng):
+    """INT8 variant of ``_paged_kernel`` — the whole point of the
+    quantized cache: each staged block DMAs int8 K/V codes PLUS the
+    [L, H_kv] f32 scale plane, so HBM traffic per cache row drops from
+    2 bytes/lane (bf16) to 1 byte/lane + 4/D scale bytes, while the
+    MXU still sees the compute dtype — codes are dequantized in VMEM
+    (``codes * scales``, scales expanded head->lanes by the constant
+    0/1 matrix ``expand`` [hp, gw]) right before each dot.  The
+    arithmetic mirrors ``paged_dequant_view`` + the XLA fallback, so
+    interpret-mode parity holds against the gather-based path.
+
+    Scratch-reuse invariant, adjusted for int8: the code buffers need
+    NO memset at all — an int8 bit pattern is always a finite value,
+    so (b) of ``_kernel``'s invariant (no NaN may meet a zero weight)
+    is vacuous for them — but ``vsbuf`` takes over vbuf's program-0
+    memset: an undefined f32 SCALE is the one place a NaN could enter
+    the PV dot (0 weight * (code * NaN scale) = NaN).  ``ksbuf`` is
+    never zeroed, like kbuf: a NaN K scale only produces NaN logits at
+    rows past the prefix, which the masked-logit flush replaces with
+    -1e30 before exp.  All of it still rests on the sequential
+    'arbitrary' grid order."""
+    bi = pl.program_id(0)
+    length = lens_ref[bi]                     # last valid slot index
+    n_blk = length // block_len + 1
+    rows = n_blocks_max * block_len
+
+    @pl.when(bi == 0)
+    def _():
+        vsbuf[...] = jnp.zeros_like(vsbuf)
+
+    for c in range(n_blocks_max):             # static unroll, guarded
+        @pl.when(c < n_blk)
+        def _(c=c):
+            blk = tbl_ref[bi, c]
+            sl = pl.ds(c * block_len, block_len)
+            pltpu.make_async_copy(
+                k_hbm.at[blk], kbuf.at[sl, :], ksem.at[c]).start()
+            pltpu.make_async_copy(
+                v_hbm.at[blk], vbuf.at[sl, :], vsem.at[c]).start()
+            pltpu.make_async_copy(
+                ks_hbm.at[blk], ksbuf.at[sl, :], kssem.at[c]).start()
+            pltpu.make_async_copy(
+                vs_hbm.at[blk], vsbuf.at[sl, :], vssem.at[c]).start()
+
+    for c in range(n_blocks_max):
+        @pl.when(c < n_blk)
+        def _(c=c):
+            blk = tbl_ref[bi, c]
+            sl = pl.ds(c * block_len, block_len)
+            pltpu.make_async_copy(
+                k_hbm.at[blk], kbuf.at[sl, :], ksem.at[c]).wait()
+            pltpu.make_async_copy(
+                ks_hbm.at[blk], ksbuf.at[sl, :], kssem.at[c]).wait()
+
+    cdt = qcat_ref.dtype
+    expand = _scale_expand(hp, gw, d)
+    for p in range(ng):
+        ks = jax.lax.dot_general(
+            ksbuf[:, p * hp:(p + 1) * hp], expand,
+            (((1,), (0,)), ((), ())))                     # [rows, gw]
+        kd = (kbuf[:, p * gw:(p + 1) * gw].astype(jnp.float32)
+              * ks).astype(cdt)
+        lg_ref[p] = jax.lax.dot_general(
+            qcat_ref[0, p], kd,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [hp*8, rows]
+
+    sub = jax.lax.broadcasted_iota(jnp.int32, (ng, hp * _GPAD, rows), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (ng, hp * _GPAD, rows), 2)
+    keep = (row <= length) & (jax.lax.rem(sub, _GPAD) < g)
+    lg = jnp.where(keep, lg_ref[...], _NEG_INF)
+    m = jnp.max(lg, axis=-1, keepdims=True)
+    p_ = jnp.exp(lg - m)
+    l = jnp.sum(p_, axis=-1, keepdims=True)    # [ng, hp*8, 1]
+    lg_ref[...] = p_
+
+    for c in range(n_blocks_max):
+        @pl.when(c < n_blk)
+        def _(c=c):
+            blk = tbl_ref[bi, c]
+            sl = pl.ds(c * block_len, block_len)
+            pltpu.make_async_copy(
+                v_hbm.at[blk], vbuf.at[sl, :], vsem.at[c]).wait()
+            pltpu.make_async_copy(
+                vs_hbm.at[blk], vsbuf.at[sl, :], vssem.at[c]).wait()
+
+    for p in range(ng):
+        vs = jax.lax.dot_general(
+            vsbuf[:, p * hp:(p + 1) * hp], expand,
+            (((1,), (0,)), ((), ())))                     # [rows, gw]
+        vd = (vbuf[:, p * gw:(p + 1) * gw].astype(jnp.float32)
+              * vs).astype(cdt)
+        pv_w = jax.lax.dot_general(
+            lg_ref[p].astype(cdt), vd,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [hp*8, gw]
+        for j in range(hp):
+            h = p * hp + j
+            o_ref[0, h] = (pv_w[j * _GPAD:j * _GPAD + g,
+                                j * d:(j + 1) * d]
+                           / l[p, j * _GPAD:j * _GPAD + g]
+                           ).astype(out_dtype)
+
+
 def _paged_multi_kernel(lens_ref, tbl_ref, qcat_ref, k_hbm, v_hbm, o_ref,
                         kbuf, vbuf, lg_ref, ksem, vsem,
                         *, block_len, n_blocks_max, cq, qr, scale,
@@ -419,9 +620,17 @@ def _paged_multi_kernel(lens_ref, tbl_ref, qcat_ref, k_hbm, v_hbm, o_ref,
     equivalence contract of the verifier).  DMA traffic is still one
     sweep of the valid prefix (now ``lens + cq - 1`` rows) — the whole
     point: K+1 positions scored for one cache sweep plus one weight
-    sweep.  The scratch-reuse invariant of ``_kernel`` (vbuf zeroed at
-    program 0 only, stale K masked to -1e30 before exp, sequential
-    grid) carries over unchanged."""
+    sweep.
+
+    Scratch-reuse invariant (same as ``_kernel``, stated in full): the
+    VMEM scratch is SHARED across the sequentially-executed grid —
+    ``vbuf`` is zeroed at program 0 ONLY, ``kbuf`` is NEVER zeroed.
+    The masked-logit flush (every logit past a query row's causal
+    frontier set to -1e30 before exp) hides stale K, and the one-time
+    vbuf memset guarantees a zero weight never multiplies an undefined
+    NaN bit pattern in V; both properties require the Pallas-TPU
+    'arbitrary' (sequential) grid order — a 'parallel' batch dimension
+    would race programs on the shared scratch."""
     bi = pl.program_id(0)
     length = lens_ref[bi]              # first query's global slot
     n_blk = jnp.minimum((length + cq - 1) // block_len + 1, n_blocks_max)
@@ -490,6 +699,119 @@ def _paged_multi_kernel(lens_ref, tbl_ref, qcat_ref, k_hbm, v_hbm, o_ref,
                            ).astype(out_dtype)
 
 
+def _paged_multi_kernel_q(lens_ref, tbl_ref, qcat_ref, k_hbm, v_hbm,
+                          ks_hbm, vs_hbm, o_ref,
+                          kbuf, vbuf, ksbuf, vsbuf, lg_ref,
+                          ksem, vsem, kssem, vssem,
+                          *, block_len, n_blocks_max, cq, qr, scale,
+                          out_dtype, g, d, gw, hp, ng):
+    """INT8 variant of ``_paged_multi_kernel`` (the speculative
+    verifier's attention over the quantized cache): int8 K/V codes +
+    [L, H_kv] f32 scale planes are DMA'd per staged block and
+    dequantized in VMEM right before each dot, exactly as in
+    ``_paged_kernel_q``.  The per-row causal frontier masking of the
+    bf16 kernel is unchanged.  Scratch-reuse invariant as adjusted for
+    int8 in ``_paged_kernel_q``: code buffers need no memset (int8 is
+    always finite), ``vsbuf`` takes the program-0 memset (an undefined
+    f32 scale is the only NaN entry point into the PV dot), ``ksbuf``
+    is never zeroed (NaN K scales only reach masked-and-flushed
+    logits), all under the sequential 'arbitrary' grid."""
+    bi = pl.program_id(0)
+    length = lens_ref[bi]              # first query's global slot
+    n_blk = jnp.minimum((length + cq - 1) // block_len + 1, n_blocks_max)
+    rows = n_blocks_max * block_len
+
+    @pl.when(bi == 0)
+    def _():
+        vsbuf[...] = jnp.zeros_like(vsbuf)
+
+    for c in range(n_blocks_max):             # static unroll, guarded
+        @pl.when(c < n_blk)
+        def _(c=c):
+            blk = tbl_ref[bi, c]
+            sl = pl.ds(c * block_len, block_len)
+            pltpu.make_async_copy(
+                k_hbm.at[blk], kbuf.at[sl, :], ksem.at[c]).start()
+            pltpu.make_async_copy(
+                v_hbm.at[blk], vbuf.at[sl, :], vsem.at[c]).start()
+            pltpu.make_async_copy(
+                ks_hbm.at[blk], ksbuf.at[sl, :], kssem.at[c]).start()
+            pltpu.make_async_copy(
+                vs_hbm.at[blk], vsbuf.at[sl, :], vssem.at[c]).start()
+
+    for c in range(n_blocks_max):
+        @pl.when(c < n_blk)
+        def _(c=c):
+            blk = tbl_ref[bi, c]
+            sl = pl.ds(c * block_len, block_len)
+            pltpu.make_async_copy(
+                k_hbm.at[blk], kbuf.at[sl, :], ksem.at[c]).wait()
+            pltpu.make_async_copy(
+                ks_hbm.at[blk], ksbuf.at[sl, :], kssem.at[c]).wait()
+
+    cdt = qcat_ref.dtype
+    expand = _scale_expand(hp, gw, d)
+    for p in range(ng):
+        ks = jax.lax.dot_general(
+            ksbuf[:, p * hp:(p + 1) * hp], expand,
+            (((1,), (0,)), ((), ())))                     # [rows, gw]
+        kd = (kbuf[:, p * gw:(p + 1) * gw].astype(jnp.float32)
+              * ks).astype(cdt)
+        lg_ref[p] = jax.lax.dot_general(
+            qcat_ref[0, p], kd,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [hp*qr, rows]
+
+    sub = jax.lax.broadcasted_iota(jnp.int32, (ng, hp * qr, rows), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (ng, hp * qr, rows), 2)
+    qsub = jax.lax.rem(sub, qr)
+    keep = (row <= length + qsub // g) & (qsub < g * cq)
+    lg = jnp.where(keep, lg_ref[...], _NEG_INF)
+    m = jnp.max(lg, axis=-1, keepdims=True)
+    p_ = jnp.exp(lg - m)
+    l = jnp.sum(p_, axis=-1, keepdims=True)    # [ng, hp*qr, 1]
+    lg_ref[...] = p_
+
+    for c in range(n_blocks_max):
+        @pl.when(c < n_blk)
+        def _(c=c):
+            blk = tbl_ref[bi, c]
+            sl = pl.ds(c * block_len, block_len)
+            pltpu.make_async_copy(
+                v_hbm.at[blk], vbuf.at[sl, :], vsem.at[c]).wait()
+            pltpu.make_async_copy(
+                vs_hbm.at[blk], vsbuf.at[sl, :], vssem.at[c]).wait()
+
+    for p in range(ng):
+        vs = jax.lax.dot_general(
+            vsbuf[:, p * hp:(p + 1) * hp], expand,
+            (((1,), (0,)), ((), ())))                     # [rows, gw]
+        vd = (vbuf[:, p * gw:(p + 1) * gw].astype(jnp.float32)
+              * vs).astype(cdt)
+        pv_w = jax.lax.dot_general(
+            lg_ref[p].astype(cdt), vd,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [hp*qr, gw]
+        for j in range(hp):
+            h = p * hp + j
+            o_ref[0, h] = (pv_w[j * qr:j * qr + cq * g,
+                                j * d:(j + 1) * d]
+                           / l[p, j * qr:j * qr + cq * g]
+                           ).astype(out_dtype)
+
+
+def _scale_expand(hp, gw, d):
+    """The head->lanes scale-expansion matrix of the int8 kernels: a
+    [hp, gw] 0/1 matrix with row j lighting lanes [j*d, (j+1)*d) —
+    ``scales[rows, hp] @ expand`` broadcasts each head's scale across
+    its D lanes as one small matmul (robust on the MXU, no in-kernel
+    gather/repeat).  Built from iota INSIDE the kernel body (Pallas
+    rejects captured array constants); the compiler folds it."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, (hp, gw), 1)
+    rowj = jax.lax.broadcasted_iota(jnp.int32, (hp, gw), 0)
+    return (lane // d == rowj).astype(jnp.float32)
+
+
 def _build_qcat(q4, hp, ng, gw):
     """Block-diagonal q: [B, H_kv, G, D] -> [B, ng, hp*8, gw] where
     group p, block j holds head p*hp+j's q in lane range [j*D, (j+1)*D)
@@ -550,6 +872,43 @@ def _decode_attention_pallas(q4, k_cache, v_cache, lens, chunk=None):
     )(lens.astype(jnp.int32), qcat, k_cache, v_cache)
 
 
+def _paged_dispatch(kernel, qcat, operands, tables, lens, *, b, hkv, d,
+                    q_rows, out_rows, gw, ng, s, n_blocks_max):
+    """Shared grid-spec + dispatch body of the four paged wrappers
+    (single/K-wide x float/int8-quantized) — ONE place for the BlockSpec
+    geometry so a fix never has to land four times.  ``operands`` is
+    the HBM operand tuple after the prefetched scalars and q: (k, v)
+    arenas, plus the two f32 scale planes for the quantized kernels.
+    Each operand gets an ANY BlockSpec, a VMEM landing buffer ((s, W)
+    in the arena dtype for the code arenas, (s, H_kv) f32 for scale
+    planes) and an n_blocks_max-deep DMA semaphore array, in operand
+    order — matching the scratch signature of every paged kernel."""
+    w = operands[0].shape[2]
+    land = [pltpu.VMEM((s, w), operands[0].dtype),
+            pltpu.VMEM((s, w), operands[1].dtype)]
+    land += [pltpu.VMEM((s, hkv), jnp.float32) for _ in operands[2:]]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, ng, q_rows, gw),
+                               lambda bi, lens_p, tbl_p: (bi, 0, 0, 0))]
+        + [pl.BlockSpec(memory_space=pltpu.ANY) for _ in operands],
+        out_specs=pl.BlockSpec((1, hkv, out_rows, d),
+                               lambda bi, lens_p, tbl_p: (bi, 0, 0, 0)),
+        scratch_shapes=land
+        + [pltpu.VMEM((ng, q_rows, s), jnp.float32)]
+        + [pltpu.SemaphoreType.DMA((n_blocks_max,)) for _ in operands],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, out_rows, d),
+                                       qcat.dtype),
+        interpret=not on_tpu(),
+    )(lens.astype(jnp.int32), tables.astype(jnp.int32), qcat,
+      *operands)
+
+
 def _decode_attention_pallas_paged(q4, k_arena, v_arena, tables, lens):
     """q4: [B, H_kv, G, D]; arenas packed [NB+1, L, H_kv*D] (last row =
     trash block); tables: [B, max_blocks] int32 arena row indices."""
@@ -566,32 +925,34 @@ def _decode_attention_pallas_paged(q4, k_arena, v_arena, tables, lens):
         scale=1.0 / (d ** 0.5), out_dtype=q4.dtype, hkv=hkv, g=g, d=d,
         gw=gw, hp=hp, ng=ng)
     qcat = _build_qcat(q4, hp, ng, gw)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b,),
-        in_specs=[
-            pl.BlockSpec((1, ng, hp * _GPAD, gw),
-                         lambda bi, lens_p, tbl_p: (bi, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
-        out_specs=pl.BlockSpec((1, hkv, g, d),
-                               lambda bi, lens_p, tbl_p: (bi, 0, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((s, w), k_arena.dtype),
-            pltpu.VMEM((s, w), v_arena.dtype),
-            pltpu.VMEM((ng, hp * _GPAD, s), jnp.float32),
-            pltpu.SemaphoreType.DMA((n_blocks_max,)),
-            pltpu.SemaphoreType.DMA((n_blocks_max,)),
-        ],
-    )
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q4.dtype),
-        interpret=not on_tpu(),
-    )(lens.astype(jnp.int32), tables.astype(jnp.int32), qcat,
-      k_arena, v_arena)
+    return _paged_dispatch(
+        kernel, qcat, (k_arena, v_arena), tables, lens, b=b, hkv=hkv,
+        d=d, q_rows=hp * _GPAD, out_rows=g, gw=gw, ng=ng, s=s,
+        n_blocks_max=n_blocks_max)
+
+
+def _decode_attention_pallas_paged_q(q4, k_arena, v_arena, k_scales,
+                                     v_scales, tables, lens):
+    """q4: [B, H_kv, G, D] float; arenas packed [NB+1, L, H_kv*D] int8
+    codes (last row = trash block); k/v_scales: [NB+1, L, H_kv] f32
+    per-entry per-head absmax scales; tables: [B, max_blocks] int32."""
+    b, hkv, g, d = q4.shape
+    blk_len = k_arena.shape[1]
+    w = k_arena.shape[2]
+    n_blocks_max = tables.shape[1]
+    s = n_blocks_max * blk_len
+    gw = max(_LANES, d)
+    hp = gw // d
+    ng = w // gw
+    kernel = functools.partial(
+        _paged_kernel_q, block_len=blk_len, n_blocks_max=n_blocks_max,
+        scale=1.0 / (d ** 0.5), out_dtype=q4.dtype, hkv=hkv, g=g, d=d,
+        gw=gw, hp=hp, ng=ng)
+    qcat = _build_qcat(q4, hp, ng, gw)
+    return _paged_dispatch(
+        kernel, qcat, (k_arena, v_arena, k_scales, v_scales), tables,
+        lens, b=b, hkv=hkv, d=d, q_rows=hp * _GPAD, out_rows=g, gw=gw,
+        ng=ng, s=s, n_blocks_max=n_blocks_max)
 
 
 def _build_qcat_multi(q5, hp, ng, gw, qr):
@@ -628,32 +989,39 @@ def _decode_attention_pallas_paged_multi(q5, k_arena, v_arena, tables,
         scale=1.0 / (d ** 0.5), out_dtype=q5.dtype, g=g, d=d,
         gw=gw, hp=hp, ng=ng)
     qcat = _build_qcat_multi(q5, hp, ng, gw, qr)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b,),
-        in_specs=[
-            pl.BlockSpec((1, ng, hp * qr, gw),
-                         lambda bi, lens_p, tbl_p: (bi, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
-        out_specs=pl.BlockSpec((1, hkv, cq * g, d),
-                               lambda bi, lens_p, tbl_p: (bi, 0, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((s, w), k_arena.dtype),
-            pltpu.VMEM((s, w), v_arena.dtype),
-            pltpu.VMEM((ng, hp * qr, s), jnp.float32),
-            pltpu.SemaphoreType.DMA((n_blocks_max,)),
-            pltpu.SemaphoreType.DMA((n_blocks_max,)),
-        ],
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, cq * g, d), q5.dtype),
-        interpret=not on_tpu(),
-    )(lens.astype(jnp.int32), tables.astype(jnp.int32), qcat,
-      k_arena, v_arena)
+    out = _paged_dispatch(
+        kernel, qcat, (k_arena, v_arena), tables, lens, b=b, hkv=hkv,
+        d=d, q_rows=hp * qr, out_rows=cq * g, gw=gw, ng=ng, s=s,
+        n_blocks_max=n_blocks_max)
+    # head-major rows c*g+gi back to [B, C, H_kv, G, D]
+    return jnp.transpose(out.reshape(b, hkv, cq, g, d), (0, 2, 1, 3, 4))
+
+
+def _decode_attention_pallas_paged_multi_q(q5, k_arena, v_arena,
+                                           k_scales, v_scales, tables,
+                                           lens):
+    """q5: [B, C, H_kv, G, D] float; int8 code arenas + f32 scale
+    arenas as ``_decode_attention_pallas_paged_q``; lens: [B] global
+    position of the FIRST query.  Returns [B, C, H_kv, G, D]."""
+    b, cq, hkv, g, d = q5.shape
+    blk_len = k_arena.shape[1]
+    w = k_arena.shape[2]
+    n_blocks_max = tables.shape[1]
+    s = n_blocks_max * blk_len
+    gw = max(_LANES, d)
+    hp = gw // d
+    ng = w // gw
+    qr = -(-(g * cq) // _GPAD) * _GPAD
+    kernel = functools.partial(
+        _paged_multi_kernel_q, block_len=blk_len,
+        n_blocks_max=n_blocks_max, cq=cq, qr=qr,
+        scale=1.0 / (d ** 0.5), out_dtype=q5.dtype, g=g, d=d,
+        gw=gw, hp=hp, ng=ng)
+    qcat = _build_qcat_multi(q5, hp, ng, gw, qr)
+    out = _paged_dispatch(
+        kernel, qcat, (k_arena, v_arena, k_scales, v_scales), tables,
+        lens, b=b, hkv=hkv, d=d, q_rows=hp * qr, out_rows=cq * g,
+        gw=gw, ng=ng, s=s, n_blocks_max=n_blocks_max)
     # head-major rows c*g+gi back to [B, C, H_kv, G, D]
     return jnp.transpose(out.reshape(b, hkv, cq, g, d), (0, 2, 1, 3, 4))
 
@@ -697,28 +1065,43 @@ def decode_attention(q, k_cache, v_cache, lens):
     return out.reshape(b, hq * d)
 
 
-def decode_attention_paged(q, k_arena, v_arena, tables, lens):
+def decode_attention_paged(q, k_arena, v_arena, tables, lens,
+                           kv_scales=None):
     """One-token GQA attention over a PAGED cache prefix.
 
     q: [B, H_q, D]; arenas: ``paged_arena_shape`` pools (packed
     [NB+1, L, H_kv*D] or unpacked [NB+1, L, H_kv, D], last row = trash
     block); tables: [B, max_blocks] int32 arena row per logical block;
-    lens: [B] = index of the LAST valid slot.  On TPU (and when the
-    block geometry passes ``_route_decision_paged``) this runs the
+    lens: [B] = index of the LAST valid slot; kv_scales: None for a
+    float cache, or the int8 cache's ``(k_scales, v_scales)`` pair of
+    [NB+1, L, H_kv] f32 absmax planes.  On TPU (and when the block
+    geometry passes ``_route_decision_paged``) this runs the
     block-table Pallas kernel — DMA indirection through the
-    scalar-prefetched table, no dense copy of the pool; otherwise the
-    gather-based XLA path materializes each row's dense view
-    (``paged_gather_view``) and reuses the reference math.  Returns
-    [B, H_q * D] in q.dtype.
+    scalar-prefetched table, no dense copy of the pool; the int8
+    pairing routes the dequant-in-kernel variant (reason
+    ``paged_int8_ok``).  Otherwise the gather-based XLA path
+    materializes each row's dense view (``paged_gather_view``, or the
+    dequantized ``paged_dequant_view`` for int8) and reuses the
+    reference math.  Returns [B, H_q * D] in q.dtype.
     """
     b, hq, d = q.shape
     hkv = (k_arena.shape[2] // d if k_arena.ndim == 3
            else k_arena.shape[2])
     g = hq // hkv
     q4 = q.reshape(b, hkv, g, d)
-    if should_use_pallas_paged(q4, k_arena, tables):
-        out = _decode_attention_pallas_paged(q4, k_arena, v_arena,
-                                             tables, lens)
+    if should_use_pallas_paged(q4, k_arena, tables, kv_scales):
+        if kv_scales is not None:
+            out = _decode_attention_pallas_paged_q(
+                q4, k_arena, v_arena, kv_scales[0], kv_scales[1],
+                tables, lens)
+        else:
+            out = _decode_attention_pallas_paged(q4, k_arena, v_arena,
+                                                 tables, lens)
+    elif kv_scales is not None:
+        out = _decode_attention_xla(
+            q4, paged_dequant_view(k_arena, kv_scales[0], tables, q.dtype),
+            paged_dequant_view(v_arena, kv_scales[1], tables, q.dtype),
+            lens)
     else:
         out = _decode_attention_xla(q4, paged_gather_view(k_arena, tables),
                                     paged_gather_view(v_arena, tables),
@@ -726,7 +1109,8 @@ def decode_attention_paged(q, k_arena, v_arena, tables, lens):
     return out.reshape(b, hq * d)
 
 
-def paged_prefix_attention(q, k_arena, v_arena, tables, start):
+def paged_prefix_attention(q, k_arena, v_arena, tables, start,
+                           kv_scales=None):
     """Chunked-prefill attention over the paged cache: C chunk queries
     at global positions ``start + row`` attend causally over everything
     already written through the block table (prefix-cached blocks,
@@ -743,11 +1127,14 @@ def paged_prefix_attention(q, k_arena, v_arena, tables, start):
     [B, C, H_q, D] in q.dtype; rows past the prompt's true length
     compute garbage that the caller masks (their K/V writes were
     trash-routed, so the garbage never enters any other row's
-    prefix)."""
-    return _paged_multi_xla(q, k_arena, v_arena, tables, start)
+    prefix).  ``kv_scales`` selects the int8 cache's dequantizing
+    gather view, same contract as ``decode_attention_paged``."""
+    return _paged_multi_xla(q, k_arena, v_arena, tables, start,
+                            kv_scales)
 
 
-def decode_attention_paged_multi(q, k_arena, v_arena, tables, lens):
+def decode_attention_paged_multi(q, k_arena, v_arena, tables, lens,
+                                 kv_scales=None):
     """K-wide GQA attention over a PAGED cache prefix — the speculative
     -decoding verify forward's attention (one target forward scores the
     just-written token plus K draft candidates).
@@ -762,30 +1149,41 @@ def decode_attention_paged_multi(q, k_arena, v_arena, tables, lens):
     prefix acceptance exactly greedy-equivalent.  Unlike chunk prefill
     this path IS cache-sweep-bound (C is small, the prefix is long), so
     it gates into the K-wide paged Pallas kernel
-    (``_route_decision_paged_multi``; accept reason ``paged_multi_ok``)
-    with the gather-based XLA path as the universal fallback.  Returns
+    (``_route_decision_paged_multi``; accept reason ``paged_multi_ok``,
+    or ``paged_multi_int8_ok`` with ``kv_scales``) with the
+    gather-based XLA path as the universal fallback.  Returns
     [B, C, H_q, D] in q.dtype."""
     b, cc, hq, d = q.shape
     hkv = (k_arena.shape[2] // d if k_arena.ndim == 3
            else k_arena.shape[2])
     g = hq // hkv
     q5 = q.reshape(b, cc, hkv, g, d)
-    if should_use_pallas_paged_multi(q5, k_arena, tables):
-        out = _decode_attention_pallas_paged_multi(q5, k_arena, v_arena,
-                                                   tables, lens)
+    if should_use_pallas_paged_multi(q5, k_arena, tables, kv_scales):
+        if kv_scales is not None:
+            out = _decode_attention_pallas_paged_multi_q(
+                q5, k_arena, v_arena, kv_scales[0], kv_scales[1],
+                tables, lens)
+        else:
+            out = _decode_attention_pallas_paged_multi(
+                q5, k_arena, v_arena, tables, lens)
         return out.reshape(b, cc, hq, d)
-    return _paged_multi_xla(q, k_arena, v_arena, tables, lens)
+    return _paged_multi_xla(q, k_arena, v_arena, tables, lens, kv_scales)
 
 
-def _paged_multi_xla(q, k_arena, v_arena, tables, start):
+def _paged_multi_xla(q, k_arena, v_arena, tables, start, kv_scales=None):
     """Gather-based multi-position paged attention (fp32 softmax): the
     shared XLA body of ``paged_prefix_attention`` and
     ``decode_attention_paged_multi`` — each row's dense view is
-    materialized through its table and query c is masked to rows
-    ``<= start[b] + c``."""
+    materialized through its table (dequantized through
+    ``paged_dequant_view`` when ``kv_scales`` marks an int8 cache) and
+    query c is masked to rows ``<= start[b] + c``."""
     b, cc, hq, d = q.shape
-    kd = paged_gather_view(k_arena, tables)
-    vd = paged_gather_view(v_arena, tables)
+    if kv_scales is not None:
+        kd = paged_dequant_view(k_arena, kv_scales[0], tables, q.dtype)
+        vd = paged_dequant_view(v_arena, kv_scales[1], tables, q.dtype)
+    else:
+        kd = paged_gather_view(k_arena, tables)
+        vd = paged_gather_view(v_arena, tables)
     if kd.ndim == 3:
         s = kd.shape[1]
         hkv = kd.shape[2] // d

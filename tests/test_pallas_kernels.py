@@ -972,3 +972,134 @@ def test_stochastic_round_preserves_shape():
         x = jnp.ones(shape, jnp.float32) * 1.2345
         out = _stochastic_round_bf16(x, key)
         assert out.shape == shape and out.dtype == jnp.bfloat16
+
+
+# ---------------------------------------------------------------------------
+# int8 paged KV cache: gate allowlist + dequant-in-kernel parity
+# ---------------------------------------------------------------------------
+
+def _quantized_paged_case(seed, nb, blk_len, hkv, d):
+    """Random float arenas quantized into (codes, scales) — the exact
+    at-rest form the int8 serving engine maintains."""
+    from paddle_tpu.models.generation import quantize_kv_heads
+    rng = np.random.default_rng(seed)
+    kf = rng.standard_normal((nb + 1, blk_len, hkv, d)).astype(np.float32)
+    vf = rng.standard_normal((nb + 1, blk_len, hkv, d)).astype(np.float32)
+    kc, ks = quantize_kv_heads(jnp.asarray(kf))
+    vc, vs = quantize_kv_heads(jnp.asarray(vf))
+    w = hkv * d
+    return (kf.reshape(nb + 1, blk_len, w), vf.reshape(nb + 1, blk_len, w),
+            kc.reshape(nb + 1, blk_len, w), vc.reshape(nb + 1, blk_len, w),
+            ks, vs)
+
+
+def test_decode_gate_mixed_dtype_rejects_and_int8_allowlisted(monkeypatch):
+    """The dtype rule of the shared decode-attention gate: mixed
+    q/cache dtypes REJECT (``dtype_mismatch``) unless the pair is on
+    the explicit allowlist — (bf16|f32 q, int8 cache) — AND the caller
+    carries the scale arenas; an allowlisted pairing that fails the
+    packed-geometry check rejects as ``int8_geom``."""
+    from paddle_tpu.ops.pallas import decode_attention as da
+    monkeypatch.setattr(da, "pallas_enabled", lambda: True)
+    b, hkv, g, blk_len, nb, mb, d = 2, 2, 2, 8, 8, 3, 64
+    w = hkv * d
+    tables = jnp.asarray(np.arange(nb)[:b * mb].reshape(b, mb), jnp.int32)
+    sshape = (nb + 1, blk_len, hkv)
+    ks = jnp.ones(sshape, jnp.float32)
+    vs = jnp.ones(sshape, jnp.float32)
+    arena_i8 = jnp.zeros((nb + 1, blk_len, w), jnp.int8)
+    for qdt in (jnp.float32, jnp.bfloat16):
+        q4 = jnp.zeros((b, hkv, g, d), qdt)
+        # dense gate: mixed (float q, f32/int8 cache) with NO scales
+        # stays rejected — the dense path never carries scale arenas
+        cache_f64like = jnp.zeros((b, mb * blk_len, w), jnp.float16)
+        use, reason = da._route_decision(q4, cache_f64like)
+        assert not use and reason == "dtype_mismatch"
+        # paged gate without scales: same rejection
+        use, reason = da._route_decision_paged(q4, arena_i8, tables)
+        assert not use and reason == "dtype_mismatch"
+        # paged gate WITH scales: the allowlisted int8 pairing routes
+        use, reason = da._route_decision_paged(q4, arena_i8, tables,
+                                               (ks, vs))
+        assert use and reason == "paged_int8_ok"
+    # K-wide verify gate mirrors it
+    q5 = jnp.zeros((b, 3, hkv, g, d), jnp.float32)
+    use, reason = da._route_decision_paged_multi(q5, arena_i8, tables,
+                                                 (ks, vs))
+    assert use and reason == "paged_multi_int8_ok"
+    # allowlisted pair + broken packing -> int8_geom (not plain
+    # geometry: the route counter separates the quantized route)
+    arena_bad = jnp.zeros((nb + 1, blk_len, w + 128), jnp.int8)
+    use, reason = da._route_decision_paged(
+        jnp.zeros((b, hkv, g, d), jnp.float32), arena_bad, tables,
+        (ks, vs))
+    assert not use and reason == "int8_geom"
+    # scale planes riding a FLOAT cache (equal q/cache dtypes, so the
+    # allowlist is never consulted) must NOT route the dequant kernel
+    arena_f32 = jnp.zeros((nb + 1, blk_len, w), jnp.float32)
+    use, reason = da._route_decision_paged(
+        jnp.zeros((b, hkv, g, d), jnp.float32), arena_f32, tables,
+        (ks, vs))
+    assert not use and reason == "scales_mismatch"
+    # ... and the XLA dequant view refuses the same contract violation
+    with pytest.raises(TypeError, match="int8 code arena"):
+        da.paged_dequant_view(arena_f32, ks, tables, jnp.float32)
+
+
+
+def test_decode_attention_paged_int8_kernel_parity():
+    """Dequant-in-kernel parity (the allowlisted-pair case): the int8
+    paged Pallas kernel (interpret mode) must match the gather-based
+    XLA fallback reading ``paged_dequant_view`` — same codes, same
+    scales, same math — tightly; and both must sit within the
+    quantization-step bound of the EXACT unquantized attention
+    (bounded logit drift)."""
+    from paddle_tpu.ops.pallas.decode_attention import (
+        _decode_attention_pallas_paged_q, _decode_attention_xla,
+        paged_dequant_view, paged_gather_view)
+    rng = np.random.default_rng(23)
+    b, hkv, g, blk_len, nb, mb, d = 3, 2, 2, 8, 12, 4, 64
+    kf, vf, kc, vc, ks, vs = _quantized_paged_case(23, nb, blk_len,
+                                                   hkv, d)
+    q4 = jnp.asarray(rng.standard_normal((b, hkv, g, d)), jnp.float32)
+    tables = jnp.asarray(rng.permutation(nb)[:b * mb].reshape(b, mb),
+                         jnp.int32)
+    lens = jnp.asarray([5, 17, 30], jnp.int32)   # mid-block frontiers
+    out = _decode_attention_pallas_paged_q(q4, jnp.asarray(kc),
+                                           jnp.asarray(vc), ks, vs,
+                                           tables, lens)
+    ref = _decode_attention_xla(
+        q4, paged_dequant_view(jnp.asarray(kc), ks, tables, jnp.float32),
+        paged_dequant_view(jnp.asarray(vc), vs, tables, jnp.float32),
+        lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    exact = _decode_attention_xla(
+        q4, paged_gather_view(jnp.asarray(kf), tables),
+        paged_gather_view(jnp.asarray(vf), tables), lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(exact),
+                               atol=5e-2, rtol=5e-2)
+
+
+def test_decode_attention_paged_multi_int8_kernel_parity():
+    """K-wide (speculative verify) twin of the int8 parity test: the
+    int8 multi kernel vs the dequantizing XLA multi path, per-offset
+    causal masking included."""
+    from paddle_tpu.ops.pallas.decode_attention import (
+        _decode_attention_pallas_paged_multi_q, _paged_multi_xla)
+    rng = np.random.default_rng(29)
+    b, hkv, g, blk_len, nb, mb, d, cq = 3, 2, 2, 8, 12, 4, 64, 5
+    kf, vf, kc, vc, ks, vs = _quantized_paged_case(29, nb, blk_len,
+                                                   hkv, d)
+    hq = hkv * g
+    q = jnp.asarray(rng.standard_normal((b, cq, hq, d)), jnp.float32)
+    q5 = q.reshape(b, cq, hkv, g, d)
+    tables = jnp.asarray(rng.permutation(nb)[:b * mb].reshape(b, mb),
+                         jnp.int32)
+    lens = jnp.asarray([5, 17, 26], jnp.int32)
+    out = _decode_attention_pallas_paged_multi_q(
+        q5, jnp.asarray(kc), jnp.asarray(vc), ks, vs, tables, lens)
+    ref = _paged_multi_xla(q, jnp.asarray(kc), jnp.asarray(vc), tables,
+                           lens, (ks, vs)).reshape(b, cq, hkv, g, d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)

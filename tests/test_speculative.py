@@ -186,6 +186,39 @@ def test_spec_parity_acceptance_rejection_rollback_eos(netm):
     assert eng2.stats()["spec_verify_steps"] == 0
 
 
+def test_spec_decode_over_int8_kv_smoke(netm):
+    """Speculative decoding over the QUANTIZED cache: the verify
+    forward reads and quantize-writes the SAME int8 arenas the decode
+    path maintains, so spec output must stay token-for-token identical
+    to the non-speculative int8 engine — greedy equivalence is an
+    argmax-agreement argument over one engine's own logits and holds
+    whatever the at-rest cache dtype.  Acceptance/rollback bookkeeping
+    must really engage (verify forwards dispatched, drafts scored)."""
+    cfg, net = netm
+    rng = np.random.default_rng(11)
+    pat = rng.integers(0, cfg.vocab_size, (3,)).astype(np.int32)
+    rep = np.tile(pat, 4)                             # 12 tokens
+
+    def run(spec_k):
+        eng = ServingEngine(net, num_slots=1, prompt_len=P,
+                            max_cache_len=C, steps_per_call=1,
+                            block_len=4, chunk_len=12,
+                            compute_dtype="float32",
+                            kv_cache_dtype="int8")
+        req = eng.submit(rep, max_new_tokens=8, spec_decode=spec_k)
+        eng.run(max_iters=200)
+        return eng, req
+
+    e_s, r_s = run(3)
+    e_p, r_p = run(None)
+    np.testing.assert_array_equal(r_s.output, r_p.output)
+    s = e_s.stats()
+    assert s["kv_cache_dtype"] == "int8"
+    assert s["spec_verify_steps"] >= 1
+    assert s["spec_draft_tokens"] >= 1
+    assert e_p.stats()["spec_verify_steps"] == 0
+
+
 def test_model_drafter_proposes_target_continuation(netm):
     """ModelDrafter through the compiled generate path: with the
     TARGET as its own draft model the proposal must be exactly the

@@ -58,6 +58,11 @@ REQUIRED_INSTRUMENTS = {
     "serving.spec.draft_misses": "counter",
     "serving.spec.draft_tokens": "counter",
     "serving.spec.verify_steps": "counter",
+    # int8 KV cache (inference/serving.py _ServingInstruments): the
+    # modeled arena-sweep counter behind the bench's achieved_GBps and
+    # the per-dtype presence gauge
+    "serving.kv.bytes_swept": "counter",
+    "serving.kv.quant_dtype": "gauge",
 }
 
 
