@@ -777,6 +777,12 @@ def _bench_serving(on_tpu):
     which also land in the run's ``metrics`` sub-object through the
     ``serving.spec.*`` instruments.
 
+    A ``sampling`` sub-object reruns the spec arm's trace greedy vs
+    stochastically sampled (per-request temperature/top-k + seeds) vs
+    spec + sampled — pricing the sampling chain on the decode path and
+    reporting what temperature does to speculative acceptance
+    (accepted-length delta vs greedy spec, residual-resample count).
+
     A fifth A/B isolates the INT8 KV CACHE (``kv_int8`` sub-object):
     the mixed trace replayed through ``kv_cache_dtype="int8"`` vs the
     full-precision engine — tokens/s ratio, modeled achieved_GBps per
@@ -1013,26 +1019,31 @@ def _bench_serving(on_tpu):
         return list(h.bounds), (list(snap["buckets"]) if snap else
                                 [0] * (len(h.bounds) + 1))
 
-    def _one_spec_trace(use_spec):
+    # the verify only dispatches when something was drafted, and the
+    # n-gram drafter may draft nothing over a 4-token warm request —
+    # the spec/sampling arms warm with a stub that always proposes,
+    # then hand the engine back to the default prompt-lookup drafter
+    class _AlwaysDraft:
+        def propose(self, context, k):
+            return np.repeat(np.asarray(context[-1:], np.int32), k)
+
+    def _one_spec_trace(use_spec, sampling_for=lambda i: None):
+        # ``sampling_for(i)`` supplies request i's SamplingParams (None
+        # = greedy): the spec AND sampling arms share this one trace
+        # protocol, so the warm ritual / replay / counter deltas can
+        # never drift between them
         eng = ServingEngine(
             model, num_slots=1, prompt_len=sp_prompt,
             max_cache_len=sp_cache, steps_per_call=1,
             block_len=pf_block, chunk_len=sp_prompt,
             compute_dtype=compute_dtype)
         # warm: chunk prefill, the verify width, AND the plain decode
-        # block (the zero-draft fallback path dips into it mid-trace).
-        # The verify only dispatches when something was drafted, and
-        # the n-gram drafter may draft nothing over a 4-token warm
-        # request — warm with a stub that always proposes, then hand
-        # the engine back to the default prompt-lookup drafter
-        class _AlwaysDraft:
-            def propose(self, context, k):
-                return np.repeat(np.asarray(context[-1:], np.int32), k)
+        # block (the zero-draft fallback path dips into it mid-trace)
         if use_spec:
             eng._drafter = _AlwaysDraft()
         for warm_spec in (sp_k if use_spec else None, None):
             eng.submit(sp_prompts[0], max_new_tokens=4,
-                       spec_decode=warm_spec)
+                       spec_decode=warm_spec, sampling=sampling_for(0))
         eng.run()
         if use_spec:
             from paddle_tpu.inference.speculative import NGramDrafter
@@ -1040,9 +1051,10 @@ def _bench_serving(on_tpu):
         warm = eng.stats()
         _le, h0 = _accept_hist_buckets()
         t0 = time.perf_counter()
-        for ids in sp_prompts:
+        for i, ids in enumerate(sp_prompts):
             eng.submit(ids, max_new_tokens=sp_new, arrival_time=t0,
-                       spec_decode=sp_k if use_spec else None)
+                       spec_decode=sp_k if use_spec else None,
+                       sampling=sampling_for(i))
         done = eng.run()
         wall = max(r.finish_time for r in done) - t0
         final = eng.stats()
@@ -1066,11 +1078,17 @@ def _bench_serving(on_tpu):
             "accepted_length_le": le,
             "accepted_length_counts": [int(a - b)
                                        for a, b in zip(h1, h0)],
+            "sampled_tokens": final["sampled_tokens"]
+            - warm["sampled_tokens"],
+            "resamples": final["sample_resamples"]
+            - warm["sample_resamples"],
         }
 
-    def run_spec_arm(use_spec):
-        # best-of-2 walls, same rationale as the prefix arm
-        runs = [_one_spec_trace(use_spec) for _ in range(2)]
+    def run_spec_arm(use_spec, sampling_for=lambda i: None):
+        # best-of-2 walls, same rationale as the prefix arm; counters
+        # are deterministic per arm (seeded streams), runs[0] carries
+        runs = [_one_spec_trace(use_spec, sampling_for)
+                for _ in range(2)]
         wall = min(r[0] for r in runs)
         out = dict(runs[0][1])
         out["tokens_per_s"] = round(float(sp_new * sp_n) / wall, 1)
@@ -1078,6 +1096,28 @@ def _bench_serving(on_tpu):
 
     spec_on = run_spec_arm(use_spec=True)
     spec_off = run_spec_arm(use_spec=False)
+
+    # -- sampling arm: the SAME single-stream engine config and
+    # draftability-selected trace as the spec arm, run three ways —
+    # greedy (the spec arm's no-spec run IS this arm's baseline),
+    # stochastically sampled (per-request temperature/top-k +
+    # per-request seeds through the slot-indexed PRNG plane), and
+    # spec + sampled (stochastic speculative sampling: accept draft i
+    # with prob min(1, p_i(d_i)), residual resample on the first cut).
+    # The tokens/s deltas price the sampling chain on the decode path;
+    # the acceptance-length delta vs the GREEDY spec arm is what
+    # temperature does to acceptance economics (the accept test paying
+    # p(draft) instead of an argmax match), with the residual-resample
+    # count from serving.sample.resamples.  All serving.sample.*
+    # deltas also land in the run's ``metrics`` sub-object --
+    from paddle_tpu.inference.sampling import SamplingParams
+    sa_temp, sa_topk = 0.8, 50
+
+    def _sampling_for(i):
+        return SamplingParams(temperature=sa_temp, top_k=sa_topk, seed=i)
+
+    samp_plain = run_spec_arm(use_spec=False, sampling_for=_sampling_for)
+    samp_spec = run_spec_arm(use_spec=True, sampling_for=_sampling_for)
 
     # -- int8 KV-cache arm: the SAME drain trace through two engines
     # that differ ONLY in kv_cache_dtype (int8 codes + f32 absmax
@@ -1234,6 +1274,27 @@ def _bench_serving(on_tpu):
             "accepted_length_le": spec_on["accepted_length_le"],
             "accepted_length_counts":
                 spec_on["accepted_length_counts"],
+        },
+        "sampling": {
+            "temperature": sa_temp, "top_k": sa_topk,
+            "greedy_tokens_per_s": spec_off["tokens_per_s"],
+            "sampled_tokens_per_s": samp_plain["tokens_per_s"],
+            "spec_sampled_tokens_per_s": samp_spec["tokens_per_s"],
+            "sampled_vs_greedy": round(
+                samp_plain["tokens_per_s"]
+                / max(spec_off["tokens_per_s"], 1e-9), 3),
+            "spec_sampled_vs_sampled": round(
+                samp_spec["tokens_per_s"]
+                / max(samp_plain["tokens_per_s"], 1e-9), 3),
+            "sampled_tokens": samp_plain["sampled_tokens"],
+            "resamples": samp_spec["resamples"],
+            "mean_accepted_len": samp_spec["mean_accepted_len"],
+            "greedy_spec_mean_accepted_len":
+                spec_on["mean_accepted_len"],
+            "accepted_len_delta": round(
+                samp_spec["mean_accepted_len"]
+                - spec_on["mean_accepted_len"], 3),
+            "acceptance_rate": samp_spec["acceptance_rate"],
         },
         "config": {"num_slots": num_slots, "prompt": prompt,
                    "cache_len": cache_len, "n_requests": n_requests,

@@ -162,7 +162,8 @@ def _flatten_paged_kvs(kvs):
 
 
 def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call,
-                              kv_int8=False):
+                              kv_int8=False,
+                              samp_flags=(False, False, False, False)):
     """Paged twin of ``_build_decode_block``: the cache is the shared
     block arena plus per-slot block tables instead of per-slot
     contiguous rows.  The tables ride into the scan closure as a
@@ -172,25 +173,39 @@ def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call,
     push; the arenas stay donated device buffers.  ``kv_int8`` selects
     the quantized cache: ``flat_arenas`` then interleaves
     (k_codes, v_codes, k_scales, v_scales) per layer and the models'
-    decode path quantizes on append / dequantizes on read.  Signature:
-    ``(p_values, tok, lens, done, key, tables, *flat_arenas) ->
-    (toks [B, n], tok', lens', done', key', *flat_arenas)``."""
-    _with_params = _param_swapper(model, cfg)
+    decode path quantizes on append / dequantizes on read.
 
-    def block_pure(p_values, tok, lens, done, key, tables, *flat_arenas):
+    ``samp_flags = (sampled, filtered, penalty, bias)`` statically selects the
+    per-row sampling machinery (``inference/sampling.py``): the
+    all-False build is the exact greedy program (argmax only), and each
+    flag compiles in only the planes its mix needs — the ``samp``
+    pytree's structure is determined by the same flags, so program
+    variants and plane dicts stay in lockstep.  Signature:
+    ``(p_values, tok, lens, done, samp, tables, *flat_arenas) ->
+    (toks [B, n], tok', lens', done', *flat_arenas)``."""
+    from .sampling import sampled_decode_scan_body
+    _with_params = _param_swapper(model, cfg)
+    sampled, _filtered, penalty, _bias = samp_flags
+
+    def block_pure(p_values, tok, lens, done, samp, tables, *flat_arenas):
         def run():
             kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
-            (tok_f, lens_f, kvs_f, key_f, done_f), toks = jax.lax.scan(
-                decode_scan_body(model, cfg), (tok, lens, kvs, key, done),
-                None, length=steps_per_call)
-            return ((toks.T.astype(jnp.int32), tok_f, lens_f, done_f,
-                     key_f) + tuple(_flatten_paged_kvs(kvs_f)))
+            pos0 = samp["pos"] if sampled else jnp.zeros_like(lens)
+            pres0 = samp["presence"] if penalty else None
+            (tok_f, lens_f, kvs_f, _pos_f, _pres_f, done_f), toks = \
+                jax.lax.scan(
+                    sampled_decode_scan_body(model, cfg, samp, samp_flags),
+                    (tok, lens, kvs, pos0, pres0, done),
+                    None, length=steps_per_call)
+            return ((toks.T.astype(jnp.int32), tok_f, lens_f, done_f)
+                    + tuple(_flatten_paged_kvs(kvs_f)))
         return _with_params(p_values, run)
 
     return block_pure
 
 
-def build_chunk_prefill(model, cfg: GenerationConfig, kv_int8=False):
+def build_chunk_prefill(model, cfg: GenerationConfig, kv_int8=False,
+                        samp_flags=(False, False, False, False)):
     """Chunked-prefill program for the paged ServingEngine: ONE prompt
     chunk of ONE sequence (batch-1; the static chunk length is the ids
     shape) computed at global positions ``start .. start+C-1``, K/V
@@ -199,28 +214,31 @@ def build_chunk_prefill(model, cfg: GenerationConfig, kv_int8=False):
     ``n_valid - 1`` every call; it is only meaningful on the chunk that
     covers that position — the engine ignores earlier chunks' sample
     and never advances decode state from them.  ``kv_int8`` selects the
-    quantized cache (see ``_build_paged_decode_block``).  Signature:
+    quantized cache and ``samp_flags`` the per-request sampling
+    machinery (see ``_build_paged_decode_block``; the batch-1 ``samp``
+    planes carry the request's params at PRNG position 0 — the
+    first output token's draw is chunk-layout- and prefix-hit-
+    independent by construction).  Signature:
     ``(p_values, ids [1, C], start [], n_valid [], tables
-    [1, max_blocks], key, *flat_arenas) -> (tok [1], key',
+    [1, max_blocks], samp, *flat_arenas) -> (tok [1],
     *flat_arenas)``."""
     if cfg.num_beams > 1:
         raise ValueError(
             "chunked prefill is greedy/sampled only — beam search "
             "expands to K cache rows per request, which does not fit a "
             "one-slot-per-request block table")
+    from .sampling import sample_rows
     _with_params = _param_swapper(model, cfg)
+    penalty = samp_flags[2]
 
-    def chunk_pure(p_values, ids, start, n_valid, tables, key,
+    def chunk_pure(p_values, ids, start, n_valid, tables, samp,
                    *flat_arenas):
         def run():
             kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
             logits, kvs_f = model.prefill_chunk(ids, start, n_valid, kvs)
-            if cfg.do_sample:
-                key0, keyr = jax.random.split(key)
-            else:
-                key0 = keyr = key
-            tok = sample_token(logits, key0, cfg)
-            return (tok, keyr) + tuple(_flatten_paged_kvs(kvs_f))
+            tok = sample_rows(logits, samp, samp_flags,
+                              samp["presence"] if penalty else None)
+            return (tok,) + tuple(_flatten_paged_kvs(kvs_f))
         return _with_params(p_values, run)
 
     return chunk_pure
@@ -318,7 +336,7 @@ class LLMPredictor:
     def __init__(self, model=None, *, batch, prompt_len,
                  max_cache_len=None, steps_per_call=16,
                  eos_token_id=None, pad_token_id=0,
-                 do_sample=False, temperature=1.0, top_k=0,
+                 do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  num_beams=1, length_penalty=0.0,
                  compute_dtype="bfloat16", cache_dtype=None,
                  _loaded=None):
@@ -337,7 +355,8 @@ class LLMPredictor:
                              "supported (beam search scores greedily)")
         self.cfg = GenerationConfig(
             do_sample=bool(do_sample), temperature=float(temperature),
-            top_k=int(top_k), num_beams=int(num_beams),
+            top_k=int(top_k), top_p=float(top_p),
+            num_beams=int(num_beams),
             length_penalty=float(length_penalty),
             eos_token_id=eos_token_id, pad_token_id=int(pad_token_id),
             compute_dtype=str(compute_dtype),
@@ -576,6 +595,7 @@ class LLMPredictor:
                     "do_sample": self.cfg.do_sample,
                     "temperature": self.cfg.temperature,
                     "top_k": self.cfg.top_k,
+                    "top_p": self.cfg.top_p,
                     "num_beams": self.cfg.num_beams,
                     "length_penalty": self.cfg.length_penalty,
                     "compute_dtype": self.cfg.compute_dtype,
@@ -609,6 +629,7 @@ class LLMPredictor:
             do_sample=meta.get("do_sample", False),
             temperature=meta.get("temperature", 1.0),
             top_k=meta.get("top_k", 0),
+            top_p=meta.get("top_p", 1.0),
             num_beams=meta.get("num_beams", 1),
             length_penalty=meta.get("length_penalty", 0.0),
             compute_dtype=meta["compute_dtype"],

@@ -1,0 +1,428 @@
+"""Per-request sampling & constrained decoding for the serving engine.
+
+Everything the engine emitted since PR 1 was greedy argmax — one
+scenario.  This module makes generation config first-class API surface
+(the reference framework's GenerationConfig role, per REQUEST instead
+of per engine): a ``SamplingParams`` record carried by ``submit()``,
+a slot-indexed PRNG plane, a batched per-row sampler applied inside
+the traced decode/chunk-final/verify dispatches, and a host-side
+logit-processor chain (repetition penalty + token-mask constrained
+decoding, the Outlines approach).
+
+Design decisions, in order of load-bearing-ness:
+
+- **Position-keyed per-request PRNG.**  Each request carries its own
+  base key ``PRNGKey(seed)``; the key for its i-th OUTPUT token is
+  ``fold_in(fold_in(base, i), lane)`` (lane 0 = the accept-test
+  uniform of speculative sampling, lane 1 = the categorical draw).
+  Every random draw is therefore a pure function of
+  ``(seed, output position, lane)`` — slot reuse, batch composition,
+  prefix-cache hits, chunked-prefill layout and engine restarts cannot
+  change a request's stream, and speculative ROLLBACK rewinds the
+  stream for free: the engine re-derives positions from host truth
+  (``len(req.tokens)``) each dispatch, so a rejected draft's positions
+  are simply drawn again next forward (their earlier draws were never
+  consumed — acceptance stopped before them — so independence holds).
+- **Per-row planes, not per-program configs.**  Temperature / top-k /
+  top-p / repetition penalty / greedy-ness ride as ``[B]`` vectors
+  ("planes") into ONE compiled program per (steps, feature-flags)
+  bucket: a greedy row and three differently-sampled rows share the
+  dispatch, mixed freely, exactly like ``lens``/``done`` already mix
+  fill levels.  Greedy rows select ``argmax`` through a per-row
+  ``is_greedy`` mask, so the default path stays BIT-EXACT (argmax of
+  the f32-cast logits equals argmax of the raw logits — the cast is
+  monotone and exact).
+- **Feature flags are static, planes are data.**  The per-row
+  categorical, the top-k/top-p sort-filter (a full-vocab sort — pure-
+  temperature mixes skip it), the repetition-penalty presence plane
+  ([B, V] bool) and the constrained-mask bias plane ([B, V] f32) each
+  cost real compute/transfer, so each is compiled in only when a
+  dispatch's active mix needs it
+  (``flags = (sampled, filtered, penalty, bias)``); an all-greedy
+  engine runs the exact pre-sampling program shape forever.
+- **Logit-processor chain order**: repetition penalty (CTRL-style:
+  divide positive / multiply negative logits of context tokens), then
+  the token-mask bias (0 allowed / -1e9 banned), then temperature,
+  top-k, top-p.  The penalty's presence set is updated IN-TRACE as a
+  multi-step decode block emits tokens (one-hot OR into the carried
+  plane), so penalty rows ride full blocks; mask rows cannot — their
+  host-side state machine must observe each token — so the engine
+  clamps their blocks to single steps.
+
+``DfaTokenMask`` is the reference mask processor: a dense
+``[states, vocab]`` transition table (entries < 0 = banned) drives
+token-mask constrained decoding for any regular language (JSON
+skeletons, regexes compiled elsewhere) — the same mechanism structured
+-output systems use, small enough to audit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# the per-row top-k/top-p filter is owned by models/generation.py (ONE
+# implementation of the nucleus prefix/tie rule for both the
+# whole-batch ``sample_token`` config and these per-request planes);
+# re-exported here as part of this module's documented surface
+from ..models.generation import filter_top_k_top_p  # noqa: F401
+
+# temperatures below this sample like argmax anyway; treat them AS
+# argmax so the scale 1/T never overflows inside the traced program
+TEMP_EPS = 1e-4
+
+# additive bias of banned tokens: finite (an -inf bias would turn an
+# all-banned row into a NaN softmax; -1e9 keeps the math defined and
+# is unreachable by any real logit)
+MASK_BIAS = -1e9
+
+
+class TokenMaskProcessor:
+    """Host-side state machine driving token-mask constrained decoding.
+
+    The engine calls ``begin(prompt_ids)`` once at submit, ``allowed()``
+    before every decode dispatch of the request (a ``[vocab]`` bool
+    vector of legal next tokens, turned into the traced bias plane),
+    and ``advance(token)`` after each emitted token.  State is PER
+    REQUEST — give each request its own processor instance.
+
+    Masks compose with temperature/top-k/top-p sampling and with greedy
+    decoding; they do NOT compose with speculative decoding (a draft
+    position's mask depends on host state the drafter bypasses — the
+    engine rejects that combination at submit).
+
+    An ``allowed()`` with NO legal token ("dead end") means the grammar
+    is complete: the engine finishes the request there, exactly like an
+    EOS (an all-banned state cannot constrain — its bias plane is a
+    uniform shift — so it is the natural encoding of an accept state in
+    a DFA that does not map EOS).  A dead START state is rejected at
+    submit."""
+
+    def begin(self, prompt_ids: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def allowed(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def advance(self, token: int) -> None:
+        raise NotImplementedError
+
+
+class DfaTokenMask(TokenMaskProcessor):
+    """Constrained decoding over a dense DFA transition table.
+
+    ``table`` is ``[n_states, vocab]`` int32: entry ``(s, t)`` is the
+    state after emitting token ``t`` in state ``s``, or ``-1`` when
+    ``t`` is illegal there.  ``allowed()`` is one table-row compare;
+    ``advance`` one lookup.  Anything regular (toy JSON grammars,
+    compiled regexes) lowers to this form; the prompt does not move the
+    DFA (constrained decoding constrains the OUTPUT)."""
+
+    def __init__(self, table, start_state: int = 0):
+        self.table = np.asarray(table, np.int32)
+        if self.table.ndim != 2:
+            raise ValueError(
+                f"DFA table must be [n_states, vocab], got "
+                f"{list(self.table.shape)}")
+        self.start_state = int(start_state)
+        if not 0 <= self.start_state < self.table.shape[0]:
+            raise ValueError(
+                f"start_state {start_state} outside the "
+                f"{self.table.shape[0]}-state table")
+        self.state = self.start_state
+
+    def begin(self, prompt_ids: np.ndarray) -> None:
+        self.state = self.start_state
+
+    def allowed(self) -> np.ndarray:
+        return self.table[self.state] >= 0
+
+    def advance(self, token: int) -> None:
+        nxt = int(self.table[self.state, int(token)])
+        if nxt < 0:
+            raise RuntimeError(
+                f"token {token} is illegal in DFA state {self.state} — "
+                f"the mask bias should have made this unreachable")
+        self.state = nxt
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decode configuration, carried by
+    ``ServingEngine.submit(sampling=...)``.
+
+    ``temperature=0`` (or ``top_k=1``) degenerates to greedy argmax —
+    the engine routes such rows through the bit-exact greedy path.
+    ``seed`` names the request's PRNG stream (see the module docstring
+    for the position-keyed derivation); the default ``None`` derives a
+    DISTINCT stream per request (engine seed folded with the request
+    id — concurrent no-seed requests differ from each other, and a
+    replayed submission order reproduces), so best-of-n submissions
+    are diverse without hand-assigned seeds.  ``mask_processor`` plugs
+    a host-side :class:`TokenMaskProcessor`; it is stateful and must
+    not be shared between requests."""
+
+    temperature: float = 1.0
+    top_k: int = 0                    # 0 = full vocabulary
+    top_p: float = 1.0                # 1.0 = off
+    repetition_penalty: float = 1.0   # 1.0 = off
+    seed: Optional[int] = None        # None = per-request stream
+    mask_processor: Optional[TokenMaskProcessor] = field(default=None)
+
+    @property
+    def is_greedy(self) -> bool:
+        """Argmax instead of a categorical draw.  Processors (penalty,
+        mask) still apply — greedy-over-masked-logits is a valid
+        constrained mode; only DEFAULT params (no processors) promise
+        bit-exactness with the pre-sampling greedy engine."""
+        return self.temperature <= TEMP_EPS or self.top_k == 1
+
+    @property
+    def needs_penalty(self) -> bool:
+        return self.repetition_penalty != 1.0
+
+    def validate(self):
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(
+                f"top_p must be in (0, 1], got {self.top_p}")
+        if self.repetition_penalty <= 0.0:
+            raise ValueError(
+                f"repetition_penalty must be > 0, got "
+                f"{self.repetition_penalty}")
+        if self.mask_processor is not None and \
+                not isinstance(self.mask_processor, TokenMaskProcessor):
+            raise ValueError(
+                "mask_processor must be a TokenMaskProcessor")
+        return self
+
+
+GREEDY = SamplingParams(temperature=0.0)
+
+
+def flags_of(params_list) -> tuple:
+    """The static feature-flag bucket of a dispatch's active mix:
+    ``(sampled, filtered, penalty, bias)``.  Determines both which
+    planes ride in ``samp`` and which program variant compiles — same
+    flags, same pytree structure, same executable.  ``filtered`` is
+    the top-k/top-p sort-filter: a pure-temperature mix leaves it out
+    and skips the full-vocab sort entirely."""
+    ps = [p for p in params_list if p is not None]
+    return (any(not p.is_greedy for p in ps),
+            any(not p.is_greedy and (p.top_k > 0 or p.top_p < 1.0)
+                for p in ps),
+            any(p.needs_penalty for p in ps),
+            any(p.mask_processor is not None for p in ps))
+
+
+def row_planes(params: Optional[SamplingParams]):
+    """One row's plane values ``(temp, top_k, top_p, greedy)``.
+    Greedy rows get NEUTRAL filter values (temp 1, no top-k/p): the
+    sampled branch's math then stays finite for them even though the
+    ``greedy`` mask discards its result.  The repetition penalty is
+    NOT part of the tuple — the ``rep``/``presence`` planes are built
+    by the penalty branch of the engine's plane builder, the one
+    source of that value."""
+    p = params or GREEDY
+    if p.is_greedy:
+        return (1.0, 0, 1.0, True)
+    return (max(float(p.temperature), TEMP_EPS), int(p.top_k),
+            float(p.top_p), False)
+
+
+def base_key(seed: int) -> np.ndarray:
+    """The request's raw uint32 base key."""
+    return np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)
+
+
+# -- traced helpers (inside the compiled serving programs) --
+
+def _fold_keys(base, pos, lane):
+    """Per-row key for output position ``pos[b]``, lane 0 (accept-test
+    uniform) or 1 (categorical draw).  base: [B, 2] uint32; pos: [B]."""
+    def one(k, p):
+        return jax.random.fold_in(jax.random.fold_in(k, p), lane)
+    return jax.vmap(one)(base, pos)
+
+
+def process_logits(logits, samp, flags, presence=None):
+    """The logit-processor chain BEFORE temperature: f32 cast,
+    repetition penalty over the ``presence`` plane, constrained-mask
+    bias.  Row-local and monotone-for-default-rows: a row with
+    ``rep == 1`` and zero bias leaves with its logits' exact f32 cast,
+    so its argmax is bit-identical to the raw argmax."""
+    _sampled, _filtered, penalty, bias = flags
+    lg = logits.astype(jnp.float32)
+    if penalty:
+        rep = samp["rep"]
+        rep = rep.reshape(rep.shape + (1,) * (lg.ndim - rep.ndim))
+        pen = jnp.where(lg > 0, lg / rep, lg * rep)
+        lg = jnp.where(presence, pen, lg)
+    if bias:
+        lg = lg + samp["bias"]
+    return lg
+
+
+
+
+def categorical_rows(lg, keys):
+    """Per-row categorical over [..., V] logits with per-row keys
+    ([..., 2] uint32).  vmapped ``jax.random.categorical``, so each
+    row's draw depends only on its own key + logits — the
+    batch-composition-independence the seeded-determinism contract
+    needs."""
+    shape = lg.shape[:-1]
+    flat = jax.vmap(jax.random.categorical)(
+        keys.reshape(-1, 2), lg.reshape(-1, lg.shape[-1]))
+    return flat.reshape(shape).astype(jnp.int32)
+
+
+def sample_rows(logits, samp, flags, presence=None):
+    """The full per-row chain of one decode position: process ->
+    greedy argmax AND (when the ``sampled`` flag is compiled in)
+    temperature/top-k/top-p categorical, selected per row by the
+    ``greedy`` plane.  logits [B, V]; returns tokens [B] int32."""
+    sampled, filtered = flags[0], flags[1]
+    lg = process_logits(logits, samp, flags, presence)
+    tok_g = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+    if not sampled:
+        return tok_g
+    keys = _fold_keys(samp["base"], samp["pos"], 1)
+    lgf = lg / samp["temp"][:, None]
+    if filtered:
+        lgf = filter_top_k_top_p(lgf, samp["top_k"], samp["top_p"])
+    tok_s = categorical_rows(lgf, keys)
+    return jnp.where(samp["greedy"], tok_g, tok_s)
+
+
+def sampled_decode_scan_body(model, cfg, samp, flags):
+    """Per-token scan body of the paged decode block with per-row
+    sampling: ``decode_scan_body``'s exact greedy semantics (EOS mask,
+    pad emits, frozen lens for done rows) plus the sampling chain.
+    carry = (tok, lens, kvs, pos, presence, done); ``pos`` advances
+    with emitted tokens (frozen rows hold, like lens) so multi-step
+    blocks consume consecutive PRNG positions; ``presence`` (None
+    unless the penalty flag is compiled in) absorbs each emitted token
+    so the repetition penalty stays exact across the block."""
+    penalty = flags[2]
+
+    def body(carry, _):
+        tok, lens_c, kvs_c, pos, presence, done = carry
+        logits_t, kvs_c = model.decode_step(tok, lens_c, kvs_c)
+        step_samp = dict(samp)
+        if flags[0]:
+            step_samp["pos"] = pos
+        nxt = sample_rows(logits_t, step_samp, flags, presence)
+        if cfg.eos_token_id is not None:
+            nxt = jnp.where(done, cfg.pad_token_id, nxt)
+            done_n = done | (nxt == cfg.eos_token_id)
+        else:
+            done_n = done
+        lens_n = jnp.where(done, lens_c, lens_c + 1)
+        pos_n = jnp.where(done, pos, pos + 1)
+        if penalty:
+            oh = jax.nn.one_hot(nxt, presence.shape[-1],
+                                dtype=jnp.bool_)
+            presence = presence | (oh & ~done[:, None])
+        return (nxt, lens_n, kvs_c, pos_n, presence, done_n), nxt
+
+    return body
+
+
+def _expand_spec_presence(toks, presence):
+    """Per-position presence planes of a verify forward: position j's
+    context adds draft candidates < j on top of the base plane
+    (``toks[:, 0]``, the last emitted token, is already in the base).
+    toks [B, C]; presence [B, V] -> [B, C, V]."""
+    b, c = toks.shape
+    v = presence.shape[-1]
+    oh = jax.nn.one_hot(toks[:, 1:], v, dtype=jnp.int32)
+    cum = jnp.cumsum(oh, axis=1) > 0
+    return presence[:, None, :] | jnp.concatenate(
+        [jnp.zeros((b, 1, v), bool), cum], axis=1)
+
+
+def spec_greedy_rows(logits, toks, samp, flags, presence=None):
+    """The greedy half of a verify forward under the processor chain:
+    per-position argmax of the PROCESSED logits (presence expanded per
+    draft position when the penalty flag is in).  Bit-exact with the
+    raw argmax for default rows — the greedy spec acceptance path."""
+    if flags[2]:
+        presence = _expand_spec_presence(toks, presence)
+    lg = process_logits(logits, samp, flags, presence)
+    return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+
+
+def spec_sampling_draws(logits, toks, samp, flags, presence=None):
+    """Everything stochastic speculative sampling needs from ONE
+    verify forward, drawn in-trace so the draws are position-keyed and
+    deterministic.  logits [B, C, V] (position j's target logits after
+    consuming drafts < j), toks [B, C] (toks[:, 0] = last emitted
+    token, toks[:, 1:] = draft candidates).
+
+    Draft distributions here are ONE-HOT: both drafters are
+    deterministic proposal mechanisms, so q_j is the point mass at the
+    proposed token and the Leviathan/Chen acceptance rule reduces to
+    ``accept draft d_j with prob p_j(d_j)`` (min(1, p/q) at q = 1) with
+    residual ``max(p - q, 0) ∝ p masked at d_j`` — still exactly
+    distribution-preserving: P(emit x) = p(d)·1[x=d] +
+    (1-p(d))·p(x)1[x≠d]/(1-p(d)) = p(x).
+
+    Returns (per row, per position j):
+    - ``greedy`` [B, C] i32 — argmax of the PROCESSED logits (the
+      greedy acceptance path of greedy rows; bit-exact for default
+      rows),
+    - ``u`` [B, C] f32 — the accept-test uniform (lane 0 of position
+      ``pos + j``),
+    - ``accept_p`` [B, C] f32 — p_j(d_j), the acceptance probability
+      of draft j (column C-1 has no draft and reads 0),
+    - ``resample`` [B, C] i32 — the residual draw at j (consumed only
+      when j is the first rejection),
+    - ``sample`` [B, C] i32 — a draw from the full p_j (consumed only
+      as the bonus token after all drafts accept, or as the plain
+      sample of a draftless row).  ``resample`` and ``sample`` share
+      lane 1 of position ``pos + j``: at most one of them is consumed
+      per position, and acceptance at j consumes only lane 0 —
+      unconsumed draws are discarded, preserving independence across
+      re-drawn (rolled-back) positions."""
+    b, c, v = logits.shape
+    if flags[2]:
+        presence = _expand_spec_presence(toks, presence)
+    lg = process_logits(logits, samp, flags, presence)
+    greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+
+    pos = samp["pos"][:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+    base = jnp.broadcast_to(samp["base"][:, None, :], (b, c, 2))
+    u_keys = _fold_keys(base.reshape(-1, 2), pos.reshape(-1), 0)
+    s_keys = _fold_keys(base.reshape(-1, 2), pos.reshape(-1), 1)
+    u = jax.vmap(jax.random.uniform)(u_keys).reshape(b, c)
+
+    lgf = lg / samp["temp"][:, None, None]
+    if flags[1]:
+        lgf = filter_top_k_top_p(
+            lgf,
+            jnp.broadcast_to(samp["top_k"][:, None], (b, c)),
+            jnp.broadcast_to(samp["top_p"][:, None], (b, c)))
+    probs = jax.nn.softmax(lgf, axis=-1)
+    # draft at position j is the NEXT input token; the last column has
+    # no draft (its draws serve only the bonus sample)
+    d = jnp.concatenate(
+        [toks[:, 1:], jnp.zeros((b, 1), jnp.int32)], axis=1)
+    accept_p = jnp.take_along_axis(probs, d[..., None], axis=-1)[..., 0]
+    accept_p = accept_p.at[:, -1].set(0.0)
+    # residual: p with the draft token masked out (renormalization is
+    # categorical-invariant — logits shift by a row constant)
+    lg_res = jnp.where(
+        jax.nn.one_hot(d, v, dtype=jnp.bool_), -jnp.inf, lgf)
+    keys = s_keys.reshape(b, c, 2)
+    resample = categorical_rows(lg_res, keys)
+    sample = categorical_rows(lgf, keys)
+    return greedy, u, accept_p, resample, sample

@@ -107,7 +107,10 @@ from ..observability import metrics as obs_metrics
 from ..observability.spans import instant as _span_instant
 from ..observability.spans import span as _span
 from .llm import _build_paged_decode_block, build_chunk_prefill
-from .speculative import NGramDrafter, accept_drafts, build_spec_verify
+from .sampling import (MASK_BIAS, SamplingParams, base_key, flags_of,
+                       row_planes)
+from .speculative import (NGramDrafter, accept_drafts,
+                          accept_drafts_sampled, build_spec_verify)
 
 
 class _ServingInstruments:
@@ -214,6 +217,28 @@ class _ServingInstruments:
             "counted)",
             buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0,
                      24.0, 32.0))
+        self.sample_sampled_tokens = r.counter(
+            "serving.sample.sampled_tokens",
+            "tokens emitted by rows with a stochastic sampling config "
+            "(temperature > 0 and top_k != 1), across decode blocks, "
+            "chunk-final prefills and speculative verifies — against "
+            "serving.sample.greedy_tokens this is the engine's "
+            "sampled-vs-greedy route split")
+        self.sample_greedy_tokens = r.counter(
+            "serving.sample.greedy_tokens",
+            "tokens emitted by greedy rows (no sampling config, "
+            "temperature 0, or top_k=1) — the bit-exact argmax route")
+        self.sample_masked_tokens = r.counter(
+            "serving.sample.masked_tokens",
+            "tokens emitted under an active token-mask constraint "
+            "(a per-request TokenMaskProcessor biased the row's "
+            "logits this step)")
+        self.sample_resamples = r.counter(
+            "serving.sample.resamples",
+            "residual resamples consumed by stochastic speculative "
+            "sampling (one per verify forward whose draft prefix was "
+            "cut by the accept test; the residual draw preserves the "
+            "output distribution)")
         self.kv_bytes_swept = r.counter(
             "serving.kv.bytes_swept",
             "modeled KV-arena bytes read by decode/verify/prefill-chunk "
@@ -233,7 +258,9 @@ class _ServingInstruments:
                   self.prefix_hits, self.prefix_misses,
                   self.spec_verifies, self.spec_draft_hits,
                   self.spec_draft_misses, self.spec_draft_tokens,
-                  self.spec_accepted_tokens, self.kv_bytes_swept):
+                  self.spec_accepted_tokens, self.kv_bytes_swept,
+                  self.sample_sampled_tokens, self.sample_greedy_tokens,
+                  self.sample_masked_tokens, self.sample_resamples):
             self._base[c.name] = c.value()
 
     def since_init(self, counter) -> float:
@@ -392,6 +419,8 @@ class Request:
     finish_time: Optional[float] = None
     state: str = "queued"
     spec_k: Optional[int] = None       # speculative mode: drafts/verify
+    sampling: Optional[SamplingParams] = None  # None = plain greedy
+    samp_base: Optional[np.ndarray] = None     # [2] u32 PRNG base key
     pf_pos: int = 0                    # next prompt position to compute
     matched: List[int] = field(default_factory=list)   # prefix-hit blocks
     blocks: List[int] = field(default_factory=list)    # full block map
@@ -435,7 +464,7 @@ class ServingEngine:
                  block_len=16, num_blocks=None, chunk_len=None,
                  enable_prefix_cache=True, drafter=None,
                  eos_token_id=None, pad_token_id=0,
-                 do_sample=False, temperature=1.0, top_k=0,
+                 do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  compute_dtype="bfloat16", cache_dtype=None,
                  kv_cache_dtype=None,
                  seed=0, static_batching=False, clock=time.perf_counter,
@@ -472,10 +501,20 @@ class ServingEngine:
             raise ValueError(f"chunk_len must be >= 1, got {chunk_len}")
         self.cfg = GenerationConfig(
             do_sample=bool(do_sample), temperature=float(temperature),
-            top_k=int(top_k), eos_token_id=eos_token_id,
+            top_k=int(top_k), top_p=float(top_p),
+            eos_token_id=eos_token_id,
             pad_token_id=int(pad_token_id),
             compute_dtype=str(compute_dtype),
             cache_dtype=None if cache_dtype is None else str(cache_dtype))
+        # engine-level sampling knobs become the DEFAULT per-request
+        # SamplingParams (requests may override via submit(sampling=));
+        # default-sampled requests draw from streams seeded by
+        # fold_in(engine seed, request_id), so the engine-level mode is
+        # restart-deterministic too without every request sharing one
+        # stream
+        self._default_sampling = (SamplingParams(
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p)).validate() if do_sample else None)
         model.eval()
         self._model = model
         params, buffers = model_arrays(model)
@@ -532,22 +571,26 @@ class ServingEngine:
         # device and are donated into both compiled programs so
         # steady-state serving does not churn a second copy of the
         # pool through HBM every step.
-        # args: (pb, ids, start, n_valid, tables, key, *arenas) /
-        #       (pb, tok, lens, done, key, tables, *arenas)
+        # args: (pb, ids, start, n_valid, tables, samp, *arenas) /
+        #       (pb, tok, lens, done, samp, tables, *arenas)
         self._tables = np.full((self.num_slots, self.max_blocks),
                                self._pool.trash, np.int32)
         donate = tuple(range(6, 6 + len(self._arenas)))
-        self._chunk_fn = jax.jit(
-            build_chunk_prefill(model, self.cfg, kv_int8=self._kv_int8),
-            donate_argnums=donate)
         self._donate = donate
-        self._blocks = {}              # static block size -> jitted fn
+        # compiled programs are cached per (static shape, sampling
+        # feature flags): an all-greedy engine compiles exactly the
+        # argmax-only program shapes, and each sampling feature
+        # (sampler planes / repetition-penalty presence / mask bias)
+        # is compiled in only for dispatches whose active mix needs it
+        self._chunk_fns = {}           # samp flags -> jitted fn
+        self._blocks = {}              # (block size, flags) -> jitted fn
+        self._vocab = int(model.config.vocab_size)
         # speculative decoding: per-request mode (submit(spec_decode=K));
         # the drafter is engine-level (host-side, shared by every spec
         # request) and defaults to prompt-lookup self-drafting the
         # first time a spec request arrives
         self._drafter = drafter
-        self._verify_fns = {}          # static verify width -> jitted fn
+        self._verify_fns = {}          # (verify width, flags) -> jitted fn
         self._spec_k_max = 0           # engine-lifetime max spec_decode
         self._spec_fallback = set()    # per-iteration: spec slots that
         #                                ride the plain block instead
@@ -557,8 +600,10 @@ class ServingEngine:
         self._tok = np.zeros((self.num_slots,), np.int32)
         self._lens = np.zeros((self.num_slots,), np.int32)
         self._done = np.ones((self.num_slots,), bool)
-        self._key = jnp.asarray(
-            np.asarray(jax.random.PRNGKey(int(seed)), np.uint32))
+        # per-request PRNG replaced the old engine-carried key chain:
+        # every draw is keyed by (request base key, output position),
+        # never by dispatch order — see inference/sampling.py
+        self._seed = int(seed)
 
         self._slots: List[Optional[Request]] = [None] * self.num_slots
         self._queue: deque = deque()
@@ -624,16 +669,28 @@ class ServingEngine:
 
     # -- request intake --
     def submit(self, prompt_ids, seq_len=None, max_new_tokens=32,
-               arrival_time=None, spec_decode=None) -> Request:
+               arrival_time=None, spec_decode=None,
+               sampling: Optional[SamplingParams] = None) -> Request:
         """Enqueue one request.  ``prompt_ids`` is a 1-D id array of at
         most ``prompt_len`` tokens (right-padded internally);
         ``arrival_time`` (in ``clock()`` units) lets a trace replay
         future arrivals — the scheduler will not admit a request before
-        it has "arrived".  ``spec_decode=K`` puts THIS request in
-        speculative-decoding mode: its decode phase runs drafter
-        proposals of up to K tokens through the K+1-position verify
-        forward instead of riding the plain decode block (greedy
-        engines only; output is unchanged, token-for-token).  With
+        it has "arrived".  ``sampling=SamplingParams(...)`` gives THIS
+        request its own decode configuration (temperature / top-k /
+        top-p / repetition penalty / seed / token-mask processor);
+        omitted, the request inherits the engine-level default
+        (``do_sample=True`` knobs, or plain greedy).  ``spec_decode=K``
+        puts THIS request in speculative-decoding mode: its decode
+        phase runs drafter proposals of up to K tokens through the
+        K+1-position verify forward instead of riding the plain decode
+        block.  Greedy spec requests keep the argmax-prefix acceptance
+        (output token-for-token unchanged); sampled spec requests run
+        stochastic speculative sampling (accept draft i with prob
+        ``min(1, p/q)``, resample the residual on reject — the output
+        DISTRIBUTION is unchanged, per-seed streams differ from the
+        non-spec engine).  The one unsupported combination is
+        ``spec_decode`` + a ``mask_processor``: a draft position's
+        mask depends on host state the drafter bypasses.  With
         prefix caching on, the prompt's full blocks are probed against
         the cache here and any hits are PINNED so they cannot be
         reclaimed while the request waits."""
@@ -650,6 +707,13 @@ class ServingEngine:
         m = int(max_new_tokens)
         if m < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {m}")
+        if sampling is not None:
+            if not isinstance(sampling, SamplingParams):
+                raise ValueError(
+                    f"sampling must be a SamplingParams, got "
+                    f"{type(sampling).__name__}")
+            sampling.validate()
+        sp = sampling if sampling is not None else self._default_sampling
         spec_k = None
         if spec_decode is not None:
             spec_k = int(spec_decode)
@@ -657,11 +721,13 @@ class ServingEngine:
                 raise ValueError(
                     f"spec_decode must be >= 1 draft tokens, got "
                     f"{spec_decode}")
-            if self.cfg.do_sample:
+            if sp is not None and sp.mask_processor is not None:
                 raise ValueError(
-                    "spec_decode requires a greedy engine "
-                    "(do_sample=False): acceptance compares drafts "
-                    "against the target argmax")
+                    "spec_decode cannot compose with a token-mask "
+                    "processor: a draft position's mask depends on "
+                    "host-side state the drafter bypasses — submit "
+                    "the request without spec_decode (sampling "
+                    "without a mask composes fine)")
         if n + m - 1 > self.max_cache_len:
             raise ValueError(
                 f"prompt ({n}) + max_new_tokens ({m}) - 1 = {n + m - 1} "
@@ -684,6 +750,20 @@ class ServingEngine:
                       pad_token_id=self.cfg.pad_token_id)
         req.submit_time = now
         req.spec_k = spec_k
+        req.sampling = sp
+        if sp is not None and not sp.is_greedy:
+            # an explicit seed draws from the USER's stream (the
+            # seeded-determinism contract: same seed => same stream,
+            # whatever the batch around it looked like); seedless
+            # sampled requests — explicit params with seed=None or the
+            # engine default — fold the request id into the engine
+            # seed, so concurrent streams stay independent of each
+            # other but a replayed trace (same submission order)
+            # reproduces
+            req.samp_base = (base_key(sp.seed) if sp.seed is not None
+                             else np.asarray(jax.random.fold_in(
+                                 jax.random.PRNGKey(self._seed),
+                                 req.request_id), np.uint32))
         if spec_k is not None:
             # only AFTER every validation above: a rejected submit must
             # not widen the engine-lifetime verify width (or install
@@ -717,6 +797,26 @@ class ServingEngine:
                     req.matched.append(b)
                 if req.matched:
                     self._update_block_gauges()
+            if sp is not None and sp.mask_processor is not None:
+                # host state-machine init + width check, AFTER the
+                # prefix probe: a raise here (bad table width, a
+                # processor rejecting the prompt) rolls back through
+                # the same unpin path as any other post-probe failure
+                sp.mask_processor.begin(ids[:n])
+                allowed0 = np.asarray(sp.mask_processor.allowed(), bool)
+                if allowed0.size != self._vocab:
+                    raise ValueError(
+                        f"mask_processor.allowed() is {allowed0.size} "
+                        f"wide but the model vocabulary is {self._vocab}")
+                if not allowed0.any():
+                    # an all-banned state would make the bias plane a
+                    # uniform shift (no constraint at all) and the
+                    # emitted token illegal — reject up front; mid-
+                    # stream dead ends instead FINISH the request (see
+                    # the advance sites)
+                    raise ValueError(
+                        "mask_processor allows no token in its start "
+                        "state — the grammar has no legal first output")
             self._next_id += 1
             self._queue.append(req)
             self._peak_queue = max(self._peak_queue, len(self._queue))
@@ -846,6 +946,91 @@ class ServingEngine:
         self._m.slot_occupancy.set(
             sum(r is not None for r in self._slots))
 
+    def _build_samp(self, reqs):
+        """The ``samp`` plane pytree of one dispatch: ``reqs`` is the
+        dispatch's batch view (one Optional[Request] per row; None =
+        vacant/frozen/not-riding).  Flags come from the ACTIVE rows
+        only, so the planes and the compiled program variant stay in
+        lockstep; rows without a request get NEUTRAL values (greedy
+        mask on, temp 1, zero bias) — their draws are computed-and-
+        discarded, never consumed.  PRNG positions are re-derived from
+        host truth (``len(req.tokens)``) on every dispatch, which is
+        the whole rewind story: a speculative rollback shrinks
+        ``tokens``, so the rolled-back positions are simply keyed and
+        drawn again next forward."""
+        flags = flags_of([r.sampling for r in reqs if r is not None])
+        sampled, _filtered, penalty, bias = flags
+        n = len(reqs)
+        samp = {}
+        if sampled:
+            base = np.zeros((n, 2), np.uint32)
+            pos = np.zeros((n,), np.int32)
+            temp = np.ones((n,), np.float32)
+            top_k = np.zeros((n,), np.int32)
+            top_p = np.ones((n,), np.float32)
+            greedy = np.ones((n,), bool)
+            for i, r in enumerate(reqs):
+                if r is None:
+                    continue
+                temp[i], top_k[i], top_p[i], greedy[i] = \
+                    row_planes(r.sampling)
+                pos[i] = len(r.tokens)
+                if r.samp_base is not None:
+                    base[i] = r.samp_base
+            samp.update(
+                base=jnp.asarray(base), pos=jnp.asarray(pos),
+                temp=jnp.asarray(temp), top_k=jnp.asarray(top_k),
+                top_p=jnp.asarray(top_p), greedy=jnp.asarray(greedy))
+        if penalty:
+            rep = np.ones((n,), np.float32)
+            presence = np.zeros((n, self._vocab), bool)
+            for i, r in enumerate(reqs):
+                if r is None or r.sampling is None \
+                        or not r.sampling.needs_penalty:
+                    continue
+                rep[i] = r.sampling.repetition_penalty
+                presence[i, r.prompt[:r.seq_len]] = True
+                if r.tokens:
+                    presence[i, np.asarray(r.tokens, np.int32)] = True
+            samp["rep"] = jnp.asarray(rep)
+            samp["presence"] = jnp.asarray(presence)
+        if bias:
+            bias_p = np.zeros((n, self._vocab), np.float32)
+            for i, r in enumerate(reqs):
+                if r is None or r.sampling is None \
+                        or r.sampling.mask_processor is None:
+                    continue
+                allowed = np.asarray(
+                    r.sampling.mask_processor.allowed(), bool)
+                bias_p[i, ~allowed] = MASK_BIAS
+            samp["bias"] = jnp.asarray(bias_p)
+        return flags, samp
+
+    def _count_sample_route(self, reqs_tokens):
+        """Classify emitted tokens into the serving.sample.* route
+        counters; ``reqs_tokens`` is (request, n_emitted) pairs."""
+        for r, k in reqs_tokens:
+            sp = r.sampling
+            if sp is None or sp.is_greedy:
+                self._m.sample_greedy_tokens.inc(k)
+            else:
+                self._m.sample_sampled_tokens.inc(k)
+            if sp is not None and sp.mask_processor is not None:
+                self._m.sample_masked_tokens.inc(k)
+
+    def _mask_dead_end(self, req: Request) -> bool:
+        """Advance the request's token-mask state machine past its
+        LAST emitted token and report whether the grammar completed:
+        an ``allowed()`` with no legal continuation is the EOS of a
+        constrained stream (the natural encoding of an accept state in
+        a DFA that does not map EOS), and the caller finishes the
+        request there.  The ONE advance site semantics for both the
+        chunk-final and decode-block paths — call only for LIVE mask
+        requests (finished requests need no future mask)."""
+        mp = req.sampling.mask_processor
+        mp.advance(int(req.tokens[-1]))
+        return not np.asarray(mp.allowed(), bool).any()
+
     def _prefill_chunk(self, out: List[Request]):
         """Run at most ONE prompt chunk (FIFO over admissions).  The
         final chunk of a prompt samples the request's first token and
@@ -855,18 +1040,18 @@ class ServingEngine:
             return
         req = self._prefilling[0]
         start, c = req.pf_pos, self.chunk_len
-        self._key, sub = jax.random.split(self._key)
+        flags, samp = self._build_samp([req])
         t0 = self._clock()
         with _span("serving.prefill", request=req.request_id,
                    slot=req.slot, start=start):
             outp = _call_quiet(
-                self._chunk_fn, self._pb,
+                self._chunk_fn(flags), self._pb,
                 jnp.asarray(req.chunk_ids[None, start:start + c]),
                 jnp.asarray(start, jnp.int32),
                 jnp.asarray(req.seq_len, jnp.int32),
-                jnp.asarray(self._tables[req.slot][None, :]), sub,
+                jnp.asarray(self._tables[req.slot][None, :]), samp,
                 *self._arenas)
-            self._arenas = list(outp[2:])
+            self._arenas = list(outp[1:])
             tok0 = int(np.asarray(outp[0])[0])
         self._m.prefill_chunks.inc()
         self._m.chunk_latency.observe(self._clock() - t0)
@@ -890,10 +1075,19 @@ class ServingEngine:
             self._m.ttft.observe(req.ttft)
         req.tokens.append(tok0)
         req.remaining = req.max_new_tokens - 1
+        self._count_sample_route([(req, 1)])
         slot = req.slot
         if (self.cfg.eos_token_id is not None and
                 tok0 == self.cfg.eos_token_id) or req.remaining == 0:
             # finished at the first token: never enters the decode mix
+            self._slots[slot] = None
+            self._done[slot] = True
+            self._release_blocks(req)
+            self._finish(req, t, out)
+            return
+        if req.sampling is not None and \
+                req.sampling.mask_processor is not None and \
+                self._mask_dead_end(req):
             self._slots[slot] = None
             self._done[slot] = True
             self._release_blocks(req)
@@ -908,14 +1102,26 @@ class ServingEngine:
         # reads its own host-side truth (req.tokens / self._lens)
         self._done[slot] = req.spec_k is not None
 
-    def _block_fn(self, steps: int):
-        fn = self._blocks.get(steps)
+    def _chunk_fn(self, flags):
+        fn = self._chunk_fns.get(flags)
+        if fn is None:
+            fn = jax.jit(
+                build_chunk_prefill(self._model, self.cfg,
+                                    kv_int8=self._kv_int8,
+                                    samp_flags=flags),
+                donate_argnums=self._donate)
+            self._chunk_fns[flags] = fn
+        return fn
+
+    def _block_fn(self, steps: int, flags):
+        fn = self._blocks.get((steps, flags))
         if fn is None:
             fn = jax.jit(
                 _build_paged_decode_block(self._model, self.cfg, steps,
-                                          kv_int8=self._kv_int8),
+                                          kv_int8=self._kv_int8,
+                                          samp_flags=flags),
                 donate_argnums=self._donate)
-            self._blocks[steps] = fn
+            self._blocks[(steps, flags)] = fn
         return fn
 
     def _block_rides(self, i: int, r: Request) -> bool:
@@ -945,15 +1151,15 @@ class ServingEngine:
                 tbl[i] = self._tables[i]
         return tbl
 
-    def _verify_fn(self, steps: int):
-        fn = self._verify_fns.get(steps)
+    def _verify_fn(self, steps: int, flags):
+        fn = self._verify_fns.get((steps, flags))
         if fn is None:
             fn = jax.jit(
                 build_spec_verify(self._model, self.cfg, steps,
-                                  kv_int8=self._kv_int8),
-                donate_argnums=tuple(
-                    5 + i for i in range(len(self._arenas))))
-            self._verify_fns[steps] = fn
+                                  kv_int8=self._kv_int8,
+                                  samp_flags=flags),
+                donate_argnums=self._donate)
+            self._verify_fns[(steps, flags)] = fn
         return fn
 
     def _spec_verify(self, out: List[Request]):
@@ -1019,13 +1225,25 @@ class ServingEngine:
             toks[i, 1:1 + d.size] = d
             n_valid[i] = 1 + d.size
             tbl[i] = self._tables[i]
+        spec_set = set(spec)
+        flags, samp = self._build_samp(
+            [r if i in spec_set else None
+             for i, r in enumerate(self._slots)])
         with _span("serving.spec_verify", width=width, active=len(spec)):
             outp = _call_quiet(
-                self._verify_fn(width), self._pb, jnp.asarray(toks),
+                self._verify_fn(width, flags), self._pb,
+                jnp.asarray(toks),
                 jnp.asarray(self._lens), jnp.asarray(n_valid),
-                jnp.asarray(tbl), *self._arenas)
-            greedy = np.asarray(outp[0])                # [B, width]
-        self._arenas = list(outp[1:])
+                jnp.asarray(tbl), samp, *self._arenas)
+            if flags[0]:
+                # sampled mix: the verify also returned the position-
+                # keyed stochastic-sampling draws ([B, width] each)
+                greedy, u, accept_p, resample, sample = (
+                    np.asarray(x) for x in outp[:5])
+                self._arenas = list(outp[5:])
+            else:
+                greedy = np.asarray(outp[0])            # [B, width]
+                self._arenas = list(outp[1:])
         self._m.spec_verifies.inc()
         # the K-wide kernel DMAs the STATIC width's frontier
         # (lens + cq - 1) for every spec row, however few positions
@@ -1035,11 +1253,19 @@ class ServingEngine:
         t = self._clock()
         for i in spec:
             req = self._slots[i]
-            emitted, accepted = accept_drafts(
-                greedy[i], drafts[i], self.cfg.eos_token_id)
+            sp = req.sampling
+            if sp is not None and not sp.is_greedy:
+                emitted, accepted, resamples = accept_drafts_sampled(
+                    drafts[i], u[i], accept_p[i], resample[i],
+                    sample[i], self.cfg.eos_token_id)
+                self._m.sample_resamples.inc(resamples)
+            else:
+                emitted, accepted = accept_drafts(
+                    greedy[i], drafts[i], self.cfg.eos_token_id)
             self._m.spec_accepted_len.observe(float(accepted))
             self._m.spec_accepted_tokens.inc(accepted)
             self._m.tokens_emitted.inc(len(emitted))
+            self._count_sample_route([(req, len(emitted))])
             req.tokens.extend(emitted)
             req.remaining -= len(emitted)
             self._lens[i] += len(emitted)
@@ -1085,23 +1311,38 @@ class ServingEngine:
             return finished
         # a full block only when no active request can finish inside it
         # (a block never overshoots a budget or a block table); otherwise
-        # drop to exact iteration-level single steps
+        # drop to exact iteration-level single steps.  Mask-constrained
+        # rows clamp the mix to single steps too: their bias plane is
+        # valid for exactly ONE emitted token — the host state machine
+        # must observe it before the next bias can be built.  The clamp
+        # prices ALL co-resident rows at one dispatch per token while a
+        # masked row is live (deliberate: masked workloads are latency-
+        # shaped and the alternative — freezing masked rows out of the
+        # n-step block via the done plane and feeding them a second
+        # 1-step dispatch per iteration — doubles dispatches and
+        # accounting paths for a mix this engine rarely sees)
         min_budget = min(self._slots[i].remaining for i in active)
-        n = self.steps_per_call if min_budget >= self.steps_per_call \
-            else 1
+        masked = any(self._slots[i].sampling is not None and
+                     self._slots[i].sampling.mask_processor is not None
+                     for i in active)
+        n = 1 if (min_budget < self.steps_per_call or masked) \
+            else self.steps_per_call
+        active_set = set(active)
+        riding = [self._slots[i] if i in active_set else None
+                  for i in range(self.num_slots)]
+        flags, samp = self._build_samp(riding)
         pre_lens = self._lens
         with _span("serving.decode_block", steps=n, active=len(active)):
             out = _call_quiet(
-                self._block_fn(n),
+                self._block_fn(n, flags),
                 self._pb, jnp.asarray(self._tok), jnp.asarray(self._lens),
-                jnp.asarray(self._done), self._key,
+                jnp.asarray(self._done), samp,
                 jnp.asarray(self._decode_tables()), *self._arenas)
             toks = np.asarray(out[0])                   # [B, n]
         self._tok = np.array(out[1])    # np.array: writable host copies
         self._lens = np.array(out[2])
         done = np.array(out[3])
-        self._key = out[4]
-        self._arenas = list(out[5:])
+        self._arenas = list(out[4:])
         self._m.decode_steps.inc(n)
         self._m.busy_slot_steps.inc(n * len(active))
         self._m.block_dispatches.inc()
@@ -1114,6 +1355,7 @@ class ServingEngine:
         self._count_kv_sweep(
             [min(int(pre_lens[i]) + s, int(self._lens[i]))
              for i in active for s in range(n)])
+        self._count_sample_route([(self._slots[i], n) for i in active])
         t = self._clock()
         for i in active:
             req = self._slots[i]
@@ -1122,6 +1364,16 @@ class ServingEngine:
             if done[i] or req.remaining == 0:
                 self._slots[i] = None
                 done[i] = True         # freeze the row until re-use
+                self._release_blocks(req)
+                self._finish(req, t, finished)
+            elif req.sampling is not None and \
+                    req.sampling.mask_processor is not None and \
+                    self._mask_dead_end(req):
+                # n == 1 for mask rows (clamped above), so exactly one
+                # token was appended; finish THIS request — co-resident
+                # rows are untouched
+                self._slots[i] = None
+                done[i] = True
                 self._release_blocks(req)
                 self._finish(req, t, finished)
         self._done = done
@@ -1174,7 +1426,10 @@ class ServingEngine:
         bonus per spec slot) tokens, so the per-forward multiplier is
         n_spec_slots + this value (1 + it only at a single spec slot);
         ``spec_acceptance_rate`` is token-granular over drafted
-        tokens."""
+        tokens.  ``sampled_tokens``/``greedy_tokens`` split emitted
+        tokens by sampling route (``masked_tokens`` of them carried an
+        active token-mask constraint); ``sample_resamples`` counts
+        residual draws consumed by stochastic speculative sampling."""
         decode_steps = self._m.since_init(self._m.decode_steps)
         busy = self._m.since_init(self._m.busy_slot_steps)
         occ = (busy / (decode_steps * self.num_slots)
@@ -1227,6 +1482,14 @@ class ServingEngine:
                                      if drafted else 0.0),
             "spec_mean_accepted_len": (accepted / verifies
                                        if verifies else 0.0),
+            "sampled_tokens": int(
+                self._m.since_init(self._m.sample_sampled_tokens)),
+            "greedy_tokens": int(
+                self._m.since_init(self._m.sample_greedy_tokens)),
+            "masked_tokens": int(
+                self._m.since_init(self._m.sample_masked_tokens)),
+            "sample_resamples": int(
+                self._m.since_init(self._m.sample_resamples)),
         }
 
     @property

@@ -157,6 +157,42 @@ class ModelDrafter(Drafter):
             np.int32)
 
 
+def accept_drafts_sampled(drafts, u_row, accept_p_row, resample_row,
+                          sample_row,
+                          eos_token_id: Optional[int] = None
+                          ) -> Tuple[List[int], int, int]:
+    """The stochastic acceptance rule (speculative SAMPLING — Leviathan
+    et al. 2023; Chen et al. 2023), specialized to one-hot draft
+    distributions: draft j is accepted iff its accept-test uniform
+    ``u_row[j]`` falls under ``accept_p_row[j] = p_j(draft_j)``
+    (``min(1, p/q)`` at ``q = 1``); the first rejection emits the
+    in-trace draw from the normalized residual ``max(0, p - q)``
+    (``resample_row[j]``), and full acceptance emits the bonus draw
+    from ``p_K`` (``sample_row[K]``).  Every draw was made in-trace
+    with position-keyed PRNG (``sampling.spec_sampling_draws``), so
+    this host walk only COMPARES and SELECTS — it consumes exactly one
+    lane-1 draw per emitted stream position, which is what makes the
+    output distribution equal the non-speculative sampled engine's and
+    the PRNG rewind under rollback sound.  An accepted EOS stops
+    acceptance (same contract as the greedy rule).
+
+    Returns ``(emitted, accepted, resamples)`` — the emitted token
+    list, the accepted-draft count, and whether a residual resample
+    was consumed (0/1)."""
+    emitted: List[int] = []
+    a = 0
+    while a < len(drafts) and float(u_row[a]) < float(accept_p_row[a]):
+        emitted.append(int(drafts[a]))
+        a += 1
+        if eos_token_id is not None and emitted[-1] == eos_token_id:
+            return emitted, a, 0
+    if a < len(drafts):
+        emitted.append(int(resample_row[a]))
+        return emitted, a, 1
+    emitted.append(int(sample_row[a]))
+    return emitted, a, 0
+
+
 def accept_drafts(greedy_row, drafts,
                   eos_token_id: Optional[int] = None
                   ) -> Tuple[List[int], int]:
@@ -188,49 +224,72 @@ def accept_drafts(greedy_row, drafts,
     return emitted, a
 
 
-def build_spec_verify(model, cfg, steps: int, kv_int8: bool = False):
+def build_spec_verify(model, cfg, steps: int, kv_int8: bool = False,
+                      samp_flags=(False, False, False, False)):
     """The compiled verifier program: ONE target forward scores
     ``steps`` positions per slot (the last emitted token plus up to
-    ``steps - 1`` draft candidates) against the paged KV arena and
-    returns every position's greedy argmax.
+    ``steps - 1`` draft candidates) against the paged KV arena.
 
     Generalizes the chunked-prefill program (``build_chunk_prefill``)
     from batch-1 x shared-start to per-row starts over the whole slot
     mix (``models.*.verify_step`` / ``paged_verify_scatter`` /
     ``decode_attention_paged_multi``), and the decode block from 1 to
-    ``steps`` positions per dispatch.  Greedy-only by construction:
-    acceptance compares the DRAFT against the target's argmax, which
-    is an exact-equivalence argument only for deterministic decoding
-    (``sample_token`` with ``do_sample=False`` — and with ``top_k=1``
-    sampling degenerating to the same argmax; rejection sampling for
-    temperature>0 is future work).  ``kv_int8`` selects the quantized
-    paged cache — the verify forward then reads int8 codes + scales and
-    its K/V writes quantize on append, so drafting/acceptance runs
-    against exactly the arena the decode path maintains.  Signature:
+    ``steps`` positions per dispatch.  ``samp_flags`` (see
+    ``_build_paged_decode_block``) selects the output protocol:
+
+    - all-greedy mix: every position's argmax of the processed logits
+      — the longest-matching-prefix acceptance path (``accept_drafts``)
+      — and nothing else; bit-exact with the pre-sampling program for
+      default rows.
+    - sampled mix: argmax PLUS the position-keyed stochastic-sampling
+      draws (``sampling.spec_sampling_draws``: the accept-test
+      uniforms, per-draft acceptance probabilities ``p_j(d_j)``,
+      residual resamples and full samples) consumed by
+      ``accept_drafts_sampled`` — the distribution-preserving
+      speculative-sampling protocol.  Greedy rows inside a sampled mix
+      still walk the argmax path on the host; their extra draws are
+      discarded.
+
+    Token-mask constrained rows never reach a verify (the engine
+    rejects ``mask_processor`` + ``spec_decode`` at submit: a draft
+    position's mask depends on host state the drafter bypasses), so
+    the bias flag is structurally False here.  ``kv_int8`` selects the
+    quantized paged cache — the verify forward then reads int8 codes +
+    scales and its K/V writes quantize on append, so drafting/
+    acceptance runs against exactly the arena the decode path
+    maintains.  Signature:
     ``(p_values, toks [B, C], lens [B], n_valid [B],
-    tables [B, max_blocks], *flat_arenas) ->
-    (greedy [B, C], *flat_arenas)``."""
-    if cfg.do_sample:
-        raise ValueError(
-            "speculative verification is greedy-only: acceptance "
-            "compares drafts against the target argmax, which matches "
-            "the sampled stream only at temperature 0 / top_k=1")
+    tables [B, max_blocks], samp, *flat_arenas) ->
+    (greedy [B, C][, u, accept_p, resample, sample], *flat_arenas)``."""
     if cfg.num_beams > 1:
         raise ValueError(
-            "speculative verification is greedy-only — beam search "
-            "scores K beams per request, not K draft positions of one "
-            "stream")
+            "speculative verification does not support beam search — "
+            "it scores K beams per request, not K draft positions of "
+            "one stream")
     if steps < 1:
         raise ValueError(f"verify steps must be >= 1, got {steps}")
+    if samp_flags[3]:
+        raise ValueError(
+            "token-mask constrained decoding cannot ride a verify "
+            "forward (mask state is host-side and per emitted token)")
     from .llm import _flatten_paged_kvs, _pack_paged_kvs, _param_swapper
+    from .sampling import spec_greedy_rows, spec_sampling_draws
 
     _with_params = _param_swapper(model, cfg)
+    sampled, _filtered, penalty, _bias = samp_flags
 
-    def verify_pure(p_values, toks, lens, n_valid, tables, *flat_arenas):
+    def verify_pure(p_values, toks, lens, n_valid, tables, samp,
+                    *flat_arenas):
         def run():
             kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
             logits, kvs_f = model.verify_step(toks, lens, n_valid, kvs)
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            pres = samp["presence"] if penalty else None
+            if sampled:
+                draws = spec_sampling_draws(logits, toks, samp,
+                                            samp_flags, pres)
+                return draws + tuple(_flatten_paged_kvs(kvs_f))
+            greedy = spec_greedy_rows(logits, toks, samp, samp_flags,
+                                      pres)
             return (greedy,) + tuple(_flatten_paged_kvs(kvs_f))
         return _with_params(p_values, run)
 

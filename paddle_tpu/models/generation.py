@@ -64,6 +64,7 @@ class GenerationConfig:
     do_sample: bool = False
     temperature: float = 1.0
     top_k: int = 0                   # 0 = full softmax
+    top_p: float = 1.0               # nucleus sampling; 1.0 = off
     num_beams: int = 1               # >1 = beam search (greedy scoring)
     length_penalty: float = 0.0      # beam score /= len**alpha at selection
     eos_token_id: Optional[int] = None
@@ -338,14 +339,55 @@ def cached_decode_attention(q, k_cache, v_cache, lens):
     return decode_attention(q, k_cache, v_cache, lens)
 
 
+def filter_top_k_top_p(lg, top_k, top_p):
+    """Per-row temperature-scaled-logits filtering: dynamic top-k
+    (``top_k[b] <= 0`` keeps everything) then nucleus top-p on the
+    top-k-filtered distribution (``top_p[b] = 1`` keeps everything).
+    One descending sort serves both: each filter keeps a PREFIX of
+    sorted order, so the cut is a per-row threshold logit and ties at
+    the threshold are kept (the standard over-inclusive tie rule).
+
+    The single implementation of the nucleus prefix/tie rule — the
+    whole-batch ``sample_token`` config and the serving engine's
+    per-request planes (``inference/sampling.py``) both call it, so
+    ``generate()`` and ``ServingEngine`` can never drift apart on
+    top-k/top-p semantics."""
+    v = lg.shape[-1]
+    srt = jnp.sort(lg, axis=-1)[..., ::-1]
+    j = jnp.arange(v)
+    keep_k = (top_k[..., None] <= 0) | (j < top_k[..., None])
+    probs = jax.nn.softmax(jnp.where(keep_k, srt, -jnp.inf), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # smallest prefix with cumulative mass >= p; position 0 always kept
+    keep = keep_k & ((cum - probs) < top_p[..., None])
+    nkeep = jnp.maximum(keep.sum(-1), 1)
+    kth = jnp.take_along_axis(srt, (nkeep - 1)[..., None], axis=-1)
+    return jnp.where(lg < kth, -jnp.inf, lg)
+
+
 def sample_token(logits, key, cfg: GenerationConfig):
-    """Greedy argmax or temperature/top-k categorical. logits: [B, V]."""
+    """Greedy argmax or temperature/top-k/top-p categorical.
+    logits: [B, V].  Filter order is the conventional warp sequence
+    (temperature, then top-k, then nucleus top-p over the already
+    top-k-filtered distribution) via :func:`filter_top_k_top_p` with
+    the static config broadcast to per-row planes; per-REQUEST planes
+    live in ``inference/sampling.py`` — this is the static whole-batch
+    config of ``generate()`` / ``LLMPredictor``."""
     if not cfg.do_sample:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     lg = logits.astype(jnp.float32) / jnp.maximum(cfg.temperature, 1e-6)
-    if cfg.top_k and cfg.top_k > 0:
+    if cfg.top_k and cfg.top_k > 0 and cfg.top_p >= 1.0:
+        # pure top-k keeps the cheap lax.top_k threshold (same
+        # keep-ties-at-kth rule as the full filter, without its
+        # whole-vocab sort)
         kth = jax.lax.top_k(lg, cfg.top_k)[0][..., -1:]
         lg = jnp.where(lg < kth, -jnp.inf, lg)
+    elif (cfg.top_k and cfg.top_k > 0) or cfg.top_p < 1.0:
+        rows = lg.shape[:-1]
+        lg = filter_top_k_top_p(
+            lg,
+            jnp.full(rows, int(cfg.top_k or 0), jnp.int32),
+            jnp.full(rows, float(cfg.top_p), jnp.float32))
     return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
 
 
@@ -594,7 +636,8 @@ class GenerationMixin:
         return compiled
 
     def generate(self, input_ids, seq_lens=None, max_new_tokens=32,
-                 do_sample=False, temperature=1.0, top_k=0, num_beams=1,
+                 do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                 num_beams=1,
                  length_penalty=0.0, eos_token_id=None, pad_token_id=0,
                  max_cache_len=None, compute_dtype="bfloat16",
                  cache_dtype=None, seed=0):
@@ -639,6 +682,7 @@ class GenerationMixin:
         cfg = GenerationConfig(
             max_new_tokens=int(max_new_tokens), do_sample=bool(do_sample),
             temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p),
             num_beams=int(num_beams),
             length_penalty=float(length_penalty),
             eos_token_id=eos_token_id, pad_token_id=int(pad_token_id),

@@ -433,18 +433,21 @@ def test_metrics_name_lint_clean():
               "serving.prefill_chunks", "serving.requests_cancelled",
               "serving.prefill_chunk_seconds"):
         assert n in names, n
-    # the speculative-decoding and int8-KV sets are both registered
-    # AND enforced by the lint's required-instruments rule (rule 4:
-    # deleting a registration site must fail the lint, not flatline a
-    # dashboard)
+    # the speculative-decoding, int8-KV and sampling sets are all
+    # registered AND enforced by the lint's required-instruments rule
+    # (rule 4: deleting a registration site must fail the lint, not
+    # flatline a dashboard)
     for n, kind in lint.REQUIRED_INSTRUMENTS.items():
-        assert n.startswith(("serving.spec.", "serving.kv.")), n
+        assert n.startswith(
+            ("serving.spec.", "serving.kv.", "serving.sample.")), n
         assert n in names, n
     kinds = {r[3]: r[2] for r in regs}
     assert kinds["serving.spec.accepted_length"] == "histogram"
     assert kinds["serving.spec.verify_steps"] == "counter"
     assert kinds["serving.kv.bytes_swept"] == "counter"
     assert kinds["serving.kv.quant_dtype"] == "gauge"
+    assert kinds["serving.sample.sampled_tokens"] == "counter"
+    assert kinds["serving.sample.resamples"] == "counter"
     # rule 4 fires on a missing required name
     import tempfile
     with tempfile.TemporaryDirectory() as empty_root:

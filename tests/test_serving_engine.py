@@ -728,3 +728,17 @@ def test_bench_llm_serving_section():
     assert 0.0 < spec["acceptance_rate"] <= 1.0
     # the distribution and the verify counter cover the same window
     assert sum(spec["accepted_length_counts"]) == spec["verify_steps"]
+    samp = out["sampling"]
+    for k in ("temperature", "top_k", "greedy_tokens_per_s",
+              "sampled_tokens_per_s", "spec_sampled_tokens_per_s",
+              "sampled_vs_greedy", "spec_sampled_vs_sampled",
+              "sampled_tokens", "resamples", "mean_accepted_len",
+              "greedy_spec_mean_accepted_len", "accepted_len_delta",
+              "acceptance_rate"):
+        assert k in samp, k
+    # the sampled arms really sampled (and spec-sampling really hit
+    # the residual-resample branch at least once on this trace)
+    assert samp["sampled_tokens"] > 0
+    assert samp["resamples"] > 0
+    assert samp["sampled_tokens_per_s"] > 0
+    assert samp["spec_sampled_tokens_per_s"] > 0

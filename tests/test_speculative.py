@@ -107,17 +107,27 @@ def test_accept_drafts_rule():
 
 def test_build_spec_verify_guards(netm):
     cfg, net = netm
+    from paddle_tpu.inference.sampling import DfaTokenMask, SamplingParams
     from paddle_tpu.models.generation import GenerationConfig
-    with pytest.raises(ValueError, match="greedy-only"):
-        build_spec_verify(net, GenerationConfig(do_sample=True), 4)
-    with pytest.raises(ValueError, match="greedy-only"):
+    with pytest.raises(ValueError, match="beam"):
         build_spec_verify(net, GenerationConfig(num_beams=2), 4)
     with pytest.raises(ValueError, match="steps"):
         build_spec_verify(net, GenerationConfig(), 0)
+    # token-mask rows structurally never reach a verify program
+    with pytest.raises(ValueError, match="mask"):
+        build_spec_verify(net, GenerationConfig(), 4,
+                          samp_flags=(True, False, False, True))
+    # sampling + spec_decode now composes (stochastic speculative
+    # sampling); the ONE unsupported combo is a mask processor + spec
     eng = ServingEngine(net, num_slots=1, prompt_len=4, max_cache_len=8,
                         do_sample=True, compute_dtype="float32")
-    with pytest.raises(ValueError, match="greedy"):
-        eng.submit(np.zeros((4,), np.int32), spec_decode=2)
+    eng.submit(np.zeros((4,), np.int32), max_new_tokens=4, spec_decode=2)
+    mask = DfaTokenMask(np.zeros((1, cfg.vocab_size), np.int32))
+    with pytest.raises(ValueError, match="mask"):
+        eng.submit(np.zeros((4,), np.int32), max_new_tokens=4,
+                   spec_decode=2,
+                   sampling=SamplingParams(temperature=0.7,
+                                           mask_processor=mask))
     eng2 = ServingEngine(net, num_slots=1, prompt_len=4, max_cache_len=8,
                          compute_dtype="float32")
     with pytest.raises(ValueError, match="spec_decode"):

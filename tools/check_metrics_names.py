@@ -63,6 +63,14 @@ REQUIRED_INSTRUMENTS = {
     # the per-dtype presence gauge
     "serving.kv.bytes_swept": "counter",
     "serving.kv.quant_dtype": "gauge",
+    # per-request sampling (inference/serving.py _ServingInstruments):
+    # the sampled-vs-greedy route split, the constrained-decoding
+    # masked-token count, and the speculative-sampling residual
+    # resamples the bench's sampling arm keys on
+    "serving.sample.sampled_tokens": "counter",
+    "serving.sample.greedy_tokens": "counter",
+    "serving.sample.masked_tokens": "counter",
+    "serving.sample.resamples": "counter",
 }
 
 
