@@ -790,6 +790,14 @@ def _bench_serving(on_tpu):
     (teacher-forced greedy token agreement >= 0.98 and |dNLL| <= 1%
     through the paged cache path, mirroring the weight-int8 gate of
     ``_bench_decode``).
+
+    A sixth A/B isolates OVERLOAD RESILIENCE (``overload``
+    sub-object): a bursty trace whose long low-priority requests pin
+    the block pool against a burst of short high-priority ones, run
+    with KV preemption + host-RAM swap ON vs OFF — the deltas are the
+    interactive class's p99 TTFT and, under a queue-delay SLO,
+    the completion rate (the no-preempt arm sheds-by-timeout what it
+    cannot serve in time), plus a bounded-queue shed demo.
     """
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -1230,6 +1238,140 @@ def _bench_serving(on_tpu):
                  "nll_ok": abs(delta_nll_pct) <= 1.0},
     }
 
+    # -- overload arm: a bursty trace that oversubscribes the BLOCK
+    # POOL (two long low-priority background requests pin nearly every
+    # block, then a burst of short high-priority interactive requests
+    # arrives) runs with preemption ON vs OFF.  With preemption the
+    # scheduler swaps a long victim's KV to the host-RAM tier and
+    # serves the burst; without it the burst queues behind the
+    # long-tail requests.  Reported: p99 TTFT of the interactive class
+    # (no-SLO replay, everything completes, the delta is pure queueing)
+    # and completion rate under a queue-delay SLO calibrated between
+    # the two arms' TTFTs (replayed with max_queue_delay_s, the
+    # no-preempt arm sheds-by-timeout what it cannot serve in time).
+    # The serving.preempt.*/swap.*/shed.*/timeout.* registry deltas
+    # land in the run's ``metrics`` sub-object like every other
+    # instrument this section fires --
+    from paddle_tpu.inference import AdmissionError, FaultInjector
+
+    if on_tpu:
+        ov_prompt, ov_block, ov_cache = 64, 16, 256
+        ov_long_new, ov_short_new, ov_n_short = 192, 16, 6
+    else:
+        ov_prompt, ov_block, ov_cache = 16, 8, 80
+        ov_long_new, ov_short_new, ov_n_short = 64, 8, 6
+    ov_plen = 12                       # both classes' prompt length
+    long_blocks = -(-(ov_plen + ov_long_new - 1) // ov_block)
+    short_blocks = -(-(ov_plen + ov_short_new - 1) // ov_block)
+    # two longs pin all but (short_blocks - 1) blocks: a short can
+    # never be admitted beside them without preemption
+    ov_blocks = 2 * long_blocks + short_blocks - 1
+    ov_long_ids = [rng.integers(0, cfg.vocab_size,
+                                ov_plen).astype(np.int32)
+                   for _ in range(2)]
+    ov_short_ids = [rng.integers(0, cfg.vocab_size,
+                                 ov_plen).astype(np.int32)
+                    for _ in range(ov_n_short)]
+
+    def _one_overload_trace(preempt, short_delay):
+        fi = FaultInjector()
+        eng = ServingEngine(
+            model, num_slots=3, prompt_len=ov_prompt,
+            max_cache_len=ov_cache, steps_per_call=steps_per_call,
+            block_len=ov_block, num_blocks=ov_blocks,
+            compute_dtype=compute_dtype, enable_preemption=preempt,
+            fault_injector=fi)
+        # warm chunk + both decode block sizes + the swap-out gather /
+        # swap-in scatter programs (a forced round-trip outside the
+        # timed window, identical ritual in both arms)
+        wr = eng.submit(ov_long_ids[0],
+                        max_new_tokens=steps_per_call + 2)
+        eng.step()
+        fi.force_swap(wr.request_id)
+        eng.run()
+        warm = eng.stats()
+        t0 = time.perf_counter()
+        longs = [eng.submit(ids, max_new_tokens=ov_long_new,
+                            arrival_time=t0, priority=0)
+                 for ids in ov_long_ids]
+        # the longs must be ADMITTED (holding the pool) before the
+        # burst arrives — that is the overload scenario; two steps run
+        # both prefill chunks, then the interactive burst lands on a
+        # pinned pool and only preemption can serve it promptly
+        eng.step()
+        eng.step()
+        shorts = [eng.submit(ids, max_new_tokens=ov_short_new,
+                             priority=1, max_queue_delay_s=short_delay)
+                  for ids in ov_short_ids]
+        eng.run()
+        final = eng.stats()
+        served = [r for r in longs + shorts if r.state == "finished"]
+        ttfts = sorted(r.ttft for r in shorts if r.ttft is not None)
+        return {
+            "short_ttfts": ttfts,
+            "completion_rate": len(served) / (2 + ov_n_short),
+            "timeouts": final["timeouts"] - warm["timeouts"],
+            "preemptions": final["preemptions"] - warm["preemptions"],
+            "swap_blocks_out": final["swap_blocks_out"]
+            - warm["swap_blocks_out"],
+        }
+
+    # phase 1 (no SLO): the pure-queueing p99 TTFT delta
+    ov_on = _one_overload_trace(preempt=True, short_delay=None)
+    ov_off = _one_overload_trace(preempt=False, short_delay=None)
+    on_p99 = ov_on["short_ttfts"][-1] if ov_on["short_ttfts"] else 0.0
+    off_p99 = ov_off["short_ttfts"][-1] if ov_off["short_ttfts"] else 0.0
+    # phase 2 (queue-delay SLO calibrated BETWEEN the arms — the
+    # geometric mean of the preempt arm's p99 and the no-preempt arm's
+    # fastest short admission, i.e. an SLO the preempt arm meets and
+    # the no-preempt arm cannot): the completion-rate delta
+    off_min = (ov_off["short_ttfts"][0]
+               if ov_off["short_ttfts"] else 0.1)
+    ov_delay = float(np.sqrt(max(on_p99, 1e-6) * max(off_min, 1e-6)))
+    ov_on_slo = _one_overload_trace(preempt=True, short_delay=ov_delay)
+    ov_off_slo = _one_overload_trace(preempt=False,
+                                     short_delay=ov_delay)
+
+    # bounded-queue shed micro-demo (pure host admission, no compute):
+    # a full queue rejects an equal-class arrival with AdmissionError
+    # and evicts a lower-class request for a higher-class one
+    shed_eng = ServingEngine(
+        model, num_slots=1, prompt_len=ov_prompt,
+        max_cache_len=ov_cache, block_len=ov_block,
+        compute_dtype=compute_dtype, max_queue=2)
+    far = time.perf_counter() + 1e6
+    shed_eng.submit(ov_short_ids[0], max_new_tokens=2,
+                    arrival_time=far, priority=1)
+    low = shed_eng.submit(ov_short_ids[1], max_new_tokens=2,
+                          arrival_time=far, priority=0)
+    shed_rejected = 0
+    try:
+        shed_eng.submit(ov_short_ids[2], max_new_tokens=2,
+                        arrival_time=far, priority=0)
+    except AdmissionError:
+        shed_rejected = 1
+    shed_eng.submit(ov_short_ids[3], max_new_tokens=2,
+                    arrival_time=far, priority=2)   # evicts `low`
+    shed_evicted = int(low.state == "shed")
+
+    overload = {
+        "n_long": 2, "n_short": ov_n_short,
+        "long_new": ov_long_new, "short_new": ov_short_new,
+        "num_blocks": ov_blocks,
+        "p99_ttft_ms": round(on_p99 * 1e3, 1),
+        "no_preempt_p99_ttft_ms": round(off_p99 * 1e3, 1),
+        "ttft_vs_no_preempt": round(off_p99 / max(on_p99, 1e-9), 3),
+        "preemptions": ov_on["preemptions"],
+        "swap_blocks_out": ov_on["swap_blocks_out"],
+        "short_delay_slo_ms": round(ov_delay * 1e3, 1),
+        "completion_rate": ov_on_slo["completion_rate"],
+        "no_preempt_completion_rate": ov_off_slo["completion_rate"],
+        "slo_timeouts": ov_on_slo["timeouts"],
+        "no_preempt_slo_timeouts": ov_off_slo["timeouts"],
+        "shed_demo": {"rejected": shed_rejected,
+                      "evicted": shed_evicted},
+    }
+
     return {
         "tokens_per_s": cont["tokens_per_s"],
         "p50_latency_ms": cont["p50_latency_ms"],
@@ -1259,6 +1401,7 @@ def _bench_serving(on_tpu):
                 pfx_off["peak_blocks_in_use"],
         },
         "kv_int8": kv_int8,
+        "overload": overload,
         "spec": {
             "k": sp_k, "max_new": sp_new, "n_requests": sp_n,
             "tokens_per_s": spec_on["tokens_per_s"],

@@ -14,10 +14,13 @@ with Config, zero-copy IO handles, clone-per-thread). The redesign:
 
 from .predictor import Config, Predictor, create_predictor  # noqa: F401
 from .llm import LLMPredictor  # noqa: F401
-from .serving import Request, ServingEngine  # noqa: F401
+from .serving import (AdmissionError, EngineStalledError,  # noqa: F401
+                      Request, ServingEngine)
+from .faultinject import FaultInjector  # noqa: F401
 from .speculative import (Drafter, ModelDrafter,  # noqa: F401
                           NGramDrafter)
 
 __all__ = ["Config", "Predictor", "create_predictor", "LLMPredictor",
            "Request", "ServingEngine", "Drafter", "NGramDrafter",
-           "ModelDrafter"]
+           "ModelDrafter", "AdmissionError", "EngineStalledError",
+           "FaultInjector"]

@@ -1,0 +1,124 @@
+"""Fault-injection harness for the serving engine.
+
+Overload resilience is a claim about behavior under conditions a
+healthy box never produces on its own — an exhausted block pool, a
+scheduler that keeps losing its allocation race, a step that takes
+seconds instead of milliseconds.  This module is the ONE hook point
+the ``ServingEngine`` consults (``ServingEngine(fault_injector=...)``)
+so tests can drive those conditions deterministically and then assert
+the invariants that define "no wedge":
+
+- ``BlockPool.check()`` stays clean after every injected failure (no
+  refcount drift, no double-free, no leaked block);
+- ``run(wall_timeout_s=...)`` raises a diagnosable
+  ``EngineStalledError`` instead of spinning forever when progress is
+  impossible;
+- clearing the fault lets the SAME engine drain to completion with
+  token-exact outputs — injected failures are delays, never
+  corruption.
+
+Three injectable failure modes:
+
+- **allocation exhaustion** (``fail_allocs``): the engine's next N (or
+  every) ``BlockPool.alloc`` call returns ``None`` as if the pool were
+  dry — exercises admission back-off, the head-of-line valve and the
+  preemption path without needing a trace that actually fills HBM.
+- **forced swap-out** (``force_swap``): the named in-flight request is
+  preempted to the host-RAM tier at the top of the next ``step()``
+  regardless of pool pressure — the deterministic driver of the
+  preempt/resume byte-parity tests.
+- **step stall** (``stall_steps``): the next N ``step()`` calls sleep
+  ``seconds`` before doing any work — a stand-in for a wedged device
+  dispatch, paired with ``run(wall_timeout_s=...)`` regression tests.
+
+The injector is pure host state with no engine back-references: one
+injector can be armed before the engine exists and inspected after it
+is gone.  ``events`` records every fault that actually FIRED (armed
+faults that never triggered do not appear), so tests can assert the
+schedule they meant to inject is the schedule the engine saw.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import List, Optional, Tuple
+
+
+class FaultInjector:
+    """Deterministic fault schedule consumed by ``ServingEngine``.
+
+    All ``take_*`` methods are called BY the engine at its hook points
+    and consume the armed fault; ``fail_*``/``force_*``/``stall_*``
+    methods are called by the test to arm them.  Thread-unsafe by
+    design: the scheduler is single-threaded host code and the tests
+    drive it synchronously.
+    """
+
+    def __init__(self):
+        self._alloc_budget = 0        # finite failures left
+        self._alloc_always = False
+        self._forced: List[int] = []  # request ids to preempt
+        self._stalls: deque = deque()  # seconds, one per upcoming step
+        self.events: List[Tuple[str, Optional[int]]] = []
+
+    # -- arming (test side) --
+    def fail_allocs(self, n: Optional[int] = None):
+        """Make the engine's next ``n`` block allocations fail as if
+        the pool were exhausted; ``n=None`` fails EVERY allocation
+        until ``clear_alloc_failures()``."""
+        if n is None:
+            self._alloc_always = True
+        else:
+            if int(n) < 1:
+                raise ValueError(f"n must be >= 1 allocs, got {n}")
+            self._alloc_budget += int(n)
+
+    def clear_alloc_failures(self):
+        self._alloc_budget = 0
+        self._alloc_always = False
+
+    def force_swap(self, request_id: int):
+        """Preempt the given in-flight request (swap its KV blocks to
+        the host tier) at the top of the next ``step()``, regardless
+        of pool pressure or scheduling class.  Unknown / not-in-flight
+        ids are silently skipped by the engine — arming is a schedule,
+        not an assertion."""
+        self._forced.append(int(request_id))
+
+    def stall_steps(self, n: int, seconds: float):
+        """Make the next ``n`` ``step()`` calls sleep ``seconds``
+        before any scheduling work — an artificial wedged-dispatch
+        stand-in for ``run(wall_timeout_s=...)`` tests."""
+        if int(n) < 1:
+            raise ValueError(f"n must be >= 1 steps, got {n}")
+        if float(seconds) < 0:
+            raise ValueError(f"seconds must be >= 0, got {seconds}")
+        self._stalls.extend([float(seconds)] * int(n))
+
+    # -- consumption (engine side) --
+    def take_alloc_failure(self) -> bool:
+        """True when THIS allocation should fail (consumes one armed
+        failure unless armed with ``n=None``)."""
+        if self._alloc_always:
+            self.events.append(("alloc_fail", None))
+            return True
+        if self._alloc_budget > 0:
+            self._alloc_budget -= 1
+            self.events.append(("alloc_fail", None))
+            return True
+        return False
+
+    def take_forced_swaps(self) -> List[int]:
+        """Request ids to force-preempt this step (consumes them)."""
+        out, self._forced = self._forced, []
+        for rid in out:
+            self.events.append(("forced_swap", rid))
+        return out
+
+    def take_stall(self) -> float:
+        """Seconds THIS step should stall (0.0 = no stall armed)."""
+        if not self._stalls:
+            return 0.0
+        s = self._stalls.popleft()
+        self.events.append(("stall", None))
+        return s

@@ -204,6 +204,44 @@ def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call,
     return block_pure
 
 
+def build_swap_out_gather():
+    """Swap-out reader for KV preemption (ServingEngine): gather one
+    slot's table row out of EVERY arena in one compiled call —
+    ``(ids [W], *flat_arenas) -> tuple of [W, ...] row stacks`` where
+    ``W = max_blocks`` (the slot's full table, trash-padded past the
+    request's allocation, so the shape is engine-static and the
+    program compiles exactly once).  The gathered rows are the EXACT
+    at-rest bytes of the request's blocks — float K/V, or int8 codes
+    plus their f32 scale planes, whichever the arena holds — which is
+    what makes preempt/resume byte-identical rather than
+    recompute-and-hope.  Trash-row gathers past the allocation are
+    finite garbage the resume scatter routes straight back to the
+    trash row."""
+    def gather_pure(ids, *flat_arenas):
+        return tuple(jnp.take(a, ids, axis=0) for a in flat_arenas)
+    return gather_pure
+
+
+def build_swap_in_scatter(n_arenas):
+    """Donation-matched re-scatter for preemption RESUME: write a
+    swapped-out request's saved block rows into its freshly allocated
+    arena rows — ``(ids [W], *rows (n_arenas of [W, ...]),
+    *flat_arenas) -> flat_arenas`` with the arenas donated, same
+    discipline as the decode/chunk/verify programs (steady-state
+    serving never materializes a second arena copy).  ``ids`` is the
+    resumed slot's NEW table row: entries past the request's
+    allocation point at the trash row, so pad rows of the saved stack
+    land there (the write-masking contract of every other paged
+    writer) and duplicate trash writes only ever overwrite finite
+    garbage with finite garbage."""
+    def scatter_pure(ids, *rows_and_arenas):
+        rows = rows_and_arenas[:n_arenas]
+        arenas = rows_and_arenas[n_arenas:]
+        return tuple(a.at[ids].set(r.astype(a.dtype))
+                     for a, r in zip(arenas, rows))
+    return scatter_pure
+
+
 def build_chunk_prefill(model, cfg: GenerationConfig, kv_int8=False,
                         samp_flags=(False, False, False, False)):
     """Chunked-prefill program for the paged ServingEngine: ONE prompt

@@ -86,6 +86,40 @@ the spec-mode slots (drafter proposals + the paged verify machinery of
 plain decode block, emitting the accepted draft prefix plus one
 correction token per slot — token-for-token the sequential greedy
 stream, at a fraction of the target forwards when drafts verify.
+
+**Overload resilience** (preemption + host-RAM swap + SLO-aware
+scheduling): under sustained overload a FIFO scheduler has no
+graceful-degradation story — a long-tail request wedges the pool
+behind the head-of-line valve and an unbounded queue just grows.
+This engine degrades deliberately instead:
+
+- ``submit(priority=, deadline_s=, max_queue_delay_s=)`` makes the
+  queue a priority-then-EDF order (higher priority first, earlier
+  deadline first within a priority, FIFO within a class — so traces
+  that never pass the new kwargs schedule exactly as before);
+- a bounded queue (``max_queue=``) sheds on arrival: a full queue
+  either evicts its worst queued request (strictly lower class than
+  the arrival, state ``"shed"``) or rejects the arrival with a typed
+  ``AdmissionError`` — never silent unbounded growth;
+- queued requests whose wait exceeds their ``max_queue_delay_s``
+  finish with state ``"timeout"`` instead of being served late;
+- when admission cannot allocate blocks, the scheduler PREEMPTS a
+  strictly-worse victim (policy: lowest priority, then latest
+  deadline, then most remaining work): the victim's pinned blocks are
+  copied out of the arenas into a host-RAM tier at EXACT at-rest
+  bytes (float K/V or int8 codes + scale planes; ``llm.py``'s
+  ``build_swap_out_gather``), its HBM blocks release, and it parks on
+  a swap list.  Re-admission re-allocates fresh blocks and re-scatters
+  the saved bytes (``build_swap_in_scatter``, donation-matched) and
+  restores the slot's ``tok``/``lens`` carries — so the resumed
+  request's greedy output stays token-for-token identical to
+  uninterrupted ``generate()``, and the position-keyed per-request
+  PRNG (PR 6) makes resumed SAMPLED streams free too.
+- ``run(wall_timeout_s=...)`` turns a wedged pool into a diagnosable
+  ``EngineStalledError``; ``inference/faultinject.py`` injects
+  allocation exhaustion / forced swaps / step stalls so tests prove
+  no wedge, no block leak and no refcount drift
+  (``BlockPool.check()``) under adversarial schedules.
 """
 
 from __future__ import annotations
@@ -106,11 +140,35 @@ from ..models.generation import (GenerationConfig, init_paged_kv_arena,
 from ..observability import metrics as obs_metrics
 from ..observability.spans import instant as _span_instant
 from ..observability.spans import span as _span
-from .llm import _build_paged_decode_block, build_chunk_prefill
+from .llm import (_build_paged_decode_block, build_chunk_prefill,
+                  build_swap_in_scatter, build_swap_out_gather)
 from .sampling import (MASK_BIAS, SamplingParams, base_key, flags_of,
                        row_planes)
 from .speculative import (NGramDrafter, accept_drafts,
                           accept_drafts_sampled, build_spec_verify)
+
+
+class AdmissionError(RuntimeError):
+    """A bounded queue (``ServingEngine(max_queue=N)``) refused an
+    arrival: the queue is full and no queued request is of strictly
+    lower scheduling class than the new one, so the ARRIVAL is the
+    right thing to shed.  Typed so callers can degrade (retry with
+    backoff, spill to another replica, fail the RPC with 429) instead
+    of pattern-matching a message."""
+
+    def __init__(self, msg, *, queue_depth=None, max_queue=None):
+        super().__init__(msg)
+        self.queue_depth = queue_depth
+        self.max_queue = max_queue
+
+
+class EngineStalledError(RuntimeError):
+    """``run(wall_timeout_s=...)`` exceeded its wall budget without
+    draining — the diagnosable form of a wedged scheduler (pool
+    exhausted with nothing running, an injected fault, a dispatch that
+    never returns).  The message carries the queue / slot / block-pool
+    state at the moment of the raise so the wedge is debuggable from
+    the exception alone."""
 
 
 class _ServingInstruments:
@@ -161,7 +219,49 @@ class _ServingInstruments:
             "serving.requests_finished", "requests retired (EOS or budget)")
         self.requests_cancelled = r.counter(
             "serving.requests_cancelled",
-            "still-queued requests dropped by cancel()")
+            "requests dropped by cancel(); the label says which phase "
+            "the request was cancelled from (queued / prefill / "
+            "decode / swapped)", labels=("phase",))
+        self.preempts = r.counter(
+            "serving.preempt.requests",
+            "in-flight requests preempted (KV blocks swapped to the "
+            "host-RAM tier, slot freed) so a higher-class request "
+            "could be admitted — or a fault-injection forced swap")
+        self.preempt_resumes = r.counter(
+            "serving.preempt.resumes",
+            "preempted requests re-admitted from the swap list (fresh "
+            "blocks allocated, saved bytes re-scattered, decode state "
+            "restored)")
+        self.swap_out_blocks = r.counter(
+            "serving.swap.blocks_out",
+            "KV blocks copied out of the arenas into the host-RAM "
+            "swap tier at preemption")
+        self.swap_in_blocks = r.counter(
+            "serving.swap.blocks_in",
+            "KV blocks re-scattered from the host-RAM swap tier into "
+            "freshly allocated arena rows at resume")
+        self.swap_out_bytes = r.counter(
+            "serving.swap.bytes_out",
+            "at-rest KV bytes (codes + scale planes for the int8 "
+            "cache) swapped out to host RAM")
+        self.swap_in_bytes = r.counter(
+            "serving.swap.bytes_in",
+            "at-rest KV bytes swapped back into the arenas at resume")
+        self.swap_host_blocks = r.gauge(
+            "serving.swap.host_blocks",
+            "KV blocks currently parked in the host-RAM swap tier "
+            "(hwm = peak swap-tier footprint in blocks)")
+        self.shed = r.counter(
+            "serving.shed.requests",
+            "requests shed by the bounded queue: 'evicted' = a queued "
+            "request displaced by a strictly-higher-class arrival, "
+            "'rejected' = an arrival refused with AdmissionError",
+            labels=("reason",))
+        self.timeouts = r.counter(
+            "serving.timeout.requests",
+            "queued requests finished with status 'timeout' because "
+            "their wait exceeded max_queue_delay_s — shed-by-deadline "
+            "instead of served-late")
         self.evictions = r.counter(
             "serving.slot_evictions", "slot frees at request retirement")
         self.prefix_hits = r.counter(
@@ -260,12 +360,20 @@ class _ServingInstruments:
                   self.spec_draft_misses, self.spec_draft_tokens,
                   self.spec_accepted_tokens, self.kv_bytes_swept,
                   self.sample_sampled_tokens, self.sample_greedy_tokens,
-                  self.sample_masked_tokens, self.sample_resamples):
-            self._base[c.name] = c.value()
+                  self.sample_masked_tokens, self.sample_resamples,
+                  self.preempts, self.preempt_resumes,
+                  self.swap_out_blocks, self.swap_in_blocks,
+                  self.swap_out_bytes, self.swap_in_bytes,
+                  self.shed, self.timeouts):
+            # total() sums label sets, so labeled counters (cancelled
+            # by phase, shed by reason) baseline the same way the
+            # unlabeled ones do
+            self._base[c.name] = c.total()
 
     def since_init(self, counter) -> float:
-        """Counter delta attributable to THIS engine."""
-        return counter.value() - self._base.get(counter.name, 0)
+        """Counter delta attributable to THIS engine (summed over
+        label sets for labeled counters)."""
+        return counter.total() - self._base.get(counter.name, 0)
 
 
 def _call_quiet(fn, *args):
@@ -299,6 +407,16 @@ def _block_digests(ids: np.ndarray, n: int, block_len: int,
             digest_size=16).digest()
         out.append(h)
     return out
+
+
+_INF = float("inf")
+
+
+def _neg_deadline(deadline: Optional[float]) -> float:
+    """Deadline term of the "worseness" ordering: no deadline sorts as
+    infinitely late (most shed-able / most preempt-able), and later
+    deadlines sort before earlier ones."""
+    return -(deadline if deadline is not None else _INF)
 
 
 class BlockPool:
@@ -392,6 +510,91 @@ class BlockPool:
             out.append(b)
         return out
 
+    def check(self) -> bool:
+        """Full invariant audit; raises ``RuntimeError`` listing every
+        violation, returns True when clean.  Called by tests and the
+        fault-injection harness after adversarial schedules — the
+        invariants that define "no leak, no double-free, no refcount
+        drift":
+
+        - conservation: free + pinned (ref > 0) + cached (LRU) covers
+          every block exactly once;
+        - the free list has no duplicates and no pinned/cached member;
+        - free blocks are unmapped (no digest — alloc clears it);
+        - every LRU member has refcount 0 and a digest mapping back to
+          itself;
+        - ``_by_digest`` and ``_digest_of`` are a bijection;
+        - no negative refcount (``unpin`` raises before one can form,
+          so a violation here means state was corrupted directly)."""
+        errs = []
+        free_set = set(self._free)
+        if len(free_set) != len(self._free):
+            errs.append(f"free list holds duplicates: {self._free}")
+        lru_set = set(self._lru.values())
+        pinned = 0
+        for b in range(self.num_blocks):
+            ref = self._ref[b]
+            dg = self._digest_of[b]
+            if ref < 0:
+                errs.append(f"block {b}: negative refcount {ref}")
+            if ref > 0:
+                pinned += 1
+                if b in free_set or b in lru_set:
+                    errs.append(
+                        f"block {b}: refcount {ref} but on the "
+                        f"{'free list' if b in free_set else 'LRU'}")
+            elif not (b in free_set or b in lru_set):
+                errs.append(f"block {b}: refcount 0 but neither free "
+                            f"nor cached — leaked")
+            if b in free_set and b in lru_set:
+                errs.append(f"block {b}: both free and LRU-cached")
+            if b in free_set and dg is not None:
+                errs.append(f"block {b}: free but still digest-mapped")
+            if dg is not None and self._by_digest.get(dg) != b:
+                errs.append(
+                    f"block {b}: digest points at block "
+                    f"{self._by_digest.get(dg)} in _by_digest")
+        for dg, b in self._by_digest.items():
+            if self._digest_of[b] != dg:
+                errs.append(f"_by_digest maps {dg.hex()} -> {b} but "
+                            f"block {b} carries digest "
+                            f"{self._digest_of[b] and self._digest_of[b].hex()}")
+        for dg, b in self._lru.items():
+            if self._ref[b] != 0:
+                errs.append(f"LRU block {b}: refcount {self._ref[b]}")
+            if self._digest_of[b] != dg:
+                errs.append(f"LRU digest {dg.hex()} maps block {b} "
+                            f"whose digest differs")
+        if len(self._free) + pinned + len(self._lru) != self.num_blocks:
+            errs.append(
+                f"conservation: free({len(self._free)}) + "
+                f"pinned({pinned}) + cached({len(self._lru)}) != "
+                f"num_blocks({self.num_blocks})")
+        if errs:
+            raise RuntimeError(
+                "BlockPool.check failed:\n  " + "\n  ".join(errs))
+        return True
+
+
+@dataclass
+class _SwapRecord:
+    """A preempted request's device state, parked in host RAM.
+
+    ``rows`` holds one ``[n_blocks, ...]`` numpy stack per flat arena
+    — the request's real blocks at the arena's exact at-rest dtype
+    (float K/V, or int8 codes plus f32 scale planes), sliced out of
+    the fixed-shape full-table gather so the host tier holds exactly
+    the bytes its accounting reports; resume re-pads to table width
+    (pad rows scatter into the trash row).  ``tok``/``lens`` are the
+    slot's device carries at preemption; with them and the bytes
+    restored, the resumed request is bit-identical to one that was
+    never preempted."""
+    rows: List[np.ndarray]
+    n_blocks: int
+    tok: int
+    lens: int
+    state: str                     # "prefill" | "decode"
+
 
 @dataclass
 class Request:
@@ -402,8 +605,16 @@ class Request:
     ``generate()``), and ``output`` is always exactly
     ``max_new_tokens`` long — token-for-token what a static-batch
     greedy ``generate()`` of this request alone would return.
-    ``state`` walks queued -> prefill -> decode -> finished (or
-    cancelled from queued).
+    ``state`` walks queued -> prefill -> decode -> finished, with the
+    overload detours: ``swapped`` (preempted to the host-RAM tier,
+    resumes into prefill/decode), ``timeout`` (queue wait exceeded
+    ``max_queue_delay_s``), ``shed`` (displaced from a full bounded
+    queue) and ``cancelled`` (dropped from any live phase).
+
+    ``priority`` (higher = more important) and ``deadline`` (absolute
+    clock time, None = no deadline) define the scheduling class:
+    admission is priority-then-EDF, preemption victims come from
+    strictly lower classes only.
     """
     request_id: int
     prompt: np.ndarray                 # [prompt_len] padded
@@ -418,6 +629,11 @@ class Request:
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
     state: str = "queued"
+    priority: int = 0                  # higher admits/survives first
+    deadline: Optional[float] = None   # absolute clock() time
+    max_queue_delay_s: Optional[float] = None
+    swap: Optional[_SwapRecord] = None
+    preempt_count: int = 0
     spec_k: Optional[int] = None       # speculative mode: drafts/verify
     sampling: Optional[SamplingParams] = None  # None = plain greedy
     samp_base: Optional[np.ndarray] = None     # [2] u32 PRNG base key
@@ -468,8 +684,16 @@ class ServingEngine:
                  compute_dtype="bfloat16", cache_dtype=None,
                  kv_cache_dtype=None,
                  seed=0, static_batching=False, clock=time.perf_counter,
-                 registry=None):
+                 registry=None, max_queue=None, enable_preemption=True,
+                 fault_injector=None):
         self.num_slots = int(num_slots)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError(
+                f"max_queue must be >= 1 (or None = unbounded), got "
+                f"{max_queue}")
+        self.enable_preemption = bool(enable_preemption)
+        self._fault = fault_injector
         self.prompt_len = int(prompt_len)
         self.max_cache_len = int(max_cache_len or (prompt_len + 256))
         self.steps_per_call = int(steps_per_call)
@@ -608,6 +832,10 @@ class ServingEngine:
         self._slots: List[Optional[Request]] = [None] * self.num_slots
         self._queue: deque = deque()
         self._prefilling: deque = deque()
+        self._swapped: List[Request] = []   # preempted, host-RAM KV
+        self._host_blocks = 0               # blocks in the swap tier
+        self._swap_out_fn = None            # lazy: engines that never
+        self._swap_in_fn = None             # preempt compile neither
         self._finished: List[Request] = []
         self._clock = clock
         self._next_id = 0
@@ -659,6 +887,12 @@ class ServingEngine:
         self._m.kv_bytes_swept.inc(rows * self._kv_row_bytes)
 
     def _release_blocks(self, req: Request):
+        """Unpin every block the request holds and trash its table
+        row.  IDEMPOTENT by construction: the block list is cleared
+        before returning, so a second call (a finish racing a cancel,
+        a fault-handler retry) unpins nothing — double-release is a
+        no-op here, and an unpin below refcount 0 still raises inside
+        the pool as the backstop."""
         for b in req.blocks:
             self._pool.unpin(b)
         req.blocks = []
@@ -667,10 +901,21 @@ class ServingEngine:
             self._tables[req.slot] = self._pool.trash
         self._update_block_gauges()
 
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        """``BlockPool.alloc`` behind the fault-injection hook: an
+        armed allocation failure makes the pool look dry to exactly
+        this call — admission back-off, the valve and preemption all
+        exercise their real paths."""
+        if self._fault is not None and self._fault.take_alloc_failure():
+            return None
+        return self._pool.alloc(n)
+
     # -- request intake --
     def submit(self, prompt_ids, seq_len=None, max_new_tokens=32,
                arrival_time=None, spec_decode=None,
-               sampling: Optional[SamplingParams] = None) -> Request:
+               sampling: Optional[SamplingParams] = None,
+               priority: int = 0, deadline_s: Optional[float] = None,
+               max_queue_delay_s: Optional[float] = None) -> Request:
         """Enqueue one request.  ``prompt_ids`` is a 1-D id array of at
         most ``prompt_len`` tokens (right-padded internally);
         ``arrival_time`` (in ``clock()`` units) lets a trace replay
@@ -693,7 +938,22 @@ class ServingEngine:
         mask depends on host state the drafter bypasses.  With
         prefix caching on, the prompt's full blocks are probed against
         the cache here and any hits are PINNED so they cannot be
-        reclaimed while the request waits."""
+        reclaimed while the request waits.
+
+        SLO knobs: ``priority`` (int, higher admits first and is
+        preempted last; default 0), ``deadline_s`` (seconds from
+        arrival — EDF order within a priority and the tie-breaker for
+        victim selection; never itself a kill switch) and
+        ``max_queue_delay_s`` (a QUEUE-WAIT bound: a request still
+        queued after this many seconds finishes with state
+        ``"timeout"`` instead of being served late — once admitted it
+        always runs to completion).  With ``max_queue=N`` set on the
+        engine, a full queue sheds — AFTER every validation, so an
+        invalid submission never displaces anyone: expired queued
+        entries are first swept to ``"timeout"``, then either some
+        queued request of strictly lower class than this arrival is
+        displaced (state ``"shed"``) or THIS submit raises
+        ``AdmissionError`` and nothing is enqueued."""
         ids = np.asarray(getattr(prompt_ids, "_value", prompt_ids))
         ids = np.asarray(ids).reshape(-1).astype(np.int32)
         if ids.size < 1 or ids.size > self.prompt_len:
@@ -741,16 +1001,30 @@ class ServingEngine:
                 f"{self.block_len} ({n + m - 1} tokens) but the pool "
                 f"only has num_blocks={self.num_blocks} — it could "
                 f"never be admitted")
+        prio = int(priority)
+        if deadline_s is not None and float(deadline_s) <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0 seconds from arrival, got "
+                f"{deadline_s}")
+        if max_queue_delay_s is not None and float(max_queue_delay_s) < 0:
+            raise ValueError(
+                f"max_queue_delay_s must be >= 0, got {max_queue_delay_s}")
         padded = np.full((self.prompt_len,), self.cfg.pad_token_id,
                          np.int32)
         padded[:ids.size] = ids
         now = self._clock()
-        req = Request(self._next_id, padded, n, m,
-                      now if arrival_time is None else float(arrival_time),
+        arrival = now if arrival_time is None else float(arrival_time)
+        deadline = None if deadline_s is None \
+            else arrival + float(deadline_s)
+        req = Request(self._next_id, padded, n, m, arrival,
                       pad_token_id=self.cfg.pad_token_id)
         req.submit_time = now
         req.spec_k = spec_k
         req.sampling = sp
+        req.priority = prio
+        req.deadline = deadline
+        req.max_queue_delay_s = (None if max_queue_delay_s is None
+                                 else float(max_queue_delay_s))
         if sp is not None and not sp.is_greedy:
             # an explicit seed draws from the USER's stream (the
             # seeded-determinism contract: same seed => same stream,
@@ -764,13 +1038,6 @@ class ServingEngine:
                              else np.asarray(jax.random.fold_in(
                                  jax.random.PRNGKey(self._seed),
                                  req.request_id), np.uint32))
-        if spec_k is not None:
-            # only AFTER every validation above: a rejected submit must
-            # not widen the engine-lifetime verify width (or install
-            # the default drafter) for requests that never ran
-            if self._drafter is None:
-                self._drafter = NGramDrafter()
-            self._spec_k_max = max(self._spec_k_max, spec_k)
         # chunk grid: any slice [start, start + chunk_len) with
         # start < seq_len must be in range
         req.chunk_ids = np.full((self.prompt_len + self.chunk_len,),
@@ -817,11 +1084,59 @@ class ServingEngine:
                     raise ValueError(
                         "mask_processor allows no token in its start "
                         "state — the grammar has no legal first output")
+            # bounded queue LAST, after EVERY validation above: an
+            # invalid submission must never destroy an innocent queued
+            # victim.  Expired (past-max_queue_delay_s) entries are
+            # swept first so dead weight the next step would drop as
+            # timeouts neither blocks a fresh admission nor gets
+            # mislabeled "shed".  Then either the WORST queued request
+            # (lowest priority, then latest deadline, then newest
+            # submission) is marked for displacement — only a STRICTLY
+            # lower class than the arrival; within a class the earlier
+            # submission keeps its place — or the arrival is rejected.
+            # The victim is shed only after the new request is safely
+            # enqueued, so a late failure (a raising span hook) rolls
+            # the arrival back without having harmed the victim.
+            evict = None
+            if self.max_queue is not None and \
+                    len(self._queue) >= self.max_queue:
+                self._sweep_timeouts(now, [])
+            if self.max_queue is not None and \
+                    len(self._queue) >= self.max_queue:
+                worst = min(reversed(self._queue), key=self._shed_key)
+                if self._shed_key(worst) < (prio,
+                                            _neg_deadline(deadline)):
+                    evict = worst
+                else:
+                    self._m.shed.inc(reason="rejected")
+                    _span_instant("serving.request.reject",
+                                  queue_depth=len(self._queue))
+                    raise AdmissionError(
+                        f"queue full ({len(self._queue)} >= max_queue="
+                        f"{self.max_queue}) and no queued request is "
+                        f"of strictly lower class than this arrival "
+                        f"(priority={prio}, deadline_s={deadline_s})",
+                        queue_depth=len(self._queue),
+                        max_queue=self.max_queue)
+            if spec_k is not None:
+                # only AFTER every validation AND the bounded-queue
+                # decision above: a rejected submit — ValueError or
+                # AdmissionError — must not widen the engine-lifetime
+                # verify width (or install the default drafter) for a
+                # request that never ran
+                if self._drafter is None:
+                    self._drafter = NGramDrafter()
+                self._spec_k_max = max(self._spec_k_max, spec_k)
             self._next_id += 1
             self._queue.append(req)
-            self._peak_queue = max(self._peak_queue, len(self._queue))
             _span_instant("serving.request.queued",
                           request=req.request_id, seq_len=n, max_new=m)
+            if evict is not None:
+                self._shed(evict, now)
+            # peak AFTER a pending eviction: the one-element overshoot
+            # between append and shed is submit-internal, not a depth
+            # the scheduler ever saw
+            self._peak_queue = max(self._peak_queue, len(self._queue))
             # counters LAST: a failure above (e.g. a raising span hook)
             # rolls the queue and pins back, but a Counter cannot be
             # decremented — incrementing only once nothing can raise
@@ -840,23 +1155,54 @@ class ServingEngine:
         return req
 
     def cancel(self, request_id: int) -> bool:
-        """Drop a STILL-QUEUED request: removes it from the queue and
-        releases any prefix-cache pins its submit-time match took.
-        Returns False when the request is unknown or already admitted —
-        in-flight work is not preempted (its blocks free at
-        retirement)."""
+        """Drop a request from ANY live phase.  Queued: removed from
+        the queue, submit-time prefix pins released.  Swapped: the
+        host-RAM copy is dropped (its HBM blocks were already freed at
+        preemption).  In-flight (prefill or decode): the slot freezes
+        through the existing trash-block discipline — ``done=True``
+        plus an all-trash table row means any write the frozen row
+        still issues lands in the trash block, never in a block a new
+        occupant owns — and its blocks release immediately instead of
+        at retirement.  The ``serving.requests_cancelled`` counter's
+        ``phase`` label records which phase paid.  Every cancelled
+        request is uniformly terminal — ``finish_time`` set, output
+        padded to ``max_new_tokens`` — like the shed/timeout
+        terminals.  Returns False for unknown or already-terminal
+        requests."""
+        now = self._clock()
         for req in self._queue:
             if req.request_id == request_id:
-                self._queue.remove(req)
-                for b in req.matched:
-                    self._pool.unpin(b)
-                req.matched = []
-                req.state = "cancelled"
-                self._m.requests_cancelled.inc()
-                self._m.queue_depth.set(len(self._queue))
-                self._update_block_gauges()
+                self._drop_queued(req, now, "cancelled")
+                self._m.requests_cancelled.inc(phase="queued")
                 _span_instant("serving.request.cancel",
-                              request=req.request_id)
+                              request=req.request_id, phase="queued")
+                return True
+        for req in self._swapped:
+            if req.request_id == request_id:
+                self._swapped.remove(req)
+                self._host_blocks -= req.swap.n_blocks
+                self._m.swap_host_blocks.set(self._host_blocks)
+                req.swap = None
+                self._terminate(req, now, "cancelled")
+                self._m.requests_cancelled.inc(phase="swapped")
+                _span_instant("serving.request.cancel",
+                              request=req.request_id, phase="swapped")
+                return True
+        for i, req in enumerate(self._slots):
+            if req is not None and req.request_id == request_id:
+                phase = req.state
+                if req in self._prefilling:
+                    self._prefilling.remove(req)
+                self._release_blocks(req)   # also trashes the table row
+                self._slots[i] = None
+                self._done[i] = True
+                req.slot = None
+                self._terminate(req, now, "cancelled")
+                self._m.requests_cancelled.inc(phase=phase)
+                self._m.slot_occupancy.set(
+                    sum(r is not None for r in self._slots))
+                _span_instant("serving.request.cancel",
+                              request=req.request_id, phase=phase)
                 return True
         return False
 
@@ -880,22 +1226,293 @@ class ServingEngine:
         self._finished.append(req)
         out.append(req)
 
-    def _admit(self, now: float):
-        """Map queue-head requests (FIFO over arrivals) into vacant
-        slots: extend the prefix match against blocks published since
-        submit, allocate the remaining blocks, and hand the request to
-        the chunked-prefill queue.  Gang mode (``static_batching``)
-        only admits into an EMPTY pool — the static-batch baseline
-        scheduler."""
+    # -- SLO scheduling keys --
+    @staticmethod
+    def _sched_key(r: Request):
+        """Admission order (smaller admits first): highest priority,
+        then earliest deadline (EDF; no deadline sorts last within the
+        priority).  Sorting is STABLE over submission order, so within
+        one (priority, deadline) class the queue stays FIFO — a trace
+        that never passes the SLO kwargs schedules exactly as before."""
+        return (-r.priority, r.deadline if r.deadline is not None
+                else _INF)
+
+    @staticmethod
+    def _shed_key(r: Request):
+        """"Worseness" (smaller = worse = shed/preempt first): lowest
+        priority, then latest deadline (no deadline = latest)."""
+        return (r.priority, _neg_deadline(r.deadline))
+
+    @staticmethod
+    def _remaining_work(r: Request) -> int:
+        """Victim tie-breaker: tokens of compute still owed (prompt
+        positions left to prefill plus the decode budget) — preempting
+        the LONGEST remaining tail frees its blocks for the longest
+        time per swap."""
+        if r.state == "prefill":
+            return (r.seq_len - r.pf_pos) + r.max_new_tokens
+        return r.remaining
+
+    def _terminate(self, req: Request, now: float, state: str):
+        """Mark a request terminal without it running to completion —
+        the ONE terminal shape shared by shed, timeout and cancel:
+        terminal state, ``finish_time`` set, output padded to exactly
+        ``max_new_tokens`` (the Request docstring's uniform-output
+        contract)."""
+        req.state = state
+        req.finish_time = now
+        req.tokens.extend([self.cfg.pad_token_id]
+                          * (req.max_new_tokens - len(req.tokens)))
+
+    def _drop_queued(self, req: Request, now: float, state: str):
+        """The ONE teardown for a queued request leaving without
+        running (shed by the bounded queue, timed out past its
+        queue-delay SLO, or cancelled from the queue): remove from the
+        queue, release submit-time prefix pins, mark terminal, refresh
+        the queue/block gauges.  The caller adds its own counter and
+        span."""
+        self._queue.remove(req)
+        for b in req.matched:
+            self._pool.unpin(b)
+        req.matched = []
+        self._terminate(req, now, state)
+        self._m.queue_depth.set(len(self._queue))
+        self._update_block_gauges()
+
+    def _shed(self, req: Request, now: float):
+        """Displace a queued request from a full bounded queue:
+        terminal, like timeout, but charged to queue pressure."""
+        self._drop_queued(req, now, "shed")
+        self._m.shed.inc(reason="evicted")
+        _span_instant("serving.request.shed", request=req.request_id)
+
+    def _sweep_timeouts(self, now: float, out: List[Request]):
+        """Finish queued requests whose wait exceeded their
+        ``max_queue_delay_s`` with state ``"timeout"`` — the SLO says
+        a late answer is worthless, so the scheduler sheds it instead
+        of serving it late.  Only QUEUED requests can time out:
+        admitted (and swapped — they already ran) requests always
+        complete."""
+        for r in [r for r in self._queue
+                  if r.max_queue_delay_s is not None
+                  and now - r.arrival_time > r.max_queue_delay_s]:
+            self._drop_queued(r, now, "timeout")
+            self._m.timeouts.inc()
+            _span_instant("serving.request.timeout",
+                          request=r.request_id,
+                          waited_ms=round(
+                              (now - r.arrival_time) * 1e3, 3))
+            out.append(r)
+
+    # -- preemption + host-RAM swap --
+    def _swap_out(self):
+        if self._swap_out_fn is None:
+            self._swap_out_fn = jax.jit(build_swap_out_gather())
+        return self._swap_out_fn
+
+    def _swap_in(self):
+        if self._swap_in_fn is None:
+            n = len(self._arenas)
+            self._swap_in_fn = jax.jit(
+                build_swap_in_scatter(n),
+                donate_argnums=tuple(range(1 + n, 1 + 2 * n)))
+        return self._swap_in_fn
+
+    def _preempt(self, req: Request, reason: str = "pressure"):
+        """Swap an in-flight request out to the host-RAM tier: gather
+        its table row's EXACT at-rest bytes out of every arena (float
+        K/V, or int8 codes + scale planes), save the slot's
+        ``tok``/``lens`` carries, release its HBM blocks and park it
+        on the swap list.  The request's host truth (``tokens``,
+        ``pf_pos``, sampling state machine, position-keyed PRNG) needs
+        no saving — it never lived on the device."""
+        slot = req.slot
+        if slot is None or req.state not in ("prefill", "decode"):
+            raise RuntimeError(
+                f"request {req.request_id} is not in flight "
+                f"(state={req.state}, slot={slot}) — only admitted "
+                f"prefill/decode requests can be preempted")
+        ids = self._tables[slot].copy()     # BEFORE release trashes it
+        n = len(req.blocks)
+        with _span("serving.swap_out", request=req.request_id,
+                   blocks=n):
+            # the gather reads the full table row (ONE compiled shape
+            # for the engine's lifetime; entries past the allocation
+            # hit the trash row) but only the request's n real blocks
+            # are KEPT host-side — the swap tier's actual footprint is
+            # exactly what swap.host_blocks / swap_out_bytes report
+            rows = [np.asarray(r[:n]) for r in
+                    self._swap_out()(jnp.asarray(ids), *self._arenas)]
+        req.swap = _SwapRecord(rows=rows, n_blocks=n,
+                               tok=int(self._tok[slot]),
+                               lens=int(self._lens[slot]),
+                               state=req.state)
+        if req in self._prefilling:
+            self._prefilling.remove(req)
+        self._release_blocks(req)
+        self._slots[slot] = None
+        self._done[slot] = True
+        req.slot = None
+        req.state = "swapped"
+        req.preempt_count += 1
+        self._swapped.append(req)
+        self._host_blocks += n
+        nbytes = n * self.block_len * self._kv_row_bytes
+        self._m.preempts.inc()
+        self._m.swap_out_blocks.inc(n)
+        self._m.swap_out_bytes.inc(nbytes)
+        self._m.swap_host_blocks.set(self._host_blocks)
+        self._m.slot_occupancy.set(
+            sum(r is not None for r in self._slots))
+        _span_instant("serving.request.preempt", request=req.request_id,
+                      blocks=n, reason=reason)
+
+    def _preempt_for(self, cand: Request, needed: int) -> bool:
+        """Free blocks for ``cand`` by swapping out strictly-worse
+        victims (victim policy: lowest priority first, then latest
+        deadline, then most remaining work) until ``needed`` blocks
+        are allocatable.  Eligibility is STRICT — a victim must be of
+        lower priority, or same priority with a later deadline — so a
+        resumed victim can never preempt its preemptor back and two
+        equal requests never thrash.  Returns True when the target was
+        reached (victims may have been swapped either way; they resume
+        when pressure clears)."""
+        cand_key = self._shed_key(cand)
+        while self._pool.available() < needed:
+            # eligibility and victim choice are BOTH the one
+            # "worseness" ordering (_shed_key: lowest priority, then
+            # latest deadline) — preemption and bounded-queue shedding
+            # can never drift apart on who is expendable; remaining
+            # work breaks the final tie
+            eligible = [
+                r for r in self._slots
+                if r is not None and r.state in ("prefill", "decode")
+                and self._shed_key(r) < cand_key]
+            if not eligible:
+                return False
+            victim = min(eligible, key=lambda v: (
+                self._shed_key(v) + (-self._remaining_work(v),)))
+            self._preempt(victim)
+        return True
+
+    def _try_resume(self, req: Request, slot: int) -> bool:
+        """Re-admit a swapped request: allocate fresh blocks (leaning
+        on the valve and preemption under pressure), re-scatter the
+        saved bytes through the donation-matched swap-in program, and
+        restore the slot carries.  The fresh block list preserves
+        logical block ORDER, so the rebuilt table row maps the same
+        dense view the request decoded against before — resumed greedy
+        output is bit-identical to never-preempted output."""
+        rec = req.swap
+        fresh = self._alloc(rec.n_blocks)
+        if fresh is None and \
+                not any(r is not None for r in self._slots):
+            self._release_queue_pins()
+            fresh = self._alloc(rec.n_blocks)
+        if fresh is None and self.enable_preemption and \
+                self._preempt_for(req, rec.n_blocks):
+            fresh = self._alloc(rec.n_blocks)
+        if fresh is None:
+            return False
+        row = np.full((self.max_blocks,), self._pool.trash, np.int32)
+        row[:rec.n_blocks] = fresh
+        # the dispatch runs BEFORE any scheduler-state commit, and a
+        # failure (a raising span hook, an argument-prep error) unpins
+        # the fresh blocks — the same rollback discipline as submit():
+        # the request must stay a valid swap-list member or become a
+        # fully-mapped slot occupant, never something in between
+        try:
+            with _span("serving.swap_in", request=req.request_id,
+                       blocks=rec.n_blocks):
+                # saved stacks are allocation-width; re-pad to the
+                # fixed table width (pad rows scatter into the trash
+                # row through the trash-padded ``row``)
+                padded_rows = []
+                for r in rec.rows:
+                    pr = np.zeros((self.max_blocks,) + r.shape[1:],
+                                  r.dtype)
+                    pr[:rec.n_blocks] = r
+                    padded_rows.append(jnp.asarray(pr))
+                outp = self._swap_in()(
+                    jnp.asarray(row), *padded_rows, *self._arenas)
+                self._arenas = list(outp)
+        except BaseException:
+            for b in fresh:
+                self._pool.unpin(b)
+            self._update_block_gauges()
+            raise
+        self._swapped.remove(req)
+        req.blocks = list(fresh)
+        req.matched = []
+        self._tables[slot] = row
+        req.slot = slot
+        self._slots[slot] = req
+        self._tok[slot] = rec.tok
+        self._lens[slot] = rec.lens
+        req.state = rec.state
+        if rec.state == "prefill":
+            self._done[slot] = True       # not decoding yet
+            self._prefilling.append(req)
+        else:
+            # spec-mode rows stay frozen out of the plain decode block
+            # (their progress happens in the verify dispatch)
+            self._done[slot] = req.spec_k is not None
+        req.swap = None
+        self._host_blocks -= rec.n_blocks
+        self._m.preempt_resumes.inc()
+        self._m.swap_in_blocks.inc(rec.n_blocks)
+        self._m.swap_in_bytes.inc(
+            rec.n_blocks * self.block_len * self._kv_row_bytes)
+        self._m.swap_host_blocks.set(self._host_blocks)
+        self._update_block_gauges()
+        _span_instant("serving.request.resume", request=req.request_id,
+                      slot=slot, blocks=rec.n_blocks)
+        return True
+
+    def _release_queue_pins(self):
+        """Head-of-line valve body: nothing is running, so the only
+        refcounts are queued requests' submit-time prefix pins —
+        release them all (the cached blocks stay mapped, just
+        reclaimable again)."""
+        for r in self._queue:
+            for b in r.matched:
+                self._pool.unpin(b)
+            r.matched = []
+
+    def _admit(self, now: float, out: List[Request]):
+        """Admit the best-class candidates into vacant slots.  The
+        candidate order is priority-then-EDF over the swap list plus
+        the arrived queue (swapped requests sort ahead of queued ones
+        within a class: they hold host memory and are closest to
+        done); within a class the order is FIFO, so default traces
+        schedule exactly as the pre-SLO engine.  Queue-delay timeouts
+        are swept first — a request must not be admitted after its
+        wait already broke its SLO.  When the pool cannot serve the
+        head candidate, the head-of-line valve (nothing running) and
+        then PREEMPTION of strictly-worse victims are tried before
+        giving up until blocks retire.  Admission is head-of-line:
+        a stuck best candidate is never skipped for a worse one that
+        would fit (no priority inversion by backfill).  Gang mode
+        (``static_batching``) only admits into an EMPTY pool — the
+        static-batch baseline scheduler."""
+        self._sweep_timeouts(now, out)
         if self.static_batching and \
                 any(r is not None for r in self._slots):
             return
-        while self._queue and self._queue[0].arrival_time <= now:
+        while True:
             slot = next((i for i, r in enumerate(self._slots)
                          if r is None), None)
             if slot is None:
                 break
-            req = self._queue[0]
+            arrived = [r for r in self._queue if r.arrival_time <= now]
+            cands = sorted(self._swapped + arrived, key=self._sched_key)
+            if not cands:
+                break
+            req = cands[0]
+            if req.state == "swapped":
+                if not self._try_resume(req, slot):
+                    break
+                continue
             if self.enable_prefix_cache:
                 # blocks computed between submit and now may extend the
                 # match (e.g. the prefix holder finished its prefill
@@ -908,22 +1525,22 @@ class ServingEngine:
                     self._pool.pin(b)
                     req.matched.append(b)
             total = self._blocks_needed(req.seq_len, req.max_new_tokens)
-            fresh = self._pool.alloc(total - len(req.matched))
+            fresh = self._alloc(total - len(req.matched))
             if fresh is None and \
                     not any(r is not None for r in self._slots):
-                # head-of-line valve: nothing is running, so the only
-                # refcounts are queued requests' submit-time pins —
-                # release them all (the cached blocks stay mapped, just
-                # reclaimable again) and retry; the submit() capacity
-                # guard makes this retry infallible
-                for r in self._queue:
-                    for b in r.matched:
-                        self._pool.unpin(b)
-                    r.matched = []
-                fresh = self._pool.alloc(total)
+                # head-of-line valve: release every queued submit-time
+                # pin (including this request's own) and retry at full
+                # width; the submit() capacity guard makes this retry
+                # infallible against real exhaustion (an injected
+                # fault can still fail it)
+                self._release_queue_pins()
+                fresh = self._alloc(total)
+            if fresh is None and self.enable_preemption and \
+                    self._preempt_for(req, total - len(req.matched)):
+                fresh = self._alloc(total - len(req.matched))
             if fresh is None:
                 break                     # pool drains as requests retire
-            self._queue.popleft()
+            self._queue.remove(req)
             matchable = ((req.seq_len - 1) // self.block_len
                          if self.enable_prefix_cache else 0)
             self._m.prefix_hits.inc(len(req.matched))
@@ -1281,14 +1898,28 @@ class ServingEngine:
                 self._finish(req, t, out)
 
     def step(self, now: Optional[float] = None) -> List[Request]:
-        """One scheduler iteration: admit arrivals into vacant slots,
-        run at most one prefill chunk, then one speculative verify
-        forward over the spec-mode slots and one decode block over the
-        plain-decode mix — all three phases coexist in the same
-        iteration.  Returns the requests that finished this
-        iteration."""
+        """One scheduler iteration: sweep queue-delay timeouts and
+        admit/resume into vacant slots (preempting strictly-worse
+        victims under block pressure), run at most one prefill chunk,
+        then one speculative verify forward over the spec-mode slots
+        and one decode block over the plain-decode mix — the phases
+        coexist in the same iteration.  Returns the requests that
+        reached a terminal state this iteration (finished or
+        timeout)."""
         finished: List[Request] = []
-        self._admit(self._clock() if now is None else now)
+        t_now = self._clock() if now is None else now
+        if self._fault is not None:
+            stall = self._fault.take_stall()
+            if stall:
+                with _span("serving.fault.stall", seconds=stall):
+                    time.sleep(stall)
+            for rid in self._fault.take_forced_swaps():
+                for r in self._slots:
+                    if r is not None and r.request_id == rid \
+                            and r.state in ("prefill", "decode"):
+                        self._preempt(r, reason="forced")
+                        break
+        self._admit(t_now, finished)
         self._prefill_chunk(finished)
         self._spec_fallback = set()
         self._spec_verify(finished)
@@ -1381,27 +2012,69 @@ class ServingEngine:
             sum(r is not None for r in self._slots))
         return finished
 
-    def run(self, max_iters: Optional[int] = None) -> List[Request]:
+    def _stall_diagnosis(self, wall_timeout_s: float) -> str:
+        """The state dump an ``EngineStalledError`` carries: enough to
+        tell an exhausted pool from an injected fault from a trace
+        whose arrivals simply lie beyond the wall budget."""
+        active = {r.request_id: r.state for r in self._slots
+                  if r is not None}
+        return (
+            f"serving loop exceeded wall_timeout_s={wall_timeout_s} "
+            f"without draining: queued={len(self._queue)} "
+            f"(arrived={sum(r.arrival_time <= self._clock() for r in self._queue)}), "
+            f"swapped={len(self._swapped)}, active slots={active}, "
+            f"prefilling={len(self._prefilling)}, blocks free="
+            f"{self._pool.available()} in_use={self._pool.in_use()} "
+            f"cached={self._pool.cached()} of {self.num_blocks}, "
+            f"fault_injector={'armed' if self._fault is not None else 'none'}")
+
+    def run(self, max_iters: Optional[int] = None,
+            wall_timeout_s: Optional[float] = None) -> List[Request]:
         """Drain the queue: admit/prefill/decode until every submitted
-        request has finished.  Sleeps only when idle ahead of a future
-        arrival.  Returns this call's finished requests in submission
+        request has reached a terminal state.  Sleeps only when idle
+        ahead of a future arrival.  ``wall_timeout_s`` bounds the
+        WHOLE drain in wall-clock time: a wedged pool (exhaustion with
+        nothing running, an injected fault, a stalled dispatch) raises
+        a diagnosable ``EngineStalledError`` — with queue / slot /
+        block-pool state in the message — instead of spinning in the
+        idle loop forever; the engine stays consistent and a later
+        ``run()`` continues where it stopped.  Returns this call's
+        terminal requests (finished and timed-out) in submission
         order."""
         finished: List[Request] = []
         iters = 0
-        while self._queue or any(r is not None for r in self._slots):
+        start = self._clock()
+        while self._queue or self._swapped \
+                or any(r is not None for r in self._slots):
             now = self._clock()
+            if wall_timeout_s is not None and \
+                    now - start > wall_timeout_s:
+                raise EngineStalledError(
+                    self._stall_diagnosis(wall_timeout_s))
             if (not any(r is not None for r in self._slots)
-                    and self._queue
-                    and self._queue[0].arrival_time > now):
-                time.sleep(
-                    min(0.005, self._queue[0].arrival_time - now))
-                continue
+                    and not self._swapped and self._queue):
+                next_arrival = min(r.arrival_time for r in self._queue)
+                if next_arrival > now:
+                    time.sleep(min(0.005, next_arrival - now))
+                    continue
+            n_before = len(finished)
             finished.extend(self.step(now))
+            if len(finished) == n_before and \
+                    not any(r is not None for r in self._slots):
+                # the step ran nothing and retired nothing — queued or
+                # swapped work that cannot be admitted/resumed (pool
+                # wedged / injected fault): nap instead of hot-spinning
+                # the scheduler until wall_timeout_s or the fault
+                # clears.  Any real progress leaves a slot occupied
+                # (admission, prefill, decode), so this never slows a
+                # healthy drain.
+                time.sleep(0.001)
             iters += 1
             if max_iters is not None and iters > max_iters:
                 raise RuntimeError(
                     f"serving loop exceeded max_iters={max_iters} with "
                     f"{len(self._queue)} queued / "
+                    f"{len(self._swapped)} swapped / "
                     f"{sum(r is not None for r in self._slots)} active")
         return sorted(finished, key=lambda r: r.request_id)
 
@@ -1429,7 +2102,14 @@ class ServingEngine:
         tokens.  ``sampled_tokens``/``greedy_tokens`` split emitted
         tokens by sampling route (``masked_tokens`` of them carried an
         active token-mask constraint); ``sample_resamples`` counts
-        residual draws consumed by stochastic speculative sampling."""
+        residual draws consumed by stochastic speculative sampling.
+        The overload keys: ``preemptions``/``preempt_resumes`` count
+        swap-outs and re-admissions, ``swap_blocks_out/in`` and
+        ``swap_bytes_out`` the block traffic through the host-RAM
+        tier, ``swap_host_blocks``/``swapped_waiting`` the tier's
+        CURRENT footprint, and ``shed``/``timeouts`` the requests the
+        bounded queue and the queue-delay SLO dropped (label-summed;
+        ``cancelled`` likewise sums its per-phase label)."""
         decode_steps = self._m.since_init(self._m.decode_steps)
         busy = self._m.since_init(self._m.busy_slot_steps)
         occ = (busy / (decode_steps * self.num_slots)
@@ -1490,6 +2170,21 @@ class ServingEngine:
                 self._m.since_init(self._m.sample_masked_tokens)),
             "sample_resamples": int(
                 self._m.since_init(self._m.sample_resamples)),
+            "preemptions": int(self._m.since_init(self._m.preempts)),
+            "preempt_resumes": int(
+                self._m.since_init(self._m.preempt_resumes)),
+            "swap_blocks_out": int(
+                self._m.since_init(self._m.swap_out_blocks)),
+            "swap_blocks_in": int(
+                self._m.since_init(self._m.swap_in_blocks)),
+            "swap_bytes_out": int(
+                self._m.since_init(self._m.swap_out_bytes)),
+            "swap_bytes_in": int(
+                self._m.since_init(self._m.swap_in_bytes)),
+            "swap_host_blocks": self._host_blocks,
+            "swapped_waiting": len(self._swapped),
+            "shed": int(self._m.since_init(self._m.shed)),
+            "timeouts": int(self._m.since_init(self._m.timeouts)),
         }
 
     @property

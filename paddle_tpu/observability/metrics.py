@@ -124,6 +124,14 @@ class Counter(_Instrument):
         with self._lock:
             return self._vals.get(key, 0)
 
+    def total(self) -> float:
+        """Sum over every label set (``== value()`` for an unlabeled
+        counter) — the label-agnostic reading consumers like the
+        serving engine's ``stats()`` delta need from a labeled
+        counter, whose ``value()`` requires one exact label set."""
+        with self._lock:
+            return float(sum(self._vals.values()))
+
     def _snap(self) -> dict:
         with self._lock:
             vals = dict(self._vals)

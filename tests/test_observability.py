@@ -439,7 +439,9 @@ def test_metrics_name_lint_clean():
     # flatline a dashboard)
     for n, kind in lint.REQUIRED_INSTRUMENTS.items():
         assert n.startswith(
-            ("serving.spec.", "serving.kv.", "serving.sample.")), n
+            ("serving.spec.", "serving.kv.", "serving.sample.",
+             "serving.preempt.", "serving.swap.", "serving.shed.",
+             "serving.timeout.")), n
         assert n in names, n
     kinds = {r[3]: r[2] for r in regs}
     assert kinds["serving.spec.accepted_length"] == "histogram"
@@ -448,6 +450,18 @@ def test_metrics_name_lint_clean():
     assert kinds["serving.kv.quant_dtype"] == "gauge"
     assert kinds["serving.sample.sampled_tokens"] == "counter"
     assert kinds["serving.sample.resamples"] == "counter"
+    # the overload-resilience set is registered with the right kinds
+    # (a gauge silently re-registered as a counter would break the
+    # bench's overload arm and any SLO dashboard)
+    assert kinds["serving.preempt.requests"] == "counter"
+    assert kinds["serving.swap.blocks_out"] == "counter"
+    assert kinds["serving.swap.host_blocks"] == "gauge"
+    assert kinds["serving.shed.requests"] == "counter"
+    assert kinds["serving.timeout.requests"] == "counter"
+    # labeled overload counters carry their declared label tuples
+    by_lbl = {r[3]: r[4] for r in regs}
+    assert by_lbl["serving.shed.requests"] == ("reason",)
+    assert by_lbl["serving.requests_cancelled"] == ("phase",)
     # rule 4 fires on a missing required name
     import tempfile
     with tempfile.TemporaryDirectory() as empty_root:

@@ -71,6 +71,20 @@ REQUIRED_INSTRUMENTS = {
     "serving.sample.greedy_tokens": "counter",
     "serving.sample.masked_tokens": "counter",
     "serving.sample.resamples": "counter",
+    # overload resilience (inference/serving.py _ServingInstruments):
+    # the preempt/swap/shed/timeout set the bench's overload arm and
+    # SLO dashboards key on — preemption + host-RAM swap traffic, the
+    # swap tier's live footprint, bounded-queue sheds and queue-delay
+    # timeouts
+    "serving.preempt.requests": "counter",
+    "serving.preempt.resumes": "counter",
+    "serving.swap.blocks_out": "counter",
+    "serving.swap.blocks_in": "counter",
+    "serving.swap.bytes_out": "counter",
+    "serving.swap.bytes_in": "counter",
+    "serving.swap.host_blocks": "gauge",
+    "serving.shed.requests": "counter",
+    "serving.timeout.requests": "counter",
 }
 
 
