@@ -17,10 +17,11 @@ from .llm import LLMPredictor  # noqa: F401
 from .serving import (AdmissionError, EngineStalledError,  # noqa: F401
                       Request, ServingEngine)
 from .faultinject import FaultInjector  # noqa: F401
+from .prefixcache import HostTier, RadixPrefixCache  # noqa: F401
 from .speculative import (Drafter, ModelDrafter,  # noqa: F401
                           NGramDrafter)
 
 __all__ = ["Config", "Predictor", "create_predictor", "LLMPredictor",
            "Request", "ServingEngine", "Drafter", "NGramDrafter",
            "ModelDrafter", "AdmissionError", "EngineStalledError",
-           "FaultInjector"]
+           "FaultInjector", "HostTier", "RadixPrefixCache"]

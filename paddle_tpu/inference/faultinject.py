@@ -17,7 +17,7 @@ the invariants that define "no wedge":
   token-exact outputs — injected failures are delays, never
   corruption.
 
-Three injectable failure modes:
+Five injectable failure modes:
 
 - **allocation exhaustion** (``fail_allocs``): the engine's next N (or
   every) ``BlockPool.alloc`` call returns ``None`` as if the pool were
@@ -30,6 +30,18 @@ Three injectable failure modes:
 - **step stall** (``stall_steps``): the next N ``step()`` calls sleep
   ``seconds`` before doing any work — a stand-in for a wedged device
   dispatch, paired with ``run(wall_timeout_s=...)`` regression tests.
+- **host-tier swap-in failure** (``fail_swapins``): the next N (or
+  every) prefix-cache host->HBM promotions fail at admission — the
+  host parcels drop and the engine degrades the match to its directly
+  mapped HBM prefix, recomputing the tail (a prefix miss, never a
+  wedge, a block leak or a token drift).  Preemption RESUME swap-ins
+  are deliberately out of scope: a resume needs its bytes for
+  correctness, so there is no degraded path to exercise.
+- **forced tier eviction** (``force_tier_evicts``): drop the N least-
+  recently-used unpinned cache parcels from the host tier at the top
+  of the next ``step()`` — holes open in the radix tree's host spans
+  and refill through recompute, the deterministic driver of the
+  tiered cache's degradation tests.
 
 The injector is pure host state with no engine back-references: one
 injector can be armed before the engine exists and inspected after it
@@ -57,6 +69,9 @@ class FaultInjector:
     def __init__(self):
         self._alloc_budget = 0        # finite failures left
         self._alloc_always = False
+        self._swapin_budget = 0       # finite swap-in failures left
+        self._swapin_always = False
+        self._tier_evicts = 0         # forced cache evictions pending
         self._forced: List[int] = []  # request ids to preempt
         self._stalls: deque = deque()  # seconds, one per upcoming step
         self.events: List[Tuple[str, Optional[int]]] = []
@@ -76,6 +91,31 @@ class FaultInjector:
     def clear_alloc_failures(self):
         self._alloc_budget = 0
         self._alloc_always = False
+
+    def fail_swapins(self, n: Optional[int] = None):
+        """Make the engine's next ``n`` prefix-cache host-tier
+        swap-ins fail at admission (``n=None`` fails EVERY one until
+        ``clear_swapin_failures()``): the host parcels drop and the
+        match degrades to its directly mapped HBM prefix — the tail
+        recomputes."""
+        if n is None:
+            self._swapin_always = True
+        else:
+            if int(n) < 1:
+                raise ValueError(f"n must be >= 1 swap-ins, got {n}")
+            self._swapin_budget += int(n)
+
+    def clear_swapin_failures(self):
+        self._swapin_budget = 0
+        self._swapin_always = False
+
+    def force_tier_evicts(self, n: int):
+        """Drop the ``n`` least-recently-used unpinned cache parcels
+        from the host tier at the top of the next ``step()`` —
+        punches holes in the radix tree's host-resident spans."""
+        if int(n) < 1:
+            raise ValueError(f"n must be >= 1 evictions, got {n}")
+        self._tier_evicts += int(n)
 
     def force_swap(self, request_id: int):
         """Preempt the given in-flight request (swap its KV blocks to
@@ -107,6 +147,32 @@ class FaultInjector:
             self.events.append(("alloc_fail", None))
             return True
         return False
+
+    def take_swapin_failure(self) -> bool:
+        """True when THIS admission's host-tier swap-in should fail
+        (consumes one armed failure unless armed with ``n=None``)."""
+        if self._swapin_always:
+            self.events.append(("swapin_fail", None))
+            return True
+        if self._swapin_budget > 0:
+            self._swapin_budget -= 1
+            self.events.append(("swapin_fail", None))
+            return True
+        return False
+
+    def take_tier_evicts(self) -> int:
+        """Forced cache-parcel evictions to apply this step (consumes
+        them).  The engine evicts at most as many unpinned parcels as
+        the tier actually holds and reports the applied count back via
+        ``record_tier_evicts`` — events record faults that FIRED, not
+        merely armed ones (the module contract)."""
+        n, self._tier_evicts = self._tier_evicts, 0
+        return n
+
+    def record_tier_evicts(self, n: int):
+        """Engine-side report of forced evictions actually applied."""
+        for _ in range(int(n)):
+            self.events.append(("tier_evict", None))
 
     def take_forced_swaps(self) -> List[int]:
         """Request ids to force-preempt this step (consumes them)."""

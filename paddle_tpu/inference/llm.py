@@ -205,15 +205,18 @@ def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call,
 
 
 def build_swap_out_gather():
-    """Swap-out reader for KV preemption (ServingEngine): gather one
-    slot's table row out of EVERY arena in one compiled call —
-    ``(ids [W], *flat_arenas) -> tuple of [W, ...] row stacks`` where
-    ``W = max_blocks`` (the slot's full table, trash-padded past the
-    request's allocation, so the shape is engine-static and the
-    program compiles exactly once).  The gathered rows are the EXACT
-    at-rest bytes of the request's blocks — float K/V, or int8 codes
-    plus their f32 scale planes, whichever the arena holds — which is
-    what makes preempt/resume byte-identical rather than
+    """Swap-out reader for the host-RAM block tier (ServingEngine):
+    gather a row of block ids out of EVERY arena in one compiled call
+    — ``(ids [W], *flat_arenas) -> tuple of [W, ...] row stacks``.
+    Two consumers share ONE compiled shape (``W = max_blocks``,
+    trash-padded): preemption gathers a slot's full table row, and the
+    tiered prefix cache demotes each alloc's reclaimed batch through
+    the same program (wider reclaims page through it) — demotion costs
+    a dispatch per admission, not per block, and adds no second
+    compile.  The gathered rows are the EXACT at-rest bytes of
+    the blocks — float K/V, or int8 codes plus their f32 scale planes,
+    whichever the arena holds — which is what makes preempt/resume
+    (and a host-tier prefix hit) byte-identical rather than
     recompute-and-hope.  Trash-row gathers past the allocation are
     finite garbage the resume scatter routes straight back to the
     trash row."""
@@ -223,17 +226,19 @@ def build_swap_out_gather():
 
 
 def build_swap_in_scatter(n_arenas):
-    """Donation-matched re-scatter for preemption RESUME: write a
-    swapped-out request's saved block rows into its freshly allocated
-    arena rows — ``(ids [W], *rows (n_arenas of [W, ...]),
-    *flat_arenas) -> flat_arenas`` with the arenas donated, same
-    discipline as the decode/chunk/verify programs (steady-state
-    serving never materializes a second arena copy).  ``ids`` is the
-    resumed slot's NEW table row: entries past the request's
-    allocation point at the trash row, so pad rows of the saved stack
-    land there (the write-masking contract of every other paged
-    writer) and duplicate trash writes only ever overwrite finite
-    garbage with finite garbage."""
+    """Donation-matched re-scatter for host-RAM -> arena restores:
+    write saved block rows into freshly allocated arena rows —
+    ``(ids [W], *rows (n_arenas of [W, ...]), *flat_arenas) ->
+    flat_arenas`` with the arenas donated, same discipline as the
+    decode/chunk/verify programs (steady-state serving never
+    materializes a second arena copy).  ONE compiled program serves
+    both preemption RESUME and the tiered prefix cache's host-hit
+    promotion (``W = max_blocks`` for both; promotion packs its k
+    parcels into the leading rows).  ``ids`` is the destination row:
+    entries past the payload point at the trash row, so pad rows of
+    the saved stack land there (the write-masking contract of every
+    other paged writer) and duplicate trash writes only ever
+    overwrite finite garbage with finite garbage."""
     def scatter_pure(ids, *rows_and_arenas):
         rows = rows_and_arenas[:n_arenas]
         arenas = rows_and_arenas[n_arenas:]

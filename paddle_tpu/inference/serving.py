@@ -23,18 +23,27 @@ prefill) design, restricted to what XLA's static shapes allow:
   concurrency is bounded by blocks actually USED
   (``ceil((prompt + new - 1) / block_len)`` per request), not by
   ``num_slots x max_cache_len``.
-- **Block-aligned prefix caching**: full prompt blocks are identified
-  by a chained blake2b digest over their token ids (chaining makes a
-  block's identity include its whole prefix, so equal digests imply
-  equal attention context).  Computed blocks are published to a
-  refcounted ``digest -> block`` map; admission maps shared leading
-  blocks straight into the new slot's table and prefill starts at the
-  first unmatched position.  Only FULL blocks are shared, and at least
-  the block holding the prompt's last token is always recomputed (its
-  hidden state is needed to sample the first token), so shared blocks
-  are immutable by construction and no copy-on-write is ever needed.
-  Unpinned cached blocks park in an LRU and are reclaimed when the
-  free list runs dry.
+- **Tiered radix-tree prefix caching** (``prefix_cache_mode="radix"``,
+  the default — see ``inference/prefixcache.py``): prompts are matched
+  token-level against a radix tree whose nodes own runs of token ids
+  mapped to block spans (RadixAttention, SGLang).  Admission maps the
+  matched span's FULL blocks straight into the new slot's table and
+  prefill starts after them; at least the block holding the prompt's
+  last token is always recomputed (its hidden state is needed to
+  sample the first token), so shared blocks are immutable by
+  construction and no copy-on-write is ever needed.  Unpinned cached
+  blocks park in an LRU — and when the free list runs dry, reclaim
+  DEMOTES their exact at-rest bytes to a host-RAM tier instead of
+  forgetting them: a later hit on a host-resident span allocates
+  fresh blocks and swaps the bytes back in (the same gather/scatter
+  programs preemption uses), byte-identical to never having evicted.
+  Admission is cache-aware: within a scheduling class, queued
+  requests whose matched prefix is HBM-resident admit first, then
+  host-resident, then cold — a strict tie-break, so traces with no
+  shared prefixes schedule exactly as before.  The PR-3 block-aligned
+  chained-digest map (``prefix_cache_mode="digest"``: full-block
+  blake2b chains, HBM-only, reclaim forgets) remains as the bench A/B
+  arm.
 - **Chunked prefill**: prompts are computed ``chunk_len`` tokens at a
   time, at most ONE chunk per ``step()`` alongside the shared decode
   block — a long prompt no longer stalls in-flight decoding for its
@@ -142,6 +151,7 @@ from ..observability.spans import instant as _span_instant
 from ..observability.spans import span as _span
 from .llm import (_build_paged_decode_block, build_chunk_prefill,
                   build_swap_in_scatter, build_swap_out_gather)
+from .prefixcache import HostTier, RadixPrefixCache
 from .sampling import (MASK_BIAS, SamplingParams, base_key, flags_of,
                        row_planes)
 from .speculative import (NGramDrafter, accept_drafts,
@@ -235,22 +245,30 @@ class _ServingInstruments:
         self.swap_out_blocks = r.counter(
             "serving.swap.blocks_out",
             "KV blocks copied out of the arenas into the host-RAM "
-            "swap tier at preemption")
+            "tier; reason='preempt' at preemption, reason='cache' "
+            "when the prefix cache demotes a reclaimed block",
+            labels=("reason",))
         self.swap_in_blocks = r.counter(
             "serving.swap.blocks_in",
-            "KV blocks re-scattered from the host-RAM swap tier into "
-            "freshly allocated arena rows at resume")
+            "KV blocks re-scattered from the host-RAM tier into "
+            "freshly allocated arena rows; reason='preempt' at "
+            "resume, reason='cache' at a host-tier prefix hit",
+            labels=("reason",))
         self.swap_out_bytes = r.counter(
             "serving.swap.bytes_out",
             "at-rest KV bytes (codes + scale planes for the int8 "
-            "cache) swapped out to host RAM")
+            "cache) swapped out to host RAM, by reason",
+            labels=("reason",))
         self.swap_in_bytes = r.counter(
             "serving.swap.bytes_in",
-            "at-rest KV bytes swapped back into the arenas at resume")
+            "at-rest KV bytes swapped back into the arenas, by reason",
+            labels=("reason",))
         self.swap_host_blocks = r.gauge(
             "serving.swap.host_blocks",
-            "KV blocks currently parked in the host-RAM swap tier "
-            "(hwm = peak swap-tier footprint in blocks)")
+            "KV blocks currently parked in the host-RAM tier (hwm = "
+            "peak footprint in blocks); reason='preempt' = swapped "
+            "requests awaiting resume, reason='cache' = demoted "
+            "prefix-cache spans", labels=("reason",))
         self.shed = r.counter(
             "serving.shed.requests",
             "requests shed by the bounded queue: 'evicted' = a queued "
@@ -270,6 +288,27 @@ class _ServingInstruments:
         self.prefix_misses = r.counter(
             "serving.prefix_misses", "matchable prompt blocks that had "
             "to be computed (no cached twin at admission)")
+        self.prefix_hit_tokens = r.counter(
+            "serving.prefix.hit_tokens",
+            "prompt tokens served from the prefix cache at admission "
+            "(mapped blocks x block_len — token-granular cache "
+            "effectiveness; PR-3's serving.prefix_hits counts whole "
+            "blocks only)")
+        self.prefix_partial_hits = r.counter(
+            "serving.prefix.partial_hits",
+            "admissions whose token-level radix match extended past "
+            "the last mappable full block (the partial tail was "
+            "recomputed — the match lengths the block-aligned digest "
+            "cache could not even see)")
+        self.prefix_host_hits = r.counter(
+            "serving.prefix.host_hits",
+            "admissions whose matched span included >= 1 host-RAM-"
+            "resident block (served by exact-bytes swap-in instead of "
+            "recompute)")
+        self.prefix_host_swapin = r.counter(
+            "serving.prefix.host_swapin_blocks",
+            "blocks promoted host-RAM -> HBM on prefix-cache hits "
+            "(the cache-reason slice of serving.swap.blocks_in)")
         self.queue_depth = r.gauge(
             "serving.queue_depth", "requests waiting for a slot")
         self.slot_occupancy = r.gauge(
@@ -359,6 +398,8 @@ class _ServingInstruments:
                   self.spec_verifies, self.spec_draft_hits,
                   self.spec_draft_misses, self.spec_draft_tokens,
                   self.spec_accepted_tokens, self.kv_bytes_swept,
+                  self.prefix_hit_tokens, self.prefix_partial_hits,
+                  self.prefix_host_hits, self.prefix_host_swapin,
                   self.sample_sampled_tokens, self.sample_greedy_tokens,
                   self.sample_masked_tokens, self.sample_resamples,
                   self.preempts, self.preempt_resumes,
@@ -433,7 +474,19 @@ class BlockPool:
 
     Purely host state — the device never sees refcounts or digests,
     only the int32 block tables (the "no per-step sync of the arena"
-    contract)."""
+    contract).
+
+    Two cache indices can park unpinned blocks reclaimable-but-mapped:
+    the PR-3 chained-digest map (``register``/``lookup``, kept as the
+    ``prefix_cache_mode="digest"`` A/B arm) and the radix tree of
+    ``inference/prefixcache.py`` (``tree_hold``/``tree_touch``; the
+    default mode).  A tree-held block whose refcount drops to 0 parks
+    in ``_tree_lru``; when ``alloc`` reclaims some, ``reclaim_cb``
+    (the engine's demote path) fires once with the reclaimed list
+    before alloc returns — the caller has not written the rows yet,
+    so their bytes can still be gathered to the host tier in one
+    batched dispatch.  ``audit_hooks`` let the owning cache fold its
+    own invariants into ``check()``."""
 
     def __init__(self, num_blocks: int, block_len: int):
         self.num_blocks = int(num_blocks)
@@ -444,10 +497,14 @@ class BlockPool:
         self._digest_of: List[Optional[bytes]] = [None] * self.num_blocks
         self._by_digest = {}                   # digest -> block id
         self._lru: OrderedDict = OrderedDict()  # digest -> block, ref==0
+        self._tree_ref = set()                 # radix-tree-held blocks
+        self._tree_lru: OrderedDict = OrderedDict()  # block -> True
+        self.reclaim_cb = None                 # fires on tree-LRU reclaim
+        self.audit_hooks = []                  # extra check() invariants
 
     def available(self) -> int:
         """Blocks allocatable right now (free + reclaimable cached)."""
-        return len(self._free) + len(self._lru)
+        return len(self._free) + len(self._lru) + len(self._tree_lru)
 
     def in_use(self) -> int:
         """Blocks pinned by live or queued requests (refcount > 0)."""
@@ -455,7 +512,7 @@ class BlockPool:
 
     def cached(self) -> int:
         """Unpinned blocks kept mapped for future prefix hits."""
-        return len(self._lru)
+        return len(self._lru) + len(self._tree_lru)
 
     def lookup(self, digest: bytes) -> Optional[int]:
         return self._by_digest.get(digest)
@@ -465,6 +522,7 @@ class BlockPool:
             dg = self._digest_of[block]
             if dg is not None:
                 self._lru.pop(dg, None)
+            self._tree_lru.pop(block, None)
         self._ref[block] += 1
 
     def unpin(self, block: int):
@@ -479,8 +537,28 @@ class BlockPool:
             dg = self._digest_of[block]
             if dg is not None:
                 self._lru[dg] = block          # reclaimable, still mapped
+            elif block in self._tree_ref:
+                self._tree_lru[block] = True   # reclaimable, still mapped
             else:
                 self._free.append(block)
+
+    def tree_hold(self, block: int):
+        """Mark a block referenced by the radix prefix tree.  The
+        caller must hold a pin (registration and promotion both run
+        under the owning request's refcount), so a held block is never
+        immediately reclaimable."""
+        if not (0 <= block < self.num_blocks):
+            raise RuntimeError(f"tree_hold of non-pool block {block}")
+        if self._ref[block] <= 0:
+            raise RuntimeError(
+                f"tree_hold of unpinned block {block} — registration "
+                f"must run under the owning request's refcount")
+        self._tree_ref.add(block)
+
+    def tree_touch(self, block: int):
+        """LRU-refresh a tree-held reclaimable block on a cache hit."""
+        if block in self._tree_lru:
+            self._tree_lru.move_to_end(block)
 
     def register(self, block: int, digest: bytes):
         """Publish a fully-written prompt block for future prefix hits.
@@ -494,20 +572,35 @@ class BlockPool:
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """``n`` blocks with refcount 1 each, reclaiming the oldest
-        refcount-0 cached blocks (unmapping their digests) when the
-        free list runs dry; None when the pool cannot serve ``n``."""
+        refcount-0 cached blocks when the free list runs dry; None
+        when the pool cannot serve ``n``.  Digest-cached blocks unmap
+        (the PR-3 forget semantics); tree-held blocks fire
+        ``reclaim_cb`` first so the radix cache can demote their bytes
+        to the host tier before the row is overwritten."""
         if n > self.available():
             return None
         out = []
+        reclaimed = []
         for _ in range(n):
             if self._free:
                 b = self._free.pop()
+            elif self._tree_lru:
+                b, _ = self._tree_lru.popitem(last=False)
+                self._tree_ref.discard(b)
+                reclaimed.append(b)
             else:
                 dg, b = self._lru.popitem(last=False)
                 del self._by_digest[dg]
                 self._digest_of[b] = None
             self._ref[b] = 1
             out.append(b)
+        if reclaimed and self.reclaim_cb is not None:
+            # ONE callback per alloc, not per block: the engine's
+            # demote path gathers every reclaimed block's bytes in one
+            # batched dispatch.  The caller has not written the rows
+            # yet (it only receives them when alloc returns), so the
+            # at-rest bytes are still intact here.
+            self.reclaim_cb(reclaimed)
         return out
 
     def check(self) -> bool:
@@ -517,39 +610,59 @@ class BlockPool:
         invariants that define "no leak, no double-free, no refcount
         drift":
 
-        - conservation: free + pinned (ref > 0) + cached (LRU) covers
-          every block exactly once;
+        - conservation: free + pinned (ref > 0) + cached (digest LRU +
+          tree LRU) covers every block exactly once;
         - the free list has no duplicates and no pinned/cached member;
-        - free blocks are unmapped (no digest — alloc clears it);
+        - free blocks are unmapped (no digest — alloc clears it) and
+          never tree-referenced;
         - every LRU member has refcount 0 and a digest mapping back to
           itself;
         - ``_by_digest`` and ``_digest_of`` are a bijection;
+        - tree-referenced blocks are never also digest-mapped, and
+          every refcount-0 tree-referenced block sits in the tree LRU
+          (no unreclaimable limbo);
         - no negative refcount (``unpin`` raises before one can form,
-          so a violation here means state was corrupted directly)."""
+          so a violation here means state was corrupted directly);
+        - every registered ``audit_hooks`` entry (the radix tree's
+          node <-> block-span bijection and host-tier consistency in
+          radix-mode engines) returns no errors."""
         errs = []
         free_set = set(self._free)
         if len(free_set) != len(self._free):
             errs.append(f"free list holds duplicates: {self._free}")
         lru_set = set(self._lru.values())
+        tlru_set = set(self._tree_lru)
         pinned = 0
         for b in range(self.num_blocks):
             ref = self._ref[b]
             dg = self._digest_of[b]
+            cached_here = b in lru_set or b in tlru_set
             if ref < 0:
                 errs.append(f"block {b}: negative refcount {ref}")
             if ref > 0:
                 pinned += 1
-                if b in free_set or b in lru_set:
+                if b in free_set or cached_here:
                     errs.append(
                         f"block {b}: refcount {ref} but on the "
                         f"{'free list' if b in free_set else 'LRU'}")
-            elif not (b in free_set or b in lru_set):
+            elif not (b in free_set or cached_here):
                 errs.append(f"block {b}: refcount 0 but neither free "
                             f"nor cached — leaked")
-            if b in free_set and b in lru_set:
+            if b in free_set and (b in lru_set or b in tlru_set):
                 errs.append(f"block {b}: both free and LRU-cached")
             if b in free_set and dg is not None:
                 errs.append(f"block {b}: free but still digest-mapped")
+            if b in free_set and b in self._tree_ref:
+                errs.append(f"block {b}: free but tree-referenced")
+            if b in self._tree_ref and dg is not None:
+                errs.append(f"block {b}: both tree-referenced and "
+                            f"digest-mapped")
+            if b in self._tree_ref and ref == 0 and b not in tlru_set:
+                errs.append(f"block {b}: tree-referenced at refcount 0 "
+                            f"but not in the tree LRU — unreclaimable")
+            if b in tlru_set and b not in self._tree_ref:
+                errs.append(f"block {b}: in the tree LRU but not "
+                            f"tree-referenced")
             if dg is not None and self._by_digest.get(dg) != b:
                 errs.append(
                     f"block {b}: digest points at block "
@@ -565,11 +678,15 @@ class BlockPool:
             if self._digest_of[b] != dg:
                 errs.append(f"LRU digest {dg.hex()} maps block {b} "
                             f"whose digest differs")
-        if len(self._free) + pinned + len(self._lru) != self.num_blocks:
+        if len(self._free) + pinned + len(self._lru) \
+                + len(self._tree_lru) != self.num_blocks:
             errs.append(
                 f"conservation: free({len(self._free)}) + "
-                f"pinned({pinned}) + cached({len(self._lru)}) != "
+                f"pinned({pinned}) + cached({len(self._lru)} digest + "
+                f"{len(self._tree_lru)} tree) != "
                 f"num_blocks({self.num_blocks})")
+        for hook in self.audit_hooks:
+            errs.extend(hook())
         if errs:
             raise RuntimeError(
                 "BlockPool.check failed:\n  " + "\n  ".join(errs))
@@ -578,18 +695,19 @@ class BlockPool:
 
 @dataclass
 class _SwapRecord:
-    """A preempted request's device state, parked in host RAM.
+    """A preempted request's device state, parked in the shared
+    ``HostTier`` (reason ``"preempt"``).
 
-    ``rows`` holds one ``[n_blocks, ...]`` numpy stack per flat arena
-    — the request's real blocks at the arena's exact at-rest dtype
-    (float K/V, or int8 codes plus f32 scale planes), sliced out of
-    the fixed-shape full-table gather so the host tier holds exactly
-    the bytes its accounting reports; resume re-pads to table width
-    (pad rows scatter into the trash row).  ``tok``/``lens`` are the
-    slot's device carries at preemption; with them and the bytes
-    restored, the resumed request is bit-identical to one that was
-    never preempted."""
-    rows: List[np.ndarray]
+    ``host_key`` names the tier parcel holding one ``[n_blocks, ...]``
+    numpy stack per flat arena — the request's real blocks at the
+    arena's exact at-rest dtype (float K/V, or int8 codes plus f32
+    scale planes), sliced out of the fixed-shape full-table gather so
+    the tier holds exactly the bytes its accounting reports; resume
+    re-pads to table width (pad rows scatter into the trash row).
+    ``tok``/``lens`` are the slot's device carries at preemption; with
+    them and the bytes restored, the resumed request is bit-identical
+    to one that was never preempted."""
+    host_key: int
     n_blocks: int
     tok: int
     lens: int
@@ -639,6 +757,9 @@ class Request:
     samp_base: Optional[np.ndarray] = None     # [2] u32 PRNG base key
     pf_pos: int = 0                    # next prompt position to compute
     matched: List[int] = field(default_factory=list)   # prefix-hit blocks
+    host_pins: List[int] = field(default_factory=list)  # pinned tier keys
+    rspan: List = field(default_factory=list)  # radix span at last probe
+    rmatch_tokens: int = 0             # token-level match at last probe
     blocks: List[int] = field(default_factory=list)    # full block map
     digests: List[bytes] = field(default_factory=list)
     registered: int = 0                # blocks published so far
@@ -678,7 +799,8 @@ class ServingEngine:
     def __init__(self, model, *, num_slots, prompt_len,
                  max_cache_len=None, steps_per_call=1,
                  block_len=16, num_blocks=None, chunk_len=None,
-                 enable_prefix_cache=True, drafter=None,
+                 enable_prefix_cache=True, prefix_cache_mode=None,
+                 host_cache_blocks=None, drafter=None,
                  eos_token_id=None, pad_token_id=0,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  compute_dtype="bfloat16", cache_dtype=None,
@@ -699,7 +821,21 @@ class ServingEngine:
         self.steps_per_call = int(steps_per_call)
         self.block_len = int(block_len)
         self.static_batching = bool(static_batching)
-        self.enable_prefix_cache = bool(enable_prefix_cache)
+        # prefix-cache mode: "radix" (the default — token-level radix
+        # tree with host-RAM tiering), "digest" (the PR-3 block-
+        # aligned chained-digest map, kept as the bench A/B arm) or
+        # "none".  enable_prefix_cache=False is the legacy spelling of
+        # "none"; an explicit prefix_cache_mode wins over the bool.
+        if prefix_cache_mode is None:
+            mode = "radix" if enable_prefix_cache else "none"
+        else:
+            mode = str(prefix_cache_mode)
+            if mode not in ("radix", "digest", "none"):
+                raise ValueError(
+                    f"prefix_cache_mode must be 'radix', 'digest' or "
+                    f"'none', got {prefix_cache_mode!r}")
+        self.prefix_cache_mode = mode
+        self.enable_prefix_cache = mode != "none"
         if self.num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if self.steps_per_call < 1:
@@ -790,6 +926,28 @@ class ServingEngine:
         # digest namespace
         self._digest_salt = ("ptpu-paged-kv/"
                              + self.kv_cache_dtype).encode()
+        # ONE host-RAM block store for both host-tier uses: preemption
+        # swap-outs (reason="preempt", pinned until resume) and prefix-
+        # cache demotions (reason="cache", LRU-evicted under the
+        # capacity bound).  host_cache_blocks bounds only the cache
+        # half (0 = demotions drop, PR-3 forget semantics; default 4x
+        # the HBM pool — the host/HBM capacity multiplier).
+        cache_cap = (int(host_cache_blocks)
+                     if host_cache_blocks is not None
+                     else 4 * self.num_blocks)
+        if cache_cap < 0:
+            raise ValueError(
+                f"host_cache_blocks must be >= 0, got {host_cache_blocks}")
+        self._host_tier = HostTier(cache_capacity_blocks=cache_cap)
+        self._radix: Optional[RadixPrefixCache] = None
+        if mode == "radix":
+            self._radix = RadixPrefixCache(self.block_len, self._pool,
+                                           self._host_tier)
+            self._pool.reclaim_cb = self._demote_blocks
+            self._host_tier.evict_cb = self._radix.drop_host
+            self._pool.audit_hooks.append(
+                lambda: self._radix.audit(self._pool))
+        self._pool.audit_hooks.append(self._audit_host_tier)
         # host-side block tables; pushed (small int32) per dispatch —
         # the ONLY new per-step transfer; the arenas never leave the
         # device and are donated into both compiled programs so
@@ -833,9 +991,8 @@ class ServingEngine:
         self._queue: deque = deque()
         self._prefilling: deque = deque()
         self._swapped: List[Request] = []   # preempted, host-RAM KV
-        self._host_blocks = 0               # blocks in the swap tier
         self._swap_out_fn = None            # lazy: engines that never
-        self._swap_in_fn = None             # preempt compile neither
+        self._swap_in_fn = None             # swap compile neither
         self._finished: List[Request] = []
         self._clock = clock
         self._next_id = 0
@@ -848,6 +1005,8 @@ class ServingEngine:
             registry if registry is not None else obs_metrics.get_registry())
         self._m.slots_total.set(self.num_slots)
         self._m.kv_quant_dtype.set(1, dtype=self.kv_cache_dtype)
+        self._m.swap_host_blocks.set(0, reason="preempt")
+        self._m.swap_host_blocks.set(0, reason="cache")
         self._m.slot_occupancy.set(0)
         self._m.blocks_free.set(self.num_blocks)
         self._m.blocks_in_use.set(0)
@@ -909,6 +1068,91 @@ class ServingEngine:
         if self._fault is not None and self._fault.take_alloc_failure():
             return None
         return self._pool.alloc(n)
+
+    # -- host tier (shared by preemption swap + prefix-cache demotion) --
+    def _gather_rows(self, ids_row: np.ndarray) -> List[np.ndarray]:
+        """Read ``ids_row``'s arena rows (EXACT at-rest bytes: float
+        K/V, or int8 codes + scale planes) into host numpy stacks —
+        the ONE gather discipline behind preemption swap-out and
+        prefix-cache demotion.  ``ids_row`` is table-width (one
+        compiled shape); trash-row entries gather finite garbage the
+        callers slice away or ignore."""
+        return [np.asarray(r) for r in
+                self._swap_out()(jnp.asarray(ids_row), *self._arenas)]
+
+    def _scatter_rows(self, ids_row: np.ndarray,
+                      stacks: List[np.ndarray]):
+        """Write per-arena row ``stacks`` (k <= table-width rows each)
+        into the arena rows named by ``ids_row`` through the ONE
+        donation-matched swap-in program — shared by preemption resume
+        and prefix-cache promotion.  Stacks are zero-padded to table
+        width; the caller's ``ids_row`` routes pad rows at the trash
+        row (the write-masking contract of every paged writer)."""
+        padded = []
+        for s in stacks:
+            pr = np.zeros((self.max_blocks,) + s.shape[1:], s.dtype)
+            pr[:s.shape[0]] = s
+            padded.append(jnp.asarray(pr))
+        outp = self._swap_in()(jnp.asarray(ids_row), *padded,
+                               *self._arenas)
+        self._arenas = list(outp)
+
+    def _update_host_gauge(self):
+        self._m.swap_host_blocks.set(
+            self._host_tier.blocks("preempt"), reason="preempt")
+        self._m.swap_host_blocks.set(
+            self._host_tier.blocks("cache"), reason="cache")
+
+    def _demote_blocks(self, blocks: List[int]):
+        """``BlockPool.reclaim_cb`` (radix mode): instead of forgetting
+        reclaimed cached blocks, gather their EXACT at-rest bytes out
+        of every arena (codes + scale planes for the int8 cache) and
+        demote them to the host tier; the radix tree relabels the
+        positions host-resident so a later hit swaps the bytes back in
+        rather than recomputing.  ONE batched gather per alloc —
+        through the same compiled table-width program preemption uses
+        (ids padded with the trash row; wider reclaims page through
+        it) — so demotion costs a dispatch per admission, not per
+        block.  When the tier cannot take parcels (capacity 0 /
+        pinned-full) the positions become holes — the gather is
+        skipped entirely, and the next miss recomputes and refills
+        them."""
+        if not self._host_tier.would_accept(1):
+            for b in blocks:
+                self._radix.drop_hbm(b)
+            return
+        demoted = 0
+        w = self.max_blocks
+        with _span("serving.cache_swap_out", blocks=len(blocks)):
+            for i in range(0, len(blocks), w):
+                chunk = blocks[i:i + w]
+                ids = np.full((w,), self._pool.trash, np.int32)
+                ids[:len(chunk)] = chunk
+                stacks = self._gather_rows(ids)
+                for j, b in enumerate(chunk):
+                    rows = [np.ascontiguousarray(s[j:j + 1])
+                            for s in stacks]
+                    if self._radix.demote(b, rows) is not None:
+                        demoted += 1
+        if demoted:
+            self._m.swap_out_blocks.inc(demoted, reason="cache")
+            self._m.swap_out_bytes.inc(
+                demoted * self.block_len * self._kv_row_bytes,
+                reason="cache")
+        self._update_host_gauge()
+
+    def _audit_host_tier(self):
+        """BlockPool.check() hook: tier-internal invariants plus the
+        preempt-key <-> swap-list bijection (cache keys are audited
+        against the tree by ``RadixPrefixCache.audit``)."""
+        errs = list(self._host_tier.audit())
+        want = sorted(r.swap.host_key for r in self._swapped)
+        got = sorted(self._host_tier.keys("preempt"))
+        if want != got:
+            errs.append(
+                f"host tier preempt keys {got} != swap-list records "
+                f"{want}")
+        return errs
 
     # -- request intake --
     def submit(self, prompt_ids, seq_len=None, max_new_tokens=32,
@@ -1049,7 +1293,15 @@ class ServingEngine:
         # probed blocks and drop the request, or each failed submit
         # would leak refcounts until the pool wedges
         try:
-            if self.enable_prefix_cache:
+            if self._radix is not None:
+                # token-level probe: pin the span's HBM blocks against
+                # reclaim and its host parcels against tier eviction
+                # while the request queues; the admission re-probe
+                # revalidates (and usually extends) the match
+                self._probe_radix(req)
+                if req.matched:
+                    self._update_block_gauges()
+            elif self.enable_prefix_cache:
                 req.digests = _block_digests(padded, n, self.block_len,
                                              salt=self._digest_salt)
                 # match at most (n-1)//block_len blocks: the block
@@ -1149,6 +1401,9 @@ class ServingEngine:
             for b in req.matched:
                 self._pool.unpin(b)
             req.matched = []
+            for k in req.host_pins:
+                self._host_tier.unpin(k)
+            req.host_pins = []
             self._update_block_gauges()
             self._m.queue_depth.set(len(self._queue))
             raise
@@ -1180,8 +1435,8 @@ class ServingEngine:
         for req in self._swapped:
             if req.request_id == request_id:
                 self._swapped.remove(req)
-                self._host_blocks -= req.swap.n_blocks
-                self._m.swap_host_blocks.set(self._host_blocks)
+                self._host_tier.drop(req.swap.host_key)
+                self._update_host_gauge()
                 req.swap = None
                 self._terminate(req, now, "cancelled")
                 self._m.requests_cancelled.inc(phase="swapped")
@@ -1268,13 +1523,16 @@ class ServingEngine:
         """The ONE teardown for a queued request leaving without
         running (shed by the bounded queue, timed out past its
         queue-delay SLO, or cancelled from the queue): remove from the
-        queue, release submit-time prefix pins, mark terminal, refresh
-        the queue/block gauges.  The caller adds its own counter and
-        span."""
+        queue, release submit-time prefix pins (HBM blocks and host-
+        tier parcels both), mark terminal, refresh the queue/block
+        gauges.  The caller adds its own counter and span."""
         self._queue.remove(req)
         for b in req.matched:
             self._pool.unpin(b)
         req.matched = []
+        for k in req.host_pins:
+            self._host_tier.unpin(k)
+        req.host_pins = []
         self._terminate(req, now, state)
         self._m.queue_depth.set(len(self._queue))
         self._update_block_gauges()
@@ -1341,9 +1599,10 @@ class ServingEngine:
             # hit the trash row) but only the request's n real blocks
             # are KEPT host-side — the swap tier's actual footprint is
             # exactly what swap.host_blocks / swap_out_bytes report
-            rows = [np.asarray(r[:n]) for r in
-                    self._swap_out()(jnp.asarray(ids), *self._arenas)]
-        req.swap = _SwapRecord(rows=rows, n_blocks=n,
+            rows = [np.ascontiguousarray(r[:n])
+                    for r in self._gather_rows(ids)]
+        key = self._host_tier.put(rows, n, "preempt")
+        req.swap = _SwapRecord(host_key=key, n_blocks=n,
                                tok=int(self._tok[slot]),
                                lens=int(self._lens[slot]),
                                state=req.state)
@@ -1356,12 +1615,11 @@ class ServingEngine:
         req.state = "swapped"
         req.preempt_count += 1
         self._swapped.append(req)
-        self._host_blocks += n
         nbytes = n * self.block_len * self._kv_row_bytes
         self._m.preempts.inc()
-        self._m.swap_out_blocks.inc(n)
-        self._m.swap_out_bytes.inc(nbytes)
-        self._m.swap_host_blocks.set(self._host_blocks)
+        self._m.swap_out_blocks.inc(n, reason="preempt")
+        self._m.swap_out_bytes.inc(nbytes, reason="preempt")
+        self._update_host_gauge()
         self._m.slot_occupancy.set(
             sum(r is not None for r in self._slots))
         _span_instant("serving.request.preempt", request=req.request_id,
@@ -1424,18 +1682,11 @@ class ServingEngine:
         try:
             with _span("serving.swap_in", request=req.request_id,
                        blocks=rec.n_blocks):
-                # saved stacks are allocation-width; re-pad to the
-                # fixed table width (pad rows scatter into the trash
-                # row through the trash-padded ``row``)
-                padded_rows = []
-                for r in rec.rows:
-                    pr = np.zeros((self.max_blocks,) + r.shape[1:],
-                                  r.dtype)
-                    pr[:rec.n_blocks] = r
-                    padded_rows.append(jnp.asarray(pr))
-                outp = self._swap_in()(
-                    jnp.asarray(row), *padded_rows, *self._arenas)
-                self._arenas = list(outp)
+                # saved stacks are allocation-width; _scatter_rows
+                # re-pads to the fixed table width (pad rows scatter
+                # into the trash row through the trash-padded ``row``)
+                self._scatter_rows(
+                    row, self._host_tier.entry(rec.host_key).rows)
         except BaseException:
             for b in fresh:
                 self._pool.unpin(b)
@@ -1458,12 +1709,13 @@ class ServingEngine:
             # (their progress happens in the verify dispatch)
             self._done[slot] = req.spec_k is not None
         req.swap = None
-        self._host_blocks -= rec.n_blocks
+        self._host_tier.drop(rec.host_key)
         self._m.preempt_resumes.inc()
-        self._m.swap_in_blocks.inc(rec.n_blocks)
+        self._m.swap_in_blocks.inc(rec.n_blocks, reason="preempt")
         self._m.swap_in_bytes.inc(
-            rec.n_blocks * self.block_len * self._kv_row_bytes)
-        self._m.swap_host_blocks.set(self._host_blocks)
+            rec.n_blocks * self.block_len * self._kv_row_bytes,
+            reason="preempt")
+        self._update_host_gauge()
         self._update_block_gauges()
         _span_instant("serving.request.resume", request=req.request_id,
                       slot=slot, blocks=rec.n_blocks)
@@ -1473,11 +1725,137 @@ class ServingEngine:
         """Head-of-line valve body: nothing is running, so the only
         refcounts are queued requests' submit-time prefix pins —
         release them all (the cached blocks stay mapped, just
-        reclaimable again)."""
+        reclaimable again; host parcels likewise become evictable)."""
         for r in self._queue:
             for b in r.matched:
                 self._pool.unpin(b)
             r.matched = []
+            for k in r.host_pins:
+                self._host_tier.unpin(k)
+            r.host_pins = []
+            r.rspan = []
+            r.rmatch_tokens = 0   # else a valve (cold) admission would
+            #                       count a spurious partial hit
+
+    # -- radix prefix cache (tiered) --
+    def _probe_radix(self, req: Request):
+        """Probe the radix tree for ``req``'s prompt and pin the
+        matched span: HBM blocks against pool reclaim, host parcels
+        against tier eviction.  Sets ``req.matched`` (HBM blocks, in
+        span order interleaved with host positions removed),
+        ``req.host_pins`` (tier keys) and ``req.rspan``/
+        ``req.rmatch_tokens``.  The span is capped at the block before
+        the prompt's last token — the PR-3 rule: sampling the first
+        output token needs that block's hidden state."""
+        n = req.seq_len
+        m_tok, span = self._radix.match(req.prompt[:n])
+        span = span[:(n - 1) // self.block_len]
+        self._radix.touch_span(span)
+        for kind, ref in span:
+            if kind == "hbm":
+                self._pool.pin(ref)
+                req.matched.append(ref)
+            else:
+                self._host_tier.pin(ref)
+                req.host_pins.append(ref)
+        req.rmatch_tokens = min(m_tok, n - 1)
+        req.rspan = span
+
+    def _reprobe_radix(self, req: Request):
+        """Admission-time revalidation of the submit-time probe: the
+        tree may have grown (a sharer prefilled while this request
+        queued), demoted spans to host, or promoted them back.  Old
+        pins release first so pin accounting stays exact (host-side
+        and atomic with respect to the scheduler — nothing can reclaim
+        between the unpin and the re-pin).  An armed swap-in fault
+        degrades the span here, BEFORE allocation is sized: the host
+        parcels drop (their bytes are the thing that "failed") and the
+        span truncates to its directly-mapped HBM prefix, so the
+        request recomputes the tail — a prefix miss, never a wedge or
+        a token drift."""
+        for b in req.matched:
+            self._pool.unpin(b)
+        req.matched = []
+        for k in req.host_pins:
+            self._host_tier.unpin(k)
+        req.host_pins = []
+        self._probe_radix(req)
+        if any(kind == "host" for kind, _ in req.rspan) and \
+                self._fault is not None and \
+                self._fault.take_swapin_failure():
+            keep = []
+            for kind, ref in req.rspan:
+                if kind != "hbm":
+                    break
+                keep.append((kind, ref))
+            for kind, ref in req.rspan[len(keep):]:
+                if kind == "hbm":
+                    self._pool.unpin(ref)
+                    req.matched.remove(ref)
+                else:
+                    self._host_tier.unpin(ref)
+                    req.host_pins.remove(ref)
+                    self._host_tier.drop(ref)
+                    self._radix.drop_host(ref)
+            req.rspan = keep
+            self._update_host_gauge()
+        self._update_block_gauges()
+
+    def _map_radix_span(self, req: Request, fresh: List[int]):
+        """Resolve the matched span into arena blocks: HBM entries map
+        directly, host entries are PROMOTED — their exact at-rest
+        bytes re-scatter into the leading ``fresh`` blocks through the
+        shared donation-matched swap-in program, and the tree relabels
+        them HBM-resident (so the whole chain of sharers benefits).
+        Returns ``(mapped, leftover_fresh)`` with ``mapped`` in span
+        order.  A raise mid-promotion unpins every fresh block and
+        leaves the request a valid queue member (the submit() rollback
+        discipline)."""
+        span = req.rspan
+        host_keys = [ref for kind, ref in span if kind == "host"]
+        n_promote = len(host_keys)
+        if n_promote:
+            dest = fresh[:n_promote]
+            entries = [self._host_tier.entry(k) for k in host_keys]
+            ids_row = np.full((self.max_blocks,), self._pool.trash,
+                              np.int32)
+            ids_row[:n_promote] = dest
+            try:
+                with _span("serving.cache_swap_in",
+                           request=req.request_id, blocks=n_promote):
+                    self._scatter_rows(ids_row, [
+                        np.concatenate([e.rows[ai] for e in entries],
+                                       axis=0)
+                        for ai in range(len(self._arenas))])
+            except BaseException:
+                for b in fresh:
+                    self._pool.unpin(b)
+                self._update_block_gauges()
+                raise
+            for k, b in zip(host_keys, dest):
+                self._host_tier.unpin(k)       # the probe pin
+                self._radix.promote(k, b)      # consumes the parcel
+                req.host_pins.remove(k)
+            nbytes = n_promote * self.block_len * self._kv_row_bytes
+            self._m.swap_in_blocks.inc(n_promote, reason="cache")
+            self._m.swap_in_bytes.inc(nbytes, reason="cache")
+            self._m.prefix_host_hits.inc()
+            self._m.prefix_host_swapin.inc(n_promote)
+            self._update_host_gauge()
+        it = iter(fresh[:n_promote])
+        mapped = [ref if kind == "hbm" else next(it)
+                  for kind, ref in span]
+        return mapped, fresh[n_promote:]
+
+    def _residency_rank(self, r: Request) -> int:
+        """Fresh radix probe (no pinning) classifying a queued
+        request's matched prefix: 0 = some of it is HBM-resident,
+        1 = host-resident only, 2 = cold."""
+        _m, span = self._radix.match(r.prompt[:r.seq_len])
+        span = span[:(r.seq_len - 1) // self.block_len]
+        if any(kind == "hbm" for kind, _ in span):
+            return 0
+        return 1 if span else 2
 
     def _admit(self, now: float, out: List[Request]):
         """Admit the best-class candidates into vacant slots.  The
@@ -1499,13 +1877,40 @@ class ServingEngine:
         if self.static_batching and \
                 any(r is not None for r in self._slots):
             return
+        # candidate order: _sched_key (priority, then EDF) extended by
+        # a residency rank — swapped requests first within a class
+        # (they hold host memory and are closest to done), then queued
+        # requests whose matched prefix is HBM-resident, then host-
+        # resident, then cold.  The rank is a STRICT tie-break inside
+        # a scheduling class and the sort is stable over submission
+        # order, so a trace with no shared prefixes (or a non-radix
+        # engine, where the rank is constant) schedules byte-
+        # identically to the pre-tiered engine.  Ranks are probed once
+        # per candidate per _admit CALL (memoized — not once per sort
+        # comparison or per freed slot): the tree only improves
+        # mid-call (promotion/registration), and a call-stale rank
+        # costs order quality, never correctness.
+        ranks: dict = {}
+
+        def _cand_key(r):
+            base = self._sched_key(r)
+            if r.state == "swapped":
+                return base + (-1,)
+            if self._radix is None:
+                return base + (0,)
+            rank = ranks.get(r.request_id)
+            if rank is None:
+                rank = self._residency_rank(r)
+                ranks[r.request_id] = rank
+            return base + (rank,)
+
         while True:
             slot = next((i for i, r in enumerate(self._slots)
                          if r is None), None)
             if slot is None:
                 break
             arrived = [r for r in self._queue if r.arrival_time <= now]
-            cands = sorted(self._swapped + arrived, key=self._sched_key)
+            cands = sorted(self._swapped + arrived, key=_cand_key)
             if not cands:
                 break
             req = cands[0]
@@ -1513,7 +1918,13 @@ class ServingEngine:
                 if not self._try_resume(req, slot):
                     break
                 continue
-            if self.enable_prefix_cache:
+            if self._radix is not None:
+                # the tree may have grown while this request queued (a
+                # sharer prefilled, a span was promoted) — re-probe and
+                # re-pin before sizing the allocation
+                self._reprobe_radix(req)
+                n_hbm = len(req.matched)
+            elif self.enable_prefix_cache:
                 # blocks computed between submit and now may extend the
                 # match (e.g. the prefix holder finished its prefill
                 # while this request queued)
@@ -1524,34 +1935,57 @@ class ServingEngine:
                         break
                     self._pool.pin(b)
                     req.matched.append(b)
+                n_hbm = len(req.matched)
+            else:
+                n_hbm = 0
             total = self._blocks_needed(req.seq_len, req.max_new_tokens)
-            fresh = self._alloc(total - len(req.matched))
+            fresh = self._alloc(total - n_hbm)
             if fresh is None and \
                     not any(r is not None for r in self._slots):
                 # head-of-line valve: release every queued submit-time
                 # pin (including this request's own) and retry at full
                 # width; the submit() capacity guard makes this retry
                 # infallible against real exhaustion (an injected
-                # fault can still fail it)
+                # fault can still fail it).  The valve admission is
+                # COLD — the released span (host parcels included) is
+                # no longer protected, so nothing of it is mapped.
                 self._release_queue_pins()
+                n_hbm = 0
                 fresh = self._alloc(total)
             if fresh is None and self.enable_preemption and \
-                    self._preempt_for(req, total - len(req.matched)):
-                fresh = self._alloc(total - len(req.matched))
+                    self._preempt_for(req, total - n_hbm):
+                fresh = self._alloc(total - n_hbm)
             if fresh is None:
                 break                     # pool drains as requests retire
-            self._queue.remove(req)
             matchable = ((req.seq_len - 1) // self.block_len
                          if self.enable_prefix_cache else 0)
-            self._m.prefix_hits.inc(len(req.matched))
-            self._m.prefix_misses.inc(matchable - len(req.matched))
-            req.blocks = req.matched + fresh
+            if self._radix is not None:
+                # host-resident span entries swap their exact at-rest
+                # bytes back into the leading fresh blocks (one batched
+                # scatter); a raise leaves the request queued and the
+                # fresh blocks unpinned (_map_radix_span's rollback)
+                mapped, fresh = self._map_radix_span(req, fresh)
+                req.blocks = mapped + fresh
+                self._m.prefix_hit_tokens.inc(
+                    len(mapped) * self.block_len)
+                if req.rmatch_tokens > len(mapped) * self.block_len:
+                    self._m.prefix_partial_hits.inc()
+                req.matched = []
+                req.rspan = []
+            else:
+                mapped = req.matched
+                req.blocks = req.matched + fresh
+                self._m.prefix_hit_tokens.inc(
+                    len(mapped) * self.block_len)
+            self._queue.remove(req)
+            self._m.prefix_hits.inc(len(mapped))
+            self._m.prefix_misses.inc(matchable - len(mapped))
             row = np.full((self.max_blocks,), self._pool.trash, np.int32)
             row[:len(req.blocks)] = req.blocks
             self._tables[slot] = row
             req.slot = slot
             req.state = "prefill"
-            req.pf_pos = len(req.matched) * self.block_len
+            req.pf_pos = len(mapped) * self.block_len
             self._slots[slot] = req
             self._done[slot] = True       # not decoding yet
             self._lens[slot] = 0
@@ -1559,7 +1993,7 @@ class ServingEngine:
             self._m.queue_depth.set(len(self._queue))
             self._update_block_gauges()
             _span_instant("serving.request.admit", request=req.request_id,
-                          slot=slot, matched_blocks=len(req.matched))
+                          slot=slot, matched_blocks=len(mapped))
         self._m.slot_occupancy.set(
             sum(r is not None for r in self._slots))
 
@@ -1674,7 +2108,17 @@ class ServingEngine:
         self._m.chunk_latency.observe(self._clock() - t0)
         self._count_kv_sweep([min(start + c, req.seq_len) - 1])
         req.pf_pos = start + c
-        if self.enable_prefix_cache:
+        if self._radix is not None:
+            full = min(req.pf_pos, req.seq_len) // self.block_len
+            if full > req.registered:
+                # token runs + block spans go into the tree as soon as
+                # the blocks are fully written (first writer wins; the
+                # request's pin keeps them alive until release, after
+                # which they park tree-held in the reclaimable LRU)
+                self._radix.insert(req.prompt, req.blocks, full,
+                                   start_block=req.registered)
+                req.registered = full
+        elif self.enable_prefix_cache:
             full = min(req.pf_pos, req.seq_len) // self.block_len
             while req.registered < min(full, len(req.digests)):
                 i = req.registered
@@ -1919,6 +2363,15 @@ class ServingEngine:
                             and r.state in ("prefill", "decode"):
                         self._preempt(r, reason="forced")
                         break
+            n_evict = self._fault.take_tier_evicts()
+            if n_evict:
+                applied = 0
+                for _ in range(n_evict):
+                    if not self._host_tier.evict_one():
+                        break
+                    applied += 1
+                self._fault.record_tier_evicts(applied)
+                self._update_host_gauge()
         self._admit(t_now, finished)
         self._prefill_chunk(finished)
         self._spec_fallback = set()
@@ -2106,10 +2559,18 @@ class ServingEngine:
         The overload keys: ``preemptions``/``preempt_resumes`` count
         swap-outs and re-admissions, ``swap_blocks_out/in`` and
         ``swap_bytes_out`` the block traffic through the host-RAM
-        tier, ``swap_host_blocks``/``swapped_waiting`` the tier's
-        CURRENT footprint, and ``shed``/``timeouts`` the requests the
-        bounded queue and the queue-delay SLO dropped (label-summed;
-        ``cancelled`` likewise sums its per-phase label)."""
+        tier (reason-label-summed: preemption AND prefix-cache
+        demotion/promotion traffic), ``swap_host_blocks``/
+        ``swapped_waiting`` the preempt half's CURRENT footprint and
+        ``host_cache_blocks`` the cache half's, and ``shed``/
+        ``timeouts`` the requests the bounded queue and the
+        queue-delay SLO dropped (label-summed; ``cancelled`` likewise
+        sums its per-phase label).  The tiered-prefix-cache keys:
+        ``prefix_hit_tokens`` is token-granular served-from-cache
+        volume (mapped blocks x block_len), ``prefix_partial_hits``
+        counts admissions whose token-level match ran past the last
+        mappable block, ``prefix_host_hits``/``host_swapin_blocks``
+        the hits served by exact-bytes host->HBM swap-in."""
         decode_steps = self._m.since_init(self._m.decode_steps)
         busy = self._m.since_init(self._m.busy_slot_steps)
         occ = (busy / (decode_steps * self.num_slots)
@@ -2149,6 +2610,14 @@ class ServingEngine:
             "prefix_misses": int(misses),
             "prefix_hit_rate": (hits / (hits + misses)
                                 if hits + misses else 0.0),
+            "prefix_hit_tokens": int(
+                self._m.since_init(self._m.prefix_hit_tokens)),
+            "prefix_partial_hits": int(
+                self._m.since_init(self._m.prefix_partial_hits)),
+            "prefix_host_hits": int(
+                self._m.since_init(self._m.prefix_host_hits)),
+            "host_swapin_blocks": int(
+                self._m.since_init(self._m.prefix_host_swapin)),
             "mean_latency_s": (sum(lats) / len(lats)) if lats else None,
             "mean_ttft_s": (sum(ttfts) / len(ttfts)) if ttfts else None,
             "spec_verify_steps": int(verifies),
@@ -2181,7 +2650,8 @@ class ServingEngine:
                 self._m.since_init(self._m.swap_out_bytes)),
             "swap_bytes_in": int(
                 self._m.since_init(self._m.swap_in_bytes)),
-            "swap_host_blocks": self._host_blocks,
+            "swap_host_blocks": self._host_tier.blocks("preempt"),
+            "host_cache_blocks": self._host_tier.blocks("cache"),
             "swapped_waiting": len(self._swapped),
             "shed": int(self._m.since_init(self._m.shed)),
             "timeouts": int(self._m.since_init(self._m.timeouts)),

@@ -441,7 +441,7 @@ def test_metrics_name_lint_clean():
         assert n.startswith(
             ("serving.spec.", "serving.kv.", "serving.sample.",
              "serving.preempt.", "serving.swap.", "serving.shed.",
-             "serving.timeout.")), n
+             "serving.timeout.", "serving.prefix.")), n
         assert n in names, n
     kinds = {r[3]: r[2] for r in regs}
     assert kinds["serving.spec.accepted_length"] == "histogram"
@@ -458,6 +458,11 @@ def test_metrics_name_lint_clean():
     assert kinds["serving.swap.host_blocks"] == "gauge"
     assert kinds["serving.shed.requests"] == "counter"
     assert kinds["serving.timeout.requests"] == "counter"
+    # the tiered-prefix-cache set (bench prefix_tiered arm)
+    assert kinds["serving.prefix.hit_tokens"] == "counter"
+    assert kinds["serving.prefix.partial_hits"] == "counter"
+    assert kinds["serving.prefix.host_hits"] == "counter"
+    assert kinds["serving.prefix.host_swapin_blocks"] == "counter"
     # labeled overload counters carry their declared label tuples
     by_lbl = {r[3]: r[4] for r in regs}
     assert by_lbl["serving.shed.requests"] == ("reason",)

@@ -618,13 +618,17 @@ def test_prefix_reclaim_and_admission_valve(netm):
     spin forever and run() would blow max_iters; (c) the head's
     allocation then reclaims the whole LRU, so the shared prefix
     re-misses at the sharer's admission — and outputs still match the
-    oracle throughout."""
+    oracle throughout.  Pinned to the DIGEST cache mode: part (c)'s
+    reclaim-forgets semantics is exactly what the tiered radix mode
+    (the default) replaces — its demote-to-host behavior is covered
+    by tests/test_prefixcache.py."""
     cfg, net = netm
     rng = np.random.default_rng(9)
     shared = rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
     eng = ServingEngine(net, num_slots=2, prompt_len=P, max_cache_len=8,
                         steps_per_call=2, block_len=2, chunk_len=4,
-                        num_blocks=4, compute_dtype="float32")
+                        num_blocks=4, compute_dtype="float32",
+                        prefix_cache_mode="digest")
     req_a = eng.submit(shared, max_new_tokens=1)     # 2 blocks, publishes 2
     eng.run(max_iters=100)
     assert eng.stats()["prefix_cached_blocks"] == 2  # parked, mapped
@@ -705,6 +709,34 @@ def test_bench_llm_serving_section():
     assert 0.0 < pfx["prefix_hit_rate"] <= 1.0
     # hits skip chunks; the cached arm must compute strictly fewer
     assert pfx["prefill_chunks"] < pfx["no_cache_prefill_chunks"]
+    tiered = out["prefix_tiered"]
+    for k in ("block_len", "hbm_blocks", "system_len", "turns",
+              "conversations", "tiered", "digest", "no_cache",
+              "hit_tokens_vs_digest", "ttft_vs_digest"):
+        assert k in tiered, k
+    for arm in ("tiered", "digest", "no_cache"):
+        for k in ("tokens_per_s", "mean_ttft_ms", "hit_tokens",
+                  "host_hits", "host_swapin_blocks", "swapin_bytes",
+                  "prefill_chunks"):
+            assert k in tiered[arm], (arm, k)
+    # the acceptance gate: the tiered radix cache beats the PR-3
+    # digest cache on the multi-turn trace — strictly more cache
+    # tokens served (host-tier retention), strictly fewer recomputed
+    # chunks, and real host->HBM swap-in traffic
+    assert tiered["tiered"]["hit_tokens"] > tiered["digest"]["hit_tokens"]
+    assert tiered["tiered"]["prefill_chunks"] < \
+        tiered["digest"]["prefill_chunks"]
+    assert tiered["tiered"]["host_swapin_blocks"] > 0
+    assert tiered["tiered"]["swapin_bytes"] > 0
+    assert tiered["digest"]["host_swapin_blocks"] == 0
+    assert tiered["no_cache"]["hit_tokens"] == 0
+    # fewer chunks shows up as lower mean TTFT on a quiet box (~0.93x
+    # measured solo; the deterministic gates above are the primary
+    # result).  The bound is deliberately a STRUCTURAL-regression
+    # gate, not a perf gate: swap-program compiles landing inside the
+    # timed window measured ~2.4x, while 2-core box contention alone
+    # has measured up to ~1.3x on a correct build
+    assert tiered["ttft_vs_digest"] < 2.0
     kvq = out["kv_int8"]
     for k in ("baseline_dtype", "tokens_per_s", "baseline_tokens_per_s",
               "vs_baseline", "achieved_GBps", "baseline_achieved_GBps",

@@ -85,6 +85,13 @@ REQUIRED_INSTRUMENTS = {
     "serving.swap.host_blocks": "gauge",
     "serving.shed.requests": "counter",
     "serving.timeout.requests": "counter",
+    # tiered radix prefix cache (inference/serving.py
+    # _ServingInstruments): token-granular hit volume, partial-match
+    # and host-tier-hit counts the bench's prefix_tiered arm keys on
+    "serving.prefix.hit_tokens": "counter",
+    "serving.prefix.partial_hits": "counter",
+    "serving.prefix.host_hits": "counter",
+    "serving.prefix.host_swapin_blocks": "counter",
 }
 
 
