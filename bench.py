@@ -807,6 +807,14 @@ def _bench_serving(on_tpu):
     interactive class's p99 TTFT and, under a queue-delay SLO,
     the completion rate (the no-preempt arm sheds-by-timeout what it
     cannot serve in time), plus a bounded-queue shed demo.
+
+    The spec and overload arms each carry a ``goodput`` sub-object
+    (PR 9's ledger): useful vs wasted dispatched token-positions with
+    per-reason waste, gated ONLY on deterministic token counts — the
+    conservation gate is exact integer equality (useful + wasted ==
+    dispatched).  Wall-shaped companions (``mean_tpot_ms``, SLO
+    attainment, the ``serving.step.{host,dispatch}_seconds`` split in
+    the run's ``metrics`` sub-object) are reported ungated.
     """
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -1153,6 +1161,42 @@ def _bench_serving(on_tpu):
         def propose(self, context, k):
             return np.repeat(np.asarray(context[-1:], np.int32), k)
 
+    def _goodput_delta(final, warm):
+        """The goodput-ledger slice of a stats() delta: all
+        DETERMINISTIC token counts (the conservation gate is exact
+        integer equality; wall-shaped numbers like TPOT ride the arm
+        separately and are never gated)."""
+        g = {
+            "useful_tokens": final["useful_tokens"]
+            - warm["useful_tokens"],
+            "wasted_tokens": final["wasted_tokens"]
+            - warm["wasted_tokens"],
+            "dispatched_tokens": final["dispatched_tokens"]
+            - warm["dispatched_tokens"],
+            "wasted_by_reason": {
+                k: final["wasted_by_reason"][k]
+                - warm["wasted_by_reason"][k]
+                for k in final["wasted_by_reason"]},
+        }
+        g["goodput"] = (round(g["useful_tokens"]
+                              / g["dispatched_tokens"], 4)
+                        if g["dispatched_tokens"] else 0.0)
+        g["gate"] = {"conservation_ok":
+                     g["useful_tokens"] + g["wasted_tokens"]
+                     == g["dispatched_tokens"]}
+        return g
+
+    def _mean_tpot_ms(done):
+        """Mean per-output-token latency over one arm's finished
+        requests — a WALL time: reported for the trajectory, never
+        gated (the 2-core CI box's TPOT is jitter, the shape of the
+        number is what real accelerators read)."""
+        tp = [(r.finish_time - r.first_token_time) / (r.n_emitted - 1)
+              for r in done
+              if r.state == "finished" and r.first_token_time is not None
+              and r.n_emitted > 1]
+        return round(1e3 * sum(tp) / len(tp), 3) if tp else None
+
     def _one_spec_trace(use_spec, sampling_for=lambda i: None):
         # ``sampling_for(i)`` supplies request i's SamplingParams (None
         # = greedy): the spec AND sampling arms share this one trace
@@ -1208,6 +1252,8 @@ def _bench_serving(on_tpu):
             - warm["sampled_tokens"],
             "resamples": final["sample_resamples"]
             - warm["sample_resamples"],
+            "goodput": _goodput_delta(final, warm),
+            "mean_tpot_ms": _mean_tpot_ms(done),
         }
 
     def run_spec_arm(use_spec, sampling_for=lambda i: None):
@@ -1432,6 +1478,10 @@ def _bench_serving(on_tpu):
             "preemptions": final["preemptions"] - warm["preemptions"],
             "swap_blocks_out": final["swap_blocks_out"]
             - warm["swap_blocks_out"],
+            "goodput": _goodput_delta(final, warm),
+            "slo_attained": final["slo_attained"] - warm["slo_attained"],
+            "slo_missed": final["slo_missed"] - warm["slo_missed"],
+            "mean_tpot_ms": _mean_tpot_ms(longs + shorts),
         }
 
     # phase 1 (no SLO): the pure-queueing p99 TTFT delta
@@ -1488,6 +1538,20 @@ def _bench_serving(on_tpu):
         "no_preempt_slo_timeouts": ov_off_slo["timeouts"],
         "shed_demo": {"rejected": shed_rejected,
                       "evicted": shed_evicted},
+        # goodput ledger (no-SLO replay: every count deterministic —
+        # the conservation gate inside is exact integer equality);
+        # no_preempt_goodput shows what preemption costs in useful
+        # fraction — exact-bytes swap keeps recompute_preempt at 0,
+        # so the arms differ only via scheduling shape
+        "goodput": ov_on["goodput"],
+        "no_preempt_goodput": ov_off["goodput"]["goodput"],
+        # SLO attainment + TPOT are WALL-shaped (the timeout sweep is
+        # clock-driven): reported for the trajectory, never gated
+        "slo_attained": ov_on_slo["slo_attained"],
+        "slo_missed": ov_on_slo["slo_missed"],
+        "no_preempt_slo_attained": ov_off_slo["slo_attained"],
+        "no_preempt_slo_missed": ov_off_slo["slo_missed"],
+        "mean_tpot_ms": ov_on["mean_tpot_ms"],
     }
 
     return {
@@ -1548,6 +1612,15 @@ def _bench_serving(on_tpu):
             "accepted_length_le": spec_on["accepted_length_le"],
             "accepted_length_counts":
                 spec_on["accepted_length_counts"],
+            # goodput ledger: deterministic token counts (conservation
+            # gated exactly); the spec arm's wasted{spec_reject} is
+            # the price of drafting priced in positions, the no-spec
+            # run's goodput fraction is the same trace's ceiling.
+            # mean_tpot_ms is wall — reported, never gated
+            "goodput": spec_on["goodput"],
+            "no_spec_goodput": spec_off["goodput"]["goodput"],
+            "mean_tpot_ms": spec_on["mean_tpot_ms"],
+            "no_spec_mean_tpot_ms": spec_off["mean_tpot_ms"],
         },
         "sampling": {
             "temperature": sa_temp, "top_k": sa_topk,

@@ -147,6 +147,7 @@ import numpy as np
 from ..models.generation import (GenerationConfig, init_paged_kv_arena,
                                  model_arrays)
 from ..observability import metrics as obs_metrics
+from ..observability.flightrec import ENGINE_EVENT, FlightRecorder
 from ..observability.spans import instant as _span_instant
 from ..observability.spans import span as _span
 from .llm import (_build_paged_decode_block, build_chunk_prefill,
@@ -179,6 +180,26 @@ class EngineStalledError(RuntimeError):
     never returns).  The message carries the queue / slot / block-pool
     state at the moment of the raise so the wedge is debuggable from
     the exception alone."""
+
+
+# the goodput ledger's closed waste vocabulary: every dispatched
+# token-position is either useful or charged to exactly one of these
+# (serving.goodput.wasted_tokens{reason=}).  ``recompute_preempt`` is
+# structurally ZERO in this engine — preemption swaps exact at-rest
+# bytes, never recomputes — and is kept in the vocabulary as the
+# ledger's proof of that (a recompute-mode preemption path would
+# charge it; see notes.md PR 9).
+GOODPUT_REASONS = ("spec_reject", "recompute_preempt",
+                   "recompute_cache", "pad")
+
+# sub-ms resolution for the host-vs-dispatch step split: on real
+# accelerators the host scheduler slice this histogram isolates is the
+# tens-of-microseconds gap the dispatch-ahead pipeline (ROADMAP item 2)
+# must hide under device time
+_STEP_BUCKETS = (
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+    0.025, 0.05, 0.1, 0.25, 1.0, 5.0,
+)
 
 
 class _ServingInstruments:
@@ -390,6 +411,58 @@ class _ServingInstruments:
             "1 for each KV-cache at-rest dtype an engine in this "
             "process serves with (the label carries the dtype name)",
             labels=("dtype",))
+        self.goodput_useful = r.counter(
+            "serving.goodput.useful_tokens",
+            "dispatched token-positions that produced kept work: "
+            "first-time prompt prefill positions and emitted output "
+            "tokens that survive in the request's final stream")
+        self.goodput_wasted = r.counter(
+            "serving.goodput.wasted_tokens",
+            "dispatched token-positions that produced discarded work, "
+            "by reason: 'spec_reject' = rejected/cut speculative draft "
+            "positions, 'recompute_preempt' = positions recomputed "
+            "after preemption (structurally 0 under exact-bytes swap), "
+            "'recompute_cache' = prompt positions the prefix cache "
+            "matched token-level but could not map (partial tails, "
+            "dropped host parcels, tier-evict holes), 'pad' = grid/"
+            "mask padding (chunk-grid tails, post-EOS block tails, "
+            "masked verify lanes)", labels=("reason",))
+        self.goodput_dispatched = r.counter(
+            "serving.goodput.dispatched_tokens",
+            "total dispatched token-positions over participating rows "
+            "(the _count_kv_sweep convention: vacant/frozen rows are "
+            "excluded) — conservation: useful + wasted == this, "
+            "exactly, by construction of the ledger helper")
+        self.tpot = r.histogram(
+            "serving.tpot_seconds",
+            "per-output-token decode latency, one observation per "
+            "finished request with >= 2 output tokens: (last token - "
+            "first token) / (n_tokens - 1)")
+        self.step_host = r.histogram(
+            "serving.step.host_seconds",
+            "host-side scheduler time of one step(): step wall minus "
+            "the time spent inside compiled dispatches — the lockstep "
+            "gap a dispatch-ahead pipeline must hide under device "
+            "time (observed only for steps that dispatched work)",
+            buckets=_STEP_BUCKETS)
+        self.step_dispatch = r.histogram(
+            "serving.step.dispatch_seconds",
+            "time one step() spent inside compiled dispatches (chunk "
+            "prefill, decode block, spec verify, swap gathers/"
+            "scatters), including output materialization",
+            buckets=_STEP_BUCKETS)
+        self.slo_attained = r.counter(
+            "serving.slo.attained",
+            "SLO-carrying requests (deadline_s or max_queue_delay_s "
+            "set) that finished within their deadline; the class "
+            "label is the priority class (p<N>) — multi-tenant "
+            "serving will label per adapter", labels=("class",))
+        self.slo_missed = r.counter(
+            "serving.slo.missed",
+            "SLO-carrying requests that finished past their deadline "
+            "or were shed/timed out before running, by priority "
+            "class; cancelled requests are a user action, not an SLO "
+            "outcome, and count in neither", labels=("class",))
         self._base = {}
         for c in (self.prefills, self.prefill_chunks, self.decode_steps,
                   self.busy_slot_steps, self.block_dispatches,
@@ -405,16 +478,30 @@ class _ServingInstruments:
                   self.preempts, self.preempt_resumes,
                   self.swap_out_blocks, self.swap_in_blocks,
                   self.swap_out_bytes, self.swap_in_bytes,
-                  self.shed, self.timeouts):
+                  self.shed, self.timeouts,
+                  self.goodput_useful, self.goodput_wasted,
+                  self.goodput_dispatched,
+                  self.slo_attained, self.slo_missed):
             # total() sums label sets, so labeled counters (cancelled
             # by phase, shed by reason) baseline the same way the
             # unlabeled ones do
             self._base[c.name] = c.total()
+        # per-reason baselines for the wasted-tokens breakdown: the
+        # reason vocabulary is closed (GOODPUT_REASONS), so stats()
+        # can report exact per-reason per-engine deltas on a shared
+        # registry the same way since_init does for totals
+        self._wasted_base = {reason: self.goodput_wasted.value(
+            reason=reason) for reason in GOODPUT_REASONS}
 
     def since_init(self, counter) -> float:
         """Counter delta attributable to THIS engine (summed over
         label sets for labeled counters)."""
         return counter.total() - self._base.get(counter.name, 0)
+
+    def wasted_since(self, reason: str) -> float:
+        """Per-reason wasted-tokens delta attributable to THIS engine."""
+        return (self.goodput_wasted.value(reason=reason)
+                - self._wasted_base.get(reason, 0))
 
 
 def _call_quiet(fn, *args):
@@ -760,6 +847,14 @@ class Request:
     host_pins: List[int] = field(default_factory=list)  # pinned tier keys
     rspan: List = field(default_factory=list)  # radix span at last probe
     rmatch_tokens: int = 0             # token-level match at last probe
+    # goodput ledger: prompt positions in [gp_recompute_from,
+    # gp_recompute_to) were matched token-level by the prefix cache at
+    # admission but could NOT be mapped (partial tail past the last
+    # full block, dropped host parcels, tier-evict holes) — their
+    # prefill recompute is charged wasted{reason="recompute_cache"}
+    gp_recompute_from: int = 0
+    gp_recompute_to: int = 0
+    n_emitted: int = 0                 # tokens at finish, before padding
     blocks: List[int] = field(default_factory=list)    # full block map
     digests: List[bytes] = field(default_factory=list)
     registered: int = 0                # blocks published so far
@@ -807,7 +902,7 @@ class ServingEngine:
                  kv_cache_dtype=None,
                  seed=0, static_batching=False, clock=time.perf_counter,
                  registry=None, max_queue=None, enable_preemption=True,
-                 fault_injector=None):
+                 fault_injector=None, flight_recorder=None):
         self.num_slots = int(num_slots)
         self.max_queue = None if max_queue is None else int(max_queue)
         if self.max_queue is not None and self.max_queue < 1:
@@ -1012,6 +1107,26 @@ class ServingEngine:
         self._m.blocks_in_use.set(0)
         self._peak_queue = 0
         self._peak_blocks = 0
+        # per-request flight recorder: every lifecycle transition emits
+        # a structured event.  The default is a DISABLED instance so
+        # the emit sites stay uniform (one bool test per call) and
+        # ``engine.flight_recorder.enable()`` can be flipped live;
+        # pass ``flight_recorder=FlightRecorder()`` for a recording
+        # engine.  bind_clock puts event wall times on the ENGINE's
+        # clock (one time base with request arrival/finish times, a
+        # replay/fake engine clock included) unless the recorder was
+        # constructed with an explicit clock of its own.
+        self._fr = (flight_recorder if flight_recorder is not None
+                    else FlightRecorder(enabled=False))
+        self._fr.bind_clock(clock)
+        # scheduler iteration index: stamped into every flight-recorder
+        # event ("preempted at step 12") and incremented at step() start;
+        # submit()/cancel() events between steps carry the last index
+        self._step_idx = 0
+        # dispatch-time accumulator for the host-vs-dispatch step split
+        # (serving.step.{host,dispatch}_seconds); reset at step() start,
+        # fed by every compiled-call site incl. swap gathers/scatters
+        self._disp_s = 0.0
 
     # -- block accounting --
     def _blocks_needed(self, n: int, m: int) -> int:
@@ -1045,6 +1160,66 @@ class ServingEngine:
                    for ix in last_indices)
         self._m.kv_bytes_swept.inc(rows * self._kv_row_bytes)
 
+    # -- goodput ledger --
+    def _ledger(self, useful: int, **wasted: int):
+        """Account one dispatch's token-positions into the goodput
+        ledger.  Conservation (useful + wasted == dispatched) holds BY
+        CONSTRUCTION: the dispatched counter is incremented by exactly
+        the sum of the classified parts, so the registry identity can
+        never drift — what CAN go wrong is a call site mis-splitting a
+        dispatch, which the negative guard and the tier-1 cross-checks
+        (wasted{spec_reject} == flight-recorder rejected sums, decode
+        positions == busy_slot_steps) catch.  Positions are counted
+        over PARTICIPATING rows only, the ``_count_kv_sweep``
+        convention: vacant/frozen rows in the same compiled dispatch
+        do burn FLOPs, but counting them would make goodput a function
+        of slot-pool geometry instead of scheduling quality (both A/B
+        bench arms share the convention, so ratios are unaffected)."""
+        total = useful
+        for reason, n in wasted.items():
+            if reason not in GOODPUT_REASONS:
+                raise ValueError(
+                    f"unknown goodput waste reason {reason!r} — known: "
+                    f"{GOODPUT_REASONS}")
+            if n < 0:
+                raise ValueError(
+                    f"goodput ledger: negative {reason} count {n} — a "
+                    f"dispatch was mis-split")
+            total += n
+        if useful < 0:
+            raise ValueError(
+                f"goodput ledger: negative useful count {useful}")
+        if total == 0:
+            return
+        self._m.goodput_dispatched.inc(total)
+        if useful:
+            self._m.goodput_useful.inc(useful)
+        for reason, n in wasted.items():
+            if n:
+                self._m.goodput_wasted.inc(n, reason=reason)
+
+    @staticmethod
+    def _slo_class(req: Request) -> str:
+        """The SLO-attainment class label: the priority class for now
+        (``p<N>``); the multi-tenant adapter work will refine this to
+        per-adapter labels."""
+        return f"p{req.priority}"
+
+    def _slo_account(self, req: Request):
+        """Score a terminal request against its SLO, by class.  Only
+        SLO-carrying requests (a deadline or a queue-delay bound)
+        count; ``deadline_s`` never kills a request (PR 7), so a late
+        finish is the 'missed' outcome deadline feeds.  Cancelled
+        requests are a user action, not an SLO outcome."""
+        if req.deadline is None and req.max_queue_delay_s is None:
+            return
+        cls = self._slo_class(req)
+        if req.state == "finished" and (
+                req.deadline is None or req.finish_time <= req.deadline):
+            self._m.slo_attained.inc(**{"class": cls})
+        elif req.state in ("finished", "timeout", "shed"):
+            self._m.slo_missed.inc(**{"class": cls})
+
     def _release_blocks(self, req: Request):
         """Unpin every block the request holds and trash its table
         row.  IDEMPOTENT by construction: the block list is cleared
@@ -1077,8 +1252,11 @@ class ServingEngine:
         prefix-cache demotion.  ``ids_row`` is table-width (one
         compiled shape); trash-row entries gather finite garbage the
         callers slice away or ignore."""
-        return [np.asarray(r) for r in
-                self._swap_out()(jnp.asarray(ids_row), *self._arenas)]
+        t0 = self._clock()
+        out = [np.asarray(r) for r in
+               self._swap_out()(jnp.asarray(ids_row), *self._arenas)]
+        self._disp_s += self._clock() - t0
+        return out
 
     def _scatter_rows(self, ids_row: np.ndarray,
                       stacks: List[np.ndarray]):
@@ -1088,6 +1266,7 @@ class ServingEngine:
         and prefix-cache promotion.  Stacks are zero-padded to table
         width; the caller's ``ids_row`` routes pad rows at the trash
         row (the write-masking contract of every paged writer)."""
+        t0 = self._clock()
         padded = []
         for s in stacks:
             pr = np.zeros((self.max_blocks,) + s.shape[1:], s.dtype)
@@ -1096,6 +1275,7 @@ class ServingEngine:
         outp = self._swap_in()(jnp.asarray(ids_row), *padded,
                                *self._arenas)
         self._arenas = list(outp)
+        self._disp_s += self._clock() - t0
 
     def _update_host_gauge(self):
         self._m.swap_host_blocks.set(
@@ -1139,6 +1319,10 @@ class ServingEngine:
             self._m.swap_out_bytes.inc(
                 demoted * self.block_len * self._kv_row_bytes,
                 reason="cache")
+            # engine-scoped (the pool demotes on behalf of the cache,
+            # not of one request) — lane -1 in the chrome export
+            self._fr.emit("swap_out", ENGINE_EVENT, self._step_idx,
+                          blocks=demoted, reason="cache")
         self._update_host_gauge()
 
     def _audit_host_tier(self):
@@ -1383,6 +1567,9 @@ class ServingEngine:
             self._queue.append(req)
             _span_instant("serving.request.queued",
                           request=req.request_id, seq_len=n, max_new=m)
+            self._fr.emit("submit", req.request_id, self._step_idx,
+                          seq_len=n, max_new=m, priority=prio,
+                          queue_depth=len(self._queue))
             if evict is not None:
                 self._shed(evict, now)
             # peak AFTER a pending eviction: the one-element overshoot
@@ -1431,6 +1618,8 @@ class ServingEngine:
                 self._m.requests_cancelled.inc(phase="queued")
                 _span_instant("serving.request.cancel",
                               request=req.request_id, phase="queued")
+                self._fr.emit("cancel", req.request_id, self._step_idx,
+                              phase="queued")
                 return True
         for req in self._swapped:
             if req.request_id == request_id:
@@ -1442,6 +1631,8 @@ class ServingEngine:
                 self._m.requests_cancelled.inc(phase="swapped")
                 _span_instant("serving.request.cancel",
                               request=req.request_id, phase="swapped")
+                self._fr.emit("cancel", req.request_id, self._step_idx,
+                              phase="swapped")
                 return True
         for i, req in enumerate(self._slots):
             if req is not None and req.request_id == request_id:
@@ -1458,6 +1649,8 @@ class ServingEngine:
                     sum(r is not None for r in self._slots))
                 _span_instant("serving.request.cancel",
                               request=req.request_id, phase=phase)
+                self._fr.emit("cancel", req.request_id, self._step_idx,
+                              phase=phase)
                 return True
         return False
 
@@ -1471,8 +1664,20 @@ class ServingEngine:
         self._m.requests_finished.inc()
         if req.latency is not None:
             self._m.latency.observe(req.latency)
+        # per-output-token latency (TPOT), one observation per request
+        # with >= 2 tokens: the decode-rate SLO metric TTFT cannot see
+        # (block-granular like tokens_emitted — a steps_per_call>1
+        # final block's pad tail is inside len(req.tokens) here)
+        n_out = len(req.tokens)
+        req.n_emitted = n_out
+        if req.first_token_time is not None and n_out >= 2:
+            self._m.tpot.observe(
+                (t - req.first_token_time) / (n_out - 1))
+        self._slo_account(req)
         _span_instant("serving.request.finish", request=req.request_id,
                       tokens=len(req.tokens))
+        self._fr.emit("finish", req.request_id, self._step_idx,
+                      tokens=n_out)
         # pad the stream out to max_new_tokens (the static generate()
         # convention: pad after EOS) so output shapes are uniform
         req.tokens.extend(
@@ -1518,6 +1723,9 @@ class ServingEngine:
         req.finish_time = now
         req.tokens.extend([self.cfg.pad_token_id]
                           * (req.max_new_tokens - len(req.tokens)))
+        # shed/timeout are SLO outcomes (missed); cancel is skipped
+        # inside _slo_account by state
+        self._slo_account(req)
 
     def _drop_queued(self, req: Request, now: float, state: str):
         """The ONE teardown for a queued request leaving without
@@ -1543,6 +1751,7 @@ class ServingEngine:
         self._drop_queued(req, now, "shed")
         self._m.shed.inc(reason="evicted")
         _span_instant("serving.request.shed", request=req.request_id)
+        self._fr.emit("shed", req.request_id, self._step_idx)
 
     def _sweep_timeouts(self, now: float, out: List[Request]):
         """Finish queued requests whose wait exceeded their
@@ -1560,6 +1769,9 @@ class ServingEngine:
                           request=r.request_id,
                           waited_ms=round(
                               (now - r.arrival_time) * 1e3, 3))
+            # no waited_ms attr here: flight-recorder attrs must stay
+            # wall-free so replayed traces compare event-identical
+            self._fr.emit("timeout", r.request_id, self._step_idx)
             out.append(r)
 
     # -- preemption + host-RAM swap --
@@ -1624,6 +1836,10 @@ class ServingEngine:
             sum(r is not None for r in self._slots))
         _span_instant("serving.request.preempt", request=req.request_id,
                       blocks=n, reason=reason)
+        self._fr.emit("preempt", req.request_id, self._step_idx,
+                      blocks=n, reason=reason, phase=req.swap.state)
+        self._fr.emit("swap_out", req.request_id, self._step_idx,
+                      blocks=n, reason="preempt")
 
     def _preempt_for(self, cand: Request, needed: int) -> bool:
         """Free blocks for ``cand`` by swapping out strictly-worse
@@ -1719,6 +1935,8 @@ class ServingEngine:
         self._update_block_gauges()
         _span_instant("serving.request.resume", request=req.request_id,
                       slot=slot, blocks=rec.n_blocks)
+        self._fr.emit("swap_in", req.request_id, self._step_idx,
+                      blocks=rec.n_blocks, reason="preempt", slot=slot)
         return True
 
     def _release_queue_pins(self):
@@ -1807,9 +2025,12 @@ class ServingEngine:
         bytes re-scatter into the leading ``fresh`` blocks through the
         shared donation-matched swap-in program, and the tree relabels
         them HBM-resident (so the whole chain of sharers benefits).
-        Returns ``(mapped, leftover_fresh)`` with ``mapped`` in span
-        order.  A raise mid-promotion unpins every fresh block and
-        leaves the request a valid queue member (the submit() rollback
+        Returns ``(mapped, leftover_fresh, n_promoted)`` with
+        ``mapped`` in span order; ``n_promoted`` counts the blocks
+        ACTUALLY promoted from the host tier — the ground truth the
+        admit-time ``prefix_hit`` event's tier label rides on.  A
+        raise mid-promotion unpins every fresh block and leaves the
+        request a valid queue member (the submit() rollback
         discipline)."""
         span = req.rspan
         host_keys = [ref for kind, ref in span if kind == "host"]
@@ -1841,11 +2062,13 @@ class ServingEngine:
             self._m.swap_in_bytes.inc(nbytes, reason="cache")
             self._m.prefix_host_hits.inc()
             self._m.prefix_host_swapin.inc(n_promote)
+            self._fr.emit("swap_in", req.request_id, self._step_idx,
+                          blocks=n_promote, reason="cache")
             self._update_host_gauge()
         it = iter(fresh[:n_promote])
         mapped = [ref if kind == "hbm" else next(it)
                   for kind, ref in span]
-        return mapped, fresh[n_promote:]
+        return mapped, fresh[n_promote:], n_promote
 
     def _residency_rank(self, r: Request) -> int:
         """Fresh radix probe (no pinning) classifying a queued
@@ -1964,12 +2187,29 @@ class ServingEngine:
                 # bytes back into the leading fresh blocks (one batched
                 # scatter); a raise leaves the request queued and the
                 # fresh blocks unpinned (_map_radix_span's rollback)
-                mapped, fresh = self._map_radix_span(req, fresh)
+                mapped, fresh, n_promoted = \
+                    self._map_radix_span(req, fresh)
                 req.blocks = mapped + fresh
-                self._m.prefix_hit_tokens.inc(
-                    len(mapped) * self.block_len)
-                if req.rmatch_tokens > len(mapped) * self.block_len:
+                hit_tokens = len(mapped) * self.block_len
+                self._m.prefix_hit_tokens.inc(hit_tokens)
+                partial = req.rmatch_tokens > hit_tokens
+                if partial:
                     self._m.prefix_partial_hits.inc()
+                # goodput: positions the tree matched token-level but
+                # could not map (partial tail, dropped host parcels,
+                # evict holes) will be recomputed by the prefill —
+                # charge them wasted{recompute_cache} as they compute
+                req.gp_recompute_from = hit_tokens
+                req.gp_recompute_to = max(hit_tokens, req.rmatch_tokens)
+                if mapped or partial:
+                    # tier rides the ACTUAL promotion count out of
+                    # _map_radix_span, never the pre-map span shape
+                    self._fr.emit(
+                        "prefix_hit", req.request_id, self._step_idx,
+                        tier=("host" if n_promoted else
+                              "hbm" if mapped else "partial"),
+                        blocks=len(mapped), tokens=hit_tokens,
+                        partial=int(partial))
                 req.matched = []
                 req.rspan = []
             else:
@@ -1977,6 +2217,12 @@ class ServingEngine:
                 req.blocks = req.matched + fresh
                 self._m.prefix_hit_tokens.inc(
                     len(mapped) * self.block_len)
+                if mapped:
+                    self._fr.emit(
+                        "prefix_hit", req.request_id, self._step_idx,
+                        tier="hbm", blocks=len(mapped),
+                        tokens=len(mapped) * self.block_len,
+                        partial=0)
             self._queue.remove(req)
             self._m.prefix_hits.inc(len(mapped))
             self._m.prefix_misses.inc(matchable - len(mapped))
@@ -1993,6 +2239,8 @@ class ServingEngine:
             self._m.queue_depth.set(len(self._queue))
             self._update_block_gauges()
             _span_instant("serving.request.admit", request=req.request_id,
+                          slot=slot, matched_blocks=len(mapped))
+            self._fr.emit("admit", req.request_id, self._step_idx,
                           slot=slot, matched_blocks=len(mapped))
         self._m.slot_occupancy.set(
             sum(r is not None for r in self._slots))
@@ -2105,8 +2353,20 @@ class ServingEngine:
             self._arenas = list(outp[1:])
             tok0 = int(np.asarray(outp[0])[0])
         self._m.prefill_chunks.inc()
-        self._m.chunk_latency.observe(self._clock() - t0)
+        dt = self._clock() - t0
+        self._m.chunk_latency.observe(dt)
+        self._disp_s += dt
         self._count_kv_sweep([min(start + c, req.seq_len) - 1])
+        # goodput: the dispatch computed chunk_len positions for this
+        # row — valid prompt positions split first-time-useful vs
+        # cache-known recompute (the [gp_recompute_from, _to) span set
+        # at admission), the grid tail past seq_len is pad
+        valid = min(start + c, req.seq_len) - start
+        rc = max(0, (min(start + valid, req.gp_recompute_to)
+                     - max(start, req.gp_recompute_from)))
+        self._ledger(valid - rc, recompute_cache=rc, pad=c - valid)
+        self._fr.emit("prefill_chunk", req.request_id, self._step_idx,
+                      start=start, tokens=valid)
         req.pf_pos = start + c
         if self._radix is not None:
             full = min(req.pf_pos, req.seq_len) // self.block_len
@@ -2290,6 +2550,7 @@ class ServingEngine:
         flags, samp = self._build_samp(
             [r if i in spec_set else None
              for i, r in enumerate(self._slots)])
+        t0 = self._clock()
         with _span("serving.spec_verify", width=width, active=len(spec)):
             outp = _call_quiet(
                 self._verify_fn(width, flags), self._pb,
@@ -2305,6 +2566,7 @@ class ServingEngine:
             else:
                 greedy = np.asarray(outp[0])            # [B, width]
                 self._arenas = list(outp[1:])
+        self._disp_s += self._clock() - t0
         self._m.spec_verifies.inc()
         # the K-wide kernel DMAs the STATIC width's frontier
         # (lens + cq - 1) for every spec row, however few positions
@@ -2312,6 +2574,7 @@ class ServingEngine:
         self._count_kv_sweep([int(self._lens[i]) + width - 1
                               for i in spec])
         t = self._clock()
+        gp_useful = gp_reject = gp_pad = 0
         for i in spec:
             req = self._slots[i]
             sp = req.sampling
@@ -2327,12 +2590,25 @@ class ServingEngine:
             self._m.spec_accepted_tokens.inc(accepted)
             self._m.tokens_emitted.inc(len(emitted))
             self._count_sample_route([(req, len(emitted))])
+            # goodput: this row dispatched ``width`` positions —
+            # emitted tokens are useful, rejected/EOS-cut draft
+            # positions (they were computed AND written, then rolled
+            # back behind the lens) are spec_reject, the masked tail
+            # past n_valid is pad
+            n_val = int(n_valid[i])
+            gp_useful += len(emitted)
+            gp_reject += n_val - len(emitted)
+            gp_pad += width - n_val
             req.tokens.extend(emitted)
             req.remaining -= len(emitted)
             self._lens[i] += len(emitted)
             self._tok[i] = emitted[-1]
             _span_instant("serving.spec.accept", request=req.request_id,
                           drafted=int(drafts[i].size), accepted=accepted)
+            self._fr.emit("spec_verify", req.request_id, self._step_idx,
+                          drafted=int(drafts[i].size), accepted=accepted,
+                          rejected=n_val - len(emitted),
+                          emitted=len(emitted))
             hit_eos = (self.cfg.eos_token_id is not None
                        and emitted[-1] == self.cfg.eos_token_id)
             if hit_eos or req.remaining == 0:
@@ -2340,6 +2616,7 @@ class ServingEngine:
                 self._done[i] = True
                 self._release_blocks(req)
                 self._finish(req, t, out)
+        self._ledger(gp_useful, spec_reject=gp_reject, pad=gp_pad)
 
     def step(self, now: Optional[float] = None) -> List[Request]:
         """One scheduler iteration: sweep queue-delay timeouts and
@@ -2349,7 +2626,27 @@ class ServingEngine:
         and one decode block over the plain-decode mix — the phases
         coexist in the same iteration.  Returns the requests that
         reached a terminal state this iteration (finished or
-        timeout)."""
+        timeout).
+
+        Also attributes the iteration's wall time: every compiled-
+        dispatch site (chunk prefill, verify, decode block, swap
+        gathers/scatters) accumulates into ``serving.step.
+        dispatch_seconds`` and the remainder is ``serving.step.
+        host_seconds`` — the host-scheduler slice a dispatch-ahead
+        pipeline (ROADMAP item 2) must hide.  Steps that dispatched
+        nothing (idle admission polls) observe neither."""
+        self._step_idx += 1
+        self._disp_s = 0.0
+        t0 = self._clock()
+        out = self._step_inner(now)
+        disp = self._disp_s
+        if disp > 0.0:
+            self._m.step_dispatch.observe(disp)
+            self._m.step_host.observe(
+                max((self._clock() - t0) - disp, 0.0))
+        return out
+
+    def _step_inner(self, now: Optional[float] = None) -> List[Request]:
         finished: List[Request] = []
         t_now = self._clock() if now is None else now
         if self._fault is not None:
@@ -2416,6 +2713,7 @@ class ServingEngine:
                   for i in range(self.num_slots)]
         flags, samp = self._build_samp(riding)
         pre_lens = self._lens
+        t_blk = self._clock()
         with _span("serving.decode_block", steps=n, active=len(active)):
             out = _call_quiet(
                 self._block_fn(n, flags),
@@ -2427,6 +2725,7 @@ class ServingEngine:
         self._lens = np.array(out[2])
         done = np.array(out[3])
         self._arenas = list(out[4:])
+        self._disp_s += self._clock() - t_blk
         self._m.decode_steps.inc(n)
         self._m.busy_slot_steps.inc(n * len(active))
         self._m.block_dispatches.inc()
@@ -2440,9 +2739,25 @@ class ServingEngine:
             [min(int(pre_lens[i]) + s, int(self._lens[i]))
              for i in active for s in range(n)])
         self._count_sample_route([(self._slots[i], n) for i in active])
+        # goodput: each riding row dispatched n positions — tokens up
+        # to (and including) a mid-block EOS are useful, the frozen
+        # tail behind it is pad (empty at steps_per_call=1)
+        gp_useful = gp_pad = 0
+        eos = self.cfg.eos_token_id
+        for i in active:
+            row = toks[i]
+            if eos is not None and eos in row:
+                useful_i = int(np.flatnonzero(row == eos)[0]) + 1
+            else:
+                useful_i = n
+            gp_useful += useful_i
+            gp_pad += n - useful_i
+        self._ledger(gp_useful, pad=gp_pad)
         t = self._clock()
         for i in active:
             req = self._slots[i]
+            self._fr.emit("decode_block", req.request_id,
+                          self._step_idx, steps=n)
             req.tokens.extend(int(x) for x in toks[i])
             req.remaining -= n
             if done[i] or req.remaining == 0:
@@ -2570,7 +2885,17 @@ class ServingEngine:
         volume (mapped blocks x block_len), ``prefix_partial_hits``
         counts admissions whose token-level match ran past the last
         mappable block, ``prefix_host_hits``/``host_swapin_blocks``
-        the hits served by exact-bytes host->HBM swap-in."""
+        the hits served by exact-bytes host->HBM swap-in.
+        The goodput-ledger keys: ``useful_tokens`` + ``wasted_tokens``
+        == ``dispatched_tokens`` EXACTLY (conservation by construction
+        of ``_ledger``), ``goodput`` is the useful fraction and
+        ``wasted_by_reason`` the per-reason breakdown over the closed
+        ``GOODPUT_REASONS`` vocabulary.  ``mean_tpot_s`` is the mean
+        per-output-token decode latency over finished requests with
+        >= 2 tokens (None while that set is empty);
+        ``slo_attained``/``slo_missed`` are class-label-summed SLO
+        outcomes over requests that carried a deadline or queue-delay
+        bound."""
         decode_steps = self._m.since_init(self._m.decode_steps)
         busy = self._m.since_init(self._m.busy_slot_steps)
         occ = (busy / (decode_steps * self.num_slots)
@@ -2583,6 +2908,13 @@ class ServingEngine:
         verifies = self._m.since_init(self._m.spec_verifies)
         drafted = self._m.since_init(self._m.spec_draft_tokens)
         accepted = self._m.since_init(self._m.spec_accepted_tokens)
+        useful = int(self._m.since_init(self._m.goodput_useful))
+        wasted = int(self._m.since_init(self._m.goodput_wasted))
+        dispatched = int(self._m.since_init(self._m.goodput_dispatched))
+        tpots = [(r.finish_time - r.first_token_time) / (r.n_emitted - 1)
+                 for r in self._finished
+                 if r.state == "finished" and r.first_token_time is not None
+                 and r.finish_time is not None and r.n_emitted > 1]
         return {
             "num_slots": self.num_slots,
             "kv_cache_dtype": self.kv_cache_dtype,
@@ -2655,6 +2987,17 @@ class ServingEngine:
             "swapped_waiting": len(self._swapped),
             "shed": int(self._m.since_init(self._m.shed)),
             "timeouts": int(self._m.since_init(self._m.timeouts)),
+            "useful_tokens": useful,
+            "wasted_tokens": wasted,
+            "dispatched_tokens": dispatched,
+            "goodput": (useful / dispatched if dispatched else 0.0),
+            "wasted_by_reason": {
+                reason: int(self._m.wasted_since(reason))
+                for reason in GOODPUT_REASONS},
+            "mean_tpot_s": (sum(tpots) / len(tpots)) if tpots else None,
+            "slo_attained": int(
+                self._m.since_init(self._m.slo_attained)),
+            "slo_missed": int(self._m.since_init(self._m.slo_missed)),
         }
 
     @property
@@ -2662,3 +3005,15 @@ class ServingEngine:
         """The MetricsRegistry this engine records into (the process
         default unless one was passed at construction)."""
         return self._m.registry
+
+    @property
+    def flight_recorder(self) -> FlightRecorder:
+        """The per-request flight recorder (a disabled default unless
+        one was passed at construction — ``.enable()`` flips it live)."""
+        return self._fr
+
+    def explain(self, request_id: int) -> str:
+        """Human-readable lifecycle of one request, from the flight
+        recorder ("waited 3 steps behind req 7, preempted at step 12,
+        resumed via 6 host blocks ...")."""
+        return self._fr.explain(request_id)

@@ -13,6 +13,11 @@ Two cooperating layers (see the module docstrings for design notes):
   ranges over ``runtime.HostTracer`` and ``merge_chrome_traces`` to
   stitch the host trace with the ``jax.profiler`` device dump into one
   Perfetto-loadable file.
+- :mod:`~paddle_tpu.observability.flightrec` — the per-request
+  ``FlightRecorder``: a bounded ring of structured lifecycle events
+  the serving engine emits, with ``timeline()``/``explain()`` queries,
+  a JSON export ``tools/explain_request.py`` reads, and per-request
+  Perfetto lanes that ride ``merge_chrome_traces``.
 
 The reference analogue is ``paddle/fluid/platform/profiler`` plus its
 benchmark/stat utilities; here the metrics side is pull-model (scrape
@@ -26,10 +31,16 @@ from .metrics import (  # noqa: F401
 from .spans import (  # noqa: F401
     format_span_name, instant, merge_chrome_traces, parse_span_name, span,
 )
+from .flightrec import (  # noqa: F401
+    EVENT_KINDS, FlightEvent, FlightRecorder, explain_events,
+    load_flight_record,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
     "NAME_RE", "diff_snapshots", "get_registry",
     "span", "instant", "format_span_name", "parse_span_name",
     "merge_chrome_traces",
+    "EVENT_KINDS", "FlightEvent", "FlightRecorder", "explain_events",
+    "load_flight_record",
 ]

@@ -1,0 +1,319 @@
+"""Per-request flight recorder: a bounded ring of structured lifecycle
+events answering "why was THIS request slow".
+
+The metrics registry (PR 2) aggregates — when one request's TTFT blows
+up it can say the fleet preempted 14 times, but not that *this*
+request waited 3 steps behind request 7, was preempted at step 12 and
+resumed via 6 host-RAM blocks.  The flight recorder keeps that
+per-request story: every ``ServingEngine`` lifecycle transition emits
+one structured event (kind + request id + scheduler step + attrs) into
+a bounded ring buffer; ``timeline()`` filters one request's events,
+``explain()`` renders them as one human-readable sentence, and
+``chrome_events()`` re-encodes the ring as HostTracer-style event
+tuples (one lane per request) that ``merge_chrome_traces`` stitches
+into the same Perfetto file as the host spans and the device dump.
+
+Design constraints (mirrors ``observability.metrics``):
+
+- **near-zero cost when disabled** — ``emit()`` starts with one
+  attribute load + bool test; kind validation, timestamping and the
+  ring append happen only on the enabled path (mislabeled kinds
+  surface on enable, the ``_resolve_labels`` argument).
+- **bounded** — the ring is a ``deque(maxlen=capacity)``: overflow
+  drops the OLDEST events (the newest tail is what an incident
+  investigation needs) and ``dropped`` counts the loss so an export
+  is never silently partial.
+- **deterministic modulo wall time** — every field except ``wall`` is
+  derived from scheduler state, never from the clock, so two replays
+  of one trace produce identical event sequences (the determinism
+  contract tests assert; attrs must never carry wall-derived values).
+
+The export format (``export()``/``load_flight_record``) is plain JSON
+so ``tools/explain_request.py`` can post-mortem a record from another
+process with no framework import beyond this module.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .spans import format_span_name
+
+# the closed vocabulary of lifecycle transitions a ServingEngine emits;
+# emit() rejects anything else so a typo'd kind cannot silently create
+# a parallel event stream no consumer (explain, the CLI) knows about
+EVENT_KINDS = frozenset({
+    "submit",         # accepted into the queue
+    "admit",          # queue -> slot (prefill starts after mapped blocks)
+    "prefix_hit",     # admission mapped cached blocks (tier=hbm|host|partial)
+    "prefill_chunk",  # one chunked-prefill dispatch for this request
+    "decode_block",   # this request rode one decode-block dispatch
+    "spec_verify",    # one verify forward's accept/reject outcome (per slot)
+    "preempt",        # swapped out to the host-RAM tier mid-flight
+    "swap_out",       # KV blocks left HBM (reason=preempt|cache)
+    "swap_in",        # KV blocks re-entered HBM (reason=preempt|cache)
+    "shed",           # displaced from a full bounded queue
+    "timeout",        # queue wait exceeded max_queue_delay_s
+    "cancel",         # dropped by cancel() (attrs carry the phase)
+    "finish",         # retired normally (EOS or budget)
+})
+
+# request id recorded for engine-scoped events (prefix-cache demotions
+# happen on behalf of the POOL, not of one request)
+ENGINE_EVENT = -1
+
+
+@dataclass
+class FlightEvent:
+    """One lifecycle event.  ``seq`` is the recorder-global monotonic
+    index (total order of emission), ``step`` the engine scheduler
+    iteration it happened in, ``wall`` the recorder clock at emission —
+    the ONE field excluded from determinism comparisons."""
+    seq: int
+    step: int
+    request: int
+    kind: str
+    wall: float
+    attrs: Dict = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {"seq": self.seq, "step": self.step,
+                "request": self.request, "kind": self.kind,
+                "wall": self.wall, "attrs": dict(self.attrs)}
+
+
+class FlightRecorder:
+    """Bounded ring of ``FlightEvent``s plus the query/export surface.
+
+    One recorder per engine (pass ``flight_recorder=`` to
+    ``ServingEngine``; the engine's default is a DISABLED instance so
+    the emit sites stay uniform at the one-bool-test cost).  Not
+    thread-safe by design: the serving scheduler is single-threaded
+    and every emit site runs on it.
+    """
+
+    def __init__(self, capacity: int = 8192, enabled: bool = True,
+                 clock=None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._enabled = bool(enabled)
+        self._clock = clock if clock is not None else time.perf_counter
+        self._clock_explicit = clock is not None
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._seq = 0
+        self.dropped = 0
+
+    def bind_clock(self, clock):
+        """Adopt the owning engine's clock UNLESS this recorder was
+        constructed with an explicit one — so event wall times and the
+        engine's request arrival/finish times share one time base even
+        for a user-constructed recorder (a replay/fake engine clock
+        included), while a deliberately different recorder clock is
+        respected."""
+        if not self._clock_explicit:
+            self._clock = clock
+
+    # -- lifecycle --
+    def enable(self):
+        self._enabled = True
+
+    def disable(self):
+        """Freeze the recorder: ``emit`` becomes one attribute load +
+        bool test (the same <2% decode-loop contract as a disabled
+        MetricsRegistry)."""
+        self._enabled = False
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    # -- recording --
+    def emit(self, kind: str, request: int, step: int, **attrs):
+        if not self._enabled:
+            return
+        if kind not in EVENT_KINDS:
+            raise ValueError(
+                f"unknown flight-recorder event kind {kind!r} — known "
+                f"kinds: {sorted(EVENT_KINDS)}")
+        if len(self._ring) == self.capacity:
+            self.dropped += 1          # deque drops the oldest on append
+        self._ring.append(FlightEvent(
+            self._seq, int(step), int(request), kind, self._clock(),
+            attrs))
+        self._seq += 1
+
+    # -- queries --
+    def events(self) -> List[FlightEvent]:
+        return list(self._ring)
+
+    def timeline(self, request_id: int) -> List[FlightEvent]:
+        """This request's events, in emission order."""
+        return [e for e in self._ring if e.request == request_id]
+
+    def request_ids(self) -> List[int]:
+        return sorted({e.request for e in self._ring
+                       if e.request != ENGINE_EVENT})
+
+    def explain(self, request_id: int) -> str:
+        return explain_events(self.events(), request_id)
+
+    # -- export --
+    def export(self, path: str) -> dict:
+        """Write the ring as JSON; ``dropped`` records how many events
+        overflowed out of the ring, so a consumer can tell a complete
+        record from a tail.  Returns the written header."""
+        header = {"version": 1, "capacity": self.capacity,
+                  "dropped": self.dropped, "n_events": len(self._ring)}
+        with open(path, "w") as f:
+            json.dump({**header,
+                       "events": [e.as_dict() for e in self._ring]}, f)
+        return header
+
+    def chrome_events(self) -> list:
+        """The ring as HostTracer-style event tuples ``(kind, t0, t1,
+        tid, value, name)`` — instants on tid = request id (one
+        Perfetto lane per request; engine-scoped events ride lane -1),
+        attrs ``;k=v``-encoded into the name exactly like ``span()``
+        does, so ``merge_chrome_traces(out, host=rec.chrome_events())``
+        decodes them into Perfetto args.  Times convert from the
+        recorder clock (seconds) to the tracer's ns."""
+        out = []
+        for e in self._ring:
+            t = int(e.wall * 1e9)
+            name = format_span_name(
+                f"flightrec.{e.kind}", {"request": e.request,
+                                        "step": e.step, **e.attrs})
+            out.append((1, t, t, e.request, 0, name))
+        return out
+
+    def export_chrome_trace(self, out_path: str, host=None,
+                            device_trace_dir: Optional[str] = None
+                            ) -> dict:
+        """One-call Perfetto export: the flight-recorder lanes plus
+        optional host-tracer events (a list of event tuples) and the
+        jax.profiler device dump, through ``merge_chrome_traces``."""
+        from .spans import merge_chrome_traces
+        events = self.chrome_events() + list(host or [])
+        return merge_chrome_traces(out_path, host=events,
+                                   device_trace_dir=device_trace_dir)
+
+
+def events_from_record(record: dict) -> List[FlightEvent]:
+    """The event list of an already-parsed export dict — the shared
+    decoder behind ``load_flight_record`` and consumers that need the
+    header too (the CLI reads ``dropped``) without parsing twice."""
+    return [FlightEvent(e["seq"], e["step"], e["request"], e["kind"],
+                        e["wall"], dict(e.get("attrs", {})))
+            for e in record.get("events", [])]
+
+
+def load_flight_record(path: str) -> List[FlightEvent]:
+    """Inverse of ``FlightRecorder.export``: the event list (attrs as
+    plain dicts), in emission order."""
+    with open(path) as f:
+        return events_from_record(json.load(f))
+
+
+def _plural(n: int, noun: str) -> str:
+    return f"{n} {noun}{'' if n == 1 else 's'}"
+
+
+def explain_events(events: List[FlightEvent], request_id: int) -> str:
+    """Render one request's lifecycle as a human-readable sentence —
+    "waited 3 steps behind req 7, preempted at step 12, resumed via 6
+    host blocks, 9 spec positions rejected".  Works on any event list
+    (a live recorder's ring or a loaded export), and uses OTHER
+    requests' events too: "behind req 7" is derived from admissions
+    that happened between this request's submit and its admit, so the
+    recorder needs no extra queue bookkeeping.
+
+    Returns a diagnostic string for unknown ids instead of raising —
+    the CLI points this at arbitrary exports, and "not in this record
+    (ring dropped N events)" is the honest answer there."""
+    tl = [e for e in events if e.request == request_id]
+    if not tl:
+        return (f"request {request_id}: no events in this record "
+                f"(wrong id, or the ring dropped them)")
+    by_kind: Dict[str, List[FlightEvent]] = {}
+    for e in tl:
+        by_kind.setdefault(e.kind, []).append(e)
+    parts: List[str] = []
+
+    sub = by_kind.get("submit", [None])[0]
+    admits = by_kind.get("admit", [])
+    if sub is not None:
+        bits = [f"submitted at step {sub.step}"]
+        for k in ("seq_len", "max_new", "priority"):
+            if k in sub.attrs:
+                bits.append(f"{k}={sub.attrs[k]}")
+        parts.append(bits[0] + " (" + ", ".join(bits[1:]) + ")"
+                     if len(bits) > 1 else bits[0])
+    if admits:
+        adm = admits[0]
+        clause = f"admitted at step {adm.step} into slot " \
+                 f"{adm.attrs.get('slot', '?')}"
+        if sub is not None:
+            waited = adm.step - sub.step
+            ahead = sorted({
+                e.request for e in events
+                if e.kind == "admit" and e.request != request_id
+                and (sub.seq < e.seq < adm.seq)})
+            # waited == 1 means "admitted at the first step after
+            # submission" — only a longer wait (or a queue-jump) is
+            # worth a clause
+            if waited > 1 or ahead:
+                clause = (f"waited {_plural(waited, 'step')}"
+                          + (f" behind req "
+                             f"{', '.join(str(r) for r in ahead)}"
+                             if ahead else "")
+                          + f", {clause}")
+        parts.append(clause)
+    for h in by_kind.get("prefix_hit", []):
+        parts.append(
+            f"prefix hit ({h.attrs.get('tier', '?')}): "
+            f"{_plural(int(h.attrs.get('blocks', 0)), 'cached block')}"
+            f" / {h.attrs.get('tokens', 0)} tokens mapped at step "
+            f"{h.step}")
+    n_chunks = len(by_kind.get("prefill_chunk", []))
+    if n_chunks:
+        parts.append(f"prefilled in {_plural(n_chunks, 'chunk')}")
+    for p in by_kind.get("preempt", []):
+        parts.append(
+            f"preempted at step {p.step} "
+            f"({_plural(int(p.attrs.get('blocks', 0)), 'block')} to "
+            f"host, reason={p.attrs.get('reason', '?')})")
+    for s in by_kind.get("swap_in", []):
+        if s.attrs.get("reason") == "preempt":
+            parts.append(
+                f"resumed at step {s.step} via "
+                f"{_plural(int(s.attrs.get('blocks', 0)), 'host block')}")
+        else:
+            parts.append(
+                f"promoted {_plural(int(s.attrs.get('blocks', 0)), 'host block')} "
+                f"at step {s.step} (cache hit)")
+    verifies = by_kind.get("spec_verify", [])
+    if verifies:
+        rejected = sum(int(v.attrs.get("rejected", 0)) for v in verifies)
+        accepted = sum(int(v.attrs.get("accepted", 0)) for v in verifies)
+        parts.append(
+            f"{_plural(accepted, 'spec position')} accepted / "
+            f"{rejected} rejected over "
+            f"{_plural(len(verifies), 'verify forward')}")
+    n_blocks = len(by_kind.get("decode_block", []))
+    if n_blocks:
+        parts.append(f"rode {_plural(n_blocks, 'decode block')}")
+    for kind, verb in (("finish", "finished"), ("timeout", "timed out"),
+                       ("shed", "shed"), ("cancel", "cancelled")):
+        for e in by_kind.get(kind, []):
+            extra = ""
+            if kind == "finish" and "tokens" in e.attrs:
+                extra = f" after {_plural(int(e.attrs['tokens']), 'token')}"
+            if kind == "cancel" and "phase" in e.attrs:
+                extra = f" from phase {e.attrs['phase']}"
+            parts.append(f"{verb} at step {e.step}{extra}")
+    return f"request {request_id}: " + "; ".join(parts)

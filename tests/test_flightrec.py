@@ -1,0 +1,412 @@
+"""Per-request flight recorder + goodput ledger (PR 9):
+observability/flightrec.py units, ServingEngine lifecycle-event
+wiring, goodput conservation over a combined preempt + spec + prefix-
+hit trace, explain() fidelity, determinism of event sequences, the
+disabled-recorder overhead contract and the tools/explain_request.py
+CLI smoke.
+
+Tier-1 budget discipline (truncation-scored on the 2-core box): ONE
+module-scoped engine trace (tiny 1-layer llama, float32, one decode-
+block compile at steps_per_call=1 plus one verify width) is shared by
+every engine-level test; the recorder/export/explain units are pure
+Python.  Determinism is asserted by replaying the SAME trace on
+private registries AND private recorders (shared-registry deltas would
+absorb the other run)."""
+
+import importlib.util
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.inference.serving import GOODPUT_REASONS, ServingEngine
+from paddle_tpu.observability import MetricsRegistry
+from paddle_tpu.observability.flightrec import (
+    ENGINE_EVENT, EVENT_KINDS, FlightRecorder, explain_events,
+    load_flight_record)
+
+
+# ---------------------------------------------------------------------------
+# recorder units (pure python)
+# ---------------------------------------------------------------------------
+
+def test_ring_overflow_keeps_newest():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.emit("finish", i, i, tokens=i)
+    evs = rec.events()
+    assert len(evs) == 4
+    assert [e.request for e in evs] == [6, 7, 8, 9]   # newest survive
+    assert [e.seq for e in evs] == [6, 7, 8, 9]       # seq keeps counting
+    assert rec.dropped == 6
+    # timeline of a dropped request is empty, of a kept one is intact
+    assert rec.timeline(0) == []
+    assert len(rec.timeline(9)) == 1
+    with pytest.raises(ValueError, match="capacity"):
+        FlightRecorder(capacity=0)
+
+
+def test_disabled_recorder_and_kind_validation():
+    rec = FlightRecorder(enabled=False)
+    rec.emit("not_a_kind", 0, 0)     # disabled: not even validated
+    rec.emit("finish", 0, 0)
+    assert rec.events() == [] and rec.dropped == 0
+    rec.enable()
+    with pytest.raises(ValueError, match="unknown flight-recorder"):
+        rec.emit("not_a_kind", 0, 0)
+    rec.emit("finish", 0, 3, tokens=5)
+    assert rec.events()[0].kind == "finish"
+    assert rec.events()[0].attrs == {"tokens": 5}
+    # the engine emits only vocabulary kinds — a rename there must
+    # update EVENT_KINDS, not silently fork the vocabulary
+    assert "submit" in EVENT_KINDS and "preempt" in EVENT_KINDS
+
+
+def test_export_load_roundtrip(tmp_path):
+    rec = FlightRecorder(capacity=3)
+    rec.emit("submit", 1, 0, seq_len=4, max_new=8, priority=0,
+             queue_depth=1)
+    rec.emit("admit", 1, 1, slot=0, matched_blocks=0)
+    rec.emit("prefill_chunk", 1, 1, start=0, tokens=4)
+    rec.emit("finish", 1, 2, tokens=8)        # overflows the submit
+    path = str(tmp_path / "rec.json")
+    header = rec.export(path)
+    assert header["dropped"] == 1 and header["n_events"] == 3
+    evs = load_flight_record(path)
+    assert [(e.kind, e.request, e.step) for e in evs] == \
+        [("admit", 1, 1), ("prefill_chunk", 1, 1), ("finish", 1, 2)]
+    assert evs[0].attrs == {"slot": 0, "matched_blocks": 0}
+    # explain over a loaded record == explain over the live ring
+    assert explain_events(evs, 1) == rec.explain(1)
+    assert "no events in this record" in rec.explain(42)
+
+
+def test_chrome_export_rides_merger(tmp_path):
+    """The chrome export path decodes hostile attr values through the
+    same ``_esc_attr`` escaping spans use — per-request lanes land as
+    Perfetto instants with attrs in args."""
+    rec = FlightRecorder()
+    rec.emit("finish", 3, 7, tokens=5)
+    rec.emit("cancel", 4, 8, phase="a=b;c")    # hostile attr value
+    rec.emit("swap_out", ENGINE_EVENT, 9, blocks=2, reason="cache")
+    out = str(tmp_path / "flight.json")
+    info = rec.export_chrome_trace(out)
+    assert info["host_events"] == 3
+    with open(out) as f:
+        evs = [e for e in json.load(f)["traceEvents"]
+               if e.get("name", "").startswith("flightrec.")]
+    by_name = {e["name"]: e for e in evs}
+    fin = by_name["flightrec.finish"]
+    assert fin["tid"] == 3 and fin["ph"] == "i"
+    assert fin["args"] == {"request": "3", "step": "7", "tokens": "5"}
+    assert by_name["flightrec.cancel"]["args"]["phase"] == "a=b;c"
+    assert by_name["flightrec.swap_out"]["tid"] == ENGINE_EVENT
+
+
+# ---------------------------------------------------------------------------
+# the combined preempt + spec + prefix-hit trace (module-scoped)
+# ---------------------------------------------------------------------------
+
+P, C = 8, 24
+BL = 2                       # block_len
+
+
+class _AlwaysDraft:
+    """Deterministic stub drafter: proposes k repeats of the last
+    token — near-random weights reject most of them, which is exactly
+    what the spec_reject ledger lane needs."""
+
+    def propose(self, context, k):
+        return np.repeat(np.asarray(context[-1:], np.int32), k)
+
+
+def _run_trace(net, cfg):
+    """One deterministic combined trace on PRIVATE registry+recorder:
+
+    - A (prio 0) admits and decodes, holding 7 of 10 blocks;
+    - B (prio 1, spec_decode=2) arrives mid-flight: admission must
+      PREEMPT A (7 blocks to host), B spec-verifies with the stub
+      drafter (rejections + the zero-draft fallback at budget end);
+    - A resumes from the host tier and finishes;
+    - C shares 5 prompt tokens with A: radix prefix hit (2 full
+      blocks mapped, 1 token of partial tail -> recompute_cache).
+    """
+    rng = np.random.default_rng(5)
+    reg = MetricsRegistry()
+    rec = FlightRecorder()
+    eng = ServingEngine(net, num_slots=2, prompt_len=P, max_cache_len=C,
+                        steps_per_call=1, block_len=BL, num_blocks=10,
+                        compute_dtype="float32", registry=reg,
+                        flight_recorder=rec, drafter=_AlwaysDraft())
+    ids_a = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    ids_b = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    ids_c = ids_a.copy()
+    ids_c[5] = (ids_c[5] + 1) % cfg.vocab_size   # shares exactly 5 tokens
+    ra = eng.submit(ids_a, max_new_tokens=8)                 # 7 blocks
+    eng.step()
+    eng.step()
+    assert ra.state == "decode"
+    rb = eng.submit(ids_b, max_new_tokens=4, priority=1,     # 5 blocks
+                    spec_decode=2)
+    steps = 0
+    while not (ra.state == "finished" and rb.state == "finished"):
+        eng.step()
+        eng._pool.check()
+        steps += 1
+        assert steps < 60, "trace did not drain"
+    rc_ = eng.submit(ids_c, max_new_tokens=3)
+    while rc_.state != "finished":
+        eng.step()
+        eng._pool.check()
+        steps += 1
+        assert steps < 90, "trace did not drain"
+    return SimpleNamespace(eng=eng, reg=reg, rec=rec,
+                           reqs=(ra, rb, rc_), stats=eng.stats())
+
+
+@pytest.fixture(scope="module")
+def traced():
+    paddle.seed(2024)
+    cfg = models.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64)
+    net = models.LlamaForCausalLM(cfg)
+    net.eval()
+    run1 = _run_trace(net, cfg)
+    run2 = _run_trace(net, cfg)
+
+    # disabled-recorder decode-step timing for the overhead contract:
+    # the registry AND recorder are off, so step() pays only the
+    # one-bool-test fast paths (PR-2 measurement discipline)
+    eng = run1.eng
+    run1.reg.disable()
+    run1.rec.disable()
+    rng = np.random.default_rng(9)
+    eng.submit(rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32),
+               max_new_tokens=16)
+    step_times = []
+    while eng._queue or any(s is not None for s in eng._slots):
+        t0 = time.perf_counter()
+        eng.step()
+        step_times.append(time.perf_counter() - t0)
+    run1.reg.enable()
+    run1.rec.enable()
+    return SimpleNamespace(r1=run1, r2=run2, step_times=step_times)
+
+
+def test_goodput_conservation_combined_trace(traced):
+    """Acceptance: useful + wasted == dispatched, EXACT integers,
+    across a trace that preempts, speculates and prefix-hits — and
+    the dispatched total reconciles against an independent model of
+    every dispatch (chunks x chunk_len + plain-decode busy cells +
+    verify rows x width)."""
+    s, reg, rec = traced.r1.stats, traced.r1.reg, traced.r1.rec
+    assert s["useful_tokens"] + s["wasted_tokens"] \
+        == s["dispatched_tokens"] > 0
+    assert s["wasted_tokens"] == sum(s["wasted_by_reason"].values())
+    assert set(s["wasted_by_reason"]) == set(GOODPUT_REASONS)
+    # stats() is registry-derived: private registry, raw values match
+    assert s["useful_tokens"] == \
+        reg.get("serving.goodput.useful_tokens").value()
+    assert s["dispatched_tokens"] == \
+        reg.get("serving.goodput.dispatched_tokens").value()
+    assert s["wasted_tokens"] == \
+        reg.get("serving.goodput.wasted_tokens").total()
+    # independent reconciliation of the dispatched total
+    verify_rows = [e for e in rec.events() if e.kind == "spec_verify"]
+    width = traced.r1.eng._spec_k_max + 1
+    assert s["dispatched_tokens"] == (
+        s["prefill_chunks"] * P            # chunk_len == prompt_len
+        + s["busy_slot_steps"]             # plain-decode positions
+        + len(verify_rows) * width)        # verify positions
+    # the spec_reject lane equals the recorder's per-row reject sums
+    assert s["wasted_by_reason"]["spec_reject"] == \
+        sum(int(e.attrs["rejected"]) for e in verify_rows) > 0
+    # C's partial tail: 5 matched tokens, 4 mappable -> 1 recompute
+    assert s["wasted_by_reason"]["recompute_cache"] == 1
+    assert s["prefix_hit_tokens"] == 4 and s["prefix_partial_hits"] == 1
+    # exact-bytes preemption recomputes nothing — the ledger proves it
+    assert s["wasted_by_reason"]["recompute_preempt"] == 0
+    assert s["preemptions"] == 1 and s["preempt_resumes"] == 1
+    # the goodput fraction is the useful share
+    assert s["goodput"] == pytest.approx(
+        s["useful_tokens"] / s["dispatched_tokens"])
+
+
+def test_flight_events_cover_lifecycle(traced):
+    """Every lifecycle the trace exercised left its event kind, with
+    per-request timelines in scheduler order."""
+    rec = traced.r1.rec
+    ra, rb, rc_ = traced.r1.reqs
+    kinds = {e.kind for e in rec.events()}
+    for k in ("submit", "admit", "prefill_chunk", "decode_block",
+              "spec_verify", "preempt", "swap_out", "swap_in",
+              "prefix_hit", "finish"):
+        assert k in kinds, k
+    # A: submitted -> admitted -> preempted -> resumed -> finished
+    tl_a = [e.kind for e in rec.timeline(ra.request_id)]
+    assert tl_a.index("preempt") < tl_a.index("swap_in") \
+        < tl_a.index("finish")
+    pre = [e for e in rec.timeline(ra.request_id)
+           if e.kind == "preempt"][0]
+    res = [e for e in rec.timeline(ra.request_id)
+           if e.kind == "swap_in"][0]
+    assert pre.attrs["blocks"] == res.attrs["blocks"] == 7
+    assert pre.attrs["reason"] == "pressure"
+    assert res.attrs["reason"] == "preempt"
+    # B: spec verifies carry accept/reject counts that sum to emitted
+    for e in rec.timeline(rb.request_id):
+        if e.kind == "spec_verify":
+            assert e.attrs["emitted"] + e.attrs["rejected"] \
+                == 1 + e.attrs["drafted"]
+    # C: prefix hit names the mapped volume
+    hit = [e for e in rec.timeline(rc_.request_id)
+           if e.kind == "prefix_hit"][0]
+    assert hit.attrs["blocks"] == 2 and hit.attrs["tokens"] == 4
+    assert hit.attrs["partial"] == 1
+    # steps are monotone within each timeline
+    for rid in (ra.request_id, rb.request_id, rc_.request_id):
+        steps = [e.step for e in rec.timeline(rid)]
+        assert steps == sorted(steps)
+
+
+def test_explain_names_actual_events(traced):
+    """Acceptance: explain() names the trace's REAL preemption/swap
+    events — the step numbers and block counts from the recorder, not
+    placeholders."""
+    eng, rec = traced.r1.eng, traced.r1.rec
+    ra, rb, rc_ = traced.r1.reqs
+    text_a = eng.explain(ra.request_id)
+    pre = [e for e in rec.timeline(ra.request_id)
+           if e.kind == "preempt"][0]
+    res = [e for e in rec.timeline(ra.request_id)
+           if e.kind == "swap_in"][0]
+    assert f"preempted at step {pre.step} (7 blocks to host" in text_a
+    assert f"resumed at step {res.step} via 7 host blocks" in text_a
+    assert "finished at step" in text_a
+    text_b = eng.explain(rb.request_id)
+    assert "spec position" in text_b and "rejected" in text_b
+    text_c = eng.explain(rc_.request_id)
+    assert "prefix hit" in text_c and "2 cached blocks / 4 tokens" \
+        in text_c
+    # C queued behind nothing mid-trace is fine, but B — submitted
+    # while A held the pool — was admitted without waiting only
+    # because it preempted; its explain must at least place admission
+    assert "admitted at step" in text_b
+
+
+def test_trace_determinism_modulo_wall(traced):
+    """Same trace, private registries AND recorders -> identical event
+    sequences (seq/step/request/kind/attrs) with wall times excluded,
+    and identical goodput ledgers."""
+    e1, e2 = traced.r1.rec.events(), traced.r2.rec.events()
+    strip = [((e.seq, e.step, e.request, e.kind, tuple(sorted(
+        (k, str(v)) for k, v in e.attrs.items())))) for e in e1]
+    strip2 = [((e.seq, e.step, e.request, e.kind, tuple(sorted(
+        (k, str(v)) for k, v in e.attrs.items())))) for e in e2]
+    assert strip == strip2
+    for k in ("useful_tokens", "wasted_tokens", "dispatched_tokens",
+              "wasted_by_reason", "prefix_hit_tokens", "preemptions",
+              "spec_accepted_tokens", "decode_steps", "prefill_chunks"):
+        assert traced.r1.stats[k] == traced.r2.stats[k], k
+    # outputs identical too (the exactness anchor under observation)
+    for a, b in zip(traced.r1.reqs, traced.r2.reqs):
+        np.testing.assert_array_equal(a.output, b.output)
+
+
+def test_step_time_attribution_recorded(traced):
+    """Every dispatching step observed both histograms, dispatch time
+    is positive, and host + dispatch stay within the step wall."""
+    reg = traced.r1.reg
+    disp = reg.get("serving.step.dispatch_seconds").summary()
+    host = reg.get("serving.step.host_seconds").summary()
+    assert disp["count"] == host["count"] > 0
+    assert disp["sum"] > 0.0 and host["sum"] >= 0.0
+    # TPOT: one observation per finished multi-token request
+    tpot = reg.get("serving.tpot_seconds").summary()
+    assert tpot["count"] == 3                 # A, B, C all >= 2 tokens
+    assert traced.r1.stats["mean_tpot_s"] > 0.0
+
+
+def test_disabled_recorder_overhead_under_2pct(traced):
+    """Satellite: a disabled recorder adds <2% to the decode loop.
+    ``step_times`` were measured in the fixture with registry AND
+    recorder disabled; here the per-step emit superset is timed on a
+    disabled recorder against the measured block time (the PR-2
+    micro-bench shape)."""
+    t_block = float(np.median(traced.step_times))
+    rec = FlightRecorder(enabled=False)
+
+    def touches():                # >= the emits of one busy step()
+        rec.emit("submit", 1, 0, seq_len=6, max_new=8, priority=0,
+                 queue_depth=1)
+        rec.emit("admit", 1, 1, slot=0, matched_blocks=0)
+        rec.emit("prefix_hit", 1, 1, tier="hbm", blocks=2, tokens=4,
+                 partial=0)
+        rec.emit("prefill_chunk", 1, 1, start=0, tokens=6)
+        rec.emit("decode_block", 1, 2, steps=1)
+        rec.emit("decode_block", 2, 2, steps=1)
+        rec.emit("spec_verify", 2, 2, drafted=2, accepted=0,
+                 rejected=2, emitted=1)
+        rec.emit("swap_in", 1, 3, blocks=7, reason="preempt", slot=0)
+        rec.emit("finish", 1, 9, tokens=8)
+
+    n = 3000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        touches()
+    t_inst = (time.perf_counter() - t0) / n
+    assert rec.events() == []
+    # prototype: ~2 us of disabled emits vs ~ms decode step -> <0.5%
+    assert t_inst < 0.02 * t_block, (t_inst, t_block)
+
+
+# ---------------------------------------------------------------------------
+# tools/explain_request.py CLI smoke (satellite, tier-1)
+# ---------------------------------------------------------------------------
+
+def _load_cli():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "explain_request.py")
+    spec = importlib.util.spec_from_file_location("explain_request", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_explain_request_cli_smoke(traced, tmp_path, capsys):
+    """Export -> parse -> explain through the CLI on the traced
+    record (>= 2 requests): all-requests mode, single-request mode,
+    --timeline mode, and the unknown-id failure path."""
+    cli = _load_cli()
+    rec = traced.r1.rec
+    ra, rb, rc_ = traced.r1.reqs
+    path = str(tmp_path / "record.json")
+    rec.export(path)
+
+    assert cli.main([path]) == 0
+    out = capsys.readouterr().out
+    for r in (ra, rb, rc_):
+        assert f"request {r.request_id}:" in out
+    assert "preempted at step" in out and "resumed at step" in out
+
+    assert cli.main([path, str(rb.request_id)]) == 0
+    out = capsys.readouterr().out
+    assert f"request {rb.request_id}:" in out
+    assert f"request {ra.request_id}:" not in out
+
+    assert cli.main([path, str(ra.request_id), "--timeline"]) == 0
+    out = capsys.readouterr().out
+    assert "preempt" in out and "swap_in" in out and "submit" in out
+
+    assert cli.main([path, "99999"]) == 1
+    assert "no events" in capsys.readouterr().out
+
+    assert cli.main([str(tmp_path / "missing.json")]) == 1
+    assert "cannot read" in capsys.readouterr().err

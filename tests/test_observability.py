@@ -177,6 +177,73 @@ def test_prometheus_text_quotes_label_values():
     assert 'b="c"' not in text2
 
 
+def test_span_name_roundtrip_hostile_values(tmp_path):
+    """Satellite (PR 9): attr values containing the encoding's own
+    metacharacters — ``%``, ``;``, ``=`` and their escape sequences —
+    survive ``format_span_name``/``parse_span_name`` round trips AND
+    the full ``merge_chrome_traces`` path (property-style sweep: the
+    ``_esc_attr`` escaping had no end-to-end coverage)."""
+    hostile = ["%", ";", "=", "%3B", "%3D", "%25", "a=b;c=d",
+               "100%;done=1", ";;==%%", "k=v", "%3D%3B", "trailing;",
+               "=lead", "%%25", "a%3Bb;c"]
+    for v in hostile:
+        enc = format_span_name("t.span", {"v": v, "w": f"x{v}y{v}"})
+        name, attrs = parse_span_name(enc)
+        assert name == "t.span"
+        assert attrs == {"v": v, "w": f"x{v}y{v}"}, v
+    # end to end: HostTracer-style tuples with encoded names through
+    # the chrome merger — every hostile value must land verbatim in
+    # the event's Perfetto args, never as a fabricated extra attr
+    events = [(1, 1000 * i, 1000 * i, 1, 0,
+               format_span_name("t.ev", {"v": v, "i": i}))
+              for i, v in enumerate(hostile)]
+    out = str(tmp_path / "hostile.json")
+    merge_chrome_traces(out, host=events)
+    with open(out) as f:
+        evs = [e for e in json.load(f)["traceEvents"]
+               if e.get("name") == "t.ev"]
+    assert len(evs) == len(hostile)
+    for i, v in enumerate(hostile):
+        assert evs[i]["args"] == {"v": v, "i": str(i)}, v
+
+
+def test_histogram_empty_and_single_bucket_edges():
+    """Satellite (PR 9): ``Histogram.summary()`` /
+    ``_quantile_from_buckets`` on empty and single-bucket histograms —
+    the edge cases the fixed-bucket interpolation must not NaN or
+    over-range on."""
+    from paddle_tpu.observability.metrics import _quantile_from_buckets
+    reg = MetricsRegistry()
+    # empty: all-zero summary, no snapshot cell, no diff noise
+    h = reg.histogram("t.empty", buckets=(0.5,))
+    assert h.summary() == {"count": 0, "sum": 0.0, "p50": 0.0,
+                           "p95": 0.0, "p99": 0.0}
+    assert reg.snapshot()["t.empty"]["values"] == {}
+    assert diff_snapshots(reg.snapshot(), reg.snapshot()) == {}
+    # single bucket: quantiles interpolate inside [0, bound]
+    h1 = reg.histogram("t.single", buckets=(1.0,))
+    h1.observe(0.25)
+    h1.observe(0.75)
+    s1 = h1.summary()
+    assert s1["count"] == 2 and abs(s1["sum"] - 1.0) < 1e-9
+    assert 0.0 <= s1["p50"] <= 1.0
+    assert 0.0 <= s1["p99"] <= 1.0
+    # a boundary observation counts in its le bucket, not +Inf
+    h1.observe(1.0)
+    assert reg.snapshot()["t.single"]["values"][""]["buckets"] == [3, 0]
+    # all mass in +Inf clamps to the largest finite bound
+    h2 = reg.histogram("t.inf", buckets=(0.1, 1.0))
+    h2.observe(5.0)
+    h2.observe(7.0)
+    s2 = h2.summary()
+    assert s2["p50"] == 1.0 and s2["p99"] == 1.0
+    # direct edges: zero totals and empty bounds return 0.0, never
+    # divide or index out of range
+    assert _quantile_from_buckets(0.5, (1.0,), [0, 0]) == 0.0
+    assert _quantile_from_buckets(0.5, (), []) == 0.0
+    assert _quantile_from_buckets(0.99, (1.0,), [0, 5]) == 1.0
+
+
 def test_span_name_roundtrip():
     enc = format_span_name("serving.prefill", {"request": 3, "slot": 1})
     assert enc == "serving.prefill;request=3;slot=1"
@@ -433,15 +500,17 @@ def test_metrics_name_lint_clean():
               "serving.prefill_chunks", "serving.requests_cancelled",
               "serving.prefill_chunk_seconds"):
         assert n in names, n
-    # the speculative-decoding, int8-KV and sampling sets are all
-    # registered AND enforced by the lint's required-instruments rule
-    # (rule 4: deleting a registration site must fail the lint, not
-    # flatline a dashboard)
-    for n, kind in lint.REQUIRED_INSTRUMENTS.items():
+    # the speculative-decoding, int8-KV, sampling, overload, prefix
+    # and goodput/SLO sets are all registered AND enforced by the
+    # lint's required-instruments rule (rule 4: deleting a
+    # registration site must fail the lint, not flatline a dashboard)
+    for n, (kind, labels) in lint.REQUIRED_INSTRUMENTS.items():
         assert n.startswith(
             ("serving.spec.", "serving.kv.", "serving.sample.",
              "serving.preempt.", "serving.swap.", "serving.shed.",
-             "serving.timeout.", "serving.prefix.")), n
+             "serving.timeout.", "serving.prefix.",
+             "serving.goodput.", "serving.slo.", "serving.step.",
+             "serving.tpot_seconds")), n
         assert n in names, n
     kinds = {r[3]: r[2] for r in regs}
     assert kinds["serving.spec.accepted_length"] == "histogram"
@@ -463,10 +532,22 @@ def test_metrics_name_lint_clean():
     assert kinds["serving.prefix.partial_hits"] == "counter"
     assert kinds["serving.prefix.host_hits"] == "counter"
     assert kinds["serving.prefix.host_swapin_blocks"] == "counter"
+    # the goodput-ledger / latency-attribution / SLO set (PR 9)
+    assert kinds["serving.goodput.useful_tokens"] == "counter"
+    assert kinds["serving.goodput.wasted_tokens"] == "counter"
+    assert kinds["serving.goodput.dispatched_tokens"] == "counter"
+    assert kinds["serving.step.host_seconds"] == "histogram"
+    assert kinds["serving.step.dispatch_seconds"] == "histogram"
+    assert kinds["serving.tpot_seconds"] == "histogram"
+    assert kinds["serving.slo.attained"] == "counter"
+    assert kinds["serving.slo.missed"] == "counter"
     # labeled overload counters carry their declared label tuples
     by_lbl = {r[3]: r[4] for r in regs}
     assert by_lbl["serving.shed.requests"] == ("reason",)
     assert by_lbl["serving.requests_cancelled"] == ("phase",)
+    assert by_lbl["serving.goodput.wasted_tokens"] == ("reason",)
+    assert by_lbl["serving.slo.attained"] == ("class",)
+    assert by_lbl["serving.slo.missed"] == ("class",)
     # rule 4 fires on a missing required name
     import tempfile
     with tempfile.TemporaryDirectory() as empty_root:
@@ -511,3 +592,38 @@ def test_metrics_name_lint_catches_violations(tmp_path):
     assert any("lbl.bare" in e for e in errors)
     assert all("lbl.dyn" not in e for e in errors)
     assert all("Free Form OK" not in e for e in errors)
+
+
+def test_metrics_lint_docs_sync_and_label_rules(tmp_path):
+    """Rule 4's label check and rule 5 (docs-sync): a required
+    instrument registered with the wrong label tuple fails, and a
+    required name missing from README.md fails — while a README that
+    names everything is clean."""
+    lint = _load_lint()
+    pkg = tmp_path / "paddle_tpu"
+    pkg.mkdir()
+    lines = []
+    for name, (kind, labels) in lint.REQUIRED_INSTRUMENTS.items():
+        lines.append(
+            f'r.{kind}("{name}", "h", labels={tuple(labels or ())!r})')
+    (pkg / "m.py").write_text("\n".join(lines) + "\n")
+    all_names = sorted(lint.REQUIRED_INSTRUMENTS)
+    # README missing exactly one required name -> exactly one error
+    (tmp_path / "README.md").write_text("\n".join(all_names[:-1]))
+    errs, _ = lint.check(str(tmp_path))
+    assert len(errs) == 1
+    assert all_names[-1] in errs[0] and "README" in errs[0]
+    # README naming every required instrument -> clean
+    (tmp_path / "README.md").write_text("\n".join(all_names))
+    assert lint.check(str(tmp_path))[0] == []
+    # a required instrument re-registered with the WRONG labels fails
+    # the label half of rule 4 (relabeling re-keys exported series)
+    bad = '\nq = r.counter("serving.goodput.wasted_tokens", "h", ' \
+          'labels=("oops",))\n'
+    (pkg / "m.py").write_text(
+        "\n".join(l for l in lines
+                  if "serving.goodput.wasted_tokens" not in l)
+        + bad)
+    errs3, _ = lint.check(str(tmp_path))
+    assert any("serving.goodput.wasted_tokens" in e and "labels" in e
+               for e in errs3)

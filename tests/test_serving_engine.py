@@ -790,3 +790,27 @@ def test_bench_llm_serving_section():
     assert ov["completion_rate"] > ov["no_preempt_completion_rate"]
     assert ov["no_preempt_slo_timeouts"] > ov["slo_timeouts"]
     assert ov["shed_demo"] == {"rejected": 1, "evicted": 1}
+    # PR 9: goodput sub-objects on the spec + overload arms — gated
+    # ONLY on deterministic token counts (conservation is exact
+    # integer equality; TPOT/SLO wall numbers ride along ungated)
+    for arm_g in (spec["goodput"], ov["goodput"]):
+        for k in ("useful_tokens", "wasted_tokens",
+                  "dispatched_tokens", "wasted_by_reason", "goodput",
+                  "gate"):
+            assert k in arm_g, k
+        assert arm_g["gate"]["conservation_ok"]
+        assert arm_g["useful_tokens"] + arm_g["wasted_tokens"] \
+            == arm_g["dispatched_tokens"] > 0
+        # exact-bytes swap preemption never recomputes (the ledger's
+        # structural-zero claim, bench-checked too)
+        assert arm_g["wasted_by_reason"]["recompute_preempt"] == 0
+    # the spec arm's waste is dominated by rejected draft positions
+    assert spec["goodput"]["wasted_by_reason"]["spec_reject"] > 0
+    assert "no_spec_goodput" in spec
+    assert "mean_tpot_ms" in spec and "no_spec_mean_tpot_ms" in spec
+    # overload SLO attainment (wall-shaped, reported not gated) and
+    # the no-preempt arm's goodput comparison key exist
+    for k in ("slo_attained", "slo_missed", "no_preempt_slo_attained",
+              "no_preempt_slo_missed", "no_preempt_goodput",
+              "mean_tpot_ms"):
+        assert k in ov, k

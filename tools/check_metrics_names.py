@@ -15,12 +15,15 @@ every static registry registration — ``<receiver>.counter("name", ...)``
 3. the same name is registered with CONFLICTING label-name tuples —
    the registry's other re-registration error; a site with a
    non-literal ``labels=`` argument is skipped for this rule, or
-4. a REQUIRED instrument has no registration site at all — the names
-   in ``REQUIRED_INSTRUMENTS`` are load-bearing for dashboards and the
-   bench JSON (currently the ``serving.spec.*`` speculative-decoding
-   set: the accepted-length histogram, the draft hit/miss counters and
-   the verify-route counter), and a rename/delete that would silently
-   flatline them fails here instead.
+4. a REQUIRED instrument has no registration site, the wrong kind, or
+   the wrong label tuple — the entries in ``REQUIRED_INSTRUMENTS``
+   (kind + expected labels) are load-bearing for dashboards and the
+   bench JSON, and a rename/delete/relabel that would silently
+   flatline or re-key them fails here instead, or
+5. a REQUIRED instrument name does not appear in ``README.md`` — the
+   observability docs must name every instrument external consumers
+   key on (docs-sync; skipped when the scanned root has no README,
+   i.e. synthetic lint-test trees).
 
 Registrations are parsed from the AST (not a regex), so multi-line
 calls and keyword/positional ``labels`` both resolve.
@@ -47,51 +50,69 @@ NAME_RE = re.compile(r"^[a-z][a-z0-9_.]*$")
 
 # instrument names external consumers (bench JSON ``metrics``
 # sub-object, dashboards) key on; the lint fails when any loses its
-# last registration site.  kind is asserted too — a histogram silently
-# re-registered as a counter would also break its consumers.
+# last registration site.  Each entry is ``name: (kind, labels)`` —
+# kind is asserted (a histogram silently re-registered as a counter
+# would break its consumers) and so is the label tuple (re-labeling
+# re-keys every exported series); ``None`` labels opt a name out of
+# the label assertion.
 REQUIRED_INSTRUMENTS = {
     # speculative decoding (inference/serving.py _ServingInstruments):
     # acceptance-length distribution, draft hit/miss, verify route
-    "serving.spec.accepted_length": "histogram",
-    "serving.spec.accepted_tokens": "counter",
-    "serving.spec.draft_hits": "counter",
-    "serving.spec.draft_misses": "counter",
-    "serving.spec.draft_tokens": "counter",
-    "serving.spec.verify_steps": "counter",
+    "serving.spec.accepted_length": ("histogram", ()),
+    "serving.spec.accepted_tokens": ("counter", ()),
+    "serving.spec.draft_hits": ("counter", ()),
+    "serving.spec.draft_misses": ("counter", ()),
+    "serving.spec.draft_tokens": ("counter", ()),
+    "serving.spec.verify_steps": ("counter", ()),
     # int8 KV cache (inference/serving.py _ServingInstruments): the
     # modeled arena-sweep counter behind the bench's achieved_GBps and
     # the per-dtype presence gauge
-    "serving.kv.bytes_swept": "counter",
-    "serving.kv.quant_dtype": "gauge",
+    "serving.kv.bytes_swept": ("counter", ()),
+    "serving.kv.quant_dtype": ("gauge", ("dtype",)),
     # per-request sampling (inference/serving.py _ServingInstruments):
     # the sampled-vs-greedy route split, the constrained-decoding
     # masked-token count, and the speculative-sampling residual
     # resamples the bench's sampling arm keys on
-    "serving.sample.sampled_tokens": "counter",
-    "serving.sample.greedy_tokens": "counter",
-    "serving.sample.masked_tokens": "counter",
-    "serving.sample.resamples": "counter",
+    "serving.sample.sampled_tokens": ("counter", ()),
+    "serving.sample.greedy_tokens": ("counter", ()),
+    "serving.sample.masked_tokens": ("counter", ()),
+    "serving.sample.resamples": ("counter", ()),
     # overload resilience (inference/serving.py _ServingInstruments):
     # the preempt/swap/shed/timeout set the bench's overload arm and
     # SLO dashboards key on — preemption + host-RAM swap traffic, the
     # swap tier's live footprint, bounded-queue sheds and queue-delay
     # timeouts
-    "serving.preempt.requests": "counter",
-    "serving.preempt.resumes": "counter",
-    "serving.swap.blocks_out": "counter",
-    "serving.swap.blocks_in": "counter",
-    "serving.swap.bytes_out": "counter",
-    "serving.swap.bytes_in": "counter",
-    "serving.swap.host_blocks": "gauge",
-    "serving.shed.requests": "counter",
-    "serving.timeout.requests": "counter",
+    "serving.preempt.requests": ("counter", ()),
+    "serving.preempt.resumes": ("counter", ()),
+    "serving.swap.blocks_out": ("counter", ("reason",)),
+    "serving.swap.blocks_in": ("counter", ("reason",)),
+    "serving.swap.bytes_out": ("counter", ("reason",)),
+    "serving.swap.bytes_in": ("counter", ("reason",)),
+    "serving.swap.host_blocks": ("gauge", ("reason",)),
+    "serving.shed.requests": ("counter", ("reason",)),
+    "serving.timeout.requests": ("counter", ()),
     # tiered radix prefix cache (inference/serving.py
     # _ServingInstruments): token-granular hit volume, partial-match
     # and host-tier-hit counts the bench's prefix_tiered arm keys on
-    "serving.prefix.hit_tokens": "counter",
-    "serving.prefix.partial_hits": "counter",
-    "serving.prefix.host_hits": "counter",
-    "serving.prefix.host_swapin_blocks": "counter",
+    "serving.prefix.hit_tokens": ("counter", ()),
+    "serving.prefix.partial_hits": ("counter", ()),
+    "serving.prefix.host_hits": ("counter", ()),
+    "serving.prefix.host_swapin_blocks": ("counter", ()),
+    # goodput ledger + latency attribution + SLO accounting (PR 9,
+    # inference/serving.py _ServingInstruments): the conservation-
+    # gated token classification (useful + wasted == dispatched,
+    # wasted by closed reason vocabulary), the host-vs-dispatch step
+    # split the dispatch-ahead pipeline will be judged against, the
+    # per-output-token latency histogram and the per-class SLO
+    # outcome counters the bench's goodput sub-objects key on
+    "serving.goodput.useful_tokens": ("counter", ()),
+    "serving.goodput.wasted_tokens": ("counter", ("reason",)),
+    "serving.goodput.dispatched_tokens": ("counter", ()),
+    "serving.step.host_seconds": ("histogram", ()),
+    "serving.step.dispatch_seconds": ("histogram", ()),
+    "serving.tpot_seconds": ("histogram", ()),
+    "serving.slo.attained": ("counter", ("class",)),
+    "serving.slo.missed": ("counter", ("class",)),
 }
 
 
@@ -196,7 +217,7 @@ def check(root: str = REPO_ROOT):
                 f"{site}: {name!r} registered with labels "
                 f"{list(labels)} but {prev[1]} registers it with "
                 f"{list(prev[2])}")
-    for name, kind in sorted(REQUIRED_INSTRUMENTS.items()):
+    for name, (kind, labels) in sorted(REQUIRED_INSTRUMENTS.items()):
         got = seen.get(name)
         if got is None:
             errors.append(
@@ -204,10 +225,30 @@ def check(root: str = REPO_ROOT):
                 f"registration site — dashboards/bench key on it; "
                 f"update REQUIRED_INSTRUMENTS if the rename is "
                 f"deliberate")
-        elif got[0] != kind:
+            continue
+        if got[0] != kind:
             errors.append(
                 f"{got[1]}: required instrument {name!r} is registered "
                 f"as {got[0]}, expected {kind}")
+        if labels is not None and got[2] is not None \
+                and tuple(got[2]) != tuple(labels):
+            errors.append(
+                f"{got[1]}: required instrument {name!r} is registered "
+                f"with labels {list(got[2])}, expected {list(labels)} "
+                f"— relabeling re-keys every exported series")
+    # rule 5 (docs-sync): every required instrument must be named in
+    # the README's observability docs.  Skipped when the scanned root
+    # carries no README (the synthetic trees the lint tests build).
+    readme = os.path.join(root, "README.md")
+    if os.path.exists(readme):
+        with open(readme, encoding="utf-8") as f:
+            readme_text = f.read()
+        for name in sorted(REQUIRED_INSTRUMENTS):
+            if name not in readme_text:
+                errors.append(
+                    f"required instrument {name!r} is not documented "
+                    f"in README.md — the observability docs must name "
+                    f"every instrument external consumers key on")
     return errors, regs
 
 

@@ -1,0 +1,101 @@
+"""Post-mortem one request's lifecycle from an exported flight record.
+
+``ServingEngine`` (with a ``FlightRecorder`` attached) records every
+lifecycle transition — submit/admit/prefix-hit/prefill-chunk/decode-
+block/spec-verify/preempt/swap/shed/timeout/cancel/finish — into a
+bounded ring; ``FlightRecorder.export(path)`` writes it as JSON.  This
+CLI answers "why was request N slow" from that file alone, in another
+process, with no engine or model state:
+
+    # one request's story
+    python tools/explain_request.py record.json 7
+
+    # every request in the record
+    python tools/explain_request.py record.json
+
+    # raw event timeline instead of the rendered sentence
+    python tools/explain_request.py record.json 7 --timeline
+
+Exit code 0 on success, 1 on a missing/garbled record or an id with no
+events (the wrong-id message still prints — it names the ring-drop
+count, which is the honest answer when the ring overflowed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# runnable both as ``python tools/explain_request.py`` (repo root on
+# sys.path via this shim) and via import machinery in tests
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.observability.flightrec import (  # noqa: E402
+    ENGINE_EVENT, events_from_record, explain_events)
+
+
+def _fmt_timeline(events, request_id) -> str:
+    lines = []
+    for e in events:
+        if e.request != request_id:
+            continue
+        attrs = " ".join(f"{k}={v}" for k, v in e.attrs.items())
+        lines.append(f"  step {e.step:>5}  {e.kind:<14} {attrs}".rstrip())
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="explain_request",
+        description="Explain request lifecycles from an exported "
+                    "flight record (FlightRecorder.export JSON).")
+    ap.add_argument("record", help="path to the exported flight record")
+    ap.add_argument("request_id", nargs="?", type=int, default=None,
+                    help="request to explain (default: all in the record)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="print the raw per-request event timeline "
+                         "instead of the rendered explanation")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.record) as f:
+            record = json.load(f)
+        events = events_from_record(record)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"explain_request: cannot read {args.record!r}: {e}",
+              file=sys.stderr)
+        return 1
+    dropped = int(record.get("dropped", 0))
+    if dropped:
+        print(f"note: the ring dropped {dropped} oldest event(s) — "
+              f"early lifecycles may be partial")
+
+    if args.request_id is not None:
+        ids = [args.request_id]
+    else:
+        ids = sorted({e.request for e in events
+                      if e.request != ENGINE_EVENT})
+        if not ids:
+            print("explain_request: record holds no request events",
+                  file=sys.stderr)
+            return 1
+    rc = 0
+    for rid in ids:
+        if args.timeline:
+            tl = _fmt_timeline(events, rid)
+            print(f"request {rid}:")
+            print(tl if tl else "  (no events)")
+            if not tl:
+                rc = 1
+        else:
+            text = explain_events(events, rid)
+            print(text)
+            if "no events in this record" in text:
+                rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
