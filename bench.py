@@ -815,6 +815,14 @@ def _bench_serving(on_tpu):
     dispatched).  Wall-shaped companions (``mean_tpot_ms``, SLO
     attainment, the ``serving.step.{host,dispatch}_seconds`` split in
     the run's ``metrics`` sub-object) are reported ungated.
+
+    An ``async`` sub-object isolates the DISPATCH-AHEAD step pipeline
+    (PR 10): the mixed drain trace through ``async_dispatch=True`` vs
+    the lockstep kill-switch on private registries, gated only on
+    deterministic counters (byte-identical outputs, equal dispatch/
+    token counts, harvests > 0 with forced syncs confined to the
+    documented reasons); the host/dispatch/overlap second sums and
+    tokens/s ride along ungated.
     """
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -1082,6 +1090,93 @@ def _bench_serving(on_tpu):
     tier_d = _tiered_arm("digest")
     tier_n = _tiered_arm("none")
 
+    # -- dispatch-ahead arm: the SAME mixed drain trace through two
+    # engines that differ ONLY in async_dispatch (the plan/harvest
+    # pipeline vs the lockstep kill-switch).  PRIVATE registries (the
+    # arms are compared, and shared-registry deltas would absorb each
+    # other).  Gated ONLY on deterministic counters: byte-identical
+    # outputs, equal dispatch/token counts, harvests > 0 with forced
+    # syncs confined to the documented reasons this trace can produce
+    # (budget exhaustion + final prefill chunks — no EOS, spec, mask
+    # or preemption here).  Wall-shaped numbers (tokens/s, the
+    # host/dispatch/overlap second sums) are reported ungated: on the
+    # 2-core CI box JAX's async dispatch overlaps little, the shape of
+    # the split is what real accelerators read --
+    def _one_async_trace(async_dispatch):
+        reg = MetricsRegistry()
+        eng = ServingEngine(
+            model, num_slots=num_slots, prompt_len=prompt,
+            max_cache_len=cache_len, steps_per_call=steps_per_call,
+            block_len=pf_block, compute_dtype=compute_dtype,
+            registry=reg, async_dispatch=async_dispatch)
+        for _ in range(2):     # warm chunk program + both block sizes
+            eng.submit(prompts[0][:int(plens[0])],
+                       max_new_tokens=steps_per_call + 2)
+        eng.run()
+        warm = eng.stats()
+        t0 = time.perf_counter()
+        for i in range(n_requests):
+            eng.submit(prompts[i][:int(plens[i])],
+                       max_new_tokens=int(news[i]), arrival_time=t0)
+        done = eng.run()
+        wall = max(r.finish_time for r in done) - t0
+        final = eng.stats()
+
+        def _hsum_ms(name):
+            return round(reg.get(name).summary()["sum"] * 1e3, 3)
+
+        counts = {k: final[k] - warm[k] for k in (
+            "block_dispatches", "prefill_chunks", "decode_steps",
+            "dispatched_tokens", "useful_tokens", "wasted_tokens",
+            "async_syncs", "async_harvests")}
+        counts["syncs_by_reason"] = {
+            k: final["async_syncs_by_reason"][k]
+            - warm["async_syncs_by_reason"][k]
+            for k in final["async_syncs_by_reason"]}
+        walls = {"host_ms": _hsum_ms("serving.step.host_seconds"),
+                 "dispatch_ms": _hsum_ms("serving.step.dispatch_seconds"),
+                 "overlap_ms": _hsum_ms("serving.step.overlap_seconds")}
+        return wall, counts, walls, np.concatenate(
+            [r.output for r in done])
+
+    def run_async_arm(async_dispatch):
+        # best-of-2 walls; counters/outputs are deterministic per arm
+        runs = [_one_async_trace(async_dispatch) for _ in range(2)]
+        wall = min(r[0] for r in runs)
+        return wall, runs[0][1], runs[0][2], runs[0][3]
+
+    as_wall, as_c, as_w, as_out = run_async_arm(True)
+    sy_wall, sy_c, sy_w, sy_out = run_async_arm(False)
+    as_fired = {k: v for k, v in as_c["syncs_by_reason"].items() if v}
+    async_ab = {
+        "tokens_per_s": round(float(news.sum()) / as_wall, 1),
+        "sync_tokens_per_s": round(float(news.sum()) / sy_wall, 1),
+        "vs_sync": round(sy_wall / max(as_wall, 1e-9), 3),
+        "async_syncs": as_c["async_syncs"],
+        "async_harvests": as_c["async_harvests"],
+        "syncs_by_reason": as_fired,
+        # wall-shaped step split per arm — reported, never gated
+        "host_ms": as_w["host_ms"],
+        "dispatch_ms": as_w["dispatch_ms"],
+        "overlap_ms": as_w["overlap_ms"],
+        "sync_host_ms": sy_w["host_ms"],
+        "sync_dispatch_ms": sy_w["dispatch_ms"],
+        "gate": {
+            "token_exact": bool((as_out == sy_out).all()),
+            "dispatch_counts_equal": all(
+                as_c[k] == sy_c[k] for k in (
+                    "block_dispatches", "prefill_chunks",
+                    "decode_steps", "dispatched_tokens",
+                    "useful_tokens", "wasted_tokens")),
+            "pipelined": (as_c["async_harvests"] > 0
+                          and as_c["async_syncs"] > 0
+                          and sy_c["async_harvests"] == 0
+                          and sy_c["async_syncs"] == 0),
+            "sync_reasons_documented": set(as_fired) <= {
+                "budget", "chunk_final"},
+        },
+    }
+
     # -- speculative-decoding arm: the SAME engine config with and
     # without per-request spec_decode=K on a repetitive/structured
     # trace (tiled short token patterns — prompt-lookup drafting's home
@@ -1202,11 +1297,17 @@ def _bench_serving(on_tpu):
         # = greedy): the spec AND sampling arms share this one trace
         # protocol, so the warm ritual / replay / counter deltas can
         # never drift between them
+        # async_dispatch=False on BOTH arms: a spec engine is
+        # effectively lockstep anyway (every spec iteration is a
+        # forced sync), so a dispatch-ahead no-spec baseline would
+        # fold the pipeline's win into this A/B and misattribute it
+        # to (against) speculation — the ``async`` sub-object is
+        # where the pipeline is measured
         eng = ServingEngine(
             model, num_slots=1, prompt_len=sp_prompt,
             max_cache_len=sp_cache, steps_per_call=1,
             block_len=pf_block, chunk_len=sp_prompt,
-            compute_dtype=compute_dtype)
+            compute_dtype=compute_dtype, async_dispatch=False)
         # warm: chunk prefill, the verify width, AND the plain decode
         # block (the zero-draft fallback path dips into it mid-trace)
         if use_spec:
@@ -1597,6 +1698,7 @@ def _bench_serving(on_tpu):
         },
         "kv_int8": kv_int8,
         "overload": overload,
+        "async": async_ab,
         "spec": {
             "k": sp_k, "max_new": sp_new, "n_requests": sp_n,
             "tokens_per_s": spec_on["tokens_per_s"],

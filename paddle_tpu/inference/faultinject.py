@@ -30,6 +30,11 @@ Five injectable failure modes:
 - **step stall** (``stall_steps``): the next N ``step()`` calls sleep
   ``seconds`` before doing any work — a stand-in for a wedged device
   dispatch, paired with ``run(wall_timeout_s=...)`` regression tests.
+  The injected sleep is charged to its own histogram
+  (``serving.fault.stall_seconds``) and carved OUT of
+  ``serving.step.host_seconds``, so fault-injection runs never
+  pollute the host-scheduler baseline the dispatch-ahead pipeline is
+  measured against.
 - **host-tier swap-in failure** (``fail_swapins``): the next N (or
   every) prefix-cache host->HBM promotions fail at admission — the
   host parcels drop and the engine degrades the match to its directly

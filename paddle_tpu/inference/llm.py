@@ -182,7 +182,15 @@ def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call,
     pytree's structure is determined by the same flags, so program
     variants and plane dicts stay in lockstep.  Signature:
     ``(p_values, tok, lens, done, samp, tables, *flat_arenas) ->
-    (toks [B, n], tok', lens', done', *flat_arenas)``."""
+    (toks [B, n], tok', lens', done', *flat_arenas)``.
+
+    Dispatch-ahead contract: every output is an UN-MATERIALIZED
+    device array (JAX async dispatch) and the carries ``tok'``/
+    ``lens'``/``done'`` are valid INPUTS to the next block call as-is
+    — the caller may enqueue iteration N+1 feeding them directly and
+    force iteration N's outputs to host afterwards (the ServingEngine
+    plan/harvest split).  Done rows self-freeze in-trace (pad emits,
+    held lens), which is what makes one-step-stale host truth safe."""
     from .sampling import sampled_decode_scan_body
     _with_params = _param_swapper(model, cfg)
     sampled, _filtered, penalty, _bias = samp_flags
@@ -264,7 +272,13 @@ def build_chunk_prefill(model, cfg: GenerationConfig, kv_int8=False,
     independent by construction).  Signature:
     ``(p_values, ids [1, C], start [], n_valid [], tables
     [1, max_blocks], samp, *flat_arenas) -> (tok [1],
-    *flat_arenas)``."""
+    *flat_arenas)``.
+
+    Dispatch-ahead contract: the outputs are un-materialized device
+    arrays; only the FINAL chunk's ``tok`` is host truth (the
+    request's first token), so the engine forces exactly that one —
+    non-final chunks are pure enqueues whose compute overlaps
+    subsequent host scheduling."""
     if cfg.num_beams > 1:
         raise ValueError(
             "chunked prefill is greedy/sampled only — beam search "

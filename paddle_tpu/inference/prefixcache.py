@@ -61,17 +61,36 @@ class _HostEntry:
     numpy stack per flat arena at the arena's exact at-rest dtype.
     ``pins`` counts queued requests whose matched span references this
     entry (pinned cache entries survive capacity eviction; preempt
-    entries are implicitly pinned by their swap record)."""
+    entries are implicitly pinned by their swap record).
 
-    __slots__ = ("key", "rows", "n_blocks", "reason", "pins")
+    ``rows`` may be constructed LAZY — a zero-arg callable producing
+    the stack list — for the dispatch-ahead engine's overlapped
+    demotion: the device gather is enqueued during plan and the host
+    copy materializes on first access (the engine reconciles
+    outstanding parcels at its harvest points; see
+    ``ServingEngine._reconcile_host_tier``).  Consumers read
+    ``entry.rows`` exactly as before; ``resolved`` tells whether the
+    bytes are host-resident yet."""
 
-    def __init__(self, key: int, rows: List[np.ndarray], n_blocks: int,
+    __slots__ = ("key", "_rows", "n_blocks", "reason", "pins")
+
+    def __init__(self, key: int, rows, n_blocks: int,
                  reason: str):
         self.key = key
-        self.rows = rows
+        self._rows = rows
         self.n_blocks = int(n_blocks)
         self.reason = reason
         self.pins = 0
+
+    @property
+    def resolved(self) -> bool:
+        return not callable(self._rows)
+
+    @property
+    def rows(self) -> List[np.ndarray]:
+        if callable(self._rows):
+            self._rows = self._rows()
+        return self._rows
 
 
 class HostTier:
@@ -132,12 +151,14 @@ class HostTier:
         return free + self._evictable() >= n_blocks
 
     # -- mutation --
-    def put(self, rows: List[np.ndarray], n_blocks: int,
+    def put(self, rows, n_blocks: int,
             reason: str) -> Optional[int]:
         """Store a parcel; returns its key, or ``None`` when a CACHE
         put cannot fit (preempt puts always fit — the capacity bound
         is a cache budget, not a correctness limit).  A cache put
-        evicts unpinned cache entries LRU-first to make room."""
+        evicts unpinned cache entries LRU-first to make room.
+        ``rows`` is the stack list, or a zero-arg callable producing
+        it (a LAZY parcel — see ``_HostEntry``)."""
         if reason not in _REASONS:
             raise ValueError(f"unknown host-tier reason {reason!r}")
         if reason == "cache" and self.cache_capacity is not None:
@@ -214,11 +235,17 @@ class HostTier:
             if e.n_blocks < 1:
                 errs.append(f"host tier: entry {k} holds {e.n_blocks} "
                             f"blocks")
-            for r in e.rows:
-                if r.shape[0] != e.n_blocks:
-                    errs.append(
-                        f"host tier: entry {k} row stack {r.shape} != "
-                        f"n_blocks {e.n_blocks}")
+            # shape validation only for host-resident bytes: a still-
+            # lazy parcel's stacks live on device until the engine's
+            # next harvest point, and forcing them here would turn
+            # every audit into a pipeline sync (the consuming scatter
+            # still fails loudly on a mismatched shape)
+            if e.resolved:
+                for r in e.rows:
+                    if r.shape[0] != e.n_blocks:
+                        errs.append(
+                            f"host tier: entry {k} row stack {r.shape} "
+                            f"!= n_blocks {e.n_blocks}")
         if self.cache_capacity is not None and \
                 self.blocks("cache") > self.cache_capacity:
             errs.append(
@@ -407,10 +434,11 @@ class RadixPrefixCache:
         node.children = {int(tail.tokens[0]): tail}
 
     # -- tier transitions --
-    def demote(self, block: int, rows: List[np.ndarray]) -> Optional[int]:
+    def demote(self, block: int, rows) -> Optional[int]:
         """Pool reclaimed a tree-held HBM block: park its gathered
-        at-rest bytes in the host tier and relabel the position
-        host-resident.  When the tier refuses (capacity), the position
+        at-rest bytes (or a lazy thunk producing them — the
+        dispatch-ahead engine's overlapped demotion) in the host tier
+        and relabel the position host-resident.  When the tier refuses (capacity), the position
         becomes a hole (the PR-3 forget semantics) and blockless
         leaves prune.  Returns the tier key, or None when dropped."""
         nd, bi = self._hbm.pop(block)

@@ -129,6 +129,43 @@ This engine degrades deliberately instead:
   allocation exhaustion / forced swaps / step stalls so tests prove
   no wedge, no block leak and no refcount drift
   (``BlockPool.check()``) under adversarial schedules.
+
+**Dispatch-ahead step pipeline** (``async_dispatch=True``, the
+default): JAX dispatch is asynchronous — a compiled call returns
+device futures immediately — and the lockstep engine used to throw
+that away by materializing every output (``np.asarray``) right after
+every dispatch, so the host scheduler (admit, block tables, sampling
+planes, ledger) ran SERIALLY with device compute.  This engine splits
+``step()`` into a host-only PLAN phase and a deferred HARVEST phase:
+
+- the decode block's outputs (``toks``/``tok``/``lens``/``done``
+  carries) stay un-materialized device arrays in a pending-harvest
+  record; the NEXT iteration plans on one-step-stale host truth,
+  feeds the device carries straight back into its own dispatch
+  (double-buffered — the traced scan self-feeds tokens, so staleness
+  never reaches the math; sampled rows get their position-keyed PRNG
+  plane advanced by the in-flight block's size), and only AFTER that
+  dispatch is enqueued forces the previous outputs to host — the
+  host-scheduler slice PR 9 measured now runs under device time.
+- a harvest is deferred ONLY on iterations whose scheduling is
+  provably output-independent: no rider can finish (no EOS configured,
+  no budget exhausting inside the block), no token-mask / repetition-
+  penalty row needs the emitted token host-side, and no speculative
+  slot needs an accept/rollback decision.  Everywhere host truth is
+  semantically required the iteration degrades to today's sync
+  behavior and charges one ``serving.async.syncs{reason=}`` — so the
+  async engine's outputs are token-for-token ``generate()``-exact and
+  its scheduling (admissions, dispatch counts, flight-recorder event
+  sequence modulo wall and harvest lag) is byte-identical to the
+  ``async_dispatch=False`` kill-switch arm BY CONSTRUCTION.
+- the tiered prefix cache's demote gather rides the same pipeline:
+  reclaim ENQUEUES the at-rest-bytes gather during plan and the host
+  copies reconcile lazily at the next harvest point (the PR-8
+  "overlapped swap-in" leftover; promotion scatters were already
+  enqueue-only).
+- time spent blocking on a PREVIOUS iteration's arrays lands in
+  ``serving.step.overlap_seconds`` (never in ``host_seconds``), and
+  injected fault stalls in ``serving.fault.stall_seconds``.
 """
 
 from __future__ import annotations
@@ -191,6 +228,25 @@ class EngineStalledError(RuntimeError):
 # charge it; see notes.md PR 9).
 GOODPUT_REASONS = ("spec_reject", "recompute_preempt",
                    "recompute_cache", "pad")
+
+# the dispatch-ahead pipeline's closed forced-sync vocabulary: every
+# iteration that must materialize device outputs EARLY — instead of
+# after the next dispatch was enqueued — charges exactly ONE of these
+# to serving.async.syncs{reason=}.  The vocabulary is closed so the
+# bench's async A/B arm (and dashboards) can assert that syncs happen
+# only for documented, semantically-required reasons:
+ASYNC_SYNC_REASONS = (
+    "eos",          # EOS detection must observe every emitted token
+    "budget",       # a rider's token budget can exhaust inside the block
+    "mask",         # a token-mask row's host state machine needs the token
+    "penalty",      # a repetition-penalty presence plane is host-built
+    "spec",         # speculative accept/rollback is a host decision
+    "chunk_final",  # a prompt's final chunk samples the first token
+    "resume",       # a swap-in rewrites the slot's host carries
+    "preempt",      # a swap-out reads the slot's host carries
+    "cancel",       # cancel() must know which tokens already exist
+    "drain",        # run() is about to raise/hand control to the caller
+)
 
 # sub-ms resolution for the host-vs-dispatch step split: on real
 # accelerators the host scheduler slice this histogram isolates is the
@@ -350,7 +406,9 @@ class _ServingInstruments:
             "time to first token, arrival -> last prefill chunk")
         self.chunk_latency = r.histogram(
             "serving.prefill_chunk_seconds",
-            "wall time of one chunked-prefill dispatch")
+            "wall time of one chunked-prefill dispatch (a dispatch-"
+            "ahead engine's non-final chunks are pure enqueues, so "
+            "only final chunks include compute+materialization there)")
         self.spec_verifies = r.counter(
             "serving.spec.verify_steps", "speculative verify forwards "
             "dispatched (one K+1-position target forward per scheduler "
@@ -449,8 +507,44 @@ class _ServingInstruments:
             "serving.step.dispatch_seconds",
             "time one step() spent inside compiled dispatches (chunk "
             "prefill, decode block, spec verify, swap gathers/"
-            "scatters), including output materialization",
-            buckets=_STEP_BUCKETS)
+            "scatters), including output materialization for sync-"
+            "harvested dispatches; a DEFERRED dispatch contributes its "
+            "enqueue time here and its materialization wait to "
+            "serving.step.overlap_seconds", buckets=_STEP_BUCKETS)
+        self.step_overlap = r.histogram(
+            "serving.step.overlap_seconds",
+            "time spent blocking on a PREVIOUS iteration's in-flight "
+            "device outputs — deferred-harvest materialization and "
+            "lazy host-tier parcel resolution; one observation per "
+            "wait.  This is the slice the dispatch-ahead pipeline "
+            "hides under device time: it is excluded from "
+            "serving.step.host_seconds, which stays pure "
+            "host-scheduler work", buckets=_STEP_BUCKETS)
+        self.stall_seconds = r.histogram(
+            "serving.fault.stall_seconds",
+            "injected fault-stall sleep time (FaultInjector."
+            "stall_steps), one observation per stalled step — charged "
+            "here so fault-injection runs never pollute the "
+            "serving.step.host_seconds baseline the dispatch-ahead "
+            "pipeline is judged against", buckets=_STEP_BUCKETS)
+        self.async_syncs = r.counter(
+            "serving.async.syncs",
+            "dispatch-ahead iterations that forced an EARLY harvest "
+            "(materialized device outputs before the next dispatch "
+            "was enqueued) because host truth was semantically "
+            "required, by closed reason vocabulary (ASYNC_SYNC_"
+            "REASONS: eos/budget/mask/penalty/spec/chunk_final/"
+            "resume/preempt/cancel/drain)", labels=("reason",))
+        self.async_harvests = r.counter(
+            "serving.async.harvests",
+            "deferred harvests completed at the pipeline's natural "
+            "point — AFTER the next compiled dispatch was enqueued — "
+            "i.e. iterations whose host-scheduler work actually "
+            "overlapped device time")
+        self.async_depth = r.gauge(
+            "serving.async.depth",
+            "un-harvested in-flight dispatches right now (hwm = peak "
+            "pipeline depth; this engine double-buffers, so 0 or 1)")
         self.slo_attained = r.counter(
             "serving.slo.attained",
             "SLO-carrying requests (deadline_s or max_queue_delay_s "
@@ -481,6 +575,7 @@ class _ServingInstruments:
                   self.shed, self.timeouts,
                   self.goodput_useful, self.goodput_wasted,
                   self.goodput_dispatched,
+                  self.async_syncs, self.async_harvests,
                   self.slo_attained, self.slo_missed):
             # total() sums label sets, so labeled counters (cancelled
             # by phase, shed by reason) baseline the same way the
@@ -492,6 +587,16 @@ class _ServingInstruments:
         # registry the same way since_init does for totals
         self._wasted_base = {reason: self.goodput_wasted.value(
             reason=reason) for reason in GOODPUT_REASONS}
+        # per-reason forced-sync baselines, same shared-registry story
+        # as _wasted_base: the reason vocabulary is closed, so stats()
+        # reports exact per-engine per-reason deltas
+        self._syncs_base = {reason: self.async_syncs.value(reason=reason)
+                            for reason in ASYNC_SYNC_REASONS}
+
+    def syncs_since(self, reason: str) -> float:
+        """Per-reason forced-sync delta attributable to THIS engine."""
+        return (self.async_syncs.value(reason=reason)
+                - self._syncs_base.get(reason, 0))
 
     def since_init(self, counter) -> float:
         """Counter delta attributable to THIS engine (summed over
@@ -781,6 +886,58 @@ class BlockPool:
 
 
 @dataclass
+class _PendingBlock:
+    """One dispatched-but-not-yet-harvested decode block — the
+    pipeline's double buffer.  ``toks_d``/``tok_d``/``lens_d``/
+    ``done_d`` are the compiled call's UN-MATERIALIZED device outputs:
+    the carries feed the next dispatch directly (device -> device, no
+    host round-trip) and the whole record is forced to host only at
+    harvest.  ``pre_lens`` is the HOST-TRUE per-slot lens entering
+    this dispatch (the KV-sweep model needs it); ``active``/``reqs``
+    pin the riding set so the harvest can verify the no-finish
+    invariant the defer predicate promised."""
+    step_idx: int
+    n: int                         # scanned steps in this block
+    active: List[int]              # riding slot indices
+    reqs: List[Request]            # parallel to ``active``
+    pre_lens: np.ndarray           # host lens mirror entering dispatch
+    toks_d: object                 # [B, n] device tokens
+    tok_d: object                  # carries out: tok / lens / done
+    lens_d: object
+    done_d: object
+
+
+class _LazyStacks:
+    """One deferred demote gather: the device row stacks captured at
+    enqueue time (JAX arrays are immutable values, so later donated
+    overwrites of the arenas can never reach them), materialized to
+    host numpy ONCE on first need.  Shared by every host-tier parcel
+    the gather page covered — resolving any parcel resolves the page."""
+
+    __slots__ = ("_dev", "_np")
+
+    def __init__(self, dev_stacks):
+        self._dev = list(dev_stacks)
+        self._np = None
+
+    @property
+    def resolved(self) -> bool:
+        return self._np is not None
+
+    def resolve(self) -> List[np.ndarray]:
+        if self._np is None:
+            self._np = [np.asarray(s) for s in self._dev]
+            self._dev = None
+        return self._np
+
+    def block_rows(self, j: int) -> List[np.ndarray]:
+        """Parcel rows for gathered row ``j``: one ``[1, ...]``
+        contiguous slice per flat arena (the ``_HostEntry.rows``
+        shape contract)."""
+        return [np.ascontiguousarray(s[j:j + 1]) for s in self.resolve()]
+
+
+@dataclass
 class _SwapRecord:
     """A preempted request's device state, parked in the shared
     ``HostTier`` (reason ``"preempt"``).
@@ -902,7 +1059,8 @@ class ServingEngine:
                  kv_cache_dtype=None,
                  seed=0, static_batching=False, clock=time.perf_counter,
                  registry=None, max_queue=None, enable_preemption=True,
-                 fault_injector=None, flight_recorder=None):
+                 fault_injector=None, flight_recorder=None,
+                 async_dispatch=True):
         self.num_slots = int(num_slots)
         self.max_queue = None if max_queue is None else int(max_queue)
         if self.max_queue is not None and self.max_queue < 1:
@@ -1127,6 +1285,21 @@ class ServingEngine:
         # (serving.step.{host,dispatch}_seconds); reset at step() start,
         # fed by every compiled-call site incl. swap gathers/scatters
         self._disp_s = 0.0
+        # dispatch-ahead pipeline (async_dispatch=True, the default):
+        # _pending holds the one dispatched-but-unharvested decode
+        # block; _overlap_s/_stall_s carve harvest waits and injected
+        # stalls out of the step's host-seconds attribution; the
+        # _lazy_stacks list tracks demote gathers enqueued during plan
+        # and reconciled at the next harvest point.
+        # async_dispatch=False is the exact lockstep kill-switch — the
+        # A/B arm of the bench's ``async`` sub-object.
+        self.async_dispatch = bool(async_dispatch)
+        self._pending: Optional[_PendingBlock] = None
+        self._overlap_s = 0.0
+        self._stall_s = 0.0
+        self._in_step = False
+        self._lazy_parcels: List[int] = []   # tier keys awaiting rows
+        self._m.async_depth.set(0)
 
     # -- block accounting --
     def _blocks_needed(self, n: int, m: int) -> int:
@@ -1244,17 +1417,204 @@ class ServingEngine:
             return None
         return self._pool.alloc(n)
 
-    # -- host tier (shared by preemption swap + prefix-cache demotion) --
-    def _gather_rows(self, ids_row: np.ndarray) -> List[np.ndarray]:
-        """Read ``ids_row``'s arena rows (EXACT at-rest bytes: float
-        K/V, or int8 codes + scale planes) into host numpy stacks —
-        the ONE gather discipline behind preemption swap-out and
-        prefix-cache demotion.  ``ids_row`` is table-width (one
-        compiled shape); trash-row entries gather finite garbage the
-        callers slice away or ignore."""
+    # -- dispatch-ahead pipeline (plan / harvest) --
+    def _charge_overlap(self, dt: float):
+        """Account time spent blocking on a PREVIOUS iteration's
+        device arrays: observed into serving.step.overlap_seconds and
+        carved out of this step's host-seconds remainder."""
+        self._m.step_overlap.observe(dt)
+        if self._in_step:
+            self._overlap_s += dt
+
+    def _block_sync_reason(self, n: int, active: List[int]):
+        """Why THIS decode block's outputs cannot be deferred (None =
+        deferrable).  A harvest may be deferred only when the next
+        iteration's scheduling is provably output-independent: no
+        rider can reach a terminal state inside the block (EOS
+        configured, or a token budget exhausting), no host-built
+        logit plane (mask bias, repetition-penalty presence) needs the
+        emitted token before the next dispatch, and no speculative
+        slot needs a host accept/rollback decision.  The first
+        matching reason is charged to serving.async.syncs."""
+        if not self.async_dispatch:
+            return "off"              # kill-switch arm: never counted
+        if self.cfg.eos_token_id is not None:
+            return "eos"
+        for i in active:
+            r = self._slots[i]
+            if r.remaining <= n:
+                return "budget"
+            sp = r.sampling
+            if sp is not None and sp.mask_processor is not None:
+                return "mask"
+            if sp is not None and sp.needs_penalty:
+                return "penalty"
+            if r.spec_k is not None:
+                return "spec"
+        # any spec-mode decode slot anywhere (verifying, not riding)
+        # keeps the iteration sync: the verify path reads host mirrors
+        if any(r is not None and r.spec_k is not None
+               and r.state == "decode" for r in self._slots):
+            return "spec"
+        return None
+
+    def _harvest_pending(self):
+        """Force the pending block's outputs to host and absorb them.
+        The no-finish invariant of the defer predicate means this can
+        only move tokens/carries/ledger state — never scheduling
+        state — which is what makes a deferred harvest legal at ANY
+        point before the next decode dispatch."""
+        p, self._pending = self._pending, None
+        if p is None:
+            return
+        self._m.async_depth.set(0)
         t0 = self._clock()
-        out = [np.asarray(r) for r in
-               self._swap_out()(jnp.asarray(ids_row), *self._arenas)]
+        toks = np.asarray(p.toks_d)
+        tok = np.array(p.tok_d)       # np.array: writable host copies
+        lens = np.array(p.lens_d)
+        done = np.array(p.done_d)
+        self._charge_overlap(self._clock() - t0)
+        sink: List[Request] = []
+        self._absorb_block(p, toks, tok, lens, done, sink)
+        if sink:
+            raise RuntimeError(
+                "deferred harvest produced a finish — the defer "
+                "predicate (_block_sync_reason) is broken")
+        self._reconcile_host_tier()
+
+    def _flush_async(self, reason: str):
+        """Harvest the pending block EARLY because host truth is
+        semantically required right now; charged to
+        serving.async.syncs{reason=}.  A no-op (and not counted) when
+        nothing is pending."""
+        if self._pending is None:
+            return
+        if reason not in ASYNC_SYNC_REASONS:
+            raise ValueError(
+                f"unknown forced-sync reason {reason!r} — known: "
+                f"{ASYNC_SYNC_REASONS}")
+        self._m.async_syncs.inc(reason=reason)
+        self._harvest_pending()
+
+    def _reconcile_host_tier(self):
+        """Materialize every demote parcel enqueued during plan (the
+        overlapped prefix-cache swap-out), at a harvest point instead
+        of serially inside admission.  Resolution happens PER ENTRY —
+        each parcel ends up owning its contiguous per-block copies and
+        flips ``resolved`` (so ``HostTier.audit`` shape checks apply
+        from here on) — and once every live entry of a gather page has
+        resolved, the page itself (table-width, trash rows included)
+        is garbage, so host residency converges to exactly what the
+        tier's block accounting says.  Dropped/evicted/promoted keys
+        are skipped.  Idempotent and cheap when nothing is
+        outstanding."""
+        if not self._lazy_parcels:
+            return
+        keys, self._lazy_parcels = self._lazy_parcels, []
+        t0 = self._clock()
+        for k in keys:
+            e = self._host_tier.entry(k)
+            if e is not None and not e.resolved:
+                e.rows    # the property materializes on first access
+        self._charge_overlap(self._clock() - t0)
+
+    def _resolve_entries(self, entries):
+        """Force still-lazy host-tier parcels a consumer (promotion,
+        resume) needs NOW; the wait is a block on a previous
+        iteration's gather, so it charges to overlap, not host."""
+        lazy = [e for e in entries if e is not None and not e.resolved]
+        if not lazy:
+            return
+        t0 = self._clock()
+        for e in lazy:
+            e.rows        # the property materializes on first access
+        self._charge_overlap(self._clock() - t0)
+
+    def _absorb_block(self, p: _PendingBlock, toks: np.ndarray,
+                      tok: np.ndarray, lens: np.ndarray,
+                      done: np.ndarray, out: List[Request]):
+        """The harvest half of one decode block: adopt the
+        materialized carries as host truth, account the KV sweep and
+        the goodput ledger, extend each rider's token stream, emit the
+        flight-recorder events (stamped with the DISPATCH step; a
+        ``lag`` attr records how many steps later the harvest ran) and
+        retire riders that reached a terminal state.  Shared verbatim
+        by the sync path (immediately after dispatch) and the deferred
+        path (after the NEXT dispatch was enqueued)."""
+        n, active = p.n, p.active
+        self._tok = tok
+        self._lens = lens
+        # per-step frontier, not the block's final lens: scanned step s
+        # scatters at index pre_lens+s and attends up to it — clamped
+        # to the row's final lens, where a mid-block EOS froze it
+        self._count_kv_sweep(
+            [min(int(p.pre_lens[i]) + s, int(lens[i]))
+             for i in active for s in range(n)])
+        # goodput: each riding row dispatched n positions — tokens up
+        # to (and including) a mid-block EOS are useful, the frozen
+        # tail behind it is pad (empty at steps_per_call=1)
+        gp_useful = gp_pad = 0
+        eos = self.cfg.eos_token_id
+        for i in active:
+            row = toks[i]
+            if eos is not None and eos in row:
+                useful_i = int(np.flatnonzero(row == eos)[0]) + 1
+            else:
+                useful_i = n
+            gp_useful += useful_i
+            gp_pad += n - useful_i
+        self._ledger(gp_useful, pad=gp_pad)
+        t = self._clock()
+        lag = self._step_idx - p.step_idx
+        for idx, i in enumerate(active):
+            req = p.reqs[idx]
+            attrs = {"steps": n}
+            if lag:
+                # deterministic (a step delta, never wall): parity
+                # comparisons against a sync engine strip it
+                attrs["lag"] = lag
+            self._fr.emit("decode_block", req.request_id, p.step_idx,
+                          **attrs)
+            req.tokens.extend(int(x) for x in toks[i])
+            req.remaining -= n
+            if done[i] or req.remaining == 0:
+                self._slots[i] = None
+                done[i] = True         # freeze the row until re-use
+                self._release_blocks(req)
+                self._finish(req, t, out)
+            elif req.sampling is not None and \
+                    req.sampling.mask_processor is not None and \
+                    self._mask_dead_end(req):
+                # n == 1 for mask rows (clamped at dispatch), so
+                # exactly one token was appended; finish THIS request
+                # — co-resident rows are untouched
+                self._slots[i] = None
+                done[i] = True
+                self._release_blocks(req)
+                self._finish(req, t, out)
+        self._done = done
+        self._m.slot_occupancy.set(
+            sum(r is not None for r in self._slots))
+
+    # -- host tier (shared by preemption swap + prefix-cache demotion) --
+    def _gather_rows(self, ids_row: np.ndarray,
+                     materialize: bool = True):
+        """Read ``ids_row``'s arena rows (EXACT at-rest bytes: float
+        K/V, or int8 codes + scale planes) — the ONE gather discipline
+        behind preemption swap-out and prefix-cache demotion.
+        ``ids_row`` is table-width (one compiled shape); trash-row
+        entries gather finite garbage the callers slice away or
+        ignore.  ``materialize=True`` forces host numpy stacks (the
+        preemption path: a swap record's bytes are correctness-
+        bearing); ``materialize=False`` returns the un-forced device
+        stacks — the dispatch-ahead demote path wraps them in a
+        ``_LazyStacks`` and reconciles at the next harvest point."""
+        t0 = self._clock()
+        dev = self._swap_out()(jnp.asarray(ids_row), *self._arenas)
+        if materialize:
+            out = [np.asarray(r) for r in dev]
+        else:
+            out = list(dev)
         self._disp_s += self._clock() - t0
         return out
 
@@ -1308,12 +1668,28 @@ class ServingEngine:
                 chunk = blocks[i:i + w]
                 ids = np.full((w,), self._pool.trash, np.int32)
                 ids[:len(chunk)] = chunk
-                stacks = self._gather_rows(ids)
-                for j, b in enumerate(chunk):
-                    rows = [np.ascontiguousarray(s[j:j + 1])
-                            for s in stacks]
-                    if self._radix.demote(b, rows) is not None:
-                        demoted += 1
+                if self.async_dispatch:
+                    # overlapped swap-out: ENQUEUE the gather now (the
+                    # device values are captured functionally — later
+                    # donated arena overwrites cannot reach them) and
+                    # hand each parcel a lazy row view; the host copy
+                    # materializes at the next harvest point
+                    # (_reconcile_host_tier) instead of serially here
+                    ls = _LazyStacks(
+                        self._gather_rows(ids, materialize=False))
+                    for j, b in enumerate(chunk):
+                        thunk = (lambda ls=ls, j=j: ls.block_rows(j))
+                        key = self._radix.demote(b, thunk)
+                        if key is not None:
+                            demoted += 1
+                            self._lazy_parcels.append(key)
+                else:
+                    stacks = self._gather_rows(ids)
+                    for j, b in enumerate(chunk):
+                        rows = [np.ascontiguousarray(s[j:j + 1])
+                                for s in stacks]
+                        if self._radix.demote(b, rows) is not None:
+                            demoted += 1
         if demoted:
             self._m.swap_out_blocks.inc(demoted, reason="cache")
             self._m.swap_out_bytes.inc(
@@ -1636,6 +2012,12 @@ class ServingEngine:
                 return True
         for i, req in enumerate(self._slots):
             if req is not None and req.request_id == request_id:
+                # only an IN-FLIGHT cancel needs host truth (the
+                # terminal output pads from the tokens that already
+                # exist, and a pending harvest must not outlive its
+                # riding set) — queued/swapped/unknown targets leave
+                # the pipeline deferred
+                self._flush_async("cancel")
                 phase = req.state
                 if req in self._prefilling:
                     self._prefilling.remove(req)
@@ -1802,6 +2184,10 @@ class ServingEngine:
                 f"request {req.request_id} is not in flight "
                 f"(state={req.state}, slot={slot}) — only admitted "
                 f"prefill/decode requests can be preempted")
+        # the swap record saves the slot's HOST tok/lens carries — a
+        # deferred harvest must land first or a pending-active victim
+        # would resume one block behind its own KV bytes
+        self._flush_async("preempt")
         ids = self._tables[slot].copy()     # BEFORE release trashes it
         n = len(req.blocks)
         with _span("serving.swap_out", request=req.request_id,
@@ -1888,6 +2274,12 @@ class ServingEngine:
             fresh = self._alloc(rec.n_blocks)
         if fresh is None:
             return False
+        # the resume REWRITES the slot's host tok/lens carries, so the
+        # next decode dispatch must come from host mirrors — harvest
+        # the pending block first.  Flushed only HERE, after blocks
+        # are secured: a resume attempt that cannot allocate keeps the
+        # pipeline deferred (it changed no carries)
+        self._flush_async("resume")
         row = np.full((self.max_blocks,), self._pool.trash, np.int32)
         row[:rec.n_blocks] = fresh
         # the dispatch runs BEFORE any scheduler-state commit, and a
@@ -2038,6 +2430,7 @@ class ServingEngine:
         if n_promote:
             dest = fresh[:n_promote]
             entries = [self._host_tier.entry(k) for k in host_keys]
+            self._resolve_entries(entries)
             ids_row = np.full((self.max_blocks,), self._pool.trash,
                               np.int32)
             ids_row[:n_promote] = dest
@@ -2245,7 +2638,7 @@ class ServingEngine:
         self._m.slot_occupancy.set(
             sum(r is not None for r in self._slots))
 
-    def _build_samp(self, reqs):
+    def _build_samp(self, reqs, pos_lag: int = 0):
         """The ``samp`` plane pytree of one dispatch: ``reqs`` is the
         dispatch's batch view (one Optional[Request] per row; None =
         vacant/frozen/not-riding).  Flags come from the ACTIVE rows
@@ -2256,9 +2649,18 @@ class ServingEngine:
         host truth (``len(req.tokens)``) on every dispatch, which is
         the whole rewind story: a speculative rollback shrinks
         ``tokens``, so the rolled-back positions are simply keyed and
-        drawn again next forward."""
+        drawn again next forward.  ``pos_lag`` corrects that host
+        truth on a DEFERRED dispatch: the pending block's tokens are
+        not yet harvested, so every riding row's true PRNG position is
+        ``len(tokens) + pending.n`` — the correction that keeps
+        sampled streams bit-identical to the lockstep engine."""
         flags = flags_of([r.sampling for r in reqs if r is not None])
         sampled, _filtered, penalty, bias = flags
+        if pos_lag and (penalty or bias):
+            raise RuntimeError(
+                "deferred dispatch with a host-built logit plane "
+                "(penalty/bias) — the defer predicate must have "
+                "forced a sync for these rows")
         n = len(reqs)
         samp = {}
         if sampled:
@@ -2273,7 +2675,7 @@ class ServingEngine:
                     continue
                 temp[i], top_k[i], top_p[i], greedy[i] = \
                     row_planes(r.sampling)
-                pos[i] = len(r.tokens)
+                pos[i] = len(r.tokens) + pos_lag
                 if r.samp_base is not None:
                     base[i] = r.samp_base
             samp.update(
@@ -2339,6 +2741,12 @@ class ServingEngine:
             return
         req = self._prefilling[0]
         start, c = req.pf_pos, self.chunk_len
+        is_final = start + c >= req.seq_len
+        if is_final:
+            # the final chunk samples the request's first token, which
+            # becomes host truth THIS step (EOS check, decode-mix
+            # entry, the slot's tok/lens carries) — the pipeline syncs
+            self._flush_async("chunk_final")
         flags, samp = self._build_samp([req])
         t0 = self._clock()
         with _span("serving.prefill", request=req.request_id,
@@ -2351,7 +2759,13 @@ class ServingEngine:
                 jnp.asarray(self._tables[req.slot][None, :]), samp,
                 *self._arenas)
             self._arenas = list(outp[1:])
-            tok0 = int(np.asarray(outp[0])[0])
+            # a non-final chunk's sampled token is meaningless (the
+            # engine never advances decode state from it): the
+            # dispatch-ahead engine leaves it un-forced, so the chunk
+            # computes under the NEXT iterations' host work; the
+            # final chunk's token is host truth and materializes here
+            tok0 = (int(np.asarray(outp[0])[0])
+                    if is_final or not self.async_dispatch else None)
         self._m.prefill_chunks.inc()
         dt = self._clock() - t0
         self._m.chunk_latency.observe(dt)
@@ -2504,6 +2918,12 @@ class ServingEngine:
                 and r.spec_k is not None]
         if not spec:
             return
+        # defensive: the defer predicate never leaves a harvest
+        # pending while spec slots decode (spec entry goes through a
+        # chunk_final sync), but the verify below reads host lens
+        # mirrors — a stale mirror here would verify against the
+        # wrong frontier, so sync loudly rather than drift silently
+        self._flush_async("spec")
         drafts = {}
         for i in spec:
             req = self._slots[i]
@@ -2631,19 +3051,34 @@ class ServingEngine:
         Also attributes the iteration's wall time: every compiled-
         dispatch site (chunk prefill, verify, decode block, swap
         gathers/scatters) accumulates into ``serving.step.
-        dispatch_seconds`` and the remainder is ``serving.step.
-        host_seconds`` — the host-scheduler slice a dispatch-ahead
-        pipeline (ROADMAP item 2) must hide.  Steps that dispatched
-        nothing (idle admission polls) observe neither."""
+        dispatch_seconds``, time spent blocking on a PREVIOUS
+        iteration's deferred outputs into ``serving.step.
+        overlap_seconds``, injected fault stalls into ``serving.fault.
+        stall_seconds``, and the remainder is ``serving.step.
+        host_seconds`` — the pure host-scheduler slice the
+        dispatch-ahead pipeline hides under device time.  Steps that
+        dispatched nothing (idle admission polls) observe neither
+        host nor dispatch."""
         self._step_idx += 1
         self._disp_s = 0.0
+        self._overlap_s = 0.0
+        self._stall_s = 0.0
+        self._in_step = True
         t0 = self._clock()
-        out = self._step_inner(now)
+        try:
+            out = self._step_inner(now)
+            # reconcile any demote gathers this step enqueued so their
+            # wait is attributed HERE (and the device copies do not
+            # outlive the step)
+            self._reconcile_host_tier()
+        finally:
+            self._in_step = False
         disp = self._disp_s
         if disp > 0.0:
             self._m.step_dispatch.observe(disp)
             self._m.step_host.observe(
-                max((self._clock() - t0) - disp, 0.0))
+                max((self._clock() - t0) - disp - self._overlap_s
+                    - self._stall_s, 0.0))
         return out
 
     def _step_inner(self, now: Optional[float] = None) -> List[Request]:
@@ -2653,7 +3088,15 @@ class ServingEngine:
             stall = self._fault.take_stall()
             if stall:
                 with _span("serving.fault.stall", seconds=stall):
+                    t0s = self._clock()
                     time.sleep(stall)
+                    dt = self._clock() - t0s
+                # charge the injected sleep to its OWN histogram and
+                # carve it out of host_seconds: a fault-injection run
+                # must not pollute the host-scheduler baseline the
+                # dispatch-ahead pipeline is judged against
+                self._stall_s += dt
+                self._m.stall_seconds.observe(dt)
             for rid in self._fault.take_forced_swaps():
                 for r in self._slots:
                     if r is not None and r.request_id == rid \
@@ -2687,6 +3130,13 @@ class ServingEngine:
         active = [i for i, r in enumerate(self._slots)
                   if r is not None and self._block_rides(i, r)]
         if not active:
+            if self._pending is not None:
+                # structurally impossible (a pending block's riders
+                # cannot finish or leave while deferred) — never let
+                # a pending record outlive its riding set silently
+                raise RuntimeError(
+                    "dispatch-ahead harvest pending with an empty "
+                    "riding set — the defer invariant broke")
             self._m.slot_occupancy.set(
                 sum(r is not None for r in self._slots))
             return finished
@@ -2702,7 +3152,20 @@ class ServingEngine:
         # n-step block via the done plane and feeding them a second
         # 1-step dispatch per iteration — doubles dispatches and
         # accounting paths for a mix this engine rarely sees)
-        min_budget = min(self._slots[i].remaining for i in active)
+        pend = self._pending
+        if pend is not None and pend.active != active:
+            # structurally impossible (deferral forbids finishes, new
+            # decode entrants sync via chunk_final/resume, cancel and
+            # preempt flush) — a mismatch means the invariant broke,
+            # and dispatching would corrupt carries: fail loudly
+            raise RuntimeError(
+                f"dispatch-ahead riding set drifted while a harvest "
+                f"was deferred: pending {pend.active} vs now {active}")
+        # one-step-stale correction: while a harvest is deferred, each
+        # rider's host truth (remaining, len(tokens), lens mirror) is
+        # behind by exactly pend.n tokens
+        lag = pend.n if pend is not None else 0
+        min_budget = min(self._slots[i].remaining for i in active) - lag
         masked = any(self._slots[i].sampling is not None and
                      self._slots[i].sampling.mask_processor is not None
                      for i in active)
@@ -2711,73 +3174,65 @@ class ServingEngine:
         active_set = set(active)
         riding = [self._slots[i] if i in active_set else None
                   for i in range(self.num_slots)]
-        flags, samp = self._build_samp(riding)
-        pre_lens = self._lens
+        flags, samp = self._build_samp(riding, pos_lag=lag)
+        pre_lens = np.array(self._lens)
+        if pend is not None:
+            # the riding set equals the pending set (checked above),
+            # so every rider's true pre-dispatch lens is mirror + n
+            pre_lens[active] += lag
+            # double-buffered carries: feed the in-flight block's
+            # device outputs straight into this dispatch — no host
+            # round-trip, no wait
+            tok_in, lens_in, done_in = pend.tok_d, pend.lens_d, \
+                pend.done_d
+        else:
+            tok_in = jnp.asarray(self._tok)
+            lens_in = jnp.asarray(self._lens)
+            done_in = jnp.asarray(self._done)
         t_blk = self._clock()
         with _span("serving.decode_block", steps=n, active=len(active)):
             out = _call_quiet(
                 self._block_fn(n, flags),
-                self._pb, jnp.asarray(self._tok), jnp.asarray(self._lens),
-                jnp.asarray(self._done), samp,
+                self._pb, tok_in, lens_in, done_in, samp,
                 jnp.asarray(self._decode_tables()), *self._arenas)
-            toks = np.asarray(out[0])                   # [B, n]
-        self._tok = np.array(out[1])    # np.array: writable host copies
-        self._lens = np.array(out[2])
-        done = np.array(out[3])
         self._arenas = list(out[4:])
         self._disp_s += self._clock() - t_blk
+        # plan-known accounting lands at DISPATCH (same step as the
+        # lockstep engine); output-dependent accounting (KV sweep,
+        # ledger, token streams, flight-recorder events) lands at
+        # harvest inside _absorb_block
         self._m.decode_steps.inc(n)
         self._m.busy_slot_steps.inc(n * len(active))
         self._m.block_dispatches.inc()
         self._m.tokens_emitted.inc(n * len(active))
-        # per-step frontier, not the block's final lens: scanned step s
-        # scatters at index lens_pre+s and attends up to it — clamped
-        # to the row's final lens, where a mid-block EOS froze it (the
-        # scan keeps sweeping the frozen frontier for the rest of the
-        # block)
-        self._count_kv_sweep(
-            [min(int(pre_lens[i]) + s, int(self._lens[i]))
-             for i in active for s in range(n)])
         self._count_sample_route([(self._slots[i], n) for i in active])
-        # goodput: each riding row dispatched n positions — tokens up
-        # to (and including) a mid-block EOS are useful, the frozen
-        # tail behind it is pad (empty at steps_per_call=1)
-        gp_useful = gp_pad = 0
-        eos = self.cfg.eos_token_id
-        for i in active:
-            row = toks[i]
-            if eos is not None and eos in row:
-                useful_i = int(np.flatnonzero(row == eos)[0]) + 1
-            else:
-                useful_i = n
-            gp_useful += useful_i
-            gp_pad += n - useful_i
-        self._ledger(gp_useful, pad=gp_pad)
-        t = self._clock()
-        for i in active:
-            req = self._slots[i]
-            self._fr.emit("decode_block", req.request_id,
-                          self._step_idx, steps=n)
-            req.tokens.extend(int(x) for x in toks[i])
-            req.remaining -= n
-            if done[i] or req.remaining == 0:
-                self._slots[i] = None
-                done[i] = True         # freeze the row until re-use
-                self._release_blocks(req)
-                self._finish(req, t, finished)
-            elif req.sampling is not None and \
-                    req.sampling.mask_processor is not None and \
-                    self._mask_dead_end(req):
-                # n == 1 for mask rows (clamped above), so exactly one
-                # token was appended; finish THIS request — co-resident
-                # rows are untouched
-                self._slots[i] = None
-                done[i] = True
-                self._release_blocks(req)
-                self._finish(req, t, finished)
-        self._done = done
-        self._m.slot_occupancy.set(
-            sum(r is not None for r in self._slots))
+        new_pend = _PendingBlock(
+            step_idx=self._step_idx, n=n, active=list(active),
+            reqs=[self._slots[i] for i in active], pre_lens=pre_lens,
+            toks_d=out[0], tok_d=out[1], lens_d=out[2], done_d=out[3])
+        if pend is not None:
+            # THE overlap point: the previous block's outputs are
+            # forced only now, after this iteration's host work ran
+            # and its dispatch was enqueued
+            self._harvest_pending()
+            self._m.async_harvests.inc()
+        reason = self._block_sync_reason(n, active)
+        if reason is None:
+            self._pending = new_pend
+            self._m.async_depth.set(1)
+        else:
+            if self.async_dispatch:
+                self._m.async_syncs.inc(reason=reason)
+            t_mat = self._clock()
+            toks = np.asarray(new_pend.toks_d)          # [B, n]
+            tok = np.array(new_pend.tok_d)  # np.array: writable copies
+            lens = np.array(new_pend.lens_d)
+            done = np.array(new_pend.done_d)
+            # sync materialization is part of the dispatch, exactly
+            # the lockstep engine's attribution
+            self._disp_s += self._clock() - t_mat
+            self._absorb_block(new_pend, toks, tok, lens, done,
+                               finished)
         return finished
 
     def _stall_diagnosis(self, wall_timeout_s: float) -> str:
@@ -2817,6 +3272,13 @@ class ServingEngine:
             now = self._clock()
             if wall_timeout_s is not None and \
                     now - start > wall_timeout_s:
+                # flush the in-flight harvest BEFORE raising: every
+                # token the device already produced reaches its
+                # request, the deferred ledger/flight-recorder events
+                # land, and the engine the caller inspects is
+                # self-consistent (a later run() continues cleanly)
+                self._flush_async("drain")
+                self._reconcile_host_tier()
                 raise EngineStalledError(
                     self._stall_diagnosis(wall_timeout_s))
             if (not any(r is not None for r in self._slots)
@@ -2839,11 +3301,18 @@ class ServingEngine:
                 time.sleep(0.001)
             iters += 1
             if max_iters is not None and iters > max_iters:
+                self._flush_async("drain")
+                self._reconcile_host_tier()
                 raise RuntimeError(
                     f"serving loop exceeded max_iters={max_iters} with "
                     f"{len(self._queue)} queued / "
                     f"{len(self._swapped)} swapped / "
                     f"{sum(r is not None for r in self._slots)} active")
+        # a drained loop cannot leave a harvest pending (the last
+        # rider's final block is always a forced budget/eos sync), but
+        # flush defensively so run() can never hand back stale truth
+        self._flush_async("drain")
+        self._reconcile_host_tier()
         return sorted(finished, key=lambda r: r.request_id)
 
     def stats(self) -> dict:
@@ -2998,6 +3467,19 @@ class ServingEngine:
             "slo_attained": int(
                 self._m.since_init(self._m.slo_attained)),
             "slo_missed": int(self._m.since_init(self._m.slo_missed)),
+            # dispatch-ahead pipeline: forced early harvests by closed
+            # reason vocabulary vs harvests that completed AFTER the
+            # next dispatch was enqueued (the overlap wins).  While a
+            # harvest is in flight the output-dependent counters above
+            # (ledger, kv_bytes_swept) lag by at most one dispatch;
+            # run() always returns with the pipeline flushed.
+            "async_dispatch": self.async_dispatch,
+            "async_syncs": int(self._m.since_init(self._m.async_syncs)),
+            "async_harvests": int(
+                self._m.since_init(self._m.async_harvests)),
+            "async_syncs_by_reason": {
+                reason: int(self._m.syncs_since(reason))
+                for reason in ASYNC_SYNC_REASONS},
         }
 
     @property

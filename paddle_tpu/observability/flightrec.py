@@ -304,9 +304,19 @@ def explain_events(events: List[FlightEvent], request_id: int) -> str:
             f"{_plural(accepted, 'spec position')} accepted / "
             f"{rejected} rejected over "
             f"{_plural(len(verifies), 'verify forward')}")
-    n_blocks = len(by_kind.get("decode_block", []))
-    if n_blocks:
-        parts.append(f"rode {_plural(n_blocks, 'decode block')}")
+    blocks_ev = by_kind.get("decode_block", [])
+    if blocks_ev:
+        clause = f"rode {_plural(len(blocks_ev), 'decode block')}"
+        # harvest lag (dispatch-ahead engines): events are stamped
+        # with the DISPATCH step; ``lag`` says how many steps later
+        # the outputs were forced to host — a deterministic step
+        # delta, never wall time
+        lags = [int(e.attrs.get("lag", 0)) for e in blocks_ev]
+        n_lag = sum(1 for v in lags if v)
+        if n_lag:
+            clause += (f" ({n_lag} harvested dispatch-ahead, lag "
+                       f"{_plural(max(lags), 'step')})")
+        parts.append(clause)
     for kind, verb in (("finish", "finished"), ("timeout", "timed out"),
                        ("shed", "shed"), ("cancel", "cancelled")):
         for e in by_kind.get(kind, []):

@@ -510,6 +510,7 @@ def test_metrics_name_lint_clean():
              "serving.preempt.", "serving.swap.", "serving.shed.",
              "serving.timeout.", "serving.prefix.",
              "serving.goodput.", "serving.slo.", "serving.step.",
+             "serving.async.", "serving.fault.",
              "serving.tpot_seconds")), n
         assert n in names, n
     kinds = {r[3]: r[2] for r in regs}

@@ -1,0 +1,302 @@
+"""Dispatch-ahead step pipeline (PR 10): sync-vs-async lockstep
+parity, forced-sync reason accounting, drain-flush semantics and the
+fault-stall attribution satellite.
+
+Tier-1 budget discipline (truncation-scored on the 2-core box): ONE
+tiny 1-layer llama model at module scope, steps_per_call=1 (one block
+compile shared by both arms), short prompts/budgets.  The parity trace
+runs TWICE — ``async_dispatch=True`` vs the ``False`` kill-switch — on
+PRIVATE registries and recorders (shared-registry deltas would absorb
+the other arm; the memory-bank bench-gate rule), stepping both engines
+manually with ``BlockPool.check()`` after every step.
+
+Parity contract (the acceptance anchor): token-for-token equal
+outputs (greedy rows also ``generate()``-exact), equal deterministic
+scheduling counters, and identical flight-recorder event sequences —
+compared stable-sorted by ``step`` with ``wall`` and the
+deterministic ``lag`` attr stripped, because a deferred harvest emits
+its ``decode_block`` events (stamped with the DISPATCH step) after
+the next step's admissions chronologically."""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.inference import FaultInjector
+from paddle_tpu.inference.sampling import DfaTokenMask, SamplingParams
+from paddle_tpu.inference.serving import (ASYNC_SYNC_REASONS,
+                                          EngineStalledError,
+                                          ServingEngine)
+from paddle_tpu.observability import MetricsRegistry
+from paddle_tpu.observability.flightrec import FlightRecorder
+
+P, C, BL = 8, 40, 4
+TERMINAL = ("finished", "timeout", "shed", "cancelled")
+
+
+@pytest.fixture(scope="module")
+def netm():
+    paddle.seed(1234)
+    cfg = models.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64)
+    net = models.LlamaForCausalLM(cfg)
+    net.eval()
+    return cfg, net
+
+
+def _gen_ref(net, ids, max_new):
+    out = net.generate(paddle.to_tensor(ids[None, :]),
+                       max_new_tokens=max_new, max_cache_len=C,
+                       compute_dtype="float32")
+    return np.asarray(out._value)[0]
+
+
+class _AlwaysDraft:
+    def propose(self, context, k):
+        return np.repeat(np.asarray(context[-1:], np.int32), k)
+
+
+def _mask_table(vocab):
+    # 2-state DFA cycling tokens 1 -> 2 -> 1 ... (always has a legal
+    # continuation, so the masked request runs its full budget)
+    table = np.full((2, vocab), -1, np.int32)
+    table[0, 1] = 1
+    table[1, 2] = 0
+    return table
+
+
+def _drive(net, cfg, async_dispatch):
+    """The combined parity trace: greedy + seeded-sampled rows with
+    shared-prefix hits and chunked prefill (phase 1, where deferral
+    actually engages), then spec decode + a token-masked row + a
+    forced preemption/resume (phase 2, the forced-sync modes)."""
+    rng = np.random.default_rng(99)
+    shared = rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
+    fi = FaultInjector()
+    reg = MetricsRegistry()
+    rec = FlightRecorder()
+    eng = ServingEngine(
+        net, num_slots=2, prompt_len=P, max_cache_len=C,
+        steps_per_call=1, block_len=BL, chunk_len=4, num_blocks=12,
+        compute_dtype="float32", registry=reg, flight_recorder=rec,
+        fault_injector=fi, drafter=_AlwaysDraft(),
+        async_dispatch=async_dispatch)
+
+    def drain(reqs, max_steps=120):
+        steps = 0
+        while any(r.state not in TERMINAL for r in reqs):
+            eng.step(now=0.0)
+            eng._pool.check()
+            steps += 1
+            assert steps < max_steps, "trace did not drain"
+
+    # phase 1: plain greedy (prefix-sharing) + a seeded sampled row —
+    # the regime where harvests defer
+    ids_a = rng.integers(0, cfg.vocab_size, (7,)).astype(np.int32)
+    ids_a[:4] = shared
+    ids_b = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    ids_c = rng.integers(0, cfg.vocab_size, (7,)).astype(np.int32)
+    ids_c[:4] = shared                      # radix hit on A's prefix
+    ra = eng.submit(ids_a, max_new_tokens=7, arrival_time=0.0)
+    rb = eng.submit(ids_b, max_new_tokens=6, arrival_time=0.0,
+                    sampling=SamplingParams(temperature=0.8, top_k=12,
+                                            seed=5))
+    rc = eng.submit(ids_c, max_new_tokens=5, arrival_time=0.0)
+    drain([ra, rb, rc])
+
+    # phase 2: spec decode beside a PLAIN co-rider (the plain row's
+    # block dispatches charge syncs{spec}), then a masked row alone
+    # (its own block dispatches charge syncs{mask})
+    rd = eng.submit(ids_a, max_new_tokens=6, arrival_time=0.0,
+                    spec_decode=2)
+    rg = eng.submit(ids_b, max_new_tokens=6, arrival_time=0.0)
+    drain([rd, rg])
+    re_ = eng.submit(ids_b, max_new_tokens=4, arrival_time=0.0,
+                     sampling=SamplingParams(
+                         temperature=0.0,
+                         mask_processor=DfaTokenMask(
+                             _mask_table(cfg.vocab_size))))
+    drain([re_])
+
+    # phase 3: forced preemption mid-decode, then resume BESIDE a
+    # still-deferring co-rider — the swap paths read/write host
+    # carries, so the pipeline must sync at both ends (the co-rider
+    # is what makes a harvest actually pending at each flush)
+    rf = eng.submit(ids_c, max_new_tokens=8, arrival_time=0.0)
+    rh = eng.submit(ids_a, max_new_tokens=14, arrival_time=0.0)
+    for _ in range(4):                      # both admitted + decoding
+        eng.step(now=0.0)
+    fi.force_swap(rf.request_id)
+    # two injected alloc failures (the direct try AND the after-
+    # preemption retry) delay the resume by exactly one step, so it
+    # lands while the co-rider's harvest is DEFERRED — the
+    # syncs{resume} path (a same-step resume would find the pipeline
+    # already flushed by the preempt)
+    fi.fail_allocs(2)
+    drain([rf, rh])
+    return eng, reg, rec, (ra, rb, rc, rd, rg, re_, rf, rh)
+
+
+@pytest.fixture(scope="module")
+def arms(netm):
+    cfg, net = netm
+    a = _drive(net, cfg, async_dispatch=True)
+    s = _drive(net, cfg, async_dispatch=False)
+    return a, s
+
+
+def _norm_events(rec):
+    """Stable-sort by step, strip wall and the harvest-lag attr (the
+    ONLY deterministic field the pipeline adds)."""
+    evs = sorted(rec.events(), key=lambda e: e.step)
+    return [(e.step, e.request, e.kind,
+             tuple(sorted((k, str(v)) for k, v in e.attrs.items()
+                          if k != "lag")))
+            for e in evs]
+
+
+def test_async_lockstep_parity(arms, netm):
+    cfg, net = netm
+    (ea, rga, reca, qa), (es, rgs, recs, qs) = arms
+    # token-exact across the combined trace, arm vs arm
+    for a, s in zip(qa, qs):
+        np.testing.assert_array_equal(a.output, s.output)
+    # greedy rows (incl. the spec row and the resumed row) are also
+    # generate()-exact — the engine's standing anchor
+    ra, _rb, rc, rd, _rg, _re, rf, _rh = qa
+    np.testing.assert_array_equal(
+        ra.output, _gen_ref(net, ra.prompt[:ra.seq_len], 7))
+    np.testing.assert_array_equal(
+        rd.output, _gen_ref(net, rd.prompt[:rd.seq_len], 6))
+    np.testing.assert_array_equal(
+        rf.output, _gen_ref(net, rf.prompt[:rf.seq_len], 8))
+    # deterministic scheduling counters identical
+    sa, ss = ea.stats(), es.stats()
+    for k in ("decode_steps", "busy_slot_steps", "block_dispatches",
+              "prefills", "prefill_chunks", "prefix_hits",
+              "prefix_hit_tokens", "preemptions", "preempt_resumes",
+              "swap_blocks_out", "swap_blocks_in", "kv_bytes_swept",
+              "useful_tokens", "wasted_tokens", "dispatched_tokens",
+              "wasted_by_reason", "spec_verify_steps",
+              "spec_accepted_tokens", "sampled_tokens",
+              "masked_tokens", "finished"):
+        assert sa[k] == ss[k], k
+    # flight-recorder event sequences identical modulo wall + lag
+    assert _norm_events(reca) == _norm_events(recs)
+    eng_checks = (ea, es)
+    for e in eng_checks:
+        e._pool.check()
+        assert e._pending is None          # run ended flushed
+
+
+def test_async_overlap_and_sync_reasons(arms):
+    (ea, rga, reca, _qa), (es, rgs, recs, _qs) = arms
+    sa, ss = ea.stats(), es.stats()
+    # the async arm really pipelined: deferred harvests completed
+    # after the next dispatch was enqueued, and the overlap histogram
+    # observed the waits; the kill-switch arm observed nothing
+    assert sa["async_dispatch"] is True and ss["async_dispatch"] is False
+    assert sa["async_harvests"] > 0
+    assert ss["async_harvests"] == 0 and ss["async_syncs"] == 0
+    assert rga.get("serving.step.overlap_seconds").summary()["count"] > 0
+    assert rgs.get("serving.step.overlap_seconds").summary()["count"] == 0
+    # forced syncs happened ONLY for documented reasons — and the
+    # trace exercised the big ones
+    by_reason = sa["async_syncs_by_reason"]
+    assert set(by_reason) == set(ASYNC_SYNC_REASONS)
+    fired = {k for k, v in by_reason.items() if v > 0}
+    assert fired <= set(ASYNC_SYNC_REASONS)
+    for expected in ("budget", "chunk_final", "spec", "mask",
+                     "preempt", "resume"):
+        assert by_reason[expected] > 0, expected
+    assert sum(by_reason.values()) == sa["async_syncs"]
+    # the deferred harvests are visible per-request: some async
+    # decode_block event carries the deterministic lag attr, no sync
+    # event does, and explain() renders it
+    lags = [e for e in reca.events()
+            if e.kind == "decode_block" and e.attrs.get("lag")]
+    assert lags
+    assert not [e for e in recs.events()
+                if e.kind == "decode_block" and e.attrs.get("lag")]
+    assert "harvested dispatch-ahead" in ea.explain(lags[0].request)
+    # step-split attribution stayed coherent in both arms
+    for rg in (rga, rgs):
+        d = rg.get("serving.step.dispatch_seconds").summary()
+        h = rg.get("serving.step.host_seconds").summary()
+        assert d["count"] == h["count"] > 0
+        assert d["sum"] > 0.0 and h["sum"] >= 0.0
+
+
+def test_timeline_cli_renders_harvest_lag(arms, tmp_path, capsys):
+    """tools/explain_request.py --timeline marks deferred harvests."""
+    (ea, _rga, reca, qa), _s = arms
+    lag_ev = next(e for e in reca.events()
+                  if e.kind == "decode_block" and e.attrs.get("lag"))
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "explain_request.py")
+    spec = importlib.util.spec_from_file_location("explain_request",
+                                                  path)
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+    record = str(tmp_path / "async_record.json")
+    reca.export(record)
+    assert cli.main([record, str(lag_ev.request), "--timeline"]) == 0
+    out = capsys.readouterr().out
+    assert "[harvested +" in out
+    # the rendered explanation (non-timeline mode) names the lag too
+    assert cli.main([record, str(lag_ev.request)]) == 0
+    assert "harvested dispatch-ahead" in capsys.readouterr().out
+
+
+def test_drain_flushes_inflight_harvest_before_stall_raise(netm):
+    """run(wall_timeout_s=) flushes the pending harvest (reason
+    'drain') before raising EngineStalledError: every token the
+    device already produced reaches its request, and clearing the
+    fault drains the SAME engine token-exactly.  Also the stall-
+    attribution satellite: injected stalls land in
+    serving.fault.stall_seconds, never in step.host_seconds."""
+    cfg, net = netm
+    rng = np.random.default_rng(3)
+    ids_a = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    ids_b = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+    fi = FaultInjector()
+    reg = MetricsRegistry()
+    eng = ServingEngine(
+        net, num_slots=1, prompt_len=P, max_cache_len=C,
+        steps_per_call=1, block_len=BL, chunk_len=P,
+        compute_dtype="float32", registry=reg, fault_injector=fi)
+    a = eng.submit(ids_a, max_new_tokens=24)
+    b = eng.submit(ids_b, max_new_tokens=3)   # queued behind a (1 slot)
+    for _ in range(3):                        # admit + prefill + decode
+        eng.step()
+    assert eng._pending is not None           # a harvest is in flight
+    n_before = len(a.tokens)
+    fi.stall_steps(2, 0.05)
+    with pytest.raises(EngineStalledError):
+        eng.run(wall_timeout_s=0.04)
+    # flushed: pending gone, the already-produced tokens landed, the
+    # sync was charged to the documented 'drain' reason
+    assert eng._pending is None
+    assert len(a.tokens) > n_before
+    assert reg.get("serving.async.syncs").value(reason="drain") >= 1
+    eng._pool.check()
+    # stall attribution: the injected sleeps observed their own
+    # histogram and were carved OUT of host_seconds
+    st = reg.get("serving.fault.stall_seconds").summary()
+    assert st["count"] >= 1 and st["sum"] >= 0.05
+    host = reg.get("serving.step.host_seconds").summary()
+    assert host["sum"] < st["sum"]
+    # clearing the fault lets the SAME engine drain token-exactly
+    done = {r.request_id: r for r in eng.run()}
+    np.testing.assert_array_equal(
+        done[a.request_id].output, _gen_ref(net, ids_a, 24))
+    np.testing.assert_array_equal(
+        done[b.request_id].output, _gen_ref(net, ids_b, 3))
+    assert eng.stats()["async_harvests"] > 0
+    eng._pool.check()

@@ -804,6 +804,20 @@ def test_bench_llm_serving_section():
         # exact-bytes swap preemption never recomputes (the ledger's
         # structural-zero claim, bench-checked too)
         assert arm_g["wasted_by_reason"]["recompute_preempt"] == 0
+    # PR 10: the dispatch-ahead A/B — gated ONLY on deterministic
+    # counters (token-exact outputs, equal dispatch/token counts,
+    # real pipelining, syncs confined to the documented reasons);
+    # tokens/s and the host/overlap second sums ride along ungated
+    aa = out["async"]
+    for k in ("tokens_per_s", "sync_tokens_per_s", "vs_sync",
+              "async_syncs", "async_harvests", "syncs_by_reason",
+              "host_ms", "dispatch_ms", "overlap_ms", "sync_host_ms",
+              "sync_dispatch_ms", "gate"):
+        assert k in aa, k
+    assert aa["gate"]["token_exact"]
+    assert aa["gate"]["dispatch_counts_equal"]
+    assert aa["gate"]["pipelined"]
+    assert aa["gate"]["sync_reasons_documented"]
     # the spec arm's waste is dominated by rejected draft positions
     assert spec["goodput"]["wasted_by_reason"]["spec_reject"] > 0
     assert "no_spec_goodput" in spec

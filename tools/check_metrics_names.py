@@ -113,6 +113,19 @@ REQUIRED_INSTRUMENTS = {
     "serving.tpot_seconds": ("histogram", ()),
     "serving.slo.attained": ("counter", ("class",)),
     "serving.slo.missed": ("counter", ("class",)),
+    # dispatch-ahead step pipeline (PR 10, inference/serving.py
+    # _ServingInstruments): the plan/harvest split's observable
+    # surface — forced-sync iterations by closed reason vocabulary
+    # (the bench's async A/B arm gates on these), completed deferred
+    # harvests, the pipeline-depth gauge, the overlap histogram
+    # (time blocked on a PREVIOUS iteration's arrays, carved out of
+    # host_seconds) and the fault-stall histogram that keeps injected
+    # sleeps out of the host-scheduler baseline
+    "serving.async.syncs": ("counter", ("reason",)),
+    "serving.async.harvests": ("counter", ()),
+    "serving.async.depth": ("gauge", ()),
+    "serving.step.overlap_seconds": ("histogram", ()),
+    "serving.fault.stall_seconds": ("histogram", ()),
 }
 
 

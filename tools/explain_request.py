@@ -41,8 +41,17 @@ def _fmt_timeline(events, request_id) -> str:
     for e in events:
         if e.request != request_id:
             continue
-        attrs = " ".join(f"{k}={v}" for k, v in e.attrs.items())
-        lines.append(f"  step {e.step:>5}  {e.kind:<14} {attrs}".rstrip())
+        attrs = dict(e.attrs)
+        # harvest lag (dispatch-ahead engines): the event is stamped
+        # with its DISPATCH step; render how many steps later the
+        # outputs were actually forced to host
+        lag = attrs.pop("lag", None)
+        joined = " ".join(f"{k}={v}" for k, v in attrs.items())
+        line = f"  step {e.step:>5}  {e.kind:<14} {joined}".rstrip()
+        if lag:
+            line += (f"  [harvested +{int(lag)} step"
+                     f"{'' if int(lag) == 1 else 's'}]")
+        lines.append(line)
     return "\n".join(lines)
 
 
