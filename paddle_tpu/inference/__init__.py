@@ -20,8 +20,10 @@ from .faultinject import FaultInjector  # noqa: F401
 from .prefixcache import HostTier, RadixPrefixCache  # noqa: F401
 from .speculative import (Drafter, ModelDrafter,  # noqa: F401
                           NGramDrafter)
+from .lora import AdapterStore, LoraAdapter  # noqa: F401
 
 __all__ = ["Config", "Predictor", "create_predictor", "LLMPredictor",
            "Request", "ServingEngine", "Drafter", "NGramDrafter",
            "ModelDrafter", "AdmissionError", "EngineStalledError",
-           "FaultInjector", "HostTier", "RadixPrefixCache"]
+           "FaultInjector", "HostTier", "RadixPrefixCache",
+           "AdapterStore", "LoraAdapter"]

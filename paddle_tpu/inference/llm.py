@@ -163,7 +163,8 @@ def _flatten_paged_kvs(kvs):
 
 def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call,
                               kv_int8=False,
-                              samp_flags=(False, False, False, False)):
+                              samp_flags=(False, False, False, False),
+                              lora=False):
     """Paged twin of ``_build_decode_block``: the cache is the shared
     block arena plus per-slot block tables instead of per-slot
     contiguous rows.  The tables ride into the scan closure as a
@@ -190,24 +191,48 @@ def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call,
     — the caller may enqueue iteration N+1 feeding them directly and
     force iteration N's outputs to host afterwards (the ServingEngine
     plan/harvest split).  Done rows self-freeze in-trace (pad emits,
-    held lens), which is what makes one-step-stale host truth safe."""
+    held lens), which is what makes one-step-stale host truth safe.
+
+    ``lora=True`` compiles the batched multi-adapter variant: a
+    ``lora`` pytree argument (``{"ids": [B] int32, "a"/"b": {target:
+    stacked arena}}``) is inserted after ``samp`` and the scan traces
+    under an active adapter context (``models/lora.py``) — per-row
+    gathered A/B einsums add each request's low-rank delta inside the
+    attention projections.  The gather is hoisted out of the scan
+    (ids are loop-invariant), and the ``lora=False`` build keeps
+    today's exact signature and program."""
     from .sampling import sampled_decode_scan_body
+    from ..models.lora import gather_lora, lora_context
     _with_params = _param_swapper(model, cfg)
     sampled, _filtered, penalty, _bias = samp_flags
 
-    def block_pure(p_values, tok, lens, done, samp, tables, *flat_arenas):
-        def run():
-            kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
-            pos0 = samp["pos"] if sampled else jnp.zeros_like(lens)
-            pres0 = samp["presence"] if penalty else None
-            (tok_f, lens_f, kvs_f, _pos_f, _pres_f, done_f), toks = \
-                jax.lax.scan(
-                    sampled_decode_scan_body(model, cfg, samp, samp_flags),
-                    (tok, lens, kvs, pos0, pres0, done),
-                    None, length=steps_per_call)
-            return ((toks.T.astype(jnp.int32), tok_f, lens_f, done_f)
-                    + tuple(_flatten_paged_kvs(kvs_f)))
-        return _with_params(p_values, run)
+    def _scan(tok, lens, done, samp, tables, flat_arenas):
+        kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
+        pos0 = samp["pos"] if sampled else jnp.zeros_like(lens)
+        pres0 = samp["presence"] if penalty else None
+        (tok_f, lens_f, kvs_f, _pos_f, _pres_f, done_f), toks = \
+            jax.lax.scan(
+                sampled_decode_scan_body(model, cfg, samp, samp_flags),
+                (tok, lens, kvs, pos0, pres0, done),
+                None, length=steps_per_call)
+        return ((toks.T.astype(jnp.int32), tok_f, lens_f, done_f)
+                + tuple(_flatten_paged_kvs(kvs_f)))
+
+    if lora:
+        def block_pure(p_values, tok, lens, done, samp, lora_planes,
+                       tables, *flat_arenas):
+            def run():
+                with lora_context(gather_lora(lora_planes)):
+                    return _scan(tok, lens, done, samp, tables,
+                                 flat_arenas)
+            return _with_params(p_values, run)
+    else:
+        def block_pure(p_values, tok, lens, done, samp, tables,
+                       *flat_arenas):
+            return _with_params(
+                p_values,
+                lambda: _scan(tok, lens, done, samp, tables,
+                              flat_arenas))
 
     return block_pure
 
@@ -256,7 +281,8 @@ def build_swap_in_scatter(n_arenas):
 
 
 def build_chunk_prefill(model, cfg: GenerationConfig, kv_int8=False,
-                        samp_flags=(False, False, False, False)):
+                        samp_flags=(False, False, False, False),
+                        lora=False):
     """Chunked-prefill program for the paged ServingEngine: ONE prompt
     chunk of ONE sequence (batch-1; the static chunk length is the ids
     shape) computed at global positions ``start .. start+C-1``, K/V
@@ -278,25 +304,46 @@ def build_chunk_prefill(model, cfg: GenerationConfig, kv_int8=False,
     arrays; only the FINAL chunk's ``tok`` is host truth (the
     request's first token), so the engine forces exactly that one —
     non-final chunks are pure enqueues whose compute overlaps
-    subsequent host scheduling."""
+    subsequent host scheduling.
+
+    ``lora=True`` inserts a ``lora`` pytree argument after ``samp``
+    (batch-1 ids: the request's adapter slot) and traces the chunk
+    under an active adapter context — so a LoRA request's PROMPT K/V
+    is computed through its adapter too, exactly what its merged-
+    weights twin would have written (see ``_build_paged_decode_block``
+    for the plane layout; ``lora=False`` keeps today's program)."""
     if cfg.num_beams > 1:
         raise ValueError(
             "chunked prefill is greedy/sampled only — beam search "
             "expands to K cache rows per request, which does not fit a "
             "one-slot-per-request block table")
     from .sampling import sample_rows
+    from ..models.lora import gather_lora, lora_context
     _with_params = _param_swapper(model, cfg)
     penalty = samp_flags[2]
 
-    def chunk_pure(p_values, ids, start, n_valid, tables, samp,
-                   *flat_arenas):
-        def run():
-            kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
-            logits, kvs_f = model.prefill_chunk(ids, start, n_valid, kvs)
-            tok = sample_rows(logits, samp, samp_flags,
-                              samp["presence"] if penalty else None)
-            return (tok,) + tuple(_flatten_paged_kvs(kvs_f))
-        return _with_params(p_values, run)
+    def _chunk(ids, start, n_valid, tables, samp, flat_arenas):
+        kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
+        logits, kvs_f = model.prefill_chunk(ids, start, n_valid, kvs)
+        tok = sample_rows(logits, samp, samp_flags,
+                          samp["presence"] if penalty else None)
+        return (tok,) + tuple(_flatten_paged_kvs(kvs_f))
+
+    if lora:
+        def chunk_pure(p_values, ids, start, n_valid, tables, samp,
+                       lora_planes, *flat_arenas):
+            def run():
+                with lora_context(gather_lora(lora_planes)):
+                    return _chunk(ids, start, n_valid, tables, samp,
+                                  flat_arenas)
+            return _with_params(p_values, run)
+    else:
+        def chunk_pure(p_values, ids, start, n_valid, tables, samp,
+                       *flat_arenas):
+            return _with_params(
+                p_values,
+                lambda: _chunk(ids, start, n_valid, tables, samp,
+                               flat_arenas))
 
     return chunk_pure
 

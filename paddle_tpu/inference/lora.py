@@ -1,0 +1,391 @@
+"""Multi-tenant batched LoRA serving: the paged adapter-weight store.
+
+Serving "millions of users" over one base model means K fine-tuned
+LoRA variants decoded in a single continuous batch (S-LoRA, Sheng et
+al., 2023; Punica, Chen et al., 2023).  The per-dispatch math lives in
+``models/lora.py`` (gathered BGMV einsums over stacked adapter
+arenas); this module owns the MEMORY system those arenas need — the
+adapter-weight twin of the KV block pool's arena + free-list +
+host-tier design (``serving.py`` / ``prefixcache.py``):
+
+- **Stacked device arenas.**  One ``[slots + 1, L, d_in, r_max]`` A
+  arena and one ``[slots + 1, L, r_max, d_out]`` B arena per target
+  projection, at the engine's compute dtype.  The LAST row is the
+  NULL adapter — all zeros, never written (the trash-row convention):
+  base-model rows gather it and their delta is an exact ``+ 0.0``.
+  Ranks below ``r_max`` zero-pad, which is exact for the same reason.
+- **Free list + pins + LRU.**  ``acquire()`` pins an adapter HBM-
+  resident for a request's lifetime (admission -> release at
+  retirement/preemption, refcounted — the BlockPool pin discipline);
+  unpinned residents park in an LRU, still mapped, and are DEMOTED
+  (their slot reclaimed) only when an acquire needs a slot and the
+  free list is dry.  All adapter slots pinned = ``acquire`` returns
+  ``None`` and admission waits, exactly like KV-block exhaustion.
+- **Host tier.**  Registration keeps every adapter's at-rest bytes
+  (arena-dtype numpy rows) in host RAM — adapter weights are
+  immutable, so unlike KV demotion no device gather is needed: the
+  registration copy IS the exact at-rest parcel, demotion just frees
+  the HBM slot, and a later ``acquire`` swaps the SAME bytes back in
+  — byte-identical to never having demoted (asserted by tests that
+  read the arena rows back).  ``serving.lora.*`` instruments report
+  residency and swap traffic.
+
+The ``ServingEngine`` drives this store: ``submit(adapter=...)``
+names the variant, admission acquires the slot (head-of-line, like
+blocks), every dispatch whose riding mix has >= 1 adapter row passes
+``planes()`` + per-row slot ids into the compiled program's gathered
+einsums, and retirement releases the pin.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.lora import LORA_TARGETS, attn_lora_dims
+from ..observability import metrics as obs_metrics
+
+
+@dataclass
+class LoraAdapter:
+    """One named low-rank adapter: ``weights[target] = (A, B)`` with
+    ``A [L, d_in, r]`` and ``B [L, r, d_out]`` numpy arrays (the
+    ``alpha / r`` scaling FOLDED INTO B before construction, so the
+    serving delta is plainly ``(x A) B`` and merging is ``W + A B``).
+    Targets may be any subset of :data:`LORA_TARGETS`; absent targets
+    apply no delta."""
+
+    name: str
+    rank: int
+    weights: Dict[str, Tuple[np.ndarray, np.ndarray]] = \
+        field(default_factory=dict)
+
+    @classmethod
+    def random(cls, config, name: str, rank: int, seed: int = 0,
+               scale: float = 0.1,
+               targets: Tuple[str, ...] = LORA_TARGETS) -> "LoraAdapter":
+        """A synthetic adapter for tests/benches: N(0, scale) A and B
+        over ``targets`` for every layer of ``config`` — deltas big
+        enough to visibly steer logits (so parity tests compare two
+        genuinely different streams), small enough to keep them
+        finite."""
+        dims = attn_lora_dims(config)
+        rng = np.random.default_rng(seed)
+        n_layers = int(config.num_hidden_layers)
+        weights = {}
+        for t in targets:
+            d_in, d_out = dims[t]
+            weights[t] = (
+                rng.normal(0.0, scale,
+                           (n_layers, d_in, rank)).astype(np.float32),
+                rng.normal(0.0, scale,
+                           (n_layers, rank, d_out)).astype(np.float32))
+        return cls(name=name, rank=int(rank), weights=weights)
+
+
+class _AdapterState:
+    """Host-side record of one registered adapter: the at-rest parcel
+    (``rows[target] = (A_pad, B_pad)`` zero-padded to ``r_max`` at the
+    arena dtype — the exact bytes every swap-in uploads), the resident
+    slot (``None`` = host-only) and the pin count."""
+
+    __slots__ = ("name", "rank", "rows", "nbytes", "slot", "pins")
+
+    def __init__(self, name: str, rank: int, rows, nbytes: int):
+        self.name = name
+        self.rank = rank
+        self.rows = rows
+        self.nbytes = nbytes
+        self.slot: Optional[int] = None
+        self.pins = 0
+
+
+class AdapterStore:
+    """Paged adapter-weight arena for one model family (see module
+    docstring).  ``slots`` bounds the HBM-resident adapter count;
+    ``max_rank`` the arena rank width; ``dtype`` must equal the
+    serving engine's compute dtype (the gathered einsums contract
+    against activations of that dtype).  Pass a private ``registry``
+    for isolated instrument assertions."""
+
+    def __init__(self, model, *, slots: int, max_rank: int,
+                 dtype: str = "bfloat16", registry=None):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if max_rank < 1:
+            raise ValueError(f"max_rank must be >= 1, got {max_rank}")
+        if not hasattr(model, "attn_projections"):
+            raise ValueError(
+                f"{type(model).__name__} has no attn_projections() — "
+                f"the model family does not expose the LoRA hook "
+                f"surface (models/lora.py)")
+        cfg = model.config
+        if getattr(cfg, "tensor_parallel", False):
+            raise ValueError(
+                "AdapterStore does not support tensor-parallel models "
+                "yet — the stacked arenas hold full-width projections")
+        self.slots = int(slots)
+        self.max_rank = int(max_rank)
+        self.dtype = jnp.dtype(dtype)
+        self.n_layers = int(cfg.num_hidden_layers)
+        self.dims = attn_lora_dims(cfg)
+        # the null adapter is the LAST row (the trash-row convention):
+        # all-zero, never written, gathered by base-model rows
+        self.null_slot = self.slots
+        self._a = {t: jnp.zeros(
+            (self.slots + 1, self.n_layers, d_in, self.max_rank),
+            self.dtype) for t, (d_in, _) in self.dims.items()}
+        self._b = {t: jnp.zeros(
+            (self.slots + 1, self.n_layers, self.max_rank, d_out),
+            self.dtype) for t, (_, d_out) in self.dims.items()}
+        self._adapters: Dict[str, _AdapterState] = {}
+        self._free: List[int] = list(range(self.slots - 1, -1, -1))
+        self._lru: "OrderedDict[str, bool]" = OrderedDict()
+        self._occupant: Dict[int, str] = {}   # slot -> adapter name
+        r = registry if registry is not None else obs_metrics.get_registry()
+        self.registry = r
+        self._g_hbm = r.gauge(
+            "serving.lora.hbm_adapters",
+            "LoRA adapters currently resident in the HBM adapter "
+            "arenas (hwm = peak residency); the arena capacity is the "
+            "AdapterStore's slots")
+        self._g_host = r.gauge(
+            "serving.lora.host_adapters",
+            "registered LoRA adapters currently resident ONLY in host "
+            "RAM (demoted or never yet acquired) — an acquire swaps "
+            "their at-rest bytes back into a free arena slot")
+        self._c_swaps = r.counter(
+            "serving.lora.swap_ins",
+            "adapter swap-ins: host-RAM parcels uploaded into an HBM "
+            "arena slot at exact at-rest bytes (first admission and "
+            "every re-admission after a demotion)")
+        self._c_swap_bytes = r.counter(
+            "serving.lora.swap_in_bytes",
+            "at-rest adapter bytes (zero-padded stacked A/B planes, "
+            "all targets x layers) uploaded by adapter swap-ins")
+        self._c_gathers = r.counter(
+            "serving.lora.gathers",
+            "compiled serving dispatches (decode block / prefill "
+            "chunk / spec verify) that ran the gathered "
+            "adapter-einsum path because >= 1 riding row selected an "
+            "adapter — against serving.block_dispatches this is the "
+            "LoRA-vs-base dispatch route split")
+        self._update_gauges()
+
+    # -- accounting --
+    def _update_gauges(self):
+        resident = sum(1 for a in self._adapters.values()
+                       if a.slot is not None)
+        self._g_hbm.set(resident)
+        self._g_host.set(len(self._adapters) - resident)
+
+    def count_gather(self):
+        """One dispatch ran the gathered-einsum path (engine hook)."""
+        self._c_gathers.inc()
+
+    def resident(self, name: str) -> bool:
+        a = self._adapters.get(name)
+        return a is not None and a.slot is not None
+
+    def names(self) -> List[str]:
+        return sorted(self._adapters)
+
+    def state(self, name: str) -> Optional[_AdapterState]:
+        return self._adapters.get(name)
+
+    # -- registration --
+    def register(self, adapter: LoraAdapter):
+        """Validate and keep ``adapter``'s at-rest bytes host-side
+        (zero-padded to ``max_rank`` at the arena dtype — the EXACT
+        parcel every later swap-in uploads).  Registration never
+        touches the device; the first ``acquire`` does."""
+        if adapter.name in self._adapters:
+            raise ValueError(
+                f"adapter {adapter.name!r} is already registered")
+        if not 1 <= adapter.rank <= self.max_rank:
+            raise ValueError(
+                f"adapter {adapter.name!r} rank {adapter.rank} outside "
+                f"[1, max_rank={self.max_rank}]")
+        if not adapter.weights:
+            raise ValueError(
+                f"adapter {adapter.name!r} has no target weights")
+        for t in adapter.weights:
+            if t not in self.dims:
+                raise ValueError(
+                    f"adapter {adapter.name!r} targets unknown "
+                    f"projection {t!r} — known: {sorted(self.dims)}")
+        rows = {}
+        nbytes = 0
+        # the parcel covers EVERY target, absent ones as zeros: a slot
+        # upload must overwrite the full slot row set, or a previous
+        # occupant's rows for a target this adapter does not carry
+        # would stay live and silently apply the WRONG delta (the
+        # gather reads all targets unconditionally)
+        for t in self.dims:
+            d_in, d_out = self.dims[t]
+            if t not in adapter.weights:
+                a_pad = np.zeros((self.n_layers, d_in, self.max_rank),
+                                 self.dtype)
+                b_pad = np.zeros((self.n_layers, self.max_rank, d_out),
+                                 self.dtype)
+                rows[t] = (a_pad, b_pad)
+                nbytes += a_pad.nbytes + b_pad.nbytes
+                continue
+            a, b = adapter.weights[t]
+            a = np.asarray(a)
+            b = np.asarray(b)
+            if a.shape != (self.n_layers, d_in, adapter.rank) or \
+                    b.shape != (self.n_layers, adapter.rank, d_out):
+                raise ValueError(
+                    f"adapter {adapter.name!r} target {t!r}: A/B "
+                    f"shapes {list(a.shape)}/{list(b.shape)} do not "
+                    f"match [L={self.n_layers}, d_in={d_in}, "
+                    f"r={adapter.rank}] / [L, r, d_out={d_out}]")
+            a_pad = np.zeros((self.n_layers, d_in, self.max_rank),
+                             self.dtype)
+            b_pad = np.zeros((self.n_layers, self.max_rank, d_out),
+                             self.dtype)
+            a_pad[:, :, :adapter.rank] = a
+            b_pad[:, :adapter.rank, :] = b
+            rows[t] = (a_pad, b_pad)
+            nbytes += a_pad.nbytes + b_pad.nbytes
+        self._adapters[adapter.name] = _AdapterState(
+            adapter.name, adapter.rank, rows, nbytes)
+        self._update_gauges()
+
+    # -- residency --
+    def acquire(self, name: str) -> Optional[int]:
+        """Pin ``name`` HBM-resident and return its slot id (the
+        gather index request rows carry), swapping its at-rest bytes
+        in first when it is host-only — reclaiming the LRU unpinned
+        resident's slot if the free list is dry.  ``None`` = every
+        slot is pinned by running requests (admission waits; pins
+        release at retirement, exactly like KV-block exhaustion).
+        Raises ``KeyError`` for unregistered names (submit validates
+        earlier, so reaching here with an unknown name is a bug)."""
+        a = self._adapters.get(name)
+        if a is None:
+            raise KeyError(f"adapter {name!r} is not registered")
+        if a.slot is not None:
+            if a.pins == 0:
+                self._lru.pop(name, None)
+            a.pins += 1
+            return a.slot
+        if self._free:
+            slot = self._free.pop()
+        elif self._lru:
+            victim, _ = self._lru.popitem(last=False)
+            slot = self._demote(self._adapters[victim])
+        else:
+            return None
+        self._upload(a, slot)
+        a.pins = 1
+        return a.slot
+
+    def release(self, name: str):
+        """Drop one pin; at zero the adapter STAYS resident, parked in
+        the LRU (reclaimable, still mapped — the BlockPool unpin
+        semantics)."""
+        a = self._adapters.get(name)
+        if a is None or a.pins <= 0:
+            raise RuntimeError(
+                f"adapter {name!r} released below pin count 0")
+        a.pins -= 1
+        if a.pins == 0:
+            self._lru[name] = True
+
+    def _demote(self, a: _AdapterState) -> int:
+        """Free a resident unpinned adapter's slot.  Weights are
+        immutable, so the registration parcel already holds the exact
+        at-rest bytes — demotion is pure bookkeeping (no device
+        gather), and the arena rows are left stale-but-unreachable
+        (no request carries the slot id once the occupant moved out;
+        the next upload overwrites them)."""
+        slot = a.slot
+        a.slot = None
+        self._occupant.pop(slot, None)
+        self._update_gauges()
+        return slot
+
+    def _upload(self, a: _AdapterState, slot: int):
+        """Swap ``a``'s at-rest parcel into arena row ``slot`` (one
+        ``.at[slot].set`` per target per A/B plane)."""
+        for t, (a_pad, b_pad) in a.rows.items():
+            self._a[t] = self._a[t].at[slot].set(jnp.asarray(a_pad))
+            self._b[t] = self._b[t].at[slot].set(jnp.asarray(b_pad))
+        a.slot = slot
+        self._occupant[slot] = a.name
+        self._c_swaps.inc()
+        self._c_swap_bytes.inc(a.nbytes)
+        self._update_gauges()
+
+    # -- dispatch surface --
+    def slot_of(self, name: str) -> int:
+        """The resident slot of an ACQUIRED adapter (admission pinned
+        it, so host-only here means a pin was dropped early)."""
+        a = self._adapters.get(name)
+        if a is None or a.slot is None:
+            raise RuntimeError(
+                f"adapter {name!r} is not HBM-resident — dispatch "
+                f"planes may only name acquired (pinned) adapters")
+        return a.slot
+
+    def arena_planes(self) -> dict:
+        """The stacked arena halves of a dispatch's traced ``lora``
+        planes (the engine adds the per-row ``ids``):
+        ``{"a": {target: arena}, "b": {target: arena}}``."""
+        return {"a": dict(self._a), "b": dict(self._b)}
+
+    def arena_row(self, target: str, slot: int):
+        """Read one target's (A, B) arena rows back as numpy — the
+        byte-identical-swap-in assertion surface for tests."""
+        return (np.asarray(self._a[target][slot]),
+                np.asarray(self._b[target][slot]))
+
+    # -- audit --
+    def check(self) -> bool:
+        """Invariant audit (the BlockPool.check discipline): slot
+        bookkeeping is a bijection, pins imply residency, every
+        refcount-0 resident sits in the LRU, the free list holds
+        exactly the unoccupied slots.  Raises listing all
+        violations."""
+        errs = []
+        for name, a in self._adapters.items():
+            if a.pins < 0:
+                errs.append(f"adapter {name}: negative pins {a.pins}")
+            if a.pins > 0 and a.slot is None:
+                errs.append(f"adapter {name}: pinned but not resident")
+            if a.slot is not None and \
+                    self._occupant.get(a.slot) != name:
+                errs.append(
+                    f"adapter {name}: slot {a.slot} occupant says "
+                    f"{self._occupant.get(a.slot)!r}")
+            if a.slot is not None and a.pins == 0 and \
+                    name not in self._lru:
+                errs.append(
+                    f"adapter {name}: resident at pins 0 but not in "
+                    f"the LRU — unreclaimable")
+            if name in self._lru and (a.slot is None or a.pins > 0):
+                errs.append(f"adapter {name}: in the LRU but "
+                            f"{'host-only' if a.slot is None else 'pinned'}")
+        for slot, name in self._occupant.items():
+            if not 0 <= slot < self.slots:
+                errs.append(f"occupant map holds non-arena slot {slot}")
+            if self._adapters.get(name) is None or \
+                    self._adapters[name].slot != slot:
+                errs.append(f"slot {slot}: occupant {name!r} does not "
+                            f"claim it back")
+        want_free = set(range(self.slots)) - set(self._occupant)
+        if set(self._free) != want_free:
+            errs.append(f"free list {sorted(self._free)} != unoccupied "
+                        f"slots {sorted(want_free)}")
+        if len(set(self._free)) != len(self._free):
+            errs.append(f"free list holds duplicates: {self._free}")
+        if errs:
+            raise RuntimeError(
+                "AdapterStore.check failed:\n  " + "\n  ".join(errs))
+        return True

@@ -166,6 +166,33 @@ planes, ledger) ran SERIALLY with device compute.  This engine splits
 - time spent blocking on a PREVIOUS iteration's arrays lands in
   ``serving.step.overlap_seconds`` (never in ``host_seconds``), and
   injected fault stalls in ``serving.fault.stall_seconds``.
+
+**Multi-tenant batched LoRA serving** (``adapter_store=`` +
+``submit(adapter=, tenant=)``): K fine-tuned LoRA variants of the one
+base model decode in the same continuous batch — a paged
+``AdapterStore`` (``inference/lora.py``: stacked per-target A/B
+arenas + free list + pins + LRU + host-tier demotion, the BlockPool
+discipline applied to adapter weights) holds the hot variants in HBM,
+admission pins a request's adapter resident (head-of-line wait when
+every slot is pinned, exactly like block exhaustion), and dispatches
+whose riding mix has >= 1 adapter row compile gathered-BGMV program
+variants (``models/lora.py``): per-row slot ids gather stacked A/B
+and two small einsums add each row's low-rank delta inside the
+attention projections.  Base rows gather the all-zero null row (an
+exact ``+ 0.0``), adapter-free dispatches keep today's exact
+programs, and K=1 batched output is token-for-token the
+merged-weights ``generate()`` of that adapter.  Adapter ids are pure
+host-plan state pinned with the riding set, so the dispatch-ahead
+pipeline carries them one-step-stale with no new sync reason.
+**Fair-share admission** rides along: ``submit(tenant=)`` buckets
+requests, and within a priority/EDF class the candidate order becomes
+deficit-weighted round-robin — the least weight-normalized-served
+tenant admits next (service charged at admission as prompt + budget),
+so a bursty tenant cannot starve a steady one; single-tenant traces
+see a constant fair term and schedule byte-identically to the
+pre-tenant engine.  The goodput ledger and SLO-attainment counters
+carry a per-tenant label, and admit flight-recorder events carry
+``adapter``/``tenant``/``deficit``.
 """
 
 from __future__ import annotations
@@ -473,7 +500,10 @@ class _ServingInstruments:
             "serving.goodput.useful_tokens",
             "dispatched token-positions that produced kept work: "
             "first-time prompt prefill positions and emitted output "
-            "tokens that survive in the request's final stream")
+            "tokens that survive in the request's final stream; the "
+            "tenant label attributes the work to the submitting "
+            "tenant ('default' for tenant-less requests)",
+            labels=("tenant",))
         self.goodput_wasted = r.counter(
             "serving.goodput.wasted_tokens",
             "dispatched token-positions that produced discarded work, "
@@ -484,13 +514,17 @@ class _ServingInstruments:
             "matched token-level but could not map (partial tails, "
             "dropped host parcels, tier-evict holes), 'pad' = grid/"
             "mask padding (chunk-grid tails, post-EOS block tails, "
-            "masked verify lanes)", labels=("reason",))
+            "masked verify lanes); the tenant label attributes the "
+            "waste to the submitting tenant",
+            labels=("reason", "tenant"))
         self.goodput_dispatched = r.counter(
             "serving.goodput.dispatched_tokens",
             "total dispatched token-positions over participating rows "
             "(the _count_kv_sweep convention: vacant/frozen rows are "
-            "excluded) — conservation: useful + wasted == this, "
-            "exactly, by construction of the ledger helper")
+            "excluded), per submitting tenant — conservation: useful "
+            "+ wasted == this, exactly, by construction of the ledger "
+            "helper (and per tenant label too, since every call "
+            "charges one tenant)", labels=("tenant",))
         self.tpot = r.histogram(
             "serving.tpot_seconds",
             "per-output-token decode latency, one observation per "
@@ -549,14 +583,39 @@ class _ServingInstruments:
             "serving.slo.attained",
             "SLO-carrying requests (deadline_s or max_queue_delay_s "
             "set) that finished within their deadline; the class "
-            "label is the priority class (p<N>) — multi-tenant "
-            "serving will label per adapter", labels=("class",))
+            "label is the priority class (p<N>) and the tenant label "
+            "the submitting tenant ('default' when unset) — per-"
+            "tenant SLO attainment is one exporter group-by away",
+            labels=("class", "tenant"))
         self.slo_missed = r.counter(
             "serving.slo.missed",
             "SLO-carrying requests that finished past their deadline "
             "or were shed/timed out before running, by priority "
-            "class; cancelled requests are a user action, not an SLO "
-            "outcome, and count in neither", labels=("class",))
+            "class and submitting tenant; cancelled requests are a "
+            "user action, not an SLO outcome, and count in neither",
+            labels=("class", "tenant"))
+        self.fairshare_served = r.counter(
+            "serving.fairshare.served_tokens",
+            "tokens of service charged to each tenant at admission "
+            "(prompt + decode budget — the reservation the fair-share "
+            "layer accounts, charged when the request leaves the "
+            "queue) — the deficit-weighted round-robin's ledger",
+            labels=("tenant",))
+        self.fairshare_deficit = r.gauge(
+            "serving.fairshare.deficit",
+            "each tenant's fair-share deficit: the most-served "
+            "tenant's weight-normalized service minus this tenant's "
+            "(>= 0; the largest deficit admits next within a "
+            "scheduling class).  0 for every tenant on single-tenant "
+            "traces — the fair-share layer is then inert",
+            labels=("tenant",))
+        self.fairshare_reorders = r.counter(
+            "serving.fairshare.reorders",
+            "admissions where the deficit-weighted round-robin chose "
+            "a candidate that was NOT the FIFO head of the best "
+            "scheduling class — each one is a starvation the plain "
+            "priority/EDF/FIFO order would have inflicted on the "
+            "chosen tenant")
         self._base = {}
         for c in (self.prefills, self.prefill_chunks, self.decode_steps,
                   self.busy_slot_steps, self.block_dispatches,
@@ -576,20 +635,19 @@ class _ServingInstruments:
                   self.goodput_useful, self.goodput_wasted,
                   self.goodput_dispatched,
                   self.async_syncs, self.async_harvests,
-                  self.slo_attained, self.slo_missed):
+                  self.slo_attained, self.slo_missed,
+                  self.fairshare_served, self.fairshare_reorders):
             # total() sums label sets, so labeled counters (cancelled
             # by phase, shed by reason) baseline the same way the
             # unlabeled ones do
             self._base[c.name] = c.total()
-        # per-reason baselines for the wasted-tokens breakdown: the
-        # reason vocabulary is closed (GOODPUT_REASONS), so stats()
-        # can report exact per-reason per-engine deltas on a shared
-        # registry the same way since_init does for totals
-        self._wasted_base = {reason: self.goodput_wasted.value(
-            reason=reason) for reason in GOODPUT_REASONS}
-        # per-reason forced-sync baselines, same shared-registry story
-        # as _wasted_base: the reason vocabulary is closed, so stats()
-        # reports exact per-engine per-reason deltas
+        # per-reason forced-sync baselines: the reason vocabulary is
+        # closed, so stats() reports exact per-engine per-reason
+        # deltas on a shared registry the same way since_init does for
+        # totals.  (The per-reason WASTED-token breakdown moved to a
+        # host-side mirror in the engine when the goodput counters
+        # grew the open-vocabulary tenant label — see
+        # ServingEngine._wasted_reason.)
         self._syncs_base = {reason: self.async_syncs.value(reason=reason)
                             for reason in ASYNC_SYNC_REASONS}
 
@@ -602,11 +660,6 @@ class _ServingInstruments:
         """Counter delta attributable to THIS engine (summed over
         label sets for labeled counters)."""
         return counter.total() - self._base.get(counter.name, 0)
-
-    def wasted_since(self, reason: str) -> float:
-        """Per-reason wasted-tokens delta attributable to THIS engine."""
-        return (self.goodput_wasted.value(reason=reason)
-                - self._wasted_base.get(reason, 0))
 
 
 def _call_quiet(fn, *args):
@@ -997,6 +1050,9 @@ class Request:
     swap: Optional[_SwapRecord] = None
     preempt_count: int = 0
     spec_k: Optional[int] = None       # speculative mode: drafts/verify
+    adapter: Optional[str] = None      # LoRA adapter name (None = base)
+    adapter_slot: Optional[int] = None  # pinned arena slot while admitted
+    tenant: str = "default"            # fair-share accounting bucket
     sampling: Optional[SamplingParams] = None  # None = plain greedy
     samp_base: Optional[np.ndarray] = None     # [2] u32 PRNG base key
     pf_pos: int = 0                    # next prompt position to compute
@@ -1060,7 +1116,8 @@ class ServingEngine:
                  seed=0, static_batching=False, clock=time.perf_counter,
                  registry=None, max_queue=None, enable_preemption=True,
                  fault_injector=None, flight_recorder=None,
-                 async_dispatch=True):
+                 async_dispatch=True, adapter_store=None,
+                 tenant_weights=None):
         self.num_slots = int(num_slots)
         self.max_queue = None if max_queue is None else int(max_queue)
         if self.max_queue is not None and self.max_queue < 1:
@@ -1240,6 +1297,46 @@ class ServingEngine:
         # never by dispatch order — see inference/sampling.py
         self._seed = int(seed)
 
+        # multi-tenant batched LoRA serving (inference/lora.py): the
+        # paged adapter store is engine-external (several engines may
+        # share one); submit(adapter=) names a registered variant,
+        # admission pins its arena slot, every dispatch with >= 1
+        # adapter row compiles/uses the gathered-einsum program
+        # variants.  The store's arenas must be at the serving compute
+        # dtype — the gathered deltas contract against activations.
+        self._adapters = adapter_store
+        if adapter_store is not None:
+            want = jnp.dtype(self.cfg.compute_dtype)
+            if jnp.dtype(adapter_store.dtype) != want:
+                raise ValueError(
+                    f"adapter_store dtype {adapter_store.dtype} != "
+                    f"engine compute_dtype {want} — the gathered LoRA "
+                    f"einsums contract against activations of the "
+                    f"compute dtype")
+            if adapter_store.n_layers != n_layers:
+                raise ValueError(
+                    f"adapter_store holds {adapter_store.n_layers} "
+                    f"layers but the model has {n_layers}")
+        # fair-share admission (deficit-weighted round-robin): per-
+        # tenant token-service accounting; weights scale each tenant's
+        # fair share (2.0 = entitled to twice the service of a
+        # weight-1 tenant).  Single-tenant traces keep every candidate
+        # at one normalized-service value, so the fair term is a
+        # constant and scheduling is byte-identical to priority/EDF/
+        # FIFO (the determinism contract tests assert).
+        self._tenant_weights = {}
+        for t, w in dict(tenant_weights or {}).items():
+            w = float(w)
+            if w <= 0:
+                raise ValueError(
+                    f"tenant_weights[{t!r}] must be > 0, got {w}")
+            self._tenant_weights[str(t)] = w
+        self._tenant_served: dict = {}     # tenant -> tokens charged
+        self._lora_dispatches = 0          # gathered-einsum dispatches
+        # host-side per-reason wasted-token mirror (the goodput
+        # counters' tenant label is open-vocabulary; this keeps the
+        # closed per-reason breakdown exact per engine)
+        self._wasted_reason = {r: 0 for r in GOODPUT_REASONS}
         self._slots: List[Optional[Request]] = [None] * self.num_slots
         self._queue: deque = deque()
         self._prefilling: deque = deque()
@@ -1334,7 +1431,8 @@ class ServingEngine:
         self._m.kv_bytes_swept.inc(rows * self._kv_row_bytes)
 
     # -- goodput ledger --
-    def _ledger(self, useful: int, **wasted: int):
+    def _ledger(self, useful: int, tenant: str = "default",
+                **wasted: int):
         """Account one dispatch's token-positions into the goodput
         ledger.  Conservation (useful + wasted == dispatched) holds BY
         CONSTRUCTION: the dispatched counter is incremented by exactly
@@ -1347,7 +1445,10 @@ class ServingEngine:
         convention: vacant/frozen rows in the same compiled dispatch
         do burn FLOPs, but counting them would make goodput a function
         of slot-pool geometry instead of scheduling quality (both A/B
-        bench arms share the convention, so ratios are unaffected)."""
+        bench arms share the convention, so ratios are unaffected).
+        ``tenant`` attributes the whole call to one tenant (call sites
+        split multi-tenant dispatches per rider), so conservation
+        holds per tenant label too."""
         total = useful
         for reason, n in wasted.items():
             if reason not in GOODPUT_REASONS:
@@ -1364,18 +1465,25 @@ class ServingEngine:
                 f"goodput ledger: negative useful count {useful}")
         if total == 0:
             return
-        self._m.goodput_dispatched.inc(total)
+        self._m.goodput_dispatched.inc(total, tenant=tenant)
         if useful:
-            self._m.goodput_useful.inc(useful)
+            self._m.goodput_useful.inc(useful, tenant=tenant)
         for reason, n in wasted.items():
             if n:
-                self._m.goodput_wasted.inc(n, reason=reason)
+                self._m.goodput_wasted.inc(n, reason=reason,
+                                           tenant=tenant)
+                # host-side per-reason mirror: the tenant label made
+                # the counter's label space open-vocabulary, so the
+                # closed per-reason breakdown stats() reports is kept
+                # exactly here (per engine by construction)
+                self._wasted_reason[reason] += n
 
     @staticmethod
     def _slo_class(req: Request) -> str:
-        """The SLO-attainment class label: the priority class for now
-        (``p<N>``); the multi-tenant adapter work will refine this to
-        per-adapter labels."""
+        """The SLO-attainment class label: the priority class
+        (``p<N>``); the counters carry the submitting tenant as a
+        second label, so per-tenant/per-adapter attainment is one
+        exporter group-by away."""
         return f"p{req.priority}"
 
     def _slo_account(self, req: Request):
@@ -1389,9 +1497,11 @@ class ServingEngine:
         cls = self._slo_class(req)
         if req.state == "finished" and (
                 req.deadline is None or req.finish_time <= req.deadline):
-            self._m.slo_attained.inc(**{"class": cls})
+            self._m.slo_attained.inc(**{"class": cls,
+                                        "tenant": req.tenant})
         elif req.state in ("finished", "timeout", "shed"):
-            self._m.slo_missed.inc(**{"class": cls})
+            self._m.slo_missed.inc(**{"class": cls,
+                                      "tenant": req.tenant})
 
     def _release_blocks(self, req: Request):
         """Unpin every block the request holds and trash its table
@@ -1404,6 +1514,12 @@ class ServingEngine:
             self._pool.unpin(b)
         req.blocks = []
         req.matched = []
+        if req.adapter_slot is not None:
+            # the adapter pin has exactly the blocks' lifetime (held
+            # admission -> retirement/preemption); the None guard
+            # keeps this as idempotent as the block release
+            self._adapters.release(req.adapter)
+            req.adapter_slot = None
         if req.slot is not None:
             self._tables[req.slot] = self._pool.trash
         self._update_block_gauges()
@@ -1552,18 +1668,21 @@ class ServingEngine:
              for i in active for s in range(n)])
         # goodput: each riding row dispatched n positions — tokens up
         # to (and including) a mid-block EOS are useful, the frozen
-        # tail behind it is pad (empty at steps_per_call=1)
-        gp_useful = gp_pad = 0
+        # tail behind it is pad (empty at steps_per_call=1); charged
+        # per rider tenant
+        gp: dict = {}          # tenant -> [useful, pad]
         eos = self.cfg.eos_token_id
-        for i in active:
+        for idx, i in enumerate(active):
             row = toks[i]
             if eos is not None and eos in row:
                 useful_i = int(np.flatnonzero(row == eos)[0]) + 1
             else:
                 useful_i = n
-            gp_useful += useful_i
-            gp_pad += n - useful_i
-        self._ledger(gp_useful, pad=gp_pad)
+            cell = gp.setdefault(p.reqs[idx].tenant, [0, 0])
+            cell[0] += useful_i
+            cell[1] += n - useful_i
+        for tenant, (u, pad) in gp.items():
+            self._ledger(u, tenant=tenant, pad=pad)
         t = self._clock()
         lag = self._step_idx - p.step_idx
         for idx, i in enumerate(active):
@@ -1719,7 +1838,9 @@ class ServingEngine:
                arrival_time=None, spec_decode=None,
                sampling: Optional[SamplingParams] = None,
                priority: int = 0, deadline_s: Optional[float] = None,
-               max_queue_delay_s: Optional[float] = None) -> Request:
+               max_queue_delay_s: Optional[float] = None,
+               adapter: Optional[str] = None,
+               tenant: Optional[str] = None) -> Request:
         """Enqueue one request.  ``prompt_ids`` is a 1-D id array of at
         most ``prompt_len`` tokens (right-padded internally);
         ``arrival_time`` (in ``clock()`` units) lets a trace replay
@@ -1757,7 +1878,18 @@ class ServingEngine:
         entries are first swept to ``"timeout"``, then either some
         queued request of strictly lower class than this arrival is
         displaced (state ``"shed"``) or THIS submit raises
-        ``AdmissionError`` and nothing is enqueued."""
+        ``AdmissionError`` and nothing is enqueued.
+
+        Multi-tenant LoRA: ``adapter=`` names a variant registered in
+        the engine's ``AdapterStore`` — admission pins its arena slot
+        (swapping its weights in from host RAM when demoted) and the
+        request decodes through its gathered low-rank delta,
+        token-exact vs running alone on merged weights.  ``tenant=``
+        names the fair-share accounting bucket (default one shared
+        ``"default"`` bucket = plain FIFO-within-class): within a
+        priority/EDF class, admission order becomes deficit-weighted
+        round-robin over tenants, so one tenant's burst cannot starve
+        another's steady stream."""
         ids = np.asarray(getattr(prompt_ids, "_value", prompt_ids))
         ids = np.asarray(ids).reshape(-1).astype(np.int32)
         if ids.size < 1 or ids.size > self.prompt_len:
@@ -1805,6 +1937,17 @@ class ServingEngine:
                 f"{self.block_len} ({n + m - 1} tokens) but the pool "
                 f"only has num_blocks={self.num_blocks} — it could "
                 f"never be admitted")
+        if adapter is not None:
+            adapter = str(adapter)
+            if self._adapters is None:
+                raise ValueError(
+                    f"submit(adapter={adapter!r}) needs an engine "
+                    f"constructed with adapter_store= (no AdapterStore "
+                    f"is attached)")
+            if self._adapters.state(adapter) is None:
+                raise ValueError(
+                    f"adapter {adapter!r} is not registered in the "
+                    f"adapter store — known: {self._adapters.names()}")
         prio = int(priority)
         if deadline_s is not None and float(deadline_s) <= 0:
             raise ValueError(
@@ -1824,6 +1967,11 @@ class ServingEngine:
                       pad_token_id=self.cfg.pad_token_id)
         req.submit_time = now
         req.spec_k = spec_k
+        req.adapter = adapter
+        req.tenant = "default" if tenant is None else str(tenant)
+        # a waiting tenant must exist in the service ledger at 0 so
+        # the deficit gauges (and the WRR choice) see it immediately
+        self._tenant_served.setdefault(req.tenant, 0)
         req.sampling = sp
         req.priority = prio
         req.deadline = deadline
@@ -2264,6 +2412,15 @@ class ServingEngine:
         dense view the request decoded against before — resumed greedy
         output is bit-identical to never-preempted output."""
         rec = req.swap
+        # the adapter pin was released at preemption (a swapped
+        # request needs no arena residency); re-acquire before any
+        # block work — failure leaves the request a valid swap-list
+        # member, exactly like block exhaustion
+        acquired = False
+        if req.adapter is not None:
+            if self._adapters.acquire(req.adapter) is None:
+                return False
+            acquired = True
         fresh = self._alloc(rec.n_blocks)
         if fresh is None and \
                 not any(r is not None for r in self._slots):
@@ -2273,6 +2430,8 @@ class ServingEngine:
                 self._preempt_for(req, rec.n_blocks):
             fresh = self._alloc(rec.n_blocks)
         if fresh is None:
+            if acquired:
+                self._adapters.release(req.adapter)
             return False
         # the resume REWRITES the slot's host tok/lens carries, so the
         # next decode dispatch must come from host mirrors — harvest
@@ -2298,9 +2457,13 @@ class ServingEngine:
         except BaseException:
             for b in fresh:
                 self._pool.unpin(b)
+            if acquired:
+                self._adapters.release(req.adapter)
             self._update_block_gauges()
             raise
         self._swapped.remove(req)
+        if acquired:
+            req.adapter_slot = self._adapters.slot_of(req.adapter)
         req.blocks = list(fresh)
         req.matched = []
         self._tables[slot] = row
@@ -2473,6 +2636,37 @@ class ServingEngine:
             return 0
         return 1 if span else 2
 
+    # -- fair-share (deficit-weighted round-robin over tenants) --
+    def _fair_norm(self, tenant: str) -> float:
+        """A tenant's weight-normalized service: tokens charged at
+        admission divided by its fair-share weight.  The WRR invariant
+        is "the LEAST-normalized-served tenant in a scheduling class
+        admits next"; integer token counts over deterministic weights
+        make the ordering byte-deterministic."""
+        return (self._tenant_served.get(tenant, 0)
+                / self._tenant_weights.get(tenant, 1.0))
+
+    def _update_deficits(self):
+        """Refresh the per-tenant deficit gauges: the most-served
+        tenant's normalized service minus each tenant's own (>= 0;
+        largest deficit admits next within a class)."""
+        if not self._tenant_served:
+            return
+        top = max(self._fair_norm(t) for t in self._tenant_served)
+        for t in self._tenant_served:
+            self._m.fairshare_deficit.set(
+                round(top - self._fair_norm(t), 3), tenant=t)
+
+    def _charge_tenant(self, req: Request):
+        """Charge a leaving-the-queue request's reservation (prompt +
+        decode budget) to its tenant's service ledger — the moment the
+        WRR ordering advances."""
+        cost = req.seq_len + req.max_new_tokens
+        self._tenant_served[req.tenant] = \
+            self._tenant_served.get(req.tenant, 0) + cost
+        self._m.fairshare_served.inc(cost, tenant=req.tenant)
+        self._update_deficits()
+
     def _admit(self, now: float, out: List[Request]):
         """Admit the best-class candidates into vacant slots.  The
         candidate order is priority-then-EDF over the swap list plus
@@ -2494,31 +2688,47 @@ class ServingEngine:
                 any(r is not None for r in self._slots):
             return
         # candidate order: _sched_key (priority, then EDF) extended by
-        # a residency rank — swapped requests first within a class
-        # (they hold host memory and are closest to done), then queued
-        # requests whose matched prefix is HBM-resident, then host-
-        # resident, then cold.  The rank is a STRICT tie-break inside
-        # a scheduling class and the sort is stable over submission
-        # order, so a trace with no shared prefixes (or a non-radix
-        # engine, where the rank is constant) schedules byte-
-        # identically to the pre-tiered engine.  Ranks are probed once
-        # per candidate per _admit CALL (memoized — not once per sort
-        # comparison or per freed slot): the tree only improves
+        # the FAIR-SHARE term and a residency rank — inside a class,
+        # the least-normalized-served tenant admits first (deficit-
+        # weighted round-robin; a constant on single-tenant traces, so
+        # they schedule byte-identically to the pre-tenant engine),
+        # then swapped requests (they hold host memory and are closest
+        # to done), then queued requests whose matched prefix is HBM-
+        # resident, then host-resident, then cold.  The rank is a
+        # STRICT tie-break inside a (class, tenant-deficit) bucket and
+        # the sort is stable over submission order, so a trace with no
+        # shared prefixes (or a non-radix engine, where the rank is
+        # constant) keeps FIFO within its bucket.  Ranks are probed
+        # once per candidate per _admit CALL (memoized — not once per
+        # sort comparison or per freed slot): the tree only improves
         # mid-call (promotion/registration), and a call-stale rank
-        # costs order quality, never correctness.
+        # costs order quality, never correctness.  The fair term is
+        # NOT memoized — each admission charges its tenant, and the
+        # re-sort on the next loop iteration must see the new ledger
+        # (that is the round-robin).
         ranks: dict = {}
 
-        def _cand_key(r):
-            base = self._sched_key(r)
+        def _state_rank(r):
             if r.state == "swapped":
-                return base + (-1,)
+                return -1
             if self._radix is None:
-                return base + (0,)
+                return 0
             rank = ranks.get(r.request_id)
             if rank is None:
                 rank = self._residency_rank(r)
                 ranks[r.request_id] = rank
-            return base + (rank,)
+            return rank
+
+        def _cand_key(r):
+            return (self._sched_key(r) + (self._fair_norm(r.tenant),)
+                    + (_state_rank(r),))
+
+        def _fifo_key(r):
+            # the pre-fair ordering (priority/EDF/residency/FIFO) —
+            # what the head would have been without the WRR term; a
+            # divergence is a counted "reorder" (a starvation the
+            # plain order would have inflicted)
+            return self._sched_key(r) + (_state_rank(r),)
 
         while True:
             slot = next((i for i, r in enumerate(self._slots)
@@ -2530,9 +2740,24 @@ class ServingEngine:
             if not cands:
                 break
             req = cands[0]
+            # a "reorder" = the WRR term promoted a different request
+            # over the plain priority/EDF/FIFO head (the starvation
+            # the old order would have inflicted); only possible — and
+            # only worth the O(n) head scan — with > 1 tenant.  min()
+            # over the pre-sort submission order IS the stable-sorted
+            # head (first minimal element wins ties), without a second
+            # full sort on the admission path.
+            reorder = (len(self._tenant_served) > 1 and
+                       req is not min(self._swapped + arrived,
+                                      key=_fifo_key))
             if req.state == "swapped":
                 if not self._try_resume(req, slot):
                     break
+                if reorder:
+                    # a fairness-promoted RESUME is a reorder too —
+                    # the counter covers every admission decision, not
+                    # just queue departures
+                    self._m.fairshare_reorders.inc()
                 continue
             if self._radix is not None:
                 # the tree may have grown while this request queued (a
@@ -2554,6 +2779,16 @@ class ServingEngine:
                 n_hbm = len(req.matched)
             else:
                 n_hbm = 0
+            # adapter residency before block sizing: the gathered
+            # dispatch needs the arena slot pinned for the request's
+            # whole admitted life.  None = every slot is pinned by
+            # running requests — head-of-line wait, exactly like KV-
+            # block exhaustion (pins release as requests retire).
+            acquired = False
+            if req.adapter is not None:
+                if self._adapters.acquire(req.adapter) is None:
+                    break
+                acquired = True
             total = self._blocks_needed(req.seq_len, req.max_new_tokens)
             fresh = self._alloc(total - n_hbm)
             if fresh is None and \
@@ -2572,6 +2807,8 @@ class ServingEngine:
                     self._preempt_for(req, total - n_hbm):
                 fresh = self._alloc(total - n_hbm)
             if fresh is None:
+                if acquired:
+                    self._adapters.release(req.adapter)
                 break                     # pool drains as requests retire
             matchable = ((req.seq_len - 1) // self.block_len
                          if self.enable_prefix_cache else 0)
@@ -2579,9 +2816,15 @@ class ServingEngine:
                 # host-resident span entries swap their exact at-rest
                 # bytes back into the leading fresh blocks (one batched
                 # scatter); a raise leaves the request queued and the
-                # fresh blocks unpinned (_map_radix_span's rollback)
-                mapped, fresh, n_promoted = \
-                    self._map_radix_span(req, fresh)
+                # fresh blocks unpinned (_map_radix_span's rollback) —
+                # and the adapter pin rolls back with them
+                try:
+                    mapped, fresh, n_promoted = \
+                        self._map_radix_span(req, fresh)
+                except BaseException:
+                    if acquired:
+                        self._adapters.release(req.adapter)
+                    raise
                 req.blocks = mapped + fresh
                 hit_tokens = len(mapped) * self.block_len
                 self._m.prefix_hit_tokens.inc(hit_tokens)
@@ -2617,6 +2860,27 @@ class ServingEngine:
                         tokens=len(mapped) * self.block_len,
                         partial=0)
             self._queue.remove(req)
+            if acquired:
+                req.adapter_slot = self._adapters.slot_of(req.adapter)
+            # fair-share bookkeeping at the admission decision: the
+            # deficit is this tenant's shortfall vs the most-served
+            # tenant BEFORE this admission's charge moved the ledger
+            # (a deterministic token count, so the admit event stays
+            # replay-identical); tenant-less default traces skip the
+            # extra attrs entirely and keep their event streams
+            # byte-identical to the pre-tenant engine
+            extra = {}
+            if req.adapter is not None:
+                extra["adapter"] = req.adapter
+            if req.tenant != "default" or reorder:
+                top = max(self._fair_norm(t)
+                          for t in self._tenant_served)
+                extra["tenant"] = req.tenant
+                extra["deficit"] = round(
+                    top - self._fair_norm(req.tenant), 3)
+            if reorder:
+                self._m.fairshare_reorders.inc()
+            self._charge_tenant(req)
             self._m.prefix_hits.inc(len(mapped))
             self._m.prefix_misses.inc(matchable - len(mapped))
             row = np.full((self.max_blocks,), self._pool.trash, np.int32)
@@ -2634,7 +2898,8 @@ class ServingEngine:
             _span_instant("serving.request.admit", request=req.request_id,
                           slot=slot, matched_blocks=len(mapped))
             self._fr.emit("admit", req.request_id, self._step_idx,
-                          slot=slot, matched_blocks=len(mapped))
+                          slot=slot, matched_blocks=len(mapped),
+                          **extra)
         self._m.slot_occupancy.set(
             sum(r is not None for r in self._slots))
 
@@ -2707,6 +2972,34 @@ class ServingEngine:
             samp["bias"] = jnp.asarray(bias_p)
         return flags, samp
 
+    def _build_lora(self, reqs):
+        """The ``lora`` plane pytree of one dispatch (the gathered-
+        einsum arguments of ``models/lora.py``): ``reqs`` is the
+        dispatch's batch view, exactly like ``_build_samp``'s.
+        Returns ``(lora_on, planes)`` — ``(False, None)`` when no
+        riding row selected an adapter, so adapter-free dispatches
+        keep compiling (and running) today's exact programs.  Rows
+        without an adapter gather the arenas' all-zero NULL row: their
+        delta is an exact ``+ 0.0``, which is what keeps base rows in
+        a mixed batch token-identical to the non-LoRA engine.  Adapter
+        ids are pure host-plan state (pinned at admission, constant
+        for the request's admitted life), so the dispatch-ahead
+        pipeline's one-step-stale planning carries them with no new
+        sync reason — a deferred harvest can never change which
+        adapter a riding row uses."""
+        if self._adapters is None or not any(
+                r is not None and r.adapter is not None for r in reqs):
+            return False, None
+        ids = np.full((len(reqs),), self._adapters.null_slot, np.int32)
+        for i, r in enumerate(reqs):
+            if r is not None and r.adapter is not None:
+                ids[i] = self._adapters.slot_of(r.adapter)
+        planes = self._adapters.arena_planes()
+        planes["ids"] = jnp.asarray(ids)
+        self._adapters.count_gather()
+        self._lora_dispatches += 1
+        return True, planes
+
     def _count_sample_route(self, reqs_tokens):
         """Classify emitted tokens into the serving.sample.* route
         counters; ``reqs_tokens`` is (request, n_emitted) pairs."""
@@ -2748,16 +3041,18 @@ class ServingEngine:
             # entry, the slot's tok/lens carries) — the pipeline syncs
             self._flush_async("chunk_final")
         flags, samp = self._build_samp([req])
+        lora_on, lora_planes = self._build_lora([req])
+        lora_args = (lora_planes,) if lora_on else ()
         t0 = self._clock()
         with _span("serving.prefill", request=req.request_id,
                    slot=req.slot, start=start):
             outp = _call_quiet(
-                self._chunk_fn(flags), self._pb,
+                self._chunk_fn(flags, lora_on), self._pb,
                 jnp.asarray(req.chunk_ids[None, start:start + c]),
                 jnp.asarray(start, jnp.int32),
                 jnp.asarray(req.seq_len, jnp.int32),
                 jnp.asarray(self._tables[req.slot][None, :]), samp,
-                *self._arenas)
+                *lora_args, *self._arenas)
             self._arenas = list(outp[1:])
             # a non-final chunk's sampled token is meaningless (the
             # engine never advances decode state from it): the
@@ -2778,7 +3073,8 @@ class ServingEngine:
         valid = min(start + c, req.seq_len) - start
         rc = max(0, (min(start + valid, req.gp_recompute_to)
                      - max(start, req.gp_recompute_from)))
-        self._ledger(valid - rc, recompute_cache=rc, pad=c - valid)
+        self._ledger(valid - rc, tenant=req.tenant,
+                     recompute_cache=rc, pad=c - valid)
         self._fr.emit("prefill_chunk", req.request_id, self._step_idx,
                       start=start, tokens=valid)
         req.pf_pos = start + c
@@ -2837,26 +3133,37 @@ class ServingEngine:
         # reads its own host-side truth (req.tokens / self._lens)
         self._done[slot] = req.spec_k is not None
 
-    def _chunk_fn(self, flags):
-        fn = self._chunk_fns.get(flags)
+    def _lora_donate(self, lora_on: bool):
+        """Arena donation positions of a serving program: the ``lora``
+        pytree argument (inserted after ``samp``) shifts the flat-
+        arena positions by one.  The adapter arenas themselves are
+        READ-ONLY program inputs and are never donated — a swap-in
+        between dispatches replaces them functionally."""
+        if not lora_on:
+            return self._donate
+        return tuple(p + 1 for p in self._donate)
+
+    def _chunk_fn(self, flags, lora_on: bool = False):
+        fn = self._chunk_fns.get((flags, lora_on))
         if fn is None:
             fn = jax.jit(
                 build_chunk_prefill(self._model, self.cfg,
                                     kv_int8=self._kv_int8,
-                                    samp_flags=flags),
-                donate_argnums=self._donate)
-            self._chunk_fns[flags] = fn
+                                    samp_flags=flags, lora=lora_on),
+                donate_argnums=self._lora_donate(lora_on))
+            self._chunk_fns[(flags, lora_on)] = fn
         return fn
 
-    def _block_fn(self, steps: int, flags):
-        fn = self._blocks.get((steps, flags))
+    def _block_fn(self, steps: int, flags, lora_on: bool = False):
+        fn = self._blocks.get((steps, flags, lora_on))
         if fn is None:
             fn = jax.jit(
                 _build_paged_decode_block(self._model, self.cfg, steps,
                                           kv_int8=self._kv_int8,
-                                          samp_flags=flags),
-                donate_argnums=self._donate)
-            self._blocks[(steps, flags)] = fn
+                                          samp_flags=flags,
+                                          lora=lora_on),
+                donate_argnums=self._lora_donate(lora_on))
+            self._blocks[(steps, flags, lora_on)] = fn
         return fn
 
     def _block_rides(self, i: int, r: Request) -> bool:
@@ -2886,15 +3193,15 @@ class ServingEngine:
                 tbl[i] = self._tables[i]
         return tbl
 
-    def _verify_fn(self, steps: int, flags):
-        fn = self._verify_fns.get((steps, flags))
+    def _verify_fn(self, steps: int, flags, lora_on: bool = False):
+        fn = self._verify_fns.get((steps, flags, lora_on))
         if fn is None:
             fn = jax.jit(
                 build_spec_verify(self._model, self.cfg, steps,
                                   kv_int8=self._kv_int8,
-                                  samp_flags=flags),
-                donate_argnums=self._donate)
-            self._verify_fns[(steps, flags)] = fn
+                                  samp_flags=flags, lora=lora_on),
+                donate_argnums=self._lora_donate(lora_on))
+            self._verify_fns[(steps, flags, lora_on)] = fn
         return fn
 
     def _spec_verify(self, out: List[Request]):
@@ -2967,16 +3274,18 @@ class ServingEngine:
             n_valid[i] = 1 + d.size
             tbl[i] = self._tables[i]
         spec_set = set(spec)
-        flags, samp = self._build_samp(
-            [r if i in spec_set else None
-             for i, r in enumerate(self._slots)])
+        riding = [r if i in spec_set else None
+                  for i, r in enumerate(self._slots)]
+        flags, samp = self._build_samp(riding)
+        lora_on, lora_planes = self._build_lora(riding)
+        lora_args = (lora_planes,) if lora_on else ()
         t0 = self._clock()
         with _span("serving.spec_verify", width=width, active=len(spec)):
             outp = _call_quiet(
-                self._verify_fn(width, flags), self._pb,
+                self._verify_fn(width, flags, lora_on), self._pb,
                 jnp.asarray(toks),
                 jnp.asarray(self._lens), jnp.asarray(n_valid),
-                jnp.asarray(tbl), samp, *self._arenas)
+                jnp.asarray(tbl), samp, *lora_args, *self._arenas)
             if flags[0]:
                 # sampled mix: the verify also returned the position-
                 # keyed stochastic-sampling draws ([B, width] each)
@@ -2994,7 +3303,7 @@ class ServingEngine:
         self._count_kv_sweep([int(self._lens[i]) + width - 1
                               for i in spec])
         t = self._clock()
-        gp_useful = gp_reject = gp_pad = 0
+        gp: dict = {}          # tenant -> [useful, spec_reject, pad]
         for i in spec:
             req = self._slots[i]
             sp = req.sampling
@@ -3016,9 +3325,10 @@ class ServingEngine:
             # back behind the lens) are spec_reject, the masked tail
             # past n_valid is pad
             n_val = int(n_valid[i])
-            gp_useful += len(emitted)
-            gp_reject += n_val - len(emitted)
-            gp_pad += width - n_val
+            cell = gp.setdefault(req.tenant, [0, 0, 0])
+            cell[0] += len(emitted)
+            cell[1] += n_val - len(emitted)
+            cell[2] += width - n_val
             req.tokens.extend(emitted)
             req.remaining -= len(emitted)
             self._lens[i] += len(emitted)
@@ -3036,7 +3346,8 @@ class ServingEngine:
                 self._done[i] = True
                 self._release_blocks(req)
                 self._finish(req, t, out)
-        self._ledger(gp_useful, spec_reject=gp_reject, pad=gp_pad)
+        for tenant, (u, rej, pad) in gp.items():
+            self._ledger(u, tenant=tenant, spec_reject=rej, pad=pad)
 
     def step(self, now: Optional[float] = None) -> List[Request]:
         """One scheduler iteration: sweep queue-delay timeouts and
@@ -3175,6 +3486,11 @@ class ServingEngine:
         riding = [self._slots[i] if i in active_set else None
                   for i in range(self.num_slots)]
         flags, samp = self._build_samp(riding, pos_lag=lag)
+        # adapter ids are host-plan state pinned with the riding set
+        # (which cannot change while a harvest is deferred), so the
+        # dispatch-ahead pipeline carries them one-step-stale for free
+        lora_on, lora_planes = self._build_lora(riding)
+        lora_args = (lora_planes,) if lora_on else ()
         pre_lens = np.array(self._lens)
         if pend is not None:
             # the riding set equals the pending set (checked above),
@@ -3192,8 +3508,8 @@ class ServingEngine:
         t_blk = self._clock()
         with _span("serving.decode_block", steps=n, active=len(active)):
             out = _call_quiet(
-                self._block_fn(n, flags),
-                self._pb, tok_in, lens_in, done_in, samp,
+                self._block_fn(n, flags, lora_on),
+                self._pb, tok_in, lens_in, done_in, samp, *lora_args,
                 jnp.asarray(self._decode_tables()), *self._arenas)
         self._arenas = list(out[4:])
         self._disp_s += self._clock() - t_blk
@@ -3460,13 +3776,24 @@ class ServingEngine:
             "wasted_tokens": wasted,
             "dispatched_tokens": dispatched,
             "goodput": (useful / dispatched if dispatched else 0.0),
-            "wasted_by_reason": {
-                reason: int(self._m.wasted_since(reason))
-                for reason in GOODPUT_REASONS},
+            "wasted_by_reason": dict(self._wasted_reason),
             "mean_tpot_s": (sum(tpots) / len(tpots)) if tpots else None,
             "slo_attained": int(
                 self._m.since_init(self._m.slo_attained)),
             "slo_missed": int(self._m.since_init(self._m.slo_missed)),
+            # multi-tenant fair share + batched LoRA: the per-tenant
+            # service ledger the deficit-WRR orders by (tokens charged
+            # at admission), the count of admissions where fairness
+            # overrode plain FIFO, and the gathered-einsum dispatch
+            # count (the LoRA-vs-base route split)
+            "tenant_served_tokens": dict(self._tenant_served),
+            "fair_reorders": int(
+                self._m.since_init(self._m.fairshare_reorders)),
+            "lora_dispatches": self._lora_dispatches,
+            "adapters_resident": (
+                None if self._adapters is None else sum(
+                    1 for name in self._adapters.names()
+                    if self._adapters.resident(name))),
             # dispatch-ahead pipeline: forced early harvests by closed
             # reason vocabulary vs harvests that completed AFTER the
             # next dispatch was enqueued (the overlap wins).  While a

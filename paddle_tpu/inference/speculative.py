@@ -225,7 +225,8 @@ def accept_drafts(greedy_row, drafts,
 
 
 def build_spec_verify(model, cfg, steps: int, kv_int8: bool = False,
-                      samp_flags=(False, False, False, False)):
+                      samp_flags=(False, False, False, False),
+                      lora=False):
     """The compiled verifier program: ONE target forward scores
     ``steps`` positions per slot (the last emitted token plus up to
     ``steps - 1`` draft candidates) against the paged KV arena.
@@ -260,7 +261,14 @@ def build_spec_verify(model, cfg, steps: int, kv_int8: bool = False,
     maintains.  Signature:
     ``(p_values, toks [B, C], lens [B], n_valid [B],
     tables [B, max_blocks], samp, *flat_arenas) ->
-    (greedy [B, C][, u, accept_p, resample, sample], *flat_arenas)``."""
+    (greedy [B, C][, u, accept_p, resample, sample], *flat_arenas)``.
+
+    ``lora=True`` inserts a ``lora`` pytree argument after ``samp``
+    (per-row adapter slot ids + stacked arenas; see
+    ``_build_paged_decode_block``) and traces the verify under an
+    active adapter context — each spec row's draft positions are
+    scored by ITS adapter's target distribution, so greedy acceptance
+    stays token-exact against that adapter's sequential stream."""
     if cfg.num_beams > 1:
         raise ValueError(
             "speculative verification does not support beam search — "
@@ -274,23 +282,37 @@ def build_spec_verify(model, cfg, steps: int, kv_int8: bool = False,
             "forward (mask state is host-side and per emitted token)")
     from .llm import _flatten_paged_kvs, _pack_paged_kvs, _param_swapper
     from .sampling import spec_greedy_rows, spec_sampling_draws
+    from ..models.lora import gather_lora, lora_context
 
     _with_params = _param_swapper(model, cfg)
     sampled, _filtered, penalty, _bias = samp_flags
 
-    def verify_pure(p_values, toks, lens, n_valid, tables, samp,
-                    *flat_arenas):
-        def run():
-            kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
-            logits, kvs_f = model.verify_step(toks, lens, n_valid, kvs)
-            pres = samp["presence"] if penalty else None
-            if sampled:
-                draws = spec_sampling_draws(logits, toks, samp,
-                                            samp_flags, pres)
-                return draws + tuple(_flatten_paged_kvs(kvs_f))
-            greedy = spec_greedy_rows(logits, toks, samp, samp_flags,
-                                      pres)
-            return (greedy,) + tuple(_flatten_paged_kvs(kvs_f))
-        return _with_params(p_values, run)
+    def _verify(toks, lens, n_valid, tables, samp, flat_arenas):
+        kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
+        logits, kvs_f = model.verify_step(toks, lens, n_valid, kvs)
+        pres = samp["presence"] if penalty else None
+        if sampled:
+            draws = spec_sampling_draws(logits, toks, samp,
+                                        samp_flags, pres)
+            return draws + tuple(_flatten_paged_kvs(kvs_f))
+        greedy = spec_greedy_rows(logits, toks, samp, samp_flags,
+                                  pres)
+        return (greedy,) + tuple(_flatten_paged_kvs(kvs_f))
+
+    if lora:
+        def verify_pure(p_values, toks, lens, n_valid, tables, samp,
+                        lora_planes, *flat_arenas):
+            def run():
+                with lora_context(gather_lora(lora_planes)):
+                    return _verify(toks, lens, n_valid, tables, samp,
+                                   flat_arenas)
+            return _with_params(p_values, run)
+    else:
+        def verify_pure(p_values, toks, lens, n_valid, tables, samp,
+                        *flat_arenas):
+            return _with_params(
+                p_values,
+                lambda: _verify(toks, lens, n_valid, tables, samp,
+                                flat_arenas))
 
     return verify_pure

@@ -27,6 +27,7 @@ from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy)
 from .generation import GenerationMixin
+from .lora import maybe_lora
 
 
 @dataclass
@@ -94,9 +95,13 @@ def _linear_cls(config, kind):
 
 
 class LlamaAttention(nn.Layer):
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, layer_idx: int = 0):
         super().__init__()
         self.config = config
+        # which row of the stacked per-layer LoRA arenas this
+        # attention's projections read (models/lora.py; inert — a
+        # plain Python int — outside an active adapter context)
+        self.layer_idx = int(layer_idx)
         h = config.hidden_size
         self.num_heads = config.num_attention_heads
         self.num_kv_heads = config.num_key_value_heads
@@ -117,13 +122,21 @@ class LlamaAttention(nn.Layer):
             self.v_proj = nn.Linear(h, kv_out, bias_attr=False)
             self.o_proj = nn.Linear(h, h, bias_attr=False)
 
+    def _o(self, t):
+        """Output projection with the per-row LoRA delta (no-op
+        outside an adapter context) — the one o_proj site every
+        attention path shares."""
+        return maybe_lora(self.o_proj(t), t, "o_proj", self.layer_idx)
+
     def _qkv_rope(self, x, position_ids=None):
         """Project + rotate.  Head counts derive from the projected width
         so tensor-parallel shards (local heads) reshape correctly."""
         b, s, _ = x.shape
-        q = self.q_proj(x)
-        k = self.k_proj(x)
-        v = self.v_proj(x)
+        # per-row LoRA deltas (batched multi-adapter serving): no-ops
+        # outside an active adapter context — see models/lora.py
+        q = maybe_lora(self.q_proj(x), x, "q_proj", self.layer_idx)
+        k = maybe_lora(self.k_proj(x), x, "k_proj", self.layer_idx)
+        v = maybe_lora(self.v_proj(x), x, "v_proj", self.layer_idx)
         hq = q.shape[-1] // self.head_dim
         hkv = k.shape[-1] // self.head_dim
         q = q.reshape([b, s, hq, self.head_dim])
@@ -149,7 +162,7 @@ class LlamaAttention(nn.Layer):
         # the O and LSE residuals are; a tag here would save a second
         # copy of O)
         out = out.reshape([b, s, -1])
-        out = self.o_proj(out)
+        out = self._o(out)
         return (out, cache) if cache is not None else out
 
     def prefill(self, x, position_ids=None):
@@ -158,7 +171,7 @@ class LlamaAttention(nn.Layer):
         b, s, _ = x.shape
         q, k, v = self._qkv_rope(x, position_ids)
         out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
-        out = self.o_proj(out.reshape([b, s, -1]))
+        out = self._o(out.reshape([b, s, -1]))
         return out, (k._value, v._value)
 
     def decode_step(self, x, kv, lens):
@@ -204,7 +217,7 @@ class LlamaAttention(nn.Layer):
             out = cached_decode_attention(q._value[:, 0], k_cache, v_cache,
                                           lens)
             kv = (k_cache, v_cache)
-        out = self.o_proj(Tensor(out[:, None, :]))
+        out = self._o(Tensor(out[:, None, :]))
         return out, kv
 
     def chunk_step(self, x, kv, start, n_valid):
@@ -242,7 +255,7 @@ class LlamaAttention(nn.Layer):
                                          tables, start.reshape(1))
             new_kv = (k_arena, v_arena, tables)
         from ..core.tensor import Tensor
-        out = self.o_proj(Tensor(out.reshape(b, c, -1)))
+        out = self._o(Tensor(out.reshape(b, c, -1)))
         return out, new_kv
 
     def verify_step(self, x, kv, lens, n_valid):
@@ -283,7 +296,7 @@ class LlamaAttention(nn.Layer):
                                                tables, lens)
             new_kv = (k_arena, v_arena, tables)
         from ..core.tensor import Tensor
-        out = self.o_proj(Tensor(out.reshape(b, c, -1)))
+        out = self._o(Tensor(out.reshape(b, c, -1)))
         return out, new_kv
 
 
@@ -318,7 +331,7 @@ class LlamaMLP(nn.Layer):
 class LlamaDecoderLayer(nn.Layer):
     def __init__(self, config: LlamaConfig, layer_idx: int = 0):
         super().__init__()
-        self.self_attn = LlamaAttention(config)
+        self.self_attn = LlamaAttention(config, layer_idx=layer_idx)
         self.mlp = LlamaMLP(config)
         self.input_layernorm = nn.RMSNorm(config.hidden_size,
                                           config.rms_norm_eps)
@@ -425,6 +438,16 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
             loss = LlamaPretrainingCriterion(self.config)(logits, labels)
             return loss, logits
         return logits
+
+    def attn_projections(self):
+        """Per-layer ``{target: Linear}`` views of the attention
+        projections, in layer order — the LoRA surface (adapter merge
+        oracle + AdapterStore shape validation; ``models/lora.py``)."""
+        return [{"q_proj": l.self_attn.q_proj,
+                 "k_proj": l.self_attn.k_proj,
+                 "v_proj": l.self_attn.v_proj,
+                 "o_proj": l.self_attn.o_proj}
+                for l in self.llama.layers]
 
     # -- GenerationMixin surface (models/generation.py; the reference
     # fused_multi_transformer_op.cu decode-serving role) --

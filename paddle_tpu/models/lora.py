@@ -1,0 +1,160 @@
+"""LoRA math for batched multi-adapter serving: the gathered
+(BGMV-style) low-rank delta and the trace-time adapter context.
+
+A LoRA adapter replaces a projection ``y = x W`` with
+``y = x W + x A B`` (``A: [d_in, r]``, ``B: [r, d_out]``, the
+``alpha / r`` scaling folded into ``B`` at registration).  Serving K
+fine-tuned variants of one base model in a single continuous batch
+needs that delta PER ROW: row ``b`` of a decode dispatch applies the
+adapter its request selected, other rows apply theirs (or none), and
+the base matmul ``x W`` stays one shared batched op.  The Punica BGMV
+formulation does this with GATHERED einsums over stacked adapter
+weights — per-row adapter ids index stacked ``[slots+1, L, d_in, r]``
+/ ``[slots+1, L, r, d_out]`` arenas, and two small einsums contract
+the gathered stacks:
+
+    h     = einsum('b...i,bir->b...r', x, A_stack[ids][:, layer])
+    delta = einsum('b...r,bro->b...o', h, B_stack[ids][:, layer])
+
+The arenas' LAST row is the NULL adapter (all zeros, never written —
+the adapter-arena twin of the KV pool's trash row): base-model rows
+gather zeros and their delta is an exact ``+ 0.0``, so a mixed batch
+leaves base rows' argmax untouched.  Rank is zero-padded to the arena
+width, which is exact for the same reason.
+
+**How the delta reaches the model.**  The serving programs are traced
+through the models' unchanged ``decode_step`` / ``chunk_step`` /
+``verify_step`` signatures, so the per-dispatch adapter planes ride a
+TRACE-TIME context instead of new arguments on every layer: the
+program builder gathers the stacks from its traced ``lora`` argument
+and wraps the model call in :func:`lora_context`; the attention
+projections call :func:`maybe_lora` (a no-op outside any context) to
+add their row's delta.  The context is plain Python state consulted
+during tracing only — training forwards, ``generate()`` and every
+non-LoRA serving program never see it and compile byte-identical
+programs.
+
+``merged_adapter`` is the parity oracle's tool: it folds ``A @ B``
+into the model's projection weights in place (and restores them on
+exit), so a per-request ``generate()`` with merged weights is the
+"run alone with its adapter" reference the batched gathered path is
+asserted token-exact against.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# the attention projections LoRA targets (the classic q/k/v/o set);
+# adapter weight dicts and the AdapterStore arenas are keyed by these
+LORA_TARGETS = ("q_proj", "k_proj", "v_proj", "o_proj")
+
+
+def attn_lora_dims(config) -> Dict[str, Tuple[int, int]]:
+    """``target -> (d_in, d_out)`` for a GQA attention stack described
+    by ``config`` (``hidden_size``, ``num_key_value_heads``,
+    ``head_dim``) — the shape contract between adapters, the
+    AdapterStore arenas and the model's projection hooks."""
+    h = int(config.hidden_size)
+    kv_out = int(config.num_key_value_heads) * int(config.head_dim)
+    return {"q_proj": (h, h), "k_proj": (h, kv_out),
+            "v_proj": (h, kv_out), "o_proj": (h, h)}
+
+
+# the active trace-time context: {target: (Ag, Bg)} with
+# Ag [B, L, d_in, r] / Bg [B, L, r, d_out] — already GATHERED per
+# dispatch row.  Module state, not a traced value: it is only ever
+# consulted while a serving program builder is tracing.
+_ACTIVE: Optional[Dict[str, Tuple]] = None
+
+
+def gather_lora(planes) -> Dict[str, Tuple]:
+    """Gather per-row adapter stacks from a dispatch's traced ``lora``
+    planes: ``planes = {"ids": [B] int32, "a": {target: arena},
+    "b": {target: arena}}`` with arenas ``[slots+1, L, d_in, r]`` /
+    ``[slots+1, L, r, d_out]``.  One gather per target per dispatch
+    (hoisted out of the decode scan — ids are loop-invariant), sized
+    ``B * L * d * r``: the BGMV trade of a small gathered copy for
+    per-row weight selection fused into the batched einsum."""
+    ids = planes["ids"]
+    return {t: (planes["a"][t][ids], planes["b"][t][ids])
+            for t in planes["a"]}
+
+
+@contextmanager
+def lora_context(gathered: Optional[Dict[str, Tuple]]):
+    """Activate a gathered adapter context for the duration of a traced
+    model call (``None`` = explicit no-op, so builders can wrap
+    unconditionally)."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = gathered
+    try:
+        yield
+    finally:
+        _ACTIVE = prev
+
+
+def lora_delta(target: str, layer_idx: int, x):
+    """The gathered low-rank delta for ``target`` at ``layer_idx`` —
+    ``x`` is the projection INPUT (``[B, S, d_in]`` raw array), the
+    return is ``[B, S, d_out]`` in ``x.dtype`` — or ``None`` when no
+    context is active (the non-LoRA fast path: one global load and a
+    membership test)."""
+    if _ACTIVE is None or target not in _ACTIVE:
+        return None
+    a_g, b_g = _ACTIVE[target]
+    a_l = a_g[:, layer_idx]            # [B, d_in, r]
+    b_l = b_g[:, layer_idx]            # [B, r, d_out]
+    h = jnp.einsum("b...i,bir->b...r", x, a_l)
+    return jnp.einsum("b...r,bro->b...o", h, b_l).astype(x.dtype)
+
+
+def maybe_lora(out, x, target: str, layer_idx: int):
+    """Hook the models' projection sites call: add ``x``'s per-row
+    adapter delta to the base projection output ``out`` (both
+    ``Tensor``s) when a context is active; return ``out`` unchanged
+    otherwise."""
+    d = lora_delta(target, layer_idx, x._value)
+    if d is None:
+        return out
+    from ..core.tensor import Tensor
+    return Tensor(out._value + d)
+
+
+def merged_weight_delta(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """``A @ B`` per layer: the dense ``[d_in, d_out]`` weight delta of
+    one (already-scaled) adapter layer — what merging folds into the
+    base ``Linear.weight`` (reference layout ``[in, out]``)."""
+    return np.asarray(a, np.float32) @ np.asarray(b, np.float32)
+
+
+@contextmanager
+def merged_adapter(model, adapter):
+    """Fold ``adapter`` into ``model``'s attention projection weights
+    in place for the duration of the block, restoring the originals on
+    exit — the per-request merged-weights oracle the batched gathered
+    path is asserted token-exact against.  ``model`` must expose
+    ``attn_projections()`` (a per-layer ``{target: Linear}`` list);
+    ``adapter`` carries ``weights[target] = (A [L, d_in, r],
+    B [L, r, d_out])`` with scaling folded into B."""
+    projs = model.attn_projections()
+    saved = []
+    try:
+        for li, layer_projs in enumerate(projs):
+            for t, lin in layer_projs.items():
+                if t not in adapter.weights:
+                    continue
+                a, b = adapter.weights[t]
+                saved.append((lin.weight, lin.weight._value))
+                delta = merged_weight_delta(a[li], b[li])
+                lin.weight._value = lin.weight._value + jnp.asarray(
+                    delta, lin.weight._value.dtype)
+        yield model
+    finally:
+        for param, orig in saved:
+            param._value = orig

@@ -257,6 +257,14 @@ def explain_events(events: List[FlightEvent], request_id: int) -> str:
         adm = admits[0]
         clause = f"admitted at step {adm.step} into slot " \
                  f"{adm.attrs.get('slot', '?')}"
+        # multi-tenant LoRA serving: which adapter the request decodes
+        # through and how far behind its fair share the tenant was at
+        # the admission decision (a deterministic token count)
+        if "adapter" in adm.attrs:
+            clause += f" with adapter {adm.attrs['adapter']}"
+        if "tenant" in adm.attrs:
+            clause += (f" (tenant {adm.attrs['tenant']}, fair-share "
+                       f"deficit {adm.attrs.get('deficit', 0)})")
         if sub is not None:
             waited = adm.step - sub.step
             ahead = sorted({

@@ -212,10 +212,13 @@ def test_goodput_conservation_combined_trace(traced):
     assert s["wasted_tokens"] == sum(s["wasted_by_reason"].values())
     assert set(s["wasted_by_reason"]) == set(GOODPUT_REASONS)
     # stats() is registry-derived: private registry, raw values match
+    # (total() — the counters are tenant-labeled since PR 11; this
+    # tenant-less trace keeps every count under tenant="default")
     assert s["useful_tokens"] == \
-        reg.get("serving.goodput.useful_tokens").value()
+        reg.get("serving.goodput.useful_tokens").total() == \
+        reg.get("serving.goodput.useful_tokens").value(tenant="default")
     assert s["dispatched_tokens"] == \
-        reg.get("serving.goodput.dispatched_tokens").value()
+        reg.get("serving.goodput.dispatched_tokens").total()
     assert s["wasted_tokens"] == \
         reg.get("serving.goodput.wasted_tokens").total()
     # independent reconciliation of the dispatched total

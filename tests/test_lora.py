@@ -1,0 +1,426 @@
+"""Multi-tenant batched LoRA serving (inference/lora.py +
+models/lora.py + the ServingEngine integration): paged AdapterStore
+semantics, K=2 batched gathered-A/B decode token-exact vs per-request
+merged-weight ``generate()`` (slot-reuse adapter changes and host-tier
+adapter swap-ins included), and fair-share (deficit-weighted
+round-robin) admission — a two-tenant starvation trace with
+byte-deterministic admission order, plus FIFO-within-class
+determinism on single-tenant traces.
+
+Tier-1 budget discipline (the suite is truncation-scored): ONE
+module-scoped tiny model shared by every test, ONE LoRA engine run
+covering the whole adapter matrix (module-scoped combined trace, many
+asserts), and the starvation trace at steps_per_call=1 so each arm
+compiles only two programs."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.inference.lora import AdapterStore, LoraAdapter
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models.lora import attn_lora_dims, merged_adapter
+from paddle_tpu.observability.flightrec import FlightRecorder
+from paddle_tpu.observability.metrics import MetricsRegistry
+
+P, C = 6, 32
+
+
+@pytest.fixture(scope="module")
+def netm():
+    paddle.seed(2024)
+    cfg = models.tiny_llama_config()
+    net = models.LlamaForCausalLM(cfg)
+    net.eval()
+    return cfg, net
+
+
+def _pad(ids):
+    padded = np.zeros((P,), np.int32)
+    padded[:ids.size] = ids
+    return padded
+
+
+def _oracle(net, adapter, ids, seq_len, max_new):
+    """Per-request merged-weights greedy generation — the 'run alone
+    with its adapter' parity oracle (base model when adapter is
+    None)."""
+    t = paddle.to_tensor(_pad(ids)[None, :].astype(np.int32))
+
+    def gen():
+        return np.asarray(net.generate(
+            t, seq_lens=np.array([seq_len]), max_new_tokens=max_new,
+            max_cache_len=C, compute_dtype="float32")._value)[0]
+
+    if adapter is None:
+        return gen()
+    with merged_adapter(net, adapter):
+        return gen()
+
+
+# -- AdapterStore units (no device dispatch beyond tiny uploads) --
+
+def test_adapter_store_semantics(netm):
+    """Registration validation, free-list/LRU/pin residency, demotion
+    + byte-identical swap-in, gauges/counters on a private registry,
+    and the invariant audit."""
+    cfg, net = netm
+    reg = MetricsRegistry()
+    store = AdapterStore(net, slots=2, max_rank=4, dtype="float32",
+                         registry=reg)
+    a = LoraAdapter.random(cfg, "a", rank=3, seed=1, scale=0.2)
+    b = LoraAdapter.random(cfg, "b", rank=2, seed=2, scale=0.2)
+    c = LoraAdapter.random(cfg, "c", rank=4, seed=3, scale=0.2)
+    for ad in (a, b, c):
+        store.register(ad)
+    store.check()
+    # registration is host-only: nothing resident yet
+    assert reg.get("serving.lora.hbm_adapters").value() == 0
+    assert reg.get("serving.lora.host_adapters").value() == 3
+
+    # guards
+    with pytest.raises(ValueError, match="already registered"):
+        store.register(LoraAdapter.random(cfg, "a", rank=1))
+    with pytest.raises(ValueError, match="rank"):
+        store.register(LoraAdapter.random(cfg, "big", rank=9))
+    bad = LoraAdapter.random(cfg, "bad", rank=2)
+    bad.weights["q_proj"] = (bad.weights["q_proj"][0][:, :-1, :],
+                             bad.weights["q_proj"][1])
+    with pytest.raises(ValueError, match="shapes"):
+        store.register(bad)
+    with pytest.raises(ValueError, match="unknown projection"):
+        store.register(LoraAdapter(
+            name="odd", rank=1,
+            weights={"mlp_gate": bad.weights["q_proj"]}))
+    with pytest.raises(KeyError):
+        store.acquire("never_registered")
+
+    # acquire fills the free list; a third acquire with both slots
+    # pinned must refuse (the admission head-of-line signal)
+    sa = store.acquire("a")
+    sb = store.acquire("b")
+    assert sorted((sa, sb)) == [0, 1]
+    assert store.acquire("c") is None
+    assert reg.get("serving.lora.swap_ins").value() == 2
+    assert reg.get("serving.lora.swap_in_bytes").value() > 0
+    store.check()
+
+    # release parks 'a' in the LRU (still resident); acquiring 'c'
+    # demotes it (slot reclaimed, host master kept) and uploads c
+    store.release("a")
+    assert store.resident("a")
+    sc = store.acquire("c")
+    assert sc == sa and not store.resident("a")
+    assert reg.get("serving.lora.host_adapters").value() == 1
+    store.check()
+
+    # swap 'a' back in: the arena row must hold its registration
+    # parcel byte-identically (zero-padded to max_rank)
+    store.release("b")
+    sa2 = store.acquire("a")
+    st = store.state("a")
+    for t in attn_lora_dims(cfg):
+        a_dev, b_dev = store.arena_row(t, sa2)
+        np.testing.assert_array_equal(a_dev, st.rows[t][0])
+        np.testing.assert_array_equal(b_dev, st.rows[t][1])
+        # rank padding really is zero
+        assert (a_dev[:, :, a.rank:] == 0).all()
+        assert (b_dev[:, a.rank:, :] == 0).all()
+    # double release raises (the BlockPool double-free discipline)
+    store.release("a")
+    with pytest.raises(RuntimeError, match="below pin count"):
+        store.release("a")
+    assert reg.get("serving.lora.hbm_adapters").hwm() == 2
+    store.check()
+
+
+def test_subset_target_adapter_overwrites_whole_slot(netm):
+    """An adapter carrying a SUBSET of targets must still overwrite
+    the whole slot row set on upload: after a full-target occupant is
+    demoted from the slot, the subset adapter's absent targets must
+    read ZERO (no delta), never the previous occupant's weights."""
+    cfg, net = netm
+    store = AdapterStore(net, slots=1, max_rank=3, dtype="float32",
+                         registry=MetricsRegistry())
+    full = LoraAdapter.random(cfg, "full", rank=2, seed=5, scale=0.3)
+    q_only = LoraAdapter.random(cfg, "q_only", rank=2, seed=6,
+                                scale=0.3, targets=("q_proj",))
+    store.register(full)
+    store.register(q_only)
+    slot = store.acquire("full")
+    store.release("full")
+    slot2 = store.acquire("q_only")      # demotes 'full', same slot
+    assert slot2 == slot
+    a_dev, b_dev = store.arena_row("q_proj", slot2)
+    assert np.abs(a_dev).sum() > 0       # its own target uploaded
+    for t in ("k_proj", "v_proj", "o_proj"):
+        a_dev, b_dev = store.arena_row(t, slot2)
+        assert (a_dev == 0).all() and (b_dev == 0).all(), t
+    store.check()
+
+
+def test_engine_adapter_guards(netm):
+    """submit(adapter=) validation: no store attached, unregistered
+    names, and store/engine dtype mismatch — all loud errors."""
+    cfg, net = netm
+    eng = ServingEngine(net, num_slots=1, prompt_len=P, max_cache_len=8,
+                        compute_dtype="float32")
+    with pytest.raises(ValueError, match="adapter_store"):
+        eng.submit(np.zeros((4,), np.int32), max_new_tokens=2,
+                   adapter="a")
+    store = AdapterStore(net, slots=1, max_rank=2, dtype="float32",
+                         registry=MetricsRegistry())
+    eng2 = ServingEngine(net, num_slots=1, prompt_len=P,
+                         max_cache_len=8, compute_dtype="float32",
+                         adapter_store=store,
+                         registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="not registered"):
+        eng2.submit(np.zeros((4,), np.int32), max_new_tokens=2,
+                    adapter="ghost")
+    with pytest.raises(ValueError, match="compute_dtype"):
+        ServingEngine(net, num_slots=1, prompt_len=P, max_cache_len=8,
+                      compute_dtype="bfloat16", adapter_store=store)
+    with pytest.raises(ValueError, match="tenant_weights"):
+        ServingEngine(net, num_slots=1, prompt_len=P, max_cache_len=8,
+                      compute_dtype="float32",
+                      tenant_weights={"t": 0.0})
+
+
+# -- the module-scoped combined LoRA trace (ONE engine run) --
+
+SPECS = [
+    # (prompt_seed, seq_len, max_new, adapter, spec_k)
+    (10, 4, 6, "a", None),     # K=2 batched: a + b decode together
+    (11, 5, 6, "b", None),
+    (12, 4, 6, None, None),    # base rides the SAME lora dispatches
+    (13, 3, 5, "c", None),     # store full -> adapter swap (demote)
+    (14, 4, 5, "a", None),     # 'a' swaps BACK in (byte-identical)
+    (15, 6, 6, "b", 2),        # spec-decode verify over an adapter
+]
+
+
+@pytest.fixture(scope="module")
+def lora_trace(netm):
+    """One engine drain covering the adapter matrix: K=2 batched
+    decode, a base request in the same dispatches, adapter slot
+    exhaustion -> host-tier demotion -> byte-identical swap-in,
+    engine-slot reuse across an adapter change, and spec-decode over
+    an adapter — on PRIVATE registry/recorder so counter asserts are
+    exact."""
+    cfg, net = netm
+    rng = np.random.default_rng(0)
+    reg = MetricsRegistry()
+    fr = FlightRecorder()
+    store = AdapterStore(net, slots=2, max_rank=4, dtype="float32",
+                         registry=reg)
+    adapters = {n: LoraAdapter.random(cfg, n, rank=3, seed=s, scale=0.2)
+                for n, s in (("a", 7), ("b", 8), ("c", 9))}
+    for ad in adapters.values():
+        store.register(ad)
+    eng = ServingEngine(net, num_slots=2, prompt_len=P, max_cache_len=C,
+                        steps_per_call=3, compute_dtype="float32",
+                        adapter_store=store, registry=reg,
+                        flight_recorder=fr)
+    reqs = []
+    for seed, n, m, aname, spec_k in SPECS:
+        ids = np.random.default_rng(seed).integers(
+            0, cfg.vocab_size, (n,)).astype(np.int32)
+        reqs.append((ids, n, m, aname, eng.submit(
+            ids, max_new_tokens=m, adapter=aname, spec_decode=spec_k,
+            tenant=None if aname is None else f"tenant_{aname}")))
+    done = eng.run()
+    assert len(done) == len(SPECS)
+    return {"cfg": cfg, "net": net, "reg": reg, "fr": fr,
+            "store": store, "adapters": adapters, "eng": eng,
+            "reqs": reqs, "rng": rng}
+
+
+def test_batched_lora_token_exact_vs_merged(lora_trace):
+    """Acceptance: every request's batched gathered-A/B output is
+    token-for-token the per-request merged-weights ``generate()`` of
+    its own adapter — K=2 concurrent adapters, the base request in
+    the same dispatches, the adapter that crossed the host tier, the
+    engine-slot reuse with a changed adapter id, and the spec-decode
+    request included."""
+    net = lora_trace["net"]
+    adapters = lora_trace["adapters"]
+    for ids, n, m, aname, req in lora_trace["reqs"]:
+        want = _oracle(net, adapters.get(aname), ids, n, m)
+        np.testing.assert_array_equal(
+            req.output, want,
+            err_msg=f"request {req.request_id} (adapter={aname})")
+
+
+def test_lora_trace_store_and_instruments(lora_trace):
+    """The trace really exercised the paged-store machinery: K=2 peak
+    residency against 2 slots, >= 4 swap-ins (a, b, c, a-again),
+    every lora dispatch counted, per-tenant goodput conservation, and
+    a clean store audit after the drain."""
+    reg, store, eng = (lora_trace["reg"], lora_trace["store"],
+                       lora_trace["eng"])
+    s = eng.stats()
+    assert reg.get("serving.lora.hbm_adapters").hwm() == 2
+    assert reg.get("serving.lora.swap_ins").value() >= 4
+    assert reg.get("serving.lora.swap_in_bytes").value() > 0
+    assert reg.get("serving.lora.gathers").value() == \
+        s["lora_dispatches"] > 0
+    store.check()
+    # all pins released at retirement
+    assert all(store.state(n).pins == 0 for n in store.names())
+    # per-tenant goodput: conservation holds per label set too
+    g_u = reg.get("serving.goodput.useful_tokens")
+    g_w = reg.get("serving.goodput.wasted_tokens")
+    g_d = reg.get("serving.goodput.dispatched_tokens")
+    for t in ("tenant_a", "tenant_b", "tenant_c", "default"):
+        w = sum(g_w.value(reason=r, tenant=t)
+                for r in ("spec_reject", "recompute_preempt",
+                          "recompute_cache", "pad"))
+        assert g_u.value(tenant=t) + w == g_d.value(tenant=t) > 0
+    assert s["useful_tokens"] + s["wasted_tokens"] \
+        == s["dispatched_tokens"]
+    # the admit events carry the adapter id (explain() renders it)
+    fr = lora_trace["fr"]
+    admits = {e.request: e for e in fr.events() if e.kind == "admit"}
+    a_req = next(r for *_x, an, r in lora_trace["reqs"] if an == "a")
+    assert admits[a_req.request_id].attrs.get("adapter") == "a"
+    text = eng.explain(a_req.request_id)
+    assert "adapter a" in text and "tenant tenant_a" in text
+
+
+# -- fair-share admission (deficit-weighted round-robin) --
+
+def _starvation_arm(net, prompts, tenants, n_steps, weights=None):
+    """One fixed-step run of the two-tenant starvation trace: a
+    1-slot engine, every request submitted at t=0, admission order
+    read back from the flight recorder."""
+    fr = FlightRecorder()
+    eng = ServingEngine(net, num_slots=1, prompt_len=4, max_cache_len=16,
+                        steps_per_call=1, compute_dtype="float32",
+                        registry=MetricsRegistry(), flight_recorder=fr,
+                        tenant_weights=weights)
+    reqs = [eng.submit(prompts[i], max_new_tokens=3, tenant=t)
+            for i, t in enumerate(tenants)]
+    for _ in range(n_steps):
+        eng.step()
+    admits = [e.request for e in fr.events() if e.kind == "admit"]
+    fin = {}
+    for i, t in enumerate(tenants):
+        if reqs[i].state == "finished":
+            fin[t] = fin.get(t, 0) + 1
+    return admits, fin, eng.stats(), fr
+
+
+def test_fair_share_starvation_trace(netm):
+    """Acceptance: under a bursty tenant's overload (6 requests at
+    t=0) vs a steady tenant (3 requests at t=0), deficit-WRR
+    admission order is byte-deterministic (it equals the hand-
+    computed alternation, twice) and the steady tenant's completion
+    count within a fixed step budget strictly improves vs FIFO —
+    while FIFO-within-class determinism is preserved exactly when
+    every request shares one tenant."""
+    cfg, net = netm
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
+               for _ in range(9)]
+    burst = ["A"] * 6 + ["B"] * 3
+    # N small enough that FIFO is still inside A's burst when the
+    # window closes: each 3-token request spans ~2-3 scheduler steps
+    # on the 1-slot engine (admit+chunk-final and the first decode
+    # share a step), so the 6-request burst alone eats ~14+
+    n = 14
+    fifo_admits, fifo_fin, fifo_stats, _ = _starvation_arm(
+        net, prompts, ["default"] * 9, n)
+    fair_admits, fair_fin, fair_stats, fair_fr = _starvation_arm(
+        net, prompts, burst, n)
+    fair2_admits, _f2, _s2, _ = _starvation_arm(net, prompts, burst, n)
+
+    # single-tenant = plain FIFO, scheduling-identical to today
+    assert fifo_admits == sorted(fifo_admits)
+    assert fifo_stats["fair_reorders"] == 0
+    # deficit-WRR alternates the starved tenant in: requests 6/7/8
+    # (tenant B) jump the bursty tenant's backlog, deterministically
+    want_order = [0, 6, 1, 7, 2, 8, 3, 4, 5]
+    assert fair_admits == want_order[:len(fair_admits)]
+    assert fair_admits == fair2_admits        # byte-deterministic
+    assert fair_stats["fair_reorders"] == 3
+    # the steady tenant strictly improves vs FIFO under the burst
+    assert fair_fin.get("B", 0) > fifo_fin.get("B", 0) \
+        if "B" in burst else True
+    assert fair_fin.get("B", 0) >= 2
+    # the service ledger charged both tenants (prompt + budget each)
+    assert fair_stats["tenant_served_tokens"]["A"] > \
+        fair_stats["tenant_served_tokens"]["B"] > 0
+    # admit events carry tenant + deficit for the reordered tenant,
+    # and explain() renders the fair-share clause
+    b_admit = next(e for e in fair_fr.events()
+                   if e.kind == "admit" and e.request == 6)
+    assert b_admit.attrs["tenant"] == "B"
+    assert b_admit.attrs["deficit"] > 0
+    text = fair_fr.explain(6)
+    assert "tenant B" in text and "deficit" in text
+
+
+@pytest.mark.slow
+def test_lora_int8_kv_compose(netm):
+    """LoRA composes with the int8 KV cache: the adapter touches
+    projections, never cache bytes, so a K=2 int8 engine emits
+    exactly what the float LoRA engine emits when the quantized
+    streams agree — asserted as exact equality against the SAME int8
+    engine run per-request (row independence), plus high agreement
+    vs the float LoRA engine."""
+    cfg, net = netm
+    reg = MetricsRegistry()
+    store = AdapterStore(net, slots=2, max_rank=4, dtype="float32",
+                         registry=reg)
+    ads = {n: LoraAdapter.random(cfg, n, rank=3, seed=s, scale=0.2)
+           for n, s in (("a", 7), ("b", 8))}
+    for ad in ads.values():
+        store.register(ad)
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+               for _ in range(3)]
+
+    def run(kv_dtype, batch):
+        eng = ServingEngine(net, num_slots=2, prompt_len=P,
+                            max_cache_len=C, steps_per_call=2,
+                            compute_dtype="float32",
+                            kv_cache_dtype=kv_dtype,
+                            adapter_store=store,
+                            registry=MetricsRegistry())
+        reqs = []
+        for i, (ids, aname) in enumerate(batch):
+            reqs.append(eng.submit(ids, max_new_tokens=5,
+                                   adapter=aname))
+        eng.run()
+        return [r.output for r in reqs]
+
+    batch = [(prompts[0], "a"), (prompts[1], "b"), (prompts[2], None)]
+    int8_batched = run("int8", batch)
+    # K>1 row independence holds on the int8 path too: each request
+    # alone reproduces its batched row exactly
+    for i, (ids, aname) in enumerate(batch):
+        alone = run("int8", [(ids, aname)])[0]
+        np.testing.assert_array_equal(int8_batched[i], alone)
+    # and the quantized stream tracks the float LoRA stream closely
+    f32 = run("float32", batch)
+    agree = np.mean([np.mean(a == b)
+                     for a, b in zip(int8_batched, f32)])
+    assert agree >= 0.9
+
+
+def test_fair_share_weights(netm):
+    """tenant_weights scale the fair share: at weight 2 the bursty
+    tenant keeps a 2:1 admission ratio instead of 1:1 alternation."""
+    cfg, net = netm
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
+               for _ in range(9)]
+    burst = ["A"] * 6 + ["B"] * 3
+    admits, _fin, _stats, _ = _starvation_arm(
+        net, prompts, burst, 18, weights={"A": 2.0, "B": 1.0})
+    # A(0): A=21/2, B=0 -> B(6); then A=10.5 vs B=21 -> A(1), A(2)
+    # (A=31.5 > 21 only after two more) — the exact deterministic
+    # prefix: 0, 6, 1, 2, 7, 3, 4, 8, 5
+    want = [0, 6, 1, 2, 7, 3, 4, 8, 5]
+    assert admits == want[:len(admits)]

@@ -511,6 +511,7 @@ def test_metrics_name_lint_clean():
              "serving.timeout.", "serving.prefix.",
              "serving.goodput.", "serving.slo.", "serving.step.",
              "serving.async.", "serving.fault.",
+             "serving.lora.", "serving.fairshare.",
              "serving.tpot_seconds")), n
         assert n in names, n
     kinds = {r[3]: r[2] for r in regs}
@@ -546,9 +547,18 @@ def test_metrics_name_lint_clean():
     by_lbl = {r[3]: r[4] for r in regs}
     assert by_lbl["serving.shed.requests"] == ("reason",)
     assert by_lbl["serving.requests_cancelled"] == ("phase",)
-    assert by_lbl["serving.goodput.wasted_tokens"] == ("reason",)
-    assert by_lbl["serving.slo.attained"] == ("class",)
-    assert by_lbl["serving.slo.missed"] == ("class",)
+    # PR 11: the goodput/SLO set carries the per-tenant label
+    assert by_lbl["serving.goodput.wasted_tokens"] == \
+        ("reason", "tenant")
+    assert by_lbl["serving.slo.attained"] == ("class", "tenant")
+    assert by_lbl["serving.slo.missed"] == ("class", "tenant")
+    # the multi-tenant LoRA + fair-share set (PR 11)
+    assert kinds["serving.lora.hbm_adapters"] == "gauge"
+    assert kinds["serving.lora.swap_ins"] == "counter"
+    assert kinds["serving.lora.gathers"] == "counter"
+    assert kinds["serving.fairshare.reorders"] == "counter"
+    assert by_lbl["serving.fairshare.served_tokens"] == ("tenant",)
+    assert by_lbl["serving.fairshare.deficit"] == ("tenant",)
     # rule 4 fires on a missing required name
     import tempfile
     with tempfile.TemporaryDirectory() as empty_root:

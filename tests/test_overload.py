@@ -394,6 +394,7 @@ def test_blockpool_check_audit_and_idempotent_release():
     class _Req:
         matched = []
         slot = None
+        adapter_slot = None   # no LoRA adapter pinned (PR 11)
     pool2 = BlockPool(num_blocks=6, block_len=4)
     req = _Req()
     req.blocks = pool2.alloc(2)

@@ -828,3 +828,15 @@ def test_bench_llm_serving_section():
               "no_preempt_slo_missed", "no_preempt_goodput",
               "mean_tpot_ms"):
         assert k in ov, k
+    # PR 11: the multi-tenant LoRA arm — deterministic gates only
+    # (K=1 merged-weights parity, gather==dispatch route counts, the
+    # steady tenant strictly improving under fair-share); tokens/s
+    # and p99 TTFT ride along ungated
+    lo = out["lora"]
+    for k in (1, 4, 8):
+        assert lo["adapters"][k]["gate_gather_count"], k
+        assert lo["adapters"][k]["tokens_per_s"] > 0
+    assert lo["adapters"][1]["gate_k1_token_exact"]
+    assert lo["starvation"]["gate_steady_improves"]
+    assert lo["starvation"]["gate_reordered"]
+    assert "k8_vs_k1" in lo

@@ -105,14 +105,18 @@ REQUIRED_INSTRUMENTS = {
     # split the dispatch-ahead pipeline will be judged against, the
     # per-output-token latency histogram and the per-class SLO
     # outcome counters the bench's goodput sub-objects key on
-    "serving.goodput.useful_tokens": ("counter", ()),
-    "serving.goodput.wasted_tokens": ("counter", ("reason",)),
-    "serving.goodput.dispatched_tokens": ("counter", ()),
+    # (PR 11 relabeled the goodput/SLO set per tenant: the tenant
+    # label attributes every dispatched token-position and SLO outcome
+    # to the submitting tenant — 'default' for tenant-less requests,
+    # so single-tenant dashboards group-by away one constant label)
+    "serving.goodput.useful_tokens": ("counter", ("tenant",)),
+    "serving.goodput.wasted_tokens": ("counter", ("reason", "tenant")),
+    "serving.goodput.dispatched_tokens": ("counter", ("tenant",)),
     "serving.step.host_seconds": ("histogram", ()),
     "serving.step.dispatch_seconds": ("histogram", ()),
     "serving.tpot_seconds": ("histogram", ()),
-    "serving.slo.attained": ("counter", ("class",)),
-    "serving.slo.missed": ("counter", ("class",)),
+    "serving.slo.attained": ("counter", ("class", "tenant")),
+    "serving.slo.missed": ("counter", ("class", "tenant")),
     # dispatch-ahead step pipeline (PR 10, inference/serving.py
     # _ServingInstruments): the plan/harvest split's observable
     # surface — forced-sync iterations by closed reason vocabulary
@@ -126,6 +130,20 @@ REQUIRED_INSTRUMENTS = {
     "serving.async.depth": ("gauge", ()),
     "serving.step.overlap_seconds": ("histogram", ()),
     "serving.fault.stall_seconds": ("histogram", ()),
+    # multi-tenant batched LoRA serving (PR 11, inference/lora.py
+    # AdapterStore + inference/serving.py _ServingInstruments):
+    # adapter residency across the HBM arena / host-RAM tiers, swap-in
+    # traffic at exact at-rest bytes, the gathered-einsum dispatch
+    # route split, and the fair-share (deficit-weighted round-robin)
+    # service ledger the bench's lora arm keys on
+    "serving.lora.hbm_adapters": ("gauge", ()),
+    "serving.lora.host_adapters": ("gauge", ()),
+    "serving.lora.swap_ins": ("counter", ()),
+    "serving.lora.swap_in_bytes": ("counter", ()),
+    "serving.lora.gathers": ("counter", ()),
+    "serving.fairshare.served_tokens": ("counter", ("tenant",)),
+    "serving.fairshare.deficit": ("gauge", ("tenant",)),
+    "serving.fairshare.reorders": ("counter", ()),
 }
 
 
