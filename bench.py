@@ -833,6 +833,16 @@ def _bench_serving(on_tpu):
     (deficit-WRR): the steady tenant's completion count at a fixed
     step budget must strictly improve and the reorder counter must
     fire; steady-tenant p99 TTFT rides along report-only.
+
+    A ``router`` sub-object isolates the FRONT-DOOR ROUTER (PR 12):
+    the multi-turn + per-conversation-adapter trace through a
+    2-replica ``Router`` with affinity routing (prefix + adapter
+    residency as a strict tie-break inside an equal-load class) vs
+    round-robin, on engine-identical traces over private registries.
+    Gated ONLY on deterministic counters: per-request token-exact
+    outputs across arms, prefix hit tokens strictly HIGHER under
+    affinity, adapter swap-ins strictly LOWER; tokens/s rides along
+    report-only (wall clock on this box is jitter-bound).
     """
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -1790,6 +1800,112 @@ def _bench_serving(on_tpu):
         },
     }
 
+    # -- front-door router arm (``router`` sub-object): the SAME
+    # multi-turn conversation trace with one LoRA adapter per
+    # conversation through a 2-replica Router, affinity vs
+    # round-robin.  Affinity keeps each conversation on the replica
+    # that holds its history (radix tree) and its adapter (HBM arena);
+    # round-robin alternates replicas every turn, so the same trace
+    # pays prefix recomputes and adapter swap-ins instead.  Outputs
+    # depend only on (prompt, adapter) — greedy, identical weights on
+    # both replicas — so the traces are engine-identical across arms
+    # and every gate below is a deterministic counter --
+    from paddle_tpu.inference.router import Router
+
+    rt_turns, rt_convs, rt_user = 3, 3, 6
+    rt_ads = [LoraAdapter.random(cfg, f"rt_a{j}", rank=4,
+                                 seed=300 + j, scale=0.05)
+              for j in range(rt_convs)]
+
+    def _one_router_trace(affinity):
+        engs, eng_regs = [], []
+        for _ei in range(2):
+            reg = obs_metrics.MetricsRegistry()
+            store = AdapterStore(model, slots=2, max_rank=4,
+                                 dtype=compute_dtype, registry=reg)
+            for ad in rt_ads:
+                store.register(ad)
+            eng = ServingEngine(
+                model, num_slots=2, prompt_len=tr_prompt,
+                max_cache_len=tr_cache, steps_per_call=steps_per_call,
+                block_len=tr_block, chunk_len=tr_chunk,
+                num_blocks=tr_blocks,
+                host_cache_blocks=8 * tr_blocks,
+                compute_dtype=compute_dtype, adapter_store=store,
+                registry=reg)
+            # warm the LoRA chunk + both block-size programs outside
+            # the timed/counted window (identical ritual per replica)
+            for _ in range(2):
+                eng.submit(tr_sys_ids,
+                           max_new_tokens=steps_per_call + 2,
+                           adapter=rt_ads[0].name)
+            eng.run()
+            engs.append(eng)
+            eng_regs.append(reg)
+        router = Router(engs, affinity=affinity,
+                        registry=obs_metrics.MetricsRegistry())
+        warm_hits = sum(e.stats()["prefix_hit_tokens"] for e in engs)
+        warm_swaps = sum(r.get("serving.lora.swap_ins").value()
+                         for r in eng_regs)
+        rrng = np.random.default_rng(11)    # identical trace per arm
+        hist = [list(tr_sys_ids) for _ in range(rt_convs)]
+        outs = {ci: [] for ci in range(rt_convs)}
+        toks = 0
+        t0 = time.perf_counter()
+        for _turn in range(rt_turns):
+            reqs = []
+            for ci in range(rt_convs):
+                user = rrng.integers(0, cfg.vocab_size,
+                                     rt_user).astype(np.int32)
+                hist[ci].extend(int(x) for x in user)
+                ids = np.asarray(hist[ci], np.int32)
+                reqs.append((ci, router.submit(
+                    ids, max_new_tokens=tr_new,
+                    adapter=rt_ads[ci].name)))
+            router.run(wall_timeout_s=600)
+            for ci, h in reqs:
+                out = h.output
+                hist[ci].extend(int(x) for x in out)
+                outs[ci].append(np.asarray(out))
+                toks += out.size
+        wall = time.perf_counter() - t0
+        rs = router.stats()
+        return {
+            "tokens_per_s": round(toks / wall, 1),
+            "prefix_hit_tokens": int(
+                sum(e.stats()["prefix_hit_tokens"] for e in engs)
+                - warm_hits),
+            "adapter_swap_ins": int(
+                sum(r.get("serving.lora.swap_ins").value()
+                    for r in eng_regs) - warm_swaps),
+            "routed_by_reason": rs["routed_by_reason"],
+            "prefix_affinity_tokens": rs["prefix_affinity_tokens"],
+            "adapter_affinity_hits": rs["adapter_affinity_hits"],
+        }, outs
+
+    rt_aff, rt_aff_outs = _one_router_trace(affinity=True)
+    rt_rr, rt_rr_outs = _one_router_trace(affinity=False)
+    router_ab = {
+        "replicas": 2, "turns": rt_turns,
+        "conversations": rt_convs, "adapters": rt_convs,
+        "affinity": rt_aff,
+        "round_robin": rt_rr,
+        "hit_tokens_vs_round_robin": round(
+            rt_aff["prefix_hit_tokens"]
+            / max(rt_rr["prefix_hit_tokens"], 1), 3),
+        # deterministic gates (the acceptance criteria): identical
+        # per-request outputs across arms, strictly more cache hit
+        # tokens and strictly fewer adapter swap-ins under affinity
+        "gate_token_exact": bool(all(
+            np.array_equal(a, b)
+            for ci in range(rt_convs)
+            for a, b in zip(rt_aff_outs[ci], rt_rr_outs[ci]))),
+        "gate_prefix_hits_higher": bool(
+            rt_aff["prefix_hit_tokens"] > rt_rr["prefix_hit_tokens"]),
+        "gate_swap_ins_lower": bool(
+            rt_aff["adapter_swap_ins"] < rt_rr["adapter_swap_ins"]),
+    }
+
     return {
         "tokens_per_s": cont["tokens_per_s"],
         "p50_latency_ms": cont["p50_latency_ms"],
@@ -1835,6 +1951,7 @@ def _bench_serving(on_tpu):
         "overload": overload,
         "async": async_ab,
         "lora": lora,
+        "router": router_ab,
         "spec": {
             "k": sp_k, "max_new": sp_new, "n_requests": sp_n,
             "tokens_per_s": spec_on["tokens_per_s"],

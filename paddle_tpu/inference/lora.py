@@ -194,6 +194,14 @@ class AdapterStore:
     def names(self) -> List[str]:
         return sorted(self._adapters)
 
+    def hbm_resident(self) -> List[str]:
+        """Adapter names currently resident in the HBM arena, sorted —
+        the adapter-affinity signal ``ServingEngine.load_report()``
+        exposes to the router (a request routed here decodes without
+        paying a swap-in)."""
+        return sorted(n for n, a in self._adapters.items()
+                      if a.slot is not None)
+
     def state(self, name: str) -> Optional[_AdapterState]:
         return self._adapters.get(name)
 

@@ -493,6 +493,15 @@ class RadixPrefixCache:
     def n_host(self) -> int:
         return len(self._host)
 
+    def root_stats(self) -> dict:
+        """Tree-size summary for ``ServingEngine.load_report()``:
+        cached block counts by tier plus the root fanout (how many
+        distinct first tokens the tree indexes).  O(1) — reverse maps
+        and the root child dict are already maintained."""
+        return {"hbm_blocks": len(self._hbm),
+                "host_blocks": len(self._host),
+                "root_children": len(self.root.children)}
+
     def audit(self, pool) -> List[str]:
         """Structural invariants ``BlockPool.check()`` folds in for
         radix-mode engines: the radix-node <-> block-span bijection

@@ -1,0 +1,749 @@
+"""Front-door router: admission across N serving-engine replicas with
+cache/adapter affinity, plus the workload-policy surface.
+
+Everything below the router is PR 1-11's single ``ServingEngine``:
+trace-in/stats-out, one queue, one block pool.  Real traffic needs the
+layer the reference stack calls the server side — something that owns
+admission across replicas, keeps a request's state while it waits,
+and speaks workload shapes (chat streaming, offline batch, embeddings)
+without forking the engine.  This module is that layer, kept
+deliberately in-process and deterministic (threads would buy nothing
+on a single host and would cost the byte-identical scheduling contract
+every parity test in this repo leans on):
+
+- **Replicas**: ``Router([eng0, eng1, ...])`` owns N homogeneous
+  ``ServingEngine`` instances (same model geometry — checked at
+  construction).  ``step()`` routes every ARRIVED router-queued
+  request, then steps each engine once; ``run()`` drains everything,
+  like the engine's own loop.  Future arrivals are ROUTER-held: they
+  are routed with the freshest affinity/load state at arrival time,
+  and router-level cancel/shed/timeout can still reach them.
+- **Affinity routing** (``affinity=True``): the routing key is
+  ``(load, -adapter_hit, -prefix_tokens, -blocks_free, index)`` over
+  ``ServingEngine.load_report()`` snapshots — load (outstanding
+  requests: queued + active + swapped) is PRIMARY, and affinity is a
+  strict tie-break inside an equal-load class, never an override: a
+  hot prefix must not pile requests onto an overloaded replica (the
+  same strictness argument as PR-8's cache-aware admission).  Inside
+  the tie-break, adapter residency ranks before prefix tokens — a
+  missed adapter costs a whole-adapter swap-in, a missed prefix at
+  most one prompt recompute — then the token-granular
+  ``RadixPrefixCache`` match (``ServingEngine.prefix_match()``,
+  read-only), so a conversation lands where its history is hottest
+  and PR-8's hit tokens multiply across replicas instead of diluting.
+  ``affinity=False`` is pure round-robin — the bench A/B arm — and a
+  single-replica router schedules byte-identically to the bare
+  engine either way (the acceptance anchor).
+- **Workload policies**: ``submit(policy=)`` selects per-request
+  defaults instead of an engine fork — ``"chat"`` (streaming on,
+  interactive priority), ``"batch"`` (offline, priority 0),
+  ``"embed"`` (prefill-only: ``max_new_tokens`` forced to 1, the
+  prompt's forward pass is the product).  Explicit kwargs win over
+  policy defaults.
+- **Overload semantics lifted from PR 7**: the router's own bounded
+  queue (``max_queue=``) sheds a strictly-lower-class router-held
+  victim or refuses the arrival with ``AdmissionError``; router-held
+  requests past ``max_queue_delay_s`` finish ``"timeout"``; and
+  ``cancel()`` reaches a request still sitting in the router queue
+  (counted ``serving.requests_cancelled{phase="router"}``) as well as
+  one already inside an engine (delegated).
+- **Observability**: ``serving.router.*`` instruments (requests by
+  policy, routing decisions by reason, affinity token/hit counters,
+  queue depth) and a ``route`` flight-recorder event (chosen engine,
+  affinity score, policy) so ``explain_request`` can say "routed to
+  engine 1 (prefix affinity 384 tokens)".
+
+The streamed half of the front door lives in ``serving.py``
+(``TokenStream``): ``submit(stream=True)`` — engine- or router-level —
+returns a handle whose flushes are the dispatch-ahead harvest points.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Union
+
+import numpy as np
+
+from ..observability import metrics as obs_metrics
+from ..observability.flightrec import FlightRecorder
+from .sampling import SamplingParams
+from .serving import (AdmissionError, EngineStalledError, Request,
+                      ServingEngine, TokenStream, _neg_deadline)
+
+# per-request defaults each workload policy applies (explicit submit
+# kwargs always win).  "embed" is the prefill-only shape: the request's
+# product is its prompt forward pass, so the decode budget is pinned to
+# the 1-token minimum the engine's first-token sampling needs — an
+# explicit larger budget is a contradiction and raises.
+ROUTER_POLICIES = {
+    "chat": {"stream": True, "priority": 1},
+    "batch": {"stream": False, "priority": 0},
+    "embed": {"stream": False, "priority": 1, "max_new_tokens": 1},
+}
+
+# closed vocabulary of routing-decision reasons
+# (serving.router.routed{reason=}): what distinguished the chosen
+# replica — round_robin (affinity disabled), adapter (its AdapterStore
+# holds the request's adapter in HBM), prefix (its radix tree matched
+# >= 1 prompt token), load (plain least-outstanding / index order)
+ROUTE_REASONS = ("round_robin", "adapter", "prefix", "load")
+
+
+class _RouterInstruments:
+    """Registry handles + per-router baselines (the engine's
+    ``_ServingInstruments`` discipline: instruments may live in a
+    shared registry, ``stats()`` reports per-router deltas)."""
+
+    def __init__(self, registry):
+        self.registry = registry
+        r = registry
+        self.requests = r.counter(
+            "serving.router.requests",
+            "requests accepted by the router front door, by workload "
+            "policy ('default' when submitted without one)",
+            labels=("policy",))
+        self.routed = r.counter(
+            "serving.router.routed",
+            "routing decisions (request -> engine replica) by what "
+            "distinguished the chosen replica: 'round_robin' "
+            "(affinity disabled), 'adapter' (request's adapter is "
+            "HBM-resident there), 'prefix' (its radix tree matched "
+            "prompt tokens), 'load' (plain least-outstanding order)",
+            labels=("reason",))
+        self.prefix_tokens = r.counter(
+            "serving.router.prefix_affinity_tokens",
+            "prompt tokens the CHOSEN replica's prefix tree had "
+            "already matched at each routing decision — the affinity "
+            "signal's magnitude (the admission-time re-probe decides "
+            "what actually maps; see serving.prefix.hit_tokens)")
+        self.adapter_hits = r.counter(
+            "serving.router.adapter_affinity_hits",
+            "routing decisions whose chosen replica already held the "
+            "request's LoRA adapter in HBM (each one is an adapter "
+            "swap-in the fleet did not pay)")
+        self.shed = r.counter(
+            "serving.router.shed",
+            "requests shed by the router's bounded queue: 'evicted' = "
+            "a router-held request displaced by a strictly-higher-"
+            "class arrival, 'rejected' = an arrival refused with "
+            "AdmissionError", labels=("reason",))
+        self.timeouts = r.counter(
+            "serving.router.timeouts",
+            "router-held requests finished with status 'timeout' "
+            "because their wait exceeded max_queue_delay_s before any "
+            "replica admitted them (engine-side queue timeouts count "
+            "in serving.timeout.requests)")
+        self.queue_depth = r.gauge(
+            "serving.router.queue_depth",
+            "requests the router holds (not yet dispatched to any "
+            "replica: future arrivals, or arrivals every replica "
+            "refused)")
+        self.engines = r.gauge(
+            "serving.router.engines",
+            "engine replicas behind this router")
+        # router-phase cancels share the ENGINE counter (same name,
+        # kind and label tuple, so shared registries re-use the
+        # instrument): phase='router' is the queue level above any
+        # engine
+        self.cancelled = r.counter(
+            "serving.requests_cancelled",
+            "requests dropped by cancel(); the label says which phase "
+            "the request was cancelled from (queued / prefill / "
+            "decode / swapped)", labels=("phase",))
+        self._base = {c.name: c.total() for c in (
+            self.requests, self.routed, self.prefix_tokens,
+            self.adapter_hits, self.shed, self.timeouts)}
+        self._cancel_base = self.cancelled.value(phase="router")
+        self._routed_base = {reason: self.routed.value(reason=reason)
+                             for reason in ROUTE_REASONS}
+
+    def since_init(self, counter) -> float:
+        return counter.total() - self._base.get(counter.name, 0)
+
+    def routed_since(self, reason: str) -> float:
+        return (self.routed.value(reason=reason)
+                - self._routed_base.get(reason, 0))
+
+
+class RoutedRequest:
+    """The router's request handle: a queue-side record before
+    dispatch, a transparent proxy of the engine ``Request`` after.
+
+    Before any replica admits it, the handle carries the router-level
+    lifecycle itself (``state`` queued/cancelled/shed/timeout, empty-
+    then-padded ``tokens``); once routed, every request-shaped read
+    (``state``/``tokens``/``output``/``ttft``/``latency``/
+    ``request_id``) delegates to the live engine request, so callers
+    hold ONE handle for the whole lifecycle.  ``router_id`` is the
+    router-global id (engine ``request_id``s are per-replica and may
+    collide across replicas); ``engine`` is the chosen replica index
+    (None while router-held)."""
+
+    def __init__(self, router_id: int, ids: np.ndarray, seq_len: int,
+                 max_new_tokens: int, arrival_time: float,
+                 pad_token_id: int, policy: Optional[str]):
+        self.router_id = int(router_id)
+        self.engine: Optional[int] = None
+        self._req: Optional[Request] = None
+        self._state = "queued"
+        self._tokens: List[int] = []
+        self._ids = ids
+        self.seq_len = int(seq_len)
+        self.max_new_tokens = int(max_new_tokens)
+        self.arrival_time = float(arrival_time)
+        self.pad_token_id = int(pad_token_id)
+        self.policy = policy
+        self.finish_time_router: Optional[float] = None
+        # scheduling class (shed ordering only; the engine re-derives
+        # its own from the dispatched kwargs)
+        self.priority = 0
+        self.deadline: Optional[float] = None
+        self.max_queue_delay_s: Optional[float] = None
+        self.adapter: Optional[str] = None
+        self._kw: dict = {}
+
+    def _bind(self, engine_idx: int, req: Request):
+        self.engine = int(engine_idx)
+        self._req = req
+
+    def _terminate(self, state: str, now: float):
+        """Router-level terminal: same uniform shape as the engine's
+        (terminal state, output padded to exactly max_new_tokens)."""
+        self._state = state
+        self.finish_time_router = now
+        self._tokens.extend(
+            [self.pad_token_id] * (self.max_new_tokens
+                                   - len(self._tokens)))
+
+    @property
+    def routed(self) -> bool:
+        return self._req is not None
+
+    @property
+    def state(self) -> str:
+        return self._req.state if self._req is not None else self._state
+
+    @property
+    def tokens(self) -> List[int]:
+        return (self._req.tokens if self._req is not None
+                else self._tokens)
+
+    @property
+    def output(self) -> np.ndarray:
+        return np.asarray(self.tokens, np.int32)
+
+    @property
+    def request_id(self) -> Optional[int]:
+        """The ENGINE-side request id (None while router-held)."""
+        return (self._req.request_id if self._req is not None
+                else None)
+
+    @property
+    def finish_time(self) -> Optional[float]:
+        if self._req is not None:
+            return self._req.finish_time
+        return self.finish_time_router
+
+    @property
+    def latency(self) -> Optional[float]:
+        ft = self.finish_time
+        return None if ft is None else ft - self.arrival_time
+
+    @property
+    def ttft(self) -> Optional[float]:
+        return self._req.ttft if self._req is not None else None
+
+    def __getattr__(self, name):
+        req = self.__dict__.get("_req")
+        if req is not None:
+            return getattr(req, name)
+        raise AttributeError(
+            f"RoutedRequest has no attribute {name!r} (the request "
+            f"has not been routed to an engine yet)")
+
+
+class Router:
+    """Admission owner over N in-process ``ServingEngine`` replicas —
+    see the module docstring for the routing/policy/overload design.
+
+    ``engines`` must be geometry-homogeneous (same prompt_len /
+    block_len / max_cache_len / pad token / KV dtype): the router
+    validates capacity once against replica 0 and any replica must be
+    able to serve any request.  Pass a private ``registry=`` when two
+    routers are A/B-compared (the engine-stats sharing caveat) and a
+    ``flight_recorder=`` for ``route``-event timelines keyed by
+    ``router_id`` (each ENGINE keeps its own recorder; engine request
+    ids are per-replica)."""
+
+    def __init__(self, engines: List[ServingEngine], *,
+                 affinity: bool = True, max_queue: Optional[int] = None,
+                 registry=None, flight_recorder=None,
+                 clock=time.perf_counter):
+        if not engines:
+            raise ValueError("Router needs >= 1 engine replica")
+        self._engines = list(engines)
+        e0 = self._engines[0]
+        for i, e in enumerate(self._engines[1:], start=1):
+            for attr in ("prompt_len", "max_cache_len", "block_len",
+                         "num_blocks", "kv_cache_dtype"):
+                if getattr(e, attr) != getattr(e0, attr):
+                    raise ValueError(
+                        f"replica {i} differs from replica 0 on "
+                        f"{attr} ({getattr(e, attr)} vs "
+                        f"{getattr(e0, attr)}) — the router assumes "
+                        f"any replica can serve any request")
+            if e.cfg.pad_token_id != e0.cfg.pad_token_id:
+                raise ValueError(
+                    f"replica {i} pad_token_id {e.cfg.pad_token_id} "
+                    f"!= replica 0's {e0.cfg.pad_token_id}")
+        self.affinity = bool(affinity)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError(
+                f"max_queue must be >= 1 (or None = unbounded), got "
+                f"{max_queue}")
+        self._clock = clock
+        self._queue: List[RoutedRequest] = []   # router-held only
+        self._handles: List[RoutedRequest] = []  # submission order
+        # requests swept terminal OUTSIDE a step (the submit-path
+        # timeout sweep): buffered so the NEXT step() returns them —
+        # run()'s "this call's terminal handles" contract must not
+        # silently lose a handle
+        self._orphan_terminals: List[RoutedRequest] = []
+        self._by_engine: dict = {}  # (engine idx, engine rid) -> handle
+        self._rr = 0                # round-robin cursor
+        self._next_id = 0
+        self._step_idx = 0
+        self._m = _RouterInstruments(
+            registry if registry is not None
+            else obs_metrics.get_registry())
+        self._m.engines.set(len(self._engines))
+        self._m.queue_depth.set(0)
+        self._fr = (flight_recorder if flight_recorder is not None
+                    else FlightRecorder(enabled=False))
+        self._fr.bind_clock(clock)
+
+    # -- intake --
+    def submit(self, prompt_ids, seq_len=None, max_new_tokens=None,
+               arrival_time=None, policy: Optional[str] = None,
+               stream: Optional[bool] = None,
+               spec_decode=None,
+               sampling: Optional[SamplingParams] = None,
+               priority: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               max_queue_delay_s: Optional[float] = None,
+               adapter: Optional[str] = None,
+               tenant: Optional[str] = None
+               ) -> Union[RoutedRequest, TokenStream]:
+        """Accept one request at the front door.  ``policy`` selects
+        workload defaults (``ROUTER_POLICIES``: "chat" streams at
+        interactive priority, "batch" is offline priority 0, "embed"
+        is prefill-only with ``max_new_tokens`` pinned to 1); every
+        other kwarg has ``ServingEngine.submit`` semantics and an
+        explicit value always wins over the policy default.  Returns
+        the :class:`RoutedRequest` handle — or, with streaming on, a
+        :class:`TokenStream` over it whose flushes land at the chosen
+        engine's harvest points.  The request is routed to a replica
+        at the next ``step()`` after its arrival time; until then it
+        is router-held (cancel/shed/timeout reach it here)."""
+        defaults = {}
+        if policy is not None:
+            if policy not in ROUTER_POLICIES:
+                raise ValueError(
+                    f"unknown router policy {policy!r} — known: "
+                    f"{sorted(ROUTER_POLICIES)}")
+            defaults = ROUTER_POLICIES[policy]
+        if policy == "embed" and max_new_tokens is not None \
+                and int(max_new_tokens) != 1:
+            raise ValueError(
+                f"policy='embed' is prefill-only (max_new_tokens "
+                f"pinned to 1) but max_new_tokens={max_new_tokens} "
+                f"was passed — drop the kwarg or the policy")
+        m = int(max_new_tokens if max_new_tokens is not None
+                else defaults.get("max_new_tokens", 32))
+        do_stream = bool(stream if stream is not None
+                         else defaults.get("stream", False))
+        prio = int(priority if priority is not None
+                   else defaults.get("priority", 0))
+        # fail-fast validation against replica-0 geometry (replicas
+        # are homogeneous) so a doomed request errors HERE, not inside
+        # a later step().  This deliberately mirrors (not shares)
+        # ServingEngine.submit's checks: the engine's validation is
+        # interleaved with its probe/rollback state machine and cannot
+        # be called statelessly.  The engine re-validates at dispatch,
+        # so a drift between the copies cannot admit an invalid
+        # request — and _route_arrived drops the request terminal
+        # before re-raising, so it cannot wedge the queue either; keep
+        # the two blocks in sync when adding submit kwargs.
+        e0 = self._engines[0]
+        ids = np.asarray(getattr(prompt_ids, "_value", prompt_ids))
+        ids = np.asarray(ids).reshape(-1).astype(np.int32)
+        if ids.size < 1 or ids.size > e0.prompt_len:
+            raise ValueError(
+                f"prompt must be 1..{e0.prompt_len} tokens, got "
+                f"{ids.size}")
+        n = int(seq_len) if seq_len is not None else int(ids.size)
+        if n < 1 or n > ids.size:
+            raise ValueError(
+                f"seq_len must be in [1, {ids.size}], got {n}")
+        if m < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {m}")
+        if n + m - 1 > e0.max_cache_len:
+            raise ValueError(
+                f"prompt ({n}) + max_new_tokens ({m}) - 1 = "
+                f"{n + m - 1} tokens exceeds max_cache_len "
+                f"({e0.max_cache_len})")
+        if e0._blocks_needed(n, m) > e0.num_blocks:
+            raise ValueError(
+                f"request needs {e0._blocks_needed(n, m)} blocks but "
+                f"each replica pool has num_blocks={e0.num_blocks} — "
+                f"no replica could ever admit it")
+        if adapter is not None:
+            adapter = str(adapter)
+            for i, e in enumerate(self._engines):
+                if e._adapters is None or \
+                        e._adapters.state(adapter) is None:
+                    raise ValueError(
+                        f"adapter {adapter!r} is not registered on "
+                        f"replica {i} — every replica must be able "
+                        f"to serve any request")
+        if sampling is not None:
+            if not isinstance(sampling, SamplingParams):
+                raise ValueError(
+                    f"sampling must be a SamplingParams, got "
+                    f"{type(sampling).__name__}")
+            sampling.validate()
+        if spec_decode is not None:
+            # mirror the engine's spec validation (a value the engine
+            # would reject must fail HERE — a dispatch-time ValueError
+            # would escape step()/run() instead of submit())
+            if int(spec_decode) < 1:
+                raise ValueError(
+                    f"spec_decode must be >= 1 draft tokens, got "
+                    f"{spec_decode}")
+            if sampling is not None and \
+                    sampling.mask_processor is not None:
+                raise ValueError(
+                    "spec_decode cannot compose with a token-mask "
+                    "processor (see ServingEngine.submit)")
+        if deadline_s is not None and float(deadline_s) <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0 seconds from arrival, got "
+                f"{deadline_s}")
+        if max_queue_delay_s is not None \
+                and float(max_queue_delay_s) < 0:
+            raise ValueError(
+                f"max_queue_delay_s must be >= 0, got "
+                f"{max_queue_delay_s}")
+        now = self._clock()
+        arrival = now if arrival_time is None else float(arrival_time)
+        pr = RoutedRequest(self._next_id, ids, n, m, arrival,
+                           e0.cfg.pad_token_id, policy)
+        pr.priority = prio
+        pr.deadline = (None if deadline_s is None
+                       else arrival + float(deadline_s))
+        pr.max_queue_delay_s = (None if max_queue_delay_s is None
+                                else float(max_queue_delay_s))
+        pr.adapter = adapter
+        pr._kw = dict(seq_len=n, max_new_tokens=m,
+                      arrival_time=arrival, spec_decode=spec_decode,
+                      sampling=sampling, priority=prio,
+                      deadline_s=(None if deadline_s is None
+                                  else float(deadline_s)),
+                      max_queue_delay_s=pr.max_queue_delay_s,
+                      adapter=adapter, tenant=tenant)
+        # bounded front-door queue, PR-7 semantics over ROUTER-HELD
+        # requests only (dispatched ones are the engines' problem):
+        # sweep expired waiters first, then displace a strictly-worse
+        # victim or refuse THIS arrival
+        if self.max_queue is not None and \
+                len(self._queue) >= self.max_queue:
+            self._sweep_timeouts(now, self._orphan_terminals)
+        if self.max_queue is not None and \
+                len(self._queue) >= self.max_queue:
+            worst = min(reversed(self._queue), key=self._shed_key)
+            if self._shed_key(worst) < (prio,
+                                        _neg_deadline(pr.deadline)):
+                self._queue.remove(worst)
+                worst._terminate("shed", now)
+                self._m.shed.inc(reason="evicted")
+                self._fr.emit("shed", worst.router_id, self._step_idx)
+            else:
+                self._m.shed.inc(reason="rejected")
+                raise AdmissionError(
+                    f"router queue full ({len(self._queue)} >= "
+                    f"max_queue={self.max_queue}) and no router-held "
+                    f"request is of strictly lower class than this "
+                    f"arrival (priority={prio}, "
+                    f"deadline_s={deadline_s})",
+                    queue_depth=len(self._queue),
+                    max_queue=self.max_queue)
+        self._next_id += 1
+        self._queue.append(pr)
+        self._handles.append(pr)
+        self._m.requests.inc(
+            policy=policy if policy is not None else "default")
+        self._m.queue_depth.set(len(self._queue))
+        self._fr.emit("submit", pr.router_id, self._step_idx,
+                      seq_len=n, max_new=m, priority=prio,
+                      policy=policy if policy is not None else "default",
+                      queue_depth=len(self._queue))
+        if do_stream:
+            return TokenStream(self, pr)
+        return pr
+
+    @staticmethod
+    def _shed_key(pr: RoutedRequest):
+        """"Worseness" (smaller = shed first): lowest priority, then
+        latest deadline — the engine's ordering lifted as-is."""
+        return (pr.priority, _neg_deadline(pr.deadline))
+
+    # -- lifecycle --
+    def cancel(self, handle_or_id) -> bool:
+        """Drop a request wherever it currently lives.  Router-held:
+        removed from the front-door queue, terminal ``"cancelled"``,
+        counted ``serving.requests_cancelled{phase="router"}`` — the
+        queue level no single engine can see.  Already routed:
+        delegated to the owning engine's ``cancel()`` (which counts
+        its own phase).  Accepts a handle or a ``router_id``.
+        Returns False for unknown/already-terminal requests."""
+        if isinstance(handle_or_id, RoutedRequest):
+            pr = handle_or_id
+        else:
+            rid = int(handle_or_id)
+            pr = next((h for h in self._handles
+                       if h.router_id == rid), None)
+            if pr is None:
+                return False
+        if pr._req is not None:
+            return self._engines[pr.engine].cancel(pr._req.request_id)
+        if pr._state != "queued":
+            return False
+        self._queue.remove(pr)
+        pr._terminate("cancelled", self._clock())
+        self._m.cancelled.inc(phase="router")
+        self._m.queue_depth.set(len(self._queue))
+        self._fr.emit("cancel", pr.router_id, self._step_idx,
+                      phase="router")
+        return True
+
+    def _sweep_timeouts(self, now: float, out: List[RoutedRequest]):
+        """Finish router-held requests whose wait broke their
+        queue-delay SLO — the engine's rule applied one level up (a
+        request that never even reached a replica queue is the
+        clearest possible timeout)."""
+        for pr in [p for p in self._queue
+                   if p.max_queue_delay_s is not None
+                   and now - p.arrival_time > p.max_queue_delay_s]:
+            self._queue.remove(pr)
+            pr._terminate("timeout", now)
+            self._m.timeouts.inc()
+            self._fr.emit("timeout", pr.router_id, self._step_idx)
+            out.append(pr)
+        self._m.queue_depth.set(len(self._queue))
+
+    # -- routing --
+    def _choose(self, pr: RoutedRequest):
+        """Pick a replica order for ``pr`` (best first) plus each
+        candidate's affinity metadata ``meta[engine] = (prefix_tokens,
+        adapter_hit)`` — the decision instruments/event must describe
+        the replica that actually ACCEPTED, which under a bounded-
+        engine-queue spill may not be the best-ranked one.  Affinity
+        mode sorts by ``(load, -adapter_hit, -prefix_tokens,
+        -blocks_free, index)`` — load primary, affinity a strict
+        tie-break (see module docstring); round-robin mode cycles the
+        cursor (every candidate's metadata is zero: affinity was
+        never consulted)."""
+        n = len(self._engines)
+        if not self.affinity:
+            first = self._rr % n
+            self._rr += 1
+            order = [(first + k) % n for k in range(n)]
+            return order, {i: (0, False) for i in order}
+        scored = []
+        meta = {}
+        for i, e in enumerate(self._engines):
+            rep = e.load_report()
+            load = (rep["queue_depth"] + rep["active_slots"]
+                    + rep["swapped_waiting"])
+            ahit = int(pr.adapter is not None
+                       and pr.adapter in rep["hbm_adapters"])
+            ptok = e.prefix_match(pr._ids[:pr.seq_len])
+            scored.append((load, -ahit, -ptok, -rep["blocks_free"], i))
+            meta[i] = (ptok, bool(ahit))
+        scored.sort()
+        return [s[4] for s in scored], meta
+
+    def _route_arrived(self, now: float):
+        """Dispatch every ARRIVED router-held request, in submission
+        (FIFO) order — class ordering is the ENGINE's job once queued,
+        and FIFO dispatch keeps the single-replica router's engine-
+        side schedule byte-identical to bare submission.  A replica
+        refusing with ``AdmissionError`` (bounded engine queue) spills
+        to the next candidate; when every replica refuses, the
+        request stays router-held and retries next step.  Any OTHER
+        engine-submit failure is a programming error the router's own
+        fail-fast validation should have caught — the request is
+        dropped terminal first so a raise cannot wedge the queue into
+        re-raising forever."""
+        for pr in [p for p in self._queue if p.arrival_time <= now]:
+            order, meta = self._choose(pr)
+            req = None
+            for ei in order:
+                try:
+                    req = self._engines[ei].submit(
+                        pr._ids, **pr._kw)
+                except AdmissionError:
+                    continue
+                except BaseException:
+                    self._queue.remove(pr)
+                    pr._terminate("cancelled", now)
+                    self._m.queue_depth.set(len(self._queue))
+                    raise
+                break
+            if req is None:
+                continue                    # every replica refused
+            self._queue.remove(pr)
+            pr._bind(ei, req)
+            self._by_engine[(ei, req.request_id)] = pr
+            # decision metadata of the replica that actually took the
+            # request (a spill target's own affinity, not the best
+            # candidate's)
+            ptok, ahit = meta[ei]
+            reason = ("round_robin" if not self.affinity else
+                      "adapter" if ahit else
+                      "prefix" if ptok > 0 else "load")
+            self._m.routed.inc(reason=reason)
+            if ptok:
+                self._m.prefix_tokens.inc(ptok)
+            if ahit:
+                self._m.adapter_hits.inc()
+            self._fr.emit(
+                "route", pr.router_id, self._step_idx, engine=ei,
+                affinity=int(ptok), adapter_hit=int(ahit),
+                policy=(pr.policy if pr.policy is not None
+                        else "default"),
+                reason=reason)
+        self._m.queue_depth.set(len(self._queue))
+
+    # -- scheduling --
+    def step(self, now: Optional[float] = None) -> List[RoutedRequest]:
+        """One front-door iteration: sweep router-held queue-delay
+        timeouts, route every arrived router-held request, then step
+        each replica once.  Returns the handles that reached a
+        terminal state this iteration (router timeouts + every
+        replica's finished/timed-out requests)."""
+        self._step_idx += 1
+        t_now = self._clock() if now is None else now
+        out: List[RoutedRequest] = []
+        if self._orphan_terminals:        # swept during a submit()
+            out.extend(self._orphan_terminals)
+            self._orphan_terminals = []
+        self._sweep_timeouts(t_now, out)
+        self._route_arrived(t_now)
+        for ei, e in enumerate(self._engines):
+            for req in e.step(t_now):
+                h = self._by_engine.get((ei, req.request_id))
+                if h is not None:
+                    out.append(h)
+        return out
+
+    def _idle(self) -> bool:
+        """No replica holds queued/active/swapped work."""
+        for e in self._engines:
+            rep = e.load_report()
+            if rep["queue_depth"] or rep["active_slots"] \
+                    or rep["swapped_waiting"]:
+                return False
+        return True
+
+    def _stall_diagnosis(self, wall_timeout_s: float) -> str:
+        now = self._clock()
+        per = ", ".join(
+            f"e{i}(q={r['queue_depth']} act={r['active_slots']} "
+            f"free={r['blocks_free']})"
+            for i, r in enumerate(e.load_report()
+                                  for e in self._engines))
+        return (f"router loop exceeded wall_timeout_s={wall_timeout_s} "
+                f"without draining: router-held={len(self._queue)} "
+                f"(arrived={sum(p.arrival_time <= now for p in self._queue)}), "
+                f"replicas: {per}")
+
+    def run(self, max_iters: Optional[int] = None,
+            wall_timeout_s: Optional[float] = None
+            ) -> List[RoutedRequest]:
+        """Drain the front door: route/step until every submitted
+        request is terminal.  Mirrors ``ServingEngine.run`` — idle
+        sleeps ahead of future arrivals, ``wall_timeout_s`` turns a
+        wedged fleet into a diagnosable ``EngineStalledError``.
+        Returns this call's terminal handles in router-submission
+        order."""
+        finished: List[RoutedRequest] = []
+        iters = 0
+        start = self._clock()
+        while self._queue or not self._idle():
+            now = self._clock()
+            if wall_timeout_s is not None and \
+                    now - start > wall_timeout_s:
+                raise EngineStalledError(
+                    self._stall_diagnosis(wall_timeout_s))
+            if self._idle() and self._queue:
+                next_arrival = min(p.arrival_time for p in self._queue)
+                if next_arrival > now:
+                    time.sleep(min(0.005, next_arrival - now))
+                    continue
+            n_before = len(finished)
+            finished.extend(self.step(now))
+            if len(finished) == n_before and self._idle():
+                # arrived work that no replica would take (bounded
+                # engine queues, pool pressure): nap, don't hot-spin
+                time.sleep(0.001)
+            iters += 1
+            if max_iters is not None and iters > max_iters:
+                busy = sum(e.load_report()["active_slots"] > 0
+                           for e in self._engines)
+                raise RuntimeError(
+                    f"router loop exceeded max_iters={max_iters} with "
+                    f"{len(self._queue)} router-held requests and "
+                    f"{busy} busy replicas")
+        return sorted(finished, key=lambda h: h.router_id)
+
+    # -- introspection --
+    def stats(self) -> dict:
+        """Router-level counter deltas plus one ``load_report()``
+        snapshot per replica."""
+        return {
+            "engines": len(self._engines),
+            "affinity": self.affinity,
+            "requests": int(self._m.since_init(self._m.requests)),
+            "routed_by_reason": {
+                reason: int(self._m.routed_since(reason))
+                for reason in ROUTE_REASONS},
+            "prefix_affinity_tokens": int(
+                self._m.since_init(self._m.prefix_tokens)),
+            "adapter_affinity_hits": int(
+                self._m.since_init(self._m.adapter_hits)),
+            "shed": int(self._m.since_init(self._m.shed)),
+            "timeouts": int(self._m.since_init(self._m.timeouts)),
+            "cancelled_router": int(
+                self._m.cancelled.value(phase="router")
+                - self._m._cancel_base),
+            "queue_depth": len(self._queue),
+            "per_engine": [e.load_report() for e in self._engines],
+        }
+
+    @property
+    def engines(self) -> List[ServingEngine]:
+        return list(self._engines)
+
+    @property
+    def flight_recorder(self) -> FlightRecorder:
+        return self._fr
+
+    def explain(self, router_id: int) -> str:
+        """The router-level lifecycle of one request ("routed to
+        engine 1 (prefix affinity 384 tokens)") from the router's
+        flight recorder; engine-side detail lives in the owning
+        replica's own recorder."""
+        return self._fr.explain(router_id)

@@ -193,6 +193,16 @@ see a constant fair term and schedule byte-identically to the
 pre-tenant engine.  The goodput ledger and SLO-attainment counters
 carry a per-tenant label, and admit flight-recorder events carry
 ``adapter``/``tenant``/``deficit``.
+
+**Token streaming** (``submit(stream=True)``): the front-door half of
+PR 12 — a :class:`TokenStream` handle whose ``read()`` drains the
+tokens that are already host truth, which on the dispatch-ahead
+engine means exactly the harvest points: streaming forces nothing,
+adds no entry to ``ASYNC_SYNC_REASONS``, and the concatenated flushes
+are token-for-token the non-streamed output.  ``load_report()`` is
+the matching scheduler-facing surface: one host-side snapshot (queue
+depth, blocks free, HBM-resident adapters, radix root stats) the
+replica router of ``inference/router.py`` reads as its load signal.
 """
 
 from __future__ import annotations
@@ -274,6 +284,10 @@ ASYNC_SYNC_REASONS = (
     "cancel",       # cancel() must know which tokens already exist
     "drain",        # run() is about to raise/hand control to the caller
 )
+
+# the terminal request states shared by the engine and the router: a
+# request in any of these will never emit another token
+TERMINAL_STATES = ("finished", "timeout", "shed", "cancelled")
 
 # sub-ms resolution for the host-vs-dispatch step split: on real
 # accelerators the host scheduler slice this histogram isolates is the
@@ -1091,6 +1105,81 @@ class Request:
         return self.first_token_time - self.arrival_time
 
 
+class TokenStream:
+    """Incremental token stream of one streaming request
+    (``submit(stream=True)`` returns one; so does the router's).
+
+    A stream handle never drives the device: ``read()`` drains the
+    tokens that are ALREADY host truth — i.e. everything the engine
+    has harvested so far — and advances a cursor.  On a
+    dispatch-ahead engine the tokens of a deferred block become host
+    truth at the harvest point (after the NEXT dispatch was
+    enqueued), so the stream's flush boundaries ARE the pipeline's
+    harvest points: streaming adds no materialization the engine was
+    not already doing, no new entry in ``ASYNC_SYNC_REASONS``, and
+    the concatenation of every flush is token-for-token the
+    non-streamed ``Request.output`` (terminal pad tail included — the
+    ``generate()`` convention).
+
+    ``owner`` is whatever schedules the request (a ``ServingEngine``
+    or a ``Router``): iterating the stream calls ``owner.step()``
+    between flushes, so ``for chunk in stream: ...`` is a working
+    chat loop.  ``read()``/``finished`` are the primitives for
+    callers that drive the scheduler themselves."""
+
+    def __init__(self, owner, target):
+        self._owner = owner
+        self._target = target
+        self._pos = 0
+        # generous safety cap for __iter__: a healthy drain finishes a
+        # request in far fewer steps than this; a wedged pool raises
+        # instead of spinning silently
+        self._max_iter_steps = 100_000
+
+    @property
+    def request(self):
+        """The underlying request handle (engine ``Request``, or the
+        router's ``RoutedRequest``)."""
+        return self._target
+
+    @property
+    def finished(self) -> bool:
+        return self._target.state in TERMINAL_STATES
+
+    @property
+    def n_read(self) -> int:
+        """Tokens delivered through this handle so far."""
+        return self._pos
+
+    def read(self) -> np.ndarray:
+        """Every token that became host truth since the last read
+        (possibly empty) — never blocks, never forces a pending
+        harvest."""
+        toks = self._target.tokens
+        new = toks[self._pos:]
+        self._pos = len(toks)
+        return np.asarray(new, np.int32)
+
+    def __iter__(self):
+        steps = 0
+        while True:
+            chunk = self.read()
+            if chunk.size:
+                yield chunk
+            if self.finished:
+                tail = self.read()   # terminal pad landed after the
+                if tail.size:        # last scheduler flush
+                    yield tail
+                return
+            self._owner.step()
+            steps += 1
+            if steps > self._max_iter_steps:
+                raise RuntimeError(
+                    f"TokenStream iteration exceeded "
+                    f"{self._max_iter_steps} scheduler steps without "
+                    f"the request reaching a terminal state")
+
+
 class ServingEngine:
     """Continuous-batching serving session over a paged KV block pool.
 
@@ -1840,7 +1929,8 @@ class ServingEngine:
                priority: int = 0, deadline_s: Optional[float] = None,
                max_queue_delay_s: Optional[float] = None,
                adapter: Optional[str] = None,
-               tenant: Optional[str] = None) -> Request:
+               tenant: Optional[str] = None,
+               stream: bool = False):
         """Enqueue one request.  ``prompt_ids`` is a 1-D id array of at
         most ``prompt_len`` tokens (right-padded internally);
         ``arrival_time`` (in ``clock()`` units) lets a trace replay
@@ -1889,7 +1979,13 @@ class ServingEngine:
         ``"default"`` bucket = plain FIFO-within-class): within a
         priority/EDF class, admission order becomes deficit-weighted
         round-robin over tenants, so one tenant's burst cannot starve
-        another's steady stream."""
+        another's steady stream.
+
+        ``stream=True`` returns a :class:`TokenStream` over the
+        request instead of the request itself (``handle.request``
+        recovers it): incremental tokens drain through ``read()`` at
+        the engine's harvest boundaries, token-for-token identical to
+        the non-streamed output — see the TokenStream docstring."""
         ids = np.asarray(getattr(prompt_ids, "_value", prompt_ids))
         ids = np.asarray(ids).reshape(-1).astype(np.int32)
         if ids.size < 1 or ids.size > self.prompt_len:
@@ -2118,6 +2214,8 @@ class ServingEngine:
             self._update_block_gauges()
             self._m.queue_depth.set(len(self._queue))
             raise
+        if stream:
+            return TokenStream(self, req)
         return req
 
     def cancel(self, request_id: int) -> bool:
@@ -3808,6 +3906,59 @@ class ServingEngine:
                 reason: int(self._m.syncs_since(reason))
                 for reason in ASYNC_SYNC_REASONS},
         }
+
+    def load_report(self) -> dict:
+        """One host-side load/residency snapshot for schedulers ABOVE
+        the engine (the router's load signal and affinity probes; a
+        future external scheduler reads the same dict instead of
+        scraping gauges).  Pure host state — no dispatch, no pending-
+        harvest flush — so polling it every routing decision is free:
+
+        - ``queue_depth`` / ``active_slots`` / ``prefilling`` /
+          ``swapped_waiting``: outstanding work by phase (active_slots
+          counts occupied slots, prefilling rows included);
+        - ``slots_total`` / ``blocks_free`` / ``blocks_in_use`` /
+          ``blocks_total`` / ``block_len``: capacity headroom
+          (blocks_free counts free + reclaimable-cached, the pool's
+          ``available()`` convention);
+        - ``hbm_adapters``: adapter names resident in the HBM arena
+          right now (``[]`` without an AdapterStore) — the adapter-
+          affinity signal;
+        - ``radix``: the prefix tree's root stats (hbm/host block
+          counts + root fanout; ``None`` off radix mode) — tree SIZE
+          only; a router scores prefix affinity by calling
+          ``prefix_match()`` per prompt;
+        - ``kv_cache_dtype``: the at-rest cache dtype (replica
+          homogeneity check)."""
+        return {
+            "queue_depth": len(self._queue),
+            "active_slots": sum(r is not None for r in self._slots),
+            "prefilling": len(self._prefilling),
+            "swapped_waiting": len(self._swapped),
+            "slots_total": self.num_slots,
+            "blocks_free": self._pool.available(),
+            "blocks_in_use": self._pool.in_use(),
+            "blocks_total": self.num_blocks,
+            "block_len": self.block_len,
+            "hbm_adapters": (self._adapters.hbm_resident()
+                             if self._adapters is not None else []),
+            "radix": (self._radix.root_stats()
+                      if self._radix is not None else None),
+            "kv_cache_dtype": self.kv_cache_dtype,
+        }
+
+    def prefix_match(self, prompt_ids) -> int:
+        """Token-granular longest-prefix match of ``prompt_ids``
+        against THIS engine's prefix index (0 off radix mode) —
+        read-only (no pin, no LRU touch): the router's prefix-affinity
+        probe.  The admission-time re-probe still decides what
+        actually maps."""
+        if self._radix is None:
+            return 0
+        ids = np.asarray(getattr(prompt_ids, "_value", prompt_ids))
+        ids = np.asarray(ids).reshape(-1).astype(np.int32)
+        matched, _span = self._radix.match(ids)
+        return int(matched)
 
     @property
     def metrics_registry(self):

@@ -48,6 +48,8 @@ from .spans import format_span_name
 # a parallel event stream no consumer (explain, the CLI) knows about
 EVENT_KINDS = frozenset({
     "submit",         # accepted into the queue
+    "route",          # router chose an engine replica (engine, affinity,
+    #                   policy — emitted by Router, not the engine)
     "admit",          # queue -> slot (prefill starts after mapped blocks)
     "prefix_hit",     # admission mapped cached blocks (tier=hbm|host|partial)
     "prefill_chunk",  # one chunked-prefill dispatch for this request
@@ -253,6 +255,21 @@ def explain_events(events: List[FlightEvent], request_id: int) -> str:
                 bits.append(f"{k}={sub.attrs[k]}")
         parts.append(bits[0] + " (" + ", ".join(bits[1:]) + ")"
                      if len(bits) > 1 else bits[0])
+    for rt in by_kind.get("route", []):
+        clause = f"routed to engine {rt.attrs.get('engine', '?')}"
+        details = []
+        aff = int(rt.attrs.get("affinity", 0))
+        if aff:
+            details.append(f"prefix affinity {aff} tokens")
+        if rt.attrs.get("adapter_hit"):
+            details.append("adapter resident")
+        if "policy" in rt.attrs:
+            details.append(f"policy {rt.attrs['policy']}")
+        if "reason" in rt.attrs and not details:
+            details.append(f"by {rt.attrs['reason']}")
+        if details:
+            clause += " (" + ", ".join(details) + ")"
+        parts.append(clause)
     if admits:
         adm = admits[0]
         clause = f"admitted at step {adm.step} into slot " \

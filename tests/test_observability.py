@@ -512,6 +512,7 @@ def test_metrics_name_lint_clean():
              "serving.goodput.", "serving.slo.", "serving.step.",
              "serving.async.", "serving.fault.",
              "serving.lora.", "serving.fairshare.",
+             "serving.router.",
              "serving.tpot_seconds")), n
         assert n in names, n
     kinds = {r[3]: r[2] for r in regs}
@@ -557,6 +558,19 @@ def test_metrics_name_lint_clean():
     assert kinds["serving.lora.swap_ins"] == "counter"
     assert kinds["serving.lora.gathers"] == "counter"
     assert kinds["serving.fairshare.reorders"] == "counter"
+    # the front-door router set (PR 12): intake/decision counters
+    # carry their label tuples, the queue/replica gauges stay gauges
+    assert kinds["serving.router.requests"] == "counter"
+    assert kinds["serving.router.routed"] == "counter"
+    assert kinds["serving.router.prefix_affinity_tokens"] == "counter"
+    assert kinds["serving.router.adapter_affinity_hits"] == "counter"
+    assert kinds["serving.router.shed"] == "counter"
+    assert kinds["serving.router.timeouts"] == "counter"
+    assert kinds["serving.router.queue_depth"] == "gauge"
+    assert kinds["serving.router.engines"] == "gauge"
+    assert by_lbl["serving.router.requests"] == ("policy",)
+    assert by_lbl["serving.router.routed"] == ("reason",)
+    assert by_lbl["serving.router.shed"] == ("reason",)
     assert by_lbl["serving.fairshare.served_tokens"] == ("tenant",)
     assert by_lbl["serving.fairshare.deficit"] == ("tenant",)
     # rule 4 fires on a missing required name

@@ -840,3 +840,22 @@ def test_bench_llm_serving_section():
     assert lo["starvation"]["gate_steady_improves"]
     assert lo["starvation"]["gate_reordered"]
     assert "k8_vs_k1" in lo
+    # PR 12: the front-door router arm — deterministic gates only
+    # (token-exact outputs across arms, prefix hit tokens strictly
+    # higher and adapter swap-ins strictly lower under affinity);
+    # tokens/s rides along ungated
+    ro = out["router"]
+    for k in ("replicas", "turns", "conversations", "affinity",
+              "round_robin", "hit_tokens_vs_round_robin"):
+        assert k in ro, k
+    for arm in ("affinity", "round_robin"):
+        for k in ("tokens_per_s", "prefix_hit_tokens",
+                  "adapter_swap_ins", "routed_by_reason",
+                  "prefix_affinity_tokens", "adapter_affinity_hits"):
+            assert k in ro[arm], (arm, k)
+    assert ro["gate_token_exact"]
+    assert ro["gate_prefix_hits_higher"]
+    assert ro["gate_swap_ins_lower"]
+    # round-robin never consulted affinity; affinity never cycled
+    assert ro["round_robin"]["prefix_affinity_tokens"] == 0
+    assert ro["affinity"]["routed_by_reason"]["round_robin"] == 0

@@ -1,0 +1,144 @@
+"""Token streaming out of the serving engine (PR 12, front-door half
+one): ``submit(stream=True)`` returns a ``TokenStream`` whose flushes
+land at the dispatch-ahead harvest points — token-for-token identical
+to the non-streamed output and to ``generate()``, with NO new forced-
+sync reason (the stream only ever reads tokens that are already host
+truth).
+
+Tier-1 budget discipline: ONE tiny 1-layer llama at module scope,
+steps_per_call=1, short prompts/budgets, private registries when two
+engines are compared."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.inference import ServingEngine, TokenStream
+from paddle_tpu.inference.serving import (ASYNC_SYNC_REASONS,
+                                          TERMINAL_STATES)
+from paddle_tpu.observability import MetricsRegistry
+
+P, C, BL = 8, 40, 4
+
+
+@pytest.fixture(scope="module")
+def netm():
+    paddle.seed(1234)
+    cfg = models.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64)
+    net = models.LlamaForCausalLM(cfg)
+    net.eval()
+    return cfg, net
+
+
+def _gen_ref(net, ids, max_new):
+    out = net.generate(paddle.to_tensor(ids[None, :]),
+                       max_new_tokens=max_new, max_cache_len=C,
+                       compute_dtype="float32")
+    return np.asarray(out._value)[0]
+
+
+def _mk(net, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return ServingEngine(net, num_slots=2, prompt_len=P,
+                         max_cache_len=C, steps_per_call=1,
+                         block_len=BL, chunk_len=4, num_blocks=12,
+                         compute_dtype="float32", **kw)
+
+
+@pytest.fixture(scope="module")
+def shared_engine(netm):
+    # ONE reusable engine for the tests that only need "an engine"
+    # (its jit caches are per-engine, so sharing saves recompiles on
+    # the tier-1 budget); each test drains it before returning
+    return _mk(netm[1])
+
+
+def test_stream_vocabulary_closed():
+    # streaming must not add a sync reason: the PR-10 closed
+    # vocabulary is unchanged (a stream read never forces a harvest)
+    assert ASYNC_SYNC_REASONS == (
+        "eos", "budget", "mask", "penalty", "spec", "chunk_final",
+        "resume", "preempt", "cancel", "drain")
+
+
+def test_stream_token_exact_and_incremental(netm, shared_engine):
+    """The combined trace: a streamed and a non-streamed twin of the
+    same request co-resident in one engine, plus a second engine
+    running the identical trace non-streamed — token parity all
+    three ways (stream == non-streamed == generate()), genuinely
+    incremental flushes at harvest boundaries, equal sync/harvest
+    counters between the streamed and unstreamed engines, and a
+    clean pool audit every step."""
+    cfg, net = netm
+    rng = np.random.default_rng(7)
+    ids_a = rng.integers(0, cfg.vocab_size, (7,)).astype(np.int32)
+    ids_b = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+
+    # engine 1: streamed A + plain B (mixed batch)
+    e1 = _mk(net)
+    st = e1.submit(ids_a, max_new_tokens=6, stream=True,
+                   arrival_time=0.0)
+    assert isinstance(st, TokenStream)
+    assert st.request.state == "queued" and not st.finished
+    rb = e1.submit(ids_b, max_new_tokens=5, arrival_time=0.0)
+    flushes = []
+    steps = 0
+    while not (st.finished and rb.state in TERMINAL_STATES):
+        e1.step(now=0.0)
+        e1._pool.check()
+        chunk = st.read()           # a flush per harvest boundary
+        if chunk.size:
+            flushes.append(chunk)
+        steps += 1
+        assert steps < 60
+    tail = st.read()                # terminal pad lands at finish
+    if tail.size:
+        flushes.append(tail)
+    streamed = np.concatenate(flushes)
+
+    # engine 2 (private registry): identical trace, nothing streamed
+    e2 = shared_engine
+    ra2 = e2.submit(ids_a, max_new_tokens=6)
+    rb2 = e2.submit(ids_b, max_new_tokens=5)
+    e2.run()
+
+    # token parity: stream == non-streamed submit() == generate()
+    assert np.array_equal(streamed, ra2.output)
+    assert np.array_equal(streamed, _gen_ref(net, ids_a, 6))
+    assert np.array_equal(rb.output, rb2.output)
+    # genuinely incremental: more than one nonempty flush, and no
+    # flush carried the whole stream at once
+    assert len(flushes) >= 3
+    assert max(len(f) for f in flushes) < streamed.size
+    assert st.n_read == streamed.size == 6
+    assert st.read().size == 0      # drained stream stays empty
+
+    # streaming changed NOTHING about scheduling: the streamed and
+    # unstreamed engines harvested and force-synced identically
+    s1, s2 = e1.stats(), e2.stats()
+    for k in ("async_syncs", "async_harvests", "block_dispatches",
+              "prefill_chunks", "decode_steps", "dispatched_tokens"):
+        assert s1[k] == s2[k], k
+    assert s1["async_syncs_by_reason"] == s2["async_syncs_by_reason"]
+    # the deferred-harvest pipeline actually engaged (flush
+    # boundaries were real harvest points, not lockstep syncs)
+    assert s1["async_harvests"] > 0
+
+
+def test_stream_iterator_protocol(netm, shared_engine):
+    """``for chunk in stream`` drives the engine itself and yields
+    every token exactly once, pad tail included."""
+    cfg, net = netm
+    rng = np.random.default_rng(8)
+    ids = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    eng = shared_engine
+    st = eng.submit(ids, max_new_tokens=5, stream=True)
+    chunks = list(st)
+    assert all(c.size for c in chunks)
+    got = np.concatenate(chunks)
+    assert np.array_equal(got, _gen_ref(net, ids, 5))
+    assert st.finished and st.request.state == "finished"

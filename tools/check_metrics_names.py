@@ -144,6 +144,20 @@ REQUIRED_INSTRUMENTS = {
     "serving.fairshare.served_tokens": ("counter", ("tenant",)),
     "serving.fairshare.deficit": ("gauge", ("tenant",)),
     "serving.fairshare.reorders": ("counter", ()),
+    # front-door router (PR 12, inference/router.py
+    # _RouterInstruments): intake by workload policy, routing
+    # decisions by closed reason vocabulary, the affinity signal
+    # magnitudes the bench's router arm gates against round-robin,
+    # the router-held queue gauge/replica-count gauge and the
+    # PR-7-semantics shed/timeout counters lifted above the engines
+    "serving.router.requests": ("counter", ("policy",)),
+    "serving.router.routed": ("counter", ("reason",)),
+    "serving.router.prefix_affinity_tokens": ("counter", ()),
+    "serving.router.adapter_affinity_hits": ("counter", ()),
+    "serving.router.shed": ("counter", ("reason",)),
+    "serving.router.timeouts": ("counter", ()),
+    "serving.router.queue_depth": ("gauge", ()),
+    "serving.router.engines": ("gauge", ()),
 }
 
 
