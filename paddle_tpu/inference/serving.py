@@ -263,8 +263,15 @@ class EngineStalledError(RuntimeError):
 # bytes, never recomputes — and is kept in the vocabulary as the
 # ledger's proof of that (a recompute-mode preemption path would
 # charge it; see notes.md PR 9).
-GOODPUT_REASONS = ("spec_reject", "recompute_preempt",
-                   "recompute_cache", "pad")
+GOODPUT_REASONS = (
+    "spec_reject",
+    "recompute_preempt",   # graftlint: disable=vocab — structurally
+    #                        zero by design (exact-bytes preemption
+    #                        never recomputes); the entry IS the proof,
+    #                        so no emit site exists on purpose
+    "recompute_cache",
+    "pad",
+)
 
 # the dispatch-ahead pipeline's closed forced-sync vocabulary: every
 # iteration that must materialize device outputs EARLY — instead of
@@ -288,6 +295,22 @@ ASYNC_SYNC_REASONS = (
 # the terminal request states shared by the engine and the router: a
 # request in any of these will never emit another token
 TERMINAL_STATES = ("finished", "timeout", "shed", "cancelled")
+
+# closed label vocabularies for the swap/shed/cancel counters (shared
+# by the engine and the router; graftlint's vocab pass resolves every
+# literal label site against these and flags drift/dead entries):
+# which tier traffic a swap moved ("preempt" = a victim's blocks,
+# "cache" = prefix-cache demotion/promotion) …
+SWAP_REASONS = ("preempt", "cache")
+# … why a request was shed from a bounded queue ("evicted" = displaced
+# by a strictly-higher-class arrival, "rejected" = the arrival itself
+# was refused with AdmissionError) …
+SHED_REASONS = ("evicted", "rejected")
+# … and which phase a cancel() caught the request in ("router" is the
+# front-door queue above any engine).  "prefill"/"decode" reach the
+# counter dynamically via req.state, so the vocab pass checks literal
+# membership but skips dead-entry detection for this one.
+CANCEL_PHASES = ("queued", "prefill", "decode", "swapped", "router")
 
 # sub-ms resolution for the host-vs-dispatch step split: on real
 # accelerators the host scheduler slice this histogram isolates is the
@@ -1642,7 +1665,10 @@ class ServingEngine:
         slot needs a host accept/rollback decision.  The first
         matching reason is charged to serving.async.syncs."""
         if not self.async_dispatch:
-            return "off"              # kill-switch arm: never counted
+            # kill-switch arm: never charged to the counter (the inc
+            # below is gated on async_dispatch), so deliberately NOT
+            # an ASYNC_SYNC_REASONS member
+            return "off"              # graftlint: disable=vocab
         if self.cfg.eos_token_id is not None:
             return "eos"
         for i in active:
@@ -1805,6 +1831,7 @@ class ServingEngine:
             sum(r is not None for r in self._slots))
 
     # -- host tier (shared by preemption swap + prefix-cache demotion) --
+    # graftlint: plan-phase
     def _gather_rows(self, ids_row: np.ndarray,
                      materialize: bool = True):
         """Read ``ids_row``'s arena rows (EXACT at-rest bytes: float
@@ -1820,7 +1847,11 @@ class ServingEngine:
         t0 = self._clock()
         dev = self._swap_out()(jnp.asarray(ids_row), *self._arenas)
         if materialize:
-            out = [np.asarray(r) for r in dev]
+            # a swap record's bytes are correctness-bearing, so the
+            # preemption path forces them NOW (the caller charged the
+            # flush); the demote path below stays lazy and reconciles
+            # at a harvest point
+            out = [np.asarray(r) for r in dev]     # sync: preempt
         else:
             out = list(dev)
         self._disp_s += self._clock() - t0
@@ -2416,6 +2447,7 @@ class ServingEngine:
                 donate_argnums=tuple(range(1 + n, 1 + 2 * n)))
         return self._swap_in_fn
 
+    # graftlint: plan-phase
     def _preempt(self, req: Request, reason: str = "pressure"):
         """Swap an in-flight request out to the host-RAM tier: gather
         its table row's EXACT at-rest bytes out of every arena (float
@@ -2501,6 +2533,7 @@ class ServingEngine:
             self._preempt(victim)
         return True
 
+    # graftlint: plan-phase
     def _try_resume(self, req: Request, slot: int) -> bool:
         """Re-admit a swapped request: allocate fresh blocks (leaning
         on the valve and preemption under pressure), re-scatter the
@@ -2672,6 +2705,7 @@ class ServingEngine:
             self._update_host_gauge()
         self._update_block_gauges()
 
+    # graftlint: plan-phase
     def _map_radix_span(self, req: Request, fresh: List[int]):
         """Resolve the matched span into arena blocks: HBM entries map
         directly, host entries are PROMOTED — their exact at-rest
@@ -2765,6 +2799,7 @@ class ServingEngine:
         self._m.fairshare_served.inc(cost, tenant=req.tenant)
         self._update_deficits()
 
+    # graftlint: plan-phase
     def _admit(self, now: float, out: List[Request]):
         """Admit the best-class candidates into vacant slots.  The
         candidate order is priority-then-EDF over the swap list plus
@@ -3123,6 +3158,7 @@ class ServingEngine:
         mp.advance(int(req.tokens[-1]))
         return not np.asarray(mp.allowed(), bool).any()
 
+    # graftlint: plan-phase
     def _prefill_chunk(self, out: List[Request]):
         """Run at most ONE prompt chunk (FIFO over admissions).  The
         final chunk of a prompt samples the request's first token and
@@ -3302,6 +3338,7 @@ class ServingEngine:
             self._verify_fns[(steps, flags, lora_on)] = fn
         return fn
 
+    # graftlint: plan-phase
     def _spec_verify(self, out: List[Request]):
         """One speculative iteration over every spec-mode decode slot:
         draft (host), verify (ONE batched K+1-position target forward),
@@ -3490,6 +3527,7 @@ class ServingEngine:
                     - self._stall_s, 0.0))
         return out
 
+    # graftlint: plan-phase
     def _step_inner(self, now: Optional[float] = None) -> List[Request]:
         finished: List[Request] = []
         t_now = self._clock() if now is None else now

@@ -1,0 +1,74 @@
+"""graftlint — AST-only static analysis for the serving stack's
+hand-maintained invariants.
+
+Five independent passes (each individually testable, each selectable
+with ``--rule``), all sharing one parse of the tree and none
+importing jax — the whole suite runs in seconds on the 2-core tier-1
+box:
+
+- ``vocab``         closed vocabularies (event kinds, sync reasons,
+                    goodput/route/shed/swap/cancel labels) stay
+                    closed, and every declared entry stays alive
+- ``donate``        ``donate_argnums``/``donate_argnames`` positions
+                    exist; donated buffers are never read after the
+                    call
+- ``trace-purity``  functions reachable from jit/pallas_call roots
+                    carry no host side effects (clock, RNG, metrics,
+                    flight recorder)
+- ``host-sync``     plan-phase materialization of device values is
+                    charged or ``# sync: <reason>``-annotated
+- ``instruments``   the metrics-name lint
+                    (``tools/check_metrics_names.py`` delegates here)
+
+See README "Static analysis" for the annotation grammar and
+``python -m tools.graftlint --list-rules`` for the one-line
+invariants.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from . import donation, hostsync, instruments, purity, vocab
+from .core import Finding, ScanContext
+
+RULES = {
+    "vocab": (vocab.run_pass,
+              "emit/charge/label literals resolve against their "
+              "declared closed vocabulary; every entry has an emit "
+              "site"),
+    "donate": (donation.run_pass,
+               "donate_argnums/argnames positions exist in the "
+               "wrapped signature; donated buffers are not read "
+               "after the call"),
+    "trace-purity": (purity.run_pass,
+                     "no time/random/registry/flight-recorder calls "
+                     "reachable from jit or pallas_call roots"),
+    "host-sync": (hostsync.run_pass,
+                  "plan-phase device materialization carries an "
+                  "adjacent sync charge or a '# sync: <reason>' "
+                  "annotation"),
+    "instruments": (instruments.run_pass,
+                    "instrument names: valid, one kind and label "
+                    "tuple per name, required set registered and "
+                    "documented"),
+}
+
+
+def run_lint(root: Optional[str] = None,
+             paths: Optional[Sequence[str]] = None,
+             rules: Optional[Sequence[str]] = None,
+             ctx: Optional[ScanContext] = None) -> List[Finding]:
+    """Run the selected passes and return disable-filtered findings,
+    sorted by site.  The programmatic twin of the CLI (the tier-1
+    test and the check_metrics_names shim both come through here or
+    through a single pass's ``run_pass``)."""
+    if ctx is None:
+        ctx = ScanContext(root, paths)
+    out: List[Finding] = []
+    for name in (rules or sorted(RULES)):
+        fn, _desc = RULES[name]
+        out.extend(fn(ctx))
+    out = ctx.filter_disabled(out)
+    return sorted(out, key=lambda f: (f.path, f.lineno, f.rule,
+                                      f.message))

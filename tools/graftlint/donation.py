@@ -1,0 +1,385 @@
+"""graftlint pass ``donate``: buffer-donation discipline.
+
+``jax.jit(..., donate_argnums=...)`` is load-bearing across this tree
+(optimizer steps, the serving arenas, the swap-in scatter): a donated
+buffer's memory is reused for the output, so two whole bug classes
+hide behind it —
+
+1. **a donated position that does not exist** (or stops existing when
+   an argument is added/removed): jax errors only when the jit is
+   first CALLED, which for rarely-taken variants (the lora-on
+   program, a fault path) can be long after the edit.  PR 11's
+   "donate argnums shifted" fix was exactly this, done by hand; and
+2. **reading a donated buffer after the call**: the caller's array
+   was invalidated by the dispatch — on real accelerators this is a
+   use-after-donate error (or worse, stale bytes) that CPU test runs
+   may never surface.
+
+Both are statically checkable for the literal sites, and literal
+sites are the overwhelming majority.  Dynamic sites (``donate_argnums
+=tuple(range(...))``, ``**jit_kwargs``) are skipped — the runtime
+owns those.
+
+Scope/soundness notes (kept deliberately conservative so a finding is
+always actionable):
+
+- signature checks cover ``@functools.partial(jax.jit, ...)``
+  decorators and ``jax.jit(f, ...)`` where ``f`` is a def or lambda
+  visible in the same module;
+- read-after-donate tracks plain-name arguments at donated positions
+  of calls to module-visible donating jits (decorated defs, and
+  locals/attributes assigned from ``jax.jit(..., donate_argnums=...)``),
+  linearizes the enclosing function's name events in execution order
+  (assignment targets store AFTER their value loads), treats the two
+  arms of an ``if`` as exclusive, and unrolls the innermost loop once
+  so ``p, m = step(p, m, g)`` inside a loop stays clean while
+  ``loss = step(p, g); log(p)`` is flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, ScanContext, dotted_name
+
+RULE = "donate"
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    return name in ("jax.jit", "jit") or name.endswith(".jax.jit")
+
+
+def _literal_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, int)
+            for e in node.elts):
+        return tuple(e.value for e in node.elts)
+    return None
+
+
+def _literal_str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in node.elts):
+        return tuple(e.value for e in node.elts)
+    return None
+
+
+def _donate_kwargs(call: ast.Call):
+    """(donate_argnums literal or None, donate_argnames literal or
+    None, has_dynamic) from a jit-wrapping call."""
+    nums = names = None
+    dynamic = False
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums = _literal_int_tuple(kw.value)
+            dynamic = dynamic or nums is None
+        elif kw.arg == "donate_argnames":
+            names = _literal_str_tuple(kw.value)
+            dynamic = dynamic or names is None
+        elif kw.arg is None:
+            dynamic = True          # **kwargs may carry donation
+    return nums, names, dynamic
+
+
+def _positional_params(args: ast.arguments) -> List[str]:
+    return [a.arg for a in args.posonlyargs] + [a.arg for a in args.args]
+
+
+def _check_signature(findings, sf, lineno, fn_name, args: ast.arguments,
+                     nums, names):
+    params = _positional_params(args)
+    if nums is not None and args.vararg is None:
+        for i in nums:
+            if not (0 <= i < len(params)):
+                findings.append(Finding(
+                    RULE, sf.path, lineno,
+                    f"donate_argnums position {i} does not exist in "
+                    f"{fn_name}'s signature ({len(params)} positional "
+                    f"parameter(s): {params}) — the donation silently "
+                    f"shifted or the argument was removed"))
+    if names is not None:
+        all_names = set(params) | {a.arg for a in args.kwonlyargs}
+        for nm in names:
+            if nm not in all_names:
+                findings.append(Finding(
+                    RULE, sf.path, lineno,
+                    f"donate_argnames name {nm!r} does not exist in "
+                    f"{fn_name}'s signature — the donation silently "
+                    f"detached"))
+
+
+class _Event:
+    """One name access in linearized execution order."""
+    __slots__ = ("name", "store", "branch", "seq")
+
+    def __init__(self, name, store, branch, seq):
+        self.name, self.store, self.branch, self.seq = \
+            name, store, branch, seq
+
+
+def _branches_exclusive(a: Tuple, b: Tuple) -> bool:
+    """True when two branch paths are provably never both taken: they
+    diverge at a shared ``if`` with different arms."""
+    for (ia, aa), (ib, ab) in zip(a, b):
+        if ia != ib:
+            return False
+        if aa != ab:
+            return True
+    return False
+
+
+class _Linearizer:
+    """Name events of one function body in execution order, with
+    branch paths and loop extents."""
+
+    def __init__(self):
+        self.events: List[_Event] = []
+        self.loops: List[Tuple[int, int]] = []   # (start seq, end seq)
+        self.call_sites: List[Tuple[ast.Call, int, Tuple]] = []
+        self._branch: Tuple = ()
+        self._seq = 0
+
+    def _emit_expr(self, node: ast.AST):
+        """Loads of an expression, then its calls.  Calls register at
+        the post-load sequence position so a call's OWN argument loads
+        never count as reads-after-donate (``p, m = step(p, m, g)``
+        reads p strictly before the dispatch donates it)."""
+        calls = []
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and \
+                    isinstance(sub.ctx, ast.Load):
+                self.events.append(_Event(sub.id, False, self._branch,
+                                          self._seq))
+                self._seq += 1
+            elif isinstance(sub, ast.Call):
+                calls.append(sub)
+        for sub in calls:
+            self.call_sites.append((sub, self._seq, self._branch))
+
+    def _emit_store_target(self, node: ast.AST):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and \
+                    isinstance(sub.ctx, (ast.Store, ast.Del)):
+                self.events.append(_Event(sub.id, True, self._branch,
+                                          self._seq))
+                self._seq += 1
+
+    def run(self, body: List[ast.stmt]):
+        for st in body:
+            self._stmt(st)
+
+    def _stmt(self, st: ast.stmt):
+        if isinstance(st, ast.Assign):
+            self._emit_expr(st.value)
+            for t in st.targets:
+                self._emit_store_target(t)
+        elif isinstance(st, ast.AugAssign):
+            self._emit_expr(st.value)
+            self._emit_expr(st.target)
+            self._emit_store_target(st.target)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._emit_expr(st.value)
+            self._emit_store_target(st.target)
+        elif isinstance(st, ast.If):
+            self._emit_expr(st.test)
+            marker = id(st)
+            outer = self._branch
+            self._branch = outer + ((marker, 0),)
+            for s in st.body:
+                self._stmt(s)
+            self._branch = outer + ((marker, 1),)
+            for s in st.orelse:
+                self._stmt(s)
+            self._branch = outer
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._emit_expr(st.iter)
+            start = self._seq
+            self._emit_store_target(st.target)
+            for s in st.body:
+                self._stmt(s)
+            self.loops.append((start, self._seq))
+            for s in st.orelse:
+                self._stmt(s)
+        elif isinstance(st, ast.While):
+            start = self._seq
+            self._emit_expr(st.test)
+            for s in st.body:
+                self._stmt(s)
+            self.loops.append((start, self._seq))
+            for s in st.orelse:
+                self._stmt(s)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._emit_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._emit_store_target(item.optional_vars)
+            for s in st.body:
+                self._stmt(s)
+        elif isinstance(st, ast.Try):
+            for s in st.body:
+                self._stmt(s)
+            for h in st.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            for s in st.orelse:
+                self._stmt(s)
+            for s in st.finalbody:
+                self._stmt(s)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            pass        # nested scopes have their own names
+        elif isinstance(st, ast.Return):
+            if st.value is not None:
+                self._emit_expr(st.value)
+        elif isinstance(st, ast.Expr):
+            self._emit_expr(st.value)
+        else:
+            for sub in ast.iter_child_nodes(st):
+                if isinstance(sub, ast.expr):
+                    self._emit_expr(sub)
+
+
+def _collect_donors(tree: ast.Module):
+    """Donating callables visible in this module:
+    ``{key: donated positions}`` where key is a def name, a local
+    variable name, or a ``self._x``-style dotted attribute assigned
+    from a donating ``jax.jit(...)`` call."""
+    donors: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and \
+                        dotted_name(dec.func).endswith("partial") and \
+                        dec.args and _is_jax_jit(dec.args[0]):
+                    nums, _names, _dyn = _donate_kwargs(dec)
+                    if nums:
+                        donors[node.name] = nums
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                _is_jax_jit(node.value.func):
+            nums, _names, _dyn = _donate_kwargs(node.value)
+            if nums and len(node.targets) == 1:
+                key = dotted_name(node.targets[0])
+                if key:
+                    donors[key] = nums
+    return donors
+
+
+def _module_defs(tree: ast.Module):
+    """Every def in the module (any nesting), by name — ambiguity is
+    resolved by skipping duplicate names."""
+    defs: Dict[str, Optional[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            nm = getattr(node, "name", None)
+            if nm is None:
+                continue
+            defs[nm] = None if nm in defs else node
+    return {k: v for k, v in defs.items() if v is not None}
+
+
+def run_pass(ctx: ScanContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.files:
+        if sf.tree is None:
+            continue
+        defs = _module_defs(sf.tree)
+
+        # -- signature checks --
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and \
+                            dotted_name(dec.func).endswith("partial") \
+                            and dec.args and _is_jax_jit(dec.args[0]):
+                        nums, names, _dyn = _donate_kwargs(dec)
+                        _check_signature(findings, sf, dec.lineno,
+                                         node.name, node.args, nums,
+                                         names)
+            elif isinstance(node, ast.Call) and _is_jax_jit(node.func):
+                nums, names, _dyn = _donate_kwargs(node)
+                if nums is None and names is None:
+                    continue
+                if not node.args:
+                    continue
+                target = node.args[0]
+                if isinstance(target, ast.Lambda):
+                    _check_signature(findings, sf, node.lineno,
+                                     "<lambda>", target.args, nums,
+                                     names)
+                elif isinstance(target, ast.Name) \
+                        and target.id in defs:
+                    tgt = defs[target.id]
+                    _check_signature(findings, sf, node.lineno,
+                                     target.id, tgt.args, nums, names)
+
+        # -- read-after-donate --
+        donors = _collect_donors(sf.tree)
+        if not donors:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            lin = _Linearizer()
+            lin.run(node.body)
+            for call, seq, branch in lin.call_sites:
+                key = dotted_name(call.func)
+                positions = donors.get(key)
+                if positions is None:
+                    continue
+                if any(isinstance(a, ast.Starred) for a in call.args):
+                    continue            # positions are ambiguous
+                for p in positions:
+                    if p >= len(call.args):
+                        continue
+                    arg = call.args[p]
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    verdict = _first_use_after(lin, arg.id, seq, branch)
+                    if verdict == "load":
+                        findings.append(Finding(
+                            RULE, sf.path, call.lineno,
+                            f"{arg.id!r} is donated to {key}() "
+                            f"(donate_argnums position {p}) but read "
+                            f"again afterwards in "
+                            f"{node.name}() — a donated buffer is "
+                            f"invalidated by the dispatch; rebind the "
+                            f"result or copy before donating"))
+    return findings
+
+
+def _first_use_after(lin: _Linearizer, name: str, seq: int,
+                     branch: Tuple) -> Optional[str]:
+    """'load' / 'store' / None for the first reachable use of ``name``
+    after event position ``seq``; loops containing the call are
+    unrolled once (events from the loop's start re-run after the
+    call)."""
+
+    def scan(events):
+        for ev in events:
+            if ev.name != name:
+                continue
+            if _branches_exclusive(ev.branch, branch):
+                continue
+            return "store" if ev.store else "load"
+        return None
+
+    after = [ev for ev in lin.events if ev.seq >= seq]
+    verdict = scan(after)
+    if verdict is not None:
+        return verdict
+    for start, end in lin.loops:
+        if start <= seq < end:       # innermost-to-outermost order
+            return scan([ev for ev in lin.events
+                         if start <= ev.seq < seq])
+    return None

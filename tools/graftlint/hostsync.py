@@ -1,0 +1,281 @@
+"""graftlint pass ``host-sync``: plan-phase materialization is either
+charged or annotated.
+
+PR 10's dispatch-ahead contract: the serving scheduler plans
+iteration N+1 while iteration N runs on device, and it may force
+device outputs to host EARLY ("degrade to sync") only where host
+truth is semantically required — every such sync charges exactly one
+reason from the closed ``ASYNC_SYNC_REASONS`` vocabulary.  That
+contract was prose + runtime counters; this pass makes the
+materialization side of it machine-checked:
+
+- functions marked ``# graftlint: plan-phase`` (marker comment on the
+  ``def`` line or the line directly above) are in scope;
+- inside them, a **materializing call** — ``int()`` / ``float()`` /
+  ``bool()`` / ``np.asarray()`` / ``np.array()`` /
+  ``np.ascontiguousarray()`` / ``.item()`` / ``.tolist()`` — whose
+  argument is **device-tainted** is a finding unless the site is
+  justified one of two ways:
+
+  1. a ``# sync: <reason>`` annotation on the line or the line above,
+     ``<reason>`` drawn from the ``ASYNC_SYNC_REASONS`` declaration
+     (free text may follow after `` — ``), or
+  2. an adjacent charge: a preceding statement in the same (or an
+     enclosing) suite of the function calls ``_flush_async(...)`` or
+     ``<x>.async_syncs.inc(...)`` — the charge IS the justification,
+     and keeping them adjacent is exactly the discipline the pass
+     enforces.
+
+Device taint is name-based and local to the function, tuned to this
+codebase's conventions: attributes/names ending in ``_d`` (the
+pending-block device handles), results of ``jnp.*`` calls and of the
+known dispatch helpers (``_call_quiet``, ``_gather_rows``,
+``_swap_out``/``_swap_in`` program calls), propagated through
+assignments, tuple unpacking, subscripts and comprehensions.  Host
+mirrors (``self._tok``, ``self._lens`` — plain numpy) are never
+tainted, so ``int(self._lens[i])`` stays clean.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, ScanContext, vocab_declarations
+
+RULE = "host-sync"
+
+_SYNC_RE = re.compile(r"#\s*sync:\s*([a-z0-9_\-]+)")
+
+_MATERIALIZE_NAMES = {"int", "float", "bool"}
+_MATERIALIZE_NP = {"asarray", "array", "ascontiguousarray"}
+_MATERIALIZE_METHODS = {"item", "tolist"}
+_DEVICE_CALLS = {"_call_quiet", "_gather_rows", "_swap_out", "_swap_in"}
+_NP_NAMES = {"np", "numpy", "onp"}
+
+
+def _sync_annotation(sf, lineno: int,
+                     end_lineno: Optional[int]) -> Optional[str]:
+    """The annotation may sit on the line above the call, or on ANY
+    physical line of a wrapped multi-line call (this codebase wraps
+    at ~72 columns, so the trailing comment often lands on the
+    closing line)."""
+    for n in range(lineno - 1, (end_lineno or lineno) + 1):
+        m = _SYNC_RE.search(sf.line(n))
+        if m:
+            return m.group(1)
+    return None
+
+
+class _Taint:
+    """Function-local device-taint state."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def expr_tainted(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in self.names:
+                return True
+            if isinstance(sub, ast.Name) and sub.id.endswith("_d"):
+                return True
+            if isinstance(sub, ast.Attribute) and \
+                    sub.attr.endswith("_d"):
+                return True
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                # jnp.<anything>(...) produces a device array
+                if isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == "jnp":
+                    return True
+                # known dispatch helpers (self._call_quiet-style or
+                # bare), incl. program-handle calls self._swap_out()()
+                for part in ast.walk(f):
+                    if isinstance(part, (ast.Name, ast.Attribute)):
+                        nm = part.id if isinstance(part, ast.Name) \
+                            else part.attr
+                        if nm in _DEVICE_CALLS:
+                            return True
+        return False
+
+    def assign(self, target: ast.AST, tainted: bool):
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name):
+                if tainted:
+                    self.names.add(sub.id)
+                else:
+                    self.names.discard(sub.id)
+
+
+def _materializing_call(node: ast.Call) -> Optional[ast.AST]:
+    """The materialized operand when this call forces host values:
+    int/float/bool(x), np.asarray/array/ascontiguousarray(x),
+    x.item()/x.tolist().  None otherwise."""
+    f = node.func
+    if isinstance(f, ast.Name) and f.id in _MATERIALIZE_NAMES \
+            and node.args:
+        return node.args[0]
+    if isinstance(f, ast.Attribute):
+        if f.attr in _MATERIALIZE_NP and \
+                isinstance(f.value, ast.Name) and \
+                f.value.id in _NP_NAMES and node.args:
+            return node.args[0]
+        if f.attr in _MATERIALIZE_METHODS and not node.args:
+            return f.value
+    return None
+
+
+_CHARGE_ATTRS = {"_flush_async", "async_syncs"}
+
+
+def _stmt_charges(st: ast.stmt) -> bool:
+    for node in ast.walk(st):
+        if isinstance(node, ast.Call):
+            for part in ast.walk(node.func):
+                nm = (part.id if isinstance(part, ast.Name)
+                      else part.attr if isinstance(part, ast.Attribute)
+                      else None)
+                if nm in _CHARGE_ATTRS:
+                    return True
+    return False
+
+
+def _charged_before(fn: ast.AST, target_stmt: ast.stmt) -> bool:
+    """True when some statement executing before ``target_stmt`` in
+    this function charges a sync: preceding siblings in the target's
+    suite and in every enclosing suite up to the function body."""
+
+    def search(body: List[ast.stmt]) -> Optional[bool]:
+        """None = target not under this body; True/False = found the
+        target, with/without a preceding charge (searched bottom-up)."""
+        for i, st in enumerate(body):
+            if st is target_stmt:
+                return any(_stmt_charges(p) for p in body[:i])
+            for sub_body in _child_suites(st):
+                r = search(sub_body)
+                if r is True:
+                    return True
+                if r is False:
+                    return any(_stmt_charges(p) for p in body[:i])
+        return None
+
+    return bool(search(fn.body))
+
+
+def _child_suites(st: ast.stmt) -> List[List[ast.stmt]]:
+    out = []
+    for field in ("body", "orelse", "finalbody"):
+        v = getattr(st, field, None)
+        if v and isinstance(v, list) and \
+                not isinstance(st, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.ClassDef)):
+            out.append(v)
+    if isinstance(st, ast.Try):
+        for h in st.handlers:
+            out.append(h.body)
+    return out
+
+
+def run_pass(ctx: ScanContext) -> List[Finding]:
+    findings: List[Finding] = []
+    decl = vocab_declarations(ctx, ["ASYNC_SYNC_REASONS"]) \
+        .get("ASYNC_SYNC_REASONS")
+    reasons = set(decl.entries) if decl is not None else None
+
+    for sf in ctx.files:
+        for fn in sf.plan_phase_defs():
+            taint = _Taint()
+            # statement -> containing stmt map for charge adjacency
+            stmt_of: Dict[int, ast.stmt] = {}
+            for st in ast.walk(fn):
+                # the def itself (and nested defs) are statements too
+                # but must not swallow their children's mapping; walk
+                # order is outer-first, so plain assignment leaves each
+                # node mapped to its INNERMOST statement — which is
+                # what lets _charged_before see same-suite siblings
+                if isinstance(st, ast.stmt) and not isinstance(
+                        st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for sub in ast.walk(st):
+                        stmt_of[id(sub)] = st
+            seen_lines: Set[int] = set()
+            for node in _exec_order(fn):
+                if isinstance(node, ast.Assign):
+                    t = taint.expr_tainted(node.value)
+                    for tgt in node.targets:
+                        taint.assign(tgt, t)
+                    continue
+                if isinstance(node, (ast.ListComp, ast.SetComp,
+                                     ast.GeneratorExp, ast.DictComp)):
+                    # a comprehension over a device source taints its
+                    # loop variable ([np.asarray(r) for r in dev])
+                    for gen in node.generators:
+                        if taint.expr_tainted(gen.iter):
+                            taint.assign(gen.target, True)
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                operand = _materializing_call(node)
+                if operand is None or not taint.expr_tainted(operand):
+                    continue
+                if node.lineno in seen_lines:
+                    continue
+                seen_lines.add(node.lineno)
+                ann = _sync_annotation(sf, node.lineno,
+                                       getattr(node, "end_lineno",
+                                               None))
+                if ann is not None:
+                    if reasons is not None and ann not in reasons:
+                        findings.append(Finding(
+                            RULE, sf.path, node.lineno,
+                            f"# sync: {ann} is not a reason from "
+                            f"ASYNC_SYNC_REASONS "
+                            f"({sorted(reasons)}) — the annotation "
+                            f"must name the charged sync"))
+                    continue
+                st = stmt_of.get(id(node))
+                if st is not None and _charged_before(fn, st):
+                    continue
+                findings.append(Finding(
+                    RULE, sf.path, node.lineno,
+                    f"plan-phase function {fn.name}() materializes a "
+                    f"device value here with no adjacent sync charge "
+                    f"and no '# sync: <reason>' annotation — "
+                    f"dispatch-ahead contract: host truth is forced "
+                    f"only where semantically required, and every "
+                    f"such site says why"))
+    return findings
+
+
+def _walk_own(fn: ast.AST):
+    """ast.walk minus nested lambda/def subtrees: code inside a
+    ``lambda: np.asarray(...)`` built in plan phase EXECUTES at
+    harvest (the _LazyStacks thunk idiom), so it must not be scored
+    as plan-phase materialization."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _exec_order(fn: ast.AST):
+    """Statements and expressions of a function in source order
+    (assignments yielded as Assign so taint updates before later
+    uses; every other node yielded as-is).  Nested lambdas/defs are
+    excluded — their bodies run later, not in plan phase."""
+    out = []
+    for node in _walk_own(fn):
+        if isinstance(node, (ast.Assign, ast.Call, ast.ListComp,
+                             ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            out.append(node)
+    # comprehension nodes start at their '[', BEFORE the element
+    # expression's calls, so sorting by position applies their taint
+    # first
+    out.sort(key=lambda n: (n.lineno, n.col_offset))
+    return out

@@ -1,0 +1,203 @@
+"""graftlint pass ``trace-purity``: no host side effects inside
+traced functions.
+
+A function handed to ``jax.jit`` or ``pallas_call`` executes its
+Python body ONCE, at trace time — any host side effect in it (a
+clock read, host RNG, a metrics increment, a flight-recorder event)
+silently runs per-compile instead of per-step, which is almost never
+what the author meant and is invisible in tests that hit the
+compile-cache.  This pass finds the traced roots of each module,
+walks the module-local call graph under them, and flags:
+
+- ``time.*`` calls (when the module imports the stdlib ``time``);
+- ``random.*`` calls (stdlib ``random`` only — ``from jax import
+  random`` keeps its name usable in traces) and ``np.random.*`` /
+  ``numpy.random.*``;
+- metrics-registry mutation: ``.counter(`` / ``.gauge(`` /
+  ``.histogram(`` registrations and ``.inc(`` / ``.observe(``
+  increments (``.set(`` is deliberately NOT matched — it is the
+  ``arr.at[i].set(v)`` functional-update idiom inside traces);
+- ``.emit(`` — a flight-recorder event from inside a trace.
+
+Roots: ``@jax.jit`` / ``@functools.partial(jax.jit, ...)`` decorated
+defs, ``jax.jit(f)`` / ``pallas_call(kernel)`` / ``pl.pallas_call``
+where the callee is a def or lambda visible in the same module
+(including through one ``functools.partial(kernel, ...)`` wrapper).
+Reachability is module-local and name-based (bare calls and
+``self.<method>`` within the defining class); cross-module reach and
+``lax.scan``/``fori_loop`` bodies are out of scope — documented, not
+silently pretended.  A deliberate trace-time effect (e.g. a
+per-compile route counter) takes a
+``# graftlint: disable=trace-purity`` on its line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, ScanContext, dotted_name
+
+RULE = "trace-purity"
+
+_REGISTRY_METHODS = {"counter", "gauge", "histogram", "inc", "observe"}
+
+
+def _is_jit(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    return name in ("jax.jit", "jit") or name.endswith(".jax.jit")
+
+
+def _is_pallas_call(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    return name.split(".")[-1] == "pallas_call"
+
+
+def _std_imports(tree: ast.Module) -> Set[str]:
+    """Names bound to the stdlib ``time``/``random`` modules in this
+    module (``import time``, ``import random as rnd``).  ``from jax
+    import random`` binds jax's — excluded."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("time", "random"):
+                    out.add(a.asname or a.name)
+    return out
+
+
+class _Defs(ast.NodeVisitor):
+    """All defs in a module with their enclosing class (for
+    ``self.x()`` resolution).  Duplicate names merge — reachability
+    is conservative."""
+
+    def __init__(self):
+        self.by_name: Dict[str, List[ast.AST]] = {}
+        self.method_class: Dict[int, Optional[str]] = {}
+        self.class_methods: Dict[str, Dict[str, List[ast.AST]]] = {}
+        self._class: List[str] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._class.append(node.name)
+        self.generic_visit(node)
+        self._class.pop()
+
+    def _visit_func(self, node):
+        self.by_name.setdefault(node.name, []).append(node)
+        cls = self._class[-1] if self._class else None
+        self.method_class[id(node)] = cls
+        if cls is not None:
+            self.class_methods.setdefault(cls, {}).setdefault(
+                node.name, []).append(node)
+        self.generic_visit(node)
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+
+def _resolve_callee(arg: ast.AST, defs: _Defs) -> List[ast.AST]:
+    """Defs/lambdas a jit/pallas_call first argument can denote,
+    module-locally: a bare name, a lambda, or
+    ``functools.partial(name, ...)``."""
+    if isinstance(arg, ast.Lambda):
+        return [arg]
+    if isinstance(arg, ast.Name):
+        return list(defs.by_name.get(arg.id, []))
+    if isinstance(arg, ast.Call) and \
+            dotted_name(arg.func).endswith("partial") and arg.args:
+        return _resolve_callee(arg.args[0], defs)
+    return []
+
+
+def _reachable(roots: List[ast.AST], defs: _Defs) -> List[ast.AST]:
+    seen: Set[int] = set()
+    order: List[ast.AST] = []
+    work = list(roots)
+    while work:
+        fn = work.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        order.append(fn)
+        cls = defs.method_class.get(id(fn))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name):
+                work.extend(defs.by_name.get(f.id, []))
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id == "self" and cls is not None:
+                work.extend(defs.class_methods.get(cls, {})
+                            .get(f.attr, []))
+    return order
+
+
+def run_pass(ctx: ScanContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.files:
+        if sf.tree is None:
+            continue
+        defs = _Defs()
+        defs.visit(sf.tree)
+        std = _std_imports(sf.tree)
+
+        roots: List[ast.AST] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_jit(dec) or (
+                            isinstance(dec, ast.Call) and (
+                                _is_jit(dec.func) or (
+                                    dotted_name(dec.func)
+                                    .endswith("partial")
+                                    and dec.args
+                                    and _is_jit(dec.args[0])))):
+                        roots.append(node)
+            elif isinstance(node, ast.Call) and node.args and (
+                    _is_jit(node.func) or _is_pallas_call(node.func)):
+                roots.extend(_resolve_callee(node.args[0], defs))
+        if not roots:
+            continue
+
+        flagged: Set[Tuple[int, str]] = set()
+        for fn in _reachable(roots, defs):
+            fn_name = getattr(fn, "name", "<lambda>")
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                msg = None
+                chain = dotted_name(f)
+                base = chain.split(".")[0] if chain else ""
+                if base in std and "." in chain:
+                    msg = (f"calls {chain}() — host "
+                           f"{'clock' if base == 'time' else 'RNG'} "
+                           f"inside a traced function runs once per "
+                           f"COMPILE, not per step")
+                elif chain.startswith(("np.random.", "numpy.random.")):
+                    msg = (f"calls {chain}() — host RNG inside a "
+                           f"traced function runs once per COMPILE; "
+                           f"use jax.random with a threaded key")
+                elif isinstance(f, ast.Attribute) and \
+                        f.attr in _REGISTRY_METHODS and \
+                        not chain.startswith(("np.", "numpy.", "jnp.",
+                                              "jax.", "math.")):
+                    msg = (f"mutates a metrics registry "
+                           f"({chain or f.attr}()) inside a traced "
+                           f"function — the increment runs per "
+                           f"compile, not per step")
+                elif isinstance(f, ast.Attribute) and f.attr == "emit":
+                    msg = (f"emits a flight-recorder event "
+                           f"({chain or 'emit'}()) inside a traced "
+                           f"function — events must come from the "
+                           f"host scheduler, never from a trace")
+                if msg is not None and \
+                        (node.lineno, msg) not in flagged:
+                    flagged.add((node.lineno, msg))
+                    findings.append(Finding(
+                        RULE, sf.path, node.lineno,
+                        f"{fn_name}() (reachable from a jit/"
+                        f"pallas_call root) {msg}"))
+    return findings
