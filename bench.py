@@ -1197,6 +1197,142 @@ def _bench_serving(on_tpu):
         },
     }
 
+    # -- depth-S dispatch-ahead arm (in-trace finish bitmap + fused
+    # multi-iteration windows): an EOS-CONFIGURED drain trace —
+    # exactly the shape where the depth-1 pipeline pays its dominant
+    # forced sync (reason "eos", once per iteration, because EOS
+    # detection is host-semantic there) — through async_depth=1 vs
+    # async_depth=S vs the lockstep kill-switch.  One request per
+    # slot, all arriving at t0, so after the prefill phase the queue
+    # is empty and the windows are provably eventless: depth S reads
+    # EOS from the device-side finish bitmap one harvest late
+    # (deterministic lag, flight-recorder-stamped) and dispatches S
+    # iterations as ONE fused scan program.  PRIVATE registries and
+    # recorders; gates DETERMINISTIC only: token-exact across all
+    # three arms, admission order identical, per-request event
+    # sequences byte-identical vs lockstep modulo step/lag/wall,
+    # syncs{eos} and decode dispatches strictly lower at depth S.
+    # Walls (tokens/s, host/dispatch/overlap ms) are report-only --
+    from paddle_tpu.observability.flightrec import FlightRecorder
+    nd_s = 4                   # the fused window depth under test
+    nd_new = int(new_hi)       # long budgets: decode dominates
+    nd_prompts = prompts[:num_slots]
+    nd_plens = plens[:num_slots]
+    # an EOS that really fires mid-stream for request 0 (tokens before
+    # EOS are unaffected by the eos config, so picking from the no-EOS
+    # reference is exact); other rows run their budgets — the mix of
+    # early-EOS and budget finishes is the protocol's whole surface
+    nd_ref = np.asarray(model.generate(
+        paddle.to_tensor(nd_prompts[0][None, :int(nd_plens[0])]),
+        max_new_tokens=nd_new, max_cache_len=cache_len,
+        compute_dtype=compute_dtype)._value)[0]
+    nd_eos = int(nd_ref[nd_new // 2])
+
+    def _one_depth_trace(depth, lockstep=False):
+        reg = MetricsRegistry()
+        rec = FlightRecorder()
+        # steps_per_call=1 on purpose: block granularity is orthogonal
+        # to the depth axis, and at 1 the per-request event stories
+        # compare byte-exactly (a stale-active row that finished on
+        # device distorts min-budget for a dispatch or two at spc > 1,
+        # reordering the n=spc/n=1 choice — token-exact but a
+        # different steps-attr sequence)
+        eng = ServingEngine(
+            model, num_slots=num_slots, prompt_len=prompt,
+            max_cache_len=cache_len, steps_per_call=1,
+            block_len=pf_block, compute_dtype=compute_dtype,
+            eos_token_id=nd_eos, registry=reg, flight_recorder=rec,
+            async_dispatch=not lockstep,
+            async_depth=1 if lockstep else depth)
+        eng.submit(nd_prompts[0][:int(nd_plens[0])],
+                   max_new_tokens=steps_per_call + 2)   # warm
+        eng.run()
+        warm = eng.stats()
+        first_real = eng._next_id      # warm requests drop from events
+        t0 = time.perf_counter()
+        for i in range(num_slots):
+            eng.submit(nd_prompts[i][:int(nd_plens[i])],
+                       max_new_tokens=nd_new, arrival_time=t0)
+        done = eng.run()
+        wall = max(r.finish_time for r in done) - t0
+        final = eng.stats()
+        counts = {k: final[k] - warm[k] for k in (
+            "block_dispatches", "decode_steps", "async_syncs",
+            "async_harvests")}
+        counts["eos_syncs"] = (
+            final["async_syncs_by_reason"]["eos"]
+            - warm["async_syncs_by_reason"]["eos"])
+        evs = [e for e in rec.events() if e.request >= first_real]
+        admits = [e.request for e in evs if e.kind == "admit"]
+        # per-request event stories: step numbering excluded by
+        # construction (the tuples carry no step — a fused window
+        # compresses steps and stamps events with the dispatch step),
+        # wall never recorded in attrs, and the deterministic lag attr
+        # stripped; at steps_per_call=1 the remaining CONTENT must
+        # match lockstep byte for byte
+        stories = {}
+        for e in evs:
+            stories.setdefault(e.request, []).append(
+                (e.kind, tuple(sorted(
+                    (k, str(v)) for k, v in e.attrs.items()
+                    if k != "lag"))))
+        walls = {
+            "host_ms": round(reg.get(
+                "serving.step.host_seconds").summary()["sum"] * 1e3, 3),
+            "dispatch_ms": round(reg.get(
+                "serving.step.dispatch_seconds").summary()["sum"]
+                * 1e3, 3),
+            "overlap_ms": round(reg.get(
+                "serving.step.overlap_seconds").summary()["sum"]
+                * 1e3, 3),
+        }
+        depth_hwm = int(reg.get("serving.async.depth").hwm())
+        out_toks = np.concatenate([r.output for r in done])
+        return (wall, counts, walls, out_toks, admits, stories,
+                depth_hwm)
+
+    dl_wall, dl_c, dl_w, dl_out, dl_adm, dl_st, _ = \
+        _one_depth_trace(1, lockstep=True)
+    d1_wall, d1_c, d1_w, d1_out, d1_adm, d1_st, d1_hwm = \
+        _one_depth_trace(1)
+    ds_wall, ds_c, ds_w, ds_out, ds_adm, ds_st, ds_hwm = \
+        _one_depth_trace(nd_s)
+    depth_ab = {
+        "depth": nd_s,
+        "eos_token_id": nd_eos,
+        "tokens_per_s": round(num_slots * nd_new / ds_wall, 1),
+        "depth1_tokens_per_s": round(num_slots * nd_new / d1_wall, 1),
+        "lockstep_tokens_per_s": round(num_slots * nd_new / dl_wall, 1),
+        "eos_syncs": {"depth1": d1_c["eos_syncs"],
+                      "depthS": ds_c["eos_syncs"]},
+        "block_dispatches": {"lockstep": dl_c["block_dispatches"],
+                             "depth1": d1_c["block_dispatches"],
+                             "depthS": ds_c["block_dispatches"]},
+        "async_harvests": ds_c["async_harvests"],
+        "depth_hwm": {"depth1": d1_hwm, "depthS": ds_hwm},
+        # wall-shaped step split per arm — reported, never gated
+        "host_ms": ds_w["host_ms"],
+        "dispatch_ms": ds_w["dispatch_ms"],
+        "overlap_ms": ds_w["overlap_ms"],
+        "depth1_host_ms": d1_w["host_ms"],
+        "lockstep_host_ms": dl_w["host_ms"],
+        "gate": {
+            "token_exact": bool((ds_out == dl_out).all()
+                                and (d1_out == dl_out).all()),
+            "eos_syncs_strictly_lower": (
+                ds_c["eos_syncs"] < d1_c["eos_syncs"]),
+            "dispatches_strictly_lower": (
+                ds_c["block_dispatches"] < d1_c["block_dispatches"]),
+            "admission_order_identical": (
+                ds_adm == dl_adm == d1_adm),
+            "event_stories_identical": ds_st == dl_st == d1_st,
+            # the depth-1 EOS arm never defers (its hwm stays 0 —
+            # exactly the wall this arm exists to show), so only the
+            # depth-S pipeline is gated on reaching its configured S
+            "depth_gauge_reaches_s": ds_hwm == nd_s and d1_hwm == 0,
+        },
+    }
+
     # -- speculative-decoding arm: the SAME engine config with and
     # without per-request spec_decode=K on a repetitive/structured
     # trace (tiled short token patterns — prompt-lookup drafting's home
@@ -1950,6 +2086,7 @@ def _bench_serving(on_tpu):
         "kv_int8": kv_int8,
         "overload": overload,
         "async": async_ab,
+        "async_depth": depth_ab,
         "lora": lora,
         "router": router_ab,
         "spec": {

@@ -182,16 +182,23 @@ def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call,
     flag compiles in only the planes its mix needs — the ``samp``
     pytree's structure is determined by the same flags, so program
     variants and plane dicts stay in lockstep.  Signature:
-    ``(p_values, tok, lens, done, samp, tables, *flat_arenas) ->
-    (toks [B, n], tok', lens', done', *flat_arenas)``.
+    ``(p_values, tok, lens, done, budget, samp, tables, *flat_arenas)
+    -> (toks [B, n], tok', lens', done', budget', *flat_arenas)``.
 
     Dispatch-ahead contract: every output is an UN-MATERIALIZED
     device array (JAX async dispatch) and the carries ``tok'``/
-    ``lens'``/``done'`` are valid INPUTS to the next block call as-is
-    — the caller may enqueue iteration N+1 feeding them directly and
-    force iteration N's outputs to host afterwards (the ServingEngine
-    plan/harvest split).  Done rows self-freeze in-trace (pad emits,
-    held lens), which is what makes one-step-stale host truth safe.
+    ``lens'``/``done'``/``budget'`` are valid INPUTS to the next block
+    call as-is — the caller may enqueue iteration N+1 feeding them
+    directly and force iteration N's outputs to host afterwards (the
+    ServingEngine plan/harvest split).  Done rows self-freeze in-trace
+    (pad emits, held lens), which is what makes one-step-stale host
+    truth safe.  ``done'`` is the IN-TRACE FINISH BITMAP: it flips on
+    an emitted EOS *and* on budget exhaustion (``budget`` [B] int32 is
+    the per-row remaining-token count, decremented per live emit), so
+    a depth-S pipeline can keep dispatching on stale truth and poll
+    the bitmap at harvest instead of syncing every iteration — see
+    ``serving.ASYNC_SYNC_REASONS`` for where a sync is still
+    semantically required.
 
     ``lora=True`` compiles the batched multi-adapter variant: a
     ``lora`` pytree argument (``{"ids": [B] int32, "a"/"b": {target:
@@ -206,35 +213,64 @@ def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call,
     _with_params = _param_swapper(model, cfg)
     sampled, _filtered, penalty, _bias = samp_flags
 
-    def _scan(tok, lens, done, samp, tables, flat_arenas):
+    def _scan(tok, lens, done, budget, samp, tables, flat_arenas):
         kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
         pos0 = samp["pos"] if sampled else jnp.zeros_like(lens)
         pres0 = samp["presence"] if penalty else None
-        (tok_f, lens_f, kvs_f, _pos_f, _pres_f, done_f), toks = \
-            jax.lax.scan(
+        (tok_f, lens_f, kvs_f, _pos_f, _pres_f, done_f, budget_f), \
+            toks = jax.lax.scan(
                 sampled_decode_scan_body(model, cfg, samp, samp_flags),
-                (tok, lens, kvs, pos0, pres0, done),
+                (tok, lens, kvs, pos0, pres0, done, budget),
                 None, length=steps_per_call)
-        return ((toks.T.astype(jnp.int32), tok_f, lens_f, done_f)
-                + tuple(_flatten_paged_kvs(kvs_f)))
+        return ((toks.T.astype(jnp.int32), tok_f, lens_f, done_f,
+                 budget_f) + tuple(_flatten_paged_kvs(kvs_f)))
 
     if lora:
-        def block_pure(p_values, tok, lens, done, samp, lora_planes,
-                       tables, *flat_arenas):
+        def block_pure(p_values, tok, lens, done, budget, samp,
+                       lora_planes, tables, *flat_arenas):
             def run():
                 with lora_context(gather_lora(lora_planes)):
-                    return _scan(tok, lens, done, samp, tables,
+                    return _scan(tok, lens, done, budget, samp, tables,
                                  flat_arenas)
             return _with_params(p_values, run)
     else:
-        def block_pure(p_values, tok, lens, done, samp, tables,
+        def block_pure(p_values, tok, lens, done, budget, samp, tables,
                        *flat_arenas):
             return _with_params(
                 p_values,
-                lambda: _scan(tok, lens, done, samp, tables,
+                lambda: _scan(tok, lens, done, budget, samp, tables,
                               flat_arenas))
 
     return block_pure
+
+
+def build_fused_decode_window(model, cfg: GenerationConfig,
+                              steps_per_iter, iters, **build_kw):
+    """Fused multi-iteration decode dispatch (PR 14): ``iters``
+    scheduler iterations of a ``steps_per_iter``-step decode block as
+    ONE compiled program — the ``steps_per_call`` amortization of
+    ``decode_scan_body`` lifted from intra-block to inter-iteration.
+
+    Because the per-token scan body already self-feeds its carries
+    (done rows freeze in-trace; the finish bitmap flips on EOS and
+    budget exhaustion), S iterations of an n-step block ARE one
+    ``lax.scan`` of S*n steps: the builder reuses
+    ``_build_paged_decode_block`` with ``steps_per_call = S * n``, so
+    a fused window and a plain (S*n)-step block share one compiled
+    program (the engine's block cache keys on total steps).
+
+    This is NOT ``steps_per_call=S*n`` at the engine level:
+    ``steps_per_call`` is a static engine-wide granularity the
+    scheduler must honor every iteration (and drops to 1 whenever a
+    budget could exhaust mid-block), while a fused window is a
+    PER-ITERATION choice the plan phase makes only when the window is
+    provably eventless (no chunk-final, no mask/penalty rows, no spec,
+    no queue, budget headroom > S*n for every rider) — and the harvest
+    still accounts the window as S logical iterations (per-iteration
+    flight-recorder events, ledger splits and KV-sweep modeling), so
+    token streams and per-request stories stay iteration-exact."""
+    return _build_paged_decode_block(
+        model, cfg, int(steps_per_iter) * int(iters), **build_kw)
 
 
 def build_swap_out_gather():

@@ -307,15 +307,25 @@ def sampled_decode_scan_body(model, cfg, samp, flags):
     """Per-token scan body of the paged decode block with per-row
     sampling: ``decode_scan_body``'s exact greedy semantics (EOS mask,
     pad emits, frozen lens for done rows) plus the sampling chain.
-    carry = (tok, lens, kvs, pos, presence, done); ``pos`` advances
-    with emitted tokens (frozen rows hold, like lens) so multi-step
-    blocks consume consecutive PRNG positions; ``presence`` (None
-    unless the penalty flag is compiled in) absorbs each emitted token
-    so the repetition penalty stays exact across the block."""
+    carry = (tok, lens, kvs, pos, presence, done, budget); ``pos``
+    advances with emitted tokens (frozen rows hold, like lens) so
+    multi-step blocks consume consecutive PRNG positions; ``presence``
+    (None unless the penalty flag is compiled in) absorbs each emitted
+    token so the repetition penalty stays exact across the block.
+
+    ``budget`` is the per-row remaining-token count and ``done`` after
+    the scan is the IN-TRACE FINISH BITMAP of the dispatch-ahead
+    protocol (PR 14): a row flips done when it emits EOS *or* when its
+    budget hits zero, and frozen rows hold their budget like they hold
+    lens/pos — so the host can dispatch the next iteration feeding
+    these carries device-to-device and poll the bitmap one harvest
+    late instead of materializing ``tok`` every iteration.  Vacant
+    rows enter with ``done=True`` and ``budget=0``; their budget term
+    is inert (done already dominates)."""
     penalty = flags[2]
 
     def body(carry, _):
-        tok, lens_c, kvs_c, pos, presence, done = carry
+        tok, lens_c, kvs_c, pos, presence, done, budget = carry
         logits_t, kvs_c = model.decode_step(tok, lens_c, kvs_c)
         step_samp = dict(samp)
         if flags[0]:
@@ -328,11 +338,19 @@ def sampled_decode_scan_body(model, cfg, samp, flags):
             done_n = done
         lens_n = jnp.where(done, lens_c, lens_c + 1)
         pos_n = jnp.where(done, pos, pos + 1)
+        # the budget half of the finish bitmap: live rows pay one
+        # token; a row whose budget just reached zero emitted its last
+        # token THIS step and freezes from the next step on — exactly
+        # the host-side ``remaining == 0`` retirement, computed where
+        # the dispatch-ahead pipeline can see it without a sync
+        budget_n = jnp.where(done, budget, budget - 1)
+        done_n = done_n | (budget_n <= 0)
         if penalty:
             oh = jax.nn.one_hot(nxt, presence.shape[-1],
                                 dtype=jnp.bool_)
             presence = presence | (oh & ~done[:, None])
-        return (nxt, lens_n, kvs_c, pos_n, presence, done_n), nxt
+        return (nxt, lens_n, kvs_c, pos_n, presence, done_n,
+                budget_n), nxt
 
     return body
 

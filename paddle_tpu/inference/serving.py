@@ -166,6 +166,21 @@ planes, ledger) ran SERIALLY with device compute.  This engine splits
 - time spent blocking on a PREVIOUS iteration's arrays lands in
   ``serving.step.overlap_seconds`` (never in ``host_seconds``), and
   injected fault stalls in ``serving.fault.stall_seconds``.
+- **depth-S** (``async_depth=S``, default 1): the decode block's
+  ``done`` carry is an IN-TRACE FINISH BITMAP (EOS hit or budget
+  exhausted — a ``budget`` carry counts each row's remaining tokens
+  down in-trace), so at S >= 2 an EOS-configured engine stops
+  syncing every iteration: the pending record becomes a bounded
+  FIFO deque, the host polls the bitmap at harvest — one dispatch
+  late — and a finished rider's slot frees one plan later (a
+  deterministic, flight-recorder-stamped lag; dispatches enqueued
+  before the finish was observable ride out with the row frozen
+  device-side and are skipped at harvest, so ledger/sweep/token
+  accounting stays exactly lockstep's).  Provably eventless windows
+  (nothing queued/swapped, no chunk, no mask/penalty/spec row,
+  budget headroom beyond the window) dispatch S iterations as ONE
+  fused scan program, re-split per iteration at harvest.  Depth 1
+  keeps PR 10's scheduling-identity contract bit-for-bit.
 
 **Multi-tenant batched LoRA serving** (``adapter_store=`` +
 ``submit(adapter=, tenant=)``): K fine-tuned LoRA variants of the one
@@ -225,7 +240,8 @@ from ..observability.flightrec import ENGINE_EVENT, FlightRecorder
 from ..observability.spans import instant as _span_instant
 from ..observability.spans import span as _span
 from .llm import (_build_paged_decode_block, build_chunk_prefill,
-                  build_swap_in_scatter, build_swap_out_gather)
+                  build_fused_decode_window, build_swap_in_scatter,
+                  build_swap_out_gather)
 from .prefixcache import HostTier, RadixPrefixCache
 from .sampling import (MASK_BIAS, SamplingParams, base_key, flags_of,
                        row_planes)
@@ -614,8 +630,9 @@ class _ServingInstruments:
             "overlapped device time")
         self.async_depth = r.gauge(
             "serving.async.depth",
-            "un-harvested in-flight dispatches right now (hwm = peak "
-            "pipeline depth; this engine double-buffers, so 0 or 1)")
+            "un-harvested in-flight decode dispatches right now (hwm "
+            "= peak pipeline depth reached; bounded by the engine's "
+            "async_depth — 1 for the default double-buffered pipeline)")
         self.slo_attained = r.counter(
             "serving.slo.attained",
             "SLO-carrying requests (deadline_s or max_queue_delay_s "
@@ -977,24 +994,39 @@ class BlockPool:
 
 @dataclass
 class _PendingBlock:
-    """One dispatched-but-not-yet-harvested decode block — the
-    pipeline's double buffer.  ``toks_d``/``tok_d``/``lens_d``/
-    ``done_d`` are the compiled call's UN-MATERIALIZED device outputs:
-    the carries feed the next dispatch directly (device -> device, no
-    host round-trip) and the whole record is forced to host only at
-    harvest.  ``pre_lens`` is the HOST-TRUE per-slot lens entering
-    this dispatch (the KV-sweep model needs it); ``active``/``reqs``
-    pin the riding set so the harvest can verify the no-finish
-    invariant the defer predicate promised."""
+    """One dispatched-but-not-yet-harvested decode dispatch — an entry
+    of the pipeline's bounded pending deque (depth 1 = the PR-10
+    double buffer).  ``toks_d``/``tok_d``/``lens_d``/``done_d``/
+    ``budget_d`` are the compiled call's UN-MATERIALIZED device
+    outputs: the carries feed the next dispatch directly (device ->
+    device, no host round-trip) and the whole record is forced to host
+    only at harvest.  ``done_d`` is the in-trace FINISH BITMAP (EOS
+    hit or budget exhausted): at ``async_depth >= 2`` the host polls
+    it at harvest — one dispatch late — instead of syncing every
+    iteration (a finished rider's slot frees one plan later; the lag
+    is deterministic and flight-recorder-stamped).
+
+    A FUSED dispatch covers ``iters`` logical scheduler iterations of
+    ``per_iter`` scanned steps each (``n = iters * per_iter`` total);
+    the harvest re-splits it iteration by iteration so accounting,
+    ledger and flight-recorder granularity match the unfused engine.
+    ``pre_lens`` is the HOST-TRUE per-slot lens entering this dispatch
+    (the KV-sweep model needs it); ``active``/``reqs`` pin the riding
+    set — a rider that finished in an EARLIER pending dispatch rides
+    later in-flight ones frozen (device-side pad emits) and is skipped
+    at their harvest."""
     step_idx: int
-    n: int                         # scanned steps in this block
+    n: int                         # scanned steps in this dispatch
+    per_iter: int                  # steps per logical iteration
+    iters: int                     # logical iterations (n//per_iter)
     active: List[int]              # riding slot indices
     reqs: List[Request]            # parallel to ``active``
     pre_lens: np.ndarray           # host lens mirror entering dispatch
     toks_d: object                 # [B, n] device tokens
-    tok_d: object                  # carries out: tok / lens / done
-    lens_d: object
-    done_d: object
+    tok_d: object                  # carries out: tok / lens / done /
+    lens_d: object                 # remaining budget (the last two
+    done_d: object                 # form the finish-bitmap protocol)
+    budget_d: object
 
 
 class _LazyStacks:
@@ -1228,8 +1260,8 @@ class ServingEngine:
                  seed=0, static_batching=False, clock=time.perf_counter,
                  registry=None, max_queue=None, enable_preemption=True,
                  fault_injector=None, flight_recorder=None,
-                 async_dispatch=True, adapter_store=None,
-                 tenant_weights=None):
+                 async_dispatch=True, async_depth=1,
+                 adapter_store=None, tenant_weights=None):
         self.num_slots = int(num_slots)
         self.max_queue = None if max_queue is None else int(max_queue)
         if self.max_queue is not None and self.max_queue < 1:
@@ -1379,8 +1411,12 @@ class ServingEngine:
         #       (pb, tok, lens, done, samp, tables, *arenas)
         self._tables = np.full((self.num_slots, self.max_blocks),
                                self._pool.trash, np.int32)
-        donate = tuple(range(6, 6 + len(self._arenas)))
-        self._donate = donate
+        # arena positions differ per program family: chunk prefill and
+        # spec verify take (pb, <4 planes>, samp, *arenas); the decode
+        # block grew the finish-bitmap ``budget`` carry, shifting its
+        # arenas one right
+        self._donate = tuple(range(6, 6 + len(self._arenas)))
+        self._donate_blk = tuple(range(7, 7 + len(self._arenas)))
         # compiled programs are cached per (static shape, sampling
         # feature flags): an all-greedy engine compiles exactly the
         # argmax-only program shapes, and each sampling feature
@@ -1495,20 +1531,49 @@ class ServingEngine:
         # fed by every compiled-call site incl. swap gathers/scatters
         self._disp_s = 0.0
         # dispatch-ahead pipeline (async_dispatch=True, the default):
-        # _pending holds the one dispatched-but-unharvested decode
-        # block; _overlap_s/_stall_s carve harvest waits and injected
-        # stalls out of the step's host-seconds attribution; the
-        # _lazy_stacks list tracks demote gathers enqueued during plan
-        # and reconciled at the next harvest point.
+        # _pend_q holds the dispatched-but-unharvested decode
+        # dispatches, bounded by async_depth; _overlap_s/_stall_s
+        # carve harvest waits and injected stalls out of the step's
+        # host-seconds attribution; the _lazy_stacks list tracks
+        # demote gathers enqueued during plan and reconciled at the
+        # next harvest point.
         # async_dispatch=False is the exact lockstep kill-switch — the
         # A/B arm of the bench's ``async`` sub-object.
+        # async_depth=1 (the default) keeps PR 10's double-buffered
+        # pipeline AND its scheduling-identity contract (every
+        # EOS-configured iteration still syncs, so dispatch counts
+        # match lockstep exactly).  async_depth=S >= 2 opts into the
+        # finish-bitmap protocol: EOS leaves the per-iteration sync
+        # path (the device bitmap is polled one harvest late — a
+        # finished rider's slot frees one plan later, deterministic
+        # and flight-recorder-stamped) and provably eventless windows
+        # dispatch S iterations as ONE fused program.
         self.async_dispatch = bool(async_dispatch)
-        self._pending: Optional[_PendingBlock] = None
+        self.async_depth = int(async_depth)
+        if self.async_depth < 1:
+            raise ValueError(
+                f"async_depth must be >= 1, got {async_depth}")
+        if self.async_depth > 1 and not self.async_dispatch:
+            raise ValueError(
+                f"async_depth={self.async_depth} needs "
+                f"async_dispatch=True — the lockstep kill-switch arm "
+                f"has no pipeline to deepen")
+        self._pend_q: deque = deque()
         self._overlap_s = 0.0
         self._stall_s = 0.0
         self._in_step = False
         self._lazy_parcels: List[int] = []   # tier keys awaiting rows
+        # finishes discovered by a flush OUTSIDE a step (cancel()
+        # between steps, run()'s pre-raise drain): handed to the next
+        # step()'s return so run() never loses a terminal request
+        self._flush_finishes: List[Request] = []
         self._m.async_depth.set(0)
+
+    @property
+    def _pending(self) -> Optional[_PendingBlock]:
+        """The OLDEST un-harvested dispatch (None = pipeline empty) —
+        the depth-1 spelling tests and tools grew up with."""
+        return self._pend_q[0] if self._pend_q else None
 
     # -- block accounting --
     def _blocks_needed(self, n: int, m: int) -> int:
@@ -1654,26 +1719,38 @@ class ServingEngine:
         if self._in_step:
             self._overlap_s += dt
 
-    def _block_sync_reason(self, n: int, active: List[int]):
-        """Why THIS decode block's outputs cannot be deferred (None =
-        deferrable).  A harvest may be deferred only when the next
+    def _block_sync_reason(self, n: int, active: List[int],
+                           lag: int = 0):
+        """Why THIS decode dispatch's outputs cannot be deferred (None
+        = deferrable).  A harvest may be deferred only when the next
         iteration's scheduling is provably output-independent: no
-        rider can reach a terminal state inside the block (EOS
-        configured, or a token budget exhausting), no host-built
-        logit plane (mask bias, repetition-penalty presence) needs the
-        emitted token before the next dispatch, and no speculative
-        slot needs a host accept/rollback decision.  The first
+        host-built logit plane (mask bias, repetition-penalty
+        presence) needs the emitted token before the next dispatch, no
+        speculative slot needs a host accept/rollback decision, and no
+        rider's token BUDGET can exhaust inside the dispatch (the plan
+        knows budgets exactly — ``lag`` corrects host truth for steps
+        still in flight — so budget finishes always harvest sync and
+        retire on the lockstep schedule).  EOS is depth-dependent: the
+        depth-1 pipeline keeps PR 10's contract (scheduling identity
+        with lockstep ⇒ every EOS-configured iteration syncs), while
+        async_depth >= 2 engines read EOS from the in-trace finish
+        bitmap at harvest instead — one dispatch late, the lag
+        deterministic — so ``eos`` leaves the per-iteration sync path
+        and is charged only when the pipeline runs DRY on in-flight
+        finishes (the depth-flush path in ``_step_inner``).  The first
         matching reason is charged to serving.async.syncs."""
         if not self.async_dispatch:
             # kill-switch arm: never charged to the counter (the inc
             # below is gated on async_dispatch), so deliberately NOT
             # an ASYNC_SYNC_REASONS member
             return "off"              # graftlint: disable=vocab
-        if self.cfg.eos_token_id is not None:
+        if self.cfg.eos_token_id is not None and self.async_depth == 1:
             return "eos"
         for i in active:
             r = self._slots[i]
-            if r.remaining <= n:
+            if r is None or r.state != "decode":
+                continue              # retired by a same-step harvest
+            if r.remaining - lag <= n:
                 return "budget"
             sp = r.sampling
             if sp is not None and sp.mask_processor is not None:
@@ -1689,43 +1766,59 @@ class ServingEngine:
             return "spec"
         return None
 
-    def _harvest_pending(self):
-        """Force the pending block's outputs to host and absorb them.
-        The no-finish invariant of the defer predicate means this can
-        only move tokens/carries/ledger state — never scheduling
-        state — which is what makes a deferred harvest legal at ANY
-        point before the next decode dispatch."""
-        p, self._pending = self._pending, None
-        if p is None:
+    # graftlint: plan-phase
+    def _harvest_next(self, out: List[Request]):
+        """Force the OLDEST pending dispatch's outputs to host and
+        absorb them — the finish-bitmap poll site: the materialized
+        ``done`` carry says which riders finished on device (EOS or
+        budget) while later dispatches were already in flight.
+        Harvest order is FIFO, so host truth (tokens, remaining, lens
+        mirrors) is fresh up to the popped dispatch.  The wait charges
+        to serving.step.overlap_seconds, never to host_seconds — this
+        is the slice the pipeline hides under device time."""
+        if not self._pend_q:
             return
-        self._m.async_depth.set(0)
+        p = self._pend_q.popleft()
+        self._m.async_depth.set(len(self._pend_q))
         t0 = self._clock()
         toks = np.asarray(p.toks_d)
         tok = np.array(p.tok_d)       # np.array: writable host copies
         lens = np.array(p.lens_d)
-        done = np.array(p.done_d)
+        done = np.array(p.done_d)     # the finish bitmap
         self._charge_overlap(self._clock() - t0)
-        sink: List[Request] = []
-        self._absorb_block(p, toks, tok, lens, done, sink)
-        if sink:
+        n_before = len(out)
+        self._absorb_block(p, toks, tok, lens, done, out)
+        if self.async_depth == 1 and len(out) > n_before:
+            # the PR-10 contract at depth 1: deferral is legal ONLY
+            # when no rider can finish inside the block (EOS syncs,
+            # budget syncs) — a finish here means the defer predicate
+            # regressed, and silent off-schedule retirement is worse
+            # than a loud failure
             raise RuntimeError(
-                "deferred harvest produced a finish — the defer "
-                "predicate (_block_sync_reason) is broken")
+                "deferred harvest produced a finish at async_depth=1 "
+                "— the defer predicate (_block_sync_reason) is broken")
         self._reconcile_host_tier()
 
-    def _flush_async(self, reason: str):
-        """Harvest the pending block EARLY because host truth is
-        semantically required right now; charged to
-        serving.async.syncs{reason=}.  A no-op (and not counted) when
-        nothing is pending."""
-        if self._pending is None:
+    def _flush_async(self, reason: str,
+                     out: Optional[List[Request]] = None):
+        """Harvest EVERY pending dispatch EARLY (oldest first) because
+        host truth is semantically required right now; charged ONCE to
+        serving.async.syncs{reason=} however deep the pipeline ran.  A
+        no-op (and not counted) when nothing is pending.  Finishes the
+        flush discovers (possible at async_depth >= 2 — the finish
+        bitmap defers them) land in ``out`` when the caller is inside
+        a step, else carry over to the next step()'s return via
+        ``_flush_finishes``."""
+        if not self._pend_q:
             return
         if reason not in ASYNC_SYNC_REASONS:
             raise ValueError(
                 f"unknown forced-sync reason {reason!r} — known: "
                 f"{ASYNC_SYNC_REASONS}")
         self._m.async_syncs.inc(reason=reason)
-        self._harvest_pending()
+        sink = out if out is not None else self._flush_finishes
+        while self._pend_q:
+            self._harvest_next(sink)
 
     def _reconcile_host_tier(self):
         """Materialize every demote parcel enqueued during plan (the
@@ -1764,68 +1857,86 @@ class ServingEngine:
     def _absorb_block(self, p: _PendingBlock, toks: np.ndarray,
                       tok: np.ndarray, lens: np.ndarray,
                       done: np.ndarray, out: List[Request]):
-        """The harvest half of one decode block: adopt the
+        """The harvest half of one decode dispatch: adopt the
         materialized carries as host truth, account the KV sweep and
         the goodput ledger, extend each rider's token stream, emit the
         flight-recorder events (stamped with the DISPATCH step; a
         ``lag`` attr records how many steps later the harvest ran) and
-        retire riders that reached a terminal state.  Shared verbatim
-        by the sync path (immediately after dispatch) and the deferred
-        path (after the NEXT dispatch was enqueued)."""
-        n, active = p.n, p.active
+        retire riders whose finish bitmap flipped.  Shared verbatim by
+        the sync path (immediately after dispatch) and the deferred
+        path (after later dispatches were enqueued).
+
+        A fused dispatch (``p.iters > 1``) is re-split into its
+        logical iterations here, ITERATION-MAJOR, so token streams,
+        per-iteration ledger splits, KV-sweep modeling and the
+        decode_block event sequence are byte-identical (modulo
+        step/lag) to the unfused engine running ``p.iters`` separate
+        blocks.  Two rider classes are skipped per iteration, both
+        frozen device-side so their cells held pad: GHOST riders
+        (finished in an EARLIER pending dispatch — at depth >= 2 the
+        plan could not know yet) and riders that finished in an
+        earlier iteration of THIS dispatch.  Skipped cells follow the
+        ``_count_kv_sweep`` convention (frozen rows excluded), which
+        keeps the ledger and sweep counters exactly what a lockstep
+        engine would have charged."""
+        per, active = p.per_iter, p.active
         self._tok = tok
         self._lens = lens
-        # per-step frontier, not the block's final lens: scanned step s
-        # scatters at index pre_lens+s and attends up to it — clamped
-        # to the row's final lens, where a mid-block EOS froze it
-        self._count_kv_sweep(
-            [min(int(p.pre_lens[i]) + s, int(lens[i]))
-             for i in active for s in range(n)])
-        # goodput: each riding row dispatched n positions — tokens up
-        # to (and including) a mid-block EOS are useful, the frozen
-        # tail behind it is pad (empty at steps_per_call=1); charged
-        # per rider tenant
-        gp: dict = {}          # tenant -> [useful, pad]
         eos = self.cfg.eos_token_id
-        for idx, i in enumerate(active):
-            row = toks[i]
-            if eos is not None and eos in row:
-                useful_i = int(np.flatnonzero(row == eos)[0]) + 1
-            else:
-                useful_i = n
-            cell = gp.setdefault(p.reqs[idx].tenant, [0, 0])
-            cell[0] += useful_i
-            cell[1] += n - useful_i
-        for tenant, (u, pad) in gp.items():
-            self._ledger(u, tenant=tenant, pad=pad)
         t = self._clock()
         lag = self._step_idx - p.step_idx
-        for idx, i in enumerate(active):
-            req = p.reqs[idx]
-            attrs = {"steps": n}
-            if lag:
-                # deterministic (a step delta, never wall): parity
-                # comparisons against a sync engine strip it
-                attrs["lag"] = lag
-            self._fr.emit("decode_block", req.request_id, p.step_idx,
-                          **attrs)
-            req.tokens.extend(int(x) for x in toks[i])
-            req.remaining -= n
-            if done[i] or req.remaining == 0:
-                self._slots[i] = None
-                done[i] = True         # freeze the row until re-use
-                self._release_blocks(req)
-                self._finish(req, t, out)
-            elif req.sampling is not None and \
-                    req.sampling.mask_processor is not None and \
-                    self._mask_dead_end(req):
-                # n == 1 for mask rows (clamped at dispatch), so
-                # exactly one token was appended; finish THIS request
-                # — co-resident rows are untouched
-                self._slots[i] = None
-                done[i] = True
-                self._release_blocks(req)
-                self._finish(req, t, out)
+        sweep: List[int] = []
+        for j in range(p.iters):
+            gp: dict = {}      # tenant -> [useful, pad] this iteration
+            for idx, i in enumerate(active):
+                req = p.reqs[idx]
+                if req.state != "decode":
+                    continue           # ghost / finished-earlier rider
+                row = toks[i, j * per:(j + 1) * per]
+                # per-step frontier, not the final lens: scanned step
+                # s scatters at index pre_lens+s and attends up to it
+                # — clamped to the row's final lens, where an EOS
+                # froze it mid-flight
+                base = int(p.pre_lens[i]) + j * per
+                sweep.extend(min(base + s, int(lens[i]))
+                             for s in range(per))
+                # tokens up to (and including) an EOS are useful, the
+                # frozen tail behind it is pad (empty at per == 1)
+                hit_eos = eos is not None and eos in row
+                useful_i = (int(np.flatnonzero(row == eos)[0]) + 1
+                            if hit_eos else per)
+                cell = gp.setdefault(req.tenant, [0, 0])
+                cell[0] += useful_i
+                cell[1] += per - useful_i
+                attrs = {"steps": per}
+                if lag:
+                    # deterministic (a step delta, never wall): parity
+                    # comparisons against a sync engine strip it
+                    attrs["lag"] = lag
+                self._fr.emit("decode_block", req.request_id,
+                              p.step_idx, **attrs)
+                req.tokens.extend(int(x) for x in row)
+                req.remaining -= per
+                if hit_eos or req.remaining == 0:
+                    # the finish bitmap observed host-side: EOS in
+                    # this iteration's segment, or the budget ran out
+                    self._slots[i] = None
+                    done[i] = True     # freeze the row until re-use
+                    self._release_blocks(req)
+                    self._finish(req, t, out, lag=lag)
+                elif req.sampling is not None and \
+                        req.sampling.mask_processor is not None and \
+                        self._mask_dead_end(req):
+                    # per == 1 for mask rows (clamped at dispatch), so
+                    # exactly one token was appended; finish THIS
+                    # request — co-resident rows are untouched
+                    self._slots[i] = None
+                    done[i] = True
+                    self._release_blocks(req)
+                    self._finish(req, t, out, lag=lag)
+            for tenant, (u, pad) in gp.items():
+                self._ledger(u, tenant=tenant, pad=pad)
+        self._count_kv_sweep(sweep)
         self._done = done
         self._m.slot_occupancy.set(
             sum(r is not None for r in self._slots))
@@ -2295,6 +2406,14 @@ class ServingEngine:
                 # riding set) — queued/swapped/unknown targets leave
                 # the pipeline deferred
                 self._flush_async("cancel")
+                if req.state in TERMINAL_STATES:
+                    # the flush itself retired the request (its finish
+                    # bit was already set on device — the depth >= 2
+                    # finish-bitmap protocol): it FINISHED, it was not
+                    # cancelled, and the documented already-terminal
+                    # contract applies (the finish reaches the next
+                    # step()'s return via _flush_finishes)
+                    return False
                 phase = req.state
                 if req in self._prefilling:
                     self._prefilling.remove(req)
@@ -2314,7 +2433,8 @@ class ServingEngine:
         return False
 
     # -- scheduler --
-    def _finish(self, req: Request, t: float, out: List[Request]):
+    def _finish(self, req: Request, t: float, out: List[Request],
+                lag: int = 0):
         req.finish_time = t
         req.state = "finished"
         if req.slot is not None:
@@ -2335,8 +2455,16 @@ class ServingEngine:
         self._slo_account(req)
         _span_instant("serving.request.finish", request=req.request_id,
                       tokens=len(req.tokens))
-        self._fr.emit("finish", req.request_id, self._step_idx,
-                      tokens=n_out)
+        # the finish-bitmap poll story: a deferred harvest observed
+        # this finish ``lag`` steps after the device produced it — the
+        # event is stamped with the DISPATCH step and the lag attr is
+        # a deterministic step delta ("finished on device at step N,
+        # host observed N+lag"); parity comparisons strip it
+        fattrs = {"tokens": n_out}
+        if lag:
+            fattrs["lag"] = lag
+        self._fr.emit("finish", req.request_id,
+                      self._step_idx - lag, **fattrs)
         # pad the stream out to max_new_tokens (the static generate()
         # convention: pad after EOS) so output shapes are uniform
         req.tokens.extend(
@@ -2448,24 +2576,34 @@ class ServingEngine:
         return self._swap_in_fn
 
     # graftlint: plan-phase
-    def _preempt(self, req: Request, reason: str = "pressure"):
+    def _preempt(self, req: Request, reason: str = "pressure",
+                 out=None):
         """Swap an in-flight request out to the host-RAM tier: gather
         its table row's EXACT at-rest bytes out of every arena (float
         K/V, or int8 codes + scale planes), save the slot's
         ``tok``/``lens`` carries, release its HBM blocks and park it
         on the swap list.  The request's host truth (``tokens``,
         ``pf_pos``, sampling state machine, position-keyed PRNG) needs
-        no saving — it never lived on the device."""
+        no saving — it never lived on the device.  Returns False when
+        the harvest flush itself RETIRED the chosen victim (the
+        finish-bitmap protocol at depth >= 2: its EOS was already on
+        device, so its blocks are free and there is nothing left to
+        swap), True after a real swap-out."""
+        # the swap record saves the slot's HOST tok/lens carries — a
+        # deferred harvest must land first or a pending-active victim
+        # would resume one block behind its own KV bytes.  Flush
+        # BEFORE validating: at depth >= 2 the flush can discover the
+        # victim finished on device, and the stale pre-flush truth
+        # must not be acted on.
+        self._flush_async("preempt", out)
         slot = req.slot
+        if req.state in TERMINAL_STATES:
+            return False            # retired by the flush — done
         if slot is None or req.state not in ("prefill", "decode"):
             raise RuntimeError(
                 f"request {req.request_id} is not in flight "
                 f"(state={req.state}, slot={slot}) — only admitted "
                 f"prefill/decode requests can be preempted")
-        # the swap record saves the slot's HOST tok/lens carries — a
-        # deferred harvest must land first or a pending-active victim
-        # would resume one block behind its own KV bytes
-        self._flush_async("preempt")
         ids = self._tables[slot].copy()     # BEFORE release trashes it
         n = len(req.blocks)
         with _span("serving.swap_out", request=req.request_id,
@@ -2504,8 +2642,10 @@ class ServingEngine:
                       blocks=n, reason=reason, phase=req.swap.state)
         self._fr.emit("swap_out", req.request_id, self._step_idx,
                       blocks=n, reason="preempt")
+        return True
 
-    def _preempt_for(self, cand: Request, needed: int) -> bool:
+    def _preempt_for(self, cand: Request, needed: int,
+                     out=None) -> bool:
         """Free blocks for ``cand`` by swapping out strictly-worse
         victims (victim policy: lowest priority first, then latest
         deadline, then most remaining work) until ``needed`` blocks
@@ -2530,11 +2670,12 @@ class ServingEngine:
                 return False
             victim = min(eligible, key=lambda v: (
                 self._shed_key(v) + (-self._remaining_work(v),)))
-            self._preempt(victim)
+            self._preempt(victim, out=out)
         return True
 
     # graftlint: plan-phase
-    def _try_resume(self, req: Request, slot: int) -> bool:
+    def _try_resume(self, req: Request, slot: int,
+                    out=None) -> bool:
         """Re-admit a swapped request: allocate fresh blocks (leaning
         on the valve and preemption under pressure), re-scatter the
         saved bytes through the donation-matched swap-in program, and
@@ -2558,7 +2699,7 @@ class ServingEngine:
             self._release_queue_pins()
             fresh = self._alloc(rec.n_blocks)
         if fresh is None and self.enable_preemption and \
-                self._preempt_for(req, rec.n_blocks):
+                self._preempt_for(req, rec.n_blocks, out):
             fresh = self._alloc(rec.n_blocks)
         if fresh is None:
             if acquired:
@@ -2569,7 +2710,7 @@ class ServingEngine:
         # the pending block first.  Flushed only HERE, after blocks
         # are secured: a resume attempt that cannot allocate keeps the
         # pipeline deferred (it changed no carries)
-        self._flush_async("resume")
+        self._flush_async("resume", out)
         row = np.full((self.max_blocks,), self._pool.trash, np.int32)
         row[:rec.n_blocks] = fresh
         # the dispatch runs BEFORE any scheduler-state commit, and a
@@ -2884,7 +3025,7 @@ class ServingEngine:
                        req is not min(self._swapped + arrived,
                                       key=_fifo_key))
             if req.state == "swapped":
-                if not self._try_resume(req, slot):
+                if not self._try_resume(req, slot, out):
                     break
                 if reorder:
                     # a fairness-promoted RESUME is a reorder too —
@@ -2937,7 +3078,7 @@ class ServingEngine:
                 n_hbm = 0
                 fresh = self._alloc(total)
             if fresh is None and self.enable_preemption and \
-                    self._preempt_for(req, total - n_hbm):
+                    self._preempt_for(req, total - n_hbm, out):
                 fresh = self._alloc(total - n_hbm)
             if fresh is None:
                 if acquired:
@@ -3173,7 +3314,7 @@ class ServingEngine:
             # the final chunk samples the request's first token, which
             # becomes host truth THIS step (EOS check, decode-mix
             # entry, the slot's tok/lens carries) — the pipeline syncs
-            self._flush_async("chunk_final")
+            self._flush_async("chunk_final", out)
         flags, samp = self._build_samp([req])
         lora_on, lora_planes = self._build_lora([req])
         lora_args = (lora_planes,) if lora_on else ()
@@ -3267,15 +3408,20 @@ class ServingEngine:
         # reads its own host-side truth (req.tokens / self._lens)
         self._done[slot] = req.spec_k is not None
 
-    def _lora_donate(self, lora_on: bool):
+    def _lora_donate(self, lora_on: bool, donate=None):
         """Arena donation positions of a serving program: the ``lora``
         pytree argument (inserted after ``samp``) shifts the flat-
-        arena positions by one.  The adapter arenas themselves are
-        READ-ONLY program inputs and are never donated — a swap-in
-        between dispatches replaces them functionally."""
+        arena positions by one.  ``donate`` is the program family's
+        base positions (chunk/verify vs the decode block, whose
+        ``budget`` carry sits one to the left of ``samp``).  The
+        adapter arenas themselves are READ-ONLY program inputs and are
+        never donated — a swap-in between dispatches replaces them
+        functionally."""
+        if donate is None:
+            donate = self._donate
         if not lora_on:
-            return self._donate
-        return tuple(p + 1 for p in self._donate)
+            return donate
+        return tuple(p + 1 for p in donate)
 
     def _chunk_fn(self, flags, lora_on: bool = False):
         fn = self._chunk_fns.get((flags, lora_on))
@@ -3288,15 +3434,30 @@ class ServingEngine:
             self._chunk_fns[(flags, lora_on)] = fn
         return fn
 
-    def _block_fn(self, steps: int, flags, lora_on: bool = False):
+    def _block_fn(self, steps: int, flags, lora_on: bool = False,
+                  iters: int = 1):
+        """The decode-block program for ``steps`` total scanned steps.
+        A fused depth-S window (``iters`` iterations of steps/iters
+        each, built by ``llm.build_fused_decode_window``) compiles to
+        the SAME program as a plain ``steps``-step block — the cache
+        keys on total steps, so windows and blocks share
+        compilations."""
         fn = self._blocks.get((steps, flags, lora_on))
         if fn is None:
+            if iters > 1:
+                build = build_fused_decode_window(
+                    self._model, self.cfg, steps // iters, iters,
+                    kv_int8=self._kv_int8, samp_flags=flags,
+                    lora=lora_on)
+            else:
+                build = _build_paged_decode_block(
+                    self._model, self.cfg, steps,
+                    kv_int8=self._kv_int8, samp_flags=flags,
+                    lora=lora_on)
             fn = jax.jit(
-                _build_paged_decode_block(self._model, self.cfg, steps,
-                                          kv_int8=self._kv_int8,
-                                          samp_flags=flags,
-                                          lora=lora_on),
-                donate_argnums=self._lora_donate(lora_on))
+                build,
+                donate_argnums=self._lora_donate(lora_on,
+                                                 self._donate_blk))
             self._blocks[(steps, flags, lora_on)] = fn
         return fn
 
@@ -3365,7 +3526,7 @@ class ServingEngine:
         # chunk_final sync), but the verify below reads host lens
         # mirrors — a stale mirror here would verify against the
         # wrong frontier, so sync loudly rather than drift silently
-        self._flush_async("spec")
+        self._flush_async("spec", out)
         drafts = {}
         for i in spec:
             req = self._slots[i]
@@ -3529,7 +3690,10 @@ class ServingEngine:
 
     # graftlint: plan-phase
     def _step_inner(self, now: Optional[float] = None) -> List[Request]:
-        finished: List[Request] = []
+        # finishes a between-steps flush discovered (cancel(), a
+        # wall-timeout drain) hand over to THIS step's return
+        finished: List[Request] = self._flush_finishes
+        self._flush_finishes = []
         t_now = self._clock() if now is None else now
         if self._fault is not None:
             stall = self._fault.take_stall()
@@ -3548,7 +3712,8 @@ class ServingEngine:
                 for r in self._slots:
                     if r is not None and r.request_id == rid \
                             and r.state in ("prefill", "decode"):
-                        self._preempt(r, reason="forced")
+                        self._preempt(r, reason="forced",
+                                      out=finished)
                         break
             n_evict = self._fault.take_tier_evicts()
             if n_evict:
@@ -3577,13 +3742,14 @@ class ServingEngine:
         active = [i for i, r in enumerate(self._slots)
                   if r is not None and self._block_rides(i, r)]
         if not active:
-            if self._pending is not None:
-                # structurally impossible (a pending block's riders
-                # cannot finish or leave while deferred) — never let
-                # a pending record outlive its riding set silently
-                raise RuntimeError(
-                    "dispatch-ahead harvest pending with an empty "
-                    "riding set — the defer invariant broke")
+            if self._pend_q:
+                # the depth-flush path of the finish-bitmap protocol:
+                # the pipeline ran DRY because every rider finished
+                # inside an in-flight dispatch (EOS observed on
+                # device; budget finishes always harvest sync) —
+                # flush the ghost tail so the finishes retire, charged
+                # to the eos the pipeline deferred
+                self._flush_async("eos", finished)
             self._m.slot_occupancy.set(
                 sum(r is not None for r in self._slots))
             return finished
@@ -3599,89 +3765,155 @@ class ServingEngine:
         # n-step block via the done plane and feeding them a second
         # 1-step dispatch per iteration — doubles dispatches and
         # accounting paths for a mix this engine rarely sees)
-        pend = self._pending
-        if pend is not None and pend.active != active:
-            # structurally impossible (deferral forbids finishes, new
-            # decode entrants sync via chunk_final/resume, cancel and
-            # preempt flush) — a mismatch means the invariant broke,
-            # and dispatching would corrupt carries: fail loudly
-            raise RuntimeError(
-                f"dispatch-ahead riding set drifted while a harvest "
-                f"was deferred: pending {pend.active} vs now {active}")
-        # one-step-stale correction: while a harvest is deferred, each
+        pend = self._pend_q[-1] if self._pend_q else None
+        if pend is not None:
+            # structurally impossible either way (new decode entrants
+            # sync via chunk_final/resume, cancel and preempt flush) —
+            # a drift means the invariant broke and dispatching would
+            # corrupt carries: fail loudly.  At depth 1 the set must
+            # match EXACTLY (no rider can finish while deferred — the
+            # PR-10 contract); at depth >= 2 riders legally LEAVE a
+            # deferred set by finishing on device, so only growth is
+            # a breach.
+            if self.async_depth == 1:
+                if pend.active != active:
+                    raise RuntimeError(
+                        f"dispatch-ahead riding set drifted while a "
+                        f"harvest was deferred: pending {pend.active} "
+                        f"vs now {active}")
+            elif not set(active) <= set(pend.active):
+                raise RuntimeError(
+                    f"dispatch-ahead riding set grew while a harvest "
+                    f"was deferred: pending {pend.active} vs now "
+                    f"{active}")
+        # stale-truth correction: while harvests are deferred, each
         # rider's host truth (remaining, len(tokens), lens mirror) is
-        # behind by exactly pend.n tokens
-        lag = pend.n if pend is not None else 0
+        # behind by exactly the steps still in flight (every rider
+        # rides every pending dispatch — it entered before the oldest
+        # and can only leave by finishing, which is discovered AT
+        # harvest)
+        lag = sum(p.n for p in self._pend_q)
         min_budget = min(self._slots[i].remaining for i in active) - lag
         masked = any(self._slots[i].sampling is not None and
                      self._slots[i].sampling.mask_processor is not None
                      for i in active)
         n = 1 if (min_budget < self.steps_per_call or masked) \
             else self.steps_per_call
+        # fused multi-iteration window (async_depth >= 2): when the
+        # next S iterations are PROVABLY eventless — nothing queued or
+        # swapped to admit, no chunk to ride, the dispatch itself
+        # deferrable (no mask/penalty/spec row) and budget headroom
+        # strictly beyond the whole window for every rider — dispatch
+        # S iterations as ONE fused scan program, amortizing the
+        # per-dispatch host cost the way decode_scan_body amortizes
+        # the per-token cost.  EOS inside the window is legal: the
+        # finish bitmap freezes the row in-trace and the harvest
+        # re-splits the window iteration by iteration.
+        iters = 1
+        if (self.async_depth > 1 and not masked
+                and not self._prefilling and not self._swapped
+                and not self._queue
+                and min_budget > self.async_depth * n
+                and self._block_sync_reason(n, active, lag) is None):
+            iters = self.async_depth
+        n_total = n * iters
         active_set = set(active)
         riding = [self._slots[i] if i in active_set else None
                   for i in range(self.num_slots)]
         flags, samp = self._build_samp(riding, pos_lag=lag)
         # adapter ids are host-plan state pinned with the riding set
-        # (which cannot change while a harvest is deferred), so the
+        # (which cannot grow while a harvest is deferred), so the
         # dispatch-ahead pipeline carries them one-step-stale for free
         lora_on, lora_planes = self._build_lora(riding)
         lora_args = (lora_planes,) if lora_on else ()
         pre_lens = np.array(self._lens)
         if pend is not None:
-            # the riding set equals the pending set (checked above),
-            # so every rider's true pre-dispatch lens is mirror + n
+            # every current rider rode every pending dispatch (subset
+            # check above), so its true pre-dispatch lens is the host
+            # mirror + the in-flight steps (rows an in-flight EOS
+            # already froze advance less — the harvest's sweep model
+            # clamps to their final lens)
             pre_lens[active] += lag
-            # double-buffered carries: feed the in-flight block's
-            # device outputs straight into this dispatch — no host
-            # round-trip, no wait
-            tok_in, lens_in, done_in = pend.tok_d, pend.lens_d, \
-                pend.done_d
+            # double-buffered carries: feed the newest in-flight
+            # dispatch's device outputs straight into this one — no
+            # host round-trip, no wait.  budget rides the same carry
+            # chain (the finish-bitmap protocol).
+            tok_in, lens_in, done_in, budget_in = \
+                pend.tok_d, pend.lens_d, pend.done_d, pend.budget_d
         else:
+            budget = np.zeros((self.num_slots,), np.int32)
+            for i in active:
+                budget[i] = self._slots[i].remaining
             tok_in = jnp.asarray(self._tok)
             lens_in = jnp.asarray(self._lens)
             done_in = jnp.asarray(self._done)
+            budget_in = jnp.asarray(budget)
         t_blk = self._clock()
-        with _span("serving.decode_block", steps=n, active=len(active)):
+        with _span("serving.decode_block", steps=n_total,
+                   active=len(active)):
             out = _call_quiet(
-                self._block_fn(n, flags, lora_on),
-                self._pb, tok_in, lens_in, done_in, samp, *lora_args,
-                jnp.asarray(self._decode_tables()), *self._arenas)
-        self._arenas = list(out[4:])
+                self._block_fn(n_total, flags, lora_on, iters=iters),
+                self._pb, tok_in, lens_in, done_in, budget_in, samp,
+                *lora_args, jnp.asarray(self._decode_tables()),
+                *self._arenas)
+        self._arenas = list(out[5:])
         self._disp_s += self._clock() - t_blk
         # plan-known accounting lands at DISPATCH (same step as the
         # lockstep engine); output-dependent accounting (KV sweep,
         # ledger, token streams, flight-recorder events) lands at
-        # harvest inside _absorb_block
-        self._m.decode_steps.inc(n)
-        self._m.busy_slot_steps.inc(n * len(active))
+        # harvest inside _absorb_block.  At async_depth >= 2 a rider
+        # that already finished on device still counts its cells here
+        # (the plan cannot know without the sync this protocol
+        # removes) — these block-granular counters are documented
+        # approximate; the harvest-side ledger stays exact.
+        self._m.decode_steps.inc(n_total)
+        self._m.busy_slot_steps.inc(n_total * len(active))
         self._m.block_dispatches.inc()
-        self._m.tokens_emitted.inc(n * len(active))
-        self._count_sample_route([(self._slots[i], n) for i in active])
+        self._m.tokens_emitted.inc(n_total * len(active))
+        self._count_sample_route(
+            [(self._slots[i], n_total) for i in active])
         new_pend = _PendingBlock(
-            step_idx=self._step_idx, n=n, active=list(active),
+            step_idx=self._step_idx, n=n_total, per_iter=n,
+            iters=iters, active=list(active),
             reqs=[self._slots[i] for i in active], pre_lens=pre_lens,
-            toks_d=out[0], tok_d=out[1], lens_d=out[2], done_d=out[3])
-        if pend is not None:
-            # THE overlap point: the previous block's outputs are
-            # forced only now, after this iteration's host work ran
-            # and its dispatch was enqueued
-            self._harvest_pending()
+            toks_d=out[0], tok_d=out[1], lens_d=out[2], done_d=out[3],
+            budget_d=out[4])
+        self._pend_q.append(new_pend)
+        # THE overlap points: older dispatches' outputs are forced
+        # only now, after this iteration's host work ran and its
+        # dispatch was enqueued — harvest down to the configured depth
+        while len(self._pend_q) > self.async_depth:
+            self._harvest_next(finished)
             self._m.async_harvests.inc()
-        reason = self._block_sync_reason(n, active)
+        # defer or sync the tail.  Riders a same-step harvest just
+        # retired are skipped inside _block_sync_reason; the remaining
+        # in-flight steps (older pendings minus the new dispatch)
+        # correct host truth for the budget check.
+        reason = self._block_sync_reason(
+            n_total, active,
+            lag=sum(p.n for p in self._pend_q) - n_total)
         if reason is None:
-            self._pending = new_pend
-            self._m.async_depth.set(1)
+            # steady-state pipeline depth (the transient enqueue->
+            # harvest overshoot is not a depth the scheduler sustains,
+            # and a sync iteration never counts as depth)
+            self._m.async_depth.set(len(self._pend_q))
         else:
             if self.async_dispatch:
                 self._m.async_syncs.inc(reason=reason)
+            # older dispatches flush first, FIFO (their waits charge
+            # to overlap — they did run under later host work) ...
+            while len(self._pend_q) > 1:
+                self._harvest_next(finished)
+            self._pend_q.pop()
+            self._m.async_depth.set(0)
             t_mat = self._clock()
             toks = np.asarray(new_pend.toks_d)          # [B, n]
             tok = np.array(new_pend.tok_d)  # np.array: writable copies
             lens = np.array(new_pend.lens_d)
             done = np.array(new_pend.done_d)
-            # sync materialization is part of the dispatch, exactly
-            # the lockstep engine's attribution
+            # ... and the new dispatch's sync materialization is part
+            # of the dispatch, exactly the lockstep engine's
+            # attribution
             self._disp_s += self._clock() - t_mat
             self._absorb_block(new_pend, toks, tok, lens, done,
                                finished)
@@ -3760,11 +3992,16 @@ class ServingEngine:
                     f"{len(self._queue)} queued / "
                     f"{len(self._swapped)} swapped / "
                     f"{sum(r is not None for r in self._slots)} active")
-        # a drained loop cannot leave a harvest pending (the last
-        # rider's final block is always a forced budget/eos sync), but
-        # flush defensively so run() can never hand back stale truth
-        self._flush_async("drain")
+        # at async_depth == 1 a drained loop cannot leave a harvest
+        # pending (the last rider's final block is always a forced
+        # budget/eos sync); at depth >= 2 the finish-bitmap protocol
+        # CAN — the dispatches enqueued after an in-flight EOS ride
+        # out as device-frozen ghosts — so the drain flush absorbs
+        # them here and run() never hands back stale truth
+        self._flush_async("drain", finished)
         self._reconcile_host_tier()
+        finished.extend(self._flush_finishes)
+        self._flush_finishes = []
         return sorted(finished, key=lambda r: r.request_id)
 
     def stats(self) -> dict:
@@ -3937,6 +4174,7 @@ class ServingEngine:
             # (ledger, kv_bytes_swept) lag by at most one dispatch;
             # run() always returns with the pipeline flushed.
             "async_dispatch": self.async_dispatch,
+            "async_depth": self.async_depth,
             "async_syncs": int(self._m.since_init(self._m.async_syncs)),
             "async_harvests": int(
                 self._m.since_init(self._m.async_harvests)),

@@ -339,7 +339,10 @@ def explain_events(events: List[FlightEvent], request_id: int) -> str:
         lags = [int(e.attrs.get("lag", 0)) for e in blocks_ev]
         n_lag = sum(1 for v in lags if v)
         if n_lag:
-            clause += (f" ({n_lag} harvested dispatch-ahead, lag "
+            # "lag <= K": a depth-S pipeline harvests each dispatch up
+            # to S steps after it was enqueued; max(lags) is the
+            # deepest deferral this request actually saw
+            clause += (f" ({n_lag} harvested dispatch-ahead, lag <= "
                        f"{_plural(max(lags), 'step')})")
         parts.append(clause)
     for kind, verb in (("finish", "finished"), ("timeout", "timed out"),
@@ -350,5 +353,15 @@ def explain_events(events: List[FlightEvent], request_id: int) -> str:
                 extra = f" after {_plural(int(e.attrs['tokens']), 'token')}"
             if kind == "cancel" and "phase" in e.attrs:
                 extra = f" from phase {e.attrs['phase']}"
-            parts.append(f"{verb} at step {e.step}{extra}")
+            flag = int(e.attrs.get("lag", 0))
+            if kind == "finish" and flag:
+                # the finish-bitmap poll (dispatch-ahead depth >= 2):
+                # the device flipped the row's finish bit inside the
+                # dispatch of step N; the host observed it at the
+                # deferred harvest, ``lag`` steps later
+                parts.append(
+                    f"finished on device at step {e.step}, host "
+                    f"observed at step {e.step + flag}{extra}")
+            else:
+                parts.append(f"{verb} at step {e.step}{extra}")
     return f"request {request_id}: " + "; ".join(parts)

@@ -363,6 +363,77 @@ def test_hostsync_device_suffix_taint(tmp_path):
     assert len(out) == 1 and "plan()" in out[0].message, out
 
 
+def test_hostsync_harvest_overlap_charge_is_recognized(tmp_path):
+    """The depth-S harvest idiom (PR 14): the finish-bitmap poll
+    materializes a previous dispatch's outputs by design — legal when
+    the wait is attributed to overlap (``_charge_overlap`` in the same
+    suite, before OR after: the idiom brackets the poll with a clock
+    read on each side)."""
+    fs = {"mod.py": """
+        import numpy as np
+
+        ASYNC_SYNC_REASONS = ("eos",)
+
+        class E:
+            # graftlint: plan-phase
+            def harvest_next(self, out):
+                p = self._pend_q.popleft()
+                t0 = self._clock()
+                toks = np.asarray(p.toks_d)
+                done = np.array(p.done_d)
+                self._charge_overlap(self._clock() - t0)
+                return toks, done
+        """}
+    assert _run(tmp_path, fs, ["host-sync"]) == []
+
+
+def test_hostsync_overlap_charge_scope_is_immediate_suite(tmp_path):
+    """A ``_charge_overlap`` inside one branch must not legalize a
+    materialization OUTSIDE that branch — the overlap justification
+    is same-immediate-suite only."""
+    fs = {"mod.py": """
+        import numpy as np
+
+        ASYNC_SYNC_REASONS = ("eos",)
+
+        class E:
+            # graftlint: plan-phase
+            def plan(self, p, fast):
+                if fast:
+                    t0 = self._clock()
+                    a = np.asarray(p.toks_d)
+                    self._charge_overlap(self._clock() - t0)
+                    return a
+                return np.asarray(p.done_d)
+        """}
+    out = _run(tmp_path, fs, ["host-sync"])
+    assert len(out) == 1, out
+    assert "plan()" in out[0].message
+
+
+def test_hostsync_depth_plan_unannotated_poll_is_flagged(tmp_path):
+    """Seeded violation: a depth-S plan function peeking at the
+    pending deque's device outputs with NO overlap attribution, sync
+    charge or annotation — exactly the un-charged materialization the
+    dispatch-ahead contract forbids."""
+    fs = {"mod.py": """
+        import numpy as np
+
+        ASYNC_SYNC_REASONS = ("eos",)
+
+        class E:
+            # graftlint: plan-phase
+            def plan_depth_bad(self):
+                lag = sum(p.n for p in self._pend_q)
+                done = np.asarray(self._pend_q[0].done_d)
+                return lag, done
+        """}
+    out = _run(tmp_path, fs, ["host-sync"])
+    assert len(out) == 1, out
+    assert "plan_depth_bad()" in out[0].message
+    assert "overlap attribution" in out[0].message
+
+
 # ---------------------------------------------------------------------------
 # instruments pass (full rules live in tests/test_observability.py via
 # the shim; here: the pass fails on a seeded conflict in a synthetic

@@ -1,0 +1,339 @@
+"""Depth-S dispatch-ahead (PR 14): in-trace finish bitmap + fused
+multi-iteration decode dispatches — depth-S vs lockstep parity.
+
+Tier-1 budget discipline (truncation-scored on the 2-core box): ONE
+tiny 1-layer llama model at module scope, steps_per_call=1 (block
+granularity is orthogonal to the depth axis, and at 1 the per-request
+event stories compare byte-exactly), short prompts/budgets, and ONE
+combined trace driven twice — ``async_depth=3`` vs the
+``async_dispatch=False`` lockstep kill-switch — on PRIVATE registries
+and recorders, ``BlockPool.check()`` after every step.
+
+Contract under test (the PR-14 acceptance anchor): outputs token-exact
+(EOS-cut rows and seeded-sampled rows included — the PRNG plane
+advances by the full queued depth), admission ORDER identical, and
+per-request flight-recorder stories byte-identical modulo step/lag —
+scheduling IDENTITY is deliberately relaxed to a deterministic,
+flight-recorder-stamped slot-free lag: a finished rider's slot frees
+one harvest later than lockstep, which the one-step-stale plan truth
+already tolerates.  ``eos`` leaves the per-iteration sync path
+(charged only on the depth-flush), eventless windows dispatch S
+iterations as ONE fused program (strictly fewer dispatches), and a
+mask row arriving mid-window degrades the pipeline back to sync."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.inference.sampling import DfaTokenMask, SamplingParams
+from paddle_tpu.inference.serving import TERMINAL_STATES, ServingEngine
+from paddle_tpu.observability import MetricsRegistry
+from paddle_tpu.observability.flightrec import FlightRecorder
+
+P, C, BL, DEPTH = 8, 40, 4, 3
+TERMINAL = TERMINAL_STATES
+
+
+@pytest.fixture(scope="module")
+def netm():
+    paddle.seed(1234)
+    cfg = models.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64)
+    net = models.LlamaForCausalLM(cfg)
+    net.eval()
+    return cfg, net
+
+
+def _gen_ref(net, ids, max_new, eos=None):
+    out = net.generate(paddle.to_tensor(ids[None, :]),
+                       max_new_tokens=max_new, max_cache_len=C,
+                       eos_token_id=eos, compute_dtype="float32")
+    return np.asarray(out._value)[0]
+
+
+def _mask_table(vocab):
+    # 2-state DFA cycling tokens 1 -> 2 -> 1 (always a legal
+    # continuation, so the masked request runs its full budget)
+    table = np.full((2, vocab), -1, np.int32)
+    table[0, 1] = 1
+    table[1, 2] = 0
+    return table
+
+
+def _drive(net, cfg, eos, ids, *, depth):
+    """The combined trace: an EOS-cut greedy row + a budget-bound
+    greedy row + a seeded-sampled row through 2 slots (the third
+    queues, so its admission rides the finish-bitmap slot-free lag),
+    then a fused-window solo phase interrupted MID-WINDOW by a
+    token-masked arrival (the forced degrade-to-sync)."""
+    ids_a, ids_b, ids_c, ids_d, ids_e = ids
+    reg = MetricsRegistry()
+    rec = FlightRecorder()
+    eng = ServingEngine(
+        net, num_slots=2, prompt_len=P, max_cache_len=C,
+        steps_per_call=1, block_len=BL, chunk_len=4, num_blocks=12,
+        eos_token_id=eos, compute_dtype="float32", registry=reg,
+        flight_recorder=rec,
+        async_dispatch=depth > 0, async_depth=max(depth, 1))
+
+    def drain(reqs, max_steps=150):
+        steps = 0
+        while any(r.state not in TERMINAL for r in reqs):
+            eng.step(now=0.0)
+            eng._pool.check()
+            steps += 1
+            assert steps < max_steps, "trace did not drain"
+
+    # phase 1: EOS row (cut at token 3 by construction) + budget row
+    # + a seeded-sampled rider; the sampled row decodes beside the
+    # budget row through fused windows once the queue empties, so its
+    # position-keyed PRNG planes advance at lag > 1
+    ra = eng.submit(ids_a, max_new_tokens=10, arrival_time=0.0)
+    rb = eng.submit(ids_b, max_new_tokens=12, arrival_time=0.0)
+    rc = eng.submit(ids_c, max_new_tokens=8, arrival_time=0.0,
+                    sampling=SamplingParams(temperature=0.8, top_k=12,
+                                            seed=5))
+    drain([ra, rb, rc])
+
+    # phase 2: a solo long rider reaches steady fused windows, then a
+    # token-masked request arrives MID-WINDOW — admission + chunk_final
+    # + the per-token mask bias all degrade the pipeline to sync
+    rd = eng.submit(ids_d, max_new_tokens=14, arrival_time=0.0)
+    for _ in range(6):          # admit + prefill + fused decode
+        eng.step(now=0.0)
+        eng._pool.check()
+    re_ = eng.submit(ids_e, max_new_tokens=4, arrival_time=0.0,
+                     sampling=SamplingParams(
+                         temperature=0.0,
+                         mask_processor=DfaTokenMask(
+                             _mask_table(cfg.vocab_size))))
+    drain([rd, re_])
+    # every pending dispatch flushed, every block home
+    done = eng.run()
+    assert eng._pending is None
+    eng._pool.check()
+    return eng, reg, rec, (ra, rb, rc, rd, re_), done
+
+
+@pytest.fixture(scope="module")
+def arms(netm):
+    cfg, net = netm
+    rng = np.random.default_rng(99)
+    ids_a = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    ids_b = rng.integers(0, cfg.vocab_size, (7,)).astype(np.int32)
+    ids_c = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    ids_d = rng.integers(0, cfg.vocab_size, (7,)).astype(np.int32)
+    ids_e = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+    # an EOS that cuts row a's stream at its 4th token (tokens before
+    # EOS are unaffected by the eos config) and is checked absent from
+    # the other greedy streams' early tokens, so exactly one phase-1
+    # row finishes through the finish bitmap
+    eos = int(_gen_ref(net, ids_a, 10)[3])
+    ids = (ids_a, ids_b, ids_c, ids_d, ids_e)
+    d = _drive(net, cfg, eos, ids, depth=DEPTH)
+    s = _drive(net, cfg, eos, ids, depth=0)   # lockstep kill-switch
+    return d, s, eos, ids
+
+
+def _stories(rec, strip=("lag", "slot")):
+    """Per-request event sequences with step numbering, the
+    deterministic lag attr and SLOT indices stripped: a fused window
+    compresses step numbering and stamps events with the dispatch
+    step, and a finished rider's slot frees one harvest later than
+    lockstep — so a later admission may land in a different (equally
+    deterministic) slot index.  Byte identity modulo step/lag/slot is
+    the depth-S parity contract; admission ORDER is asserted
+    separately and exactly."""
+    out = {}
+    for e in rec.events():
+        out.setdefault(e.request, []).append(
+            (e.kind, tuple(sorted((k, str(v)) for k, v in
+                                  e.attrs.items() if k not in strip))))
+    return out
+
+
+def test_depth_vs_lockstep_parity(arms, netm):
+    cfg, net = netm
+    (ed, rgd, recd, qd, _), (es, rgs, recs, qs, _), eos, ids = arms
+    # token-exact across the combined trace, arm vs arm — EOS-cut,
+    # budget-bound, seeded-sampled and mask-constrained rows alike
+    for d, s in zip(qd, qs):
+        np.testing.assert_array_equal(d.output, s.output)
+    # greedy rows are also generate()-exact (the standing anchor);
+    # row a really was cut by EOS and padded out
+    ra, rb, _rc, rd, _re = qd
+    np.testing.assert_array_equal(
+        ra.output, _gen_ref(net, ids[0], 10, eos=eos))
+    np.testing.assert_array_equal(
+        rb.output, _gen_ref(net, ids[1], 12, eos=eos))
+    np.testing.assert_array_equal(
+        rd.output, _gen_ref(net, ids[3], 14, eos=eos))
+    assert eos in ra.output and ra.n_emitted < 10
+    # admission ORDER identical (the slot frees late at depth S, but
+    # who-admits-next never changes)
+    adm_d = [e.request for e in recd.events() if e.kind == "admit"]
+    adm_s = [e.request for e in recs.events() if e.kind == "admit"]
+    assert adm_d == adm_s
+    # per-request stories byte-identical modulo step/lag
+    assert _stories(recd) == _stories(recs)
+    # the goodput ledger is exact in both arms (ghost riders are
+    # excluded like any frozen row): identical useful/wasted splits
+    sd, ss = ed.stats(), es.stats()
+    for k in ("useful_tokens", "wasted_tokens", "dispatched_tokens",
+              "wasted_by_reason", "finished", "prefills",
+              "prefill_chunks", "kv_bytes_swept"):
+        assert sd[k] == ss[k], k
+
+
+def test_depth_pipeline_behavior(arms):
+    (ed, rgd, recd, _qd, _), (es, rgs, recs, _qs, _), _eos, _ids = arms
+    sd, ss = ed.stats(), es.stats()
+    assert sd["async_depth"] == DEPTH and ss["async_dispatch"] is False
+    # fused windows really dispatched fewer blocks than lockstep ran
+    # iterations, while scanning the same number of decode steps or
+    # more (device-frozen ghost tails ride after an in-flight EOS)
+    assert sd["block_dispatches"] < ss["block_dispatches"]
+    assert sd["decode_steps"] >= ss["decode_steps"]
+    assert sd["async_harvests"] > 0
+    # eos left the per-iteration sync path: an EOS-configured engine
+    # charged 'eos' only on depth-flushes (pipeline ran dry on an
+    # in-flight finish), never once per iteration
+    by_reason = sd["async_syncs_by_reason"]
+    assert by_reason["eos"] <= 2
+    assert by_reason["eos"] < ss["block_dispatches"] // 2
+    # the mask arrival mid-window degraded the pipeline to sync, and
+    # budget finishes stayed on the sync path
+    assert by_reason["mask"] > 0
+    assert by_reason["budget"] > 0
+    assert by_reason["chunk_final"] > 0
+    # the depth gauge reports the real queued depth and its high-water
+    # mark (the PR-14 bugfix: it could never read above 1 before)
+    g = rgd.get("serving.async.depth")
+    assert g.hwm() == DEPTH
+    assert g.value() == 0                  # drained
+    assert rgs.get("serving.async.depth").hwm() == 0
+    # the finish-bitmap poll is visible per request: the EOS row's
+    # finish event carries the deterministic lag attr and explain()
+    # renders the device-vs-host observation steps
+    lag_fin = [e for e in recd.events()
+               if e.kind == "finish" and e.attrs.get("lag")]
+    assert lag_fin
+    text = ed.explain(lag_fin[0].request)
+    assert "finished on device at step" in text
+    assert "host observed at step" in text
+    assert not [e for e in recs.events()
+                if e.kind == "finish" and e.attrs.get("lag")]
+
+
+def test_depth_flush_retires_target_guards(netm):
+    """cancel() and preemption race an IN-FLIGHT device finish: at
+    depth >= 2 the pre-action flush can itself retire the target (its
+    EOS was already on device), and the stale pre-flush truth must
+    not be acted on — cancel returns False (the request FINISHED, per
+    its already-terminal contract) and a forced preemption swaps
+    nothing; the finish reaches run()'s return via the flush stash
+    and the output stays generate()-exact."""
+    from paddle_tpu.inference import FaultInjector
+    cfg, net = netm
+    rng = np.random.default_rng(21)
+    ids = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    eos = int(_gen_ref(net, ids, 12)[2])    # EOS at the 3rd token
+    want = _gen_ref(net, ids, 12, eos=eos)
+
+    def prime(fi=None):
+        """Solo rider at depth 3, stepped until its EOS is provably
+        in flight but unharvested (deferred dispatches pending, the
+        rider still stale-active)."""
+        eng = ServingEngine(
+            net, num_slots=2, prompt_len=P, max_cache_len=C,
+            steps_per_call=1, block_len=BL, chunk_len=4,
+            eos_token_id=eos, compute_dtype="float32",
+            registry=MetricsRegistry(), fault_injector=fi,
+            async_dispatch=True, async_depth=3)
+        r = eng.submit(ids, max_new_tokens=12, arrival_time=0.0)
+        # armed = the EOS (3rd token) has been DISPATCHED (tok0 plus
+        # >= 2 decode steps in flight) but not harvested (the rider
+        # still looks live on stale host truth)
+        for _ in range(12):
+            if (r.state == "decode" and eng._pend_q
+                    and eng.stats()["decode_steps"] >= 2
+                    and len(r.tokens) < 3):
+                break
+            eng.step(now=0.0)
+        assert r.state == "decode" and eng._pend_q   # race armed
+        return eng, r
+
+    # cancel loses the race: the flush finishes the request first
+    eng, r = prime()
+    assert eng.cancel(r.request_id) is False
+    assert r.state == "finished"
+    done = eng.run()
+    assert [q.request_id for q in done] == [r.request_id]
+    np.testing.assert_array_equal(r.output, want)
+    eng._pool.check()
+
+    # forced preemption loses the race the same way: nothing swaps,
+    # the victim is not resurrected onto the swap list
+    fi = FaultInjector()
+    eng2, r2 = prime(fi)
+    fi.force_swap(r2.request_id)
+    done2 = eng2.run()
+    assert r2.state == "finished" and not eng2._swapped
+    assert eng2.stats()["preemptions"] == 0
+    assert [q.request_id for q in done2] == [r2.request_id]
+    np.testing.assert_array_equal(r2.output, want)
+    eng2._pool.check()
+
+
+def test_depth_validation_guards(netm):
+    cfg, net = netm
+    with pytest.raises(ValueError, match="async_depth"):
+        ServingEngine(net, num_slots=1, prompt_len=P, max_cache_len=C,
+                      compute_dtype="float32", async_depth=0)
+    with pytest.raises(ValueError, match="async_dispatch=True"):
+        ServingEngine(net, num_slots=1, prompt_len=P, max_cache_len=C,
+                      compute_dtype="float32", async_dispatch=False,
+                      async_depth=2)
+
+
+@pytest.mark.slow
+def test_depth_int8_spec_twin(netm):
+    """Depth-S over the quantized cache with a speculative rider: the
+    spec row forces per-iteration syncs (reason 'spec'), the plain
+    co-rider keeps the finish bitmap exercised over int8 arenas, and
+    outputs stay token-exact vs the int8 lockstep engine."""
+    cfg, net = netm
+    rng = np.random.default_rng(11)
+    pat = rng.integers(0, cfg.vocab_size, (3,)).astype(np.int32)
+    rep = np.tile(pat, 2)                   # draftable 6-token prompt
+    plain = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    eos = int(_gen_ref(net, plain, 12)[6])
+
+    class _AlwaysDraft:
+        def propose(self, context, k):
+            return np.repeat(np.asarray(context[-1:], np.int32), k)
+
+    def run(depth):
+        eng = ServingEngine(
+            net, num_slots=2, prompt_len=P, max_cache_len=C,
+            steps_per_call=1, block_len=BL, chunk_len=8,
+            eos_token_id=eos, kv_cache_dtype="int8",
+            compute_dtype="float32", registry=MetricsRegistry(),
+            drafter=_AlwaysDraft(),
+            async_dispatch=depth > 0, async_depth=max(depth, 1))
+        r1 = eng.submit(plain, max_new_tokens=12, arrival_time=0.0)
+        r2 = eng.submit(rep, max_new_tokens=10, arrival_time=0.0,
+                        spec_decode=2)
+        eng.run(max_iters=500)
+        eng._pool.check()
+        return r1.output, r2.output, eng.stats()
+
+    o1d, o2d, sd = run(DEPTH)
+    o1s, o2s, ss = run(0)
+    np.testing.assert_array_equal(o1d, o1s)
+    np.testing.assert_array_equal(o2d, o2s)
+    assert sd["spec_verify_steps"] == ss["spec_verify_steps"] > 0
+    assert sd["async_syncs_by_reason"]["spec"] > 0

@@ -818,6 +818,24 @@ def test_bench_llm_serving_section():
     assert aa["gate"]["dispatch_counts_equal"]
     assert aa["gate"]["pipelined"]
     assert aa["gate"]["sync_reasons_documented"]
+    # PR 14: the depth-S finish-bitmap/fused-window A/B — gated ONLY
+    # on deterministic counters (token-exact across all three arms,
+    # admission order identical, event stories byte-identical modulo
+    # step/lag, eos syncs and dispatches strictly lower at depth S,
+    # depth gauge hwm == S); walls ride along ungated
+    ad = out["async_depth"]
+    for k in ("depth", "eos_token_id", "tokens_per_s",
+              "depth1_tokens_per_s", "lockstep_tokens_per_s",
+              "eos_syncs", "block_dispatches", "async_harvests",
+              "depth_hwm", "host_ms", "dispatch_ms", "overlap_ms",
+              "gate"):
+        assert k in ad, k
+    for g in ("token_exact", "eos_syncs_strictly_lower",
+              "dispatches_strictly_lower",
+              "admission_order_identical", "event_stories_identical",
+              "depth_gauge_reaches_s"):
+        assert ad["gate"][g], g
+    assert ad["eos_syncs"]["depthS"] < ad["eos_syncs"]["depth1"]
     # the spec arm's waste is dominated by rejected draft positions
     assert spec["goodput"]["wasted_by_reason"]["spec_reject"] > 0
     assert "no_spec_goodput" in spec

@@ -48,7 +48,13 @@ def _fmt_timeline(events, request_id) -> str:
         lag = attrs.pop("lag", None)
         joined = " ".join(f"{k}={v}" for k, v in attrs.items())
         line = f"  step {e.step:>5}  {e.kind:<14} {joined}".rstrip()
-        if lag:
+        if lag and e.kind == "finish":
+            # the finish-bitmap poll (depth >= 2 pipelines): the row
+            # finished on device at the stamped step; the host saw it
+            # at the deferred harvest, lag steps later
+            line += (f"  [finished on device at step {e.step}, host "
+                     f"observed at step {e.step + int(lag)}]")
+        elif lag:
             line += (f"  [harvested +{int(lag)} step"
                      f"{'' if int(lag) == 1 else 's'}]")
         lines.append(line)

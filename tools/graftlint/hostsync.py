@@ -24,7 +24,17 @@ materialization side of it machine-checked:
      enclosing) suite of the function calls ``_flush_async(...)`` or
      ``<x>.async_syncs.inc(...)`` — the charge IS the justification,
      and keeping them adjacent is exactly the discipline the pass
-     enforces.
+     enforces, or
+  3. an overlap attribution in the same IMMEDIATE suite: the
+     HARVEST-side finish-bitmap poll (PR 14) materializes a previous
+     dispatch's outputs by design — that is the pipeline's natural
+     overlap point, not a forced sync — and its discipline is that
+     the wait is charged to ``serving.step.overlap_seconds`` via
+     ``_charge_overlap(...)``.  A suite that both materializes and
+     calls ``_charge_overlap`` (before or after — the idiom brackets
+     the poll with a clock read on each side) is a recognized charged
+     harvest site; a charge in a sibling branch or an enclosing suite
+     does NOT carry over.
 
 Device taint is name-based and local to the function, tuned to this
 codebase's conventions: attributes/names ending in ``_d`` (the
@@ -128,18 +138,55 @@ def _materializing_call(node: ast.Call) -> Optional[ast.AST]:
 
 
 _CHARGE_ATTRS = {"_flush_async", "async_syncs"}
+# the harvest-side discipline: a finish-bitmap poll is charged to
+# overlap, not to a sync reason (see rule 3 in the module docstring)
+_HARVEST_CHARGES = {"_charge_overlap"}
 
 
-def _stmt_charges(st: ast.stmt) -> bool:
+def _stmt_calls(st: ast.stmt, names) -> bool:
     for node in ast.walk(st):
         if isinstance(node, ast.Call):
             for part in ast.walk(node.func):
                 nm = (part.id if isinstance(part, ast.Name)
                       else part.attr if isinstance(part, ast.Attribute)
                       else None)
-                if nm in _CHARGE_ATTRS:
+                if nm in names:
                     return True
     return False
+
+
+def _stmt_charges(st: ast.stmt) -> bool:
+    return _stmt_calls(st, _CHARGE_ATTRS)
+
+
+def _overlap_charged_suite(fn: ast.AST, target_stmt: ast.stmt) -> bool:
+    """True when the IMMEDIATE suite holding ``target_stmt`` also
+    calls ``_charge_overlap`` — anywhere in that one suite: the
+    harvest idiom reads the clock BEFORE the poll and attributes the
+    wait AFTER it, so adjacency here means same-suite, not
+    strictly-preceding.  Deliberately narrower than
+    ``_charged_before``'s enclosing-suite climb: a charge in a
+    sibling branch (or 80 lines away at an outer level) must not
+    legalize an unrelated materialization."""
+
+    compound = (ast.If, ast.For, ast.While, ast.Try, ast.With,
+                ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+    def search(body: List[ast.stmt]) -> Optional[bool]:
+        for st in body:
+            if st is target_stmt:
+                # shallow scan: a compound sibling's NESTED suites are
+                # other scopes — their charges do not carry over
+                return any(not isinstance(p, compound)
+                           and _stmt_calls(p, _HARVEST_CHARGES)
+                           for p in body)
+            for sub_body in _child_suites(st):
+                r = search(sub_body)
+                if r is not None:
+                    return r
+        return None
+
+    return bool(search(fn.body))
 
 
 def _charged_before(fn: ast.AST, target_stmt: ast.stmt) -> bool:
@@ -235,16 +282,20 @@ def run_pass(ctx: ScanContext) -> List[Finding]:
                             f"must name the charged sync"))
                     continue
                 st = stmt_of.get(id(node))
-                if st is not None and _charged_before(fn, st):
+                if st is not None and (_charged_before(fn, st)
+                                       or _overlap_charged_suite(
+                                           fn, st)):
                     continue
                 findings.append(Finding(
                     RULE, sf.path, node.lineno,
                     f"plan-phase function {fn.name}() materializes a "
-                    f"device value here with no adjacent sync charge "
-                    f"and no '# sync: <reason>' annotation — "
-                    f"dispatch-ahead contract: host truth is forced "
-                    f"only where semantically required, and every "
-                    f"such site says why"))
+                    f"device value here with no adjacent sync charge, "
+                    f"no overlap attribution (_charge_overlap in the "
+                    f"suite — the harvest-side finish-bitmap poll "
+                    f"discipline) and no '# sync: <reason>' "
+                    f"annotation — dispatch-ahead contract: host "
+                    f"truth is forced only where semantically "
+                    f"required, and every such site says why"))
     return findings
 
 
