@@ -2042,6 +2042,111 @@ def _bench_serving(on_tpu):
             rt_aff["adapter_swap_ins"] < rt_rr["adapter_swap_ins"]),
     }
 
+    # -- replica failover arm (``failover`` sub-object): a seeded
+    # kill-at-step trace through a 2-replica router — one request
+    # force-swapped to the host tier (its parcel is what migrates at
+    # exact bytes), then its replica killed mid-flight — failover ON
+    # vs OFF.  Gated ONLY on deterministic counters: the ON arm
+    # completes every request token-for-token equal to the no-fault
+    # reference (completion 1.0), the OFF kill-switch arm loses the
+    # victim's requests (completion < 1.0, typed terminal 'failed'),
+    # and the migrated-block / failover-path counts are exact.
+    # Walls are report-only per the bench-gate discipline --
+    from paddle_tpu.inference import FaultInjector
+
+    fo_rng = np.random.default_rng(23)
+    fo_prompts = [fo_rng.integers(0, cfg.vocab_size,
+                                  (int(n),)).astype(np.int32)
+                  for n in fo_rng.integers(tr_user, 3 * tr_user, 4)]
+    # long enough that the kill lands mid-decode (the fault schedule
+    # below swaps + kills ~4 scheduler steps in)
+    fo_new = 4 * tr_new
+
+    def _one_failover_trace(failover_on, inject):
+        engs, injs = [], []
+        for _ in range(2):
+            inj = FaultInjector() if inject else None
+            engs.append(ServingEngine(
+                model, num_slots=2, prompt_len=tr_prompt,
+                max_cache_len=tr_cache, steps_per_call=steps_per_call,
+                block_len=tr_block, chunk_len=tr_chunk,
+                num_blocks=tr_blocks, compute_dtype=compute_dtype,
+                registry=obs_metrics.MetricsRegistry(),
+                fault_injector=inj))
+            injs.append(inj)
+        rt = Router(engs, failover=failover_on,
+                    registry=obs_metrics.MetricsRegistry())
+        t0 = time.perf_counter()
+        hs = [rt.submit(p, max_new_tokens=fo_new, arrival_time=0.0)
+              for p in fo_prompts]
+        rt.step(now=0.0)                  # routes everything
+        affected = 0
+        victim_blocks = 0
+        if inject:
+            for _ in range(2):
+                rt.step(now=0.0)
+            vi = hs[0].engine
+            # park the streamed-ahead request on the swap list (the
+            # armed alloc failures block its resume), then kill
+            injs[vi].force_swap(hs[0].request_id)
+            injs[vi].fail_allocs(None)
+            rt.step(now=0.0)
+            victim_blocks = (hs[0]._req.swap.n_blocks
+                             if hs[0].state == "swapped" else 0)
+            affected = sum(
+                1 for h in hs if h.engine == vi
+                and h.state not in ("finished", "failed"))
+            injs[vi].kill_at_step(engs[vi]._step_idx + 1)
+        steps = 0
+        while any(h.state not in ("finished", "failed", "timeout",
+                                  "shed", "cancelled") for h in hs):
+            rt.step(now=0.0)
+            steps += 1
+            if steps > 400:
+                break
+        wall = time.perf_counter() - t0
+        outs = [np.asarray(h.output) for h in hs]
+        done = sum(h.state == "finished" for h in hs)
+        rs = rt.stats()
+        return {
+            "completion_rate": round(done / len(hs), 3),
+            "failed": rs["failed"],
+            "replica_faults": rs["replica_faults"],
+            "failover_requests": rs["failover_requests"],
+            "migrated_blocks": rs["migrated_blocks"],
+            "migrated_bytes": rs["migrated_bytes"],
+            "wall_ms": round(1e3 * wall, 1),
+        }, outs, affected, victim_blocks
+
+    fo_ref, fo_ref_outs, _a0, _v0 = _one_failover_trace(
+        True, inject=False)
+    fo_on, fo_on_outs, fo_affected, fo_vblocks = _one_failover_trace(
+        True, inject=True)
+    fo_off, fo_off_outs, _a1, _v1 = _one_failover_trace(
+        False, inject=True)
+    failover_ab = {
+        "replicas": 2, "n_requests": len(fo_prompts),
+        "max_new": fo_new,
+        "reference": fo_ref, "on": fo_on, "off": fo_off,
+        "affected_requests": int(fo_affected),
+        "victim_parcel_blocks": int(fo_vblocks),
+        # deterministic gates: failover recovers EVERYTHING the fault
+        # touched, token-for-token; the kill-switch arm provably loses
+        # requests; migration moved exactly the victim's resident
+        # parcel; every affected request cost exactly one retry
+        "gate_on_token_exact": bool(all(
+            np.array_equal(a, b)
+            for a, b in zip(fo_ref_outs, fo_on_outs))),
+        "gate_on_completes_all": bool(
+            fo_on["completion_rate"] == 1.0 and fo_on["failed"] == 0),
+        "gate_off_loses_requests": bool(
+            fo_off["completion_rate"] < 1.0 and fo_off["failed"] > 0),
+        "gate_migrated_blocks_exact": bool(
+            fo_on["migrated_blocks"] == fo_vblocks and fo_vblocks > 0),
+        "gate_retries_exact": bool(
+            fo_on["failover_requests"] == fo_affected),
+    }
+
     return {
         "tokens_per_s": cont["tokens_per_s"],
         "p50_latency_ms": cont["p50_latency_ms"],
@@ -2089,6 +2194,7 @@ def _bench_serving(on_tpu):
         "async_depth": depth_ab,
         "lora": lora,
         "router": router_ab,
+        "failover": failover_ab,
         "spec": {
             "k": sp_k, "max_new": sp_new, "n_requests": sp_n,
             "tokens_per_s": spec_on["tokens_per_s"],

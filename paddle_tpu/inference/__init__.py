@@ -15,18 +15,21 @@ with Config, zero-copy IO handles, clone-per-thread). The redesign:
 from .predictor import Config, Predictor, create_predictor  # noqa: F401
 from .llm import LLMPredictor  # noqa: F401
 from .serving import (AdmissionError, EngineStalledError,  # noqa: F401
+                      PoisonedDispatchError, ReplicaKilledError,
                       Request, ServingEngine, TokenStream)
 from .faultinject import FaultInjector  # noqa: F401
 from .prefixcache import HostTier, RadixPrefixCache  # noqa: F401
 from .speculative import (Drafter, ModelDrafter,  # noqa: F401
                           NGramDrafter)
 from .lora import AdapterStore, LoraAdapter  # noqa: F401
-from .router import (ROUTER_POLICIES, RoutedRequest,  # noqa: F401
-                     Router)
+from .router import (HEALTH_STATES, ROUTER_POLICIES,  # noqa: F401
+                     RoutedRequest, Router)
 
 __all__ = ["Config", "Predictor", "create_predictor", "LLMPredictor",
            "Request", "ServingEngine", "TokenStream", "Drafter",
            "NGramDrafter", "ModelDrafter", "AdmissionError",
-           "EngineStalledError", "FaultInjector", "HostTier",
+           "EngineStalledError", "ReplicaKilledError",
+           "PoisonedDispatchError", "FaultInjector", "HostTier",
            "RadixPrefixCache", "AdapterStore", "LoraAdapter",
-           "Router", "RoutedRequest", "ROUTER_POLICIES"]
+           "Router", "RoutedRequest", "ROUTER_POLICIES",
+           "HEALTH_STATES"]

@@ -48,6 +48,24 @@ Five injectable failure modes:
   and refill through recompute, the deterministic driver of the
   tiered cache's degradation tests.
 
+Three REPLICA-level failure modes ride on top (the failover layer of
+``inference/router.py`` is tested against these; each models a whole
+replica going bad rather than one allocation or one step):
+
+- **kill** (``kill_at_step``): the engine raises a typed
+  ``ReplicaKilledError`` at the top of every ``step()`` from the armed
+  scheduler step on — LATCHED, like a crashed process that stays dead
+  until restarted; ``clear_replica_faults()`` is the restart.
+- **poisoned dispatch** (``poison_at_step``): ONE decode-block harvest
+  at-or-after the armed step materializes corrupted outputs (the
+  engine's harvest validation then raises ``PoisonedDispatchError``) —
+  the int-token-stream analogue of a device returning non-finite
+  logits.  Transient: the fault consumes itself, so a restarted
+  replica probes healthy.
+- **permanent stall** (``stall_forever``): every ``step()`` raises
+  ``EngineStalledError`` immediately — the watchdog's view of a
+  dispatch that never returns — until ``clear_replica_faults()``.
+
 The injector is pure host state with no engine back-references: one
 injector can be armed before the engine exists and inspected after it
 is gone.  ``events`` records every fault that actually FIRED (armed
@@ -79,6 +97,10 @@ class FaultInjector:
         self._tier_evicts = 0         # forced cache evictions pending
         self._forced: List[int] = []  # request ids to preempt
         self._stalls: deque = deque()  # seconds, one per upcoming step
+        # replica-level faults (router-failover drivers)
+        self._kill_at: Optional[int] = None     # latched from this step
+        self._poison_at: Optional[int] = None   # one-shot from this step
+        self._stall_forever = False             # latched until cleared
         self.events: List[Tuple[str, Optional[int]]] = []
 
     # -- arming (test side) --
@@ -129,6 +151,56 @@ class FaultInjector:
         ids are silently skipped by the engine — arming is a schedule,
         not an assertion."""
         self._forced.append(int(request_id))
+
+    def kill_at_step(self, step: int):
+        """Kill the replica from scheduler step ``step`` on: every
+        ``step()`` whose index is >= ``step`` raises
+        ``ReplicaKilledError`` at the top, before any scheduling work.
+        LATCHED — a crashed process stays dead until the operator
+        restarts it (``clear_replica_faults``); a router probe against
+        a still-dead replica keeps failing, which is the point."""
+        if int(step) < 1:
+            raise ValueError(f"step must be >= 1, got {step}")
+        self._kill_at = int(step)
+
+    def poison_at_step(self, step: int):
+        """Poison ONE decode-block harvest at-or-after scheduler step
+        ``step``: the engine materializes corrupted outputs and its
+        harvest validation raises ``PoisonedDispatchError`` — the
+        deterministic stand-in for a dispatch that came back with
+        non-finite logits.  One-shot: a restarted replica is healthy
+        (transient device fault), unlike the latched kill/stall."""
+        if int(step) < 1:
+            raise ValueError(f"step must be >= 1, got {step}")
+        self._poison_at = int(step)
+
+    def stall_forever(self):
+        """Make EVERY ``step()`` raise ``EngineStalledError``
+        immediately (a permanently wedged dispatch, as the watchdog
+        sees it) until ``clear_replica_faults()``."""
+        self._stall_forever = True
+
+    def clear_replica_faults(self):
+        """The replica 'restart': clears the latched kill/stall and
+        any un-fired poison, so the next router probe can pass."""
+        self._kill_at = None
+        self._poison_at = None
+        self._stall_forever = False
+
+    def arm_replica_fault(self, kind: str, step: int = 1):
+        """Arm one replica fault by name — the seeded-schedule
+        convenience (a soak test draws ``kind``/``step`` from a seeded
+        RNG and arms them here)."""
+        if kind == "kill":
+            self.kill_at_step(step)
+        elif kind == "poison":
+            self.poison_at_step(step)
+        elif kind == "stall":
+            self.stall_forever()
+        else:
+            raise ValueError(
+                f"unknown replica fault {kind!r} — known: "
+                f"kill / poison / stall")
 
     def stall_steps(self, n: int, seconds: float):
         """Make the next ``n`` ``step()`` calls sleep ``seconds``
@@ -185,6 +257,32 @@ class FaultInjector:
         for rid in out:
             self.events.append(("forced_swap", rid))
         return out
+
+    def take_kill(self, step_idx: int) -> bool:
+        """True when THIS step should raise ``ReplicaKilledError``
+        (latched: keeps returning True until the restart clears it)."""
+        if self._kill_at is not None and int(step_idx) >= self._kill_at:
+            self.events.append(("kill", None))
+            return True
+        return False
+
+    def take_poison(self, step_idx: int) -> bool:
+        """True when THIS decode harvest should materialize corrupted
+        outputs (one-shot: consumed on fire)."""
+        if self._poison_at is not None \
+                and int(step_idx) >= self._poison_at:
+            self._poison_at = None
+            self.events.append(("poison", None))
+            return True
+        return False
+
+    def take_permanent_stall(self) -> bool:
+        """True when THIS step should raise ``EngineStalledError``
+        (latched until the restart clears it)."""
+        if self._stall_forever:
+            self.events.append(("perma_stall", None))
+            return True
+        return False
 
     def take_stall(self) -> float:
         """Seconds THIS step should stall (0.0 = no stall armed)."""

@@ -207,6 +207,31 @@ class HostTier:
         self._blocks[e.reason] -= e.n_blocks
         return True
 
+    def transfer(self, key: int, dest: "HostTier") -> Optional[int]:
+        """Move one parcel's EXACT at-rest bytes into another tier —
+        the cross-replica KV handoff the router's failover migration
+        rides: a failed replica's host-RAM swap parcels survive its
+        device fault, and handing the resolved byte stacks to a
+        healthy replica's tier is all "migration" is (the destination
+        engine's donation-matched swap-in scatter does the rest, the
+        same program its own resumes use).  The parcel keeps its
+        ``reason``; a still-lazy parcel resolves here (its bytes must
+        exist somewhere before the source can forget them).  Pins do
+        NOT travel — they belong to the source's queued requests,
+        which the failover is recovering separately.  Returns the
+        DESTINATION key, or ``None`` when the destination refused a
+        cache-reason put (preempt parcels always fit); the source
+        entry is dropped only after the destination accepted."""
+        e = self._entries.get(key)
+        if e is None:
+            return None
+        rows = [np.ascontiguousarray(r) for r in e.rows]
+        new_key = dest.put(rows, e.n_blocks, e.reason)
+        if new_key is None:
+            return None
+        self.drop(key)
+        return new_key
+
     def touch(self, key: int):
         if key in self._entries:
             self._entries.move_to_end(key)

@@ -47,11 +47,34 @@ every parity test in this repo leans on):
   ``cancel()`` reaches a request still sitting in the router queue
   (counted ``serving.requests_cancelled{phase="router"}``) as well as
   one already inside an engine (delegated).
+- **Replica failover** (``failover=True``, the default): the router
+  owns a per-replica HEALTH model.  A replica whose ``step()`` raises
+  a replica-fatal signal — ``ReplicaKilledError`` (crash),
+  ``PoisonedDispatchError`` (a harvest failed validation: the
+  int-token analogue of non-finite logits) or ``EngineStalledError``
+  (a dispatch that will never return) — leaves the routing set, is
+  restarted (``ServingEngine.crash_reset``) and its requests are
+  RECOVERED: still-queued ones re-route immediately; swapped ones
+  whose host-RAM parcel survived migrate at EXACT at-rest bytes
+  (``HostTier.transfer`` into the destination tier +
+  ``ServingEngine.migrate_in`` — the PR-7/8 swap gather/scatter
+  programs, now crossing replicas); in-flight ones (KV died with the
+  device) recompute from the prompt, bit-identically, because the
+  victim's position-keyed PRNG base key travels with them — a
+  ``TokenStream`` splices at the last flushed token without
+  double-emitting.  Each failover costs one unit of a bounded
+  ``retry_budget``; exhaustion is the typed terminal state
+  ``"failed"``.  Recovered replicas are PROBED (a 1-token request
+  driven to completion) before rejoining on probation, and promoted
+  to healthy after a fault-free probation window.
 - **Observability**: ``serving.router.*`` instruments (requests by
   policy, routing decisions by reason, affinity token/hit counters,
-  queue depth) and a ``route`` flight-recorder event (chosen engine,
-  affinity score, policy) so ``explain_request`` can say "routed to
-  engine 1 (prefix affinity 384 tokens)".
+  queue depth, replica faults / failover paths / probes / migrated
+  blocks+bytes) and ``route`` / ``fail`` / ``migrate`` / ``retry``
+  flight-recorder events (chosen engine, affinity score, policy,
+  fault kind, migrated block count) so ``explain_request`` can say
+  "routed to engine 1 (prefix affinity 384 tokens)" or "failed over
+  to engine 0 (migrated 6 blocks at exact bytes)".
 
 The streamed half of the front door lives in ``serving.py``
 (``TokenStream``): ``submit(stream=True)`` — engine- or router-level —
@@ -67,9 +90,12 @@ import numpy as np
 
 from ..observability import metrics as obs_metrics
 from ..observability.flightrec import FlightRecorder
+from .prefixcache import HostTier
 from .sampling import SamplingParams
-from .serving import (AdmissionError, EngineStalledError, Request,
-                      ServingEngine, TokenStream, _neg_deadline)
+from .serving import (TERMINAL_STATES, AdmissionError,
+                      EngineStalledError, PoisonedDispatchError,
+                      ReplicaKilledError, Request, ServingEngine,
+                      TokenStream, _neg_deadline)
 
 # per-request defaults each workload policy applies (explicit submit
 # kwargs always win).  "embed" is the prefill-only shape: the request's
@@ -88,6 +114,43 @@ ROUTER_POLICIES = {
 # holds the request's adapter in HBM), prefix (its radix tree matched
 # >= 1 prompt token), load (plain least-outstanding / index order)
 ROUTE_REASONS = ("round_robin", "adapter", "prefix", "load")
+
+# closed vocabularies of the failover layer (graftlint's vocab pass
+# resolves every literal site against these):
+# how a replica failed — the typed signal its step() raised
+# (serving.router.failover.replica_faults{fault=})
+REPLICA_FAULTS = ("kill", "poison", "stall")
+# how an affected request was recovered
+# (serving.router.failover.requests{path=}): "migrate" = its swap
+# parcel's exact at-rest bytes moved to a healthy replica's host tier
+# and resumed there, "recompute" = re-ran from the prompt (the
+# position-keyed PRNG makes the replayed stream bit-identical),
+# "requeue" = it was still queued on the victim, so a plain fresh
+# placement suffices
+FAILOVER_PATHS = ("migrate", "recompute", "requeue")
+# health-probe outcomes (serving.router.failover.probes{outcome=})
+PROBE_OUTCOMES = ("pass", "fail")
+# per-replica health lifecycle: "unhealthy" replicas are out of the
+# routing set; a passed probe moves them to "probation" (routable, but
+# one more fault sends them straight back), and a fault-free
+# probation window promotes them to "healthy"
+HEALTH_STATES = ("healthy", "probation", "unhealthy")
+
+# the replica-fatal exception types the failover layer consumes — any
+# OTHER exception from an engine step is a programming error and
+# propagates (failing over a code bug would retry it forever)
+REPLICA_FAULT_ERRORS = (ReplicaKilledError, PoisonedDispatchError,
+                        EngineStalledError)
+
+
+def _classify_fault(err: BaseException) -> str:
+    """The ``REPLICA_FAULTS`` entry for a caught replica-fatal
+    exception."""
+    if isinstance(err, ReplicaKilledError):
+        return "kill"
+    if isinstance(err, PoisonedDispatchError):
+        return "poison"
+    return "stall"
 
 
 class _RouterInstruments:
@@ -142,6 +205,46 @@ class _RouterInstruments:
         self.engines = r.gauge(
             "serving.router.engines",
             "engine replicas behind this router")
+        self.healthy_engines = r.gauge(
+            "serving.router.healthy_engines",
+            "replicas currently in the routing set (health 'healthy' "
+            "or 'probation'); engines minus this is the failed count")
+        self.replica_faults = r.counter(
+            "serving.router.failover.replica_faults",
+            "replica-fatal faults the router observed, by kind: "
+            "'kill' (the replica's step raised ReplicaKilledError), "
+            "'poison' (a harvest failed validation — "
+            "PoisonedDispatchError), 'stall' (EngineStalledError: a "
+            "dispatch that will never return)", labels=("fault",))
+        self.failover_requests = r.counter(
+            "serving.router.failover.requests",
+            "requests recovered off a failed replica, by path: "
+            "'migrate' = exact-bytes KV migration through the host "
+            "tier, 'recompute' = deterministic re-run from the "
+            "prompt, 'requeue' = was still queued, placed fresh",
+            labels=("path",))
+        self.failover_failed = r.counter(
+            "serving.router.failover.failed",
+            "requests that reached the terminal state 'failed': their "
+            "replica died and the bounded retry budget ran out")
+        self.probes = r.counter(
+            "serving.router.failover.probes",
+            "health probes against unhealthy replicas, by outcome "
+            "('pass' readmits the replica on probation; 'fail' keeps "
+            "it out of the routing set)", labels=("outcome",))
+        self.readmissions = r.counter(
+            "serving.router.failover.readmissions",
+            "recovered replicas readmitted to the routing set after "
+            "a passed probe (the probation entry point)")
+        self.migrate_blocks = r.counter(
+            "serving.migrate.blocks",
+            "KV blocks moved between replicas at exact at-rest bytes "
+            "during failover (victim host-tier parcel -> destination "
+            "host tier -> destination arenas via the swap-in scatter)")
+        self.migrate_bytes = r.counter(
+            "serving.migrate.bytes",
+            "at-rest KV bytes (codes + scale planes for the int8 "
+            "cache) moved between replicas during failover migration")
         # router-phase cancels share the ENGINE counter (same name,
         # kind and label tuple, so shared registries re-use the
         # instrument): phase='router' is the queue level above any
@@ -153,7 +256,10 @@ class _RouterInstruments:
             "decode / swapped)", labels=("phase",))
         self._base = {c.name: c.total() for c in (
             self.requests, self.routed, self.prefix_tokens,
-            self.adapter_hits, self.shed, self.timeouts)}
+            self.adapter_hits, self.shed, self.timeouts,
+            self.replica_faults, self.failover_requests,
+            self.failover_failed, self.probes, self.readmissions,
+            self.migrate_blocks, self.migrate_bytes)}
         self._cancel_base = self.cancelled.value(phase="router")
         self._routed_base = {reason: self.routed.value(reason=reason)
                              for reason in ROUTE_REASONS}
@@ -202,10 +308,26 @@ class RoutedRequest:
         self.max_queue_delay_s: Optional[float] = None
         self.adapter: Optional[str] = None
         self._kw: dict = {}
+        # failover bookkeeping: how many times this request was
+        # recovered off a failed replica (bounded by the router's
+        # retry_budget), and the token prefix it had emitted at the
+        # last failover — the deterministic-replay contract the
+        # router verifies at the retried finish
+        self.retries = 0
+        self._replay: List[int] = []
 
     def _bind(self, engine_idx: int, req: Request):
         self.engine = int(engine_idx)
         self._req = req
+
+    def _unbind(self, tokens_so_far: List[int]):
+        """Detach from a failed replica's request: the handle keeps
+        the already-emitted tokens as its own truth while the router
+        recovers it onto a healthy replica."""
+        self._req = None
+        self.engine = None
+        self._state = "queued"
+        self._tokens = list(tokens_so_far)
 
     def _terminate(self, state: str, now: float):
         """Router-level terminal: same uniform shape as the engine's
@@ -226,8 +348,18 @@ class RoutedRequest:
 
     @property
     def tokens(self) -> List[int]:
-        return (self._req.tokens if self._req is not None
-                else self._tokens)
+        if self._req is not None:
+            live = self._req.tokens
+            if self._replay and len(self._replay) > len(live):
+                # a failover RECOMPUTE is replaying its deterministic
+                # prefix (the new engine request restarts from the
+                # prompt); present the longer truth so the handle's
+                # view is monotonic — the replayed tokens are
+                # bit-identical to the saved ones (verified at the
+                # retried finish), so no reader can see a divergence
+                return list(self._replay)
+            return live
+        return self._tokens
 
     @property
     def output(self) -> np.ndarray:
@@ -278,10 +410,23 @@ class Router:
 
     def __init__(self, engines: List[ServingEngine], *,
                  affinity: bool = True, max_queue: Optional[int] = None,
+                 failover: bool = True, retry_budget: int = 3,
+                 probe_interval: int = 1, probation_steps: int = 2,
                  registry=None, flight_recorder=None,
                  clock=time.perf_counter):
         if not engines:
             raise ValueError("Router needs >= 1 engine replica")
+        if int(retry_budget) < 0:
+            raise ValueError(
+                f"retry_budget must be >= 0 failovers per request, "
+                f"got {retry_budget}")
+        if int(probe_interval) < 1:
+            raise ValueError(
+                f"probe_interval must be >= 1 router steps, got "
+                f"{probe_interval}")
+        if int(probation_steps) < 0:
+            raise ValueError(
+                f"probation_steps must be >= 0, got {probation_steps}")
         self._engines = list(engines)
         e0 = self._engines[0]
         for i, e in enumerate(self._engines[1:], start=1):
@@ -315,10 +460,30 @@ class Router:
         self._rr = 0                # round-robin cursor
         self._next_id = 0
         self._step_idx = 0
+        # failover health model: per-replica health state, the next
+        # step each unhealthy replica may be probed at, the step each
+        # probation ends at, and the recovery records awaiting a
+        # healthy placement (each is one affected request's snapshot
+        # off a failed replica)
+        self.failover = bool(failover)
+        self.retry_budget = int(retry_budget)
+        self.probe_interval = int(probe_interval)
+        self.probation_steps = int(probation_steps)
+        self._health = ["healthy"] * len(self._engines)
+        self._next_probe = [0] * len(self._engines)
+        self._probation_until = [0] * len(self._engines)
+        self._recover: List[dict] = []
+        # the router-owned staging tier migration parcels ride
+        # through: HostTier.transfer moves the victim's exact
+        # at-rest bytes here BEFORE its crash_reset drops the source
+        # tier, and transfers them on to the chosen destination at
+        # placement (preempt-reason parcels always fit)
+        self._stage = HostTier(cache_capacity_blocks=0)
         self._m = _RouterInstruments(
             registry if registry is not None
             else obs_metrics.get_registry())
         self._m.engines.set(len(self._engines))
+        self._m.healthy_engines.set(len(self._engines))
         self._m.queue_depth.set(0)
         self._fr = (flight_recorder if flight_recorder is not None
                     else FlightRecorder(enabled=False))
@@ -455,8 +620,16 @@ class Router:
                       adapter=adapter, tenant=tenant)
         # bounded front-door queue, PR-7 semantics over ROUTER-HELD
         # requests only (dispatched ones are the engines' problem):
-        # sweep expired waiters first, then displace a strictly-worse
-        # victim or refuse THIS arrival
+        # sweep expired waiters first, then mark a strictly-worse
+        # victim for displacement or refuse THIS arrival.  The victim
+        # is shed only AFTER the arrival is safely enqueued — the
+        # engine's rollback-symmetry discipline: a typed failure
+        # after the enqueue (a raising recorder/span hook) must leave
+        # queue depth, gauges and the victim exactly as before, so
+        # everything from the append on rolls back in one except
+        # block and a failed submit never destroys an innocent
+        # queued request
+        evict = None
         if self.max_queue is not None and \
                 len(self._queue) >= self.max_queue:
             self._sweep_timeouts(now, self._orphan_terminals)
@@ -465,10 +638,7 @@ class Router:
             worst = min(reversed(self._queue), key=self._shed_key)
             if self._shed_key(worst) < (prio,
                                         _neg_deadline(pr.deadline)):
-                self._queue.remove(worst)
-                worst._terminate("shed", now)
-                self._m.shed.inc(reason="evicted")
-                self._fr.emit("shed", worst.router_id, self._step_idx)
+                evict = worst
             else:
                 self._m.shed.inc(reason="rejected")
                 raise AdmissionError(
@@ -480,15 +650,31 @@ class Router:
                     queue_depth=len(self._queue),
                     max_queue=self.max_queue)
         self._next_id += 1
-        self._queue.append(pr)
-        self._handles.append(pr)
-        self._m.requests.inc(
-            policy=policy if policy is not None else "default")
-        self._m.queue_depth.set(len(self._queue))
-        self._fr.emit("submit", pr.router_id, self._step_idx,
-                      seq_len=n, max_new=m, priority=prio,
-                      policy=policy if policy is not None else "default",
-                      queue_depth=len(self._queue))
+        try:
+            self._queue.append(pr)
+            self._handles.append(pr)
+            self._fr.emit("submit", pr.router_id, self._step_idx,
+                          seq_len=n, max_new=m, priority=prio,
+                          policy=(policy if policy is not None
+                                  else "default"),
+                          queue_depth=len(self._queue))
+            if evict is not None:
+                self._queue.remove(evict)
+                evict._terminate("shed", now)
+                self._m.shed.inc(reason="evicted")
+                self._fr.emit("shed", evict.router_id, self._step_idx)
+            # counters LAST, once nothing can raise (a Counter cannot
+            # be decremented — the engine submit's discipline)
+            self._m.requests.inc(
+                policy=policy if policy is not None else "default")
+            self._m.queue_depth.set(len(self._queue))
+        except BaseException:
+            if self._queue and self._queue[-1] is pr:
+                self._queue.pop()
+            if self._handles and self._handles[-1] is pr:
+                self._handles.pop()
+            self._m.queue_depth.set(len(self._queue))
+            raise
         if do_stream:
             return TokenStream(self, pr)
         return pr
@@ -520,6 +706,20 @@ class Router:
             return self._engines[pr.engine].cancel(pr._req.request_id)
         if pr._state != "queued":
             return False
+        rec = next((r for r in self._recover if r["handle"] is pr),
+                   None)
+        if rec is not None:
+            # cancelled while its failover recovery awaited placement
+            # (unbound: not in the router queue, not on any engine) —
+            # drop the record and its staged parcel
+            self._recover.remove(rec)
+            if rec["parcel"] is not None:
+                self._stage.drop(rec["parcel"]["skey"])
+            pr._terminate("cancelled", self._clock())
+            self._m.cancelled.inc(phase="router")
+            self._fr.emit("cancel", pr.router_id, self._step_idx,
+                          phase="router")
+            return True
         self._queue.remove(pr)
         pr._terminate("cancelled", self._clock())
         self._m.cancelled.inc(phase="router")
@@ -555,15 +755,20 @@ class Router:
         tie-break (see module docstring); round-robin mode cycles the
         cursor (every candidate's metadata is zero: affinity was
         never consulted)."""
-        n = len(self._engines)
+        routable = [i for i, s in enumerate(self._health)
+                    if s != "unhealthy"]
+        if not routable:
+            return [], {}
+        n = len(routable)
         if not self.affinity:
             first = self._rr % n
             self._rr += 1
-            order = [(first + k) % n for k in range(n)]
+            order = [routable[(first + k) % n] for k in range(n)]
             return order, {i: (0, False) for i in order}
         scored = []
         meta = {}
-        for i, e in enumerate(self._engines):
+        for i in routable:
+            e = self._engines[i]
             rep = e.load_report()
             load = (rep["queue_depth"] + rep["active_slots"]
                     + rep["swapped_waiting"])
@@ -627,13 +832,255 @@ class Router:
                 reason=reason)
         self._m.queue_depth.set(len(self._queue))
 
+    # -- failover: health model, recovery, probation --
+    def _set_health(self, ei: int, state: str):
+        self._health[ei] = state
+        self._m.healthy_engines.set(
+            sum(s != "unhealthy" for s in self._health))
+
+    def _fail_over(self, ei: int, err: BaseException, now: float,
+                   out: List[RoutedRequest]):
+        """One replica just raised a replica-fatal error from its
+        ``step()``.  Mark it unhealthy, snapshot every affected
+        request off its (still-readable) host-side state, restart it
+        (``crash_reset``) and queue the recoveries:
+
+        - requests still QUEUED on the victim re-route immediately
+          (path ``requeue`` — nothing ran, a fresh placement is
+          exact);
+        - SWAPPED requests whose host-RAM parcel is reachable migrate
+          at exact at-rest bytes (path ``migrate`` — the parcel
+          survived the device fault by construction: preempt parcels
+          are materialized host numpy at swap-out);
+        - in-flight requests (their KV lived in the dead device)
+          recompute from the prompt (path ``recompute`` — the
+          position-keyed PRNG replays the emitted prefix
+          bit-identically, and the handle splices without
+          double-emitting).
+
+        Each failover consumes one unit of the request's retry
+        budget; exhaustion is the typed terminal state ``"failed"``.
+        With ``failover=False`` (the bench kill-switch arm) every
+        affected request goes terminal ``"failed"`` instead and the
+        replica stays out of the routing set."""
+        fault = _classify_fault(err)
+        self._m.replica_faults.inc(fault=fault)
+        self._set_health(ei, "unhealthy")
+        self._next_probe[ei] = self._step_idx + self.probe_interval
+        eng = self._engines[ei]
+        bound = sorted(
+            (h for (e_i, _rid), h in self._by_engine.items()
+             if e_i == ei),
+            key=lambda h: h.router_id)
+        affected = [h for h in bound
+                    if h.state not in TERMINAL_STATES]
+        recs = []
+        for h in affected:
+            req = h._req
+            rec = {
+                "handle": h,
+                "samp_base": (None if req.samp_base is None
+                              else np.array(req.samp_base)),
+                "tokens": [int(x) for x in req.tokens],
+                "first_token_time": req.first_token_time,
+                "was_queued": req.state == "queued",
+                "parcel": None,
+            }
+            if req.state == "swapped" and req.swap is not None:
+                # move the parcel out BEFORE the reset drops the tier
+                # — host RAM survives a device fault, which is the
+                # whole migration story.  HostTier.transfer carries
+                # the exact at-rest bytes into the router's staging
+                # tier (resolving a still-lazy parcel: its bytes must
+                # exist somewhere before the source forgets them)
+                skey = eng._host_tier.transfer(req.swap.host_key,
+                                               self._stage)
+                if skey is not None:
+                    rec["parcel"] = {
+                        "skey": skey,
+                        "n_blocks": req.swap.n_blocks,
+                        "tok": req.swap.tok, "lens": req.swap.lens,
+                        "phase": req.swap.state, "pf_pos": req.pf_pos,
+                    }
+            recs.append(rec)
+        eng.crash_reset()
+        for k in [k for k in self._by_engine if k[0] == ei]:
+            del self._by_engine[k]
+        for rec in recs:
+            h = rec["handle"]
+            path = ("migrate" if rec["parcel"] is not None else
+                    "requeue" if rec["was_queued"] else "recompute")
+            rec["path"] = path
+            rec["src"] = ei
+            self._fr.emit("fail", h.router_id, self._step_idx,
+                          engine=ei, fault=fault)
+            if not self.failover or h.retries >= self.retry_budget:
+                if rec["parcel"] is not None:
+                    self._stage.drop(rec["parcel"]["skey"])
+                h._unbind(rec["tokens"])
+                h._terminate("failed", now)
+                self._m.failover_failed.inc()
+                self._fr.emit("fail", h.router_id, self._step_idx,
+                              engine=ei, fault=fault, terminal=1,
+                              retries=h.retries)
+                out.append(h)
+                continue
+            h.retries += 1
+            self._m.failover_requests.inc(path=path)
+            h._unbind([] if path == "requeue" else rec["tokens"])
+            if path != "requeue":
+                h._replay = list(rec["tokens"])
+            self._recover.append(rec)
+        if self.failover:
+            self._place_recoveries(now)
+
+    def _place_recoveries(self, now: float):
+        """Place every pending recovery on a healthy replica — the
+        unified re-admission path for all three failover routes.
+        ``migrate`` hands the parcel to the destination's host tier
+        (``HostTier.put``, reason preempt) and parks the request on
+        its swap list via ``ServingEngine.migrate_in``; ``recompute``
+        and ``requeue`` re-enter the destination queue cold, with the
+        victim's PRNG base key carried so replayed streams are
+        bit-identical.  A destination refusing with ``AdmissionError``
+        spills to the next candidate; when every routable replica
+        refuses, the record waits for the next step."""
+        if not self._recover:
+            return
+        pending, self._recover = self._recover, []
+        for rec in pending:
+            h = rec["handle"]
+            order, _meta = self._choose(h)
+            placed = False
+            for ei in order:
+                eng = self._engines[ei]
+                kw = dict(h._kw)
+                if rec["path"] != "requeue":
+                    # already admitted once: the queue-delay SLO does
+                    # not restart (PR 7: once admitted, a request
+                    # always runs to completion)
+                    kw["max_queue_delay_s"] = None
+                parcel = None
+                key = None
+                if rec["path"] == "migrate":
+                    p = rec["parcel"]
+                    key = self._stage.transfer(p["skey"],
+                                               eng._host_tier)
+                    parcel = {"key": key, "n_blocks": p["n_blocks"],
+                              "tok": p["tok"], "lens": p["lens"],
+                              "phase": p["phase"],
+                              "pf_pos": p["pf_pos"]}
+                try:
+                    req = eng.migrate_in(
+                        h._ids, **kw, samp_base=rec["samp_base"],
+                        tokens=(rec["tokens"]
+                                if rec["path"] == "migrate" else ()),
+                        first_token_time=rec["first_token_time"],
+                        parcel=parcel)
+                except AdmissionError:
+                    if key is not None:
+                        rec["parcel"]["skey"] = eng._host_tier.transfer(
+                            key, self._stage)
+                    continue
+                except BaseException:
+                    if key is not None:
+                        rec["parcel"]["skey"] = eng._host_tier.transfer(
+                            key, self._stage)
+                    self._recover.append(rec)
+                    raise
+                h._bind(ei, req)
+                self._by_engine[(ei, req.request_id)] = h
+                if rec["path"] == "migrate":
+                    nb = int(rec["parcel"]["n_blocks"])
+                    self._m.migrate_blocks.inc(nb)
+                    self._m.migrate_bytes.inc(
+                        nb * eng.block_len * eng._kv_row_bytes)
+                    self._fr.emit(
+                        "migrate", h.router_id, self._step_idx,
+                        engine=ei, src=rec["src"], blocks=nb)
+                else:
+                    self._fr.emit(
+                        "retry", h.router_id, self._step_idx,
+                        engine=ei, path=rec["path"],
+                        attempt=h.retries)
+                placed = True
+                break
+            if not placed:
+                self._recover.append(rec)
+
+    def _probe_replicas(self, now: float):
+        """Probe due unhealthy replicas: a tiny 1-token request driven
+        to completion on the candidate alone.  Pass -> the replica
+        rejoins the routing set on PROBATION (a fault-free probation
+        window then promotes it to healthy); fail -> it stays out and
+        the probe backs off by ``probe_interval`` steps."""
+        for ei, st in enumerate(self._health):
+            if st != "unhealthy" or \
+                    self._step_idx < self._next_probe[ei]:
+                continue
+            eng = self._engines[ei]
+            ok = False
+            probe = None
+            try:
+                probe = eng.submit(np.zeros((1,), np.int32),
+                                   max_new_tokens=1, arrival_time=now)
+                for _ in range(8):
+                    eng.step(now)
+                    if probe.state in TERMINAL_STATES:
+                        break
+                ok = probe.state == "finished"
+            except REPLICA_FAULT_ERRORS:
+                eng.crash_reset()
+            except AdmissionError:
+                pass        # full queue = failed probe, not a crash
+            if not ok and probe is not None and \
+                    probe.state not in TERMINAL_STATES:
+                # a probe that stalled non-exceptionally must not be
+                # left queued/active: each retry would stack another
+                # live request onto the sick replica until its own
+                # bounded queue starts refusing (after crash_reset
+                # the probe is already stripped — cancel is a no-op)
+                eng.cancel(probe.request_id)
+            if ok:
+                self._m.probes.inc(outcome="pass")
+                self._m.readmissions.inc()
+                self._set_health(ei, "probation")
+                self._probation_until[ei] = (self._step_idx
+                                             + self.probation_steps)
+            else:
+                self._m.probes.inc(outcome="fail")
+                self._next_probe[ei] = (self._step_idx
+                                        + self.probe_interval)
+
+    def _verify_replay(self, h: RoutedRequest):
+        """The retried-stream determinism contract, checked at the
+        recovered finish: the replayed output must start with exactly
+        the tokens the victim had already emitted — anything else
+        means a reader saw tokens the final stream disowns, which is
+        corruption, not recovery."""
+        if not h._replay or h._req is None:
+            return
+        live = h._req.tokens
+        k = min(len(h._replay), len(live))
+        if list(live[:k]) != h._replay[:k]:
+            raise RuntimeError(
+                f"failover replay diverged for request "
+                f"{h.router_id}: emitted prefix {h._replay[:k]} vs "
+                f"replayed {list(live[:k])} — the deterministic-"
+                f"recovery contract is broken")
+        h._replay = []
+
     # -- scheduling --
     def step(self, now: Optional[float] = None) -> List[RoutedRequest]:
         """One front-door iteration: sweep router-held queue-delay
-        timeouts, route every arrived router-held request, then step
-        each replica once.  Returns the handles that reached a
-        terminal state this iteration (router timeouts + every
-        replica's finished/timed-out requests)."""
+        timeouts, probe unhealthy replicas / place pending failover
+        recoveries, route every arrived router-held request, then
+        step each routable replica once — a replica-fatal raise
+        (kill / poisoned dispatch / permanent stall) triggers
+        failover instead of propagating.  Returns the handles that
+        reached a terminal state this iteration (router timeouts,
+        exhausted-budget ``failed`` terminals, and every replica's
+        finished/timed-out requests)."""
         self._step_idx += 1
         t_now = self._clock() if now is None else now
         out: List[RoutedRequest] = []
@@ -641,16 +1088,33 @@ class Router:
             out.extend(self._orphan_terminals)
             self._orphan_terminals = []
         self._sweep_timeouts(t_now, out)
+        if self.failover:
+            self._probe_replicas(t_now)
+            self._place_recoveries(t_now)
         self._route_arrived(t_now)
         for ei, e in enumerate(self._engines):
-            for req in e.step(t_now):
+            if self._health[ei] == "unhealthy":
+                continue
+            try:
+                stepped = e.step(t_now)
+            except REPLICA_FAULT_ERRORS as err:
+                self._fail_over(ei, err, t_now, out)
+                continue
+            for req in stepped:
                 h = self._by_engine.get((ei, req.request_id))
                 if h is not None:
+                    self._verify_replay(h)
                     out.append(h)
+            if self._health[ei] == "probation" and \
+                    self._step_idx >= self._probation_until[ei]:
+                self._set_health(ei, "healthy")
         return out
 
     def _idle(self) -> bool:
-        """No replica holds queued/active/swapped work."""
+        """No replica holds queued/active/swapped work and no
+        failover recovery awaits placement."""
+        if self._recover:
+            return False
         for e in self._engines:
             rep = e.load_report()
             if rep["queue_depth"] or rep["active_slots"] \
@@ -668,7 +1132,8 @@ class Router:
         return (f"router loop exceeded wall_timeout_s={wall_timeout_s} "
                 f"without draining: router-held={len(self._queue)} "
                 f"(arrived={sum(p.arrival_time <= now for p in self._queue)}), "
-                f"replicas: {per}")
+                f"recoveries pending={len(self._recover)}, "
+                f"health={self._health}, replicas: {per}")
 
     def run(self, max_iters: Optional[int] = None,
             wall_timeout_s: Optional[float] = None
@@ -730,8 +1195,30 @@ class Router:
                 self._m.cancelled.value(phase="router")
                 - self._m._cancel_base),
             "queue_depth": len(self._queue),
+            # failover health + recovery accounting
+            "failover": self.failover,
+            "health": list(self._health),
+            "recoveries_pending": len(self._recover),
+            "replica_faults": int(
+                self._m.since_init(self._m.replica_faults)),
+            "failover_requests": int(
+                self._m.since_init(self._m.failover_requests)),
+            "failed": int(
+                self._m.since_init(self._m.failover_failed)),
+            "probes": int(self._m.since_init(self._m.probes)),
+            "readmissions": int(
+                self._m.since_init(self._m.readmissions)),
+            "migrated_blocks": int(
+                self._m.since_init(self._m.migrate_blocks)),
+            "migrated_bytes": int(
+                self._m.since_init(self._m.migrate_bytes)),
             "per_engine": [e.load_report() for e in self._engines],
         }
+
+    @property
+    def health(self) -> List[str]:
+        """Per-replica health states (``HEALTH_STATES``), by index."""
+        return list(self._health)
 
     @property
     def engines(self) -> List[ServingEngine]:

@@ -269,7 +269,31 @@ class EngineStalledError(RuntimeError):
     exhausted with nothing running, an injected fault, a dispatch that
     never returns).  The message carries the queue / slot / block-pool
     state at the moment of the raise so the wedge is debuggable from
-    the exception alone."""
+    the exception alone.  ``step()`` also raises it directly under an
+    injected PERMANENT stall (``FaultInjector.stall_forever``) — the
+    watchdog's verdict on a dispatch that will never return, and one
+    of the three replica fault signals the router's health model
+    consumes."""
+
+
+class ReplicaKilledError(RuntimeError):
+    """The replica died: an injected kill (``FaultInjector.
+    kill_at_step``) raised at the top of ``step()``, modeling what a
+    multi-process deployment sees as a lost connection to a crashed
+    worker.  Device state (arenas, in-flight dispatches) is gone;
+    host-side request records and host-RAM swap parcels survive —
+    which is exactly the split the router's failover recovery
+    (migrate reachable parcels, recompute the rest) leans on."""
+
+
+class PoisonedDispatchError(RuntimeError):
+    """A dispatch came back corrupted: the engine's harvest validation
+    found token ids outside the model vocabulary — the int-token
+    analogue of non-finite logits (a device fault, a corrupted
+    collective, an OOB write).  Raised BEFORE the corrupt outputs are
+    adopted as host truth, so no request's token stream ever carries
+    a poisoned value; the router treats the raise as a replica-fatal
+    health signal and fails the replica's requests over."""
 
 
 # the goodput ledger's closed waste vocabulary: every dispatched
@@ -309,8 +333,12 @@ ASYNC_SYNC_REASONS = (
 )
 
 # the terminal request states shared by the engine and the router: a
-# request in any of these will never emit another token
-TERMINAL_STATES = ("finished", "timeout", "shed", "cancelled")
+# request in any of these will never emit another token.  "failed" is
+# the router's failover terminal — a request whose replica died and
+# whose bounded retry budget ran out; the engine itself never assigns
+# it (an engine-local request either finishes or is dropped by its
+# caller)
+TERMINAL_STATES = ("finished", "timeout", "shed", "cancelled", "failed")
 
 # closed label vocabularies for the swap/shed/cancel counters (shared
 # by the engine and the router; graftlint's vocab pass resolves every
@@ -1209,10 +1237,16 @@ class TokenStream:
     def read(self) -> np.ndarray:
         """Every token that became host truth since the last read
         (possibly empty) — never blocks, never forces a pending
-        harvest."""
+        harvest.  The cursor NEVER moves backward: during a failover
+        recompute the underlying token list transiently restarts from
+        the prompt, and the replayed prefix is bit-identical to what
+        was already flushed (the position-keyed PRNG contract), so the
+        stream splices at the last flushed token — new tokens appear
+        once the replay passes the cursor, and nothing is ever
+        double-emitted."""
         toks = self._target.tokens
         new = toks[self._pos:]
-        self._pos = len(toks)
+        self._pos = max(self._pos, len(toks))
         return np.asarray(new, np.int32)
 
     def __iter__(self):
@@ -1392,6 +1426,7 @@ class ServingEngine:
         if cache_cap < 0:
             raise ValueError(
                 f"host_cache_blocks must be >= 0, got {host_cache_blocks}")
+        self._host_cache_cap = cache_cap    # kept for crash_reset()
         self._host_tier = HostTier(cache_capacity_blocks=cache_cap)
         self._radix: Optional[RadixPrefixCache] = None
         if mode == "radix":
@@ -1786,6 +1821,7 @@ class ServingEngine:
         lens = np.array(p.lens_d)
         done = np.array(p.done_d)     # the finish bitmap
         self._charge_overlap(self._clock() - t0)
+        toks = self._checked_harvest(toks)
         n_before = len(out)
         self._absorb_block(p, toks, tok, lens, done, out)
         if self.async_depth == 1 and len(out) > n_before:
@@ -1853,6 +1889,33 @@ class ServingEngine:
         for e in lazy:
             e.rows        # the property materializes on first access
         self._charge_overlap(self._clock() - t0)
+
+    def _checked_harvest(self, toks: np.ndarray) -> np.ndarray:
+        """Validate one decode harvest BEFORE its outputs become host
+        truth: every materialized token id must lie in the model
+        vocabulary (vacant/frozen rows emit the pad token, which
+        does).  Out-of-range ids are the int-token-stream analogue of
+        non-finite logits — a poisoned dispatch — and adopting them
+        would corrupt request streams, the prefix tree and every
+        downstream sharer, so the harvest raises
+        :class:`PoisonedDispatchError` instead and leaves the token
+        streams untouched (the router fails the replica over).  The
+        fault injector's ``poison_at_step`` corrupts the materialized
+        array right here, upstream of the same validation a real
+        device fault would hit."""
+        if self._fault is not None and \
+                self._fault.take_poison(self._step_idx):
+            # model the corrupted dispatch: the validation below is
+            # the engine's real (always-on) detector
+            toks = np.full_like(toks, -1)
+        if toks.size and (int(toks.min()) < 0
+                          or int(toks.max()) >= self._vocab):
+            raise PoisonedDispatchError(
+                f"decode harvest at step {self._step_idx} produced "
+                f"token ids outside [0, {self._vocab}) — poisoned "
+                f"dispatch (non-finite logits / corrupted outputs); "
+                f"the harvest was NOT adopted as host truth")
+        return toks
 
     def _absorb_block(self, p: _PendingBlock, toks: np.ndarray,
                       tok: np.ndarray, lens: np.ndarray,
@@ -2360,6 +2423,291 @@ class ServingEngine:
             return TokenStream(self, req)
         return req
 
+    def migrate_in(self, prompt_ids, *, seq_len=None, max_new_tokens=32,
+                   arrival_time=None, spec_decode=None,
+                   sampling: Optional[SamplingParams] = None,
+                   priority: int = 0, deadline_s: Optional[float] = None,
+                   max_queue_delay_s: Optional[float] = None,
+                   adapter: Optional[str] = None,
+                   tenant: Optional[str] = None,
+                   samp_base: Optional[np.ndarray] = None,
+                   tokens=(), first_token_time: Optional[float] = None,
+                   parcel: Optional[dict] = None) -> Request:
+        """Adopt a request recovered from a FAILED replica — the
+        migration entry point the router's failover uses.  Two paths:
+
+        - ``parcel=None``: deterministic **recompute-from-prompt** —
+          the request re-enters this engine's queue cold and re-runs
+          prefill + decode from position 0.  Token-exactness is the
+          determinism stack's job: greedy rows are deterministic by
+          construction, sampled rows replay bit-identically because
+          ``samp_base`` carries the VICTIM's PRNG base key (the
+          position-keyed PRNG of PR 6 makes the restart free — a
+          seedless sampled request's stream is pinned by its original
+          base key, not by this engine's seed or the new request id).
+        - ``parcel={key, n_blocks, tok, lens, phase, pf_pos}``:
+          **exact-bytes KV migration** — the victim's swap parcel was
+          already transferred into THIS engine's host tier
+          (``HostTier.transfer``, reason ``"preempt"``) and the
+          request parks on the swap list exactly as if this engine
+          had preempted it: the normal ``_try_resume`` path allocates
+          fresh blocks and re-scatters the saved bytes through the
+          one donation-matched swap-in program, so the resumed stream
+          is bit-identical to never having failed.  ``tokens`` is the
+          host-truth output emitted before the failure (decode phase;
+          prefill-phase parcels carry none), ``tok``/``lens`` the
+          victim slot's carries at its last consistent point.
+
+        ``max_queue_delay_s`` should be passed only for requests that
+        were still QUEUED on the victim (the PR-7 rule: once admitted,
+        a request always runs to completion — a migrated or
+        recomputed request was already admitted once, so its
+        queue-delay SLO does not restart).  A full bounded queue
+        refuses the recompute path with ``AdmissionError`` (no local
+        victim is displaced for a foreign re-admission; the caller
+        spills to another replica); parcel re-admissions join the
+        swap list, which is never bounded (exactly like preemption).
+        """
+        ids = np.asarray(getattr(prompt_ids, "_value", prompt_ids))
+        ids = np.asarray(ids).reshape(-1).astype(np.int32)
+        if ids.size < 1 or ids.size > self.prompt_len:
+            raise ValueError(
+                f"prompt must be 1..{self.prompt_len} tokens, got "
+                f"{ids.size}")
+        n = int(seq_len) if seq_len is not None else int(ids.size)
+        if n < 1 or n > ids.size:
+            raise ValueError(
+                f"seq_len must be in [1, {ids.size}], got {n}")
+        m = int(max_new_tokens)
+        if m < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {m}")
+        if n + m - 1 > self.max_cache_len:
+            raise ValueError(
+                f"prompt ({n}) + max_new_tokens ({m}) - 1 = {n + m - 1} "
+                f"tokens exceeds max_cache_len ({self.max_cache_len}) "
+                f"— migration requires replica-homogeneous geometry")
+        if self._blocks_needed(n, m) > self.num_blocks:
+            raise ValueError(
+                f"request needs {self._blocks_needed(n, m)} blocks "
+                f"but the pool only has num_blocks={self.num_blocks}")
+        if sampling is not None:
+            if not isinstance(sampling, SamplingParams):
+                raise ValueError(
+                    f"sampling must be a SamplingParams, got "
+                    f"{type(sampling).__name__}")
+            sampling.validate()
+        sp = sampling if sampling is not None else self._default_sampling
+        spec_k = None if spec_decode is None else int(spec_decode)
+        if spec_k is not None:
+            if spec_k < 1:
+                raise ValueError(
+                    f"spec_decode must be >= 1 draft tokens, got "
+                    f"{spec_decode}")
+            if sp is not None and sp.mask_processor is not None:
+                raise ValueError(
+                    "spec_decode cannot compose with a token-mask "
+                    "processor: a draft position's mask depends on "
+                    "host-side state the drafter bypasses — recover "
+                    "the request without spec_decode")
+        if adapter is not None:
+            adapter = str(adapter)
+            if self._adapters is None or \
+                    self._adapters.state(adapter) is None:
+                raise ValueError(
+                    f"adapter {adapter!r} is not registered on this "
+                    f"engine — migration requires replica-homogeneous "
+                    f"adapter registration")
+        if parcel is None and self.max_queue is not None and \
+                len(self._queue) >= self.max_queue:
+            raise AdmissionError(
+                f"queue full ({len(self._queue)} >= max_queue="
+                f"{self.max_queue}) — this engine refuses the "
+                f"recovered request (spill to another replica)",
+                queue_depth=len(self._queue), max_queue=self.max_queue)
+        now = self._clock()
+        arrival = now if arrival_time is None else float(arrival_time)
+        req = Request(self._next_id, np.full(
+            (self.prompt_len,), self.cfg.pad_token_id, np.int32),
+            n, m, arrival, pad_token_id=self.cfg.pad_token_id)
+        req.prompt[:ids.size] = ids
+        req.submit_time = now
+        req.spec_k = spec_k
+        req.adapter = adapter
+        req.tenant = "default" if tenant is None else str(tenant)
+        self._tenant_served.setdefault(req.tenant, 0)
+        req.sampling = sp
+        req.priority = int(priority)
+        req.deadline = (None if deadline_s is None
+                        else arrival + float(deadline_s))
+        req.max_queue_delay_s = (None if max_queue_delay_s is None
+                                 else float(max_queue_delay_s))
+        if sp is not None and not sp.is_greedy:
+            # the victim's base key pins the stream (restart-exact);
+            # without one this engine derives its own, exactly like a
+            # fresh submit
+            req.samp_base = (np.asarray(samp_base, np.uint32)
+                             if samp_base is not None
+                             else base_key(sp.seed)
+                             if sp.seed is not None
+                             else np.asarray(jax.random.fold_in(
+                                 jax.random.PRNGKey(self._seed),
+                                 req.request_id), np.uint32))
+        req.chunk_ids = np.full((self.prompt_len + self.chunk_len,),
+                                self.cfg.pad_token_id, np.int32)
+        req.chunk_ids[:self.prompt_len] = req.prompt
+        if self.prefix_cache_mode == "digest":
+            req.digests = _block_digests(req.prompt, n, self.block_len,
+                                         salt=self._digest_salt)
+        if spec_k is not None:
+            if self._drafter is None:
+                self._drafter = NGramDrafter()
+            self._spec_k_max = max(self._spec_k_max, spec_k)
+        if parcel is not None:
+            ent = self._host_tier.entry(int(parcel["key"]))
+            if ent is None or ent.reason != "preempt":
+                raise ValueError(
+                    f"parcel key {parcel['key']!r} is not a preempt "
+                    f"entry in this engine's host tier — transfer the "
+                    f"victim's parcel first (HostTier.transfer)")
+            if ent.n_blocks != int(parcel["n_blocks"]):
+                raise ValueError(
+                    f"parcel holds {ent.n_blocks} blocks but the swap "
+                    f"record says {parcel['n_blocks']}")
+            phase = str(parcel["phase"])
+            if phase not in ("prefill", "decode"):
+                raise ValueError(
+                    f"parcel phase must be 'prefill' or 'decode', got "
+                    f"{phase!r}")
+            req.tokens = [int(x) for x in tokens]
+            if phase == "decode":
+                req.remaining = m - len(req.tokens)
+                if req.remaining <= 0:
+                    raise ValueError(
+                        f"parcel carries {len(req.tokens)} emitted "
+                        f"tokens of a {m}-token budget — nothing left "
+                        f"to decode (the victim should have finished "
+                        f"it)")
+            req.pf_pos = int(parcel.get("pf_pos", 0))
+            req.first_token_time = first_token_time
+            req.swap = _SwapRecord(
+                host_key=int(parcel["key"]),
+                n_blocks=int(parcel["n_blocks"]),
+                tok=int(parcel["tok"]), lens=int(parcel["lens"]),
+                state=phase)
+            req.state = "swapped"
+            self._next_id += 1
+            self._swapped.append(req)
+            # the parcel entered this tier behind the engine's back
+            # (HostTier.transfer from the router) — settle the gauge
+            # now, not at the next unrelated swap event
+            self._update_host_gauge()
+            self._fr.emit("submit", req.request_id, self._step_idx,
+                          seq_len=n, max_new=m, priority=req.priority,
+                          migrated_blocks=int(parcel["n_blocks"]))
+        else:
+            # the recompute path re-enters the queue cold; submit's
+            # unpin-on-error discipline applies to the prefix probe
+            try:
+                if self._radix is not None:
+                    self._probe_radix(req)
+                    if req.matched:
+                        self._update_block_gauges()
+                elif self.enable_prefix_cache:
+                    for dg in req.digests[:(n - 1) // self.block_len]:
+                        b = self._pool.lookup(dg)
+                        if b is None:
+                            break
+                        self._pool.pin(b)
+                        req.matched.append(b)
+                    if req.matched:
+                        self._update_block_gauges()
+                self._next_id += 1
+                self._queue.append(req)
+                self._fr.emit("submit", req.request_id, self._step_idx,
+                              seq_len=n, max_new=m,
+                              priority=req.priority,
+                              queue_depth=len(self._queue),
+                              recovered=1)
+            except BaseException:
+                if self._queue and self._queue[-1] is req:
+                    self._queue.pop()
+                for b in req.matched:
+                    self._pool.unpin(b)
+                req.matched = []
+                for k in req.host_pins:
+                    self._host_tier.unpin(k)
+                req.host_pins = []
+                self._update_block_gauges()
+                raise
+            self._m.queue_depth.set(len(self._queue))
+            self._peak_queue = max(self._peak_queue, len(self._queue))
+        self._m.requests_submitted.inc()
+        return req
+
+    def crash_reset(self) -> dict:
+        """Model a replica RESTART after a fatal fault (kill, poisoned
+        dispatch, permanent stall): every in-flight dispatch is
+        dropped un-harvested (the device work is lost or untrusted),
+        every live request is STRIPPED — returned to the caller by
+        phase, with no terminal bookkeeping, because the failover
+        layer above owns their recovery now — and the whole memory
+        system (block pool, tables, radix tree, host tier) comes back
+        empty, exactly like a freshly constructed engine over the same
+        model.  Arena CONTENTS deliberately survive as garbage: every
+        new occupant writes its KV before reading it and the trash-row
+        discipline is content-independent, so no wipe dispatch is
+        needed (or possible — the device may be the thing that died).
+
+        The caller must read any host-tier parcels it intends to
+        migrate BEFORE calling this (``HostTier.transfer``): the reset
+        replaces the tier, dropping preempt parcels of stripped
+        requests and every demoted cache span.  Adapter pins release
+        back to the (engine-external, surviving) ``AdapterStore``;
+        compiled program caches and the request-id counter survive —
+        a restart recompiles nothing here because the model is
+        unchanged, and ids stay monotonic.  Returns ``{"queued": [..],
+        "active": [..], "swapped": [..]}`` in scheduler order."""
+        stripped = {
+            "queued": list(self._queue),
+            "active": [r for r in self._slots if r is not None],
+            "swapped": list(self._swapped),
+        }
+        for r in stripped["active"]:
+            if r.adapter_slot is not None:
+                self._adapters.release(r.adapter)
+                r.adapter_slot = None
+        self._queue.clear()
+        self._prefilling.clear()
+        self._swapped = []
+        self._slots = [None] * self.num_slots
+        self._pend_q.clear()
+        self._lazy_parcels = []
+        self._flush_finishes = []
+        self._spec_fallback = set()
+        # fresh memory system, re-wired exactly like __init__
+        self._pool = BlockPool(self.num_blocks, self.block_len)
+        self._host_tier = HostTier(
+            cache_capacity_blocks=self._host_cache_cap)
+        if self.prefix_cache_mode == "radix":
+            self._radix = RadixPrefixCache(self.block_len, self._pool,
+                                           self._host_tier)
+            self._pool.reclaim_cb = self._demote_blocks
+            self._host_tier.evict_cb = self._radix.drop_host
+            self._pool.audit_hooks.append(
+                lambda: self._radix.audit(self._pool))
+        self._pool.audit_hooks.append(self._audit_host_tier)
+        self._tables = np.full((self.num_slots, self.max_blocks),
+                               self._pool.trash, np.int32)
+        self._tok = np.zeros((self.num_slots,), np.int32)
+        self._lens = np.zeros((self.num_slots,), np.int32)
+        self._done = np.ones((self.num_slots,), bool)
+        self._m.queue_depth.set(0)
+        self._m.slot_occupancy.set(0)
+        self._m.async_depth.set(0)
+        self._update_block_gauges()
+        self._update_host_gauge()
+        return stripped
+
     def cancel(self, request_id: int) -> bool:
         """Drop a request from ANY live phase.  Queued: removed from
         the queue, submit-time prefix pins released.  Swapped: the
@@ -2857,9 +3205,15 @@ class ServingEngine:
         ``mapped`` in span order; ``n_promoted`` counts the blocks
         ACTUALLY promoted from the host tier — the ground truth the
         admit-time ``prefix_hit`` event's tier label rides on.  A
-        raise mid-promotion unpins every fresh block and leaves the
-        request a valid queue member (the submit() rollback
-        discipline)."""
+        raise mid-promotion unpins every fresh block AND releases the
+        request's probe pins (HBM blocks and tier parcels both,
+        span metadata cleared), leaving the request a valid queue
+        member with NOTHING held — the submit() rollback discipline,
+        hardened: the next admission attempt re-probes from scratch
+        anyway (``_reprobe_radix`` rebuilds the span), and a caller
+        that never retries must not leave parcels pinned forever —
+        a pinned cache entry can never be capacity-evicted, so a
+        leaked pin slowly wedges the whole tier."""
         span = req.rspan
         host_keys = [ref for kind, ref in span if kind == "host"]
         n_promote = len(host_keys)
@@ -2880,6 +3234,19 @@ class ServingEngine:
             except BaseException:
                 for b in fresh:
                     self._pool.unpin(b)
+                # release the probe pins too — symmetric teardown, so
+                # a caller that never retries leaks nothing (a pinned
+                # parcel is un-evictable); the parcels themselves stay
+                # reachable in the tree, just unprotected, and the
+                # next admission attempt re-probes from scratch
+                for b in req.matched:
+                    self._pool.unpin(b)
+                req.matched = []
+                for k in req.host_pins:
+                    self._host_tier.unpin(k)
+                req.host_pins = []
+                req.rspan = []
+                req.rmatch_tokens = 0
                 self._update_block_gauges()
                 raise
             for k, b in zip(host_keys, dest):
@@ -3696,6 +4063,20 @@ class ServingEngine:
         self._flush_finishes = []
         t_now = self._clock() if now is None else now
         if self._fault is not None:
+            # replica-fatal faults raise BEFORE any scheduling work
+            # mutates state: a killed/wedged replica did not run this
+            # step, and the router's failover recovers from the last
+            # consistent host truth
+            if self._fault.take_kill(self._step_idx):
+                raise ReplicaKilledError(
+                    f"injected replica kill at step {self._step_idx} "
+                    f"(latched until the injector's replica restart)")
+            if self._fault.take_permanent_stall():
+                raise EngineStalledError(
+                    f"injected permanent stall at step "
+                    f"{self._step_idx}: the dispatch will never "
+                    f"return (latched until the injector's replica "
+                    f"restart)")
             stall = self._fault.take_stall()
             if stall:
                 with _span("serving.fault.stall", seconds=stall):
@@ -3915,6 +4296,7 @@ class ServingEngine:
             # of the dispatch, exactly the lockstep engine's
             # attribution
             self._disp_s += self._clock() - t_mat
+            toks = self._checked_harvest(toks)
             self._absorb_block(new_pend, toks, tok, lens, done,
                                finished)
         return finished

@@ -62,6 +62,13 @@ EVENT_KINDS = frozenset({
     "timeout",        # queue wait exceeded max_queue_delay_s
     "cancel",         # dropped by cancel() (attrs carry the phase)
     "finish",         # retired normally (EOS or budget)
+    "fail",           # the request's replica failed (attrs: engine,
+    #                   fault=kill|poison|stall; terminal=1 + retries
+    #                   when the retry budget ran out -> state failed)
+    "migrate",        # exact-bytes KV migration to a healthy replica
+    #                   (attrs: engine=dest, src, blocks)
+    "retry",          # re-placed on a healthy replica (attrs:
+    #                   engine=dest, path=recompute|requeue, attempt)
 })
 
 # request id recorded for engine-scoped events (prefix-cache demotions
@@ -321,6 +328,33 @@ def explain_events(events: List[FlightEvent], request_id: int) -> str:
             parts.append(
                 f"promoted {_plural(int(s.attrs.get('blocks', 0)), 'host block')} "
                 f"at step {s.step} (cache hit)")
+    # failover lifecycle (router health model): replica failure, then
+    # the recovery path — exact-bytes migration or deterministic
+    # recompute/requeue — or the terminal budget exhaustion
+    for f in by_kind.get("fail", []):
+        if f.attrs.get("terminal"):
+            nr = int(f.attrs.get("retries", 0))
+            parts.append(
+                f"failed terminally at step {f.step} (retry budget "
+                f"exhausted after {nr} "
+                f"{'retry' if nr == 1 else 'retries'})")
+        else:
+            parts.append(
+                f"replica e{f.attrs.get('engine', '?')} failed under "
+                f"{f.attrs.get('fault', '?')} at step {f.step}")
+    for mg in by_kind.get("migrate", []):
+        parts.append(
+            f"failed over to engine {mg.attrs.get('engine', '?')} "
+            f"(migrated "
+            f"{_plural(int(mg.attrs.get('blocks', 0)), 'block')} "
+            f"at exact bytes)")
+    for rt in by_kind.get("retry", []):
+        how = ("recomputed from prompt"
+               if rt.attrs.get("path") == "recompute"
+               else "re-queued")
+        parts.append(
+            f"failed over to engine {rt.attrs.get('engine', '?')} "
+            f"({how}, attempt {rt.attrs.get('attempt', '?')})")
     verifies = by_kind.get("spec_verify", [])
     if verifies:
         rejected = sum(int(v.attrs.get("rejected", 0)) for v in verifies)

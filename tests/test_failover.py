@@ -1,0 +1,515 @@
+"""Replica failover & exact-state request migration (PR 15): the
+router's health model over injected replica faults (kill / poisoned
+dispatch / permanent stall), exact-bytes KV migration through the
+host tier, deterministic recompute-from-prompt, the bounded retry
+budget with the typed ``failed`` terminal, probation/readmission, and
+the seeded random-fault soak.
+
+Tier-1 budget discipline: ONE tiny 1-layer llama at module scope,
+steps_per_call=1, PRIVATE registries and recorders everywhere,
+``BlockPool.check()`` on every replica after every router step, and
+token-exactness always asserted against an identical NO-FAULT twin
+trace (plus ``generate()`` on greedy rows)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.inference import (AdapterStore, FaultInjector,
+                                  HostTier, LoraAdapter,
+                                  PoisonedDispatchError,
+                                  ReplicaKilledError, Router,
+                                  ServingEngine)
+from paddle_tpu.inference.router import (FAILOVER_PATHS, HEALTH_STATES,
+                                         PROBE_OUTCOMES,
+                                         REPLICA_FAULTS,
+                                         _classify_fault)
+from paddle_tpu.inference.sampling import SamplingParams
+from paddle_tpu.inference.serving import (TERMINAL_STATES,
+                                          EngineStalledError)
+from paddle_tpu.observability import MetricsRegistry
+from paddle_tpu.observability.flightrec import FlightRecorder
+
+P, C, BL = 32, 48, 4
+
+
+@pytest.fixture(scope="module")
+def netm():
+    paddle.seed(1234)
+    cfg = models.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64)
+    net = models.LlamaForCausalLM(cfg)
+    net.eval()
+    return cfg, net
+
+
+def _gen_ref(net, ids, max_new):
+    out = net.generate(paddle.to_tensor(ids[None, :]),
+                       max_new_tokens=max_new, max_cache_len=C,
+                       compute_dtype="float32")
+    return np.asarray(out._value)[0]
+
+
+def _mk(net, *, registry=None, store=None, recorder=None,
+        injector=None, **kw):
+    return ServingEngine(
+        net, num_slots=2, prompt_len=P, max_cache_len=C,
+        steps_per_call=1, block_len=BL, chunk_len=4, num_blocks=16,
+        compute_dtype="float32",
+        registry=registry if registry is not None else MetricsRegistry(),
+        adapter_store=store, flight_recorder=recorder,
+        fault_injector=injector, **kw)
+
+
+def _drain(rt, handles, *, streams=(), max_steps=150, audit=True):
+    """Step the router until every handle is terminal, auditing every
+    replica's pool after every step and collecting stream flushes."""
+    flushes = {id(s): [] for s in streams}
+    steps = 0
+    while any(h.state not in TERMINAL_STATES for h in handles):
+        rt.step(now=0.0)
+        if audit:
+            for e in rt.engines:
+                e._pool.check()
+        for s in streams:
+            c = s.read()
+            if c.size:
+                flushes[id(s)].append(c)
+        steps += 1
+        assert steps < max_steps, "trace did not drain"
+    return flushes
+
+
+def test_failover_units(netm):
+    """Dispatch-free surface: injector arming guards, the fault
+    classifier, closed vocabularies, HostTier.transfer accounting,
+    router construction guards and migrate_in validation."""
+    cfg, net = netm
+
+    # -- injector arming guards + latching semantics --
+    inj = FaultInjector()
+    with pytest.raises(ValueError, match="step must be"):
+        inj.kill_at_step(0)
+    with pytest.raises(ValueError, match="step must be"):
+        inj.poison_at_step(0)
+    with pytest.raises(ValueError, match="unknown replica fault"):
+        inj.arm_replica_fault("meteor")
+    inj.kill_at_step(3)
+    assert not inj.take_kill(2)
+    assert inj.take_kill(3) and inj.take_kill(7)   # latched
+    inj.poison_at_step(2)
+    assert not inj.take_poison(1)
+    assert inj.take_poison(2) and not inj.take_poison(9)  # one-shot
+    inj.stall_forever()
+    assert inj.take_permanent_stall()
+    inj.clear_replica_faults()
+    assert not inj.take_kill(99) and not inj.take_permanent_stall()
+    assert [e[0] for e in inj.events] == \
+        ["kill", "kill", "poison", "perma_stall"]
+
+    # -- fault classification covers the closed vocabulary --
+    assert _classify_fault(ReplicaKilledError("x")) == "kill"
+    assert _classify_fault(PoisonedDispatchError("x")) == "poison"
+    assert _classify_fault(EngineStalledError("x")) == "stall"
+    assert set(REPLICA_FAULTS) == {"kill", "poison", "stall"}
+    assert set(FAILOVER_PATHS) == {"migrate", "recompute", "requeue"}
+    assert set(PROBE_OUTCOMES) == {"pass", "fail"}
+    assert set(HEALTH_STATES) == {"healthy", "probation", "unhealthy"}
+    assert "failed" in TERMINAL_STATES
+
+    # -- HostTier.transfer: exact bytes move, accounting stays exact --
+    src, dst = HostTier(), HostTier(cache_capacity_blocks=1)
+    rows = [np.arange(8, dtype=np.float32).reshape(2, 4) + j
+            for j in range(3)]
+    k = src.put([r.copy() for r in rows], 2, "preempt")
+    k2 = src.transfer(k, dst)
+    assert k2 is not None and src.entry(k) is None
+    assert dst.blocks("preempt") == 2 and src.blocks("preempt") == 0
+    for a, b in zip(rows, dst.entry(k2).rows):
+        assert np.array_equal(a, b)              # exact at-rest bytes
+    assert src.audit() == [] and dst.audit() == []
+    # a cache-reason transfer the destination cannot fit is refused
+    # and the source keeps the parcel
+    kc = src.put([r.copy() for r in rows], 2, "cache")
+    assert src.transfer(kc, dst) is None
+    assert src.entry(kc) is not None
+    assert src.transfer(12345, dst) is None      # unknown key
+    # a LAZY parcel resolves on transfer (its bytes must exist before
+    # the source forgets them)
+    kl = src.put(lambda: [r.copy() for r in rows], 1, "preempt")
+    k3 = src.transfer(kl, dst)
+    assert dst.entry(k3).resolved
+
+    # -- router construction guards --
+    eng = _mk(net)
+    with pytest.raises(ValueError, match="retry_budget"):
+        Router([eng], retry_budget=-1, registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="probe_interval"):
+        Router([eng], probe_interval=0, registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="probation_steps"):
+        Router([eng], probation_steps=-1, registry=MetricsRegistry())
+    rt = Router([eng], registry=MetricsRegistry())
+    assert rt.health == ["healthy"]
+    st = rt.stats()
+    for key in ("failover", "health", "recoveries_pending",
+                "replica_faults", "failover_requests", "failed",
+                "probes", "readmissions", "migrated_blocks",
+                "migrated_bytes"):
+        assert key in st, key
+
+    # -- migrate_in validation (no dispatch reaches the device) --
+    ids = np.arange(6, dtype=np.int32) + 1
+    with pytest.raises(ValueError, match="not a preempt entry"):
+        eng.migrate_in(ids, max_new_tokens=4,
+                       parcel={"key": 999, "n_blocks": 2, "tok": 0,
+                               "lens": 6, "phase": "decode"})
+    pk = eng._host_tier.put([np.zeros((2, BL, 4), np.float32)
+                             for _ in range(2)], 2, "preempt")
+    with pytest.raises(ValueError, match="swap record says"):
+        eng.migrate_in(ids, max_new_tokens=4,
+                       parcel={"key": pk, "n_blocks": 3, "tok": 0,
+                               "lens": 6, "phase": "decode"})
+    with pytest.raises(ValueError, match="phase must be"):
+        eng.migrate_in(ids, max_new_tokens=4,
+                       parcel={"key": pk, "n_blocks": 2, "tok": 0,
+                               "lens": 6, "phase": "verify"})
+    with pytest.raises(ValueError, match="nothing left to decode"):
+        eng.migrate_in(ids, max_new_tokens=2, tokens=[1, 2],
+                       parcel={"key": pk, "n_blocks": 2, "tok": 0,
+                               "lens": 6, "phase": "decode"})
+    eng._host_tier.drop(pk)
+    eng._pool.check()
+
+
+def test_failover_combined_kill_with_migration(netm):
+    """THE combined failover trace: 2 replicas, 5 requests — a
+    chat-streamed greedy conversation, a seeded-sampled row, a
+    spec-decode row, a LoRA adapter row and a plain greedy row — one
+    request force-swapped to the host tier, then its replica KILLED.
+    The swapped request migrates at exact bytes; in-flight ones
+    recompute; everything finishes token-for-token equal to the
+    identical no-fault twin trace (and generate() on greedy rows);
+    the failover counters, fail/migrate/retry events and explain
+    renderings are deterministic; the killed replica probes back in
+    after the injector's restart and serves again."""
+    cfg, net = netm
+    rng = np.random.default_rng(77)
+    ad = LoraAdapter.random(cfg, "fo_a0", rank=4, seed=91, scale=0.05)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (10, 7, 8, 6, 9)]
+    news = [6, 5, 5, 4, 6]
+    samp = SamplingParams(temperature=0.8, top_k=0, seed=7)
+
+    def build(inject):
+        engs, injs = [], []
+        for _ in range(2):
+            reg = MetricsRegistry()
+            store = AdapterStore(net, slots=2, max_rank=4,
+                                 dtype="float32", registry=reg)
+            store.register(ad)
+            inj = FaultInjector() if inject else None
+            engs.append(_mk(net, registry=reg, store=store,
+                            injector=inj))
+            injs.append(inj)
+        rrec = FlightRecorder()
+        rt = Router(engs, affinity=True, registry=MetricsRegistry(),
+                    flight_recorder=rrec)
+        return rt, engs, injs, rrec
+
+    def submit_all(rt):
+        hs = []
+        st = rt.submit(prompts[0], max_new_tokens=news[0],
+                       policy="chat", arrival_time=0.0)
+        hs.append(st.request)
+        hs.append(rt.submit(prompts[1], max_new_tokens=news[1],
+                            sampling=samp, arrival_time=0.0))
+        hs.append(rt.submit(prompts[2], max_new_tokens=news[2],
+                            spec_decode=2, arrival_time=0.0))
+        hs.append(rt.submit(prompts[3], max_new_tokens=news[3],
+                            adapter=ad.name, arrival_time=0.0))
+        hs.append(rt.submit(prompts[4], max_new_tokens=news[4],
+                            arrival_time=0.0))
+        return hs, st
+
+    # ---- arm A: the no-fault twin (reference outputs + flushes) ----
+    rtA, engsA, _, _ = build(inject=False)
+    hsA, stA = submit_all(rtA)
+    flA = _drain(rtA, hsA, streams=[stA])
+    refs = [np.asarray(h.output) for h in hsA]
+    # greedy rows are generate()-exact (r1 is sampled; r3 rides LoRA
+    # and is merged-oracle-checked in test_lora)
+    for i in (0, 2, 4):
+        assert np.array_equal(refs[i], _gen_ref(net, prompts[i],
+                                                news[i])), i
+
+    # ---- arm B: identical trace, replica fault mid-flight ----
+    rt, engs, injs, rrec = build(inject=True)
+    hs, st = submit_all(rt)
+    rt.step(now=0.0)                  # routes everything
+    by_eng = {ei: [h for h in hs if h.engine == ei] for ei in (0, 1)}
+    assert all(h.engine is not None for h in hs)
+    # the victim: whichever replica holds the streamed request r0
+    vi = hs[0].engine
+    victim, vinj = engs[vi], injs[vi]
+    # let r0 decode a few tokens so the failover replays a non-empty
+    # prefix (and the stream has flushed some of it)
+    flushes = {id(st): []}
+    for _ in range(4):
+        rt.step(now=0.0)
+        c = st.read()
+        if c.size:
+            flushes[id(st)].append(c)
+    assert hs[0].state == "decode" and len(hs[0].tokens) >= 1
+    pre_fail_read = int(sum(c.size for c in flushes[id(st)]))
+    # force-swap r0 to the host tier (its parcel is what migrates);
+    # armed alloc failures keep it parked on the swap list (resume
+    # needs fresh blocks) until the kill lands next step
+    vinj.force_swap(hs[0].request_id)
+    vinj.fail_allocs(None)
+    rt.step(now=0.0)
+    assert hs[0].state == "swapped"
+    vblocks = hs[0]._req.swap.n_blocks
+    assert vblocks > 0
+    affected = [h for h in by_eng[vi]
+                if h.state not in TERMINAL_STATES]
+    vinj.kill_at_step(victim._step_idx + 1)
+    rt.step(now=0.0)                  # the kill fires -> failover
+    assert rt.health[vi] == "unhealthy"
+    rs = rt.stats()
+    assert rs["replica_faults"] == 1
+    assert rs["failover_requests"] == len(affected)
+
+    # drain, reading the stream every step; the killed replica stays
+    # latched-dead, so everything finishes on the survivor
+    while any(h.state not in TERMINAL_STATES for h in hs):
+        rt.step(now=0.0)
+        for e in engs:
+            e._pool.check()
+        c = st.read()
+        if c.size:
+            flushes[id(st)].append(c)
+
+    # token-exactness: every request — streamed, sampled, spec, LoRA,
+    # plain — equals the no-fault twin bit for bit
+    for i, h in enumerate(hs):
+        assert h.state == "finished", (i, h.state)
+        assert np.array_equal(np.asarray(h.output), refs[i]), i
+    # the stream spliced without double-emitting: concatenated arm-B
+    # flushes equal the no-fault stream's concatenation, and the
+    # pre-failure reads were never replayed
+    catA = np.concatenate(flA[id(stA)])
+    catB = np.concatenate(flushes[id(st)])
+    assert np.array_equal(catA, catB)
+    assert pre_fail_read + sum(
+        c.size for c in flushes[id(st)][len(flushes[id(st)]):]) \
+        <= catB.size
+
+    # the migration moved EXACTLY the victim's resident parcel
+    rs = rt.stats()
+    assert rs["migrated_blocks"] == vblocks
+    assert rs["migrated_bytes"] == \
+        vblocks * victim.block_len * victim._kv_row_bytes
+    assert rs["failed"] == 0
+
+    # deterministic event story: one fail per affected request, one
+    # migrate (r0), recompute/requeue retries for the rest
+    fails = [e for e in rrec.events() if e.kind == "fail"]
+    migrs = [e for e in rrec.events() if e.kind == "migrate"]
+    retries = [e for e in rrec.events() if e.kind == "retry"]
+    assert len(fails) == len(affected)
+    assert all(e.attrs["fault"] == "kill" and e.attrs["engine"] == vi
+               for e in fails)
+    assert len(migrs) == 1 and migrs[0].request == hs[0].router_id
+    assert migrs[0].attrs == {"engine": 1 - vi, "src": vi,
+                              "blocks": vblocks}
+    assert len(retries) == len(affected) - 1
+    assert {e.attrs["path"] for e in retries} <= {"recompute",
+                                                 "requeue"}
+    text = rt.explain(hs[0].router_id)
+    assert f"failed over to engine {1 - vi} (migrated " in text
+    assert "at exact bytes" in text
+    rec_h = next(h for h in affected if h is not hs[0])
+    assert "failed over to engine" in rt.explain(rec_h.router_id)
+
+    # probation/readmission: while the kill is latched every probe
+    # fails; after the injector restart one probe passes, the replica
+    # rejoins on probation and is promoted after the window
+    probes_failed = rt._m.probes.value(outcome="fail")
+    assert probes_failed >= 1
+    vinj.clear_replica_faults()
+    vinj.clear_alloc_failures()
+    steps = 0
+    while rt.health[vi] != "healthy":
+        rt.step(now=0.0)
+        steps += 1
+        assert steps < 12
+    assert rt._m.probes.value(outcome="pass") == 1
+    assert rt.stats()["readmissions"] == 1
+    # the readmitted replica serves again (fresh pool, clean audit)
+    h2 = rt.submit(prompts[0], max_new_tokens=2, arrival_time=0.0)
+    _drain(rt, [h2])
+    assert h2.state == "finished"
+    assert np.array_equal(h2.output,
+                          _gen_ref(net, prompts[0], 2))
+
+
+def test_failover_poison_stall_and_budget(netm):
+    """The other two fault modes plus budget exhaustion: a poisoned
+    decode harvest fails the replica over (recompute path, outputs
+    still generate()-exact, no corrupt token ever reaches a stream);
+    a permanent stall does the same and keeps failing probes until
+    cleared; and with the retry budget exhausted the affected request
+    goes terminal 'failed' with the uniform padded output shape."""
+    cfg, net = netm
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (8, 6)]
+    refs = [_gen_ref(net, p, 4) for p in prompts]
+
+    # -- poison: transient — the replica probes straight back in --
+    injs = [FaultInjector(), FaultInjector()]
+    engs = [_mk(net, injector=injs[i]) for i in range(2)]
+    rrec = FlightRecorder()
+    rt = Router(engs, registry=MetricsRegistry(),
+                flight_recorder=rrec)
+    hs = [rt.submit(p, max_new_tokens=4, arrival_time=0.0)
+          for p in prompts]
+    rt.step(now=0.0)
+    vi = hs[0].engine
+    for _ in range(2):
+        rt.step(now=0.0)
+    injs[vi].poison_at_step(engs[vi]._step_idx + 1)
+    rt.step(now=0.0)
+    assert rt.stats()["replica_faults"] == 1
+    assert rt._m.replica_faults.value(fault="poison") == 1
+    _drain(rt, hs)
+    for h, ref in zip(hs, refs):
+        assert h.state == "finished"
+        assert np.array_equal(h.output, ref)
+        # no poisoned value ever reached the stream
+        assert all(0 <= t < cfg.vocab_size for t in h.tokens)
+    steps = 0
+    while rt.health[vi] != "healthy":     # transient: self-heals
+        rt.step(now=0.0)
+        steps += 1
+        assert steps < 12
+
+    # -- permanent stall: probes fail until the injector restart --
+    injs[vi].stall_forever()
+    h3 = rt.submit(prompts[0], max_new_tokens=3, arrival_time=0.0)
+    before = rt._m.probes.value(outcome="fail")
+    _drain(rt, [h3])                      # survivor serves it
+    assert h3.state == "finished"
+    assert rt._m.replica_faults.value(fault="stall") >= 1
+    assert rt.health[vi] == "unhealthy"
+    assert rt._m.probes.value(outcome="fail") > before
+    injs[vi].clear_replica_faults()
+    steps = 0
+    while rt.health[vi] != "healthy":
+        rt.step(now=0.0)
+        steps += 1
+        assert steps < 12
+
+    # -- budget exhaustion: retry_budget=0 -> typed terminal failed --
+    inj = FaultInjector()
+    eng = _mk(net, injector=inj)
+    rrec2 = FlightRecorder()
+    rt2 = Router([eng], retry_budget=0, registry=MetricsRegistry(),
+                 flight_recorder=rrec2)
+    stf = rt2.submit(prompts[1], max_new_tokens=4, stream=True,
+                     arrival_time=0.0)
+    hf = stf.request
+    inj.kill_at_step(eng._step_idx + 1)
+    out = rt2.step(now=0.0)
+    assert hf.state == "failed" and hf in out
+    assert stf.finished                   # streams observe the terminal
+    assert hf.output.size == 4            # uniform padded terminal
+    assert rt2.stats()["failed"] == 1
+    assert rt2.stats()["failover_requests"] == 0
+    term = [e for e in rrec2.events()
+            if e.kind == "fail" and e.attrs.get("terminal")]
+    assert len(term) == 1 and term[0].attrs["retries"] == 0
+    assert "failed terminally" in rt2.explain(hf.router_id)
+    # failover=False is the kill-switch arm: same terminal, no retry
+    inj2 = FaultInjector()
+    eng2 = _mk(net, injector=inj2)
+    rt3 = Router([eng2], failover=False, registry=MetricsRegistry())
+    h4 = rt3.submit(prompts[1], max_new_tokens=4, arrival_time=0.0)
+    inj2.kill_at_step(eng2._step_idx + 1)
+    rt3.step(now=0.0)
+    assert h4.state == "failed"
+    assert rt3.stats()["probes"] == 0     # no recovery machinery runs
+
+
+def test_random_fault_soak(netm):
+    """Satellite: the seeded random-fault soak — a deterministic
+    schedule of kill/poison/stall faults drawn from a seeded RNG
+    drives a 2-replica router through a small mixed trace, with
+    ``BlockPool.check()`` on every replica at every step, faults
+    cleared a fixed delay after arming (so probes readmit), bounded
+    total steps, and final token-exactness against the identical
+    no-fault twin."""
+    cfg, net = netm
+    rng = np.random.default_rng(42)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (9, 7, 6, 8, 5, 10)]
+    news = [4, 3, 4, 3, 3, 4]
+    samp = SamplingParams(temperature=0.7, top_k=0, seed=11)
+
+    def submit_all(rt):
+        hs = []
+        for i, (p, m) in enumerate(zip(prompts, news)):
+            kw = {"sampling": samp} if i == 3 else {}
+            hs.append(rt.submit(p, max_new_tokens=m,
+                                arrival_time=0.0, **kw))
+        return hs
+
+    # no-fault twin
+    rtA = Router([_mk(net) for _ in range(2)],
+                 registry=MetricsRegistry())
+    hsA = submit_all(rtA)
+    _drain(rtA, hsA)
+    refs = [np.asarray(h.output) for h in hsA]
+
+    # the seeded fault schedule: (router step, victim, kind), cleared
+    # CLEAR_AFTER steps after arming
+    frng = np.random.default_rng(4242)
+    schedule = sorted(
+        (int(frng.integers(2, 9)) + 7 * i,
+         int(frng.integers(0, 2)),
+         ("kill", "poison", "stall")[int(frng.integers(0, 3))])
+        for i in range(3))
+    CLEAR_AFTER = 3
+    injs = [FaultInjector(), FaultInjector()]
+    rt = Router([_mk(net, injector=injs[i]) for i in range(2)],
+                registry=MetricsRegistry())
+    hs = submit_all(rt)
+    clears = []
+    step = 0
+    while any(h.state not in TERMINAL_STATES for h in hs):
+        step += 1
+        for s, vi, kind in schedule:
+            if s == step:
+                injs[vi].arm_replica_fault(
+                    kind, rt.engines[vi]._step_idx + 1)
+                clears.append((step + CLEAR_AFTER, vi))
+        for s, vi in list(clears):
+            if s == step:
+                injs[vi].clear_replica_faults()
+                clears.remove((s, vi))
+        rt.step(now=0.0)
+        for e in rt.engines:
+            e._pool.check()
+        assert step < 120, "soak did not drain"
+    for vi in (0, 1):
+        injs[vi].clear_replica_faults()
+    for i, (h, ref) in enumerate(zip(hs, refs)):
+        assert h.state == "finished", (i, h.state)
+        assert np.array_equal(np.asarray(h.output), ref), i
+    rs = rt.stats()
+    assert rs["replica_faults"] >= 1      # the schedule actually bit
+    assert rs["failed"] == 0              # budget never exhausted
+    assert rs["recoveries_pending"] == 0

@@ -512,7 +512,7 @@ def test_metrics_name_lint_clean():
              "serving.goodput.", "serving.slo.", "serving.step.",
              "serving.async.", "serving.fault.",
              "serving.lora.", "serving.fairshare.",
-             "serving.router.",
+             "serving.router.", "serving.migrate.",
              "serving.tpot_seconds")), n
         assert n in names, n
     kinds = {r[3]: r[2] for r in regs}
@@ -571,6 +571,16 @@ def test_metrics_name_lint_clean():
     assert by_lbl["serving.router.requests"] == ("policy",)
     assert by_lbl["serving.router.routed"] == ("reason",)
     assert by_lbl["serving.router.shed"] == ("reason",)
+    # the replica-failover set (PR 15): fault/path/outcome labels and
+    # the cross-replica migration volume counters
+    assert kinds["serving.router.failover.replica_faults"] == "counter"
+    assert kinds["serving.router.healthy_engines"] == "gauge"
+    assert kinds["serving.migrate.blocks"] == "counter"
+    assert kinds["serving.migrate.bytes"] == "counter"
+    assert by_lbl["serving.router.failover.replica_faults"] == \
+        ("fault",)
+    assert by_lbl["serving.router.failover.requests"] == ("path",)
+    assert by_lbl["serving.router.failover.probes"] == ("outcome",)
     assert by_lbl["serving.fairshare.served_tokens"] == ("tenant",)
     assert by_lbl["serving.fairshare.deficit"] == ("tenant",)
     # rule 4 fires on a missing required name

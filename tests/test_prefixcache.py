@@ -384,6 +384,92 @@ def test_swapin_fault_and_tier_evict_degrade(netm):
     eng._pool.check()
 
 
+def test_promotion_scatter_raise_releases_pins(netm, monkeypatch):
+    """PR-15 satellite (HostTier pin accounting on a failed swap-in):
+    a scatter that raises MID-PROMOTION must not leak the entry pin
+    or strand the parcel unreachable — the hardened
+    ``_map_radix_span`` rollback releases the request's probe pins
+    (pool blocks AND tier parcels) symmetrically, so a caller that
+    never retries leaves nothing pinned and tier eviction never
+    wedges.  Asserted via ``audit()``/``check()`` after the raise and
+    again after an injected ``fail_swapins`` storm over two sharers
+    of the same host span."""
+    cfg, net = netm
+    rng = np.random.default_rng(17)
+    shared = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    big = rng.integers(0, cfg.vocab_size, (10,)).astype(np.int32)
+    fi = FaultInjector()
+    eng = ServingEngine(net, num_slots=1, prompt_len=P, max_cache_len=C,
+                        steps_per_call=1, block_len=2, chunk_len=4,
+                        num_blocks=7, compute_dtype="float32",
+                        fault_injector=fi)
+
+    def drain():
+        while (eng._queue or eng._swapped
+               or any(s is not None for s in eng._slots)):
+            eng.step()
+            eng._pool.check()
+
+    eng.submit(shared, max_new_tokens=2)
+    drain()
+    eng.submit(big, max_new_tokens=2)     # demotes the shared span
+    drain()
+    assert eng.stats()["host_cache_blocks"] > 0
+
+    # two queued sharers pin the host span (pins > 1 per parcel)
+    a = eng.submit(shared, max_new_tokens=2)
+    b = eng.submit(shared, max_new_tokens=2)
+    assert a.host_pins and b.host_pins
+    tier = eng._host_tier
+    assert all(tier.entry(k).pins == 2 for k in a.host_pins)
+
+    # inject a raising scatter at the promotion site
+    from paddle_tpu.inference import serving as srv
+    real_span = srv._span
+
+    def exploding(name, **attrs):
+        if name == "serving.cache_swap_in":
+            raise RuntimeError("injected scatter failure")
+        return real_span(name, **attrs)
+
+    monkeypatch.setattr(srv, "_span", exploding)
+    with pytest.raises(RuntimeError, match="injected scatter"):
+        eng.step()
+    # the hardened rollback: the admitting request holds NOTHING —
+    # its probe pins released (parcels back to the sibling's single
+    # pin, un-evictability cannot leak), span metadata cleared, and
+    # the parcels stay reachable in the tree (no strand)
+    assert a.host_pins == [] and a.matched == [] and a.rspan == []
+    assert all(tier.entry(k).pins == 1 for k in b.host_pins)
+    assert set(tier.keys("cache")) == set(eng._radix._host)
+    eng._pool.check()
+    monkeypatch.setattr(srv, "_span", real_span)
+
+    # the retry re-probes from scratch and admits cleanly
+    drain()
+    np.testing.assert_array_equal(a.output, _oracle(net, shared, 2))
+    np.testing.assert_array_equal(b.output, _oracle(net, shared, 2))
+    assert all(tier.entry(k) is None or tier.entry(k).pins == 0
+               for k in set(a.host_pins) | set(b.host_pins))
+    eng._pool.check()
+
+    # the fail_swapins storm over fresh sharers: every admission
+    # degrades (parcels drop), audits stay clean at every step, no
+    # pin survives the drain
+    eng.submit(big, max_new_tokens=2)     # re-demote the shared span
+    drain()
+    fi.fail_swapins(None)
+    c = eng.submit(shared, max_new_tokens=2)
+    d = eng.submit(shared, max_new_tokens=2)
+    drain()
+    fi.clear_swapin_failures()
+    np.testing.assert_array_equal(c.output, _oracle(net, shared, 2))
+    np.testing.assert_array_equal(d.output, _oracle(net, shared, 2))
+    assert all(e.pins == 0 for e in eng._host_tier._entries.values())
+    assert eng._pool.in_use() == 0
+    eng._pool.check()
+
+
 def test_engine_guards_and_mode_validation(netm):
     """Constructor guards: bad prefix_cache_mode / negative
     host_cache_blocks raise; enable_prefix_cache=False still spells

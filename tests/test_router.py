@@ -321,6 +321,57 @@ def test_router_combined_trace(netm):
     assert rt.cancel(he) is False              # finished long ago
 
 
+def test_router_submit_rollback_symmetry(netm):
+    """PR-15 satellite (the PR-4 unpin-on-error discipline at the
+    front door): a typed failure AFTER the router enqueued an arrival
+    — a raising recorder hook is the injection — must leave queue
+    depth, gauges, handle list and any would-be shed victim exactly
+    as before; and the victim of a bounded-queue eviction is only
+    shed once the arrival is safely enqueued."""
+    cfg, net = netm
+    ids = np.arange(6, dtype=np.int32) + 1
+
+    class ExplodingRecorder(FlightRecorder):
+        def __init__(self):
+            super().__init__()
+            self.armed = False
+
+        def emit(self, kind, request, step, **attrs):
+            if self.armed and kind == "submit":
+                raise RuntimeError("injected recorder failure")
+            super().emit(kind, request, step, **attrs)
+
+    rec = ExplodingRecorder()
+    reg = MetricsRegistry()
+    eng = _mk(net)
+    rt = Router([eng], max_queue=1, registry=reg,
+                flight_recorder=rec)
+    lo = rt.submit(ids, arrival_time=FAR, priority=0)
+    depth0 = reg.get("serving.router.queue_depth").value()
+    requests0 = reg.get("serving.router.requests").total()
+    assert depth0 == 1
+    rec.armed = True
+    # a high-priority arrival WOULD evict `lo` — but the enqueue
+    # fails, so the rollback must leave `lo` untouched and the
+    # arrival fully unwound (no handle, no counter, no gauge drift)
+    for _ in range(3):
+        with pytest.raises(RuntimeError, match="injected recorder"):
+            rt.submit(ids, arrival_time=FAR, priority=2)
+        assert lo.state == "queued"            # victim unharmed
+        assert list(rt._queue) == [lo]
+        assert rt._handles == [lo]
+        assert reg.get("serving.router.queue_depth").value() == depth0
+        assert reg.get(
+            "serving.router.requests").total() == requests0
+    rec.armed = False
+    # the same arrival now succeeds and sheds the victim, post-enqueue
+    hi = rt.submit(ids, arrival_time=FAR, priority=2)
+    assert lo.state == "shed" and hi.state == "queued"
+    assert rt._handles == [lo, hi]
+    ev = [e.kind for e in rec.events()]
+    assert ev[-2:] == ["submit", "shed"]       # enqueue BEFORE shed
+
+
 def test_router_single_replica_byte_identical(netm):
     """A single-replica router with affinity disabled schedules
     byte-identically to the bare engine: same outputs, same

@@ -877,3 +877,20 @@ def test_bench_llm_serving_section():
     # round-robin never consulted affinity; affinity never cycled
     assert ro["round_robin"]["prefix_affinity_tokens"] == 0
     assert ro["affinity"]["routed_by_reason"]["round_robin"] == 0
+    # PR 15: the replica-failover arm — deterministic gates only
+    # (token-exact recovery, completion 1.0 vs < 1.0, exact migrated-
+    # block and retry counts); walls report-only
+    fo = out["failover"]
+    for k in ("replicas", "n_requests", "reference", "on", "off",
+              "affected_requests", "victim_parcel_blocks"):
+        assert k in fo, k
+    for arm in ("reference", "on", "off"):
+        for k in ("completion_rate", "failed", "replica_faults",
+                  "failover_requests", "migrated_blocks", "wall_ms"):
+            assert k in fo[arm], (arm, k)
+    assert fo["gate_on_token_exact"]
+    assert fo["gate_on_completes_all"]
+    assert fo["gate_off_loses_requests"]
+    assert fo["gate_migrated_blocks_exact"]
+    assert fo["gate_retries_exact"]
+    assert fo["reference"]["replica_faults"] == 0

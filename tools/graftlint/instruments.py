@@ -150,6 +150,20 @@ REQUIRED_INSTRUMENTS = {
     "serving.router.timeouts": ("counter", ()),
     "serving.router.queue_depth": ("gauge", ()),
     "serving.router.engines": ("gauge", ()),
+    # replica failover (PR 15, inference/router.py
+    # _RouterInstruments): the health model's observable surface —
+    # replica-fatal faults by kind, recovered requests by path,
+    # exhausted-budget terminals, probe outcomes / readmissions, the
+    # routable-replica gauge, and the cross-replica exact-bytes KV
+    # migration volume the bench's failover arm gates on
+    "serving.router.healthy_engines": ("gauge", ()),
+    "serving.router.failover.replica_faults": ("counter", ("fault",)),
+    "serving.router.failover.requests": ("counter", ("path",)),
+    "serving.router.failover.failed": ("counter", ()),
+    "serving.router.failover.probes": ("counter", ("outcome",)),
+    "serving.router.failover.readmissions": ("counter", ()),
+    "serving.migrate.blocks": ("counter", ()),
+    "serving.migrate.bytes": ("counter", ()),
 }
 
 

@@ -66,6 +66,12 @@ VOCABS: Tuple[VocabSpec, ...] = (
     VocabSpec("SWAP_REASONS"),
     VocabSpec("SHED_REASONS"),
     VocabSpec("CANCEL_PHASES", dead=False),
+    # the failover layer (PR 15): replica fault kinds flow through the
+    # _classify_fault producer; recovery paths and probe outcomes are
+    # literal counter labels
+    VocabSpec("REPLICA_FAULTS", producers=("_classify_fault",)),
+    VocabSpec("FAILOVER_PATHS"),
+    VocabSpec("PROBE_OUTCOMES"),
 )
 
 
@@ -121,6 +127,14 @@ MATCHERS: Tuple[Matcher, ...] = (
     Matcher("CANCEL_PHASES",
             receivers=frozenset({"requests_cancelled", "cancelled"}),
             methods=frozenset({"inc"}), kwarg="phase"),
+    # failover counters (router health model)
+    Matcher("REPLICA_FAULTS", receivers=frozenset({"replica_faults"}),
+            methods=frozenset({"inc"}), kwarg="fault"),
+    Matcher("FAILOVER_PATHS",
+            receivers=frozenset({"failover_requests"}),
+            methods=frozenset({"inc"}), kwarg="path"),
+    Matcher("PROBE_OUTCOMES", receivers=frozenset({"probes"}),
+            methods=frozenset({"inc"}), kwarg="outcome"),
 )
 
 
