@@ -52,23 +52,165 @@ def _unflatten_kvs(flat):
     return [(flat[i], flat[i + 1]) for i in range(0, len(flat), 2)]
 
 
-def _param_swapper(model, cfg: GenerationConfig):
+def normalize_weight_dtype(weight_dtype):
+    """Validate a ``weight_dtype=`` argument.  Returns ``None`` for
+    full-precision serving (``None`` or any float dtype name — weights
+    then stream at the compute dtype, today's behavior) or the
+    canonical ``"int8"``/``"int4"`` string for quantized weight planes.
+    The allowed set is deliberately distinct from ``kv_cache_dtype``'s
+    (which admits float dtypes or ``"int8"`` only)."""
+    if weight_dtype is None:
+        return None
+    s = str(weight_dtype)
+    if s in ("int8", "int4"):
+        return s
+    try:
+        dt = jnp.dtype(weight_dtype)
+    except TypeError:
+        raise ValueError(
+            f"weight_dtype must be a float dtype (full-precision "
+            f"weights), 'int8' or 'int4' (quantized code+scale planes); "
+            f"got {weight_dtype!r}")
+    if jnp.issubdtype(dt, jnp.floating):
+        return None
+    raise ValueError(
+        f"weight_dtype must be a float dtype, 'int8' or 'int4'; got "
+        f"{weight_dtype!r} — integer weight arenas other than int8/int4 "
+        "have no code+scale discipline")
+
+
+class WeightQuantPlan:
+    """One model's quantized-weight planes plus the bookkeeping that
+    threads them through the serving programs: per (layer_idx, target)
+    an int8 code plane ([K, N]; int4 packs to [K//2, N]) and a
+    per-output-channel f32 scale plane [N], calibrated through
+    ``quantization.observers`` (the ONE quant rule — see
+    ``absmax_to_scales``).  ``flat_values()`` appends to the engine's
+    swapped param/buffer list (ONE positional list argument, so donation
+    index tuples never shift); ``bind()`` rebuilds the trace-time
+    context from the traced values inside a program."""
+
+    def __init__(self, dtype_str, bits, entries, max_m=256):
+        self.dtype = dtype_str
+        self.bits = bits
+        # entries: (layer_idx, target, param_pos, codes, scales) in
+        # deterministic (layer, declaration) order
+        self.entries = entries
+        self.max_m = max_m
+        self.param_positions = frozenset(e[2] for e in entries)
+
+    def flat_values(self):
+        flat = []
+        for _li, _t, _pos, codes, scales in self.entries:
+            flat.append(codes)
+            flat.append(scales)
+        return flat
+
+    def bind(self, flat):
+        from ..models.wquant import WeightQuantContext
+        planes = {}
+        for i, (li, t, _pos, _c, _s) in enumerate(self.entries):
+            planes[(li, t)] = (flat[2 * i], flat[2 * i + 1])
+        return WeightQuantContext(planes, self.bits, self.max_m)
+
+    def bytes_swept(self):
+        """Modeled HBM bytes one forward streams for the quantized
+        planes (codes at their packed width + f32 scales)."""
+        return sum(int(c.nbytes) + int(s.nbytes)
+                   for _li, _t, _pos, c, s in self.entries)
+
+    def placeholder_params(self, params):
+        """The swapped param value list with every quantized weight's
+        slot replaced by a ZERO-SIZE placeholder: a projection site that
+        fails to divert through ``wq_linear`` hits a shape error at
+        trace time instead of silently streaming a stale float plane."""
+        return [jnp.zeros((0,), p._value.dtype)
+                if i in self.param_positions else p._value
+                for i, p in enumerate(params)]
+
+
+def build_weight_quant_plan(model, weight_dtype) -> WeightQuantPlan:
+    """Quantize ``model``'s hot projections once at load.  Scales go
+    through the PerChannelAbsmaxObserver path (``quantization/
+    observers.py``) so PTQ calibration and the serving loader share one
+    bit-exact rule; codes are ``quantize_channelwise`` of the same rule;
+    int4 packs two codes per byte (``pack_int4``)."""
+    from ..nn import Linear
+    from ..quantization.observers import (PerChannelAbsmaxObserver,
+                                          absmax_to_scales,
+                                          quantize_channelwise)
+    from ..ops.pallas.quantized_matmul import pack_int4
+    bits = {"int8": 8, "int4": 4}[weight_dtype]
+    if not hasattr(model, "quant_projections"):
+        raise ValueError(
+            f"weight_dtype={weight_dtype!r} needs a model exposing "
+            "quant_projections() (llama/gpt); got "
+            f"{type(model).__name__}")
+    params, _buffers = model_arrays(model)
+    pos = {id(p): i for i, p in enumerate(params)}
+    entries = []
+    for li, layer in enumerate(model.quant_projections()):
+        for target, lin in layer.items():
+            if not isinstance(lin, Linear):
+                raise ValueError(
+                    f"weight_dtype={weight_dtype!r} supports plain "
+                    f"nn.Linear projections only; layer {li} {target} is "
+                    f"{type(lin).__name__} (tensor-parallel serving "
+                    "quantization is not wired)")
+            obs = PerChannelAbsmaxObserver(quant_axis=-1, bit_length=bits)
+            obs.observe(lin.weight)
+            scales = absmax_to_scales(obs.scales()._value, bits)
+            codes = quantize_channelwise(lin.weight._value, scales, bits,
+                                         quant_axis=-1)
+            if bits == 4:
+                codes = pack_int4(codes)
+            entries.append((li, target, pos[id(lin.weight)],
+                            codes, scales))
+    return WeightQuantPlan(weight_dtype, bits, entries)
+
+
+def _param_swapper(model, cfg: GenerationConfig, wq=None):
     """The closure every serving program shares: positional
     params+buffers values in, the model's weights swapped for the traced
     arrays for the duration of the call (floats cast ONCE to the serving
-    compute dtype — the hoisted fast-layout copy)."""
+    compute dtype — the hoisted fast-layout copy).
+
+    ``wq`` (a WeightQuantPlan) appends the quantized code/scale planes
+    to the SAME positional list: the trailing ``2 * len(entries)``
+    values are split off, bound into a trace-time wquant context
+    (``models/wquant.py``), and the projection sites route through them
+    — the core params at quantized positions are zero-size placeholders
+    that fail loudly if any site misses the diversion."""
     params, buffers = model_arrays(model)
 
-    def _with_params(pb_values, fn):
-        p_values = pb_values[:len(params)]
-        b_values = pb_values[len(params):]
+    if wq is None:
+        def _with_params(pb_values, fn):
+            p_values = pb_values[:len(params)]
+            b_values = pb_values[len(params):]
+            return swap_call(params, buffers, p_values, b_values,
+                             cfg.compute_dtype, fn)
+        return _with_params
+
+    from ..models.wquant import wquant_context
+    n_core = len(params) + len(buffers)
+
+    def _with_params_wq(pb_values, fn):
+        core = pb_values[:n_core]
+        ctx = wq.bind(list(pb_values[n_core:]))
+        p_values = core[:len(params)]
+        b_values = core[len(params):]
+
+        def run():
+            with wquant_context(ctx):
+                return fn()
         return swap_call(params, buffers, p_values, b_values,
-                         cfg.compute_dtype, fn)
+                         cfg.compute_dtype, run)
 
-    return _with_params
+    return _with_params_wq
 
 
-def _build_decode_block(model, cfg: GenerationConfig, steps_per_call):
+def _build_decode_block(model, cfg: GenerationConfig, steps_per_call,
+                        wq=None):
     """Pure greedy/sampled decode block: ``lax.scan`` of
     ``steps_per_call`` steps of the shared ``decode_scan_body``.
 
@@ -80,8 +222,10 @@ def _build_decode_block(model, cfg: GenerationConfig, steps_per_call):
     one compiled block serves every occupancy mix, and rows with
     ``done=True`` freeze (lens stops advancing, emits are pad), which
     is how both finished and vacant slots ride along for free.
+    ``wq`` (a WeightQuantPlan) appends quantized code/scale planes to
+    the positional param list — see ``_param_swapper``.
     """
-    _with_params = _param_swapper(model, cfg)
+    _with_params = _param_swapper(model, cfg, wq=wq)
 
     def block_pure(p_values, tok, lens, done, key, *flat_kvs):
         def run():
@@ -164,7 +308,7 @@ def _flatten_paged_kvs(kvs):
 def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call,
                               kv_int8=False,
                               samp_flags=(False, False, False, False),
-                              lora=False):
+                              lora=False, wq=None):
     """Paged twin of ``_build_decode_block``: the cache is the shared
     block arena plus per-slot block tables instead of per-slot
     contiguous rows.  The tables ride into the scan closure as a
@@ -207,10 +351,16 @@ def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call,
     gathered A/B einsums add each request's low-rank delta inside the
     attention projections.  The gather is hoisted out of the scan
     (ids are loop-invariant), and the ``lora=False`` build keeps
-    today's exact signature and program."""
+    today's exact signature and program.
+
+    ``wq`` (a WeightQuantPlan) selects quantized-weight serving: the
+    plan's code/scale planes ride as trailing entries of ``p_values``
+    (one positional list — donation indices over the trailing arena
+    args never shift) and the scan traces under an active weight-quant
+    context (``models/wquant.py``)."""
     from .sampling import sampled_decode_scan_body
     from ..models.lora import gather_lora, lora_context
-    _with_params = _param_swapper(model, cfg)
+    _with_params = _param_swapper(model, cfg, wq=wq)
     sampled, _filtered, penalty, _bias = samp_flags
 
     def _scan(tok, lens, done, budget, samp, tables, flat_arenas):
@@ -318,7 +468,7 @@ def build_swap_in_scatter(n_arenas):
 
 def build_chunk_prefill(model, cfg: GenerationConfig, kv_int8=False,
                         samp_flags=(False, False, False, False),
-                        lora=False):
+                        lora=False, wq=None):
     """Chunked-prefill program for the paged ServingEngine: ONE prompt
     chunk of ONE sequence (batch-1; the static chunk length is the ids
     shape) computed at global positions ``start .. start+C-1``, K/V
@@ -347,7 +497,10 @@ def build_chunk_prefill(model, cfg: GenerationConfig, kv_int8=False,
     under an active adapter context — so a LoRA request's PROMPT K/V
     is computed through its adapter too, exactly what its merged-
     weights twin would have written (see ``_build_paged_decode_block``
-    for the plane layout; ``lora=False`` keeps today's program)."""
+    for the plane layout; ``lora=False`` keeps today's program).
+    ``wq`` selects quantized-weight serving (see
+    ``_build_paged_decode_block``) — the prompt pass runs through the
+    same codes+scales the decode blocks do."""
     if cfg.num_beams > 1:
         raise ValueError(
             "chunked prefill is greedy/sampled only — beam search "
@@ -355,7 +508,7 @@ def build_chunk_prefill(model, cfg: GenerationConfig, kv_int8=False,
             "one-slot-per-request block table")
     from .sampling import sample_rows
     from ..models.lora import gather_lora, lora_context
-    _with_params = _param_swapper(model, cfg)
+    _with_params = _param_swapper(model, cfg, wq=wq)
     penalty = samp_flags[2]
 
     def _chunk(ids, start, n_valid, tables, samp, flat_arenas):
@@ -385,7 +538,7 @@ def build_chunk_prefill(model, cfg: GenerationConfig, kv_int8=False,
 
 
 def _build_serving_fns(model, batch, max_cache_len,
-                       cfg: GenerationConfig, steps_per_call):
+                       cfg: GenerationConfig, steps_per_call, wq=None):
     """Pure (params, ...) -> (...) functions for prefill and one decode
     block; the exported/jitted serving programs.
 
@@ -405,7 +558,7 @@ def _build_serving_fns(model, batch, max_cache_len,
     n_layers, hkv, d = model.kv_cache_spec()
     cache_dtype = jnp.dtype(cfg.cache_dtype or cfg.compute_dtype)
     k = cfg.num_beams
-    _with_params = _param_swapper(model, cfg)
+    _with_params = _param_swapper(model, cfg, wq=wq)
 
     if k > 1:
         def prefill_pure(p_values, ids, lens):
@@ -461,7 +614,8 @@ def _build_serving_fns(model, batch, max_cache_len,
             return (tok0, lens, done0, keyr) + tuple(_flatten_kvs(kvs))
         return _with_params(p_values, run)
 
-    return prefill_pure, _build_decode_block(model, cfg, steps_per_call)
+    return prefill_pure, _build_decode_block(model, cfg, steps_per_call,
+                                             wq=wq)
 
 
 class LLMPredictor:
@@ -479,7 +633,7 @@ class LLMPredictor:
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  num_beams=1, length_penalty=0.0,
                  compute_dtype="bfloat16", cache_dtype=None,
-                 _loaded=None):
+                 weight_dtype=None, _loaded=None):
         self.batch = int(batch)
         self.prompt_len = int(prompt_len)
         self.max_cache_len = int(max_cache_len or (prompt_len + 256))
@@ -507,7 +661,14 @@ class LLMPredictor:
         # caller asked for are buffered here and drained first on the
         # next decode() (the device carry is always block-aligned)
         self._pending: Optional[np.ndarray] = None
+        self.weight_dtype = normalize_weight_dtype(weight_dtype)
+        self._wq = None
         if _loaded is not None:
+            if self.weight_dtype is not None:
+                raise ValueError(
+                    "weight_dtype is a load-time quantization of the "
+                    "in-process model; a deserialized artifact carries "
+                    "its weights baked into the exported programs")
             (self._prefill, self._block, self._param_values) = _loaded
             self._model = None
             return
@@ -515,14 +676,20 @@ class LLMPredictor:
             raise ValueError("LLMPredictor needs a model (or .load(path))")
         self._model = model
         model.eval()
+        if self.weight_dtype is not None:
+            self._wq = build_weight_quant_plan(model, self.weight_dtype)
         prefill, block = _build_serving_fns(
             model, self.batch, self.max_cache_len, self.cfg,
-            self.steps_per_call)
+            self.steps_per_call, wq=self._wq)
         self._prefill = jax.jit(prefill)
         self._block = jax.jit(block)
         params, buffers = model_arrays(model)
-        self._param_values = [p._value for p in params] + \
-            [bf._value for bf in buffers]
+        if self._wq is not None:
+            self._param_values = self._wq.placeholder_params(params) + \
+                [bf._value for bf in buffers] + self._wq.flat_values()
+        else:
+            self._param_values = [p._value for p in params] + \
+                [bf._value for bf in buffers]
 
     # -- session --
     def _check_prompt(self, input_ids, seq_lens):
@@ -676,6 +843,12 @@ class LLMPredictor:
         AnalysisPredictor deployment contract)."""
         if self._model is None:
             raise RuntimeError("save() needs the in-process model")
+        if self._wq is not None:
+            raise NotImplementedError(
+                "save() with weight_dtype='int8'/'int4' is not wired — "
+                "the exported artifact's weights pickle would carry the "
+                "code/scale planes without the loader knowing the plan "
+                "layout; quantized-weight predictors serve in-process")
         from jax import export as jax_export
         prefill, block = _build_serving_fns(
             self._model, self.batch, self.max_cache_len, self.cfg,

@@ -241,7 +241,8 @@ from ..observability.spans import instant as _span_instant
 from ..observability.spans import span as _span
 from .llm import (_build_paged_decode_block, build_chunk_prefill,
                   build_fused_decode_window, build_swap_in_scatter,
-                  build_swap_out_gather)
+                  build_swap_out_gather, build_weight_quant_plan,
+                  normalize_weight_dtype)
 from .prefixcache import HostTier, RadixPrefixCache
 from .sampling import (MASK_BIAS, SamplingParams, base_key, flags_of,
                        row_planes)
@@ -577,6 +578,22 @@ class _ServingInstruments:
             "1 for each KV-cache at-rest dtype an engine in this "
             "process serves with (the label carries the dtype name)",
             labels=("dtype",))
+        self.weights_bytes_swept = r.counter(
+            "serving.weights.bytes_swept",
+            "modeled model-weight bytes streamed from HBM by decode/"
+            "verify/prefill-chunk dispatches: one full weight sweep per "
+            "forward (non-quantized params at the compute dtype; "
+            "quantized projections at their code width — int8 codes, "
+            "packed int4 nibbles — plus f32 scale planes).  The "
+            "weight-side twin of serving.kv.bytes_swept and the "
+            "roofline denominator of the weight_quant bench arm")
+        self.weights_quant_dtype = r.gauge(
+            "serving.weights.quant_dtype",
+            "1 for each weight at-rest dtype an engine in this process "
+            "serves with — the compute dtype name for full-precision "
+            "engines, 'int8'/'int4' for quantized weight planes (the "
+            "label carries the dtype name)",
+            labels=("dtype",))
         self.goodput_useful = r.counter(
             "serving.goodput.useful_tokens",
             "dispatched token-positions that produced kept work: "
@@ -706,6 +723,7 @@ class _ServingInstruments:
                   self.spec_verifies, self.spec_draft_hits,
                   self.spec_draft_misses, self.spec_draft_tokens,
                   self.spec_accepted_tokens, self.kv_bytes_swept,
+                  self.weights_bytes_swept,
                   self.prefix_hit_tokens, self.prefix_partial_hits,
                   self.prefix_host_hits, self.prefix_host_swapin,
                   self.sample_sampled_tokens, self.sample_greedy_tokens,
@@ -1290,7 +1308,7 @@ class ServingEngine:
                  eos_token_id=None, pad_token_id=0,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  compute_dtype="bfloat16", cache_dtype=None,
-                 kv_cache_dtype=None,
+                 kv_cache_dtype=None, weight_dtype=None,
                  seed=0, static_batching=False, clock=time.perf_counter,
                  registry=None, max_queue=None, enable_preemption=True,
                  fault_injector=None, flight_recorder=None,
@@ -1366,8 +1384,46 @@ class ServingEngine:
         model.eval()
         self._model = model
         params, buffers = model_arrays(model)
-        self._pb = [p._value for p in params] + \
-            [bf._value for bf in buffers]
+        # weight_dtype: "int8"/"int4" quantizes the hot projections once
+        # at load (codes + per-output-channel f32 scales, the PR-5 KV
+        # discipline applied to weights; inference/llm.py
+        # build_weight_quant_plan).  The planes append to the SAME
+        # positional p_values list every program already takes — the
+        # donation index tuples over the trailing arena args never
+        # shift — and the quantized params' own slots become zero-size
+        # placeholders (a missed projection diversion fails loudly at
+        # trace time).  None or any float dtype = full-precision
+        # weights, today's exact programs.
+        wq_dtype = normalize_weight_dtype(weight_dtype)
+        if wq_dtype is not None:
+            self._wq = build_weight_quant_plan(model, wq_dtype)
+            self.weight_dtype = wq_dtype
+            p_values = self._wq.placeholder_params(params)
+        else:
+            self._wq = None
+            self.weight_dtype = str(jnp.dtype(self.cfg.compute_dtype).name)
+            p_values = [p._value for p in params]
+        self._pb = p_values + [bf._value for bf in buffers] + \
+            (self._wq.flat_values() if self._wq is not None else [])
+        # modeled bytes ONE forward streams for the whole weight set:
+        # float params at the compute dtype (the hoisted cast is what
+        # the dispatch actually reads), buffers and quantized planes at
+        # their own at-rest widths
+        cd_item = jnp.dtype(self.cfg.compute_dtype).itemsize
+        wbytes = 0
+        skip = self._wq.param_positions if self._wq is not None \
+            else frozenset()
+        for i, p in enumerate(params):
+            if i in skip:
+                continue
+            item = (cd_item if jnp.issubdtype(p._value.dtype, jnp.floating)
+                    else p._value.dtype.itemsize)
+            wbytes += int(p._value.size) * item
+        for bf in buffers:
+            wbytes += int(bf._value.nbytes)
+        if self._wq is not None:
+            wbytes += self._wq.bytes_swept()
+        self._weight_sweep_bytes = wbytes
 
         n_layers, hkv, d = model.kv_cache_spec()
         # kv_cache_dtype overrides the arena dtype only; "int8" selects
@@ -1386,12 +1442,19 @@ class ServingEngine:
         if cdt != jnp.dtype(jnp.int8) and \
                 not jnp.issubdtype(cdt, jnp.floating):
             # any float dtype is a valid at-rest cache; "int8" selects
-            # the quantized cache.  Everything else (int4, uint8, ...)
-            # would silently cast K/V into an integer arena with no
-            # scale planes — garbage outputs, so reject loudly
+            # the quantized cache.  Every other integer dtype would
+            # silently cast K/V into an arena with no scale planes —
+            # garbage outputs, so reject loudly.  kv_cache_dtype's
+            # allowed set is NOT weight_dtype's: weights additionally
+            # admit "int4" (packed nibbles unpacked in-kernel), the KV
+            # cache does not — its scatter/attention paths have no
+            # nibble discipline.
+            hint = (" — 'int4' is a WEIGHT dtype: pass "
+                    "weight_dtype='int4' instead (the KV cache has no "
+                    "int4 mode)" if str(kvdt) == "int4" else "")
             raise ValueError(
                 f"kv_cache_dtype must be a float dtype or 'int8' (the "
-                f"quantized cache), got {kvdt!r}")
+                f"quantized KV cache), got {kvdt!r}{hint}")
         self.kv_cache_dtype = str(jnp.dtype(cdt).name)
         self._kv_int8 = cdt == jnp.dtype(jnp.int8)
         self._n_layers = n_layers
@@ -1538,6 +1601,7 @@ class ServingEngine:
             registry if registry is not None else obs_metrics.get_registry())
         self._m.slots_total.set(self.num_slots)
         self._m.kv_quant_dtype.set(1, dtype=self.kv_cache_dtype)
+        self._m.weights_quant_dtype.set(1, dtype=self.weight_dtype)
         self._m.swap_host_blocks.set(0, reason="preempt")
         self._m.swap_host_blocks.set(0, reason="cache")
         self._m.slot_occupancy.set(0)
@@ -1641,6 +1705,18 @@ class ServingEngine:
                    * self.block_len
                    for ix in last_indices)
         self._m.kv_bytes_swept.inc(rows * self._kv_row_bytes)
+
+    def _count_weight_sweep(self, forwards: int):
+        """Modeled weight-streaming traffic: every dispatched forward
+        (one decode scan step, one prefill chunk, one verify pass)
+        streams the whole weight set from HBM once — non-quantized
+        params at the compute dtype, quantized projections at their
+        code+scale width (``_weight_sweep_bytes``).  Modeled like
+        ``_count_kv_sweep``, and charged for EVERY engine (full-
+        precision included) so the weight_quant bench arms compare the
+        same model on the same trace with strictly ordered bytes."""
+        self._m.weights_bytes_swept.inc(
+            int(forwards) * self._weight_sweep_bytes)
 
     # -- goodput ledger --
     def _ledger(self, useful: int, tenant: str = "default",
@@ -2000,6 +2076,8 @@ class ServingEngine:
             for tenant, (u, pad) in gp.items():
                 self._ledger(u, tenant=tenant, pad=pad)
         self._count_kv_sweep(sweep)
+        # every scanned decode step streamed the whole weight set once
+        self._count_weight_sweep(per * p.iters)
         self._done = done
         self._m.slot_occupancy.set(
             sum(r is not None for r in self._slots))
@@ -3708,6 +3786,7 @@ class ServingEngine:
         self._m.chunk_latency.observe(dt)
         self._disp_s += dt
         self._count_kv_sweep([min(start + c, req.seq_len) - 1])
+        self._count_weight_sweep(1)
         # goodput: the dispatch computed chunk_len positions for this
         # row — valid prompt positions split first-time-useful vs
         # cache-known recompute (the [gp_recompute_from, _to) span set
@@ -3796,7 +3875,8 @@ class ServingEngine:
             fn = jax.jit(
                 build_chunk_prefill(self._model, self.cfg,
                                     kv_int8=self._kv_int8,
-                                    samp_flags=flags, lora=lora_on),
+                                    samp_flags=flags, lora=lora_on,
+                                    wq=self._wq),
                 donate_argnums=self._lora_donate(lora_on))
             self._chunk_fns[(flags, lora_on)] = fn
         return fn
@@ -3815,12 +3895,12 @@ class ServingEngine:
                 build = build_fused_decode_window(
                     self._model, self.cfg, steps // iters, iters,
                     kv_int8=self._kv_int8, samp_flags=flags,
-                    lora=lora_on)
+                    lora=lora_on, wq=self._wq)
             else:
                 build = _build_paged_decode_block(
                     self._model, self.cfg, steps,
                     kv_int8=self._kv_int8, samp_flags=flags,
-                    lora=lora_on)
+                    lora=lora_on, wq=self._wq)
             fn = jax.jit(
                 build,
                 donate_argnums=self._lora_donate(lora_on,
@@ -3861,7 +3941,8 @@ class ServingEngine:
             fn = jax.jit(
                 build_spec_verify(self._model, self.cfg, steps,
                                   kv_int8=self._kv_int8,
-                                  samp_flags=flags, lora=lora_on),
+                                  samp_flags=flags, lora=lora_on,
+                                  wq=self._wq),
                 donate_argnums=self._lora_donate(lora_on))
             self._verify_fns[(steps, flags, lora_on)] = fn
         return fn
@@ -3965,6 +4046,7 @@ class ServingEngine:
         # n_valid marks valid — model exactly that
         self._count_kv_sweep([int(self._lens[i]) + width - 1
                               for i in spec])
+        self._count_weight_sweep(1)
         t = self._clock()
         gp: dict = {}          # tenant -> [useful, spec_reject, pad]
         for i in spec:
@@ -4460,6 +4542,9 @@ class ServingEngine:
             "kv_cache_dtype": self.kv_cache_dtype,
             "kv_bytes_swept": int(
                 self._m.since_init(self._m.kv_bytes_swept)),
+            "weight_dtype": self.weight_dtype,
+            "weight_bytes_swept": int(
+                self._m.since_init(self._m.weights_bytes_swept)),
             "decode_steps": int(decode_steps),
             "busy_slot_steps": int(busy),
             "block_dispatches": int(
@@ -4603,6 +4688,7 @@ class ServingEngine:
             "radix": (self._radix.root_stats()
                       if self._radix is not None else None),
             "kv_cache_dtype": self.kv_cache_dtype,
+            "weight_dtype": self.weight_dtype,
         }
 
     def prefix_match(self, prompt_ids) -> int:

@@ -226,7 +226,7 @@ def accept_drafts(greedy_row, drafts,
 
 def build_spec_verify(model, cfg, steps: int, kv_int8: bool = False,
                       samp_flags=(False, False, False, False),
-                      lora=False):
+                      lora=False, wq=None):
     """The compiled verifier program: ONE target forward scores
     ``steps`` positions per slot (the last emitted token plus up to
     ``steps - 1`` draft candidates) against the paged KV arena.
@@ -268,7 +268,12 @@ def build_spec_verify(model, cfg, steps: int, kv_int8: bool = False,
     ``_build_paged_decode_block``) and traces the verify under an
     active adapter context — each spec row's draft positions are
     scored by ITS adapter's target distribution, so greedy acceptance
-    stays token-exact against that adapter's sequential stream."""
+    stays token-exact against that adapter's sequential stream.
+
+    ``wq`` selects quantized-weight serving (see
+    ``_build_paged_decode_block``): the verify forward scores draft
+    positions through the SAME codes+scales the decode path emits
+    with, so acceptance compares like with like."""
     if cfg.num_beams > 1:
         raise ValueError(
             "speculative verification does not support beam search — "
@@ -284,7 +289,7 @@ def build_spec_verify(model, cfg, steps: int, kv_int8: bool = False,
     from .sampling import spec_greedy_rows, spec_sampling_draws
     from ..models.lora import gather_lora, lora_context
 
-    _with_params = _param_swapper(model, cfg)
+    _with_params = _param_swapper(model, cfg, wq=wq)
     sampled, _filtered, penalty, _bias = samp_flags
 
     def _verify(toks, lens, n_valid, tables, samp, flat_arenas):

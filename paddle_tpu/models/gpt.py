@@ -17,6 +17,7 @@ from ..nn import functional as F
 from ..tensor.creation import arange
 from ..tensor.manipulation import concat, unsqueeze
 from .generation import GenerationMixin
+from .wquant import wq_linear
 from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy)
@@ -63,9 +64,12 @@ def tiny_gpt_config(**kw):
 
 
 class GPTAttention(nn.Layer):
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, layer_idx: int = 0):
         super().__init__()
         self.config = config
+        # which row of the weight-quant plan this attention's
+        # projections read (models/wquant.py; inert outside a context)
+        self.layer_idx = int(layer_idx)
         h = config.hidden_size
         self.num_heads = config.num_attention_heads
         self.head_dim = config.head_dim
@@ -78,12 +82,22 @@ class GPTAttention(nn.Layer):
             self.out_proj = nn.Linear(h, h)
         self.dropout_p = config.attention_probs_dropout_prob
 
+    def _qkv(self, x):
+        """The one fused-QKV projection site every attention path
+        shares — wq_linear routes it through the quantized codes+scales
+        when a weight-quant context is active (the fused [h, 3h] weight
+        quantizes as one plane)."""
+        return wq_linear(self.qkv_proj, x, "qkv_proj", self.layer_idx)
+
+    def _out(self, t):
+        return wq_linear(self.out_proj, t, "out_proj", self.layer_idx)
+
     def forward(self, x, attention_mask=None):
         # (cached decoding lives in prefill/decode_step below — the
         # static-cache GenerationMixin path; the old concat-grow cache
         # was removed with it)
         b, s, _ = x.shape
-        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads,
+        qkv = self._qkv(x).reshape([b, s, 3, self.num_heads,
                                         self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         out = F.scaled_dot_product_attention(
@@ -91,18 +105,18 @@ class GPTAttention(nn.Layer):
             dropout_p=self.dropout_p if self.training else 0.0,
             is_causal=attention_mask is None)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
-        out = self.out_proj(out)
+        out = self._out(out)
         return out
 
     def prefill(self, x):
         """Causal forward returning the K/V planes ([B, S, H, D]) for
         the static generation cache (models/generation.py)."""
         b, s, _ = x.shape
-        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads,
+        qkv = self._qkv(x).reshape([b, s, 3, self.num_heads,
                                         self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
-        out = self.out_proj(out.reshape([b, s, -1]))
+        out = self._out(out.reshape([b, s, -1]))
         return out, (k._value, v._value)
 
     def decode_step(self, x, kv, lens):
@@ -113,7 +127,7 @@ class GPTAttention(nn.Layer):
         k_scales, v_scales, tables) of the int8 KV cache."""
         from ..core.tensor import Tensor
         b = x.shape[0]
-        qkv = self.qkv_proj(x).reshape([b, 1, 3, self.num_heads,
+        qkv = self._qkv(x).reshape([b, 1, 3, self.num_heads,
                                         self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if len(kv) == 5:
@@ -147,7 +161,7 @@ class GPTAttention(nn.Layer):
             out = cached_decode_attention(q._value[:, 0], k_cache, v_cache,
                                           lens)
             kv = (k_cache, v_cache)
-        out = self.out_proj(Tensor(out[:, None, :]))
+        out = self._out(Tensor(out[:, None, :]))
         return out, kv
 
     def chunk_step(self, x, kv, start, n_valid):
@@ -158,7 +172,7 @@ class GPTAttention(nn.Layer):
         from ..ops.pallas.decode_attention import paged_prefix_attention
         from ..core.tensor import Tensor
         b, c, _ = x.shape
-        qkv = self.qkv_proj(x).reshape([b, c, 3, self.num_heads,
+        qkv = self._qkv(x).reshape([b, c, 3, self.num_heads,
                                         self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if len(kv) == 5:
@@ -182,7 +196,7 @@ class GPTAttention(nn.Layer):
             out = paged_prefix_attention(q._value, k_arena, v_arena,
                                          tables, start.reshape(1))
             new_kv = (k_arena, v_arena, tables)
-        out = self.out_proj(Tensor(out.reshape(b, c, -1)))
+        out = self._out(Tensor(out.reshape(b, c, -1)))
         return out, new_kv
 
     def verify_step(self, x, kv, lens, n_valid):
@@ -196,7 +210,7 @@ class GPTAttention(nn.Layer):
             decode_attention_paged_multi
         from ..core.tensor import Tensor
         b, c, _ = x.shape
-        qkv = self.qkv_proj(x).reshape([b, c, 3, self.num_heads,
+        qkv = self._qkv(x).reshape([b, c, 3, self.num_heads,
                                         self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if len(kv) == 5:
@@ -220,13 +234,14 @@ class GPTAttention(nn.Layer):
             out = decode_attention_paged_multi(q._value, k_arena, v_arena,
                                                tables, lens)
             new_kv = (k_arena, v_arena, tables)
-        out = self.out_proj(Tensor(out.reshape(b, c, -1)))
+        out = self._out(Tensor(out.reshape(b, c, -1)))
         return out, new_kv
 
 
 class GPTMLP(nn.Layer):
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, layer_idx: int = 0):
         super().__init__()
+        self.layer_idx = int(layer_idx)
         h, m = config.hidden_size, config.intermediate_size
         if config.tensor_parallel:
             self.fc_in = ColumnParallelLinear(h, m, gather_output=False)
@@ -237,18 +252,20 @@ class GPTMLP(nn.Layer):
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
 
     def forward(self, x):
-        return self.dropout(self.fc_out(F.gelu(self.fc_in(x))))
+        h = F.gelu(wq_linear(self.fc_in, x, "fc_in", self.layer_idx))
+        return self.dropout(wq_linear(self.fc_out, h, "fc_out",
+                                      self.layer_idx))
 
 
 class GPTDecoderLayer(nn.Layer):
     """Pre-norm block: x + attn(ln1(x)); x + mlp(ln2(x))."""
 
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, layer_idx: int = 0):
         super().__init__()
         self.ln_1 = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
-        self.attn = GPTAttention(config)
+        self.attn = GPTAttention(config, layer_idx=layer_idx)
         self.ln_2 = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
-        self.mlp = GPTMLP(config)
+        self.mlp = GPTMLP(config, layer_idx=layer_idx)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
         self._recompute = config.recompute
 
@@ -296,8 +313,8 @@ class GPTModel(nn.Layer):
         self.wpe = nn.Embedding(config.max_position_embeddings,
                                 config.hidden_size)
         self.drop = nn.Dropout(config.hidden_dropout_prob)
-        self.h = nn.LayerList([GPTDecoderLayer(config)
-                               for _ in range(config.num_hidden_layers)])
+        self.h = nn.LayerList([GPTDecoderLayer(config, layer_idx=i)
+                               for i in range(config.num_hidden_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
 
     def forward(self, input_ids, position_ids=None, attention_mask=None):
@@ -360,6 +377,16 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
                                         seq_lens=seq_lens,
                                         max_new_tokens=max_new_tokens,
                                         **kw)
+
+    def quant_projections(self):
+        """Per-layer ``{target: Linear}`` views of every hot projection
+        (fused qkv + out + MLP fc_in/fc_out), in layer order — the
+        weight-quantization surface (``models/wquant.py``)."""
+        return [{"qkv_proj": l.attn.qkv_proj,
+                 "out_proj": l.attn.out_proj,
+                 "fc_in": l.mlp.fc_in,
+                 "fc_out": l.mlp.fc_out}
+                for l in self.gpt.h]
 
     def kv_cache_spec(self):
         return (self.config.num_hidden_layers,

@@ -28,6 +28,7 @@ from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
     ParallelCrossEntropy)
 from .generation import GenerationMixin
 from .lora import maybe_lora
+from .wquant import wq_linear
 
 
 @dataclass
@@ -125,18 +126,27 @@ class LlamaAttention(nn.Layer):
     def _o(self, t):
         """Output projection with the per-row LoRA delta (no-op
         outside an adapter context) — the one o_proj site every
-        attention path shares."""
-        return maybe_lora(self.o_proj(t), t, "o_proj", self.layer_idx)
+        attention path shares.  wq_linear routes the base matmul
+        through the quantized codes+scales when a weight-quant context
+        is active (models/wquant.py); the LoRA delta rides full-
+        precision on top of the quantized base."""
+        out = wq_linear(self.o_proj, t, "o_proj", self.layer_idx)
+        return maybe_lora(out, t, "o_proj", self.layer_idx)
 
     def _qkv_rope(self, x, position_ids=None):
         """Project + rotate.  Head counts derive from the projected width
         so tensor-parallel shards (local heads) reshape correctly."""
         b, s, _ = x.shape
-        # per-row LoRA deltas (batched multi-adapter serving): no-ops
-        # outside an active adapter context — see models/lora.py
-        q = maybe_lora(self.q_proj(x), x, "q_proj", self.layer_idx)
-        k = maybe_lora(self.k_proj(x), x, "k_proj", self.layer_idx)
-        v = maybe_lora(self.v_proj(x), x, "v_proj", self.layer_idx)
+        # quantized base matmul (weight-quant serving context, no-op
+        # outside it) + per-row LoRA deltas (batched multi-adapter
+        # serving, no-op outside an adapter context) — see
+        # models/wquant.py and models/lora.py
+        q = maybe_lora(wq_linear(self.q_proj, x, "q_proj", self.layer_idx),
+                       x, "q_proj", self.layer_idx)
+        k = maybe_lora(wq_linear(self.k_proj, x, "k_proj", self.layer_idx),
+                       x, "k_proj", self.layer_idx)
+        v = maybe_lora(wq_linear(self.v_proj, x, "v_proj", self.layer_idx),
+                       x, "v_proj", self.layer_idx)
         hq = q.shape[-1] // self.head_dim
         hkv = k.shape[-1] // self.head_dim
         q = q.reshape([b, s, hq, self.head_dim])
@@ -301,8 +311,11 @@ class LlamaAttention(nn.Layer):
 
 
 class LlamaMLP(nn.Layer):
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, layer_idx: int = 0):
         super().__init__()
+        # which row of the weight-quant plan this MLP's projections
+        # read (models/wquant.py; inert outside an active context)
+        self.layer_idx = int(layer_idx)
         h, m = config.hidden_size, config.intermediate_size
         if config.tensor_parallel:
             self.gate_proj = ColumnParallelLinear(h, m, has_bias=False,
@@ -322,17 +335,21 @@ class LlamaMLP(nn.Layer):
         # tagged for the "save_attn_mlp" remat policy: with gate and up
         # outputs saved, backward skips re-running the two big
         # [hidden, intermediate] matmuls (their grads need BOTH)
-        g = Tensor(checkpoint_name(self.gate_proj(x)._value,
-                                   "mlp_gate_up"))
-        u = Tensor(checkpoint_name(self.up_proj(x)._value, "mlp_gate_up"))
-        return self.down_proj(swiglu(g, u))
+        g = Tensor(checkpoint_name(
+            wq_linear(self.gate_proj, x, "gate_proj",
+                      self.layer_idx)._value, "mlp_gate_up"))
+        u = Tensor(checkpoint_name(
+            wq_linear(self.up_proj, x, "up_proj",
+                      self.layer_idx)._value, "mlp_gate_up"))
+        su = swiglu(g, u)
+        return wq_linear(self.down_proj, su, "down_proj", self.layer_idx)
 
 
 class LlamaDecoderLayer(nn.Layer):
     def __init__(self, config: LlamaConfig, layer_idx: int = 0):
         super().__init__()
         self.self_attn = LlamaAttention(config, layer_idx=layer_idx)
-        self.mlp = LlamaMLP(config)
+        self.mlp = LlamaMLP(config, layer_idx=layer_idx)
         self.input_layernorm = nn.RMSNorm(config.hidden_size,
                                           config.rms_norm_eps)
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
@@ -447,6 +464,20 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
                  "k_proj": l.self_attn.k_proj,
                  "v_proj": l.self_attn.v_proj,
                  "o_proj": l.self_attn.o_proj}
+                for l in self.llama.layers]
+
+    def quant_projections(self):
+        """Per-layer ``{target: Linear}`` views of every hot projection
+        (attention q/k/v/o + MLP gate/up/down), in layer order — the
+        weight-quantization surface (``models/wquant.py``).  Embeddings,
+        norms and lm_head are deliberately absent: they stay float."""
+        return [{"q_proj": l.self_attn.q_proj,
+                 "k_proj": l.self_attn.k_proj,
+                 "v_proj": l.self_attn.v_proj,
+                 "o_proj": l.self_attn.o_proj,
+                 "gate_proj": l.mlp.gate_proj,
+                 "up_proj": l.mlp.up_proj,
+                 "down_proj": l.mlp.down_proj}
                 for l in self.llama.layers]
 
     # -- GenerationMixin surface (models/generation.py; the reference

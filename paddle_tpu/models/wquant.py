@@ -1,0 +1,99 @@
+"""Quantized-weight serving: the trace-time code+scale context.
+
+``ServingEngine(weight_dtype="int8"|"int4")`` quantizes every hot
+projection weight once at load into an int8 code plane (int4 packs two
+codes per byte) plus a per-output-channel f32 scale plane — the PR-5
+KV-cache discipline applied to weights.  The serving programs are traced
+through the models' unchanged ``decode_step`` / ``chunk_step`` /
+``verify_step`` signatures, so — exactly like :mod:`.lora` — the planes
+ride a TRACE-TIME context instead of new arguments on every layer: the
+program builder binds the traced code/scale values and wraps the model
+call in :func:`wquant_context`; the projection sites call
+:func:`wq_linear` (the plain ``lin(x)`` fast path outside any context)
+to route the matmul through the quantized kernel family.
+
+Composition rules:
+
+* **LoRA stays float.**  Projection sites call ``maybe_lora`` ON TOP of
+  ``wq_linear``'s output, so the low-rank delta is computed at full
+  activation precision against the quantized base — quantizing the
+  per-adapter deltas would re-introduce exactly the per-adapter error
+  the kv_int8-style quality gate is meant to bound.
+* **Loud failure over silent full-precision.**  When the engine
+  quantizes a weight, its slot in the swapped param list is a
+  ZERO-SIZE placeholder; any projection site that fails to divert
+  through ``wq_linear`` hits a shape error at trace time instead of
+  silently streaming a stale float plane.
+* Non-projection params (embeddings, norms, lm_head) stay float and
+  swap through ``swap_call`` unchanged.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Dict, Optional, Tuple
+
+# the projection sets the serving quantizer targets, per model family;
+# the models' quant_projections() surfaces return per-layer dicts keyed
+# by these names
+QUANT_TARGETS_LLAMA = ("q_proj", "k_proj", "v_proj", "o_proj",
+                       "gate_proj", "up_proj", "down_proj")
+QUANT_TARGETS_GPT = ("qkv_proj", "out_proj", "fc_in", "fc_out")
+
+
+class WeightQuantContext:
+    """The bound, traced planes for one dispatch:
+    ``planes[(layer_idx, target)] = (codes, scales)`` with codes
+    ``[K, N]`` int8 (``[K//2, N]`` packed for int4) and scales ``[N]``
+    f32; ``bits`` is 8 or 4; ``max_m`` caps the Pallas route at
+    decode/verify-sized row counts (prefill-sized M re-streams the
+    weight per M-block — the XLA dequant fallback wins there)."""
+
+    __slots__ = ("planes", "bits", "max_m")
+
+    def __init__(self, planes: Dict[Tuple[int, str], Tuple], bits: int,
+                 max_m: Optional[int] = 256):
+        self.planes = planes
+        self.bits = bits
+        self.max_m = max_m
+
+
+# the active trace-time context — module state, not a traced value: it
+# is only ever consulted while a serving program builder is tracing
+_ACTIVE: Optional[WeightQuantContext] = None
+
+
+@contextmanager
+def wquant_context(ctx: Optional[WeightQuantContext]):
+    """Activate a weight-quant context for the duration of a traced
+    model call (``None`` = explicit no-op, so builders can wrap
+    unconditionally)."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = ctx
+    try:
+        yield
+    finally:
+        _ACTIVE = prev
+
+
+def wq_linear(lin, x, target: str, layer_idx: int):
+    """Projection-site hook: route ``lin``'s matmul through the
+    quantized codes+scales when the active context registers
+    ``(layer_idx, target)``; the plain ``lin(x)`` fast path otherwise
+    (one global load and a dict probe, trace-time only).  ``x`` and the
+    return are ``Tensor``s; the bias (always float) fuses into the
+    kernel's f32 epilogue."""
+    ctx = _ACTIVE
+    if ctx is None:
+        return lin(x)
+    entry = ctx.planes.get((layer_idx, target))
+    if entry is None:
+        return lin(x)
+    codes, scales = entry
+    from ..ops.pallas.quantized_matmul import routed_quantized_matmul
+    bias = None if lin.bias is None else lin.bias._value
+    y = routed_quantized_matmul(x._value, codes, scales, bits=ctx.bits,
+                                bias=bias, max_m=ctx.max_m)
+    from ..core.tensor import Tensor
+    return Tensor(y)

@@ -14,7 +14,8 @@ changing this surface).
 
 from .config import QuantConfig
 from .observers import (AbsmaxObserver, MovingAverageAbsmaxObserver,
-                        PerChannelAbsmaxObserver, BaseObserver)
+                        PerChannelAbsmaxObserver, BaseObserver,
+                        absmax_to_scales, quantize_channelwise)
 from .quanters import (BaseQuanter, FakeQuanterWithAbsMaxObserver,
                        FakeQuanterChannelWiseAbsMaxObserver,
                        quantize_tensor, dequantize_tensor, fake_quant)
@@ -25,7 +26,7 @@ __all__ = [
     "QuantConfig", "QAT", "PTQ", "weight_only_quantize",
     "fuse_act_into_quant_linear",
     "BaseObserver", "AbsmaxObserver", "MovingAverageAbsmaxObserver",
-    "PerChannelAbsmaxObserver",
+    "PerChannelAbsmaxObserver", "absmax_to_scales", "quantize_channelwise",
     "BaseQuanter", "FakeQuanterWithAbsMaxObserver",
     "FakeQuanterChannelWiseAbsMaxObserver",
     "quantize_tensor", "dequantize_tensor", "fake_quant",

@@ -13,6 +13,33 @@ from ..core.tensor import Tensor
 from ..nn import Layer
 
 
+def absmax_to_scales(absmax, bit_length: int = 8):
+    """THE quant rule: absmax statistics -> per-channel (or scalar)
+    quantization scales.  ``scale = max(absmax, 1e-9) / qmax`` with
+    ``qmax = 2**(bits-1) - 1`` — the epsilon floor lands on the absmax
+    BEFORE the divide so composing with an observer's already-floored
+    ``scales()`` output is idempotent (observer path and any loader path
+    agree bit-exactly).  Every weight-quantization site (QAT freeze, PTQ
+    weight-only, the serving engine's weight_dtype loader) must call
+    this, not re-derive it."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    return jnp.maximum(jnp.asarray(absmax, jnp.float32), 1e-9) / qmax
+
+
+def quantize_channelwise(w, scales, bit_length: int = 8,
+                         quant_axis: int = -1):
+    """Codes for ``w`` against per-channel ``scales`` along
+    ``quant_axis``: ``clip(round(w / scale), -qmax, qmax)`` as int8
+    (int4 codes also ride in an int8 container, range [-7, 7])."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    w = jnp.asarray(w, jnp.float32)
+    axis = quant_axis % w.ndim
+    shape = [1] * w.ndim
+    shape[axis] = -1
+    s = jnp.asarray(scales, jnp.float32).reshape(shape)
+    return jnp.clip(jnp.round(w / s), -qmax, qmax).astype(jnp.int8)
+
+
 class BaseObserver(Layer):
     """Observers are identity layers that record statistics; ``scales()``
     yields the calibrated quantization scale (absmax)."""

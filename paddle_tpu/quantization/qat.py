@@ -16,6 +16,7 @@ from ..nn.quant.quant_layers import (QuantedLinear, QuantedConv2D,
                                      QuantizedLinearInfer,
                                      QuantizedConv2DInfer)
 from .config import QuantConfig
+from .observers import absmax_to_scales, quantize_channelwise
 from .quanters import FakeQuanterChannelWiseAbsMaxObserver
 
 
@@ -76,7 +77,6 @@ def _freeze(qlayer):
     w = jnp.asarray(qlayer.weight._value, jnp.float32)
     bits = (qlayer.weight_quanter.bit_length()
             if qlayer.weight_quanter is not None else 8)
-    qmax = float(2 ** (bits - 1) - 1)
     act_scale = None
     if qlayer.activation_quanter is not None:
         act_scale = qlayer.activation_quanter.scales()
@@ -84,21 +84,17 @@ def _freeze(qlayer):
     if isinstance(qlayer, QuantedLinear):
         axis = 1  # [in, out] -> per-out-channel
         reduce_axes = (0,)
-        scales = jnp.maximum(jnp.max(jnp.abs(w), axis=reduce_axes) / qmax,
-                             1e-9)
-        qw = jnp.clip(jnp.round(w / scales[None, :]), -qmax, qmax) \
-            .astype(jnp.int8)
+        scales = absmax_to_scales(jnp.max(jnp.abs(w), axis=reduce_axes),
+                                  bits)
+        qw = quantize_channelwise(w, scales, bits, quant_axis=axis)
         return QuantizedLinearInfer(
             qw, scales, qlayer.bias, qlayer._float_layer.in_features,
             qlayer._float_layer.out_features, act_scale, bits)
 
     axis = 0  # conv [out, in, kh, kw]
     reduce_axes = tuple(range(1, w.ndim))
-    scales = jnp.maximum(jnp.max(jnp.abs(w), axis=reduce_axes) / qmax, 1e-9)
-    shape = [1] * w.ndim
-    shape[axis] = -1
-    qw = jnp.clip(jnp.round(w / scales.reshape(shape)), -qmax, qmax) \
-        .astype(jnp.int8)
+    scales = absmax_to_scales(jnp.max(jnp.abs(w), axis=reduce_axes), bits)
+    qw = quantize_channelwise(w, scales, bits, quant_axis=axis)
     conv_args = (qlayer._stride, qlayer._padding, qlayer._dilation,
                  qlayer._groups, qlayer._data_format)
     return QuantizedConv2DInfer(qw, scales, qlayer.bias, conv_args,

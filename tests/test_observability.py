@@ -513,6 +513,7 @@ def test_metrics_name_lint_clean():
              "serving.async.", "serving.fault.",
              "serving.lora.", "serving.fairshare.",
              "serving.router.", "serving.migrate.",
+             "serving.weights.", "pallas.quantized_matmul.",
              "serving.tpot_seconds")), n
         assert n in names, n
     kinds = {r[3]: r[2] for r in regs}

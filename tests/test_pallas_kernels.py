@@ -463,6 +463,171 @@ def test_quantized_matmul_differentiable_x():
     np.testing.assert_allclose(np.asarray(g)[0], ref, atol=1e-4, rtol=1e-4)
 
 
+def test_int4_pack_unpack_roundtrip_property():
+    """pack_int4/unpack_int4 are exact inverses over the whole int4 code
+    range, at every even K (including K=2 and non-128-multiples) — and
+    odd K fails loudly."""
+    from paddle_tpu.ops.pallas import quantized_matmul as qmm
+    rng = np.random.default_rng(14)
+    for k, n in ((2, 1), (6, 3), (64, 128), (128, 384), (254, 8)):
+        codes = jnp.asarray(rng.integers(-8, 8, (k, n)), jnp.int8)
+        packed = qmm.pack_int4(codes)
+        assert packed.shape == (k // 2, n)
+        assert packed.dtype == jnp.int8
+        np.testing.assert_array_equal(np.asarray(qmm.unpack_int4(packed)),
+                                      np.asarray(codes))
+    # the full nibble range survives one packed byte
+    col = jnp.asarray(np.arange(-8, 8, dtype=np.int8).reshape(16, 1))
+    np.testing.assert_array_equal(
+        np.asarray(qmm.unpack_int4(qmm.pack_int4(col))), np.asarray(col))
+    with pytest.raises(ValueError, match="must be even"):
+        qmm.pack_int4(jnp.zeros((3, 4), jnp.int8))
+
+
+def test_quantized_matmul_int4_kernel_matches_xla_fallback():
+    """The int4 kernel (interpret mode: in-kernel nibble unpack +
+    split-K-halves concat) vs dequant_matmul_xla — same codes, same
+    scales, fused bias — and a second call with different activations
+    must not see stale state."""
+    from paddle_tpu.ops.pallas import quantized_matmul as qmm
+    rng = np.random.default_rng(15)
+    k, n = 128, 256
+    codes = jnp.asarray(rng.integers(-7, 8, (k, n)), jnp.int8)
+    packed = qmm.pack_int4(codes)
+    scales = jnp.asarray(rng.uniform(0.01, 0.03, (n,)), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((n,)), jnp.float32)
+    for dtype, tol in ((jnp.float32, 1e-4), (jnp.bfloat16, 2e-2)):
+        x = jnp.asarray(rng.standard_normal((16, k)), dtype)
+        out = qmm.quantized_matmul(x, packed, scales, bias=bias, bits=4)
+        ref = qmm.dequant_matmul_xla(x, packed, scales, bits=4, bias=bias)
+        assert out.dtype == ref.dtype == dtype
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=tol, rtol=tol)
+    # stale-scratch invariant: a fresh x through the same planes
+    x1 = jnp.asarray(rng.standard_normal((16, k)), jnp.float32)
+    x2 = jnp.asarray(rng.standard_normal((16, k)), jnp.float32)
+    qmm.quantized_matmul(x1, packed, scales, bits=4)
+    out2 = qmm.quantized_matmul(x2, packed, scales, bits=4)
+    np.testing.assert_allclose(
+        np.asarray(out2),
+        np.asarray(qmm.dequant_matmul_xla(x2, packed, scales, bits=4)),
+        atol=1e-4, rtol=1e-4)
+
+
+def test_quantized_matmul_int8_kernel_matches_xla_fallback_bf16():
+    """bf16 activations through the int8 kernel: the MXU sees bf16 but
+    accumulates fp32; the XLA fallback computes the identical math."""
+    from paddle_tpu.ops.pallas import quantized_matmul as qmm
+    rng = np.random.default_rng(16)
+    k, n = 128, 128
+    qw = jnp.asarray(rng.integers(-127, 128, (k, n)), jnp.int8)
+    scales = jnp.asarray(rng.uniform(0.005, 0.02, (n,)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((8, k)), jnp.bfloat16)
+    out = qmm.quantized_matmul(x, qw, scales)
+    ref = qmm.dequant_matmul_xla(x, qw, scales)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_routed_quantized_matmul_edge_shapes_take_fallback(monkeypatch):
+    """Odd-K / odd-channel shapes the kernel cannot tile must still
+    compute correctly through the routed entry point (the XLA fallback),
+    and the route counter must name the disqualifier."""
+    from paddle_tpu.observability.metrics import get_registry
+    from paddle_tpu.ops.pallas import quantized_matmul as qmm
+    monkeypatch.setattr(qmm, "pallas_enabled", lambda: True)
+    rng = np.random.default_rng(17)
+    route = get_registry().counter("pallas.quantized_matmul.route",
+                                   labels=("decision", "reason"))
+
+    def count(decision, reason):
+        assert reason in qmm.QMM_ROUTE_REASONS
+        return route.value(decision=decision, reason=reason)
+
+    # K=96 (not a 128 multiple) -> geometry
+    x = jnp.asarray(rng.standard_normal((8, 96)), jnp.float32)
+    qw = jnp.asarray(rng.integers(-127, 128, (96, 128)), jnp.int8)
+    sc = jnp.asarray(rng.uniform(0.01, 0.02, (128,)), jnp.float32)
+    before = count("xla", "geometry")
+    out = qmm.routed_quantized_matmul(x, qw, sc)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(qmm.dequant_matmul_xla(x, qw, sc)),
+        atol=1e-5, rtol=1e-5)
+    assert count("xla", "geometry") == before + 1
+    # m=4 decode rows below the sublane minimum -> rows_below_min
+    x4 = jnp.asarray(rng.standard_normal((4, 128)), jnp.float32)
+    qw128 = jnp.asarray(rng.integers(-127, 128, (128, 128)), jnp.int8)
+    b_min = count("xla", "rows_below_min")
+    out4 = qmm.routed_quantized_matmul(x4, qw128, sc)
+    np.testing.assert_allclose(
+        np.asarray(out4),
+        np.asarray(qmm.dequant_matmul_xla(x4, qw128, sc)),
+        atol=1e-5, rtol=1e-5)
+    assert count("xla", "rows_below_min") == b_min + 1
+    # prefill-sized m above the cap -> rows_above_cap
+    xp = jnp.asarray(rng.standard_normal((512, 128)), jnp.float32)
+    b_cap = count("xla", "rows_above_cap")
+    qmm.routed_quantized_matmul(xp, qw128, sc, max_m=256)
+    assert count("xla", "rows_above_cap") == b_cap + 1
+    # N=100 (odd output-channel count, not a lane multiple) -> geometry
+    x8 = jnp.asarray(rng.standard_normal((8, 128)), jnp.float32)
+    qw_n = jnp.asarray(rng.integers(-127, 128, (128, 100)), jnp.int8)
+    sc_n = jnp.asarray(rng.uniform(0.01, 0.02, (100,)), jnp.float32)
+    b_n = count("xla", "geometry")
+    out_n = qmm.routed_quantized_matmul(x8, qw_n, sc_n)
+    np.testing.assert_allclose(
+        np.asarray(out_n),
+        np.asarray(qmm.dequant_matmul_xla(x8, qw_n, sc_n)),
+        atol=1e-5, rtol=1e-5)
+    assert count("xla", "geometry") == b_n + 1
+
+
+def test_routed_quantized_matmul_dispatches_kernel(monkeypatch):
+    """128-aligned decode-shaped calls route to the Pallas kernel
+    (interpret mode) for both int8 and int4, landing pallas-decision
+    route counts — the bench's route-proof in miniature."""
+    from paddle_tpu.observability.metrics import get_registry
+    from paddle_tpu.ops.pallas import quantized_matmul as qmm
+    monkeypatch.setattr(qmm, "pallas_enabled", lambda: True)
+    rng = np.random.default_rng(18)
+    route = get_registry().counter("pallas.quantized_matmul.route",
+                                   labels=("decision", "reason"))
+
+    def count(decision, reason):
+        return route.value(decision=decision, reason=reason)
+
+    x = jnp.asarray(rng.standard_normal((8, 128)), jnp.float32)
+    qw = jnp.asarray(rng.integers(-127, 128, (128, 128)), jnp.int8)
+    sc = jnp.asarray(rng.uniform(0.01, 0.02, (128,)), jnp.float32)
+    b8 = count("pallas", "int8_ok")
+    out = qmm.routed_quantized_matmul(x, qw, sc)
+    assert count("pallas", "int8_ok") == b8 + 1
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(qmm.dequant_matmul_xla(x, qw, sc)),
+        atol=1e-4, rtol=1e-4)
+    codes = jnp.asarray(rng.integers(-7, 8, (128, 128)), jnp.int8)
+    packed = qmm.pack_int4(codes)
+    b4 = count("pallas", "int4_ok")
+    out4 = qmm.routed_quantized_matmul(x, packed, sc, bits=4)
+    assert count("pallas", "int4_ok") == b4 + 1
+    np.testing.assert_allclose(
+        np.asarray(out4),
+        np.asarray(qmm.dequant_matmul_xla(x, packed, sc, bits=4)),
+        atol=1e-4, rtol=1e-4)
+    # without the monkeypatch (CPU), the same call falls back with the
+    # pallas_unavailable reason — routing never changes results
+    monkeypatch.undo()
+    if not qmm.pallas_enabled():
+        bu = count("xla", "pallas_unavailable")
+        out_cpu = qmm.routed_quantized_matmul(x, qw, sc)
+        assert count("xla", "pallas_unavailable") == bu + 1
+        np.testing.assert_allclose(np.asarray(out_cpu), np.asarray(out),
+                                   atol=1e-4, rtol=1e-4)
+
+
 def test_flash_block_schedule_search_and_persistence(tmp_path, monkeypatch):
     # the CINN-auto_schedule analogue: enumerate feasible block configs,
     # time them (interpret mode on CPU — mechanics, not speed), persist

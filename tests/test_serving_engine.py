@@ -749,6 +749,30 @@ def test_bench_llm_serving_section():
     assert kvq["kv_bytes_swept"] * 2 < kvq["baseline_kv_bytes_swept"]
     assert kvq["gate"]["token_agreement_ok"]
     assert kvq["gate"]["nll_ok"]
+    wq = out["weight_quant"]
+    for k in ("baseline_dtype", "baseline_tokens_per_s",
+              "baseline_achieved_GBps", "baseline_weight_bytes_swept",
+              "forced_tokens", "int8", "int4", "gate"):
+        assert k in wq, k
+    for arm in ("int8", "int4"):
+        for k in ("tokens_per_s", "achieved_GBps",
+                  "weight_bytes_swept", "token_agreement",
+                  "decisive_token_agreement", "engine_token_agreement",
+                  "delta_nll_pct", "token_agreement_ok", "nll_ok"):
+            assert k in wq[arm], (arm, k)
+    # deterministic gates: quality per quantized dtype, strictly
+    # shrinking modeled weight sweep, scheduling identity, and the
+    # forced-enable route proof that both bit widths dispatch Pallas
+    assert wq["gate"]["token_agreement_ok"]
+    assert wq["gate"]["nll_ok"]
+    assert wq["gate"]["bytes_order_ok"]
+    # the decisive-margin filter must not hollow out the token gate
+    assert wq["decisive_frac"] > 0.5
+    assert wq["gate"]["dispatch_parity_ok"]
+    assert wq["gate"]["route_ok"]
+    assert wq["baseline_weight_bytes_swept"] \
+        > wq["int8"]["weight_bytes_swept"] \
+        > wq["int4"]["weight_bytes_swept"] > 0
     spec = out["spec"]
     for k in ("k", "tokens_per_s", "no_spec_tokens_per_s", "vs_no_spec",
               "mean_accepted_len", "acceptance_rate", "drafts_per_token",

@@ -61,6 +61,16 @@ REQUIRED_INSTRUMENTS = {
     # the per-dtype presence gauge
     "serving.kv.bytes_swept": ("counter", ()),
     "serving.kv.quant_dtype": ("gauge", ("dtype",)),
+    # quantized weight arenas (PR 16, inference/serving.py
+    # _ServingInstruments + ops/pallas/quantized_matmul.py): the
+    # weight-side twins of the KV pair — modeled weight-plane sweep
+    # bytes per forward and the engine weight-dtype presence gauge —
+    # plus the dequant-matmul dispatch route counter the bench's
+    # weight_quant arm gates on (pallas kernel vs XLA fallback, with
+    # the gating reason, mirroring pallas.decode_attention.route)
+    "serving.weights.bytes_swept": ("counter", ()),
+    "serving.weights.quant_dtype": ("gauge", ("dtype",)),
+    "pallas.quantized_matmul.route": ("counter", ("decision", "reason")),
     # per-request sampling (inference/serving.py _ServingInstruments):
     # the sampled-vs-greedy route split, the constrained-decoding
     # masked-token count, and the speculative-sampling residual

@@ -72,6 +72,10 @@ VOCABS: Tuple[VocabSpec, ...] = (
     VocabSpec("REPLICA_FAULTS", producers=("_classify_fault",)),
     VocabSpec("FAILOVER_PATHS"),
     VocabSpec("PROBE_OUTCOMES"),
+    # quantized-matmul routing reasons (PR 16): every label the
+    # pallas.quantized_matmul.route counter can carry flows through the
+    # _qmm_route_reason producer's literal returns
+    VocabSpec("QMM_ROUTE_REASONS", producers=("_qmm_route_reason",)),
 )
 
 
