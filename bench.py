@@ -2333,6 +2333,141 @@ def _bench_serving(on_tpu):
             fo_on["failover_requests"] == fo_affected),
     }
 
+    # -- fleet observability arm (``fleet_obs`` sub-object, PR 17):
+    # the SAME seeded kill trace re-run with the whole observability
+    # plane attached — per-replica flight recorders, the router's
+    # recorder, the SLO burn-rate monitor, a step-indexed time-series.
+    # Gated ONLY on deterministic facts: the stitched fleet record
+    # accounts for every ring event, explain() renders the one migrate
+    # hop at the exact block count, the monitor alerts exactly once on
+    # the kill, and the instrumented run stays token-for-token equal
+    # to the uninstrumented ON arm (observability must not perturb the
+    # trace).  Sampling overhead is the PR-2 disabled-mode micro-bench
+    # — walls report-only --
+    from paddle_tpu.observability.fleet import SLOBurnRateMonitor
+    from paddle_tpu.observability.flightrec import FlightRecorder
+    from paddle_tpu.observability.timeseries import TimeSeriesRecorder
+
+    def _one_obs_trace():
+        engs, injs, recs = [], [], []
+        for _ in range(2):
+            inj = FaultInjector()
+            rec = FlightRecorder()
+            engs.append(ServingEngine(
+                model, num_slots=2, prompt_len=tr_prompt,
+                max_cache_len=tr_cache, steps_per_call=steps_per_call,
+                block_len=tr_block, chunk_len=tr_chunk,
+                num_blocks=tr_blocks, compute_dtype=compute_dtype,
+                registry=obs_metrics.MetricsRegistry(),
+                fault_injector=inj, flight_recorder=rec))
+            injs.append(inj)
+            recs.append(rec)
+        rreg = obs_metrics.MetricsRegistry()
+        rrec = FlightRecorder()
+        mon = SLOBurnRateMonitor(slo_target=0.9, window_steps=8)
+        ts = TimeSeriesRecorder(rreg, capacity=64)
+        rt = Router(engs, failover=True, registry=rreg,
+                    flight_recorder=rrec, monitor=mon, timeseries=ts)
+        hs = [rt.submit(p, max_new_tokens=fo_new, arrival_time=0.0)
+              for p in fo_prompts]
+        rt.step(now=0.0)
+        for _ in range(2):
+            rt.step(now=0.0)
+        vi = hs[0].engine
+        injs[vi].force_swap(hs[0].request_id)
+        injs[vi].fail_allocs(None)
+        rt.step(now=0.0)
+        vblocks = (hs[0]._req.swap.n_blocks
+                   if hs[0].state == "swapped" else 0)
+        injs[vi].kill_at_step(engs[vi]._step_idx + 1)
+        steps = 0
+        step_walls = []
+        while any(h.state not in ("finished", "failed", "timeout",
+                                  "shed", "cancelled") for h in hs):
+            s0 = time.perf_counter()
+            rt.step(now=0.0)
+            step_walls.append(time.perf_counter() - s0)
+            steps += 1
+            if steps > 400:
+                break
+        outs = [np.asarray(h.output) for h in hs]
+        return (rt, recs, rrec, mon, ts, outs, vi, vblocks,
+                hs[0].router_id, step_walls)
+
+    (obs_rt, obs_recs, obs_rrec, obs_mon, obs_ts, obs_outs,
+     obs_vi, obs_vblocks, obs_victim_rid, obs_walls) = _one_obs_trace()
+    obs_st = obs_rt.stitched_record()
+    obs_ring = (len(obs_rrec.events())
+                + sum(len(r.events()) for r in obs_recs))
+    obs_story = obs_st.explain(obs_victim_rid)
+    obs_hop = (f"migrated {obs_vblocks} blocks to engine "
+               f"{1 - obs_vi} at exact bytes")
+    obs_alerts = obs_mon.alerts()
+
+    # disabled-mode micro-bench (the PR-2 shape): one busy router
+    # step's worth of recorder emits plus a time-series sample on
+    # DISABLED instances vs the measured instrumented step wall
+    rec_d = FlightRecorder(enabled=False)
+    ts_d = TimeSeriesRecorder(obs_metrics.MetricsRegistry(),
+                              capacity=8, enabled=False)
+
+    def _touches():
+        rec_d.emit("submit", 1, 0, seq_len=6, max_new=8, priority=0,
+                   queue_depth=1)
+        rec_d.emit("route", 1, 0, engine=0, queue_depth=1)
+        rec_d.emit("admit", 1, 1, slot=0, matched_blocks=0)
+        rec_d.emit("prefill_chunk", 1, 1, start=0, tokens=6)
+        rec_d.emit("decode_block", 1, 2, steps=1)
+        rec_d.emit("decode_block", 2, 2, steps=1)
+        rec_d.emit("migrate", 1, 3, engine=1, src=0, blocks=3)
+        rec_d.emit("finish", 1, 9, tokens=8)
+        ts_d.sample(0)
+
+    n_micro = 3000
+    t0 = time.perf_counter()
+    for _ in range(n_micro):
+        _touches()
+    t_disabled = (time.perf_counter() - t0) / n_micro
+    t_step = float(np.median(obs_walls)) if obs_walls else 1.0
+
+    fleet_obs_ab = {
+        "replicas": 2, "n_requests": len(fo_prompts),
+        "stitched_events": len(obs_st),
+        "ring_events": int(obs_ring),
+        "stitched_dropped": int(obs_st.dropped_total),
+        "victim_replica": int(obs_vi),
+        "victim_parcel_blocks": int(obs_vblocks),
+        "alerts": [{"kind": a["kind"], "step": a["step"]}
+                   for a in obs_alerts],
+        "timeseries_samples": len(obs_ts),
+        "timeseries_dropped": int(obs_ts.dropped),
+        # walls: report-only, never gated on magnitude
+        "step_ms": round(1e3 * t_step, 3),
+        "disabled_emit_us": round(1e6 * t_disabled, 3),
+        "overhead_pct": round(100.0 * t_disabled / max(t_step, 1e-9),
+                              4),
+        # deterministic gates: every ring event survives stitching
+        # (nothing dropped, nothing duplicated), explain() narrates
+        # exactly one migrate hop at the victim's exact parcel size,
+        # the kill raises exactly one replica_unhealthy alert, the
+        # instrumented trace is token-exact vs the uninstrumented ON
+        # arm, and the disabled plane costs <2% of a step
+        "gate_stitch_count_exact": bool(
+            len(obs_st) == obs_ring and obs_st.dropped_total == 0),
+        "gate_migrate_hop_rendered": bool(
+            obs_hop in obs_story
+            and obs_story.count("migrated ") == 1
+            and obs_vblocks > 0),
+        "gate_alert_once_on_kill": bool(
+            len(obs_alerts) == 1
+            and obs_alerts[0]["kind"] == "replica_unhealthy"
+            and obs_alerts[0]["engine"] == obs_vi),
+        "gate_obs_token_exact": bool(all(
+            np.array_equal(a, b)
+            for a, b in zip(fo_on_outs, obs_outs))),
+        "gate_disabled_under_2pct": bool(t_disabled < 0.02 * t_step),
+    }
+
     return {
         "tokens_per_s": cont["tokens_per_s"],
         "p50_latency_ms": cont["p50_latency_ms"],
@@ -2382,6 +2517,7 @@ def _bench_serving(on_tpu):
         "lora": lora,
         "router": router_ab,
         "failover": failover_ab,
+        "fleet_obs": fleet_obs_ab,
         "spec": {
             "k": sp_k, "max_new": sp_new, "n_requests": sp_n,
             "tokens_per_s": spec_on["tokens_per_s"],

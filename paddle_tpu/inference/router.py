@@ -88,6 +88,7 @@ from typing import List, Optional, Union
 
 import numpy as np
 
+from ..observability import fleet as obs_fleet
 from ..observability import metrics as obs_metrics
 from ..observability.flightrec import FlightRecorder
 from .prefixcache import HostTier
@@ -245,6 +246,12 @@ class _RouterInstruments:
             "serving.migrate.bytes",
             "at-rest KV bytes (codes + scale planes for the int8 "
             "cache) moved between replicas during failover migration")
+        self.fleet_snapshots = r.counter(
+            "serving.fleet.snapshots",
+            "Router.fleet_snapshot() calls — each merges every "
+            "replica's registry snapshot, health state and "
+            "load_report() into one replica-labeled fleet view (the "
+            "tools/serving_top.py surface)")
         # router-phase cancels share the ENGINE counter (same name,
         # kind and label tuple, so shared registries re-use the
         # instrument): phase='router' is the queue level above any
@@ -413,6 +420,7 @@ class Router:
                  failover: bool = True, retry_budget: int = 3,
                  probe_interval: int = 1, probation_steps: int = 2,
                  registry=None, flight_recorder=None,
+                 monitor=None, timeseries=None,
                  clock=time.perf_counter):
         if not engines:
             raise ValueError("Router needs >= 1 engine replica")
@@ -488,6 +496,15 @@ class Router:
         self._fr = (flight_recorder if flight_recorder is not None
                     else FlightRecorder(enabled=False))
         self._fr.bind_clock(clock)
+        # fleet observability plane (observability.fleet /
+        # .timeseries): the monitor adopts the router's registry and
+        # recorder unless constructed with its own, and both are
+        # driven once at the end of every step() — step-indexed, so
+        # replaying a trace reproduces samples and alerts exactly
+        self._monitor = monitor
+        if monitor is not None:
+            monitor._bind(self._m.registry, self._fr)
+        self._ts = timeseries
 
     # -- intake --
     def submit(self, prompt_ids, seq_len=None, max_new_tokens=None,
@@ -824,12 +841,15 @@ class Router:
                 self._m.prefix_tokens.inc(ptok)
             if ahit:
                 self._m.adapter_hits.inc()
+            # rid = the engine-side id the replica assigned: the
+            # binding the fleet stitcher uses to re-key that replica's
+            # events onto this router-global id (no global clock)
             self._fr.emit(
                 "route", pr.router_id, self._step_idx, engine=ei,
                 affinity=int(ptok), adapter_hit=int(ahit),
                 policy=(pr.policy if pr.policy is not None
                         else "default"),
-                reason=reason)
+                reason=reason, rid=req.request_id)
         self._m.queue_depth.set(len(self._queue))
 
     # -- failover: health model, recovery, probation --
@@ -997,12 +1017,13 @@ class Router:
                         nb * eng.block_len * eng._kv_row_bytes)
                     self._fr.emit(
                         "migrate", h.router_id, self._step_idx,
-                        engine=ei, src=rec["src"], blocks=nb)
+                        engine=ei, src=rec["src"], blocks=nb,
+                        rid=req.request_id)
                 else:
                     self._fr.emit(
                         "retry", h.router_id, self._step_idx,
                         engine=ei, path=rec["path"],
-                        attempt=h.retries)
+                        attempt=h.retries, rid=req.request_id)
                 placed = True
                 break
             if not placed:
@@ -1108,6 +1129,15 @@ class Router:
             if self._health[ei] == "probation" and \
                     self._step_idx >= self._probation_until[ei]:
                 self._set_health(ei, "healthy")
+        if self._monitor is not None:
+            self._monitor.observe(
+                step=self._step_idx,
+                registries=[e.metrics_registry
+                            for e in self._engines],
+                health=self._health, queue_depth=len(self._queue),
+                max_queue=self.max_queue)
+        if self._ts is not None:
+            self._ts.sample(self._step_idx)
         return out
 
     def _idle(self) -> bool:
@@ -1213,7 +1243,49 @@ class Router:
             "migrated_bytes": int(
                 self._m.since_init(self._m.migrate_bytes)),
             "per_engine": [e.load_report() for e in self._engines],
+            # light fleet-plane summary (the full merged view is
+            # fleet_snapshot() — embedding it here would make stats()
+            # O(registry) and recursive through snapshot consumers)
+            "fleet": {
+                "monitor": self._monitor is not None,
+                "timeseries": self._ts is not None,
+                "alerts": (len(self._monitor.alerts())
+                           if self._monitor is not None else 0),
+            },
         }
+
+    def fleet_snapshot(self) -> dict:
+        """The whole fleet as ONE replica-labeled dict: every
+        replica's registry snapshot merged under a ``replica=<i>``
+        label (shared registries deduplicate to a ``"+"``-joined
+        replica value), health states, ``load_report()``s, the
+        router's own stats, and — when attached — the monitor's
+        alert/burn-rate summary and the time-series window
+        aggregates.  Pure data (JSON-ready): ``tools/serving_top.py``
+        renders it without a live engine."""
+        self._m.fleet_snapshots.inc()
+        # dedupe shared registries: each distinct registry is merged
+        # once, labeled with every replica index it serves
+        by_reg: dict = {}
+        for i, e in enumerate(self._engines):
+            reg = e.metrics_registry
+            by_reg.setdefault(id(reg), [reg, []])[1].append(str(i))
+        pairs = [("+".join(idxs), reg.snapshot())
+                 for reg, idxs in by_reg.values()]
+        snap = {
+            "version": 1,
+            "step": self._step_idx,
+            "engines": len(self._engines),
+            "health": list(self._health),
+            "registries": obs_fleet.merge_registry_snapshots(pairs),
+            "load_reports": [e.load_report() for e in self._engines],
+            "router": self.stats(),
+        }
+        if self._monitor is not None:
+            snap["monitor"] = self._monitor.summary()
+        if self._ts is not None:
+            snap["timeseries"] = self._ts.aggregates()
+        return snap
 
     @property
     def health(self) -> List[str]:
@@ -1227,6 +1299,25 @@ class Router:
     @property
     def flight_recorder(self) -> FlightRecorder:
         return self._fr
+
+    @property
+    def monitor(self):
+        """The attached ``SLOBurnRateMonitor`` (None when absent)."""
+        return self._monitor
+
+    @property
+    def timeseries(self):
+        """The attached ``TimeSeriesRecorder`` (None when absent)."""
+        return self._ts
+
+    def stitched_record(self):
+        """One fleet-wide :class:`~paddle_tpu.observability.fleet.
+        StitchedRecord` over the router's recorder and every
+        replica's — the cross-replica ``explain()`` / Perfetto-export
+        surface."""
+        return obs_fleet.stitch_flight_records(
+            [e.flight_recorder for e in self._engines],
+            router=self._fr)
 
     def explain(self, router_id: int) -> str:
         """The router-level lifecycle of one request ("routed to

@@ -69,6 +69,11 @@ EVENT_KINDS = frozenset({
     #                   (attrs: engine=dest, src, blocks)
     "retry",          # re-placed on a healthy replica (attrs:
     #                   engine=dest, path=recompute|requeue, attempt)
+    "alert",          # fleet monitor alarm (observability.fleet
+    #                   SLOBurnRateMonitor): attrs carry kind
+    #                   (ALERT_KINDS) + deterministic context; request
+    #                   is ENGINE_EVENT — an alert is fleet-scoped, and
+    #                   riding the recorder makes it replay-deterministic
 })
 
 # request id recorded for engine-scoped events (prefix-cache demotions
@@ -142,7 +147,9 @@ class FlightRecorder:
         return self._enabled
 
     # -- recording --
-    def emit(self, kind: str, request: int, step: int, **attrs):
+    def emit(self, kind: str, request: int, step: int, /, **attrs):
+        # positional-only core so attrs may reuse the names (the fleet
+        # monitor's "alert" events carry a kind= attr)
         if not self._enabled:
             return
         if kind not in EVENT_KINDS:
@@ -169,7 +176,9 @@ class FlightRecorder:
                        if e.request != ENGINE_EVENT})
 
     def explain(self, request_id: int) -> str:
-        return explain_events(self.events(), request_id)
+        return explain_events(
+            FlightRecord(self._ring, dropped=self.dropped,
+                         capacity=self.capacity), request_id)
 
     # -- export --
     def export(self, path: str) -> dict:
@@ -221,11 +230,34 @@ def events_from_record(record: dict) -> List[FlightEvent]:
             for e in record.get("events", [])]
 
 
-def load_flight_record(path: str) -> List[FlightEvent]:
+class FlightRecord(list):
+    """The loaded form of an export: a plain event list (full ``list``
+    behavior, so every pre-existing consumer indexes/iterates it
+    unchanged) that ALSO round-trips the export header — most
+    importantly ``dropped``.  A stitched fleet story must know when a
+    replica's ring overflowed: its missing early events are HOLES, not
+    absence, and ``explain_events`` warns instead of narrating a
+    partial lifecycle as if it were whole."""
+
+    def __init__(self, events=(), *, dropped: int = 0,
+                 capacity: Optional[int] = None, version: int = 1):
+        super().__init__(events)
+        self.dropped = int(dropped)
+        self.capacity = capacity
+        self.version = int(version)
+
+
+def load_flight_record(path: str) -> FlightRecord:
     """Inverse of ``FlightRecorder.export``: the event list (attrs as
-    plain dicts), in emission order."""
+    plain dicts) in emission order, as a :class:`FlightRecord` carrying
+    the header's ``dropped``/``capacity`` alongside."""
     with open(path) as f:
-        return events_from_record(json.load(f))
+        record = json.load(f)
+    return FlightRecord(
+        events_from_record(record),
+        dropped=int(record.get("dropped", 0)),
+        capacity=record.get("capacity"),
+        version=int(record.get("version", 1)))
 
 
 def _plural(n: int, noun: str) -> str:
@@ -243,11 +275,18 @@ def explain_events(events: List[FlightEvent], request_id: int) -> str:
 
     Returns a diagnostic string for unknown ids instead of raising —
     the CLI points this at arbitrary exports, and "not in this record
-    (ring dropped N events)" is the honest answer there."""
+    (ring dropped N events)" is the honest answer there.  When the
+    event list carries a ``dropped`` attribute (a loaded
+    :class:`FlightRecord`, or the live recorder via ``explain()``),
+    a non-zero drop count is surfaced in the rendering — an
+    overflowed ring's story has holes and must say so."""
+    dropped = int(getattr(events, "dropped", 0) or 0)
     tl = [e for e in events if e.request == request_id]
     if not tl:
+        note = (f"; the ring dropped "
+                f"{_plural(dropped, 'oldest event')}" if dropped else "")
         return (f"request {request_id}: no events in this record "
-                f"(wrong id, or the ring dropped them)")
+                f"(wrong id, or the ring dropped them)" + note)
     by_kind: Dict[str, List[FlightEvent]] = {}
     for e in tl:
         by_kind.setdefault(e.kind, []).append(e)
@@ -398,4 +437,8 @@ def explain_events(events: List[FlightEvent], request_id: int) -> str:
                     f"observed at step {e.step + flag}{extra}")
             else:
                 parts.append(f"{verb} at step {e.step}{extra}")
-    return f"request {request_id}: " + "; ".join(parts)
+    text = f"request {request_id}: " + "; ".join(parts)
+    if dropped:
+        text += (f" [ring dropped {_plural(dropped, 'oldest event')} — "
+                 f"the early story may have holes]")
+    return text

@@ -143,7 +143,8 @@ def _host_events_as_chrome(events) -> list:
 
 
 def merge_chrome_traces(out_path: str, host=None,
-                        device_trace_dir: Optional[str] = None) -> dict:
+                        device_trace_dir: Optional[str] = None,
+                        extra=None) -> dict:
     """Write one chrome/Perfetto JSON combining host spans and the
     jax.profiler device capture.
 
@@ -152,9 +153,14 @@ def merge_chrome_traces(out_path: str, host=None,
     ``device_trace_dir``: the ``Profiler.device_trace_dir`` /
     ``jax.profiler.start_trace`` directory; None or a dir without
     captures yields a host-only trace (still valid JSON).
+    ``extra``: already-formed chrome event dicts appended verbatim —
+    the hook fleet exports use to add one process lane per replica
+    (their own pids + process_name metadata) without re-implementing
+    the writer; callers own pid disjointness from the device range
+    (>= 1000).
 
     Returns summary counts: ``{"host_events", "device_events",
-    "device_processes", "path"}``.
+    "device_processes", "extra_events", "path"}``.
     """
     events = [{"ph": "M", "pid": 0, "name": "process_name",
                "args": {"name": "host (paddle_tpu.runtime.HostTracer)"}}]
@@ -175,6 +181,12 @@ def merge_chrome_traces(out_path: str, host=None,
     else:
         host_events = _host_events_as_chrome(host)
     events.extend(host_events)
+    n_extra = 0
+    if extra is not None:
+        for e in extra:
+            events.append(e)
+            if e.get("ph") != "M":
+                n_extra += 1
 
     n_dev = 0
     pid_map = {}
@@ -202,4 +214,5 @@ def merge_chrome_traces(out_path: str, host=None,
         json.dump({"traceEvents": events,
                    "displayTimeUnit": "ms"}, f)
     return {"host_events": len(host_events), "device_events": n_dev,
-            "device_processes": len(pid_map), "path": out_path}
+            "device_processes": len(pid_map), "extra_events": n_extra,
+            "path": out_path}

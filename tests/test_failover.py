@@ -323,8 +323,13 @@ def test_failover_combined_kill_with_migration(netm):
     assert all(e.attrs["fault"] == "kill" and e.attrs["engine"] == vi
                for e in fails)
     assert len(migrs) == 1 and migrs[0].request == hs[0].router_id
-    assert migrs[0].attrs == {"engine": 1 - vi, "src": vi,
-                              "blocks": vblocks}
+    assert {k: migrs[0].attrs[k]
+            for k in ("engine", "src", "blocks")} == \
+        {"engine": 1 - vi, "src": vi, "blocks": vblocks}
+    # the stitcher's correlation key: every router placement event
+    # names the engine-side id the destination replica assigned
+    assert "rid" in migrs[0].attrs
+    assert all("rid" in e.attrs for e in retries)
     assert len(retries) == len(affected) - 1
     assert {e.attrs["path"] for e in retries} <= {"recompute",
                                                  "requeue"}

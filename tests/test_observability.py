@@ -22,8 +22,9 @@ import paddle_tpu as paddle
 from paddle_tpu import models
 from paddle_tpu.inference.serving import ServingEngine
 from paddle_tpu.observability import (
-    MetricsRegistry, diff_snapshots, format_span_name, get_registry,
-    merge_chrome_traces, parse_span_name, span,
+    MetricsRegistry, TimeSeriesRecorder, diff_snapshots,
+    format_span_name, get_registry, merge_chrome_traces,
+    parse_span_name, span,
 )
 from paddle_tpu.profiler import Profiler, ProfilerTarget
 
@@ -242,6 +243,152 @@ def test_histogram_empty_and_single_bucket_edges():
     assert _quantile_from_buckets(0.5, (1.0,), [0, 0]) == 0.0
     assert _quantile_from_buckets(0.5, (), []) == 0.0
     assert _quantile_from_buckets(0.99, (1.0,), [0, 5]) == 1.0
+
+
+def test_diff_snapshots_fleet_edge_cases():
+    """Satellite (PR 17): ``diff_snapshots`` edges the fleet snapshot
+    merge leans on — histogram-delta quantiles computed from the
+    WINDOW's bucket deltas only, gauge hwm across empty / stale
+    windows (process-lifetime caveat), and counter/histogram resets
+    (a fresh registry after a crash replaces ``after``)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("t.lat", buckets=(0.1, 1.0, 10.0))
+    # pre-window mass lands entirely in the FIRST bucket...
+    for _ in range(100):
+        h.observe(0.05)
+    before = reg.snapshot()
+    # ...window mass entirely in the LAST finite bucket: quantiles of
+    # the delta must ignore the 100 earlier observations completely
+    for _ in range(4):
+        h.observe(5.0)
+    cell = diff_snapshots(before, reg.snapshot())["t.lat"]["values"][""]
+    assert cell["count"] == 4 and abs(cell["sum"] - 20.0) < 1e-9
+    assert 1.0 <= cell["p50"] <= 10.0
+    assert 1.0 <= cell["p99"] <= 10.0
+    # single-bucket histogram: delta quantiles interpolate in
+    # [0, bound] and never NaN on a one-observation window
+    regs = MetricsRegistry()
+    h1 = regs.histogram("t.one", buckets=(2.0,))
+    h1.observe(0.5)
+    b1 = regs.snapshot()
+    h1.observe(1.5)
+    c1 = diff_snapshots(b1, regs.snapshot())["t.one"]["values"][""]
+    assert c1["count"] == 1
+    assert 0.0 <= c1["p50"] <= 2.0 and 0.0 <= c1["p99"] <= 2.0
+
+    # gauge hwm: an EMPTY window (nothing moved) drops the gauge even
+    # though its level is nonzero — stale levels are never re-reported
+    regg = MetricsRegistry()
+    g = regg.gauge("t.depth")
+    g.set(10)
+    s0 = regg.snapshot()
+    assert diff_snapshots(s0, s0) == {}
+    # value returns to its pre-window level but the hwm moved: the
+    # window DID see activity and must report it (hwm 10 -> 12)
+    g.set(12)
+    g.set(10)
+    d = diff_snapshots(s0, regg.snapshot())
+    assert d["t.depth"] == {"type": "gauge", "values": {"": 10},
+                            "hwm": {"": 12}}
+    # process-lifetime caveat: a later window whose activity stayed
+    # BELOW the earlier peak still reports the old hwm of 12
+    s1 = regg.snapshot()
+    g.set(3)
+    d2 = diff_snapshots(s1, regg.snapshot())
+    assert d2["t.depth"]["values"][""] == 3
+    assert d2["t.depth"]["hwm"][""] == 12
+
+    # counter reset: ``after`` taken from a FRESH registry (crashed
+    # replica rejoining) sits below ``before`` — the delta goes
+    # negative rather than silently clamping, so reconciliation
+    # arithmetic stays exact and the reset is visible
+    rega = MetricsRegistry()
+    rega.counter("t.c").inc(9)
+    ba = rega.snapshot()
+    regb = MetricsRegistry()
+    regb.counter("t.c").inc(2)
+    assert diff_snapshots(ba, regb.snapshot())["t.c"]["values"][""] == -7
+    # histogram reset: the window's count delta is <= 0, and a
+    # quantile over negative bucket mass is meaningless — the cell
+    # drops entirely (same contract as an unmoved cell)
+    regh = MetricsRegistry()
+    hh = regh.histogram("t.h", buckets=(1.0,))
+    hh.observe(0.5)
+    hh.observe(0.5)
+    bh = regh.snapshot()
+    regh2 = MetricsRegistry()
+    regh2.histogram("t.h", buckets=(1.0,)).observe(0.5)
+    assert diff_snapshots(bh, regh2.snapshot()) == {}
+    # instruments present in ``before`` but absent from the fresh
+    # ``after`` drop out (diff iterates ``after``); absent from
+    # ``before`` count from zero
+    regf = MetricsRegistry()
+    regf.counter("t.new").inc(5)
+    df = diff_snapshots(ba, regf.snapshot())
+    assert df == {"t.new": {"type": "counter", "values": {"": 5}}}
+
+
+def _drive_timeseries(clock):
+    """One synthetic 10-step trace into a capacity-4 recorder —
+    deterministic modulo the injected wall clock."""
+    reg = MetricsRegistry()
+    c = reg.counter("t.tokens")
+    g = reg.gauge("t.depth")
+    h = reg.histogram("t.lat", buckets=(0.1, 1.0))
+    ts = TimeSeriesRecorder(reg, capacity=4, clock=clock)
+    g.set(100)                       # pre-window peak, dropped by ring
+    for step in range(10):
+        c.inc(3)
+        g.set(step)
+        h.observe(0.05 if step % 2 else 0.5)
+        ts.sample(step)
+    return reg, ts
+
+
+def test_timeseries_ring_overflow_determinism():
+    """Satellite (PR 17): ``TimeSeriesRecorder`` ring overflow drops
+    the OLDEST samples with honest accounting, window aggregates are
+    computed over the SURVIVING window only (gauge max = per-window
+    hwm, not the registry's process-lifetime hwm), and two identical
+    traces serialize byte-for-byte modulo wall."""
+    import itertools
+    wall = itertools.count(1000)
+    reg1, ts1 = _drive_timeseries(lambda: float(next(wall)))
+    reg2, ts2 = _drive_timeseries(time.perf_counter)
+
+    # overflow accounting: 10 samples into capacity 4 keeps the last
+    # 4 and counts the 6 evicted ones — never silently partial
+    assert len(ts1) == 4 and ts1.dropped == 6
+    assert ts1.steps() == [6, 7, 8, 9]
+    # cumulative storage: a dropped sample loses resolution, not mass
+    assert ts1.series("t.tokens") == [(6, 21), (7, 24), (8, 27), (9, 30)]
+    assert ts1.rates("t.tokens") == [(7, 3.0), (8, 3.0), (9, 3.0)]
+    agg = ts1.aggregates()
+    assert agg["first_step"] == 6 and agg["last_step"] == 9
+    assert agg["dropped"] == 6 and agg["samples"] == 4
+    tok = agg["instruments"]["t.tokens"]
+    assert tok["delta"][""] == 9                 # window delta, not 30
+    assert abs(tok["rate_per_step"][""] - 3.0) < 1e-9
+    # per-window gauge hwm: the pre-window peak of 100 was evicted
+    # with its ring slot — max reflects only surviving samples, while
+    # the registry hwm still remembers the process-lifetime peak
+    dep = agg["instruments"]["t.depth"]
+    assert dep["last"][""] == 9 and dep["min"][""] == 6
+    assert dep["max"][""] == 9
+    assert reg1.gauge("t.depth").hwm() == 100
+    # histogram window delta: the oldest surviving sample is the
+    # BASE, so the delta covers steps 7..9 (0.05 + 0.5 + 0.05)
+    lat = agg["instruments"]["t.lat"]["values"][""]
+    assert lat["count"] == 3 and abs(lat["sum"] - 0.6) < 1e-9
+
+    # replay determinism: different wall clocks, identical canonical
+    # form once the report-only wall is dropped...
+    j1 = json.dumps(ts1.to_dict(drop_wall=True), sort_keys=True)
+    j2 = json.dumps(ts2.to_dict(drop_wall=True), sort_keys=True)
+    assert j1 == j2
+    # ...and the wall-bearing forms differ (the clocks really ran)
+    assert (json.dumps(ts1.to_dict(), sort_keys=True)
+            != json.dumps(ts2.to_dict(), sort_keys=True))
 
 
 def test_span_name_roundtrip():
@@ -514,6 +661,7 @@ def test_metrics_name_lint_clean():
              "serving.lora.", "serving.fairshare.",
              "serving.router.", "serving.migrate.",
              "serving.weights.", "pallas.quantized_matmul.",
+             "serving.fleet.", "serving.alerts",
              "serving.tpot_seconds")), n
         assert n in names, n
     kinds = {r[3]: r[2] for r in regs}

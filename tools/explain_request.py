@@ -1,11 +1,11 @@
-"""Post-mortem one request's lifecycle from an exported flight record.
+"""Post-mortem one request's lifecycle from exported flight records.
 
 ``ServingEngine`` (with a ``FlightRecorder`` attached) records every
 lifecycle transition — submit/admit/prefix-hit/prefill-chunk/decode-
 block/spec-verify/preempt/swap/shed/timeout/cancel/finish — into a
 bounded ring; ``FlightRecorder.export(path)`` writes it as JSON.  This
-CLI answers "why was request N slow" from that file alone, in another
-process, with no engine or model state:
+CLI answers "why was request N slow" from those files alone, in
+another process, with no engine or model state:
 
     # one request's story
     python tools/explain_request.py record.json 7
@@ -15,6 +15,12 @@ process, with no engine or model state:
 
     # raw event timeline instead of the rendered sentence
     python tools/explain_request.py record.json 7 --timeline
+
+    # FLEET post-mortem: per-replica records (list order = replica
+    # index) stitched with the router's record — request ids become
+    # router-global, the story crosses failover hops
+    python tools/explain_request.py rep0.json rep1.json 7 \
+        --router router.json --timeline
 
 Exit code 0 on success, 1 on a missing/garbled record or an id with no
 events (the wrong-id message still prints — it names the ring-drop
@@ -32,6 +38,8 @@ import sys
 # sys.path via this shim) and via import machinery in tests
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from paddle_tpu.observability.fleet import (  # noqa: E402
+    ROUTER_LANE, stitch_flight_records)
 from paddle_tpu.observability.flightrec import (  # noqa: E402
     ENGINE_EVENT, events_from_record, explain_events)
 
@@ -48,6 +56,10 @@ def _fmt_timeline(events, request_id) -> str:
         lag = attrs.pop("lag", None)
         joined = " ".join(f"{k}={v}" for k, v in attrs.items())
         line = f"  step {e.step:>5}  {e.kind:<14} {joined}".rstrip()
+        rep = getattr(e, "replica", None)
+        if rep is not None:
+            line += (f"  [on router]" if rep == ROUTER_LANE
+                     else f"  [on replica {rep}]")
         if lag and e.kind == "finish":
             # the finish-bitmap poll (depth >= 2 pipelines): the row
             # finished on device at the stamped step; the host saw it
@@ -64,34 +76,60 @@ def _fmt_timeline(events, request_id) -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="explain_request",
-        description="Explain request lifecycles from an exported "
-                    "flight record (FlightRecorder.export JSON).")
-    ap.add_argument("record", help="path to the exported flight record")
-    ap.add_argument("request_id", nargs="?", type=int, default=None,
-                    help="request to explain (default: all in the record)")
+        description="Explain request lifecycles from exported flight "
+                    "records (FlightRecorder.export JSON). Several "
+                    "records stitch into one fleet story (list order "
+                    "= replica index; pass the router's record via "
+                    "--router).")
+    ap.add_argument("records", nargs="+",
+                    help="exported flight record path(s); a trailing "
+                         "integer is taken as the request id")
+    ap.add_argument("--router", default=None, metavar="PATH",
+                    help="the ROUTER's exported flight record — "
+                         "re-keys replica events onto router-global "
+                         "ids when stitching")
     ap.add_argument("--timeline", action="store_true",
                     help="print the raw per-request event timeline "
                          "instead of the rendered explanation")
     args = ap.parse_args(argv)
 
+    # backward-compatible positional request id: the original CLI was
+    # ``explain_request.py record.json 7`` — argparse cannot split
+    # "files then maybe an int" itself, so peel a trailing integer off
+    paths = list(args.records)
+    request_id = None
+    if len(paths) > 1:
+        try:
+            request_id = int(paths[-1])
+        except ValueError:
+            pass
+        else:
+            paths = paths[:-1]
+
+    stitched = len(paths) > 1 or args.router is not None
     try:
-        with open(args.record) as f:
-            record = json.load(f)
-        events = events_from_record(record)
+        if stitched:
+            record = stitch_flight_records(paths, router=args.router)
+            events = record.events
+            dropped = record.dropped_total
+        else:
+            with open(paths[0]) as f:
+                raw = json.load(f)
+            events = events_from_record(raw)
+            dropped = int(raw.get("dropped", 0))
     except (OSError, ValueError, KeyError) as e:
-        print(f"explain_request: cannot read {args.record!r}: {e}",
+        print(f"explain_request: cannot read record(s): {e}",
               file=sys.stderr)
         return 1
-    dropped = int(record.get("dropped", 0))
     if dropped:
         print(f"note: the ring dropped {dropped} oldest event(s) — "
               f"early lifecycles may be partial")
 
-    if args.request_id is not None:
-        ids = [args.request_id]
+    if request_id is not None:
+        ids = [request_id]
     else:
         ids = sorted({e.request for e in events
-                      if e.request != ENGINE_EVENT})
+                      if e.request != ENGINE_EVENT and e.request >= 0})
         if not ids:
             print("explain_request: record holds no request events",
                   file=sys.stderr)
@@ -105,9 +143,10 @@ def main(argv=None) -> int:
             if not tl:
                 rc = 1
         else:
-            text = explain_events(events, rid)
+            text = (record.explain(rid) if stitched
+                    else explain_events(events, rid))
             print(text)
-            if "no events in this record" in text:
+            if "no events in" in text:
                 rc = 1
     return rc
 

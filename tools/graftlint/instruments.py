@@ -174,6 +174,16 @@ REQUIRED_INSTRUMENTS = {
     "serving.router.failover.readmissions": ("counter", ()),
     "serving.migrate.blocks": ("counter", ()),
     "serving.migrate.bytes": ("counter", ()),
+    # fleet observability plane (PR 17, observability/fleet.py
+    # _MonitorInstruments + inference/router.py _RouterInstruments):
+    # the SLO burn-rate monitor's windowed per-tenant gauge, its
+    # closed-vocabulary alert counter (ALERT_KINDS — the vocab pass
+    # keeps it closed and alive), the monitor's own liveness counter,
+    # and the fleet_snapshot() call counter
+    "serving.slo.burn_rate": ("gauge", ("tenant",)),
+    "serving.alerts": ("counter", ("kind",)),
+    "serving.fleet.monitor_steps": ("counter", ()),
+    "serving.fleet.snapshots": ("counter", ()),
 }
 
 

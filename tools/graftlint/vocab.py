@@ -76,6 +76,10 @@ VOCABS: Tuple[VocabSpec, ...] = (
     # pallas.quantized_matmul.route counter can carry flows through the
     # _qmm_route_reason producer's literal returns
     VocabSpec("QMM_ROUTE_REASONS", producers=("_qmm_route_reason",)),
+    # fleet monitor alerts (PR 17, observability/fleet.py): every
+    # alert kind has a literal serving.alerts{kind=...} inc site in
+    # SLOBurnRateMonitor.observe
+    VocabSpec("ALERT_KINDS"),
 )
 
 
@@ -139,6 +143,9 @@ MATCHERS: Tuple[Matcher, ...] = (
             methods=frozenset({"inc"}), kwarg="path"),
     Matcher("PROBE_OUTCOMES", receivers=frozenset({"probes"}),
             methods=frozenset({"inc"}), kwarg="outcome"),
+    # fleet alerts (SLOBurnRateMonitor): serving.alerts{kind=...}
+    Matcher("ALERT_KINDS", receivers=frozenset({"alerts"}),
+            methods=frozenset({"inc"}), kwarg="kind"),
 )
 
 

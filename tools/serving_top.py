@@ -1,0 +1,233 @@
+"""Render a ``Router.fleet_snapshot()`` as a terminal fleet dashboard.
+
+The snapshot is pure JSON-ready data (per-replica registry snapshots
+merged under a ``replica=`` label, health states, ``load_report()``s,
+router stats, and — when attached — the SLO monitor's summary and the
+time-series window aggregates), so this CLI is a PURE FUNCTION over
+it: ``render(snapshot) -> str`` needs no live engine, which is what
+makes it testable in tier-1 and usable as a post-mortem viewer over a
+snapshot file somebody saved during an incident.
+
+    # live-ish: dump a snapshot from your driver, then
+    python tools/serving_top.py snapshot.json
+
+    # machine check (tier-1 smoke): parse + validate + render, rc 0/1
+    python tools/serving_top.py --check snapshot.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+# runnable both as ``python tools/serving_top.py`` (repo root on
+# sys.path via this shim) and via import machinery in tests
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_HEALTH_MARK = {"healthy": "+", "probation": "~", "unhealthy": "!"}
+
+
+def _fmt_pct(x: float) -> str:
+    return f"{100.0 * x:.1f}%"
+
+
+def render(snapshot: dict) -> str:
+    """The fleet dashboard text for one snapshot dict — deterministic
+    (sorted tenants/kinds, no clock reads), so two renders of one
+    snapshot are byte-identical."""
+    lines: List[str] = []
+    n = int(snapshot.get("engines", 0))
+    health = list(snapshot.get("health", []))
+    marks = "".join(_HEALTH_MARK.get(h, "?") for h in health)
+    lines.append(f"fleet @ step {snapshot.get('step', '?')} — "
+                 f"{n} replica{'' if n == 1 else 's'} [{marks}]")
+
+    reports = snapshot.get("load_reports", [])
+    if reports:
+        lines.append("")
+        lines.append(f"  {'rep':>3} {'health':<10} {'queue':>5} "
+                     f"{'active':>6} {'swapped':>7} {'blocks':>13} "
+                     f"{'kv':>8}")
+        for i, r in enumerate(reports):
+            st = health[i] if i < len(health) else "?"
+            blocks = (f"{r.get('blocks_in_use', 0)}/"
+                      f"{r.get('blocks_total', 0)}")
+            lines.append(
+                f"  {i:>3} {st:<10} {r.get('queue_depth', 0):>5} "
+                f"{r.get('active_slots', 0):>6} "
+                f"{r.get('swapped_waiting', 0):>7} {blocks:>13} "
+                f"{str(r.get('kv_cache_dtype', '?')):>8}")
+
+    router = snapshot.get("router", {})
+    if router:
+        routed = router.get("routed_by_reason", {})
+        routed_txt = " ".join(f"{k}={routed[k]}" for k in sorted(routed)
+                              if routed[k])
+        lines.append("")
+        lines.append(
+            f"  router: held={router.get('queue_depth', 0)} "
+            f"requests={router.get('requests', 0)} "
+            f"shed={router.get('shed', 0)} "
+            f"timeouts={router.get('timeouts', 0)}"
+            + (f"  routed[{routed_txt}]" if routed_txt else ""))
+        if router.get("failover"):
+            lines.append(
+                f"  failover: faults={router.get('replica_faults', 0)} "
+                f"recovered={router.get('failover_requests', 0)} "
+                f"failed={router.get('failed', 0)} "
+                f"migrated_blocks={router.get('migrated_blocks', 0)} "
+                f"probes={router.get('probes', 0)}")
+
+    mon = snapshot.get("monitor")
+    if mon:
+        lines.append("")
+        lines.append(f"  slo target={mon.get('slo_target')} "
+                     f"window={mon.get('window_steps')} steps "
+                     f"burn_threshold={mon.get('burn_threshold')}")
+        budgets = mon.get("budget", {})
+        for tenant in sorted(mon.get("burn_rate", {})):
+            burn = mon["burn_rate"][tenant]
+            b = budgets.get(tenant, {})
+            flag = " <-- BURNING" if burn >= float(
+                mon.get("burn_threshold", 1.0)) else ""
+            lines.append(
+                f"    tenant {tenant}: burn={burn:.2f}x "
+                f"attained={b.get('attained', 0)} "
+                f"missed={b.get('missed', 0)} "
+                f"budget_consumed={_fmt_pct(b.get('consumed', 0.0))}"
+                f"{flag}")
+        by_kind = mon.get("alerts_by_kind", {})
+        if by_kind:
+            kinds = " ".join(f"{k}={by_kind[k]}"
+                             for k in sorted(by_kind))
+            lines.append(f"  alerts: {kinds}")
+            for a in mon.get("alerts", [])[-5:]:
+                ctx = " ".join(f"{k}={v}" for k, v in sorted(a.items())
+                               if k not in ("kind", "step"))
+                lines.append(f"    step {a.get('step', '?'):>5} "
+                             f"{a.get('kind', '?'):<18} {ctx}".rstrip())
+
+    ts = snapshot.get("timeseries")
+    if ts and ts.get("instruments"):
+        lines.append("")
+        lines.append(
+            f"  window: {ts.get('samples', 0)} samples over "
+            f"{ts.get('steps', 0)} steps "
+            f"(steps {ts.get('first_step', '?')}.."
+            f"{ts.get('last_step', '?')}"
+            + (f", {ts['dropped']} dropped" if ts.get("dropped")
+               else "") + ")")
+        insts = ts["instruments"]
+        for name in sorted(insts):
+            inst = insts[name]
+            if inst.get("type") == "counter":
+                for lk in sorted(inst.get("rate_per_step", {})):
+                    lines.append(
+                        f"    {name}{{{lk}}} "
+                        f"+{inst['delta'][lk]} "
+                        f"({inst['rate_per_step'][lk]:.2f}/step)")
+            elif inst.get("type") == "gauge":
+                for lk in sorted(inst.get("last", {})):
+                    lines.append(
+                        f"    {name}{{{lk}}} "
+                        f"last={inst['last'][lk]} "
+                        f"min={inst['min'].get(lk)} "
+                        f"max={inst['max'].get(lk)}")
+            elif inst.get("type") == "histogram":
+                for lk, c in sorted(inst.get("values", {}).items()):
+                    lines.append(
+                        f"    {name}{{{lk}}} n={c['count']} "
+                        f"p50={c['p50']:.4g} p95={c['p95']:.4g} "
+                        f"p99={c['p99']:.4g}")
+
+    regs = snapshot.get("registries", {})
+    if regs:
+        cells = sum(len(inst.get("values", {}))
+                    for inst in regs.values())
+        lines.append("")
+        lines.append(f"  registries: {len(regs)} fleet instruments, "
+                     f"{cells} labeled cells "
+                     f"(replica=<i> federation labels)")
+    return "\n".join(lines)
+
+
+def check(snapshot: dict) -> List[str]:
+    """Structural validation of a fleet snapshot: the problems list
+    (empty = valid).  Checks shape only — values are the fleet's
+    business."""
+    problems: List[str] = []
+    if not isinstance(snapshot, dict):
+        return ["snapshot is not a JSON object"]
+    for key in ("engines", "health", "registries", "load_reports",
+                "router"):
+        if key not in snapshot:
+            problems.append(f"missing key {key!r}")
+    n = snapshot.get("engines")
+    health = snapshot.get("health", [])
+    reports = snapshot.get("load_reports", [])
+    if isinstance(n, int):
+        if len(health) != n:
+            problems.append(
+                f"health has {len(health)} entries for {n} engines")
+        if len(reports) != n:
+            problems.append(
+                f"load_reports has {len(reports)} entries for "
+                f"{n} engines")
+    for h in health:
+        if h not in ("healthy", "probation", "unhealthy"):
+            problems.append(f"unknown health state {h!r}")
+    regs = snapshot.get("registries", {})
+    if not isinstance(regs, dict):
+        problems.append("registries is not a dict")
+    else:
+        for name, inst in regs.items():
+            if not isinstance(inst, dict) or "type" not in inst \
+                    or "values" not in inst:
+                problems.append(
+                    f"registry entry {name!r} lacks type/values")
+            elif inst.get("labels", [None])[0:1] != ["replica"]:
+                problems.append(
+                    f"registry entry {name!r} is not replica-labeled")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="serving_top",
+        description="Render a Router.fleet_snapshot() JSON dump as a "
+                    "fleet dashboard (pure function over the file — "
+                    "no live engine needed).")
+    ap.add_argument("snapshot", help="path to the fleet snapshot JSON")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the snapshot's structure and render "
+                         "it; rc 0 when both succeed (the tier-1 "
+                         "smoke mode)")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.snapshot) as f:
+            snapshot = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"serving_top: cannot read {args.snapshot!r}: {e}",
+              file=sys.stderr)
+        return 1
+    if args.check:
+        problems = check(snapshot)
+        if problems:
+            for p in problems:
+                print(f"serving_top: invalid snapshot: {p}",
+                      file=sys.stderr)
+            return 1
+        render(snapshot)          # must not raise on a valid snapshot
+        print(f"serving_top: ok ({snapshot.get('engines', '?')} "
+              f"replicas @ step {snapshot.get('step', '?')})")
+        return 0
+    print(render(snapshot))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
