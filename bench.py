@@ -2468,6 +2468,53 @@ def _bench_serving(on_tpu):
         "gate_disabled_under_2pct": bool(t_disabled < 0.02 * t_step),
     }
 
+    # -- multichip arm (``multichip`` sub-object, PR 18): the mesh-
+    # sharded serving dryrun, MULTICHIP_r*-shaped — re-exec this file
+    # as a child with xla_force_host_platform_device_count=8 (the
+    # parent's device topology is whatever it is; the dryrun always
+    # gets 8 virtual host devices) and gate ONLY on the deterministic
+    # counters the child ships back: tensor-parallel decode is
+    # token-exact and dispatch-count-identical to single-chip, the
+    # sharded route overlay really advanced, data-parallel shard-group
+    # replicas behind the Router stay token-exact across the topology
+    # change, and the fleet surfaces the expected shard-group labels.
+    # tokens/s scaling and per-replica occupancy are REPORT-ONLY
+    # walls (this box is jitter-bound per ROADMAP).
+    import os as _os
+    import subprocess
+    import sys as _sys
+    _env = dict(_os.environ)
+    _env["XLA_FLAGS"] = (_env.get("XLA_FLAGS", "")
+                         + " --xla_force_host_platform_device_count=8"
+                         ).strip()
+    _env["JAX_PLATFORMS"] = "cpu"
+    try:
+        _proc = subprocess.run(
+            [_sys.executable, _os.path.abspath(__file__),
+             "--serving-multichip-child"],
+            capture_output=True, text=True, timeout=900, env=_env)
+        if _proc.returncode != 0:
+            raise RuntimeError(
+                f"child rc={_proc.returncode}: {_proc.stderr[-300:]}")
+        mc = json.loads(_proc.stdout.strip().splitlines()[-1])
+        multichip = {
+            "devices": mc["devices"],
+            "tp": mc["tp"],
+            "dp": mc["dp"],
+            "gate_tp_token_exact": bool(mc["tp"]["token_exact"]),
+            "gate_tp_dispatch_parity": bool(
+                mc["tp"]["dispatch_parity"]),
+            "gate_sharded_route": bool(
+                mc["tp"]["sharded_ok_delta"] > 0),
+            "gate_dp_token_exact": bool(mc["dp"]["token_exact"]),
+            "gate_shard_groups": bool(
+                mc["dp"]["shard_groups"] == ["tp2@d0", "tp2@d2"]),
+            # report-only: wall-derived throughput scaling
+            "dp_scaling": mc["dp"]["scaling"],
+        }
+    except Exception as e:                      # keep the bench JSON whole
+        multichip = {"error": str(e)[:300]}
+
     return {
         "tokens_per_s": cont["tokens_per_s"],
         "p50_latency_ms": cont["p50_latency_ms"],
@@ -2518,6 +2565,7 @@ def _bench_serving(on_tpu):
         "router": router_ab,
         "failover": failover_ab,
         "fleet_obs": fleet_obs_ab,
+        "multichip": multichip,
         "spec": {
             "k": sp_k, "max_new": sp_new, "n_requests": sp_n,
             "tokens_per_s": spec_on["tokens_per_s"],
@@ -2574,5 +2622,131 @@ def _bench_serving(on_tpu):
     }
 
 
+def _serving_multichip_child():
+    """The ``multichip`` arm's dryrun body (see ``_bench_serving``):
+    runs in a CHILD process whose XLA_FLAGS force 8 virtual host
+    devices, so the mesh-sharded serving path executes a real 8-device
+    SPMD program regardless of the parent's platform.  Prints ONE JSON
+    line.  Three phases:
+
+    - tensor-parallel A/B: one combined trace (chunked prefill +
+      spec-decode verify + greedy decode) through a single-chip engine
+      and a ``mesh=tp2`` engine — token streams, dispatch counts and
+      the ``sharded_ok`` route-counter delta ship back as gate inputs;
+    - data-parallel scaling: the same wider trace through a 1-replica
+      and a 2-replica Router (each replica a tp2 shard group on its
+      own device pair) — outputs must stay token-exact across the
+      routing change (greedy rows; the host plan is topology-blind),
+      walls/occupancy ship back report-only;
+    - fleet identity: the 2-replica ``fleet_snapshot()`` shard-group
+      labels."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import models
+    from paddle_tpu.distributed.topology import build_mesh
+    from paddle_tpu.inference.router import Router
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    paddle.seed(18)
+    devs = jax.devices()
+    cfg = models.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64)
+    net = models.LlamaForCausalLM(cfg)
+    net.eval()
+    rng = np.random.default_rng(18)
+
+    def mk(mesh=None):
+        return ServingEngine(
+            net, num_slots=2, prompt_len=8, max_cache_len=32,
+            steps_per_call=2, block_len=4, num_blocks=24, chunk_len=4,
+            compute_dtype="float32",
+            registry=obs_metrics.MetricsRegistry(), mesh=mesh)
+
+    route = obs_metrics.get_registry().counter(
+        "pallas.decode_attention.route", labels=("decision", "reason"))
+
+    def shard_hits():
+        return (route.value(decision="pallas", reason="sharded_ok")
+                + route.value(decision="xla", reason="sharded_ok"))
+
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            (int(n),)).astype(np.int32)
+               for n in rng.integers(4, 9, 6)]
+    # the spec-decode row repeats a 3-gram so the prompt-lookup
+    # drafter has a chance to propose; its longer budget leaves
+    # k_eff room if the greedy stream cycles
+    pat = rng.integers(0, cfg.vocab_size, (3,)).astype(np.int32)
+    prompts[2] = np.concatenate([pat, pat, pat[:1]])
+    news = [4, 5, 8, 6, 4, 5]
+
+    def tp_trace(eng):
+        t0 = time.perf_counter()
+        hs = [eng.submit(p, max_new_tokens=m,
+                         spec_decode=(2 if i == 2 else None),
+                         arrival_time=0.0)
+              for i, (p, m) in enumerate(zip(prompts, news))]
+        eng.run()
+        wall = time.perf_counter() - t0
+        s = eng.stats()
+        return [h.output.tolist() for h in hs], wall, {
+            "block_dispatches": s["block_dispatches"],
+            "prefill_chunks": s["prefill_chunks"],
+            "verify_steps": s["spec_verify_steps"],
+            "prefills": s["prefills"],
+            "finished": s["finished"],
+        }
+
+    out1, wall1, c1 = tp_trace(mk())
+    base_hits = shard_hits()
+    out2, wall2, c2 = tp_trace(
+        mk(mesh=build_mesh(mp=2, devices=devs[:2])))
+
+    def dp_trace(n_replicas):
+        engs = [mk(mesh=build_mesh(mp=2, devices=devs[2 * i:2 * i + 2]))
+                for i in range(n_replicas)]
+        rt = Router(engs, registry=obs_metrics.MetricsRegistry())
+        t0 = time.perf_counter()
+        hs = [rt.submit(p, max_new_tokens=m, arrival_time=0.0)
+              for p, m in zip(prompts, news)]
+        rt.run()
+        wall = time.perf_counter() - t0
+        toks = sum(len(h.output) for h in hs)
+        return ([h.output.tolist() for h in hs], toks / max(wall, 1e-9),
+                [e.stats()["mean_slot_occupancy"] for e in engs],
+                rt.fleet_snapshot()["shard_groups"])
+
+    dp1_out, dp1_tps, _occ1, _sg1 = dp_trace(1)
+    dp2_out, dp2_tps, occ2, sg2 = dp_trace(2)
+
+    print(json.dumps({
+        "devices": len(devs),
+        "tp": {
+            "token_exact": out1 == out2,
+            "dispatch_parity": c1 == c2,
+            "sharded_ok_delta": shard_hits() - base_hits,
+            "counts": c1,
+            "single_wall_ms": round(1e3 * wall1, 1),
+            "tp2_wall_ms": round(1e3 * wall2, 1),
+        },
+        "dp": {
+            "replicas": 2,
+            "token_exact": dp1_out == dp2_out and dp1_out == out1,
+            "tokens_per_s": round(dp2_tps, 1),
+            "one_replica_tokens_per_s": round(dp1_tps, 1),
+            "scaling": round(dp2_tps / max(dp1_tps, 1e-9), 3),
+            "per_replica_occupancy": [round(o, 3) for o in occ2],
+            "shard_groups": sg2,
+        },
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+    if "--serving-multichip-child" in _sys.argv:
+        _serving_multichip_child()
+    else:
+        main()

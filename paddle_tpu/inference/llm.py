@@ -26,9 +26,10 @@ cache tensors.  TPU formulation:
 
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
-from typing import List, Optional
+from typing import List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -286,6 +287,47 @@ def build_slot_prefill(model, max_cache_len, cfg: GenerationConfig):
     return slot_prefill_pure
 
 
+class ArenaSharding(NamedTuple):
+    """Mesh recipe for tensor-parallel paged serving: every arena plane
+    (float K/V, int8 codes, AND their f32 scale planes) shards its
+    LAST axis — kv-heads; ``Hkv*D`` for packed planes, ``Hkv`` for
+    scales — over the mesh's ``model`` axis, so one ``NamedSharding``
+    covers all of them and each shard owns ``Hkv / n_shards`` whole
+    heads (the engine enforces the divisibility).  Block tables,
+    token/length/done planes and sampling state stay replicated: the
+    byte-deterministic host plan is the SAME program input on every
+    shard, which is what keeps scheduling identical to single-chip.
+    ``n_shards`` rides along so trace-time code (the kernel route
+    gate) can report the shard geometry without re-deriving it from
+    the sharding object."""
+    kv: object        # jax.sharding.NamedSharding over the arena axes
+    n_shards: int
+
+
+def _shard_scope(shard):
+    """Trace-time marker: inside this scope the paged kernel gates
+    report the ``sharded_ok``/``mesh_geom`` route overlay (see
+    ``ops/pallas/decode_attention.shard_dispatch_scope``).  A ``None``
+    shard is the single-chip build — no scope, no overlay counters."""
+    if shard is None:
+        return contextlib.nullcontext()
+    from ..ops.pallas import decode_attention as _da
+    return _da.shard_dispatch_scope(shard.n_shards)
+
+
+def _constrain_arenas(flat, shard):
+    """Pin every arena plane to the shard recipe inside a traced
+    program (``with_sharding_constraint``): on the way IN it makes
+    GSPMD propagation decisive through the scan carry, on the way OUT
+    it guarantees the donated round-trip keeps the input sharding
+    (donation only reuses buffers when in/out layouts match — an
+    unconstrained output that propagated to replicated would silently
+    re-shard every dispatch).  No-op for single-chip builds."""
+    if shard is None:
+        return list(flat)
+    return [jax.lax.with_sharding_constraint(a, shard.kv) for a in flat]
+
+
 def _pack_paged_kvs(flat_arenas, tables, kv_int8):
     """Per-layer kv entries from the engine's flat arena list: the
     (k, v, tables) triple of the float cache, or the
@@ -308,7 +350,7 @@ def _flatten_paged_kvs(kvs):
 def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call,
                               kv_int8=False,
                               samp_flags=(False, False, False, False),
-                              lora=False, wq=None):
+                              lora=False, wq=None, shard=None):
     """Paged twin of ``_build_decode_block``: the cache is the shared
     block arena plus per-slot block tables instead of per-slot
     contiguous rows.  The tables ride into the scan closure as a
@@ -364,16 +406,19 @@ def _build_paged_decode_block(model, cfg: GenerationConfig, steps_per_call,
     sampled, _filtered, penalty, _bias = samp_flags
 
     def _scan(tok, lens, done, budget, samp, tables, flat_arenas):
-        kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
+        kvs = _pack_paged_kvs(_constrain_arenas(flat_arenas, shard),
+                              tables, kv_int8)
         pos0 = samp["pos"] if sampled else jnp.zeros_like(lens)
         pres0 = samp["presence"] if penalty else None
-        (tok_f, lens_f, kvs_f, _pos_f, _pres_f, done_f, budget_f), \
-            toks = jax.lax.scan(
-                sampled_decode_scan_body(model, cfg, samp, samp_flags),
-                (tok, lens, kvs, pos0, pres0, done, budget),
-                None, length=steps_per_call)
+        with _shard_scope(shard):
+            (tok_f, lens_f, kvs_f, _pos_f, _pres_f, done_f, budget_f), \
+                toks = jax.lax.scan(
+                    sampled_decode_scan_body(model, cfg, samp, samp_flags),
+                    (tok, lens, kvs, pos0, pres0, done, budget),
+                    None, length=steps_per_call)
         return ((toks.T.astype(jnp.int32), tok_f, lens_f, done_f,
-                 budget_f) + tuple(_flatten_paged_kvs(kvs_f)))
+                 budget_f) + tuple(_constrain_arenas(
+                     _flatten_paged_kvs(kvs_f), shard)))
 
     if lora:
         def block_pure(p_values, tok, lens, done, budget, samp,
@@ -423,7 +468,7 @@ def build_fused_decode_window(model, cfg: GenerationConfig,
         model, cfg, int(steps_per_iter) * int(iters), **build_kw)
 
 
-def build_swap_out_gather():
+def build_swap_out_gather(shard=None):
     """Swap-out reader for the host-RAM block tier (ServingEngine):
     gather a row of block ids out of EVERY arena in one compiled call
     — ``(ids [W], *flat_arenas) -> tuple of [W, ...] row stacks``.
@@ -440,11 +485,12 @@ def build_swap_out_gather():
     finite garbage the resume scatter routes straight back to the
     trash row."""
     def gather_pure(ids, *flat_arenas):
-        return tuple(jnp.take(a, ids, axis=0) for a in flat_arenas)
+        return tuple(jnp.take(a, ids, axis=0)
+                     for a in _constrain_arenas(flat_arenas, shard))
     return gather_pure
 
 
-def build_swap_in_scatter(n_arenas):
+def build_swap_in_scatter(n_arenas, shard=None):
     """Donation-matched re-scatter for host-RAM -> arena restores:
     write saved block rows into freshly allocated arena rows —
     ``(ids [W], *rows (n_arenas of [W, ...]), *flat_arenas) ->
@@ -460,15 +506,16 @@ def build_swap_in_scatter(n_arenas):
     overwrite finite garbage with finite garbage."""
     def scatter_pure(ids, *rows_and_arenas):
         rows = rows_and_arenas[:n_arenas]
-        arenas = rows_and_arenas[n_arenas:]
-        return tuple(a.at[ids].set(r.astype(a.dtype))
-                     for a, r in zip(arenas, rows))
+        arenas = _constrain_arenas(rows_and_arenas[n_arenas:], shard)
+        return tuple(_constrain_arenas(
+            [a.at[ids].set(r.astype(a.dtype))
+             for a, r in zip(arenas, rows)], shard))
     return scatter_pure
 
 
 def build_chunk_prefill(model, cfg: GenerationConfig, kv_int8=False,
                         samp_flags=(False, False, False, False),
-                        lora=False, wq=None):
+                        lora=False, wq=None, shard=None):
     """Chunked-prefill program for the paged ServingEngine: ONE prompt
     chunk of ONE sequence (batch-1; the static chunk length is the ids
     shape) computed at global positions ``start .. start+C-1``, K/V
@@ -512,11 +559,14 @@ def build_chunk_prefill(model, cfg: GenerationConfig, kv_int8=False,
     penalty = samp_flags[2]
 
     def _chunk(ids, start, n_valid, tables, samp, flat_arenas):
-        kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
-        logits, kvs_f = model.prefill_chunk(ids, start, n_valid, kvs)
+        kvs = _pack_paged_kvs(_constrain_arenas(flat_arenas, shard),
+                              tables, kv_int8)
+        with _shard_scope(shard):
+            logits, kvs_f = model.prefill_chunk(ids, start, n_valid, kvs)
         tok = sample_rows(logits, samp, samp_flags,
                           samp["presence"] if penalty else None)
-        return (tok,) + tuple(_flatten_paged_kvs(kvs_f))
+        return (tok,) + tuple(_constrain_arenas(
+            _flatten_paged_kvs(kvs_f), shard))
 
     if lora:
         def chunk_pure(p_values, ids, start, n_valid, tables, samp,

@@ -844,12 +844,18 @@ class Router:
             # rid = the engine-side id the replica assigned: the
             # binding the fleet stitcher uses to re-key that replica's
             # events onto this router-global id (no global clock)
+            # shard-group identity rides the route event (PR 18): a
+            # mesh replica's label (e.g. "tp2@d0"), "single" for a
+            # single-chip engine — the fleet stitcher narrates which
+            # shard group served the request without a second probe
+            sg = getattr(self._engines[ei], "shard_group", None)
             self._fr.emit(
                 "route", pr.router_id, self._step_idx, engine=ei,
                 affinity=int(ptok), adapter_hit=int(ahit),
                 policy=(pr.policy if pr.policy is not None
                         else "default"),
-                reason=reason, rid=req.request_id)
+                reason=reason, rid=req.request_id,
+                shard=(sg["label"] if sg is not None else "single"))
         self._m.queue_depth.set(len(self._queue))
 
     # -- failover: health model, recovery, probation --
@@ -1279,6 +1285,14 @@ class Router:
             "health": list(self._health),
             "registries": obs_fleet.merge_registry_snapshots(pairs),
             "load_reports": [e.load_report() for e in self._engines],
+            # per-replica shard-group identity (PR 18): "single" for
+            # plain engines, the mesh label ("tp2@d0", "rep@d4") for
+            # shard groups — the fleet's data-parallel topology at a
+            # glance, same order as load_reports/health
+            "shard_groups": [
+                (sg["label"] if (sg := getattr(e, "shard_group",
+                                               None)) is not None
+                 else "single") for e in self._engines],
             "router": self.stats(),
         }
         if self._monitor is not None:

@@ -239,10 +239,11 @@ from ..observability import metrics as obs_metrics
 from ..observability.flightrec import ENGINE_EVENT, FlightRecorder
 from ..observability.spans import instant as _span_instant
 from ..observability.spans import span as _span
-from .llm import (_build_paged_decode_block, build_chunk_prefill,
-                  build_fused_decode_window, build_swap_in_scatter,
-                  build_swap_out_gather, build_weight_quant_plan,
-                  normalize_weight_dtype)
+from ..ops.pallas import decode_attention as _decode_attn
+from .llm import (ArenaSharding, _build_paged_decode_block,
+                  build_chunk_prefill, build_fused_decode_window,
+                  build_swap_in_scatter, build_swap_out_gather,
+                  build_weight_quant_plan, normalize_weight_dtype)
 from .prefixcache import HostTier, RadixPrefixCache
 from .sampling import (MASK_BIAS, SamplingParams, base_key, flags_of,
                        row_planes)
@@ -587,6 +588,16 @@ class _ServingInstruments:
             "packed int4 nibbles — plus f32 scale planes).  The "
             "weight-side twin of serving.kv.bytes_swept and the "
             "roofline denominator of the weight_quant bench arm")
+        self.shard_groups = r.gauge(
+            "serving.shard.groups",
+            "1 per engine serving as a tensor-parallel shard group "
+            "over a device mesh, 0 for single-chip engines — a fleet "
+            "registry's sum counts its live shard groups")
+        self.shard_width = r.gauge(
+            "serving.shard.width",
+            "kv-head tensor-parallel degree of this engine's paged "
+            "arenas (shards per group; 1 = single-chip or the "
+            "replicated mesh_geom fallback)")
         self.weights_quant_dtype = r.gauge(
             "serving.weights.quant_dtype",
             "1 for each weight at-rest dtype an engine in this process "
@@ -1313,7 +1324,7 @@ class ServingEngine:
                  registry=None, max_queue=None, enable_preemption=True,
                  fault_injector=None, flight_recorder=None,
                  async_dispatch=True, async_depth=1,
-                 adapter_store=None, tenant_weights=None):
+                 adapter_store=None, tenant_weights=None, mesh=None):
         self.num_slots = int(num_slots)
         self.max_queue = None if max_queue is None else int(max_queue)
         if self.max_queue is not None and self.max_queue < 1:
@@ -1463,6 +1474,52 @@ class ServingEngine:
         self._arenas: List = []
         for entry in arenas:
             self._arenas += list(entry)
+        # -- tensor-parallel serving over a device mesh (PR 18) --
+        # ``mesh=Mesh(...)`` shards every arena plane's kv-head axis
+        # (codes [NB+1, L, Hkv*D] and int8 scales [NB+1, L, Hkv] both
+        # shard axis 2) over the mesh's ``model`` axis and replicates
+        # the params, so the paged decode/verify/chunk programs
+        # partition per-head under GSPMD while block tables, token/
+        # length/done carries and sampling planes stay replicated host
+        # inputs — the byte-deterministic plan drives all shards
+        # unchanged, which is what keeps a sharded engine scheduling-
+        # identical (and, with per-request keyed PRNG, token-exact) to
+        # single-chip.  Sharding is pjit annotations ONLY (no
+        # shard_map — unavailable in this environment, see the
+        # pre-existing F-cluster) so no new sync reason exists.  A
+        # geometry that cannot split whole kv-heads (hkv % n_shards
+        # != 0, or a 1-wide model axis) falls back to the exact
+        # single-chip engine and says so once on the route counter
+        # (decision="xla", reason="mesh_geom").
+        self._shard = None
+        self.shard_group = None
+        if mesh is not None:
+            if "model" not in mesh.axis_names:
+                raise ValueError(
+                    f"ServingEngine(mesh=...) shards kv-heads over the "
+                    f"mesh's 'model' axis; got axes {mesh.axis_names}")
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as _P
+            n_sh = int(mesh.shape["model"])
+            devs = [int(dv.id) for dv in mesh.devices.flat]
+            tp_ok = n_sh > 1 and hkv % n_sh == 0
+            if tp_ok:
+                kv_ns = NamedSharding(mesh, _P(None, None, "model"))
+                self._shard = ArenaSharding(kv=kv_ns, n_shards=n_sh)
+                rep = NamedSharding(mesh, _P())
+                self._arenas = [jax.device_put(a, kv_ns)
+                                for a in self._arenas]
+                self._pb = [jax.device_put(v, rep) for v in self._pb]
+            else:
+                _decode_attn.count_shard_route(hkv, n_sh, False)
+            self.shard_group = {
+                "n_shards": n_sh if tp_ok else 1,
+                "requested": n_sh,
+                "sharded": tp_ok,
+                "devices": devs,
+                "label": (f"tp{n_sh}@d{devs[0]}" if tp_ok
+                          else f"rep@d{devs[0]}"),
+            }
         # modeled per-row KV sweep bytes across all layers, at the
         # Pallas kernels' block-DMA granularity (serving.kv.bytes_swept)
         row_bytes = 2 * hkv * d * (1 if self._kv_int8
@@ -1607,6 +1664,9 @@ class ServingEngine:
         self._m.slot_occupancy.set(0)
         self._m.blocks_free.set(self.num_blocks)
         self._m.blocks_in_use.set(0)
+        self._m.shard_groups.set(1 if self.shard_group is not None else 0)
+        self._m.shard_width.set(self._shard.n_shards
+                                if self._shard is not None else 1)
         self._peak_queue = 0
         self._peak_blocks = 0
         # per-request flight recorder: every lifecycle transition emits
@@ -2990,14 +3050,15 @@ class ServingEngine:
     # -- preemption + host-RAM swap --
     def _swap_out(self):
         if self._swap_out_fn is None:
-            self._swap_out_fn = jax.jit(build_swap_out_gather())
+            self._swap_out_fn = jax.jit(
+                build_swap_out_gather(shard=self._shard))
         return self._swap_out_fn
 
     def _swap_in(self):
         if self._swap_in_fn is None:
             n = len(self._arenas)
             self._swap_in_fn = jax.jit(
-                build_swap_in_scatter(n),
+                build_swap_in_scatter(n, shard=self._shard),
                 donate_argnums=tuple(range(1 + n, 1 + 2 * n)))
         return self._swap_in_fn
 
@@ -3876,7 +3937,7 @@ class ServingEngine:
                 build_chunk_prefill(self._model, self.cfg,
                                     kv_int8=self._kv_int8,
                                     samp_flags=flags, lora=lora_on,
-                                    wq=self._wq),
+                                    wq=self._wq, shard=self._shard),
                 donate_argnums=self._lora_donate(lora_on))
             self._chunk_fns[(flags, lora_on)] = fn
         return fn
@@ -3895,12 +3956,12 @@ class ServingEngine:
                 build = build_fused_decode_window(
                     self._model, self.cfg, steps // iters, iters,
                     kv_int8=self._kv_int8, samp_flags=flags,
-                    lora=lora_on, wq=self._wq)
+                    lora=lora_on, wq=self._wq, shard=self._shard)
             else:
                 build = _build_paged_decode_block(
                     self._model, self.cfg, steps,
                     kv_int8=self._kv_int8, samp_flags=flags,
-                    lora=lora_on, wq=self._wq)
+                    lora=lora_on, wq=self._wq, shard=self._shard)
             fn = jax.jit(
                 build,
                 donate_argnums=self._lora_donate(lora_on,
@@ -3942,7 +4003,7 @@ class ServingEngine:
                 build_spec_verify(self._model, self.cfg, steps,
                                   kv_int8=self._kv_int8,
                                   samp_flags=flags, lora=lora_on,
-                                  wq=self._wq),
+                                  wq=self._wq, shard=self._shard),
                 donate_argnums=self._lora_donate(lora_on))
             self._verify_fns[(steps, flags, lora_on)] = fn
         return fn
@@ -4689,6 +4750,11 @@ class ServingEngine:
                       if self._radix is not None else None),
             "kv_cache_dtype": self.kv_cache_dtype,
             "weight_dtype": self.weight_dtype,
+            # shard-group identity (PR 18): None for single-chip
+            # engines; a mesh engine reports its tensor-parallel
+            # geometry so the router's fleet_snapshot()/stats() carry
+            # which shard group served what without a second probe
+            "shard_group": self.shard_group,
         }
 
     def prefix_match(self, prompt_ids) -> int:

@@ -226,7 +226,7 @@ def accept_drafts(greedy_row, drafts,
 
 def build_spec_verify(model, cfg, steps: int, kv_int8: bool = False,
                       samp_flags=(False, False, False, False),
-                      lora=False, wq=None):
+                      lora=False, wq=None, shard=None):
     """The compiled verifier program: ONE target forward scores
     ``steps`` positions per slot (the last emitted token plus up to
     ``steps - 1`` draft candidates) against the paged KV arena.
@@ -285,7 +285,8 @@ def build_spec_verify(model, cfg, steps: int, kv_int8: bool = False,
         raise ValueError(
             "token-mask constrained decoding cannot ride a verify "
             "forward (mask state is host-side and per emitted token)")
-    from .llm import _flatten_paged_kvs, _pack_paged_kvs, _param_swapper
+    from .llm import (_constrain_arenas, _flatten_paged_kvs,
+                      _pack_paged_kvs, _param_swapper, _shard_scope)
     from .sampling import spec_greedy_rows, spec_sampling_draws
     from ..models.lora import gather_lora, lora_context
 
@@ -293,16 +294,20 @@ def build_spec_verify(model, cfg, steps: int, kv_int8: bool = False,
     sampled, _filtered, penalty, _bias = samp_flags
 
     def _verify(toks, lens, n_valid, tables, samp, flat_arenas):
-        kvs = _pack_paged_kvs(flat_arenas, tables, kv_int8)
-        logits, kvs_f = model.verify_step(toks, lens, n_valid, kvs)
+        kvs = _pack_paged_kvs(_constrain_arenas(flat_arenas, shard),
+                              tables, kv_int8)
+        with _shard_scope(shard):
+            logits, kvs_f = model.verify_step(toks, lens, n_valid, kvs)
         pres = samp["presence"] if penalty else None
+        flat_f = tuple(_constrain_arenas(_flatten_paged_kvs(kvs_f),
+                                         shard))
         if sampled:
             draws = spec_sampling_draws(logits, toks, samp,
                                         samp_flags, pres)
-            return draws + tuple(_flatten_paged_kvs(kvs_f))
+            return draws + flat_f
         greedy = spec_greedy_rows(logits, toks, samp, samp_flags,
                                   pres)
-        return (greedy,) + tuple(_flatten_paged_kvs(kvs_f))
+        return (greedy,) + flat_f
 
     if lora:
         def verify_pure(p_values, toks, lens, n_valid, tables, samp,

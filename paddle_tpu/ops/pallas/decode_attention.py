@@ -38,6 +38,7 @@ CONTIGUOUS in lanes (head h at lane offset h*D).  Then:
 
 from __future__ import annotations
 
+import contextlib
 import functools
 
 import jax
@@ -46,6 +47,78 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ._common import on_tpu, pallas_enabled
+
+# The closed label vocabulary of the ``pallas.decode_attention.route``
+# counter's ``reason`` axis (graftlint DECODE_ROUTE_REASONS; the
+# runtime guard is ``_count_route``).  The ``*_ok`` entries mean the
+# Pallas kernel dispatched; everything else names the disqualifier
+# that sent the call to the XLA fallback.  ``sharded_ok``/``mesh_geom``
+# are the mesh-sharded serving overlay (``shard_dispatch_scope``):
+# recorded IN ADDITION to the kernel decision, they prove a paged
+# program traced with its kv-head shard geometry accepted
+# (``sharded_ok``) or fell back to replicated arenas (``mesh_geom``).
+DECODE_ROUTE_REASONS = (
+    "ok", "paged_ok", "paged_int8_ok", "paged_multi_ok",
+    "paged_multi_int8_ok", "sharded_ok", "mesh_geom",
+    "flag_disabled", "pallas_unavailable", "unpacked_cache",
+    "dtype_mismatch", "scales_mismatch", "geometry", "int8_geom",
+    "group_too_wide", "seq_align", "paged_block_len", "query_rows",
+    "vmem_budget",
+)
+
+
+class ShardedTableError(TypeError):
+    """A paged dispatch received a block table committed with a
+    non-replicated device sharding.  Block tables are HOST scheduling
+    state: the byte-deterministic plan drives every kv-head shard with
+    ONE replicated table, and the Pallas kernels scalar-prefetch it
+    whole — a partitioned table would silently index a different
+    arena row per shard.  Shard the ARENAS (``ServingEngine(mesh=)``),
+    never the tables."""
+
+
+# mesh-sharded serving overlay (module-scoped, set at TRACE time by the
+# serving builders): the kv-head shard count the paged arenas are
+# partitioned over, or None outside a sharded serving program.  Not
+# thread-local — tracing is synchronous under the builder call.
+_SHARD_N = None
+
+
+@contextlib.contextmanager
+def shard_dispatch_scope(n_shards: int):
+    """Mark the enclosed trace as a mesh-sharded serving program: every
+    paged route decision additionally records the shard-overlay reason
+    (``sharded_ok``/``mesh_geom``) for its kv-head geometry — the
+    deterministic route-counter proof that the sharded path actually
+    dispatched (one count per compiled paged program, the same
+    trace-time discipline as the kernel decision itself)."""
+    global _SHARD_N
+    prev = _SHARD_N
+    _SHARD_N = int(n_shards)
+    try:
+        yield
+    finally:
+        _SHARD_N = prev
+
+
+def _shard_route_reason(hkv: int, n_shards: int) -> str:
+    """Producer of the shard-overlay route reasons: ``sharded_ok`` when
+    the kv heads divide evenly over the shard axis (each shard owns
+    whole heads — the partitioned math is per-head-identical to the
+    replicated program), ``mesh_geom`` when they do not (the engine
+    keeps the arenas replicated over the mesh instead)."""
+    if n_shards > 1 and hkv % n_shards == 0:
+        return "sharded_ok"
+    return "mesh_geom"
+
+
+def count_shard_route(hkv: int, n_shards: int, use_pallas: bool):
+    """Record one shard-overlay route decision (see
+    ``shard_dispatch_scope``; also called once at engine init when the
+    mesh geometry forces the replicated fallback)."""
+    _count_route("pallas" if use_pallas else "xla",
+                 _shard_route_reason(hkv, n_shards))
+
 
 _LANES = 128
 DEFAULT_CHUNK = 256            # cache slots per DMA chunk
@@ -232,13 +305,23 @@ def _route_counter():
     return _route_counter_inst
 
 
+def _count_route(decision: str, reason: str):
+    """ONE emit site for the route counter, guarding the closed reason
+    vocabulary at runtime (the graftlint vocab pass cannot resolve the
+    tuple-returning gate functions, so the closure is enforced here)."""
+    if reason not in DECODE_ROUTE_REASONS:
+        raise ValueError(
+            f"unknown decode-attention route reason {reason!r} — "
+            f"known: {DECODE_ROUTE_REASONS}")
+    _route_counter().inc(decision=decision, reason=reason)
+
+
 def should_use_pallas(q4, cache) -> bool:
     use, reason = _route_decision(q4, cache)
     # counted at trace/gate time (once per compiled program or direct
     # query, not per device step): the always-on Pallas-fallback-rate
     # signal the bench JSON and Prometheus scrape expose
-    _route_counter().inc(decision="pallas" if use else "xla",
-                         reason=reason)
+    _count_route("pallas" if use else "xla", reason)
     return use
 
 
@@ -266,8 +349,9 @@ def _route_decision_paged(q4, arena, tables, kv_scales=None):
 
 def should_use_pallas_paged(q4, arena, tables, kv_scales=None) -> bool:
     use, reason = _route_decision_paged(q4, arena, tables, kv_scales)
-    _route_counter().inc(decision="pallas" if use else "xla",
-                         reason=reason)
+    _count_route("pallas" if use else "xla", reason)
+    if _SHARD_N is not None:
+        count_shard_route(q4.shape[1], _SHARD_N, use)
     return use
 
 
@@ -305,8 +389,9 @@ def should_use_pallas_paged_multi(q5, arena, tables,
                                   kv_scales=None) -> bool:
     use, reason = _route_decision_paged_multi(q5, arena, tables,
                                               kv_scales)
-    _route_counter().inc(decision="pallas" if use else "xla",
-                         reason=reason)
+    _count_route("pallas" if use else "xla", reason)
+    if _SHARD_N is not None:
+        count_shard_route(q5.shape[2], _SHARD_N, use)
     return use
 
 
@@ -872,6 +957,27 @@ def _decode_attention_pallas(q4, k_cache, v_cache, lens, chunk=None):
     )(lens.astype(jnp.int32), qcat, k_cache, v_cache)
 
 
+def _guard_replicated_tables(tables):
+    """The paged dispatch path assumes block tables are replicated host
+    plan state (the scalar-prefetched table must be WHOLE on every
+    shard).  A concrete committed array carrying a partitioned sharding
+    is the one way that assumption can silently break — reject it with
+    a typed error.  Tracers are skipped: under a serving trace the
+    table is a fresh per-dispatch host push whose (replicated) layout
+    the builders control."""
+    if isinstance(tables, jax.core.Tracer) \
+            or not isinstance(tables, jax.Array):
+        return
+    sharding = getattr(tables, "sharding", None)
+    if sharding is not None and not sharding.is_fully_replicated:
+        raise ShardedTableError(
+            f"paged decode dispatch requires a REPLICATED block table; "
+            f"got one committed with {sharding} — block tables are "
+            f"host scheduling state driven identically on every "
+            f"kv-head shard (shard the arenas via ServingEngine(mesh=), "
+            f"never the tables)")
+
+
 def _paged_dispatch(kernel, qcat, operands, tables, lens, *, b, hkv, d,
                     q_rows, out_rows, gw, ng, s, n_blocks_max):
     """Shared grid-spec + dispatch body of the four paged wrappers
@@ -883,6 +989,7 @@ def _paged_dispatch(kernel, qcat, operands, tables, lens, *, b, hkv, d,
     in the arena dtype for the code arenas, (s, H_kv) f32 for scale
     planes) and an n_blocks_max-deep DMA semaphore array, in operand
     order — matching the scratch signature of every paged kernel."""
+    _guard_replicated_tables(tables)
     w = operands[0].shape[2]
     land = [pltpu.VMEM((s, w), operands[0].dtype),
             pltpu.VMEM((s, w), operands[1].dtype)]

@@ -86,6 +86,7 @@ def _net(**kw):
 # GenerationMixin.generate(num_beams=k)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_beam_matches_numpy_reference():
     cfg, net = _net()
     rng = np.random.default_rng(0)

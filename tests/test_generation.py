@@ -40,6 +40,7 @@ def test_greedy_matches_full_recompute():
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow
 def test_ragged_prompts_match_per_sequence():
     cfg, net = _net()
     rng = np.random.default_rng(1)

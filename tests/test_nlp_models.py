@@ -118,6 +118,7 @@ def test_gpt_train_step_reduces_loss():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_gpt_generate_with_kv_cache():
     """GenerationMixin contract: generate returns the NEW tokens [B, N]
     from one compiled prefill+scan over the static cache, and must

@@ -662,6 +662,7 @@ def test_metrics_name_lint_clean():
              "serving.router.", "serving.migrate.",
              "serving.weights.", "pallas.quantized_matmul.",
              "serving.fleet.", "serving.alerts",
+             "serving.shard.", "pallas.decode_attention.route",
              "serving.tpot_seconds")), n
         assert n in names, n
     kinds = {r[3]: r[2] for r in regs}

@@ -1,6 +1,7 @@
 """OCR model family tests (det DBNet + rec CRNN, BASELINE config 4)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import models, optimizer
@@ -21,6 +22,7 @@ def test_dbnet_train_and_eval_shapes():
     assert v.min() >= 0.0 and v.max() <= 1.0  # sigmoid output
 
 
+@pytest.mark.slow  # tier-1 budget: training-loop compile is the cost
 def test_dbnet_loss_decreases():
     m = models.DBNet(models.DBNetConfig(backbone_scale=0.25,
                                         fpn_channels=32))
@@ -53,6 +55,7 @@ def test_db_postprocess_finds_box():
     assert score > 0.6
 
 
+@pytest.mark.slow  # tier-1 budget: LSTM train-step compile is the cost
 def test_crnn_forward_and_ctc_training():
     cfg = models.CRNNConfig(num_classes=12, hidden_size=32, image_height=32)
     m = models.CRNN(cfg)

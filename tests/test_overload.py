@@ -107,6 +107,7 @@ def _assert_combined_parity(net, cfg, kvdt):
     return eng
 
 
+@pytest.mark.slow
 def test_preempt_swap_resume_parity_float(netm):
     """Forced preempt -> host-RAM swap -> resume is token-exact on the
     float arena with spec-decode and seeded sampling active in the

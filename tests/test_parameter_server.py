@@ -295,6 +295,7 @@ def test_sharded_sparse_embedding_trains():
         s2.stop()
 
 
+@pytest.mark.slow  # tier-1 budget: second cold subprocess; e2e launch test stays tier-1
 def test_launch_two_servers(tmp_path):
     import subprocess, sys, textwrap, os as _os
     script = tmp_path / "ps2_job.py"

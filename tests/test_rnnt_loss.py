@@ -97,6 +97,7 @@ def test_ragged_lengths():
                                    rtol=1e-5, err_msg=f"batch {b}")
 
 
+@pytest.mark.slow  # tier-1 budget: FD probe loop re-executes the loss many times
 def test_gradient_finite_difference():
     rng = np.random.default_rng(2)
     B, T, U, V = 1, 3, 2, 4

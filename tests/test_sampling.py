@@ -147,6 +147,7 @@ def test_filter_top_k_top_p_math():
 # the seeded-determinism engine trace (ONE engine shape)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_seeded_sampling_determinism_trace(netm):
     """The acceptance contract in one set of same-shape engines:
     a request's sampled stream is a pure function of (seed, prompt) —

@@ -339,6 +339,7 @@ def test_submit_failure_after_prefix_probe_unpins(netm, monkeypatch):
     assert eng._pool.available() == avail0
 
 
+@pytest.mark.slow
 def test_int8_kv_parity_trace_and_scheduling(netm):
     """The int8-KV acceptance contract on one compact mixed trace: an
     engine with ``kv_cache_dtype="int8"`` must make IDENTICAL
@@ -918,3 +919,19 @@ def test_bench_llm_serving_section():
     assert fo["gate_migrated_blocks_exact"]
     assert fo["gate_retries_exact"]
     assert fo["reference"]["replica_faults"] == 0
+    # PR 18: the multichip arm — 8-virtual-device child process,
+    # deterministic counter gates only (tp token-exact + dispatch
+    # parity + sharded-route proof, dp token-exact across the
+    # topology change, exact shard-group labels); scaling/occupancy
+    # walls report-only
+    mcp = out["multichip"]
+    assert "error" not in mcp, mcp.get("error")
+    assert mcp["devices"] == 8
+    assert mcp["gate_tp_token_exact"]
+    assert mcp["gate_tp_dispatch_parity"]
+    assert mcp["gate_sharded_route"]
+    assert mcp["gate_dp_token_exact"]
+    assert mcp["gate_shard_groups"]
+    assert mcp["dp"]["shard_groups"] == ["tp2@d0", "tp2@d2"]
+    for k in ("scaling", "tokens_per_s", "per_replica_occupancy"):
+        assert k in mcp["dp"], k

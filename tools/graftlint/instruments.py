@@ -184,6 +184,15 @@ REQUIRED_INSTRUMENTS = {
     "serving.alerts": ("counter", ("kind",)),
     "serving.fleet.monitor_steps": ("counter", ()),
     "serving.fleet.snapshots": ("counter", ()),
+    # mesh-sharded serving (PR 18, inference/serving.py
+    # _ServingInstruments + ops/pallas/decode_attention.py): the
+    # shard-group presence/width gauges the multichip bench arm and
+    # fleet_snapshot() key on, and the kernel route counter whose
+    # sharded_ok/mesh_geom reasons (DECODE_ROUTE_REASONS) prove the
+    # tensor-parallel paged path actually dispatched
+    "serving.shard.groups": ("gauge", ()),
+    "serving.shard.width": ("gauge", ()),
+    "pallas.decode_attention.route": ("counter", ("decision", "reason")),
 }
 
 

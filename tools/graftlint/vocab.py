@@ -80,6 +80,15 @@ VOCABS: Tuple[VocabSpec, ...] = (
     # alert kind has a literal serving.alerts{kind=...} inc site in
     # SLOBurnRateMonitor.observe
     VocabSpec("ALERT_KINDS"),
+    # paged flash-decode routing reasons (PR 18,
+    # ops/pallas/decode_attention.py): every reason the
+    # pallas.decode_attention.route counter can carry — the gate/
+    # dispatch reasons are string literals threaded into _count_route
+    # through non-literal locals the lint cannot chase (dead=False),
+    # and the sharded-dispatch overlay flows through the
+    # _shard_route_reason producer's literal returns
+    VocabSpec("DECODE_ROUTE_REASONS", dead=False,
+              producers=("_shard_route_reason",)),
 )
 
 
