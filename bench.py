@@ -2515,6 +2515,145 @@ def _bench_serving(on_tpu):
     except Exception as e:                      # keep the bench JSON whole
         multichip = {"error": str(e)[:300]}
 
+    # -- multiproc arm (``multiproc`` sub-object, PR 19): REAL
+    # EngineProcess children behind SocketTransport proxies, the
+    # failover-arm kill trace with an actual process death — the
+    # victim child arms FaultInjector.exit_at_step and os._exit()s
+    # mid-trace, which the parent only sees as a dead socket
+    # (TransportDeadError -> the PR-15 failover paths).  Gated ONLY on
+    # deterministic counters: socket outputs token-exact vs an
+    # in-process reference built from the SAME factory, migration
+    # moved exactly the victim's staged parcel, and the per-replica
+    # frame counts (by kind) are equal across two reruns of the whole
+    # trace — the frame-sequence determinism contract.  Walls (spawn,
+    # rpc) are REPORT-ONLY: sockets are slow/bench-only by design.
+    try:
+        from paddle_tpu.inference.procserve import (EngineProcess,
+                                                    TCPStoreLite,
+                                                    tiny_llama_engine)
+        from paddle_tpu.inference.transport import (RemoteReplica,
+                                                    SocketTransport)
+
+        mp_rng = np.random.default_rng(29)
+        mp_prompts = [mp_rng.integers(1, 128, (int(n),)).astype(np.int32)
+                      for n in mp_rng.integers(6, 12, 4)]
+        mp_new = 8
+        _FACTORY = "paddle_tpu.inference.procserve:tiny_llama_engine"
+        # the victim (child 0) force-swaps its first request at step 6
+        # (parking it via always-failing allocs so the parcel stays
+        # staged on the client), then dies for real two steps later
+        _FAULT = {"force_swap_rid": 0, "force_swap_step": 6,
+                  "park_allocs": True, "exit_at_step": 8}
+
+        def _mp_reference():
+            engs = [tiny_llama_engine() for _ in range(2)]
+            rt = Router(engs, registry=obs_metrics.MetricsRegistry())
+            hs = [rt.submit(p, max_new_tokens=mp_new,
+                            arrival_time=0.0) for p in mp_prompts]
+            for _ in range(400):
+                rt.step(now=0.0)
+                if all(h.state in ("finished", "failed")
+                       for h in hs):
+                    break
+            return [np.asarray(h.output) for h in hs]
+
+        def _mp_socket_trace():
+            store_addr, closer = TCPStoreLite.serve()
+            procs, reps = [], []
+            try:
+                for i in range(2):
+                    kw = {"fault_spec": _FAULT} if i == 0 else {}
+                    procs.append(EngineProcess(
+                        f"mp{i}", _FACTORY, kw, store_addr))
+                t0 = time.perf_counter()
+                reps = [RemoteReplica(SocketTransport(
+                            p, registry=obs_metrics.MetricsRegistry(),
+                            rpc_timeout_s=300.0)) for p in procs]
+                t_handshake = time.perf_counter() - t0
+                rt = Router(reps,
+                            registry=obs_metrics.MetricsRegistry())
+                hs = [rt.submit(p, max_new_tokens=mp_new,
+                                arrival_time=0.0)
+                      for p in mp_prompts]
+                vblocks = 0
+                for _ in range(400):
+                    rt.step(now=0.0)
+                    for h in hs:
+                        if h.state == "swapped" \
+                                and h._req.swap is not None:
+                            vblocks = h._req.swap.n_blocks
+                    if all(h.state in ("finished", "failed")
+                           for h in hs):
+                        break
+                wall = time.perf_counter() - t0
+                rs = rt.stats()
+                return {
+                    "outs": [np.asarray(h.output) for h in hs],
+                    "frames": [r.transport_stats()["frames"]
+                               for r in reps],
+                    "bytes_out": [r.transport_stats()["bytes_out"]
+                                  for r in reps],
+                    "replica_faults": rs["replica_faults"],
+                    "failover_requests": rs["failover_requests"],
+                    "migrated_blocks": rs["migrated_blocks"],
+                    "migrated_bytes": rs["migrated_bytes"],
+                    "victim_parcel_blocks": int(vblocks),
+                    "victim_gen": procs[0].gen,
+                    "completion": sum(h.state == "finished"
+                                      for h in hs) / len(hs),
+                    "handshake_ms": round(1e3 * t_handshake, 1),
+                    "wall_ms": round(1e3 * wall, 1),
+                }
+            finally:
+                for r in reps:
+                    try:
+                        r._t.close()
+                    except Exception:
+                        pass
+                for p in procs:
+                    p.kill()
+                closer()
+
+        mp_ref_outs = _mp_reference()
+        mp_a = _mp_socket_trace()
+        mp_b = _mp_socket_trace()
+        multiproc = {
+            "replicas": 2, "n_requests": len(mp_prompts),
+            "max_new": mp_new,
+            "replica_faults": mp_a["replica_faults"],
+            "failover_requests": mp_a["failover_requests"],
+            "migrated_blocks": mp_a["migrated_blocks"],
+            "migrated_bytes": mp_a["migrated_bytes"],
+            "victim_parcel_blocks": mp_a["victim_parcel_blocks"],
+            "frames_by_kind": mp_a["frames"],
+            # a real process died (the supervisor respawned it as
+            # generation 1) and every request still completed
+            # token-for-token equal to the no-fault in-process
+            # reference; migration moved exactly the victim's parcel
+            "gate_token_exact": bool(
+                mp_a["completion"] == 1.0
+                and all(np.array_equal(a, b) for a, b in
+                        zip(mp_ref_outs, mp_a["outs"]))),
+            "gate_real_process_death": bool(
+                mp_a["victim_gen"] >= 1
+                and mp_a["replica_faults"] >= 1),
+            "gate_migrated_blocks_exact": bool(
+                mp_a["victim_parcel_blocks"] > 0
+                and mp_a["migrated_blocks"]
+                == mp_a["victim_parcel_blocks"]),
+            # frame counts per kind equal across two full reruns —
+            # deterministic sequences, not byte totals (payload floats
+            # may format differently), though bytes are reported
+            "gate_frames_deterministic": bool(
+                mp_a["frames"] == mp_b["frames"]),
+            # report-only walls
+            "handshake_ms": mp_a["handshake_ms"],
+            "wall_ms": [mp_a["wall_ms"], mp_b["wall_ms"]],
+            "bytes_out": mp_a["bytes_out"],
+        }
+    except Exception as e:                      # keep the bench JSON whole
+        multiproc = {"error": str(e)[:300]}
+
     return {
         "tokens_per_s": cont["tokens_per_s"],
         "p50_latency_ms": cont["p50_latency_ms"],
@@ -2566,6 +2705,7 @@ def _bench_serving(on_tpu):
         "failover": failover_ab,
         "fleet_obs": fleet_obs_ab,
         "multichip": multichip,
+        "multiproc": multiproc,
         "spec": {
             "k": sp_k, "max_new": sp_new, "n_requests": sp_n,
             "tokens_per_s": spec_on["tokens_per_s"],

@@ -24,6 +24,12 @@ from .speculative import (Drafter, ModelDrafter,  # noqa: F401
 from .lora import AdapterStore, LoraAdapter  # noqa: F401
 from .router import (HEALTH_STATES, ROUTER_POLICIES,  # noqa: F401
                      RoutedRequest, Router)
+from .transport import (FRAME_KINDS, LoopbackTransport,  # noqa: F401
+                        RemoteReplica, SocketTransport,
+                        TransportDeadError, TransportError,
+                        WIRE_VERSION)
+from .procserve import (EngineHost, EngineProcess,  # noqa: F401
+                        TCPStoreLite)
 
 __all__ = ["Config", "Predictor", "create_predictor", "LLMPredictor",
            "Request", "ServingEngine", "TokenStream", "Drafter",
@@ -32,4 +38,7 @@ __all__ = ["Config", "Predictor", "create_predictor", "LLMPredictor",
            "PoisonedDispatchError", "FaultInjector", "HostTier",
            "RadixPrefixCache", "AdapterStore", "LoraAdapter",
            "Router", "RoutedRequest", "ROUTER_POLICIES",
-           "HEALTH_STATES"]
+           "HEALTH_STATES", "FRAME_KINDS", "WIRE_VERSION",
+           "LoopbackTransport", "SocketTransport", "RemoteReplica",
+           "TransportError", "TransportDeadError", "EngineHost",
+           "EngineProcess", "TCPStoreLite"]

@@ -101,6 +101,7 @@ class FaultInjector:
         self._kill_at: Optional[int] = None     # latched from this step
         self._poison_at: Optional[int] = None   # one-shot from this step
         self._stall_forever = False             # latched until cleared
+        self._exit_at: Optional[int] = None     # process death (PR 19)
         self.events: List[Tuple[str, Optional[int]]] = []
 
     # -- arming (test side) --
@@ -173,6 +174,21 @@ class FaultInjector:
         if int(step) < 1:
             raise ValueError(f"step must be >= 1, got {step}")
         self._poison_at = int(step)
+
+    def exit_at_step(self, step: int):
+        """Arm a REAL process death at scheduler step ``step``: the
+        process-side serving host (``procserve.EngineHost``) consumes
+        this with ``take_exit`` before dispatching the step and dies
+        with ``os._exit`` — no teardown, no exception, no goodbye
+        frame.  The engine itself never sees the fault: unlike
+        ``kill_at_step`` (an in-process stand-in for a crash), this IS
+        the crash, and the parent router only learns of it as a dead
+        socket (``TransportDeadError``).  Deterministic by
+        construction — the death lands on a chosen scheduler step, not
+        on a parent-side kill racing the child's event loop."""
+        if int(step) < 1:
+            raise ValueError(f"step must be >= 1, got {step}")
+        self._exit_at = int(step)
 
     def stall_forever(self):
         """Make EVERY ``step()`` raise ``EngineStalledError``
@@ -273,6 +289,15 @@ class FaultInjector:
                 and int(step_idx) >= self._poison_at:
             self._poison_at = None
             self.events.append(("poison", None))
+            return True
+        return False
+
+    def take_exit(self, step_idx: int) -> bool:
+        """True when the serving host should ``os._exit`` BEFORE
+        running this step (latched — though the process is normally
+        gone after the first True)."""
+        if self._exit_at is not None and int(step_idx) >= self._exit_at:
+            self.events.append(("exit", None))
             return True
         return False
 

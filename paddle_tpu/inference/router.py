@@ -849,13 +849,20 @@ class Router:
             # single-chip engine — the fleet stitcher narrates which
             # shard group served the request without a second probe
             sg = getattr(self._engines[ei], "shard_group", None)
+            # transport identity rides the route event (PR 19) only
+            # when the replica IS remote — local engines keep their
+            # PR-12 event shape byte-identical (the loopback-identity
+            # contract compares attrs minus this key)
+            tk = getattr(self._engines[ei], "transport_kind", None)
+            extra = {} if tk is None else {"transport": tk}
             self._fr.emit(
                 "route", pr.router_id, self._step_idx, engine=ei,
                 affinity=int(ptok), adapter_hit=int(ahit),
                 policy=(pr.policy if pr.policy is not None
                         else "default"),
                 reason=reason, rid=req.request_id,
-                shard=(sg["label"] if sg is not None else "single"))
+                shard=(sg["label"] if sg is not None else "single"),
+                **extra)
         self._m.queue_depth.set(len(self._queue))
 
     # -- failover: health model, recovery, probation --
@@ -932,6 +939,8 @@ class Router:
         eng.crash_reset()
         for k in [k for k in self._by_engine if k[0] == ei]:
             del self._by_engine[k]
+        tk = getattr(eng, "transport_kind", None)
+        textra = {} if tk is None else {"transport": tk}
         for rec in recs:
             h = rec["handle"]
             path = ("migrate" if rec["parcel"] is not None else
@@ -939,7 +948,7 @@ class Router:
             rec["path"] = path
             rec["src"] = ei
             self._fr.emit("fail", h.router_id, self._step_idx,
-                          engine=ei, fault=fault)
+                          engine=ei, fault=fault, **textra)
             if not self.failover or h.retries >= self.retry_budget:
                 if rec["parcel"] is not None:
                     self._stage.drop(rec["parcel"]["skey"])
@@ -948,7 +957,7 @@ class Router:
                 self._m.failover_failed.inc()
                 self._fr.emit("fail", h.router_id, self._step_idx,
                               engine=ei, fault=fault, terminal=1,
-                              retries=h.retries)
+                              retries=h.retries, **textra)
                 out.append(h)
                 continue
             h.retries += 1
@@ -1271,11 +1280,18 @@ class Router:
         renders it without a live engine."""
         self._m.fleet_snapshots.inc()
         # dedupe shared registries: each distinct registry is merged
-        # once, labeled with every replica index it serves
+        # once, labeled with every replica index it serves.  Identity
+        # is the registry's stable ``dedupe_key`` when it has one —
+        # under remote replicas every snapshot fetch materializes a
+        # FRESH shim/dict, so ``id()`` would split one shared server
+        # registry into N "distinct" ones and double-count its
+        # counters (the PR-19 bugfix); ``id()`` stays as the fallback
+        # for bare registries that predate the key
         by_reg: dict = {}
         for i, e in enumerate(self._engines):
             reg = e.metrics_registry
-            by_reg.setdefault(id(reg), [reg, []])[1].append(str(i))
+            key = getattr(reg, "dedupe_key", None) or id(reg)
+            by_reg.setdefault(key, [reg, []])[1].append(str(i))
         pairs = [("+".join(idxs), reg.snapshot())
                  for reg, idxs in by_reg.values()]
         snap = {
@@ -1295,6 +1311,14 @@ class Router:
                  else "single") for e in self._engines],
             "router": self.stats(),
         }
+        # per-replica transport counters (PR 19): None for local
+        # engines, deterministic frame/byte totals for remote proxies
+        # — same order as load_reports/health
+        tstats = [getattr(e, "transport_stats", None)
+                  for e in self._engines]
+        if any(t is not None for t in tstats):
+            snap["transport"] = [None if t is None else t()
+                                 for t in tstats]
         if self._monitor is not None:
             snap["monitor"] = self._monitor.summary()
         if self._ts is not None:

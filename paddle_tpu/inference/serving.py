@@ -4757,6 +4757,33 @@ class ServingEngine:
             "shard_group": self.shard_group,
         }
 
+    def engine_spec(self) -> dict:
+        """The engine's IMMUTABLE identity as one JSON-safe dict —
+        what a wire handshake advertises (PR 19's ``welcome`` frame)
+        and what the router's replica-homogeneity validation reads:
+        geometry (``prompt_len`` / ``max_cache_len`` / ``block_len``
+        / ``num_blocks`` / ``num_slots`` / ``chunk_len``), at-rest
+        dtypes, the pad token, the per-block KV row stride the
+        migration byte accounting multiplies by, registered adapter
+        names (``None`` without an AdapterStore — "no store" and
+        "empty store" route differently) and the shard-group
+        identity.  Pure host attrs, free to call."""
+        return {
+            "prompt_len": self.prompt_len,
+            "max_cache_len": self.max_cache_len,
+            "block_len": self.block_len,
+            "num_blocks": self.num_blocks,
+            "num_slots": self.num_slots,
+            "chunk_len": self.chunk_len,
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "weight_dtype": self.weight_dtype,
+            "pad_token_id": int(self.cfg.pad_token_id),
+            "kv_row_bytes": int(self._kv_row_bytes),
+            "adapters": (None if self._adapters is None
+                         else list(self._adapters.names())),
+            "shard_group": self.shard_group,
+        }
+
     def prefix_match(self, prompt_ids) -> int:
         """Token-granular longest-prefix match of ``prompt_ids``
         against THIS engine's prefix index (0 off radix mode) —

@@ -581,9 +581,14 @@ class SLOBurnRateMonitor:
         seen = set()
         out: Dict[str, List[int]] = {}
         for reg in registries:
-            if reg is None or id(reg) in seen:
+            # dedupe by the registry's STABLE key when it has one —
+            # remote-replica registry shims are fresh objects per
+            # fetch, so id() would double-count one shared server
+            # registry (PR 19); id() remains the bare-object fallback
+            k = getattr(reg, "dedupe_key", None) or id(reg)
+            if reg is None or k in seen:
                 continue
-            seen.add(id(reg))
+            seen.add(k)
             for name, slot in (("serving.slo.attained", 0),
                                ("serving.slo.missed", 1)):
                 inst = reg.get(name)

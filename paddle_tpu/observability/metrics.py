@@ -32,12 +32,17 @@ statically.
 from __future__ import annotations
 
 import bisect
+import itertools
 import json
 import re
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 NAME_RE = re.compile(r"^[a-z][a-z0-9_.]*$")
+
+# monotone registry ids: the stable dedupe identity (see
+# ``MetricsRegistry.dedupe_key``)
+_REGISTRY_UID = itertools.count()
 
 # default buckets cover sub-ms kernel dispatch through multi-second
 # request latencies (seconds)
@@ -295,6 +300,12 @@ class MetricsRegistry:
         self._enabled = bool(enabled)
         self._lock = threading.Lock()
         self._instruments: Dict[str, _Instrument] = {}
+        # stable in-process identity: consumers that deduplicate
+        # SHARED registries (fleet_snapshot, the SLO monitor) key on
+        # this instead of id() — a remote replica's registry shim can
+        # carry the server registry's key across the wire, where
+        # object identity is meaningless (every fetch is a fresh dict)
+        self.dedupe_key = f"reg{next(_REGISTRY_UID)}"
 
     # -- lifecycle --
     def enable(self):

@@ -662,7 +662,8 @@ def test_metrics_name_lint_clean():
              "serving.router.", "serving.migrate.",
              "serving.weights.", "pallas.quantized_matmul.",
              "serving.fleet.", "serving.alerts",
-             "serving.shard.", "pallas.decode_attention.route",
+             "serving.shard.", "serving.transport.",
+             "pallas.decode_attention.route",
              "serving.tpot_seconds")), n
         assert n in names, n
     kinds = {r[3]: r[2] for r in regs}

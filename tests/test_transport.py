@@ -1,0 +1,758 @@
+"""Wire transport + multi-process replica serving (PR 19).
+
+Tier-1 rides the LOOPBACK transport exclusively: the full frame codec
+runs on every call, but in-process — dispatch-cheap, tiny models, the
+PR-12 module-scoped combined-trace pattern.  The centerpiece is the
+loopback BYTE-IDENTITY contract: a Router over ``RemoteReplica``
+proxies schedules exactly like the bare Router on the combined
+2-replica trace (outputs, admission order, routing reasons, engine
+counter stories, flight-recorder sequences modulo the ``transport``
+attr).  The real-socket/process kill-and-recover lane is marked
+``slow`` (sockets are bench-only by design — see notes.md)."""
+
+import json
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.inference import (AdapterStore, FaultInjector,
+                                  LoraAdapter, Router, ServingEngine,
+                                  TokenStream)
+from paddle_tpu.inference.procserve import (EngineHost, EngineProcess,
+                                            TCPStoreLite,
+                                            tiny_llama_engine)
+from paddle_tpu.inference.serving import (AdmissionError,
+                                          ReplicaKilledError,
+                                          TERMINAL_STATES)
+from paddle_tpu.inference.transport import (FRAME_KINDS, WIRE_VERSION,
+                                            FrameCorruptError,
+                                            FrameTruncatedError,
+                                            FrameVersionError,
+                                            LoopbackTransport,
+                                            RemoteReplica,
+                                            SocketTransport,
+                                            TransportDeadError,
+                                            TransportError,
+                                            decode_frame, encode_frame,
+                                            err_to_wire,
+                                            raise_from_wire,
+                                            sampling_from_wire,
+                                            sampling_to_wire)
+from paddle_tpu.inference.sampling import SamplingParams
+from paddle_tpu.observability import MetricsRegistry
+from paddle_tpu.observability.flightrec import FlightRecorder
+from tools.serving_top import check as top_check
+from tools.serving_top import render as top_render
+
+P, C, BL = 32, 48, 4
+FAR = 1e12
+
+
+@pytest.fixture(scope="module")
+def netm():
+    paddle.seed(1234)
+    cfg = models.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64)
+    net = models.LlamaForCausalLM(cfg)
+    net.eval()
+    return cfg, net
+
+
+def _mk(net, *, registry=None, store=None, recorder=None, **kw):
+    # clock pinned to 0.0: first_token/finish times come off the
+    # engine clock (not step's ``now``), and the byte-identity test
+    # compares FULL stats dicts — latency means included
+    return ServingEngine(
+        net, num_slots=2, prompt_len=P, max_cache_len=C,
+        steps_per_call=1, block_len=BL, chunk_len=4, num_blocks=16,
+        compute_dtype="float32", clock=lambda: 0.0,
+        registry=registry if registry is not None else MetricsRegistry(),
+        adapter_store=store, flight_recorder=recorder, **kw)
+
+
+def _wrap(engine, label="replica"):
+    """One engine behind the full wire path: EngineHost + loopback."""
+    return RemoteReplica(LoopbackTransport(
+        EngineHost(engine, label=label), registry=MetricsRegistry()))
+
+
+# ---------------------------------------------------------------------------
+# protocol round-trip property tests
+# ---------------------------------------------------------------------------
+
+def test_frame_roundtrip_every_kind():
+    """Every FRAME_KINDS frame encodes/decodes byte-exactly: kind,
+    seq, payload and planes all survive, and re-encoding the decoded
+    frame reproduces the original bytes (canonical JSON makes the
+    encoding a bijection on its image)."""
+    payload = {"b": 1, "a": [1, 2.5, None, "x"], "z": {"k": True}}
+    for i, kind in enumerate(FRAME_KINDS):
+        buf = encode_frame(kind, i, payload)
+        k2, seq2, obj2, planes2, n = decode_frame(buf)
+        assert (k2, seq2, obj2, planes2, n) == (kind, i, payload,
+                                                [], len(buf))
+        assert encode_frame(k2, seq2, obj2) == buf
+    # empty payload is None on the wire, not {}
+    k2, _s, obj2, _p, _n = decode_frame(encode_frame("probe", 0))
+    assert k2 == "probe" and obj2 is None
+
+
+def test_frame_roundtrip_migration_parcel():
+    """A migration parcel — int8 quantized codes + float32 scale
+    planes, the PR-16 at-rest layout — rides as raw planes and comes
+    back byte-exact (dtype, shape, every byte)."""
+    rng = np.random.default_rng(7)
+    codes = rng.integers(-128, 128, (5, 2, 4, 8), np.int8)
+    scales = rng.standard_normal((5, 2, 4, 1)).astype(np.float32)
+    big = rng.standard_normal((3, 16)).astype(np.float64)
+    meta = {"n_blocks": 5, "tok": 11, "lens": 9, "phase": "decode",
+            "pf_pos": 0, "n_planes": 3}
+    buf = encode_frame("migrate_in", 3, {"parcel": meta},
+                       (codes, scales, big))
+    kind, seq, obj, planes, _n = decode_frame(buf)
+    assert kind == "migrate_in" and seq == 3 and obj == {"parcel": meta}
+    assert len(planes) == 3
+    for src, got in zip((codes, scales, big), planes):
+        assert got.dtype == src.dtype and got.shape == src.shape
+        assert got.tobytes() == src.tobytes()
+    # byte-exactness survives a second hop (re-encode the decoded
+    # planes — the proxy-stage-then-migrate path)
+    assert encode_frame(kind, seq, obj, tuple(planes)) == buf
+
+
+def test_frame_typed_errors():
+    buf = encode_frame("step", 9, {"now": 0.0})
+    # truncation at EVERY prefix length raises the typed truncation
+    # error — never a parse guess, never an unrelated exception
+    for cut in range(len(buf)):
+        with pytest.raises(FrameTruncatedError):
+            decode_frame(buf[:cut])
+    # truncated plane body
+    pbuf = encode_frame("stepped", 0, {"parcels": []},
+                        (np.arange(8, dtype=np.int8),))
+    with pytest.raises(FrameTruncatedError):
+        decode_frame(pbuf[:-1])
+    # bad magic / corrupt kind index
+    with pytest.raises(FrameCorruptError):
+        decode_frame(b"XXXX" + buf[4:])
+    bad_kind = bytearray(buf)
+    bad_kind[6] = 250                  # kind index out of range
+    with pytest.raises(FrameCorruptError):
+        decode_frame(bytes(bad_kind))
+    # version mismatch is ITS OWN error (mismatched peers, not noise)
+    bad_ver = bytearray(buf)
+    bad_ver[4:6] = (WIRE_VERSION + 1).to_bytes(2, "big")
+    with pytest.raises(FrameVersionError):
+        decode_frame(bytes(bad_ver))
+    # unknown kind refused at encode time
+    with pytest.raises(TransportError, match="unknown frame kind"):
+        encode_frame("bogus", 0)
+
+
+def test_wire_error_and_sampling_codecs():
+    # typed engine errors survive the wire as their original type,
+    # AdmissionError keeping its backpressure fields
+    e = AdmissionError("full", queue_depth=3, max_queue=3)
+    with pytest.raises(AdmissionError) as ei:
+        raise_from_wire(json.loads(json.dumps(err_to_wire(e))))
+    assert ei.value.queue_depth == 3 and ei.value.max_queue == 3
+    with pytest.raises(ReplicaKilledError):
+        raise_from_wire(err_to_wire(ReplicaKilledError("boom")))
+    # an unknown remote type degrades to TransportError, loudly
+    with pytest.raises(TransportError, match="SomethingElse"):
+        raise_from_wire({"name": "SomethingElse", "msg": "?"})
+    # sampling params round-trip; the host-callable mask_processor is
+    # refused at the front door (not wire-shaped)
+    sp = SamplingParams(temperature=0.7, top_k=5, top_p=0.9,
+                        repetition_penalty=1.1, seed=42)
+    sp2 = sampling_from_wire(json.loads(json.dumps(
+        sampling_to_wire(sp))))
+    assert (sp2.temperature, sp2.top_k, sp2.top_p,
+            sp2.repetition_penalty, sp2.seed) == (0.7, 5, 0.9, 1.1, 42)
+    assert sampling_to_wire(None) is None
+
+    from paddle_tpu.inference.sampling import DfaTokenMask
+    table = np.full((1, 8), -1, np.int32)
+    table[0, 1] = 0
+    with pytest.raises(TransportError, match="mask_processor"):
+        sampling_to_wire(SamplingParams(
+            mask_processor=DfaTokenMask(table)))
+
+
+# ---------------------------------------------------------------------------
+# loopback byte-identity: THE determinism contract
+# ---------------------------------------------------------------------------
+
+def _combined_trace(net, cfg, *, wrap):
+    """The PR-12 combined 2-replica trace (3 conversations x 2 turns,
+    c0 streamed 'chat', c1/c2 on their own LoRA adapters, plus an
+    embed-policy request), against bare engines or loopback-wrapped
+    ones.  Returns every deterministic observable the byte-identity
+    assert compares."""
+    rng = np.random.default_rng(42)
+    ads = [LoraAdapter.random(cfg, f"a{j}", rank=4, seed=50 + j,
+                              scale=0.05) for j in range(2)]
+    engs, regs = [], []
+    for _ in range(2):
+        reg = MetricsRegistry()
+        store = AdapterStore(net, slots=2, max_rank=4,
+                             dtype="float32", registry=reg)
+        for ad in ads:
+            store.register(ad)
+        engs.append(_mk(net, registry=reg, store=store))
+        regs.append(reg)
+    replicas = ([_wrap(e, f"r{i}") for i, e in enumerate(engs)]
+                if wrap else engs)
+    rrec = FlightRecorder()
+    rt = Router(replicas, affinity=True, registry=MetricsRegistry(),
+                flight_recorder=rrec)
+
+    sys_ids = rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
+    hist = [list(sys_ids) for _ in range(3)]
+    adapters = [None, ads[0].name, ads[1].name]
+    new = 4
+
+    def drain(handles, streams=()):
+        flushes = {id(s): [] for s in streams}
+        steps = 0
+        while any(h.state not in TERMINAL_STATES for h in handles):
+            rt.step(now=0.0)
+            for e in engs:
+                e._pool.check()
+            for s in streams:
+                c = s.read()
+                if c.size:
+                    flushes[id(s)].append(c)
+            steps += 1
+            assert steps < 80, "trace did not drain"
+        return flushes
+
+    assign = {ci: [] for ci in range(3)}
+    outs, c0_flushes = [], []
+    for turn in range(2):
+        handles, streams = [], []
+        for ci in range(3):
+            user = rng.integers(0, cfg.vocab_size, (3,)).astype(
+                np.int32)
+            hist[ci].extend(int(x) for x in user)
+            ids = np.asarray(hist[ci], np.int32)
+            if ci == 0:
+                s = rt.submit(ids, max_new_tokens=new, policy="chat",
+                              arrival_time=0.0)
+                assert isinstance(s, TokenStream)
+                streams.append(s)
+                h = s.request
+            else:
+                h = rt.submit(ids, max_new_tokens=new,
+                              adapter=adapters[ci], arrival_time=0.0)
+            handles.append(h)
+        fl = drain(handles, streams)
+        for ci, h in enumerate(handles):
+            assign[ci].append(h.engine)
+            outs.append([int(x) for x in h.output])
+            hist[ci].extend(int(x) for x in h.output)
+        c0_flushes.append([c.tolist() for c in fl[id(streams[0])]])
+
+    he = rt.submit(np.asarray(hist[0][:6], np.int32), policy="embed",
+                   arrival_time=0.0)
+    drain([he])
+    assert he.state == "finished" and he.output.size == 1
+
+    # flight-recorder stories, normalized: drop the ONE attr the
+    # transport layer adds (remote replicas tag route/fail events
+    # transport=loopback) — everything else must be equal, seq
+    # numbers included
+    events = [(e.seq, e.step, e.request, e.kind,
+               tuple(sorted((k, v) for k, v in e.attrs.items()
+                            if k != "transport")))
+              for e in rrec.events()]
+    return {
+        "assign": assign,
+        "routed_by_reason": rt.stats()["routed_by_reason"],
+        "outs": outs,
+        "c0_flushes": c0_flushes,
+        "events": events,
+        "n_route_events": sum(1 for e in rrec.events()
+                              if e.kind == "route"),
+        # engine-side truth: the full deterministic counter story of
+        # each SERVER engine (dispatch counts, goodput ledger, prefix
+        # hits, swaps — now=0.0 makes even the latency means exact)
+        "engine_stats": [e.stats() for e in engs],
+        "swapins": [r.get("serving.lora.swap_ins").value()
+                    for r in regs],
+        "rrec_transport_attrs": sorted({
+            e.attrs.get("transport") for e in rrec.events()
+            if e.kind == "route"}),
+    }
+
+
+def test_loopback_byte_identity(netm):
+    """Router-over-LoopbackTransport schedules BYTE-IDENTICALLY to
+    the bare Router on the combined trace: same request ids, same
+    admission order, same dispatch counts, same outputs, same
+    flight-recorder event sequences (modulo the transport attr).  The
+    PR-12 single-replica-identity trick applied at the transport
+    layer — and the reason remote replicas need no new scheduler
+    tests: the wire is invisible to scheduling."""
+    cfg, net = netm
+    bare = _combined_trace(net, cfg, wrap=False)
+    loop = _combined_trace(net, cfg, wrap=True)
+    assert bare["assign"] == loop["assign"]
+    assert bare["routed_by_reason"] == loop["routed_by_reason"]
+    assert bare["outs"] == loop["outs"]
+    # streamed flush BOUNDARIES equal too: the stepped-reply token
+    # deltas land on the same steps as in-process harvests
+    assert bare["c0_flushes"] == loop["c0_flushes"]
+    assert bare["events"] == loop["events"]
+    assert bare["n_route_events"] == loop["n_route_events"] == 7
+    assert bare["engine_stats"] == loop["engine_stats"]
+    assert bare["swapins"] == loop["swapins"] == [1.0, 1.0]
+    # and the one allowed difference is exactly the transport tag
+    assert bare["rrec_transport_attrs"] == [None]
+    assert loop["rrec_transport_attrs"] == ["loopback"]
+
+
+# ---------------------------------------------------------------------------
+# the RemoteReplica engine surface
+# ---------------------------------------------------------------------------
+
+def test_remote_replica_surface(netm):
+    """The proxy's engine surface against the same engine bare:
+    handshake geometry, submit (greedy + seeded sampling with
+    samp_base mirroring), prefix_match, load_report, cancel, typed
+    error relay, observability shims, transport stats determinism."""
+    cfg, net = netm
+    eng = _mk(net, recorder=FlightRecorder())
+    rep = _wrap(eng, "solo")
+
+    # handshake carried the engine_spec: geometry + identity attrs
+    spec = eng.engine_spec()
+    assert (rep.prompt_len, rep.max_cache_len, rep.block_len,
+            rep.num_blocks, rep.num_slots) == (
+        spec["prompt_len"], spec["max_cache_len"], spec["block_len"],
+        spec["num_blocks"], spec["num_slots"])
+    assert rep.kv_cache_dtype == spec["kv_cache_dtype"]
+    assert rep._kv_row_bytes == spec["kv_row_bytes"]
+    assert rep.cfg.pad_token_id == spec["pad_token_id"]
+    assert rep._adapters is None        # no store on this engine
+    for n, m in ((1, 1), (6, 4), (31, 17)):
+        assert rep._blocks_needed(n, m) == eng._blocks_needed(n, m)
+    assert rep.load_report() == eng.load_report()
+
+    ids = np.arange(6, dtype=np.int32) + 1
+    assert rep.prefix_match(ids) == eng.prefix_match(ids) == 0
+
+    # greedy parity (drive the proxy like the router would)
+    h = rep.submit(ids, max_new_tokens=5, arrival_time=0.0)
+    assert h.state == "queued" and h.samp_base is None
+    for _ in range(60):
+        done = rep.step(now=0.0)
+        if done:
+            break
+    assert h.state == "finished" and done == [h]
+    ref = eng.submit(ids, max_new_tokens=5, arrival_time=0.0)
+    eng.run()
+    assert np.array_equal(h.output, ref.output)
+    assert h.ttft == ref.ttft == 0.0 and h.latency == ref.latency
+
+    # sampled parity: the samp_base the server assigned mirrors back
+    # (failover recompute replays from it), and the streams agree
+    sp = SamplingParams(temperature=0.8, top_k=8, seed=11)
+    hs = rep.submit(ids, max_new_tokens=5, arrival_time=0.0,
+                    sampling=sp)
+    assert hs.samp_base is not None and hs.samp_base.dtype == np.uint32
+    for _ in range(60):
+        if rep.step(now=0.0):
+            break
+    rs = eng.submit(ids, max_new_tokens=5, arrival_time=0.0,
+                    sampling=sp)
+    eng.run()
+    assert np.array_equal(hs.output, rs.output)
+    assert np.array_equal(hs.samp_base, np.asarray(rs.samp_base))
+
+    # cancel: queued request drops on the server, ack carries truth
+    hq = rep.submit(ids, max_new_tokens=5, arrival_time=FAR)
+    assert rep.cancel(hq.request_id) is True
+    assert rep.cancel(10_000) is False        # unknown id: engine no-op
+
+    # typed validation errors relay as ValueError, front-door exact
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        rep.submit(ids, max_new_tokens=0)
+    with pytest.raises(TransportError, match="mask_processor"):
+        from paddle_tpu.inference.sampling import DfaTokenMask
+        table = np.full((1, cfg.vocab_size), -1, np.int32)
+        table[0, 1] = 0
+        rep.submit(ids, sampling=SamplingParams(
+            mask_processor=DfaTokenMask(table)))
+
+    # observability shims: the registry snapshot is the server's, the
+    # dedupe key is pid-qualified and stable across fetches, the
+    # flight record is a stitchable dict
+    snap = rep.metrics_registry.snapshot()
+    assert snap == eng.metrics_registry.snapshot()
+    assert rep.metrics_registry.dedupe_key \
+        == rep.metrics_registry.dedupe_key
+    inst = rep.metrics_registry.get("serving.queue_depth")
+    assert inst is not None and inst._snap()["type"] == "gauge"
+    fr = rep.flight_recorder
+    assert fr["n_events"] == len(eng.flight_recorder.events())
+    assert fr["events"][0]["kind"] == "submit"
+    assert rep.ping() is True
+
+    # transport counters are deterministic plain-python state
+    st = rep.transport_stats()
+    assert st["kind"] == "loopback" and st["label"] == "solo"
+    assert st["frames"]["submit"] == 4 and st["frames"]["hello"] == 1
+    assert st["bytes_out"] > 0 and st["bytes_in"] > 0
+    assert st["staged_parcels"] == 0
+    # and the serving.transport.* instruments recorded the same story
+    tsnap = rep._t._m.registry.snapshot()
+    frames = tsnap["serving.transport.frames"]["values"]
+    assert frames["kind=submit"] == 4.0
+    assert tsnap["serving.transport.bytes_out"]["values"][""] \
+        == float(st["bytes_out"])
+    assert tsnap["serving.transport.rpc_seconds"]["values"][""][
+        "count"] > 0
+
+
+def test_transport_stats_deterministic(netm):
+    """Two identical loopback traces move byte-identical frame
+    sequences: frames-by-kind AND byte totals equal — the determinism
+    surface the bench multiproc arm gates on (sockets can only gate
+    frame counts; loopback pins the bytes too)."""
+    cfg, net = netm
+    ids = np.arange(7, dtype=np.int32) + 3
+
+    def one():
+        rep = _wrap(_mk(net))
+        h = rep.submit(ids, max_new_tokens=4, arrival_time=0.0)
+        for _ in range(60):
+            if rep.step(now=0.0):
+                break
+        assert h.state == "finished"
+        return rep.transport_stats()
+
+    a, b = one(), one()
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
+# failover across the wire (loopback lane)
+# ---------------------------------------------------------------------------
+
+def test_loopback_failover_migration_token_exact(netm):
+    """The PR-15 failover story with the victim behind a transport:
+    force-swap parks a request (its parcel ships to the proxy's LOCAL
+    staging tier in the stepped reply), the replica is killed (the
+    typed ReplicaKilledError relays through an error frame), and the
+    router migrates the STAGED parcel + recomputes the rest — outputs
+    token-exact vs a no-fault reference, migrated blocks exact, fail
+    events tagged with the transport."""
+    cfg, net = netm
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(n),)).astype(
+        np.int32) for n in rng.integers(6, 12, 4)]
+    new = 16
+
+    def run(inject):
+        engs, injs = [], []
+        for _ in range(2):
+            inj = FaultInjector()
+            engs.append(_mk(net, fault_injector=inj))
+            injs.append(inj)
+        reps = [_wrap(e, f"r{i}") for i, e in enumerate(engs)]
+        rrec = FlightRecorder()
+        rt = Router(reps, registry=MetricsRegistry(),
+                    flight_recorder=rrec)
+        hs = [rt.submit(p, max_new_tokens=new, arrival_time=0.0)
+              for p in prompts]
+        rt.step(now=0.0)
+        vblocks = 0
+        if inject:
+            for _ in range(2):
+                rt.step(now=0.0)
+            vi = hs[0].engine
+            injs[vi].force_swap(hs[0].request_id)
+            injs[vi].fail_allocs(None)
+            rt.step(now=0.0)
+            assert hs[0].state == "swapped"
+            # the parcel is STAGED CLIENT-SIDE now: the proxy's local
+            # tier holds the exact bytes, so the engine's death
+            # cannot lose them
+            vrep = reps[vi]
+            assert vrep.transport_stats()["staged_parcels"] == 1
+            vblocks = hs[0]._req.swap.n_blocks
+            assert vrep._host_tier.entry(
+                hs[0]._req.swap.host_key).n_blocks == vblocks
+            injs[vi].kill_at_step(engs[vi]._step_idx + 1)
+        steps = 0
+        while any(h.state not in TERMINAL_STATES for h in hs):
+            rt.step(now=0.0)
+            steps += 1
+            assert steps < 400, [h.state for h in hs]
+        return (rt, reps, hs, rrec, vblocks,
+                [np.asarray(h.output) for h in hs])
+
+    _rt0, _r0, hs0, _rec0, _v0, ref_outs = run(inject=False)
+    rt, reps, hs, rrec, vblocks, outs = run(inject=True)
+    assert all(h.state == "finished" for h in hs)
+    assert all(np.array_equal(a, b) for a, b in zip(ref_outs, outs))
+    rs = rt.stats()
+    assert rs["replica_faults"] == 1
+    assert vblocks > 0 and rs["migrated_blocks"] == vblocks
+    assert rs["migrated_bytes"] == vblocks * BL * reps[0]._kv_row_bytes
+    # the victim's staged parcels are gone: the migrate handed the
+    # bytes to the destination (which keeps its OWN staged copy until
+    # the request resumes/finishes, then drops it)
+    assert all(r.transport_stats()["staged_parcels"] == 0
+               for r in reps)
+    assert all(len(r._host_tier.keys()) == 0 for r in reps)
+    # fail events carry the transport identity
+    fails = [e for e in rrec.events() if e.kind == "fail"]
+    assert fails and all(e.attrs["transport"] == "loopback"
+                         for e in fails)
+
+
+def test_remote_crash_reset_and_probe_recovery(netm):
+    """crash_reset over the wire strips the replica (mirrors clear,
+    staged parcels drop) and the router's probe loop re-admits it
+    after the injector's restart — the loopback half of the
+    kill/respawn contract."""
+    cfg, net = netm
+    inj = FaultInjector()
+    eng = _mk(net, fault_injector=inj)
+    rep = _wrap(eng)
+    rt = Router([rep, _wrap(_mk(net))], registry=MetricsRegistry(),
+                probe_interval=2)
+    ids = np.arange(6, dtype=np.int32) + 1
+    h = rt.submit(ids, max_new_tokens=4, arrival_time=0.0)
+    rt.step(now=0.0)
+    inj.kill_at_step(eng._step_idx + 1)
+    steps = 0
+    while h.state not in TERMINAL_STATES:
+        rt.step(now=0.0)
+        steps += 1
+        assert steps < 100
+    assert h.state == "finished"
+    assert rt.health[0] == "unhealthy" and not rep._reqs
+    inj.clear_replica_faults()            # the "restart"
+    for _ in range(20):
+        rt.step(now=0.0)
+        if rt.health[0] != "unhealthy":
+            break
+    assert rt.health[0] in ("probation", "healthy")
+
+
+# ---------------------------------------------------------------------------
+# fleet snapshot: dedupe bugfix + serving_top over transport gauges
+# ---------------------------------------------------------------------------
+
+def test_fleet_snapshot_dedupe_and_serving_top(netm, tmp_path):
+    """The PR-19 dedupe bugfix: two replicas SHARING one registry
+    must merge it once even when each snapshot fetch materializes a
+    fresh dict (the remote-replica reality) — keyed by the stable
+    ``dedupe_key``, not object identity.  And the re-serialized
+    snapshot (with shard_groups + transport sections) passes
+    ``serving_top --check``."""
+    cfg, net = netm
+    shared = MetricsRegistry()
+    engs = [_mk(net, registry=shared) for _ in range(2)]
+    reps = [_wrap(e, f"r{i}") for i, e in enumerate(engs)]
+    # the two proxies' registry shims are DISTINCT objects over the
+    # same server registry; their snapshots are fresh dicts per fetch
+    assert reps[0].metrics_registry is not reps[1].metrics_registry
+    assert reps[0].metrics_registry.dedupe_key \
+        == reps[1].metrics_registry.dedupe_key
+    rt = Router(reps, registry=MetricsRegistry())
+    ids = np.arange(6, dtype=np.int32) + 1
+    h = rt.submit(ids, max_new_tokens=4, arrival_time=0.0)
+    steps = 0
+    while h.state not in TERMINAL_STATES:
+        rt.step(now=0.0)
+        steps += 1
+        assert steps < 60
+    snap = rt.fleet_snapshot()
+
+    # merged ONCE, labeled with both replica indices — and the
+    # regression: the counter value equals the single registry's
+    # truth, not twice it
+    sub = snap["registries"]["serving.requests_finished"]
+    assert list(sub["values"]) == ["replica=0+1"]
+    shared_val = shared.get("serving.requests_finished").value()
+    assert sub["values"]["replica=0+1"] == shared_val == 1.0
+
+    # transport section: one entry per replica, deterministic
+    assert len(snap["transport"]) == 2
+    assert all(t["kind"] == "loopback" for t in snap["transport"])
+    assert snap["shard_groups"] == ["single", "single"]
+
+    # the JSON round-trip (what an incident dump actually is) checks
+    # clean and renders with the transport/shard columns
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(snap))
+    rt2 = json.loads(path.read_text())
+    assert top_check(rt2) == []
+    text = top_render(rt2)
+    assert "transport=loopback" in text
+    # a mangled transport section is a check failure, not a render
+    # surprise
+    bad = dict(rt2)
+    bad["transport"] = rt2["transport"][:1]
+    assert any("transport has 1 entries" in p for p in top_check(bad))
+    bad2 = dict(rt2)
+    bad2["transport"] = [{"frames": {}}, None]
+    assert any("lacks a transport kind" in p for p in top_check(bad2))
+
+    # stitched fleet record over remote replicas: flight records
+    # arrive as pure dicts and stitch unchanged
+    engs2 = [_mk(net, recorder=FlightRecorder()) for _ in range(2)]
+    reps2 = [_wrap(e, f"s{i}") for i, e in enumerate(engs2)]
+    rrec = FlightRecorder()
+    rt3 = Router(reps2, registry=MetricsRegistry(),
+                 flight_recorder=rrec)
+    h2 = rt3.submit(ids, max_new_tokens=3, arrival_time=0.0)
+    while h2.state not in TERMINAL_STATES:
+        rt3.step(now=0.0)
+    st = rt3.stitched_record()
+    assert len(st) > 0 and h2.router_id in st.request_ids()
+    assert "routed to engine" in st.explain(h2.router_id)
+
+
+def test_slo_monitor_dedupes_by_key():
+    """The monitor's tenant-budget sum dedupes shared registries by
+    the stable key too (the other half of the double-count bug)."""
+    from paddle_tpu.observability.fleet import SLOBurnRateMonitor
+
+    reg = MetricsRegistry()
+    att = reg.counter("serving.slo.attained", "t",
+                      labels=("tenant", "cls"))
+    att.inc(10, tenant="t0", cls="latency")
+    mon = SLOBurnRateMonitor(slo_target=0.9, window_steps=8)
+
+    class _Shim:
+        def __init__(self, reg):
+            self.dedupe_key = reg.dedupe_key
+            self._r = reg
+
+        def get(self, name):
+            return self._r.get(name)
+
+    # two distinct shim OBJECTS over one registry: counted once
+    totals = mon._tenant_totals([_Shim(reg), _Shim(reg)])
+    assert totals == {"t0": [10, 0]}
+    # bare registries still dedupe (id fallback unchanged)
+    assert mon._tenant_totals([reg, reg]) == {"t0": [10, 0]}
+
+
+# ---------------------------------------------------------------------------
+# process supervision (dryrun = tier-1; real sockets = slow)
+# ---------------------------------------------------------------------------
+
+def test_engine_process_dryrun():
+    """The supervisor's launch/restart surface without paying a
+    process: commands recorded verbatim, restart bumps the
+    generation (a stale rendezvous key can never resolve), and the
+    generation-0 fault schedule does NOT survive a respawn."""
+    ep = EngineProcess(
+        "r0", "paddle_tpu.inference.procserve:tiny_llama_engine",
+        {"seed": 7, "fault_spec": {"exit_at_step": 8}},
+        ("127.0.0.1", 1), dryrun=True)
+    assert ep.alive() is False and ep.address() is None
+    assert ep.gen == 0 and len(ep.commands) == 1
+    cmd = ep.commands[0]
+    assert cmd[1] == "-c" and "procserve" in cmd[2]
+    assert cmd[cmd.index("--label") + 1] == "r0"
+    assert cmd[cmd.index("--gen") + 1] == "0"
+    kw0 = json.loads(cmd[cmd.index("--kwargs") + 1])
+    assert kw0 == {"seed": 7, "fault_spec": {"exit_at_step": 8}}
+    ep.restart()
+    assert ep.gen == 1 and len(ep.commands) == 2
+    cmd1 = ep.commands[1]
+    assert cmd1[cmd1.index("--gen") + 1] == "1"
+    kw1 = json.loads(cmd1[cmd1.index("--kwargs") + 1])
+    assert kw1 == {"seed": 7}            # fault schedule dropped
+    ep.kill()                            # no-op in dryrun
+
+
+def test_tcp_store_lite():
+    addr, closer = TCPStoreLite.serve()
+    try:
+        store = TCPStoreLite(addr)
+        assert store.get("replica/r0/0") is None
+        store.set("replica/r0/0", "127.0.0.1:5000")
+        assert store.wait("replica/r0/0") == "127.0.0.1:5000"
+        with pytest.raises(TimeoutError):
+            store.wait("missing", timeout_s=0.2)
+    finally:
+        closer()
+
+
+@pytest.mark.slow
+def test_socket_kill_and_recover_token_exact(netm):
+    """The real thing: two EngineProcess children behind
+    SocketTransport proxies; the victim child arms exit_at_step and
+    os._exit()s mid-decode — the parent sees ONLY a dead socket
+    (TransportDeadError, a REPLICA_FAULT_ERRORS member) and the
+    PR-15 failover recovers token-exact against an in-process
+    reference built from the same factory, with the supervisor
+    respawning the child as generation 1."""
+    rng = np.random.default_rng(29)
+    prompts = [rng.integers(1, 128, (int(n),)).astype(np.int32)
+               for n in rng.integers(6, 12, 4)]
+    new = 8
+
+    engs = [tiny_llama_engine() for _ in range(2)]
+    rt0 = Router(engs, registry=MetricsRegistry())
+    hs0 = [rt0.submit(p, max_new_tokens=new, arrival_time=0.0)
+           for p in prompts]
+    for _ in range(400):
+        rt0.step(now=0.0)
+        if all(h.state in TERMINAL_STATES for h in hs0):
+            break
+    ref = [np.asarray(h.output) for h in hs0]
+
+    store_addr, closer = TCPStoreLite.serve()
+    procs, reps = [], []
+    try:
+        fault = {"force_swap_rid": 0, "force_swap_step": 6,
+                 "park_allocs": True, "exit_at_step": 8}
+        for i in range(2):
+            procs.append(EngineProcess(
+                f"kr{i}",
+                "paddle_tpu.inference.procserve:tiny_llama_engine",
+                {"fault_spec": fault} if i == 0 else {}, store_addr))
+        reps = [RemoteReplica(SocketTransport(
+            p, registry=MetricsRegistry(), rpc_timeout_s=300.0))
+            for p in procs]
+        rt = Router(reps, registry=MetricsRegistry())
+        hs = [rt.submit(p, max_new_tokens=new, arrival_time=0.0)
+              for p in prompts]
+        vblocks = 0
+        for _ in range(400):
+            rt.step(now=0.0)
+            for h in hs:
+                if h.state == "swapped" and h._req.swap is not None:
+                    vblocks = h._req.swap.n_blocks
+            if all(h.state in TERMINAL_STATES for h in hs):
+                break
+        assert all(h.state == "finished" for h in hs)
+        outs = [np.asarray(h.output) for h in hs]
+        assert all(np.array_equal(a, b) for a, b in zip(ref, outs))
+        rs = rt.stats()
+        assert rs["replica_faults"] == 1
+        assert vblocks > 0 and rs["migrated_blocks"] == vblocks
+        assert procs[0].gen == 1          # a REAL death, respawned
+        assert procs[0].returncode() is None or procs[0].alive()
+        # dead-transport fast-fail surfaced as the typed member
+        assert issubclass(TransportDeadError, ReplicaKilledError)
+    finally:
+        for r in reps:
+            r._t.close()
+        for p in procs:
+            p.kill()
+        closer()

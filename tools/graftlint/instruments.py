@@ -193,6 +193,14 @@ REQUIRED_INSTRUMENTS = {
     "serving.shard.groups": ("gauge", ()),
     "serving.shard.width": ("gauge", ()),
     "pallas.decode_attention.route": ("counter", ("decision", "reason")),
+    # wire transport (PR 19, inference/transport.py
+    # _TransportInstruments): frames moved per kind (the determinism
+    # surface the bench multiproc arm gates on), encoded byte totals
+    # both directions, and the report-only rpc round-trip wall
+    "serving.transport.frames": ("counter", ("kind",)),
+    "serving.transport.bytes_out": ("counter", ()),
+    "serving.transport.bytes_in": ("counter", ()),
+    "serving.transport.rpc_seconds": ("histogram", ()),
 }
 
 

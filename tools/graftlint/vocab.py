@@ -89,6 +89,12 @@ VOCABS: Tuple[VocabSpec, ...] = (
     # _shard_route_reason producer's literal returns
     VocabSpec("DECODE_ROUTE_REASONS", dead=False,
               producers=("_shard_route_reason",)),
+    # wire-transport frame kinds (PR 19, inference/transport.py):
+    # every request kind has a literal transport.rpc("<kind>", ...)
+    # site (RemoteReplica and friends), every reply kind a literal
+    # EngineHost._reply("<kind>", ...) site — dead-entry detection
+    # stays ON, so a frame kind nothing emits is a lint failure
+    VocabSpec("FRAME_KINDS"),
 )
 
 
@@ -155,6 +161,12 @@ MATCHERS: Tuple[Matcher, ...] = (
     # fleet alerts (SLOBurnRateMonitor): serving.alerts{kind=...}
     Matcher("ALERT_KINDS", receivers=frozenset({"alerts"}),
             methods=frozenset({"inc"}), kwarg="kind"),
+    # wire-transport frames (PR 19): request kinds at the client's
+    # rpc() sites, reply kinds at the host's _reply() sites, and any
+    # hand-framed encode_frame() call (bench/tools) — all positional
+    Matcher("FRAME_KINDS", method="rpc", arg=0),
+    Matcher("FRAME_KINDS", method="_reply", arg=0),
+    Matcher("FRAME_KINDS", method="encode_frame", arg=0),
 )
 
 

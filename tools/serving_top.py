@@ -51,15 +51,28 @@ def render(snapshot: dict) -> str:
         lines.append(f"  {'rep':>3} {'health':<10} {'queue':>5} "
                      f"{'active':>6} {'swapped':>7} {'blocks':>13} "
                      f"{'kv':>8}")
+        shards = snapshot.get("shard_groups", [])
+        transports = snapshot.get("transport", [])
         for i, r in enumerate(reports):
             st = health[i] if i < len(health) else "?"
             blocks = (f"{r.get('blocks_in_use', 0)}/"
                       f"{r.get('blocks_total', 0)}")
+            # shard-group + transport identity suffixes (PR 18/19):
+            # omitted when single-chip / local, so pre-PR snapshots
+            # render unchanged
+            tail = ""
+            if i < len(shards) and shards[i] != "single":
+                tail += f"  shard={shards[i]}"
+            t = transports[i] if i < len(transports) else None
+            if t is not None:
+                tail += (f"  transport={t.get('kind', '?')} "
+                         f"out={t.get('bytes_out', 0)}B "
+                         f"in={t.get('bytes_in', 0)}B")
             lines.append(
                 f"  {i:>3} {st:<10} {r.get('queue_depth', 0):>5} "
                 f"{r.get('active_slots', 0):>6} "
                 f"{r.get('swapped_waiting', 0):>7} {blocks:>13} "
-                f"{str(r.get('kv_cache_dtype', '?')):>8}")
+                f"{str(r.get('kv_cache_dtype', '?')):>8}{tail}")
 
     router = snapshot.get("router", {})
     if router:
@@ -179,6 +192,27 @@ def check(snapshot: dict) -> List[str]:
     for h in health:
         if h not in ("healthy", "probation", "unhealthy"):
             problems.append(f"unknown health state {h!r}")
+    # optional per-replica sections (PR 18/19): absent in older
+    # snapshots, but when present they must align with the engine
+    # list — a mis-lengthed section means a mangled snapshot
+    if isinstance(n, int):
+        shards = snapshot.get("shard_groups")
+        if shards is not None and len(shards) != n:
+            problems.append(
+                f"shard_groups has {len(shards)} entries for "
+                f"{n} engines")
+        transports = snapshot.get("transport")
+        if transports is not None:
+            if len(transports) != n:
+                problems.append(
+                    f"transport has {len(transports)} entries for "
+                    f"{n} engines")
+            for i, t in enumerate(transports):
+                if t is None:
+                    continue       # a local (in-process) replica
+                if not isinstance(t, dict) or "kind" not in t:
+                    problems.append(
+                        f"transport entry {i} lacks a transport kind")
     regs = snapshot.get("registries", {})
     if not isinstance(regs, dict):
         problems.append("registries is not a dict")
