@@ -2654,6 +2654,135 @@ def _bench_serving(on_tpu):
     except Exception as e:                      # keep the bench JSON whole
         multiproc = {"error": str(e)[:300]}
 
+    # -- disaggregated prefill/decode arm (``disagg`` sub-object,
+    # PR 20): a mixed long-prefill + interactive trace through TWO
+    # fleets — disagg (1 prefill + 1 decode replica, chunk-final
+    # handoff through the router stage) vs monolithic (2 "both"
+    # replicas).  Gated ONLY on deterministic counters: per-request
+    # token exactness across arms, handoff count == chunk-final count
+    # on the prefill replica, the migrated parcel blocks exact
+    # (router handoff events sum to the engine's handoff_blocks),
+    # ZERO prefill chunks dispatched on the decode replica, and
+    # counter equality across two full reruns.  The TTFT/TPOT split —
+    # disaggregation's whole point is isolating decode TPOT from
+    # prefill bursts — is wall-shaped and therefore REPORT-ONLY --
+    try:
+        dg_rng = np.random.default_rng(31)
+        dg_prompts = []
+        for i in range(6):
+            # even = long prefill burst (multi-chunk), odd = short
+            # interactive prompt riding alongside
+            lo, hi = ((2 * tr_chunk - 4, 2 * tr_chunk) if i % 2 == 0
+                      else (4, tr_user + 4))
+            n = int(dg_rng.integers(lo, hi))
+            dg_prompts.append(dg_rng.integers(
+                0, cfg.vocab_size, (n,)).astype(np.int32))
+        dg_new = 2 * tr_new
+
+        def _one_disagg_trace(roles):
+            recs = [FlightRecorder() for _ in roles]
+            rrec = FlightRecorder()
+            engs = [ServingEngine(
+                model, num_slots=2, prompt_len=tr_prompt,
+                max_cache_len=tr_cache, steps_per_call=steps_per_call,
+                block_len=tr_block, chunk_len=tr_chunk,
+                num_blocks=tr_blocks, compute_dtype=compute_dtype,
+                registry=obs_metrics.MetricsRegistry(),
+                flight_recorder=rec, role=role)
+                for role, rec in zip(roles, recs)]
+            rt = Router(engs, registry=obs_metrics.MetricsRegistry(),
+                        flight_recorder=rrec)
+            t0 = time.perf_counter()
+            hs = [rt.submit(p, max_new_tokens=dg_new,
+                            arrival_time=0.0, stream=False)
+                  for p in dg_prompts]
+            done = ("finished", "failed", "timeout", "shed",
+                    "cancelled")
+            first_step, finish_step = {}, {}
+            steps = 0
+            while any(h.state not in done for h in hs):
+                rt.step(now=0.0)
+                steps += 1
+                for j, h in enumerate(hs):
+                    if j not in first_step and len(h.tokens) > 0:
+                        first_step[j] = steps
+                    if j not in finish_step and h.state in done:
+                        finish_step[j] = steps
+                if steps > 400:
+                    break
+            wall = time.perf_counter() - t0
+            outs = [np.asarray(h.output) for h in hs]
+            stats = [e.stats() for e in engs]
+            # the TTFT/TPOT split is the whole point of disaggre-
+            # gation, but this trace runs on a constant step clock so
+            # the gates stay deterministic — report the split in
+            # router STEPS (step-indexed, rerun-stable), not wall ms
+            ttfts, tpots = [], []
+            for j, h in enumerate(hs):
+                if j not in first_step:
+                    continue
+                ttfts.append(first_step[j])
+                if j in finish_step and len(h.tokens) > 1:
+                    tpots.append((finish_step[j] - first_step[j])
+                                 / (len(h.tokens) - 1))
+            counters = {
+                "handoffs": [s["handoffs"] for s in stats],
+                "handoff_blocks": [s["handoff_blocks"]
+                                   for s in stats],
+                "handoff_bytes": [s["handoff_bytes"] for s in stats],
+                "prefills": [s["prefills"] for s in stats],
+                "prefill_chunks": [
+                    sum(e.kind == "prefill_chunk"
+                        for e in rec.events()) for rec in recs],
+                "decode_blocks": [
+                    sum(e.kind == "decode_block"
+                        for e in rec.events()) for rec in recs],
+                "router_handoff_blocks": sum(
+                    int(e.attrs.get("blocks", 0))
+                    for e in rrec.events() if e.kind == "handoff"),
+            }
+            return {
+                "roles": [s["role"] for s in stats],
+                "counters": counters,
+                "mean_ttft_steps": round(
+                    float(np.mean(ttfts)), 2) if ttfts else None,
+                "mean_tpot_steps": round(
+                    float(np.mean(tpots)), 2) if tpots else None,
+                "wall_ms": round(1e3 * wall, 1),
+            }, outs
+
+        dg_mono, dg_mono_outs = _one_disagg_trace(["both", "both"])
+        dg_a, dg_a_outs = _one_disagg_trace(["prefill", "decode"])
+        dg_b, dg_b_outs = _one_disagg_trace(["prefill", "decode"])
+        ca, cb = dg_a["counters"], dg_b["counters"]
+        # chunk-final count on the prefill replica: every request
+        # that decoded past tok0 must have handed off exactly once
+        # (tok0-terminal requests finish locally, never migrate)
+        dg_expect_handoffs = sum(len(o) > 1 for o in dg_a_outs)
+        disagg = {
+            "replicas": 2, "n_requests": len(dg_prompts),
+            "max_new": dg_new,
+            "monolithic": dg_mono,
+            "disagg": dg_a,
+            "gate_token_exact": bool(all(
+                np.array_equal(a, b)
+                for a, b in zip(dg_mono_outs, dg_a_outs))),
+            "gate_handoffs_exact": bool(
+                ca["handoffs"][0] == dg_expect_handoffs
+                and dg_expect_handoffs > 0
+                and ca["handoffs"][1] == 0),
+            "gate_parcel_blocks_exact": bool(
+                ca["router_handoff_blocks"]
+                == ca["handoff_blocks"][0] > 0),
+            "gate_no_prefill_on_decode": bool(
+                ca["prefill_chunks"][1] == 0
+                and ca["prefills"][1] == 0
+                and ca["prefill_chunks"][0] > 0),
+            "gate_deterministic": bool(ca == cb),
+        }
+    except Exception as e:                      # keep the bench JSON whole
+        disagg = {"error": str(e)[:300]}
+
     return {
         "tokens_per_s": cont["tokens_per_s"],
         "p50_latency_ms": cont["p50_latency_ms"],
@@ -2706,6 +2835,7 @@ def _bench_serving(on_tpu):
         "fleet_obs": fleet_obs_ab,
         "multichip": multichip,
         "multiproc": multiproc,
+        "disagg": disagg,
         "spec": {
             "k": sp_k, "max_new": sp_new, "n_requests": sp_n,
             "tokens_per_s": spec_on["tokens_per_s"],

@@ -222,9 +222,27 @@ class EngineHost:
         if kind == "step":
             self._arm_step_faults()
             terminal = e.step(now=obj.get("now"))
+            handoffs = [r for r in getattr(e, "take_handoffs",
+                                           lambda: [])()]
             updates = [self._update_of(rid)
                        for rid in sorted(self._track)]
+            # the handoff parcels ship through the SAME diff as
+            # preemption swaps (tracked + "swapped" + tier entry);
+            # the reply's "handoffs" rid list is what tells the proxy
+            # they are chunk-final handoffs awaiting router pickup
             parcels, pplanes, unstaged = self._parcel_diff()
+            hand_ids = []
+            for r in handoffs:
+                hand_ids.append(int(r.request_id))
+                # once shipped, the client's staged planes are the
+                # authoritative bytes — drop the server copy and stop
+                # tracking (the router rebinds the request to its
+                # decode replica via migrate_in, a fresh rid there)
+                e._host_tier.drop(r.swap.host_key)
+                self._track.pop(r.request_id, None)
+                self._shipped.pop(r.request_id, None)
+            if handoffs:
+                e._update_host_gauge()
             term_ids = [int(r.request_id) for r in terminal]
             for rid in term_ids:
                 self._track.pop(rid, None)
@@ -232,6 +250,7 @@ class EngineHost:
             return self._reply("stepped", {
                 "updates": updates, "parcels": parcels,
                 "unstaged": unstaged, "terminal": term_ids,
+                "handoffs": hand_ids,
                 "step_idx": int(e._step_idx)}, tuple(pplanes))
         if kind == "load_report":
             return self._reply("load", e.load_report())
@@ -532,7 +551,8 @@ def tiny_llama_engine(*, seed: int = 1234, num_slots: int = 2,
                       prompt_len: int = 32, max_cache_len: int = 48,
                       block_len: int = 4, num_blocks: int = 16,
                       chunk_len: int = 4, engine_seed: int = 0,
-                      with_fault_injector: bool = False):
+                      with_fault_injector: bool = False,
+                      role: str = "both"):
     """Deterministic tiny-llama ``ServingEngine`` — the importable
     factory ``EngineProcess`` children build from (and the bench's
     in-process reference builds from, so socket-vs-reference token
@@ -560,7 +580,7 @@ def tiny_llama_engine(*, seed: int = 1234, num_slots: int = 2,
         seed=int(engine_seed), registry=MetricsRegistry(),
         flight_recorder=FlightRecorder(),
         fault_injector=FaultInjector() if with_fault_injector
-        else None)
+        else None, role=str(role))
 
 
 def _resolve_factory(spec: str):

@@ -450,6 +450,23 @@ class Router:
                 raise ValueError(
                     f"replica {i} pad_token_id {e.cfg.pad_token_id} "
                     f"!= replica 0's {e0.cfg.pad_token_id}")
+        # disaggregation roles (ROADMAP item 2): the ONE homogeneity
+        # exemption — roles are routing policy, not geometry.  Fresh
+        # arrivals need a prefill-capable replica; a fleet with any
+        # "prefill" replica needs a decode-capable one to hand off to,
+        # or every chunk-final parcel would wait forever.
+        self._roles = [str(getattr(e, "role", "both"))
+                       for e in self._engines]
+        if not any(r in ("prefill", "both") for r in self._roles):
+            raise ValueError(
+                f"no prefill-capable replica (roles={self._roles}) — "
+                f"fresh arrivals could never be placed")
+        if any(r == "prefill" for r in self._roles) and \
+                not any(r in ("decode", "both") for r in self._roles):
+            raise ValueError(
+                f"prefill-role replicas but no decode-capable one "
+                f"(roles={self._roles}) — chunk-final handoffs could "
+                f"never be placed")
         self.affinity = bool(affinity)
         self.max_queue = None if max_queue is None else int(max_queue)
         if self.max_queue is not None and self.max_queue < 1:
@@ -481,6 +498,11 @@ class Router:
         self._next_probe = [0] * len(self._engines)
         self._probation_until = [0] * len(self._engines)
         self._recover: List[dict] = []
+        # chunk-final handoff records awaiting a decode-capable
+        # placement (the disaggregation twin of _recover: same parcel
+        # staging, same migrate_in placement, no retry-budget charge —
+        # a handoff is scheduled work, not a fault)
+        self._handoffs: List[dict] = []
         # the router-owned staging tier migration parcels ride
         # through: HostTier.transfer moves the victim's exact
         # at-rest bytes here BEFORE its crash_reset drops the source
@@ -725,11 +747,17 @@ class Router:
             return False
         rec = next((r for r in self._recover if r["handle"] is pr),
                    None)
+        lane = self._recover
+        if rec is None:
+            rec = next((r for r in self._handoffs
+                        if r["handle"] is pr), None)
+            lane = self._handoffs
         if rec is not None:
-            # cancelled while its failover recovery awaited placement
-            # (unbound: not in the router queue, not on any engine) —
-            # drop the record and its staged parcel
-            self._recover.remove(rec)
+            # cancelled while its failover recovery or chunk-final
+            # handoff awaited placement (unbound: not in the router
+            # queue, not on any engine) — drop the record and its
+            # staged parcel
+            lane.remove(rec)
             if rec["parcel"] is not None:
                 self._stage.drop(rec["parcel"]["skey"])
             pr._terminate("cancelled", self._clock())
@@ -761,7 +789,16 @@ class Router:
         self._m.queue_depth.set(len(self._queue))
 
     # -- routing --
-    def _choose(self, pr: RoutedRequest):
+    def _phase_ok(self, ei: int, phase: str) -> bool:
+        """Can replica ``ei`` serve ``phase`` work?  ``"prefill"`` =
+        fresh prompts (roles "prefill"/"both"), ``"decode"`` =
+        resumed decode parcels (roles "decode"/"both").  An all-
+        ``"both"`` fleet passes every phase — the role layer is then
+        inert and routing is byte-identical to the pre-role router."""
+        role = self._roles[ei]
+        return role == "both" or role == phase
+
+    def _choose(self, pr: RoutedRequest, phase: str = "prefill"):
         """Pick a replica order for ``pr`` (best first) plus each
         candidate's affinity metadata ``meta[engine] = (prefix_tokens,
         adapter_hit)`` — the decision instruments/event must describe
@@ -771,9 +808,12 @@ class Router:
         -blocks_free, index)`` — load primary, affinity a strict
         tie-break (see module docstring); round-robin mode cycles the
         cursor (every candidate's metadata is zero: affinity was
-        never consulted)."""
+        never consulted).  ``phase`` is the disaggregation routing
+        key: fresh arrivals (including ``embed`` — prefill IS its
+        product) consider only prefill-capable replicas, handoff and
+        decode-parcel placements only decode-capable ones."""
         routable = [i for i, s in enumerate(self._health)
-                    if s != "unhealthy"]
+                    if s != "unhealthy" and self._phase_ok(i, phase)]
         if not routable:
             return [], {}
         n = len(routable)
@@ -985,7 +1025,14 @@ class Router:
         pending, self._recover = self._recover, []
         for rec in pending:
             h = rec["handle"]
-            order, _meta = self._choose(h)
+            # phase-aware destination set: a decode-phase parcel can
+            # only resume on a decode-capable replica; prefill-phase
+            # parcels and the recompute/requeue paths re-run prompt
+            # chunks, so they need a prefill-capable one
+            need = ("decode" if rec["parcel"] is not None
+                    and rec["parcel"]["phase"] == "decode"
+                    else "prefill")
+            order, _meta = self._choose(h, phase=need)
             placed = False
             for ei in order:
                 eng = self._engines[ei]
@@ -1044,6 +1091,116 @@ class Router:
             if not placed:
                 self._recover.append(rec)
 
+    # -- disaggregation: chunk-final handoff orchestration --
+    def _collect_handoffs(self, ei: int):
+        """Pick up every request replica ``ei`` staged at chunk-final
+        (``ServingEngine.take_handoffs``): move its KV parcel into the
+        router-owned staging tier — EXACTLY the failover migration
+        staging, the parcel is preempt-reason host bytes either way —
+        unbind the handle (its emitted ``tok0`` becomes the handle's
+        own truth, so the stream view stays monotonic while the
+        request is between replicas) and queue the placement record.
+        No retry-budget charge: a handoff is scheduled work, not a
+        fault."""
+        eng = self._engines[ei]
+        take = getattr(eng, "take_handoffs", None)
+        if take is None:
+            return
+        for req in take():
+            h = self._by_engine.pop((ei, req.request_id), None)
+            if h is None:
+                continue        # router never saw it (direct submit)
+            skey = eng._host_tier.transfer(req.swap.host_key,
+                                           self._stage)
+            upd = getattr(eng, "_update_host_gauge", None)
+            if upd is not None:        # local engines only; a remote
+                upd()                  # proxy's server updates its own
+            rec = {
+                "handle": h,
+                "samp_base": (None if req.samp_base is None
+                              else np.array(req.samp_base)),
+                "tokens": [int(x) for x in req.tokens],
+                "first_token_time": req.first_token_time,
+                "src": ei,
+                "parcel": None if skey is None else {
+                    "skey": skey,
+                    "n_blocks": req.swap.n_blocks,
+                    "tok": req.swap.tok, "lens": req.swap.lens,
+                    "phase": "decode",
+                    "pf_pos": req.pf_pos,
+                },
+            }
+            h._unbind(rec["tokens"])
+            h._replay = list(rec["tokens"])
+            if rec["parcel"] is None:
+                # parcel unreachable (a remote proxy whose staging
+                # never landed): recover like a failover recompute —
+                # the position-keyed PRNG replays tok0 bit-identically
+                rec["path"] = "recompute"
+                rec["was_queued"] = False
+                self._recover.append(rec)
+                continue
+            self._handoffs.append(rec)
+
+    def _place_handoffs(self, now: float):
+        """Place every staged handoff on a decode-capable replica:
+        stage-tier parcel -> destination host tier
+        (``HostTier.transfer``) -> ``migrate_in`` parks it on the
+        destination's swap list, where ``_try_resume`` re-scatters the
+        exact bytes and decode continues token-for-token (the
+        ``tok0``/``seq_len`` carries travel in the parcel).  A
+        destination refusing with ``AdmissionError`` spills to the
+        next candidate; when every decode-capable replica refuses,
+        the record waits for the next step — parcels are host bytes,
+        waiting costs nothing but latency."""
+        if not self._handoffs:
+            return
+        pending, self._handoffs = self._handoffs, []
+        for rec in pending:
+            h = rec["handle"]
+            if h.state in TERMINAL_STATES:
+                # cancelled while awaiting placement; the parcel was
+                # already dropped by cancel()
+                continue
+            order, _meta = self._choose(h, phase="decode")
+            placed = False
+            for ei in order:
+                eng = self._engines[ei]
+                kw = dict(h._kw)
+                # already admitted once (PR 7: once admitted, a
+                # request always runs to completion)
+                kw["max_queue_delay_s"] = None
+                p = rec["parcel"]
+                key = self._stage.transfer(p["skey"], eng._host_tier)
+                parcel = {"key": key, "n_blocks": p["n_blocks"],
+                          "tok": p["tok"], "lens": p["lens"],
+                          "phase": p["phase"], "pf_pos": p["pf_pos"]}
+                try:
+                    req = eng.migrate_in(
+                        h._ids, **kw, samp_base=rec["samp_base"],
+                        tokens=rec["tokens"],
+                        first_token_time=rec["first_token_time"],
+                        parcel=parcel)
+                except AdmissionError:
+                    rec["parcel"]["skey"] = eng._host_tier.transfer(
+                        key, self._stage)
+                    continue
+                except BaseException:
+                    rec["parcel"]["skey"] = eng._host_tier.transfer(
+                        key, self._stage)
+                    self._handoffs.append(rec)
+                    raise
+                h._bind(ei, req)
+                self._by_engine[(ei, req.request_id)] = h
+                self._fr.emit(
+                    "handoff", h.router_id, self._step_idx,
+                    engine=ei, src=rec["src"],
+                    blocks=int(p["n_blocks"]), rid=req.request_id)
+                placed = True
+                break
+            if not placed:
+                self._handoffs.append(rec)
+
     def _probe_replicas(self, now: float):
         """Probe due unhealthy replicas: a tiny 1-token request driven
         to completion on the candidate alone.  Pass -> the replica
@@ -1058,13 +1215,24 @@ class Router:
             ok = False
             probe = None
             try:
-                probe = eng.submit(np.zeros((1,), np.int32),
-                                   max_new_tokens=1, arrival_time=now)
-                for _ in range(8):
+                if self._roles[ei] == "decode":
+                    # a decode-role replica rejects fresh submits by
+                    # POLICY, so the 1-token probe request could never
+                    # pass — probe the crash surface instead: a dead
+                    # or poisoned replica faults on step/load_report,
+                    # a healthy one answers both
                     eng.step(now)
-                    if probe.state in TERMINAL_STATES:
-                        break
-                ok = probe.state == "finished"
+                    eng.load_report()
+                    ok = True
+                else:
+                    probe = eng.submit(np.zeros((1,), np.int32),
+                                       max_new_tokens=1,
+                                       arrival_time=now)
+                    for _ in range(8):
+                        eng.step(now)
+                        if probe.state in TERMINAL_STATES:
+                            break
+                    ok = probe.state == "finished"
             except REPLICA_FAULT_ERRORS:
                 eng.crash_reset()
             except AdmissionError:
@@ -1127,6 +1295,7 @@ class Router:
         if self.failover:
             self._probe_replicas(t_now)
             self._place_recoveries(t_now)
+        self._place_handoffs(t_now)
         self._route_arrived(t_now)
         for ei, e in enumerate(self._engines):
             if self._health[ei] == "unhealthy":
@@ -1136,6 +1305,7 @@ class Router:
             except REPLICA_FAULT_ERRORS as err:
                 self._fail_over(ei, err, t_now, out)
                 continue
+            self._collect_handoffs(ei)
             for req in stepped:
                 h = self._by_engine.get((ei, req.request_id))
                 if h is not None:
@@ -1144,6 +1314,11 @@ class Router:
             if self._health[ei] == "probation" and \
                     self._step_idx >= self._probation_until[ei]:
                 self._set_health(ei, "healthy")
+        # same-step placement: a chunk-final collected from a
+        # prefill replica this iteration lands on its decode replica
+        # before the step returns, so disaggregation costs at most
+        # one router step of handoff latency, never a full spin
+        self._place_handoffs(t_now)
         if self._monitor is not None:
             self._monitor.observe(
                 step=self._step_idx,
@@ -1157,8 +1332,8 @@ class Router:
 
     def _idle(self) -> bool:
         """No replica holds queued/active/swapped work and no
-        failover recovery awaits placement."""
-        if self._recover:
+        failover recovery or chunk-final handoff awaits placement."""
+        if self._recover or self._handoffs:
             return False
         for e in self._engines:
             rep = e.load_report()
@@ -1178,6 +1353,7 @@ class Router:
                 f"without draining: router-held={len(self._queue)} "
                 f"(arrived={sum(p.arrival_time <= now for p in self._queue)}), "
                 f"recoveries pending={len(self._recover)}, "
+                f"handoffs pending={len(self._handoffs)}, "
                 f"health={self._health}, replicas: {per}")
 
     def run(self, max_iters: Optional[int] = None,
@@ -1244,6 +1420,10 @@ class Router:
             "failover": self.failover,
             "health": list(self._health),
             "recoveries_pending": len(self._recover),
+            # disaggregation (PR 20): per-replica phase roles plus
+            # chunk-final handoffs awaiting a decode-capable slot
+            "roles": list(self._roles),
+            "handoffs_pending": len(self._handoffs),
             "replica_faults": int(
                 self._m.since_init(self._m.replica_faults)),
             "failover_requests": int(
@@ -1309,6 +1489,10 @@ class Router:
                 (sg["label"] if (sg := getattr(e, "shard_group",
                                                None)) is not None
                  else "single") for e in self._engines],
+            # per-replica phase roles (PR 20): "both" for monolithic
+            # replicas, "prefill"/"decode" under disaggregation —
+            # same order as load_reports/health
+            "roles": list(self._roles),
             "router": self.stats(),
         }
         # per-replica transport counters (PR 19): None for local

@@ -358,6 +358,21 @@ SHED_REASONS = ("evicted", "rejected")
 # membership but skips dead-entry detection for this one.
 CANCEL_PHASES = ("queued", "prefill", "decode", "swapped", "router")
 
+# why a request left a prefill-role replica with its KV parcel instead
+# of decoding in place (serving.handoff.requests{reason=}).  Today the
+# only trigger is the disaggregation point itself — the prompt's final
+# chunk sampled tok0, so decode belongs on a decode-capable replica —
+# kept closed so dashboards can assert no undocumented handoff exists.
+HANDOFF_REASONS = ("chunk_final",)
+
+# the role axis of disaggregated serving (ROADMAP item 2): "both" is
+# the monolithic default (byte-identical to every pre-role trace),
+# "prefill" replicas run prompt chunks and hand each request off at
+# its final chunk, "decode" replicas only ever resume migrated
+# parcels — they reject fresh submits and never dispatch a prefill
+# chunk.
+ENGINE_ROLES = ("prefill", "decode", "both")
+
 # sub-ms resolution for the host-vs-dispatch step split: on real
 # accelerators the host scheduler slice this histogram isolates is the
 # tens-of-microseconds gap the dispatch-ahead pipeline (ROADMAP item 2)
@@ -456,6 +471,29 @@ class _ServingInstruments:
             "peak footprint in blocks); reason='preempt' = swapped "
             "requests awaiting resume, reason='cache' = demoted "
             "prefix-cache spans", labels=("reason",))
+        self.handoff_requests = r.counter(
+            "serving.handoff.requests",
+            "requests that left a prefill-role replica with their KV "
+            "parcel staged for a decode replica instead of decoding "
+            "in place, by closed reason vocabulary (HANDOFF_REASONS: "
+            "today only 'chunk_final' — the disaggregation point "
+            "itself)", labels=("reason",))
+        self.handoff_blocks = r.counter(
+            "serving.handoff.blocks",
+            "KV blocks gathered into handoff parcels at chunk-final "
+            "(exact at-rest bytes; the decode replica re-scatters "
+            "the same count, so a fleet's migrated-block ledger "
+            "balances)")
+        self.handoff_bytes = r.counter(
+            "serving.handoff.bytes",
+            "at-rest KV bytes (codes + scale planes for the int8 "
+            "cache) gathered into handoff parcels at chunk-final")
+        self.role = r.gauge(
+            "serving.role",
+            "1 for this engine's disaggregation role ('prefill', "
+            "'decode', or the monolithic default 'both'); a fleet "
+            "registry's per-label sum counts replicas by role",
+            labels=("role",))
         self.shed = r.counter(
             "serving.shed.requests",
             "requests shed by the bounded queue: 'evicted' = a queued "
@@ -742,6 +780,8 @@ class _ServingInstruments:
                   self.preempts, self.preempt_resumes,
                   self.swap_out_blocks, self.swap_in_blocks,
                   self.swap_out_bytes, self.swap_in_bytes,
+                  self.handoff_requests, self.handoff_blocks,
+                  self.handoff_bytes,
                   self.shed, self.timeouts,
                   self.goodput_useful, self.goodput_wasted,
                   self.goodput_dispatched,
@@ -1324,8 +1364,18 @@ class ServingEngine:
                  registry=None, max_queue=None, enable_preemption=True,
                  fault_injector=None, flight_recorder=None,
                  async_dispatch=True, async_depth=1,
-                 adapter_store=None, tenant_weights=None, mesh=None):
+                 adapter_store=None, tenant_weights=None, mesh=None,
+                 role="both"):
         self.num_slots = int(num_slots)
+        # disaggregation role (ROADMAP item 2): pure POLICY over the
+        # landed exact-bytes migration mechanism.  "both" (default) is
+        # byte-identical to every pre-role trace; "prefill" hands each
+        # request off at its final chunk; "decode" only ever resumes
+        # migrated parcels (fresh submits are rejected at the door).
+        self.role = str(role)
+        if self.role not in ENGINE_ROLES:
+            raise ValueError(
+                f"role must be one of {ENGINE_ROLES}, got {role!r}")
         self.max_queue = None if max_queue is None else int(max_queue)
         if self.max_queue is not None and self.max_queue < 1:
             raise ValueError(
@@ -1667,6 +1717,19 @@ class ServingEngine:
         self._m.shard_groups.set(1 if self.shard_group is not None else 0)
         self._m.shard_width.set(self._shard.n_shards
                                 if self._shard is not None else 1)
+        self._m.role.set(1, role=self.role)
+        # chunk-final handoff staging (prefill-role engines only):
+        # requests whose final chunk just sampled tok0 and whose KV
+        # parcel now sits in the host tier awaiting router pickup
+        # (Router._place_handoffs drains this via take_handoffs())
+        self._handoff_ready = []
+        # step-rate estimate for the arrival-aware fused window
+        # (_step_inner): the last explicit step(now=) value and the
+        # last observed positive now-delta; 0.0 = no estimate (wall-
+        # clock-driven or first steps), which keeps the conservative
+        # queued-arrival fusing block
+        self._last_now = None
+        self._step_dt = 0.0
         self._peak_queue = 0
         self._peak_blocks = 0
         # per-request flight recorder: every lifecycle transition emits
@@ -2329,6 +2392,14 @@ class ServingEngine:
         recovers it): incremental tokens drain through ``read()`` at
         the engine's harvest boundaries, token-for-token identical to
         the non-streamed output — see the TokenStream docstring."""
+        if self.role == "decode":
+            # role enforcement at the door: a decode replica owns no
+            # prefill budget — fresh prompts belong on a prefill-
+            # capable replica; only migrate_in() parcels land here
+            raise AdmissionError(
+                "decode-role engine does not accept fresh submits "
+                "(prompts route to prefill-capable replicas; this "
+                "replica only resumes migrated KV parcels)")
         ids = np.asarray(getattr(prompt_ids, "_value", prompt_ids))
         ids = np.asarray(ids).reshape(-1).astype(np.int32)
         if ids.size < 1 or ids.size > self.prompt_len:
@@ -2808,7 +2879,10 @@ class ServingEngine:
         stripped = {
             "queued": list(self._queue),
             "active": [r for r in self._slots if r is not None],
-            "swapped": list(self._swapped),
+            # handoff-ready requests are swapped-by-phase: their
+            # parcel is host-tier-staged exactly like a preemption's,
+            # so the failover layer migrates them the same way
+            "swapped": list(self._swapped) + list(self._handoff_ready),
         }
         for r in stripped["active"]:
             if r.adapter_slot is not None:
@@ -2817,6 +2891,7 @@ class ServingEngine:
         self._queue.clear()
         self._prefilling.clear()
         self._swapped = []
+        self._handoff_ready = []
         self._slots = [None] * self.num_slots
         self._pend_q.clear()
         self._lazy_parcels = []
@@ -3906,6 +3981,15 @@ class ServingEngine:
             self._release_blocks(req)
             self._finish(req, t, out)
             return
+        if self.role == "prefill":
+            # the disaggregation point (ROADMAP item 2): a prefill-
+            # role replica never decodes in place — gather the
+            # request's KV parcel at exact at-rest bytes and stage it
+            # for router pickup; the chosen decode replica resumes
+            # token-exact through the unchanged migrate_in/_try_resume
+            # path (tok0 travels in the parcel's tok carry)
+            self._handoff_out(req, tok0, slot)
+            return
         req.state = "decode"
         self._tok[slot] = tok0
         self._lens[slot] = req.seq_len
@@ -3914,6 +3998,56 @@ class ServingEngine:
         # emits) and all progress happens in the verify dispatch, which
         # reads its own host-side truth (req.tokens / self._lens)
         self._done[slot] = req.spec_k is not None
+
+    def _handoff_out(self, req: Request, tok0: int, slot: int):
+        """Chunk-final handoff swap-out (prefill-role engines only):
+        the ``_preempt`` gather applied at the moment the final chunk
+        sampled ``tok0`` — exact at-rest bytes into the host tier, a
+        ``_SwapRecord`` with the DECODE-phase carries (``tok=tok0``,
+        ``lens=seq_len``), blocks/slot released — except the request
+        parks on the handoff-ready list for ``take_handoffs()``
+        instead of this engine's own swap list: its decode belongs to
+        another replica now.  No pipeline flush is needed: the final
+        chunk already synced (reason ``chunk_final``) before
+        dispatching, and its outputs materialized with ``tok0``."""
+        ids = self._tables[slot].copy()     # BEFORE release trashes it
+        n = len(req.blocks)
+        with _span("serving.handoff_out", request=req.request_id,
+                   blocks=n):
+            rows = [np.ascontiguousarray(r[:n])
+                    for r in self._gather_rows(ids)]
+        key = self._host_tier.put(rows, n, "preempt")
+        req.swap = _SwapRecord(host_key=key, n_blocks=n,
+                               tok=int(tok0), lens=int(req.seq_len),
+                               state="decode")
+        self._release_blocks(req)
+        self._slots[slot] = None
+        self._done[slot] = True
+        req.slot = None
+        req.state = "swapped"
+        self._handoff_ready.append(req)
+        nbytes = n * self.block_len * self._kv_row_bytes
+        self._m.handoff_requests.inc(reason="chunk_final")
+        self._m.handoff_blocks.inc(n)
+        self._m.handoff_bytes.inc(nbytes)
+        self._update_host_gauge()
+        self._m.slot_occupancy.set(
+            sum(r is not None for r in self._slots))
+        _span_instant("serving.request.handoff",
+                      request=req.request_id, blocks=n)
+        self._fr.emit("handoff", req.request_id, self._step_idx,
+                      blocks=n, reason="chunk_final")
+
+    def take_handoffs(self) -> List[Request]:
+        """Drain the chunk-final handoff staging: requests whose KV
+        parcel awaits a decode replica (state ``"swapped"``, parcel in
+        this engine's host tier under ``req.swap.host_key``).  The
+        caller — the router's handoff orchestration — owns them after
+        this call: it transfers each parcel through its staging tier
+        and places the request via the destination's ``migrate_in``.
+        Empty on every step of a ``"both"``/``"decode"`` engine."""
+        out, self._handoff_ready = self._handoff_ready, []
+        return out
 
     def _lora_donate(self, lora_on: bool, donate=None):
         """Arena donation positions of a serving program: the ``lora``
@@ -4205,6 +4339,17 @@ class ServingEngine:
         finished: List[Request] = self._flush_finishes
         self._flush_finishes = []
         t_now = self._clock() if now is None else now
+        # step-rate estimate for the arrival-aware fused window:
+        # tracked ONLY from explicit step(now=) clocks (the
+        # deterministic-trace contract) — a wall-clock-driven engine
+        # must never size windows from its own nondeterministic rate
+        if now is not None:
+            if self._last_now is not None and t_now > self._last_now:
+                self._step_dt = t_now - self._last_now
+            self._last_now = t_now
+        else:
+            self._step_dt = 0.0
+            self._last_now = None
         if self._fault is not None:
             # replica-fatal faults raise BEFORE any scheduling work
             # mutates state: a killed/wedged replica did not run this
@@ -4334,12 +4479,30 @@ class ServingEngine:
         # finish bitmap freezes the row in-trace and the harvest
         # re-splits the window iteration by iteration.
         iters = 1
+        fuse_cap = self.async_depth
+        if self._queue:
+            # a queued request normally blocks fusing outright (its
+            # admission is an event inside the window).  Arrival-aware
+            # sizing (PR 14's open follow-on): when every queued entry
+            # is a known FUTURE arrival and the trace drives step(now=)
+            # on a monotonic clock, the last observed per-step
+            # now-delta bounds the steps until the earliest arrival —
+            # fuse min(S, steps_until_arrival), so the window SHRINKS
+            # to close at the arrival step instead of degrading to
+            # unfused.  Already-arrived entries (or no step-rate
+            # estimate) keep the conservative outright block.
+            fuse_cap = 0
+            if self._step_dt > 0 and \
+                    all(r.arrival_time > t_now for r in self._queue):
+                nxt = min(r.arrival_time for r in self._queue)
+                until = int(-(-(nxt - t_now) // self._step_dt))
+                fuse_cap = min(self.async_depth, until)
         if (self.async_depth > 1 and not masked
                 and not self._prefilling and not self._swapped
-                and not self._queue
+                and fuse_cap > 1
                 and min_budget > self.async_depth * n
                 and self._block_sync_reason(n, active, lag) is None):
-            iters = self.async_depth
+            iters = fuse_cap
         n_total = n * iters
         active_set = set(active)
         riding = [self._slots[i] if i in active_set else None
@@ -4678,6 +4841,19 @@ class ServingEngine:
             "dispatched_tokens": dispatched,
             "goodput": (useful / dispatched if dispatched else 0.0),
             "wasted_by_reason": dict(self._wasted_reason),
+            # the goodput ledger's handoff lane: requests that left
+            # this (prefill-role) engine at chunk-final with their KV
+            # parcel instead of decoding in place.  Deliberately NOT a
+            # wasted_by_reason entry — a handoff moves exact bytes and
+            # recomputes nothing, and these counters are the proof
+            # (zero on every "both"/"decode" engine)
+            "handoffs": int(
+                self._m.since_init(self._m.handoff_requests)),
+            "handoff_blocks": int(
+                self._m.since_init(self._m.handoff_blocks)),
+            "handoff_bytes": int(
+                self._m.since_init(self._m.handoff_bytes)),
+            "role": self.role,
             "mean_tpot_s": (sum(tpots) / len(tpots)) if tpots else None,
             "slo_attained": int(
                 self._m.since_init(self._m.slo_attained)),
@@ -4755,6 +4931,10 @@ class ServingEngine:
             # geometry so the router's fleet_snapshot()/stats() carry
             # which shard group served what without a second probe
             "shard_group": self.shard_group,
+            # disaggregation role (ROADMAP item 2): the router's
+            # phase-routing key — "prefill"/"both" replicas take
+            # fresh arrivals, "decode"/"both" take handoff parcels
+            "role": self.role,
         }
 
     def engine_spec(self) -> dict:
@@ -4782,6 +4962,9 @@ class ServingEngine:
             "adapters": (None if self._adapters is None
                          else list(self._adapters.names())),
             "shard_group": self.shard_group,
+            # disaggregation role: rides the PR-19 welcome frame so a
+            # multi-process fleet phase-routes exactly like a local one
+            "role": self.role,
         }
 
     def prefix_match(self, prompt_ids) -> int:

@@ -659,6 +659,9 @@ class RemoteReplica:
         self._kv_row_bytes = int(spec["kv_row_bytes"])
         self.cfg = _RemoteCfg(spec["pad_token_id"])
         self.shard_group = spec.get("shard_group")
+        # phase role rides the handshake (PR 20): pre-role servers
+        # never send it, and "both" keeps them routable everywhere
+        self.role = str(spec.get("role", "both"))
         adapters = spec.get("adapters")
         self._adapters = (None if adapters is None
                           else _RemoteAdapters(adapters))
@@ -667,6 +670,7 @@ class RemoteReplica:
         self._host_tier = HostTier()
         self._reqs: Dict[int, RemoteRequest] = {}
         self._staged: Dict[int, int] = {}      # rid -> local tier key
+        self._handoff_ready: List[RemoteRequest] = []
         self._registry = _RemoteRegistry(self, spec["registry_key"])
 
     # -- geometry helpers the router calls client-side --
@@ -757,11 +761,28 @@ class RemoteReplica:
                 req.pf_pos = int(p["pf_pos"])
                 req.preempt_count += 1
         self._drop_staged(obj.get("unstaged", ()))
+        # chunk-final handoffs (PR 20): the reply names which of this
+        # step's parcels are handoffs (vs pressure preemptions) — the
+        # server already dropped ITS copy, the staged local planes
+        # are now the authoritative bytes awaiting router pickup
+        for rid in obj.get("handoffs", ()):
+            req = self._reqs.get(int(rid))
+            if req is not None:
+                self._handoff_ready.append(req)
         out = []
         for rid in obj.get("terminal", ()):
             req = self._reqs.get(int(rid))
             if req is not None:
                 out.append(req)
+        return out
+
+    def take_handoffs(self) -> List[RemoteRequest]:
+        """Drain the chunk-final handoff mirrors staged by ``step``
+        replies — the router ``transfer``s each parcel out of this
+        proxy's tier, so the staged-key map entry goes with it."""
+        out, self._handoff_ready = self._handoff_ready, []
+        for req in out:
+            self._staged.pop(req.request_id, None)
         return out
 
     def crash_reset(self) -> dict:
@@ -785,6 +806,7 @@ class RemoteReplica:
         for key in list(self._staged.values()):
             self._host_tier.drop(key)
         self._staged.clear()
+        self._handoff_ready = []
         return stripped
 
     def migrate_in(self, prompt_ids, *, seq_len, max_new_tokens,

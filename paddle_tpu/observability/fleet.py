@@ -143,7 +143,8 @@ def stitch_flight_records(records: Sequence, *,
     bindings: Dict[Tuple[int, int], List[int]] = {}
     if router_events is not None:
         for e in sorted(router_events, key=lambda e: e.seq):
-            if e.kind not in ("route", "migrate", "retry"):
+            if e.kind not in ("route", "migrate", "retry",
+                              "handoff"):
                 continue
             ei, rid = e.attrs.get("engine"), e.attrs.get("rid")
             if ei is None or rid is None:
@@ -330,6 +331,21 @@ class StitchedRecord:
                 parts.append(
                     f"failed over to engine {a.get('engine', '?')} "
                     f"({how}, attempt {a.get('attempt', '?')})")
+            elif k == "handoff":
+                if rep == ROUTER_LANE:
+                    parts.append(
+                        f"prefilled on engine {a.get('src', '?')}, "
+                        f"handed off "
+                        f"{_plural(int(a.get('blocks', 0)), 'block')} "
+                        f"to engine {a.get('engine', '?')} at "
+                        f"chunk-final")
+                elif not has_router:
+                    parts.append(
+                        f"handed off "
+                        f"{_plural(int(a.get('blocks', 0)), 'block')} "
+                        f"at chunk-final from engine {rep}")
+                # engine-side handoff after a router handoff is the
+                # same hop — the router clause names both endpoints
             elif k == "finish":
                 extra = (f" after {_plural(int(a['tokens']), 'token')}"
                          if "tokens" in a else "")

@@ -69,6 +69,11 @@ EVENT_KINDS = frozenset({
     #                   (attrs: engine=dest, src, blocks)
     "retry",          # re-placed on a healthy replica (attrs:
     #                   engine=dest, path=recompute|requeue, attempt)
+    "handoff",        # disaggregated chunk-final handoff: prefill
+    #                   replica -> decode replica through the router
+    #                   stage (router event attrs: engine=dest, src,
+    #                   blocks, rid; engine event attrs: blocks,
+    #                   reason — same parcel, two vantage points)
     "alert",          # fleet monitor alarm (observability.fleet
     #                   SLOBurnRateMonitor): attrs carry kind
     #                   (ALERT_KINDS) + deterministic context; request
@@ -387,6 +392,21 @@ def explain_events(events: List[FlightEvent], request_id: int) -> str:
             f"(migrated "
             f"{_plural(int(mg.attrs.get('blocks', 0)), 'block')} "
             f"at exact bytes)")
+    for ho in by_kind.get("handoff", []):
+        src = ho.attrs.get("src")
+        if src is not None:
+            # the router's vantage: it knows both endpoints
+            parts.append(
+                f"prefilled on engine {src}, handed off "
+                f"{_plural(int(ho.attrs.get('blocks', 0)), 'block')} "
+                f"to engine {ho.attrs.get('engine', '?')} at "
+                f"chunk-final")
+        else:
+            # a single engine's vantage: it only knows it let go
+            parts.append(
+                f"handed off "
+                f"{_plural(int(ho.attrs.get('blocks', 0)), 'block')} "
+                f"at chunk-final for decode elsewhere")
     for rt in by_kind.get("retry", []):
         how = ("recomputed from prompt"
                if rt.attrs.get("path") == "recompute"

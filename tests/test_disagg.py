@@ -1,0 +1,413 @@
+"""Disaggregated prefill/decode serving (PR 20): role-typed
+replicas, phase-aware routing and the chunk-final KV handoff —
+roles as pure POLICY over the PR-15 migration mechanism.
+
+Covers: construction/submit guards and the closed vocabularies, the
+"both"-fleet byte-identity contract (the role layer is inert for
+monolithic fleets), the end-to-end 1-prefill + 1-decode handoff
+trace (token-exact vs the monolithic twin, counters, narration,
+stitched story, serving_top), handoff composing with failover across
+the loopback wire (a decode replica killed mid-stream after a
+handoff recovers token-exact), and the arrival-aware fused-window
+guard (the PR-14 follow-on: the window SHRINKS to close at a known
+future arrival instead of degrading to unfused).
+
+Tier-1 budget: ONE tiny 1-layer llama at module scope, private
+registries/recorders everywhere, geometries shared with the router /
+depth test files so compiled programs are cache-warm."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.inference import (FaultInjector, Router,
+                                  ServingEngine)
+from paddle_tpu.inference.serving import (ENGINE_ROLES,
+                                          HANDOFF_REASONS,
+                                          TERMINAL_STATES,
+                                          AdmissionError)
+from paddle_tpu.inference.procserve import EngineHost
+from paddle_tpu.inference.transport import (LoopbackTransport,
+                                            RemoteReplica)
+from paddle_tpu.observability import MetricsRegistry
+from paddle_tpu.observability.fleet import stitch_flight_records
+from paddle_tpu.observability.flightrec import (FlightRecorder,
+                                                explain_events)
+from tools.serving_top import check as top_check
+from tools.serving_top import render as top_render
+
+P, C, BL = 32, 48, 4
+
+
+@pytest.fixture(scope="module")
+def netm():
+    paddle.seed(1234)
+    cfg = models.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64)
+    net = models.LlamaForCausalLM(cfg)
+    net.eval()
+    return cfg, net
+
+
+def _gen_ref(net, ids, max_new):
+    out = net.generate(paddle.to_tensor(ids[None, :]),
+                       max_new_tokens=max_new, max_cache_len=C,
+                       compute_dtype="float32")
+    return np.asarray(out._value)[0]
+
+
+def _mk(net, *, registry=None, recorder=None, injector=None, **kw):
+    return ServingEngine(
+        net, num_slots=2, prompt_len=P, max_cache_len=C,
+        steps_per_call=1, block_len=BL, chunk_len=4, num_blocks=16,
+        compute_dtype="float32", clock=lambda: 0.0,
+        registry=registry if registry is not None else MetricsRegistry(),
+        flight_recorder=recorder, fault_injector=injector, **kw)
+
+
+def _drain(rt, handles, *, max_steps=200, audit=True):
+    steps = 0
+    while any(h.state not in TERMINAL_STATES for h in handles):
+        rt.step(now=0.0)
+        if audit:
+            for e in rt.engines:
+                e._pool.check()
+        steps += 1
+        assert steps < max_steps, [h.state for h in handles]
+
+
+def test_role_units(netm):
+    """Dispatch-free surface: the closed vocabularies, engine role
+    validation, the decode-role submit guard and the router's fleet
+    composition guards."""
+    cfg, net = netm
+    assert set(ENGINE_ROLES) == {"prefill", "decode", "both"}
+    assert set(HANDOFF_REASONS) == {"chunk_final"}
+
+    with pytest.raises(ValueError, match="role"):
+        _mk(net, role="embedder")
+
+    # a decode-role engine owns no prefill path — fresh submits are
+    # refused at the door (typed, so the router can route around it)
+    dec = _mk(net, role="decode")
+    ids = np.arange(1, 7, dtype=np.int32)
+    with pytest.raises(AdmissionError, match="decode-role"):
+        dec.submit(ids, max_new_tokens=4, arrival_time=0.0)
+    assert dec.stats()["role"] == "decode"
+
+    # fleet composition guards: every fleet needs a prefill-capable
+    # replica, and prefill-role replicas need a decode-capable sink
+    with pytest.raises(ValueError, match="prefill-capable"):
+        Router([_mk(net, role="decode")], registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="decode-capable"):
+        Router([_mk(net, role="prefill")],
+               registry=MetricsRegistry())
+    # "both" alone and prefill+decode pairs are valid
+    Router([_mk(net, role="both")], registry=MetricsRegistry())
+    Router([_mk(net, role="prefill"), _mk(net, role="decode")],
+           registry=MetricsRegistry())
+
+
+def _fleet_trace(net, cfg, roles, *, explicit=True):
+    """The shared 5-request trace through a 2-replica fleet; returns
+    (router, engines, router recorder, per-engine recorders, outputs
+    sorted by router id)."""
+    recs = [FlightRecorder() for _ in roles]
+    rrec = FlightRecorder()
+    if explicit:
+        engs = [_mk(net, recorder=rec, role=role)
+                for role, rec in zip(roles, recs)]
+    else:
+        engs = [_mk(net, recorder=rec) for rec in recs]
+    rt = Router(engs, registry=MetricsRegistry(),
+                flight_recorder=rrec)
+    rng = np.random.default_rng(7)
+    hs = []
+    for i in range(5):
+        ids = rng.integers(1, 100, size=6 + 2 * i).astype(np.int32)
+        hs.append(rt.submit(ids, max_new_tokens=4 + i,
+                            arrival_time=0.0, stream=False))
+    _drain(rt, hs, audit=not any(
+        isinstance(e, RemoteReplica) for e in engs))
+    outs = [list(h.tokens)
+            for h in sorted(hs, key=lambda h: h.router_id)]
+    return rt, engs, rrec, recs, outs
+
+
+def test_both_role_fleet_byte_identity(netm):
+    """role="both" is the monolithic default: a fleet built with the
+    role spelled out schedules BYTE-IDENTICALLY to one that never
+    mentions roles — same outputs, same flight-recorder sequences,
+    same dispatch counters.  The role layer is policy; for "both"
+    fleets it is inert."""
+    cfg, net = netm
+    rt_a, engs_a, rrec_a, recs_a, outs_a = _fleet_trace(
+        net, cfg, ["both", "both"], explicit=True)
+    rt_b, engs_b, rrec_b, recs_b, outs_b = _fleet_trace(
+        net, cfg, ["both", "both"], explicit=False)
+    assert outs_a == outs_b
+
+    def story(rec):
+        return [(e.kind, e.request, e.step) for e in rec.events()]
+
+    assert story(rrec_a) == story(rrec_b)       # admission order too
+    for ra, rb in zip(recs_a, recs_b):
+        assert story(ra) == story(rb)
+    for ea, eb in zip(engs_a, engs_b):
+        sa, sb = ea.stats(), eb.stats()
+        for k in ("role", "prefills", "block_dispatches", "handoffs",
+                  "handoff_blocks", "handoff_bytes"):
+            assert sa[k] == sb[k], k
+        assert sa["handoffs"] == 0              # nobody hands off
+    assert rt_a.stats()["roles"] == ["both", "both"]
+    assert rt_a.stats()["handoffs_pending"] == 0
+
+
+def test_disagg_handoff_token_exact(netm, tmp_path, capsys):
+    """THE disaggregation trace: 1 prefill + 1 decode replica vs the
+    monolithic 2x"both" twin.  Every multi-token request prefills on
+    the prefill replica, hands its KV parcel off through the router
+    stage at chunk-final and decodes on the decode replica —
+    token-for-token equal to the twin (and generate() on a greedy
+    row), with exact handoff counters, ZERO prefill work on the
+    decode replica, narrated handoff hops in both the router explain
+    and the stitched fleet story, and serving_top rendering the role
+    census."""
+    cfg, net = netm
+    rt_m, engs_m, rrec_m, recs_m, outs_m = _fleet_trace(
+        net, cfg, ["both", "both"])
+    rt_d, engs_d, rrec_d, recs_d, outs_d = _fleet_trace(
+        net, cfg, ["prefill", "decode"])
+    assert outs_m == outs_d                     # token-exact arms
+    # greedy rows are generate()-exact through the handoff
+    rng = np.random.default_rng(7)
+    ids0 = rng.integers(1, 100, size=6).astype(np.int32)
+    assert np.array_equal(
+        np.asarray(outs_d[0]), _gen_ref(net, ids0, 4))
+
+    sp, sd = engs_d[0].stats(), engs_d[1].stats()
+    assert sp["role"] == "prefill" and sd["role"] == "decode"
+    # every request decoded past tok0 handed off exactly once;
+    # nothing ever hands off FROM the decode replica
+    assert sp["handoffs"] == sum(len(o) > 1 for o in outs_d) == 5
+    assert sd["handoffs"] == 0
+    assert sp["handoff_blocks"] > 0
+    assert sp["handoff_bytes"] == \
+        sp["handoff_blocks"] * BL * engs_d[0]._kv_row_bytes
+    # zero prefill work on the decode replica — the isolation claim
+    assert sd["prefills"] == 0
+    assert not [e for e in recs_d[1].events()
+                if e.kind == "prefill_chunk"]
+    # router handoff events: one per migration, parcel blocks exact
+    hos = [e for e in rrec_d.events() if e.kind == "handoff"]
+    assert len(hos) == 5
+    assert all(e.attrs["src"] == 0 and e.attrs["engine"] == 1
+               for e in hos)
+    assert sum(int(e.attrs["blocks"]) for e in hos) == \
+        sp["handoff_blocks"]
+    assert rt_d.stats()["handoffs_pending"] == 0
+
+    # narration: the router's vantage names both endpoints
+    rid = hos[0].request
+    text = explain_events(rrec_d.events(), rid)
+    assert ("prefilled on engine 0, handed off" in text
+            and "to engine 1 at chunk-final" in text)
+    # a lone engine's vantage only knows it let go
+    eho = [e for e in recs_d[0].events() if e.kind == "handoff"][0]
+    etext = explain_events(recs_d[0].events(), eho.request)
+    assert "at chunk-final for decode elsewhere" in etext
+    assert eho.attrs["reason"] == "chunk_final"
+    # the stitched fleet story covers the hop exactly once, with the
+    # engine-side duplicate folded into the router clause
+    st = stitch_flight_records(recs_d, router=rrec_d)
+    story = st.explain(rid)
+    assert story.count("handed off") == 1
+    assert "prefilled on engine 0" in story
+    assert "to engine 1 at chunk-final" in story
+
+    # the explain_request CLI tells the same story from exported
+    # records: the stitched sentence names both endpoints, and
+    # --timeline shows the router-lane handoff hop
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "explain_request", os.path.join(
+            os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            "tools", "explain_request.py"))
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+    paths = []
+    for i, rec in enumerate(recs_d):
+        pth = str(tmp_path / f"rep{i}.json")
+        rec.export(pth)
+        paths.append(pth)
+    rpath = str(tmp_path / "router.json")
+    rrec_d.export(rpath)
+    assert cli.main(paths + [str(rid), "--router", rpath]) == 0
+    out = capsys.readouterr().out
+    assert "prefilled on engine 0" in out
+    assert "to engine 1 at chunk-final" in out
+    assert cli.main(paths + [str(rid), "--router", rpath,
+                             "--timeline"]) == 0
+    out = capsys.readouterr().out
+    assert "handoff" in out and "[on router]" in out
+
+    # serving_top: the role census renders and the checker is clean
+    snap = rt_d.fleet_snapshot()
+    assert snap["roles"] == ["prefill", "decode"]
+    assert top_check(snap) == []
+    text = top_render(snap)
+    assert "role=prefill" in text and "role=decode" in text
+    assert "disagg: prefill=1 decode=1" in text
+    # monolithic fleets don't render a census (roles stay quiet)
+    mono_text = top_render(rt_m.fleet_snapshot())
+    assert "disagg:" not in mono_text and "role=" not in mono_text
+
+
+def test_handoff_then_decode_failover_loopback(netm):
+    """Handoff COMPOSES with failover, across the wire: 1 prefill +
+    2 decode replicas behind loopback transports; a decode replica is
+    killed mid-stream AFTER requests handed off onto it.  The router
+    recovers them through the unchanged PR-15 path (staged parcels
+    migrate to the surviving decode replica; unstaged ones recompute
+    on the prefill replica and hand off AGAIN at chunk-final) —
+    outputs token-exact vs the identical no-fault twin."""
+    cfg, net = netm
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(n),)).astype(
+        np.int32) for n in rng.integers(6, 12, 4)]
+    new = 12
+
+    def run(inject):
+        roles = ["prefill", "decode", "decode"]
+        engs, injs = [], []
+        for r in roles:
+            inj = FaultInjector()
+            engs.append(_mk(net, role=r, injector=inj))
+            injs.append(inj)
+        reps = [RemoteReplica(LoopbackTransport(
+            EngineHost(e, label=f"r{i}"), registry=MetricsRegistry()))
+            for i, e in enumerate(engs)]
+        assert [r.role for r in reps] == roles  # rides the welcome
+        rrec = FlightRecorder()
+        rt = Router(reps, registry=MetricsRegistry(),
+                    flight_recorder=rrec)
+        hs = [rt.submit(p, max_new_tokens=new, arrival_time=0.0)
+              for p in prompts]
+        vi = None
+        if inject:
+            # step until a handed-off request is decoding on a
+            # decode replica, then kill that replica mid-stream
+            for _ in range(30):
+                rt.step(now=0.0)
+                vi = next((h.engine for h in hs
+                           if h.engine in (1, 2)
+                           and h.state == "decode"), None)
+                if vi is not None:
+                    break
+            assert vi is not None, "no handoff landed"
+            injs[vi].kill_at_step(engs[vi]._step_idx + 1)
+        steps = 0
+        while any(h.state not in TERMINAL_STATES for h in hs):
+            rt.step(now=0.0)
+            steps += 1
+            assert steps < 400, [h.state for h in hs]
+        return (rt, reps, engs, hs,
+                [np.asarray(h.output) for h in hs])
+
+    _rt0, _r0, _e0, _hs0, ref = run(inject=False)
+    rt, reps, engs, hs, outs = run(inject=True)
+    assert all(h.state == "finished" for h in hs)
+    assert all(np.array_equal(a, b) for a, b in zip(ref, outs))
+    rs = rt.stats()
+    assert rs["replica_faults"] == 1
+    assert rs["roles"] == ["prefill", "decode", "decode"]
+    # the prefill replica handed off every request at least once (a
+    # recomputed victim hands off a second time at chunk-final)
+    assert engs[0].stats()["handoffs"] >= len(prompts)
+    assert rs["failover_requests"] >= 1
+    # no parcel left behind anywhere: router stage + proxy tiers
+    assert rs["handoffs_pending"] == 0
+    assert all(len(r._host_tier.keys()) == 0 for r in reps)
+
+
+def test_arrival_aware_fused_window_shrink(netm):
+    """The PR-14 follow-on guard: a queued FUTURE arrival no longer
+    blocks fusing outright.  On a monotonic step(now=) clock the
+    engine bounds steps-until-arrival with its observed step rate
+    and fuses min(S, steps_until_arrival) — the window SHRINKS to
+    close at the arrival step.  Already-arrived queue entries (and
+    clock-less traces) keep the conservative outright block."""
+    cfg, net = netm
+    rng = np.random.default_rng(42)
+    ids1 = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    ids2 = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+
+    def mk():
+        return ServingEngine(
+            net, num_slots=2, prompt_len=8, max_cache_len=40,
+            steps_per_call=1, block_len=BL, chunk_len=4,
+            num_blocks=12, compute_dtype="float32",
+            registry=MetricsRegistry(),
+            flight_recorder=FlightRecorder(),
+            async_dispatch=True, async_depth=3)
+
+    def newest(e):
+        # _pend_q[-1] is the window dispatched THIS step (the
+        # deferred-harvest queue holds up to S in-flight windows)
+        return e._pend_q[-1] if e._pend_q else None
+
+    # -- arm A: monotonic clock, future arrival -> shrunk window --
+    eng = mk()
+    r1 = eng.submit(ids1, max_new_tokens=24, arrival_time=0.0)
+    t = 0.0
+    for _ in range(6):      # admit + 2 prefill chunks + steady decode
+        eng.step(now=t)
+        t += 1.0
+    assert r1.state == "decode" and eng._step_dt == 1.0
+    # steady solo fused windows run at full depth S=3
+    assert newest(eng) is not None and newest(eng).iters == 3
+    # a request 2 steps out shrinks the NEXT window to 2 iterations
+    r2 = eng.submit(ids2, max_new_tokens=3, arrival_time=t + 2.0)
+    eng.step(now=t)
+    assert newest(eng) is not None and newest(eng).iters == 2
+    t += 1.0
+    # 1 step out: a 1-iteration window is just an unfused dispatch
+    eng.step(now=t)
+    assert newest(eng) is None or newest(eng).iters == 1
+    t += 1.0
+    eng.step(now=t)         # the arrival step admits r2
+    assert r2.state != "queued"
+    t += 1.0
+    steps = 0
+    while any(r.state not in TERMINAL_STATES for r in (r1, r2)):
+        eng.step(now=t)
+        t += 1.0
+        steps += 1
+        assert steps < 100
+    eng.run()
+    eng._pool.check()
+    # fusing never bent tokens: greedy rows stay generate()-exact
+    ref1 = net.generate(paddle.to_tensor(ids1[None, :]),
+                        max_new_tokens=24, max_cache_len=40,
+                        compute_dtype="float32")
+    assert np.array_equal(np.asarray(r1.tokens),
+                          np.asarray(ref1._value)[0])
+
+    # -- arm B: same trace on a CONSTANT clock -> no step-rate
+    # estimate, the queued entry blocks fusing outright --
+    eng_b = mk()
+    rb1 = eng_b.submit(ids1, max_new_tokens=24, arrival_time=0.0)
+    for _ in range(6):
+        eng_b.step(now=0.0)
+    assert eng_b._step_dt == 0.0
+    assert newest(eng_b) is not None and newest(eng_b).iters == 3
+    eng_b.submit(ids2, max_new_tokens=3, arrival_time=2.0)
+    eng_b.step(now=0.0)
+    assert newest(eng_b) is None or newest(eng_b).iters == 1
+    # tokens agree with arm A regardless of window sizing
+    assert list(rb1.tokens) == list(r1.tokens)[:len(rb1.tokens)]

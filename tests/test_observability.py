@@ -663,6 +663,7 @@ def test_metrics_name_lint_clean():
              "serving.weights.", "pallas.quantized_matmul.",
              "serving.fleet.", "serving.alerts",
              "serving.shard.", "serving.transport.",
+             "serving.handoff.", "serving.role",
              "pallas.decode_attention.route",
              "serving.tpot_seconds")), n
         assert n in names, n

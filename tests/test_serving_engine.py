@@ -935,3 +935,25 @@ def test_bench_llm_serving_section():
     assert mcp["dp"]["shard_groups"] == ["tp2@d0", "tp2@d2"]
     for k in ("scaling", "tokens_per_s", "per_replica_occupancy"):
         assert k in mcp["dp"], k
+    # PR 20: the disaggregated prefill/decode arm — deterministic
+    # counter gates only (token-exact vs the monolithic fleet, exact
+    # chunk-final handoff count, parcel-block conservation through
+    # the router stage, zero prefill work on the decode replica,
+    # rerun-identical counters); TTFT/TPOT walls report-only
+    dg = out["disagg"]
+    assert "error" not in dg, dg.get("error")
+    for k in ("replicas", "n_requests", "max_new", "monolithic",
+              "disagg"):
+        assert k in dg, k
+    for arm in ("monolithic", "disagg"):
+        for k in ("roles", "counters", "mean_ttft_steps",
+                  "mean_tpot_steps", "wall_ms"):
+            assert k in dg[arm], (arm, k)
+    assert dg["disagg"]["roles"] == ["prefill", "decode"]
+    assert dg["gate_token_exact"]
+    assert dg["gate_handoffs_exact"]
+    assert dg["gate_parcel_blocks_exact"]
+    assert dg["gate_no_prefill_on_decode"]
+    assert dg["gate_deterministic"]
+    # the monolithic fleet never hands off — roles are pure policy
+    assert sum(dg["monolithic"]["counters"]["handoffs"]) == 0

@@ -201,6 +201,15 @@ REQUIRED_INSTRUMENTS = {
     "serving.transport.bytes_out": ("counter", ()),
     "serving.transport.bytes_in": ("counter", ()),
     "serving.transport.rpc_seconds": ("histogram", ()),
+    # disaggregated prefill/decode serving (PR 20, inference/serving.py
+    # _ServingInstruments): chunk-final handoff volume by closed reason
+    # vocabulary (HANDOFF_REASONS), the exact-bytes parcel footprint
+    # the bench disagg arm gates on, and the per-engine phase-role
+    # presence gauge (ENGINE_ROLES label values)
+    "serving.handoff.requests": ("counter", ("reason",)),
+    "serving.handoff.blocks": ("counter", ()),
+    "serving.handoff.bytes": ("counter", ()),
+    "serving.role": ("gauge", ("role",)),
 }
 
 

@@ -95,6 +95,14 @@ VOCABS: Tuple[VocabSpec, ...] = (
     # EngineHost._reply("<kind>", ...) site — dead-entry detection
     # stays ON, so a frame kind nothing emits is a lint failure
     VocabSpec("FRAME_KINDS"),
+    # disaggregated chunk-final handoffs (PR 20): every reason label
+    # the serving.handoff.requests counter can carry has a literal
+    # inc site in ServingEngine._handoff_out
+    VocabSpec("HANDOFF_REASONS"),
+    # per-engine phase roles (PR 20): asserted at construction, set
+    # once on the serving.role gauge — flows through self.role
+    # dynamically, so dead-entry detection cannot prove entries live
+    VocabSpec("ENGINE_ROLES", dead=False),
 )
 
 
@@ -167,6 +175,12 @@ MATCHERS: Tuple[Matcher, ...] = (
     Matcher("FRAME_KINDS", method="rpc", arg=0),
     Matcher("FRAME_KINDS", method="_reply", arg=0),
     Matcher("FRAME_KINDS", method="encode_frame", arg=0),
+    # chunk-final handoff counter labels (PR 20)
+    Matcher("HANDOFF_REASONS",
+            receivers=frozenset({"handoff_requests"}),
+            methods=frozenset({"inc"}), kwarg="reason"),
+    Matcher("ENGINE_ROLES", receivers=frozenset({"role"}),
+            methods=frozenset({"set"}), kwarg="role"),
 )
 
 

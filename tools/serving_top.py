@@ -53,14 +53,17 @@ def render(snapshot: dict) -> str:
                      f"{'kv':>8}")
         shards = snapshot.get("shard_groups", [])
         transports = snapshot.get("transport", [])
+        roles = snapshot.get("roles", [])
         for i, r in enumerate(reports):
             st = health[i] if i < len(health) else "?"
             blocks = (f"{r.get('blocks_in_use', 0)}/"
                       f"{r.get('blocks_total', 0)}")
-            # shard-group + transport identity suffixes (PR 18/19):
-            # omitted when single-chip / local, so pre-PR snapshots
-            # render unchanged
+            # shard-group / transport / role identity suffixes
+            # (PR 18/19/20): omitted when single-chip / local /
+            # monolithic, so pre-PR snapshots render unchanged
             tail = ""
+            if i < len(roles) and roles[i] != "both":
+                tail += f"  role={roles[i]}"
             if i < len(shards) and shards[i] != "single":
                 tail += f"  shard={shards[i]}"
             t = transports[i] if i < len(transports) else None
@@ -93,6 +96,17 @@ def render(snapshot: dict) -> str:
                 f"failed={router.get('failed', 0)} "
                 f"migrated_blocks={router.get('migrated_blocks', 0)} "
                 f"probes={router.get('probes', 0)}")
+        roles = snapshot.get("roles", [])
+        if any(ro != "both" for ro in roles):
+            # disaggregated fleet (PR 20): phase-role census + the
+            # handoff lane's placement backlog
+            census = " ".join(
+                f"{ro}={roles.count(ro)}"
+                for ro in ("prefill", "decode", "both")
+                if roles.count(ro))
+            lines.append(
+                f"  disagg: {census} "
+                f"handoffs_pending={router.get('handoffs_pending', 0)}")
 
     mon = snapshot.get("monitor")
     if mon:
@@ -213,6 +227,14 @@ def check(snapshot: dict) -> List[str]:
                 if not isinstance(t, dict) or "kind" not in t:
                     problems.append(
                         f"transport entry {i} lacks a transport kind")
+        roles = snapshot.get("roles")
+        if roles is not None:
+            if len(roles) != n:
+                problems.append(
+                    f"roles has {len(roles)} entries for {n} engines")
+            for i, ro in enumerate(roles):
+                if ro not in ("prefill", "decode", "both"):
+                    problems.append(f"unknown role {ro!r} at entry {i}")
     regs = snapshot.get("registries", {})
     if not isinstance(regs, dict):
         problems.append("registries is not a dict")
